@@ -1,0 +1,7 @@
+"""Native (C++) tier: the DCN host bridge for the multi-process backend.
+
+Replaces the reference's Cython XLA bridge
+(mpi4jax/_src/xla_bridge/mpi_xla_bridge*.pyx) with a C++ socket-based
+collective backend exposed through XLA FFI.  Built by
+``mpi4jax_tpu/native/build.py``; absent until built.
+"""

@@ -1,0 +1,167 @@
+"""Token plumbing and shared machinery for the communication ops.
+
+The reference threads an XLA token through every op and marks lowerings
+``has_side_effect=True`` so XLA cannot reorder or DCE communication
+(mpi4jax/_src/collective_ops/allreduce.py:58-66, _src/jax_compat.py:24-50;
+token misuse declared UB in docs/sharp-bits.rst:6-34).  On TPU the same
+ordering contract is expressed through *data dependence*: a
+:class:`Token` carries a scalar "stamp" array, and every op is fenced with
+``lax.optimization_barrier`` so its collective depends on the incoming
+stamp and the outgoing stamp depends on the collective's result.  Under
+SPMD, XLA schedules collectives in a program order consistent across all
+devices, so a connected token chain is sufficient to rule out cross-device
+mismatches and deadlocks.
+
+The token additionally carries the *pending-send queue*: in SPMD there is
+no per-rank control flow, so a ``send`` stages its payload on the token at
+trace time and the matching ``recv`` consumes it, emitting a single fused
+``ppermute`` (see :mod:`mpi4jax_tpu.ops.p2p`).  This materialises MPI's
+eager-send/matching-recv semantics at trace time instead of at runtime.
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.tree_util import register_pytree_node
+
+__all__ = [
+    "Token",
+    "create_token",
+    "as_token",
+    "token_array",
+    "ANY_SOURCE",
+    "ANY_TAG",
+]
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+
+
+@dataclass(frozen=True)
+class PendingSendMeta:
+    """Static descriptor of a staged send (aux data of the Token pytree)."""
+
+    perm: tuple  # tuple of (source_rank, dest_rank) pairs
+    tag: int
+    comm_key: tuple  # (backend, axes/context) identifying the communicator
+    shape: tuple
+    dtype: str
+
+
+class Token:
+    """Opaque ordering token returned by every communication op.
+
+    A pytree whose children are the ordering stamp plus any staged
+    (pending) send payloads; the matching metadata is static aux data.
+    """
+
+    def __init__(self, stamp=None, pending=(), pending_meta=()):
+        if stamp is None:
+            stamp = jnp.zeros((), jnp.float32)
+        self.stamp = stamp
+        self.pending = tuple(pending)
+        self.pending_meta = tuple(pending_meta)
+        if len(self.pending) != len(self.pending_meta):
+            raise ValueError("pending payloads and metadata out of sync")
+
+    def push_send(self, payload, meta):
+        return Token(
+            self.stamp,
+            self.pending + (payload,),
+            self.pending_meta + (meta,),
+        )
+
+    def pop_send(self, index):
+        """Remove pending send ``index``; returns (payload, meta, token)."""
+        payload = self.pending[index]
+        meta = self.pending_meta[index]
+        tok = Token(
+            self.stamp,
+            self.pending[:index] + self.pending[index + 1 :],
+            self.pending_meta[:index] + self.pending_meta[index + 1 :],
+        )
+        return payload, meta, tok
+
+    def with_stamp(self, stamp):
+        return Token(stamp, self.pending, self.pending_meta)
+
+    def assert_drained(self):
+        """Raise if sends were staged but never matched by a recv."""
+        if self.pending:
+            descs = [f"tag={m.tag} perm={m.perm}" for m in self.pending_meta]
+            raise RuntimeError(
+                "token still carries unmatched send(s): "
+                + "; ".join(descs)
+                + ". Every mpi4jax_tpu.send must be paired with a recv in "
+                "the same trace (SPMD programs are uniform across devices)."
+            )
+        return self
+
+    def __repr__(self):
+        return f"Token(pending={len(self.pending)})"
+
+
+def _token_flatten(tok):
+    return (tok.stamp, *tok.pending), tok.pending_meta
+
+
+def _token_unflatten(meta, children):
+    return Token(children[0], children[1:], meta)
+
+
+register_pytree_node(Token, _token_flatten, _token_unflatten)
+
+
+def create_token(arg=None):
+    """Create a fresh communication token.
+
+    ``arg`` is accepted (and ignored) for call-compatibility with
+    ``jax.lax.create_token`` / the reference examples.
+    """
+    del arg
+    return Token()
+
+
+def as_token(token):
+    """Coerce user-supplied token values (None / array / Token) to a Token."""
+    if token is None:
+        return Token()
+    if isinstance(token, Token):
+        return token
+    if isinstance(token, jax.Array) or hasattr(token, "dtype"):
+        return Token(jnp.asarray(token, jnp.float32).reshape(()) * 0)
+    raise TypeError(f"cannot interpret {type(token)} as a communication token")
+
+
+def token_array(token):
+    """The raw stamp array (for interop with array-token code)."""
+    return as_token(token).stamp
+
+
+def fence_in(token, *arrays):
+    """Make ``arrays`` depend on the token's stamp (pre-collective fence)."""
+    from mpi4jax_tpu.utils import config
+
+    if not config.fences_enabled():
+        return token, arrays
+    out = lax.optimization_barrier((token.stamp, *arrays))
+    return token.with_stamp(out[0]), out[1:]
+
+
+def fence_out(token, *arrays):
+    """Make the token's stamp depend on ``arrays`` (post-collective fence)."""
+    from mpi4jax_tpu.utils import config
+
+    if not config.fences_enabled():
+        return token, arrays
+    out = lax.optimization_barrier((token.stamp, *arrays))
+    return token.with_stamp(out[0]), out[1:]
+
+
+def comm_key(comm):
+    """Hashable identity of a communicator for send/recv matching."""
+    if comm.backend == "mesh":
+        return ("mesh", comm.axes, comm.context)
+    return (comm.backend, comm.context)

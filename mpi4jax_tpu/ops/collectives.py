@@ -1,0 +1,238 @@
+"""Collective ops: allgather, alltoall, barrier, bcast, gather, reduce,
+scan, scatter.
+
+API surface mirrors the reference one-to-one
+(mpi4jax/__init__.py:26-38); each docstring cites the matching reference
+op.  On the mesh backend every op is a composition of XLA ICI collectives
+(``all_gather`` / ``all_to_all`` / ``psum`` / ``ppermute``) inside the
+enclosing ``shard_map`` — data never leaves HBM.  Autodiff falls out of
+the underlying collectives' JAX rules, a superset of the reference (which
+defines AD only for allreduce and sendrecv).
+
+SPMD note (the MPMD↔SPMD gap, SURVEY §7): the reference's rooted ops have
+*rank-dependent output shapes* — e.g. gather returns ``(nproc, *shape)``
+on root and the input unchanged elsewhere
+(mpi4jax/_src/collective_ops/gather.py:74-87).  A single SPMD program must
+have uniform shapes, so here rooted ops return the root's result on
+*every* member: ``gather ≡ allgather``, ``reduce ≡ allreduce`` value-wise.
+Off-root values are well-defined (not garbage); programs written against
+the reference's root-only guarantees remain correct.  The multi-process
+backend preserves exact MPMD shapes.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4jax_tpu.ops import reductions
+from mpi4jax_tpu.ops._core import as_token, fence_in, fence_out
+from mpi4jax_tpu.ops.allreduce import allreduce
+from mpi4jax_tpu.utils.validation import check_comm, check_op, check_root
+
+__all__ = [
+    "allgather",
+    "alltoall",
+    "barrier",
+    "bcast",
+    "gather",
+    "reduce",
+    "scan",
+    "scatter",
+]
+
+
+def _prologue(x, comm, token):
+    comm = check_comm(comm)
+    token = as_token(token)
+    x = jnp.asarray(x) if x is not None else None
+    return x, comm, token
+
+
+def _unsupported(name, comm):
+    return NotImplementedError(
+        f"{name} not implemented for backend {comm.backend!r}"
+    )
+
+
+def allgather(x, *, comm=None, token=None):
+    """Gather ``x`` from every rank onto every rank.
+
+    Output shape is ``(comm.size, *x.shape)`` on all ranks (reference:
+    mpi4jax/_src/collective_ops/allgather.py:35-74, out shape at
+    :167-174).
+    """
+    x, comm, token = _prologue(x, comm, token)
+    if comm.backend == "self":
+        y = x[None]
+        token, (y,) = fence_out(token, y)
+        return y, token
+    if comm.backend == "mesh":
+        token, (x,) = fence_in(token, x)
+        y = lax.all_gather(x, comm.axes, axis=0, tiled=False)
+        token, (y,) = fence_out(token, y)
+        return y, token
+    raise _unsupported("allgather", comm)
+
+
+def alltoall(x, *, comm=None, token=None):
+    """All-to-all block exchange.
+
+    ``x`` must have leading dimension ``comm.size`` (checked eagerly, as
+    in the reference — mpi4jax/_src/collective_ops/alltoall.py:62-64);
+    output row ``j`` is rank ``j``'s row ``rank``.
+    """
+    x, comm, token = _prologue(x, comm, token)
+    if x.ndim == 0 or x.shape[0] != comm.size:
+        raise ValueError(
+            f"alltoall input must have leading dimension comm.size="
+            f"{comm.size}, got shape {x.shape}"
+        )
+    if comm.backend == "self":
+        token, (x,) = fence_out(token, x)
+        return x, token
+    if comm.backend == "mesh":
+        token, (x,) = fence_in(token, x)
+        y = lax.all_to_all(x, comm.axes, split_axis=0, concat_axis=0, tiled=True)
+        token, (y,) = fence_out(token, y)
+        return y, token
+    raise _unsupported("alltoall", comm)
+
+
+def barrier(*, comm=None, token=None):
+    """Synchronisation barrier; returns only a token (reference:
+    mpi4jax/_src/collective_ops/barrier.py:32-53).
+
+    On the mesh backend this is a zero-payload ``psum`` chained into the
+    token, forcing a cross-device rendezvous at this point in the program
+    order.
+    """
+    comm = check_comm(comm)
+    token = as_token(token)
+    if comm.backend == "self":
+        return token
+    if comm.backend == "mesh":
+        z = jnp.zeros((), jnp.int32)
+        token, (z,) = fence_in(token, z)
+        s = lax.psum(z, comm.axes)
+        token, _ = fence_out(token, s)
+        return token
+    raise _unsupported("barrier", comm)
+
+
+def bcast(x, root, *, comm=None, token=None):
+    """Broadcast ``x`` from ``root`` to every rank (reference:
+    mpi4jax/_src/collective_ops/bcast.py:36-72).
+
+    Implemented as a masked ``psum``: every non-root contribution is
+    zeroed, so one ICI all-reduce delivers the root's value everywhere.
+    """
+    x, comm, token = _prologue(x, comm, token)
+    root = check_root(root, comm)
+    if comm.backend == "self":
+        token, (x,) = fence_out(token, x)
+        return x, token
+    if comm.backend == "mesh":
+        token, (x,) = fence_in(token, x)
+        rank = lax.axis_index(comm.axes)
+        as_int = x.dtype == jnp.bool_
+        xv = x.astype(jnp.int8) if as_int else x
+        masked = jnp.where(rank == root, xv, jnp.zeros_like(xv))
+        y = lax.psum(masked, comm.axes)
+        if as_int:
+            y = y.astype(jnp.bool_)
+        token, (y,) = fence_out(token, y)
+        return y, token
+    raise _unsupported("bcast", comm)
+
+
+def gather(x, root, *, comm=None, token=None):
+    """Gather ``x`` from every rank to ``root`` (reference:
+    mpi4jax/_src/collective_ops/gather.py:36-87).
+
+    Mesh backend: output is ``(comm.size, *x.shape)`` on every rank (SPMD
+    uniform-shape note in the module docstring).
+    """
+    root = check_root(root, check_comm(comm))
+    del root  # value identical on every member under SPMD
+    return allgather(x, comm=comm, token=token)
+
+
+def reduce(x, op, root, *, comm=None, token=None):
+    """Reduce ``x`` with ``op`` to ``root`` (reference:
+    mpi4jax/_src/collective_ops/reduce.py:37-71).
+
+    Mesh backend: result is delivered on every rank (≡ allreduce).
+    """
+    op = check_op(op)
+    root = check_root(root, check_comm(comm))
+    del root
+    return allreduce(x, op, comm=comm, token=token)
+
+
+def scan(x, op, *, comm=None, token=None):
+    """Inclusive prefix reduction over ranks (MPI_Scan; reference:
+    mpi4jax/_src/collective_ops/scan.py:36-61).
+
+    XLA has no native prefix collective (SURVEY §7 hard part 4); this is a
+    Hillis–Steele ladder of ``ceil(log2(size))`` masked ``ppermute`` steps
+    over ICI.
+    """
+    x, comm, token = _prologue(x, comm, token)
+    op = check_op(op)
+    if comm.backend == "self":
+        token, (x,) = fence_out(token, x)
+        return x, token
+    if comm.backend == "mesh":
+        size = comm.size
+        token, (x,) = fence_in(token, x)
+        rank = lax.axis_index(comm.axes)
+        as_int = x.dtype == jnp.bool_
+        acc = x.astype(jnp.int8) if as_int else x
+        dist = 1
+        while dist < size:
+            perm = [(r, r + dist) for r in range(size - dist)]
+            shifted = lax.ppermute(acc, comm.axes, perm)
+            combined = op.combine(acc, shifted.astype(acc.dtype))
+            acc = jnp.where(rank >= dist, combined.astype(acc.dtype), acc)
+            dist *= 2
+        if as_int:
+            acc = acc.astype(jnp.bool_)
+        token, (acc,) = fence_out(token, acc)
+        return acc, token
+    raise _unsupported("scan", comm)
+
+
+def scatter(x, root, *, comm=None, token=None):
+    """Scatter rows of ``x`` from ``root`` (reference:
+    mpi4jax/_src/collective_ops/scatter.py:36-92).
+
+    ``x`` must have shape ``(comm.size, *rest)`` (the reference checks
+    this on root, scatter.py:77-81; under SPMD every member passes the
+    same template and only the root's values matter).  Returns the row at
+    index ``rank``.
+    """
+    x, comm, token = _prologue(x, comm, token)
+    root = check_root(root, comm)
+    if x.ndim == 0 or x.shape[0] != comm.size:
+        raise ValueError(
+            f"scatter input must have leading dimension comm.size="
+            f"{comm.size}, got shape {x.shape}"
+        )
+    if comm.backend == "self":
+        y = x[0]
+        token, (y,) = fence_out(token, y)
+        return y, token
+    if comm.backend == "mesh":
+        token, (x,) = fence_in(token, x)
+        rank = lax.axis_index(comm.axes)
+        as_int = x.dtype == jnp.bool_
+        xv = x.astype(jnp.int8) if as_int else x
+        masked = jnp.where(rank == root, xv, jnp.zeros_like(xv))
+        from_root = lax.psum(masked, comm.axes)
+        y = lax.dynamic_index_in_dim(from_root, rank, axis=0, keepdims=False)
+        if as_int:
+            y = y.astype(jnp.bool_)
+        token, (y,) = fence_out(token, y)
+        return y, token
+    raise _unsupported("scatter", comm)

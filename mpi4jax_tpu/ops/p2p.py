@@ -1,0 +1,296 @@
+"""Point-to-point ops: send, recv, sendrecv (+ Status).
+
+Reference API: mpi4jax/_src/collective_ops/send.py:37-60,
+recv.py:39-84, sendrecv.py:41-103.
+
+The MPMD→SPMD translation (SURVEY §7 hard part 1): the reference's p2p
+ops are *per-rank* calls — rank 0 runs ``send`` while rank 1 runs
+``recv`` in a different program.  A single SPMD program is uniform across
+devices, so here a p2p pattern is specified *globally*:
+
+* ``dest`` / ``source`` may be a **callable** ``rank -> partner`` (return
+  ``None`` to sit out, the MPI_PROC_NULL analog) or an explicit list of
+  ``(source_rank, dest_rank)`` pairs;
+* a plain ``int`` is only meaningful on size-1 / multi-process backends
+  — on a MeshComm it raises with guidance, since "every rank sends to
+  rank k" is not a permutation.
+
+``sendrecv`` lowers to one ``lax.ppermute`` over ICI.  Its transpose is
+the inverse permutation — exactly the reference's transpose rule that
+swaps source and dest so gradients travel the reverse network direction
+(sendrecv.py:366-385) — and unlike the reference, forward-mode also works
+(the reference hard-errors at sendrecv.py:128-133).
+
+Lone ``send``/``recv`` pairs are matched **at trace time** through the
+token: ``send`` stages its payload and pattern on the token's
+pending-send queue, and the matching ``recv`` pops it and emits the fused
+``ppermute``.  This reproduces MPI's eager-send/matching-recv semantics
+(including tag matching and FIFO message order per pattern) with zero
+runtime rendezvous cost.  The deadlock-freedom the reference must test
+for (tests/collective_ops/test_send_and_recv.py:104-117) holds by
+construction: a ppermute cannot deadlock.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4jax_tpu.ops._core import (
+    ANY_SOURCE,
+    ANY_TAG,
+    PendingSendMeta,
+    as_token,
+    comm_key,
+    fence_in,
+    fence_out,
+)
+from mpi4jax_tpu.utils.validation import check_comm, check_static_int
+
+__all__ = ["send", "recv", "sendrecv", "Status", "ANY_SOURCE", "ANY_TAG"]
+
+
+class Status:
+    """Output status for recv/sendrecv (MPI.Status analog).
+
+    ``source`` and ``tag`` are filled on return; ``source`` may be a
+    traced per-device value on the mesh backend.
+    """
+
+    def __init__(self):
+        self.source = None
+        self.tag = None
+
+
+def _resolve_pairs(spec, size, role):
+    """Normalise a p2p partner spec into (source, dest) pairs.
+
+    ``role`` is "dest" (spec maps rank -> where its data goes) or
+    "source" (spec maps rank -> where its data comes from).
+    """
+    if callable(spec):
+        pairs = []
+        for r in range(size):
+            p = spec(r)
+            if p is None:
+                continue
+            p = int(p)
+            if not 0 <= p < size:
+                raise ValueError(
+                    f"{role} callable returned rank {p} for rank {r}, out "
+                    f"of range for communicator of size {size}. Wrap "
+                    f"explicitly (e.g. (r + 1) % size) for periodic "
+                    f"patterns, or return None to sit out."
+                )
+            pairs.append((r, p) if role == "dest" else (p, r))
+        return pairs
+    if isinstance(spec, (list, tuple)) and all(
+        isinstance(e, (list, tuple)) and len(e) == 2 for e in spec
+    ):
+        return [(int(s), int(d)) for s, d in spec]
+    value = check_static_int(spec, role)
+    if size == 1:
+        if value != 0:
+            raise ValueError(
+                f"{role}={value} out of range for communicator of size 1"
+            )
+        return [(0, 0)]
+    raise ValueError(
+        f"{role}={value!r}: a bare integer rank is ambiguous under SPMD "
+        f"(all {size} devices would target the same rank, which is not a "
+        f"permutation). Pass a callable rank->partner (e.g. "
+        f"lambda r: (r + 1) % size), an explicit list of (source, dest) "
+        f"pairs, or use comm.shift_perm(axis, disp)."
+    )
+
+
+def _validate_perm(pairs, size, what):
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+        raise ValueError(f"{what} pattern is not a permutation: {pairs}")
+    for s, d in pairs:
+        if not (0 <= s < size and 0 <= d < size):
+            raise ValueError(f"{what} pattern rank out of range: {pairs}")
+    return pairs
+
+
+def _ppermute(x, axes, pairs):
+    if x.dtype == jnp.bool_:
+        return lax.ppermute(x.astype(jnp.int8), axes, pairs).astype(jnp.bool_)
+    return lax.ppermute(x, axes, pairs)
+
+
+def _recv_merge(permuted, template, pairs, size, axes):
+    """Ranks with no inbound message keep their recv buffer (MPI leaves
+    recvbuf untouched for MPI_PROC_NULL partners)."""
+    if len(pairs) == size:
+        return permuted
+    has_msg = np.zeros(size, bool)
+    for _, d in pairs:
+        has_msg[d] = True
+    rank = lax.axis_index(axes)
+    mask = jnp.asarray(has_msg)[rank]
+    return jnp.where(mask, permuted, template)
+
+
+def _static_source_of(pairs, size, axes):
+    src_of = np.full(size, ANY_SOURCE, np.int32)
+    for s, d in pairs:
+        src_of[d] = s
+    return jnp.asarray(src_of)[lax.axis_index(axes)]
+
+
+def send(x, dest, tag=0, *, comm=None, token=None):
+    """Stage a send of ``x`` along the ``dest`` pattern; returns a token
+    (reference: mpi4jax/_src/collective_ops/send.py:37-60 — returns token
+    only, send.py:139-140).
+
+    The payload rides the token until the matching :func:`recv` in the
+    same trace consumes it.
+    """
+    comm = check_comm(comm)
+    token = as_token(token)
+    tag = check_static_int(tag, "tag")
+    x = jnp.asarray(x)
+    pairs = _resolve_pairs(dest, comm.size, "dest")
+    _validate_perm(pairs, comm.size, "send dest")
+    meta = PendingSendMeta(
+        perm=tuple(sorted(pairs)),
+        tag=tag,
+        comm_key=comm_key(comm),
+        shape=tuple(x.shape),
+        dtype=str(x.dtype),
+    )
+    return token.push_send(x, meta)
+
+
+def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=None):
+    """Receive into the shape/dtype of template ``x`` (a template only —
+    arrays are immutable; reference: mpi4jax/_src/collective_ops/
+    recv.py:39-84, ANY defaults at recv.py:39-47).
+
+    Matches the earliest staged :func:`send` on the token whose
+    communicator, tag and pattern are compatible, and emits the fused
+    ``ppermute``.
+    """
+    comm = check_comm(comm)
+    token = as_token(token)
+    tag = check_static_int(tag, "tag")
+    x = jnp.asarray(x)
+    want_pairs = None
+    source_is_any = (
+        isinstance(source, (int, np.integer)) and int(source) == ANY_SOURCE
+    )
+    if not source_is_any:
+        want_pairs = frozenset(
+            _validate_perm(
+                _resolve_pairs(source, comm.size, "source"), comm.size, "recv source"
+            )
+        )
+
+    key = comm_key(comm)
+    for i, meta in enumerate(token.pending_meta):
+        if meta.comm_key != key:
+            continue
+        if tag != ANY_TAG and meta.tag != tag:
+            continue
+        if want_pairs is not None and frozenset(meta.perm) != want_pairs:
+            continue
+        if meta.shape != tuple(x.shape) or meta.dtype != str(x.dtype):
+            raise ValueError(
+                f"recv template shape/dtype {x.shape}/{x.dtype} does not "
+                f"match staged send {meta.shape}/{meta.dtype}"
+            )
+        payload, meta, token = token.pop_send(i)
+        pairs = list(meta.perm)
+        if comm.backend == "self":
+            token, (y,) = fence_out(token, payload)
+        elif comm.backend == "mesh":
+            token, (payload,) = fence_in(token, payload)
+            y = _ppermute(payload, comm.axes, pairs)
+            y = _recv_merge(y, x, pairs, comm.size, comm.axes)
+            token, (y,) = fence_out(token, y)
+        else:
+            raise NotImplementedError(
+                f"recv not implemented for backend {comm.backend!r}"
+            )
+        if status is not None:
+            if comm.backend == "self":
+                status.source, status.tag = 0, meta.tag
+            else:
+                status.source = _static_source_of(pairs, comm.size, comm.axes)
+                status.tag = meta.tag
+        return y, token
+
+    raise RuntimeError(
+        "recv found no matching in-trace send on this token. Under SPMD, "
+        "send and recv must be paired within the same trace (the send "
+        "stages its payload on the token; pass that token to recv). For "
+        "true cross-process MPMD p2p use the multi-process backend."
+    )
+
+
+def sendrecv(
+    sendbuf,
+    recvbuf,
+    source,
+    dest,
+    sendtag=0,
+    recvtag=ANY_TAG,
+    *,
+    comm=None,
+    token=None,
+    status=None,
+):
+    """Combined send+receive (reference: mpi4jax/_src/collective_ops/
+    sendrecv.py:41-103).
+
+    ``dest`` gives where each rank's ``sendbuf`` goes, ``source`` where
+    its ``recvbuf`` comes from; the two views must describe the same
+    global permutation.  Lowers to one ``lax.ppermute``; transposition
+    reverses the permutation (reference transpose rule:
+    sendrecv.py:366-385).
+    """
+    comm = check_comm(comm)
+    token = as_token(token)
+    check_static_int(sendtag, "sendtag")
+    check_static_int(recvtag, "recvtag")
+    sendbuf = jnp.asarray(sendbuf)
+    recvbuf = jnp.asarray(recvbuf)
+    if comm.backend == "self":
+        token, (y,) = fence_out(token, sendbuf)
+        if status is not None:
+            status.source, status.tag = 0, sendtag
+        return y, token
+    if comm.backend == "mesh":
+        if tuple(sendbuf.shape) != tuple(recvbuf.shape) or sendbuf.dtype != recvbuf.dtype:
+            raise ValueError(
+                "mesh-backend sendrecv requires uniform send/recv "
+                f"shapes and dtypes, got {sendbuf.shape}/{sendbuf.dtype} vs "
+                f"{recvbuf.shape}/{recvbuf.dtype}"
+            )
+        dpairs = _validate_perm(
+            _resolve_pairs(dest, comm.size, "dest"), comm.size, "sendrecv dest"
+        )
+        source_is_any = isinstance(source, (int, np.integer)) and int(source) == ANY_SOURCE
+        if not source_is_any:
+            spairs = _resolve_pairs(source, comm.size, "source")
+            if frozenset(spairs) != frozenset(dpairs):
+                raise ValueError(
+                    "sendrecv source and dest views disagree: "
+                    f"dest implies {sorted(dpairs)}, source implies "
+                    f"{sorted(spairs)}. They must describe one global "
+                    "permutation."
+                )
+        token, (payload,) = fence_in(token, sendbuf)
+        y = _ppermute(payload, comm.axes, dpairs)
+        y = _recv_merge(y, recvbuf, dpairs, comm.size, comm.axes)
+        token, (y,) = fence_out(token, y)
+        if status is not None:
+            status.source = _static_source_of(dpairs, comm.size, comm.axes)
+            status.tag = sendtag
+        return y, token
+    raise NotImplementedError(
+        f"sendrecv not implemented for backend {comm.backend!r}"
+    )

@@ -1,0 +1,19 @@
+from mpi4jax_tpu.parallel.comm import (
+    Comm,
+    MeshComm,
+    SelfComm,
+    default_comm,
+    get_default_comm,
+    set_default_comm,
+)
+from mpi4jax_tpu.parallel.proc import ProcComm
+
+__all__ = [
+    "Comm",
+    "MeshComm",
+    "SelfComm",
+    "ProcComm",
+    "default_comm",
+    "get_default_comm",
+    "set_default_comm",
+]

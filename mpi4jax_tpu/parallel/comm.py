@@ -1,0 +1,226 @@
+"""Communicator abstractions mapping MPI-style (rank, size) onto JAX meshes.
+
+The reference's communicator plumbing wraps live mpi4py objects and bakes
+their C handles into compiled executables
+(mpi4jax/_src/comm.py:4-11, mpi4jax/_src/utils.py:23-39).  Here a
+communicator is instead a *hashable description* of a group of devices, so
+it can ride along as a static primitive parameter and key compilation
+caches:
+
+* :class:`MeshComm` — a subgroup of a ``jax.sharding.Mesh`` identified by
+  mesh-axis names.  This is the TPU-native SPMD backend: ops called inside
+  ``jax.shard_map`` with these axes in scope lower to XLA ICI collectives
+  and never leave HBM.  ``rank()`` is a *traced* value
+  (``lax.axis_index``), matching SPMD semantics.
+* :class:`SelfComm` — the single-process world (size 1); ops become local
+  identities, mirroring the reference's behaviour under ``pytest`` with one
+  MPI process.
+* ``ProcComm`` (multi-process MPMD over the native DCN bridge) lives in
+  :mod:`mpi4jax_tpu.parallel.proc` and registers itself here.
+
+``clone()`` returns a communicator with a fresh ``context`` id — the
+analog of the reference's ``COMM_WORLD.Clone()`` default-communicator
+firewall (mpi4jax/_src/comm.py:4-11, docs/sharp-bits.rst:80-143).
+"""
+
+import itertools
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field, replace
+from math import prod
+
+import numpy as np
+
+__all__ = [
+    "Comm",
+    "MeshComm",
+    "SelfComm",
+    "get_default_comm",
+    "set_default_comm",
+    "default_comm",
+]
+
+_context_counter = itertools.count(1)
+
+
+class Comm:
+    """Abstract communicator. Subclasses must be hashable value objects."""
+
+    backend = None  # "mesh" | "self" | "proc"
+
+    @property
+    def size(self):
+        raise NotImplementedError
+
+    def rank(self):
+        """This process/device's rank in the communicator.
+
+        May be a traced value (mesh backend) or a Python int (self / proc).
+        """
+        raise NotImplementedError
+
+    def clone(self):
+        """New communicator over the same group with a fresh context id."""
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class SelfComm(Comm):
+    """The trivial single-member communicator (MPI_COMM_SELF analog)."""
+
+    context: int = 0
+
+    backend = "self"
+
+    @property
+    def size(self):
+        return 1
+
+    def rank(self):
+        return 0
+
+    def clone(self):
+        return SelfComm(context=next(_context_counter))
+
+
+@dataclass(frozen=True)
+class MeshComm(Comm):
+    """A communicator over one or more named axes of a device mesh.
+
+    Ranks are the row-major ravel of the member axes' indices, i.e.
+    ``rank = axis_index(axes)`` — the first axis in ``axes`` varies
+    slowest.  All collective ops called with a MeshComm must run inside a
+    ``jax.shard_map`` whose mesh has these axes.
+    """
+
+    axes: tuple
+    axis_sizes: tuple
+    context: int = 0
+    # Convenience only (not part of identity): lets model code build
+    # shard_maps from the comm.  Excluded from eq/hash.
+    mesh: object = field(default=None, compare=False, repr=False)
+
+    backend = "mesh"
+
+    def __post_init__(self):
+        if isinstance(self.axes, str):
+            object.__setattr__(self, "axes", (self.axes,))
+        object.__setattr__(self, "axes", tuple(self.axes))
+        object.__setattr__(self, "axis_sizes", tuple(int(s) for s in self.axis_sizes))
+        if len(self.axes) != len(self.axis_sizes):
+            raise ValueError("axes and axis_sizes must have equal length")
+
+    @classmethod
+    def from_mesh(cls, mesh, axes=None):
+        """Build a MeshComm spanning ``axes`` (default: all) of ``mesh``."""
+        if axes is None:
+            axes = tuple(mesh.axis_names)
+        elif isinstance(axes, str):
+            axes = (axes,)
+        sizes = tuple(mesh.shape[a] for a in axes)
+        return cls(axes=tuple(axes), axis_sizes=sizes, mesh=mesh)
+
+    @property
+    def size(self):
+        return prod(self.axis_sizes)
+
+    def rank(self):
+        from jax import lax
+
+        return lax.axis_index(self.axes)
+
+    def clone(self):
+        return replace(self, context=next(_context_counter))
+
+    def sub(self, *axes):
+        """Sub-communicator over a subset of axes (MPI_Cart_sub analog).
+
+        E.g. on a ``("y", "x")`` comm, ``comm.sub("x")`` is the row
+        communicator: collectives over it run independently per y-index.
+        """
+        for a in axes:
+            if a not in self.axes:
+                raise ValueError(f"axis {a!r} not in {self.axes}")
+        sizes = tuple(self.axis_sizes[self.axes.index(a)] for a in axes)
+        # Keep the context id: a sub-communicator of a clone must stay in
+        # the clone's message namespace (the firewall the clone creates).
+        return MeshComm(
+            axes=tuple(axes),
+            axis_sizes=sizes,
+            context=self.context,
+            mesh=self.mesh,
+        )
+
+    # -- topology helpers -------------------------------------------------
+
+    def rank_grid(self):
+        """ndarray of shape ``axis_sizes`` holding each coordinate's rank."""
+        return np.arange(self.size).reshape(self.axis_sizes)
+
+    def coords_of(self, rank):
+        """Static inverse of the rank ravel: rank -> axis coordinates."""
+        return tuple(np.unravel_index(rank, self.axis_sizes))
+
+    def shift_perm(self, axis, disp, periodic=True):
+        """(source, dest) pairs shifting data by ``disp`` along ``axis``.
+
+        The returned permutation moves each rank's data to the rank whose
+        coordinate along ``axis`` is ``disp`` greater (mod the axis size if
+        ``periodic``).  Non-periodic shifts drop the wrapping pairs, so
+        edge ranks receive nothing: recv/sendrecv then return their recv
+        buffer (template) unchanged, matching MPI_PROC_NULL semantics.
+        """
+        ax = self.axes.index(axis)
+        n = self.axis_sizes[ax]
+        grid = self.rank_grid()
+        pairs = []
+        for src_coord in np.ndindex(*self.axis_sizes):
+            dst_coord = list(src_coord)
+            d = src_coord[ax] + disp
+            if periodic:
+                dst_coord[ax] = d % n
+            elif 0 <= d < n:
+                dst_coord[ax] = d
+            else:
+                continue
+            pairs.append((int(grid[src_coord]), int(grid[tuple(dst_coord)])))
+        return pairs
+
+
+class _DefaultCommState(threading.local):
+    def __init__(self):
+        self.comm = None
+
+
+_default = _DefaultCommState()
+_WORLD_SELF = SelfComm()
+
+
+def get_default_comm():
+    """The ambient communicator used when ops get ``comm=None``.
+
+    Defaults to the process world: :class:`SelfComm` in a single process,
+    or the ProcComm world once the multi-process runtime is initialised
+    (reference: lazy COMM_WORLD.Clone(), mpi4jax/_src/comm.py:4-11).
+    """
+    if _default.comm is not None:
+        return _default.comm
+    from mpi4jax_tpu.parallel import proc
+
+    world = proc.world_comm_if_initialized()
+    return world if world is not None else _WORLD_SELF
+
+
+def set_default_comm(comm):
+    _default.comm = comm
+
+
+@contextmanager
+def default_comm(comm):
+    """Context manager scoping the default communicator."""
+    prev = _default.comm
+    _default.comm = comm
+    try:
+        yield comm
+    finally:
+        _default.comm = prev

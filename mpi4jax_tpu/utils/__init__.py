@@ -1,0 +1,3 @@
+from mpi4jax_tpu.utils import config, validation
+
+__all__ = ["config", "validation"]

@@ -1,0 +1,45 @@
+"""Environment-driven configuration.
+
+Mirrors the reference's env-var config surface
+(mpi4jax/_src/decorators.py:37-42 truthy parser; MPI4JAX_DEBUG at
+xla_bridge/__init__.py:22) with the ``MPI4JAX_TPU_`` prefix:
+
+* ``MPI4JAX_TPU_DEBUG``      — per-call wire-format logging on host paths
+* ``MPI4JAX_TPU_NO_FENCE``   — drop optimization-barrier token fences
+                               (perf experiments only; ordering becomes UB)
+"""
+
+import os
+
+__all__ = ["truthy", "debug_enabled", "fences_enabled", "set_debug"]
+
+_TRUE = {"1", "true", "on", "yes"}
+_FALSE = {"0", "false", "off", "no", ""}
+
+_state = {"debug": None}
+
+
+def truthy(value, default=False):
+    if value is None:
+        return default
+    v = str(value).strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    raise ValueError(f"cannot interpret {value!r} as a boolean flag")
+
+
+def debug_enabled():
+    if _state["debug"] is not None:
+        return _state["debug"]
+    return truthy(os.environ.get("MPI4JAX_TPU_DEBUG"), default=False)
+
+
+def set_debug(enabled):
+    """Runtime toggle (overrides the env var; None resets to env)."""
+    _state["debug"] = enabled
+
+
+def fences_enabled():
+    return not truthy(os.environ.get("MPI4JAX_TPU_NO_FENCE"), default=False)

@@ -1,0 +1,74 @@
+"""Runtime argument validation for the public ops.
+
+Re-creates the reference's ``@enforce_types`` behaviour
+(mpi4jax/_src/validation.py:8-94) with a lighter mechanism: explicit
+check helpers rather than a signature-walking decorator.  The load-bearing
+part is the error ergonomics — in particular the "traced value used as a
+static argument" hint (validation.py:77-88), which is the most common user
+error when wrapping these ops in ``jax.jit``.
+"""
+
+import numpy as np
+
+import jax.core
+
+__all__ = ["check_static_int", "check_comm", "check_op", "check_root"]
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def check_static_int(value, name, allow_none=False):
+    """Validate a static integer parameter (root, tag, source, dest...)."""
+    if value is None and allow_none:
+        return None
+    if _is_tracer(value):
+        raise TypeError(
+            f"{name} must be a static (trace-time) integer, but got a traced "
+            f"value. If you are calling this inside jax.jit, mark {name} as "
+            f"static (e.g. via functools.partial or jit's static_argnums)."
+        )
+    if isinstance(value, (bool, np.bool_)):
+        raise TypeError(f"{name} must be an integer, got bool")
+    if isinstance(value, (int, np.integer)):
+        return int(value)
+    raise TypeError(f"{name} must be an integer, got {type(value).__name__}")
+
+
+def check_comm(comm):
+    from mpi4jax_tpu.parallel.comm import Comm, get_default_comm
+
+    if comm is None:
+        return get_default_comm()
+    if not isinstance(comm, Comm):
+        raise TypeError(
+            f"comm must be an mpi4jax_tpu communicator "
+            f"(MeshComm / SelfComm / ProcComm), got {type(comm).__name__}"
+        )
+    return comm
+
+
+def check_op(op):
+    from mpi4jax_tpu.ops.reductions import Op, named_op
+
+    if isinstance(op, Op):
+        return op
+    if isinstance(op, str):
+        return named_op(op)
+    raise TypeError(
+        f"op must be an mpi4jax_tpu.Op (e.g. mpi4jax_tpu.SUM) or an op "
+        f"name, got {type(op).__name__}"
+    )
+
+
+def check_root(root, comm):
+    """Validate a root rank against the communicator size (MPI and the
+    reference both reject out-of-range roots; a silent mismatch here
+    would zero data instead of erroring)."""
+    root = check_static_int(root, "root")
+    if not 0 <= root < comm.size:
+        raise ValueError(
+            f"root={root} out of range for communicator of size {comm.size}"
+        )
+    return root

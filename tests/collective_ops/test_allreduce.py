@@ -1,0 +1,240 @@
+"""allreduce test matrix, mirroring the reference's
+tests/collective_ops/test_allreduce.py: eager / jit / scalar / vmap plus
+the full AD battery (grad, jvp, vjp, linear_transpose, double transpose,
+chained-token grad) with closed-form oracles in rank/size.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+
+from tests.helpers import spmd, spmd_jit
+
+SIZE = 8
+
+
+def world_input():
+    # per-device value = rank (per-device shape (1,))
+    return jnp.arange(float(SIZE))
+
+
+def test_allreduce_sum_eager(comm1d):
+    out = spmd(comm1d, lambda x: m.allreduce(x, m.SUM, comm=comm1d)[0])(world_input())
+    assert np.array_equal(np.asarray(out), np.full(SIZE, SIZE * (SIZE - 1) / 2))
+
+
+def test_allreduce_sum_jit(comm1d):
+    out = spmd_jit(comm1d, lambda x: m.allreduce(x, m.SUM, comm=comm1d)[0])(
+        world_input()
+    )
+    assert np.array_equal(np.asarray(out), np.full(SIZE, 28.0))
+
+
+def test_allreduce_scalar(comm1d):
+    def fn(x):
+        res, _ = m.allreduce(x[0], m.SUM, comm=comm1d)
+        return res[None]
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), np.full(SIZE, 28.0))
+
+
+@pytest.mark.parametrize(
+    "op,expected",
+    [
+        (m.MAX, 7.0),
+        (m.MIN, 0.0),
+        (m.PROD, 0.0),
+    ],
+)
+def test_allreduce_other_ops(comm1d, op, expected):
+    out = spmd_jit(comm1d, lambda x: m.allreduce(x, op, comm=comm1d)[0])(world_input())
+    assert np.array_equal(np.asarray(out), np.full(SIZE, expected))
+
+
+def test_allreduce_prod_nonzero(comm1d):
+    out = spmd_jit(comm1d, lambda x: m.allreduce(x + 1, m.PROD, comm=comm1d)[0])(
+        world_input()
+    )
+    import math
+
+    assert np.array_equal(np.asarray(out), np.full(SIZE, float(math.factorial(8))))
+
+
+def test_allreduce_logical(comm1d):
+    def fn(x):
+        flag = x[0] > 3  # True on ranks 4..7
+        a, tok = m.allreduce(flag, m.LAND, comm=comm1d)
+        o, tok = m.allreduce(flag, m.LOR, comm=comm1d, token=tok)
+        x_, tok = m.allreduce(flag, m.LXOR, comm=comm1d, token=tok)
+        return jnp.stack([a, o, x_])[None].astype(jnp.float32)
+
+    out = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                fn,
+                mesh=comm1d.mesh,
+                in_specs=jax.P(comm1d.axes),
+                out_specs=jax.P(comm1d.axes, None),
+            )
+        )(world_input())
+    )
+    assert np.array_equal(out[0], [0.0, 1.0, 0.0])  # 4 Trues: and=F or=T xor=F
+
+
+def test_allreduce_bitwise(comm1d):
+    def fn(x):
+        v = x.astype(jnp.int32)
+        a, tok = m.allreduce(v, m.BOR, comm=comm1d)
+        b, tok = m.allreduce(v, m.BAND, comm=comm1d, token=tok)
+        c, tok = m.allreduce(v, m.BXOR, comm=comm1d, token=tok)
+        return a, b, c
+
+    f = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=comm1d.mesh,
+            in_specs=jax.P(comm1d.axes),
+            out_specs=(jax.P(comm1d.axes),) * 3,
+        )
+    )
+    a, b, c = f(world_input())
+    ranks = np.arange(8)
+    assert np.array_equal(np.asarray(a), np.full(8, np.bitwise_or.reduce(ranks)))
+    assert np.array_equal(np.asarray(b), np.full(8, np.bitwise_and.reduce(ranks)))
+    assert np.array_equal(np.asarray(c), np.full(8, np.bitwise_xor.reduce(ranks)))
+
+
+def test_allreduce_vmap(comm1d):
+    def fn(x):
+        batched = jnp.stack([x, 2 * x, 3 * x])  # (3, 1) per device
+        out = jax.vmap(lambda v: m.allreduce(v, m.SUM, comm=comm1d)[0])(batched)
+        return out.sum(axis=0)
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), np.full(SIZE, 6 * 28.0))
+
+
+# ---- AD battery (reference: test_allreduce.py:79-221) ----
+
+
+def _allreduce_fn(comm):
+    return spmd_jit(comm, lambda x: m.allreduce(x, m.SUM, comm=comm)[0])
+
+
+def test_allreduce_transpose(comm1d):
+    f = _allreduce_fn(comm1d)
+    x = world_input()
+    (res,) = jax.linear_transpose(f, x)(x)
+    assert np.array_equal(np.asarray(res), np.asarray(x))
+
+
+def test_allreduce_transpose2(comm1d):
+    f = _allreduce_fn(comm1d)
+    x = world_input()
+
+    def lt(y):
+        return jax.linear_transpose(f, x)(y)[0]
+
+    (res,) = jax.linear_transpose(lt, x)(jnp.ones(SIZE))
+    expected = f(jnp.ones(SIZE))
+    assert np.array_equal(np.asarray(res), np.asarray(expected))
+
+
+def test_allreduce_transpose3(comm1d):
+    # triple transpose = single transpose = identity
+    f = _allreduce_fn(comm1d)
+    x = world_input()
+
+    def lt(y):
+        return jax.linear_transpose(f, x)(y)[0]
+
+    def lt2(y):
+        return jax.linear_transpose(lt, x)(y)[0]
+
+    (res,) = jax.linear_transpose(lt2, x)(x)
+    assert np.array_equal(np.asarray(res), np.asarray(x))
+
+
+def test_allreduce_grad(comm1d):
+    f = _allreduce_fn(comm1d)
+    x = world_input()
+    res, grad = jax.value_and_grad(lambda v: f(v).sum())(x)
+    assert np.asarray(res) == pytest.approx(8 * 28.0)
+    assert np.array_equal(np.asarray(grad), np.ones(SIZE))
+
+
+def test_allreduce_jvp(comm1d):
+    f = _allreduce_fn(comm1d)
+    x = world_input()
+    res, tangent = jax.jvp(f, (x,), (x,))
+    assert np.array_equal(np.asarray(res), np.full(SIZE, 28.0))
+    assert np.array_equal(np.asarray(tangent), np.full(SIZE, 28.0))
+
+
+def test_allreduce_vjp(comm1d):
+    f = _allreduce_fn(comm1d)
+    x = world_input()
+    res, vjp_fun = jax.vjp(f, x)
+    (vjp,) = vjp_fun(x)
+    assert np.array_equal(np.asarray(res), np.full(SIZE, 28.0))
+    assert np.array_equal(np.asarray(vjp), np.asarray(x))
+
+
+def test_allreduce_chained_grad(comm1d):
+    # reference: test_allreduce_chained — d/dx of two token-chained
+    # allreduces of the same scalar = 2
+    def fn(x):
+        tok = m.create_token()
+        x1, tok = m.allreduce(x, m.SUM, comm=comm1d, token=tok)
+        x2, tok = m.allreduce(x, m.SUM, comm=comm1d, token=tok)
+        return (x1 + x2).sum()
+
+    def global_fn(x):
+        return (
+            jax.shard_map(
+                lambda v: jax.grad(fn)(v[0])[None],
+                mesh=comm1d.mesh,
+                in_specs=jax.P(comm1d.axes),
+                out_specs=jax.P(comm1d.axes),
+            )(x)
+        )
+
+    res = jax.jit(global_fn)(world_input())
+    assert np.array_equal(np.asarray(res), np.full(SIZE, 2.0))
+
+
+def test_allreduce_nonsum_grad_raises(comm1d):
+    f = spmd_jit(comm1d, lambda x: m.allreduce(x, m.MAX, comm=comm1d)[0])
+    with pytest.raises(NotImplementedError):
+        jax.grad(lambda v: f(v).sum())(world_input())
+
+
+def test_allreduce_2d_comm(comm2d):
+    out = jax.jit(
+        jax.shard_map(
+            lambda x: m.allreduce(x, m.SUM, comm=comm2d)[0],
+            mesh=comm2d.mesh,
+            in_specs=jax.P(comm2d.axes),
+            out_specs=jax.P(comm2d.axes),
+        )
+    )(world_input())
+    assert np.array_equal(np.asarray(out), np.full(SIZE, 28.0))
+
+
+def test_allreduce_subcomm(comm2d):
+    # reduce only over the "x" axis: 2 independent row groups of 4
+    row = comm2d.sub("x")
+    out = jax.jit(
+        jax.shard_map(
+            lambda x: m.allreduce(x, m.SUM, comm=row)[0],
+            mesh=comm2d.mesh,
+            in_specs=jax.P(comm2d.axes),
+            out_specs=jax.P(comm2d.axes),
+        )
+    )(world_input())
+    # ranks 0-3 sum to 6, ranks 4-7 sum to 22
+    assert np.array_equal(np.asarray(out), [6, 6, 6, 6, 22, 22, 22, 22])

@@ -1,0 +1,133 @@
+"""Distributed (tensor-parallel) matvec integration tests, mirroring the
+reference's tests/collective_ops/test_allreduce_matvec.py:44-239 — a
+column-sharded matvec whose partial products are allreduced, checked
+against dense oracles through grad, jvp, vjp and nested
+``jax.linear_transpose`` — the Megatron-style TP f/g pair on our
+primitives.
+
+MPMD→SPMD embedding note: in the reference each rank returns the *full*
+(replicated) result vector and AD is per-rank.  Here the replicated
+result carries an explicit leading device axis (shape ``(size, N)``
+globally, one row per device), so per-device cotangents — the MPMD
+semantics the reference's identity-transpose convention assumes — map
+one-to-one onto rows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+
+SIZE = 8
+N = 16  # global vector length; each device owns N // SIZE columns
+COLS = N // SIZE
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.RandomState(42)
+    A = rng.randn(N, N).astype(np.float32)  # replicated matrix
+    x = rng.randn(N).astype(np.float32)  # column-sharded vector
+    return A, jnp.asarray(x)
+
+
+def matvec_spmd(comm, A):
+    """f: x (N, sharded) -> (SIZE, N): per-device full result rows."""
+
+    def fn(x_local):
+        rank = comm.rank()
+        A_local = jax.lax.dynamic_slice_in_dim(
+            jnp.asarray(A), rank * COLS, COLS, axis=1
+        )
+        partial = A_local @ x_local
+        full, _ = m.allreduce(partial, m.SUM, comm=comm)
+        return full[None]  # (1, N) per device -> (SIZE, N) global
+
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=comm.mesh,
+            in_specs=jax.P(comm.axes),
+            out_specs=jax.P(comm.axes, None),
+        )
+    )
+
+
+def test_matvec_forward(comm1d, setup):
+    A, x = setup
+    f = matvec_spmd(comm1d, A)
+    out = np.asarray(f(x))
+    for r in range(SIZE):
+        np.testing.assert_allclose(out[r], A @ np.asarray(x), rtol=1e-4, atol=1e-5)
+
+
+def test_matvec_transpose(comm1d, setup):
+    # per-rank cotangent = y on every device -> global x_bar = A.T @ y
+    # (reference oracle at test_allreduce_matvec.py:93-117)
+    A, x = setup
+    f = matvec_spmd(comm1d, A)
+    y = np.asarray(f(x))[0]
+    ct = jnp.asarray(np.tile(y, (SIZE, 1)))
+    (xt,) = jax.linear_transpose(f, x)(ct)
+    np.testing.assert_allclose(np.asarray(xt), A.T @ y, rtol=1e-3, atol=1e-5)
+
+
+def test_matvec_transpose2(comm1d, setup):
+    A, x = setup
+    f = matvec_spmd(comm1d, A)
+
+    def lt(ct):
+        return jax.linear_transpose(f, x)(ct)[0]
+
+    # transpose of the transpose recovers the forward matvec
+    (res,) = jax.linear_transpose(lt, f(x))(x)
+    expected = np.asarray(f(x))
+    np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-3, atol=1e-5)
+
+
+def test_matvec_transpose3(comm1d, setup):
+    A, x = setup
+    f = matvec_spmd(comm1d, A)
+
+    def lt(ct):
+        return jax.linear_transpose(f, x)(ct)[0]
+
+    def lt2(v):
+        return jax.linear_transpose(lt, f(x))(v)[0]
+
+    y = np.asarray(f(x))[0]
+    ct = jnp.asarray(np.tile(y, (SIZE, 1)))
+    # transpose(transpose(transpose(f))) = transpose(f)
+    (res,) = jax.linear_transpose(lt2, x)(ct)
+    np.testing.assert_allclose(np.asarray(res), A.T @ y, rtol=1e-3, atol=1e-5)
+
+
+def test_matvec_grad(comm1d, setup):
+    A, x = setup
+    f = matvec_spmd(comm1d, A)
+    g = jax.grad(lambda v: (f(v) ** 2).sum())(x)
+    # per-rank loss ||y||^2 -> per-block grads 2 A_r^T y, concat = 2 A^T A x
+    expected = 2 * A.T @ (A @ np.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-3, atol=1e-5)
+
+
+def test_matvec_jvp(comm1d, setup):
+    A, x = setup
+    f = matvec_spmd(comm1d, A)
+    v = jnp.ones(N, jnp.float32)
+    _, tangent = jax.jvp(f, (x,), (v,))
+    for r in range(SIZE):
+        np.testing.assert_allclose(
+            np.asarray(tangent)[r], A @ np.ones(N, np.float32), rtol=1e-3
+        )
+
+
+def test_matvec_vjp(comm1d, setup):
+    A, x = setup
+    f = matvec_spmd(comm1d, A)
+    y2, vjp_fun = jax.vjp(f, x)
+    (xt,) = vjp_fun(y2)
+    y = np.asarray(y2)[0]
+    np.testing.assert_allclose(np.asarray(xt), A.T @ y, rtol=1e-3, atol=1e-5)

@@ -1,0 +1,143 @@
+"""send/recv pairing tests, mirroring the reference's
+tests/collective_ops/test_send_and_recv.py (including the deadlock
+regression shape at :104-117 — here deadlock-freedom holds by
+construction because the matched pair lowers to one ppermute, but the
+ordering and matching semantics still need coverage).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+
+from tests.helpers import spmd_jit
+
+SIZE = 8
+
+
+def world_input():
+    return jnp.arange(float(SIZE))
+
+
+def test_send_then_recv(comm1d):
+    def fn(x):
+        tok = m.create_token()
+        tok = m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d, token=tok)
+        y, tok = m.recv(x, lambda r: (r - 1) % SIZE, comm=comm1d, token=tok)
+        return y
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_two_sends_two_recvs_fifo(comm1d):
+    # same pattern + same tag: recvs must match sends in FIFO order
+    def fn(x):
+        tok = m.create_token()
+        tok = m.send(x, lambda r: (r + 1) % SIZE, tag=0, comm=comm1d, token=tok)
+        tok = m.send(10 * x, lambda r: (r + 1) % SIZE, tag=0, comm=comm1d, token=tok)
+        a, tok = m.recv(x, lambda r: (r - 1) % SIZE, tag=0, comm=comm1d, token=tok)
+        b, tok = m.recv(x, lambda r: (r - 1) % SIZE, tag=0, comm=comm1d, token=tok)
+        return a + b  # shifted(x) + shifted(10x) = 11 * shifted(x)
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), 11 * np.roll(np.arange(8.0), 1))
+
+
+def test_tag_matching(comm1d):
+    # recv with tag=2 must skip the staged tag=1 send
+    def fn(x):
+        tok = m.create_token()
+        tok = m.send(x, lambda r: (r + 1) % SIZE, tag=1, comm=comm1d, token=tok)
+        tok = m.send(-x, lambda r: (r + 1) % SIZE, tag=2, comm=comm1d, token=tok)
+        b, tok = m.recv(x, lambda r: (r - 1) % SIZE, tag=2, comm=comm1d, token=tok)
+        a, tok = m.recv(x, lambda r: (r - 1) % SIZE, tag=1, comm=comm1d, token=tok)
+        return 100 * b + a
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    shifted = np.roll(np.arange(8.0), 1)
+    assert np.array_equal(np.asarray(out), -100 * shifted + shifted)
+
+
+def test_any_tag_any_source(comm1d):
+    def fn(x):
+        tok = m.create_token()
+        tok = m.send(x, lambda r: (r + 3) % SIZE, tag=9, comm=comm1d, token=tok)
+        y, tok = m.recv(x, m.ANY_SOURCE, m.ANY_TAG, comm=comm1d, token=tok)
+        return y
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), np.roll(np.arange(8.0), 3))
+
+
+def test_recv_without_send_raises(comm1d):
+    with pytest.raises(RuntimeError, match="no matching in-trace send"):
+        spmd_jit(
+            comm1d,
+            lambda x: m.recv(x, lambda r: (r - 1) % SIZE, comm=comm1d)[0],
+        )(world_input())
+
+
+def test_undrained_token_detectable(comm1d):
+    def fn(x):
+        tok = m.create_token()
+        tok = m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d, token=tok)
+        with pytest.raises(RuntimeError, match="unmatched send"):
+            tok.assert_drained()
+        y, tok = m.recv(x, lambda r: (r - 1) % SIZE, comm=comm1d, token=tok)
+        tok.assert_drained()
+        return y
+
+    spmd_jit(comm1d, fn)(world_input())
+
+
+def test_send_recv_through_jit_boundary(comm1d):
+    # a send staged inside one jit can be received after it: the pending
+    # payload rides the token pytree across the boundary
+    def stage(x):
+        tok = m.create_token()
+        return m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d, token=tok)
+
+    def consume(x, tok):
+        y, tok = m.recv(x, lambda r: (r - 1) % SIZE, comm=comm1d, token=tok)
+        return y
+
+    def fn(x):
+        tok = stage(x)
+        return consume(x, tok)
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_sendrecv_to_self(selfcomm):
+    # reference regression: sendrecv-to-self must not hang
+    # (test_common.py:91-115); here it is a local identity
+    def fn(x):
+        tok = m.create_token()
+        tok = m.send(x, 0, comm=selfcomm, token=tok)
+        y, tok = m.recv(x, 0, comm=selfcomm, token=tok)
+        return y
+
+    x = jnp.arange(4.0)
+    out = jax.jit(fn)(x)
+    assert np.array_equal(np.asarray(out), np.arange(4.0))
+
+
+def test_recv_invalid_source_size1(selfcomm):
+    tok = m.create_token()
+    tok = m.send(jnp.ones(3), 0, comm=selfcomm, token=tok)
+    with pytest.raises(ValueError, match="out of range"):
+        m.recv(jnp.ones(3), 5, comm=selfcomm, token=tok)
+
+
+def test_out_of_range_partner_callable(comm1d):
+    with pytest.raises(ValueError, match="out of range"):
+        spmd_jit(
+            comm1d,
+            lambda x: m.sendrecv(
+                x, x, source=lambda r: r - 1, dest=lambda r: r + 1, comm=comm1d
+            )[0],
+        )(world_input())

@@ -1,0 +1,148 @@
+"""sendrecv tests, mirroring tests/collective_ops/test_sendrecv.py of the
+reference plus the transpose rule (sendrecv.py:366-385: gradients travel
+the reverse ring direction).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+
+from tests.helpers import spmd, spmd_jit
+
+SIZE = 8
+
+
+def world_input():
+    return jnp.arange(float(SIZE))
+
+
+def ring_fn(comm, disp=1):
+    def fn(x):
+        y, _ = m.sendrecv(
+            x,
+            x,
+            source=lambda r: (r - disp) % SIZE,
+            dest=lambda r: (r + disp) % SIZE,
+            comm=comm,
+        )
+        return y
+
+    return fn
+
+
+@pytest.mark.parametrize("jit", [True, False])
+def test_sendrecv_ring(comm1d, jit):
+    f = spmd(comm1d, ring_fn(comm1d))
+    if jit:
+        f = jax.jit(f)
+    out = f(world_input())
+    assert np.array_equal(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_sendrecv_perm_pairs(comm1d):
+    # explicit (source, dest) pair list, reversed ring
+    pairs = [(r, (r - 1) % SIZE) for r in range(SIZE)]
+
+    def fn(x):
+        y, _ = m.sendrecv(x, x, source=pairs, dest=pairs, comm=comm1d)
+        return y
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), np.roll(np.arange(8.0), -1))
+
+
+def test_sendrecv_transpose(comm1d):
+    # transpose of a +1 ring shift is a -1 ring shift
+    f = spmd_jit(comm1d, ring_fn(comm1d))
+    x = world_input()
+    (res,) = jax.linear_transpose(f, x)(x)
+    assert np.array_equal(np.asarray(res), np.roll(np.arange(8.0), -1))
+
+
+def test_sendrecv_grad(comm1d):
+    f = spmd_jit(comm1d, ring_fn(comm1d))
+    g = jax.grad(lambda v: (f(v) * jnp.arange(8.0)).sum())(world_input())
+    # dL/dx_r = weight at the rank x_r was shifted to = (r+1) % 8
+    assert np.array_equal(np.asarray(g), np.roll(np.arange(8.0), -1))
+
+
+def test_sendrecv_jvp(comm1d):
+    # forward mode works here (the reference hard-errors, sendrecv.py:128-133)
+    f = spmd_jit(comm1d, ring_fn(comm1d))
+    x = world_input()
+    _, tangent = jax.jvp(f, (x,), (x,))
+    assert np.array_equal(np.asarray(tangent), np.roll(np.arange(8.0), 1))
+
+
+def test_sendrecv_nonperiodic(comm1d):
+    # MPI_PROC_NULL analog: edge ranks keep their recv buffer
+    def fn(x):
+        recvbuf = jnp.full_like(x, -5.0)
+        y, _ = m.sendrecv(
+            x,
+            recvbuf,
+            source=lambda r: r - 1 if r > 0 else None,
+            dest=lambda r: r + 1 if r < SIZE - 1 else None,
+            comm=comm1d,
+        )
+        return y
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), [-5.0, 0, 1, 2, 3, 4, 5, 6])
+
+
+def test_sendrecv_status(comm1d):
+    def fn(x):
+        status = m.Status()
+        y, _ = m.sendrecv(
+            x,
+            x,
+            source=lambda r: (r - 1) % SIZE,
+            dest=lambda r: (r + 1) % SIZE,
+            sendtag=3,
+            comm=comm1d,
+            status=status,
+        )
+        return y + status.source.astype(jnp.float32)
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    expected = np.roll(np.arange(8.0), 1) + (np.arange(8) - 1) % 8
+    assert np.array_equal(np.asarray(out), expected)
+
+
+def test_sendrecv_mismatched_views(comm1d):
+    with pytest.raises(ValueError, match="disagree"):
+        spmd_jit(
+            comm1d,
+            lambda x: m.sendrecv(
+                x,
+                x,
+                source=lambda r: (r + 1) % SIZE,  # wrong: same direction as dest
+                dest=lambda r: (r + 1) % SIZE,
+                comm=comm1d,
+            )[0],
+        )(world_input())
+
+
+def test_sendrecv_int_dest_raises(comm1d):
+    with pytest.raises(ValueError, match="permutation"):
+        spmd_jit(
+            comm1d,
+            lambda x: m.sendrecv(x, x, source=0, dest=1, comm=comm1d)[0],
+        )(world_input())
+
+
+def test_sendrecv_2d_shift(comm2d):
+    # shift along the x axis of a (2,4) grid via comm.shift_perm
+    pairs = comm2d.shift_perm("x", 1, periodic=True)
+
+    def fn(x):
+        y, _ = m.sendrecv(x, x, source=pairs, dest=pairs, comm=comm2d)
+        return y
+
+    out = spmd_jit(comm2d, fn)(world_input())
+    expected = np.concatenate([np.roll(np.arange(4.0), 1), np.roll(np.arange(4.0, 8.0), 1)])
+    assert np.array_equal(np.asarray(out), expected)

@@ -1,0 +1,14 @@
+"""Shared helpers: run a per-device function SPMD over a comm's mesh."""
+
+import jax
+
+
+def spmd(comm, fn):
+    """shard_map ``fn`` over all of ``comm``'s axes, everything sharded
+    along its leading dimension."""
+    spec = jax.P(comm.axes)
+    return jax.shard_map(fn, mesh=comm.mesh, in_specs=spec, out_specs=spec)
+
+
+def spmd_jit(comm, fn):
+    return jax.jit(spmd(comm, fn))

@@ -1,0 +1,79 @@
+"""Communicator tests: hashability (comms are static primitive params,
+the analog of the reference's HashableMPIType, utils.py:77-96), subgroup
+extraction, topology helpers, clone contexts, defaults."""
+
+import jax
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+
+
+def test_hashable_eq(mesh1d):
+    a = m.MeshComm.from_mesh(mesh1d)
+    b = m.MeshComm.from_mesh(mesh1d)
+    assert a == b and hash(a) == hash(b)
+    c = a.clone()
+    assert c != a  # fresh context id (reference: COMM_WORLD.Clone firewall)
+    assert c.axes == a.axes
+
+
+def test_self_comm():
+    s = m.SelfComm()
+    assert s.size == 1 and s.rank() == 0
+    assert s.clone() != s
+
+
+def test_from_mesh_subset(mesh2d):
+    full = m.MeshComm.from_mesh(mesh2d)
+    assert full.size == 8
+    assert full.axis_sizes == (2, 4)
+    row = full.sub("x")
+    assert row.size == 4 and row.axes == ("x",)
+    col = full.sub("y")
+    assert col.size == 2
+    with pytest.raises(ValueError):
+        full.sub("z")
+
+
+def test_rank_grid_and_coords(mesh2d):
+    comm = m.MeshComm.from_mesh(mesh2d)
+    grid = comm.rank_grid()
+    assert grid.shape == (2, 4)
+    assert grid[1, 2] == 6
+    assert comm.coords_of(6) == (1, 2)
+
+
+def test_shift_perm(mesh2d):
+    comm = m.MeshComm.from_mesh(mesh2d)
+    perm = comm.shift_perm("x", 1, periodic=True)
+    assert (0, 1) in perm and (3, 0) in perm and (7, 4) in perm
+    assert len(perm) == 8
+    perm_np = comm.shift_perm("x", 1, periodic=False)
+    assert len(perm_np) == 6  # edge column does not wrap
+    assert all(d != 4 * y for (s, d) in perm_np for y in (0, 1) if s != d - 1)
+
+
+def test_shift_perm_y(mesh2d):
+    comm = m.MeshComm.from_mesh(mesh2d)
+    perm = comm.shift_perm("y", 1, periodic=True)
+    assert (0, 4) in perm and (4, 0) in perm
+
+
+def test_string_axes():
+    c = m.MeshComm(axes="x", axis_sizes=(4,))
+    assert c.axes == ("x",)
+    assert c.size == 4
+
+
+def test_bad_comm_type_error():
+    with pytest.raises(TypeError, match="communicator"):
+        m.allreduce(np.ones(3), m.SUM, comm="world")
+
+
+def test_sub_preserves_clone_context(mesh2d):
+    # a sub-communicator of a clone must stay in the clone's message
+    # namespace (firewall regression)
+    comm = m.MeshComm.from_mesh(mesh2d)
+    assert comm.clone().sub("x") != comm.sub("x")
+    assert comm.clone().sub("x").axes == ("x",)

@@ -1,0 +1,108 @@
+"""Single-process (SelfComm) semantics for every op — the analog of the
+reference suite running under plain ``pytest`` with one MPI process
+(SURVEY §4.1: every collective degenerates to an identity at size 1) —
+including the AD battery on the size-1 allreduce.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+
+
+@pytest.fixture
+def arr():
+    return jnp.arange(6.0).reshape(3, 2)
+
+
+def test_allreduce(selfcomm, arr):
+    res, tok = m.allreduce(arr, m.SUM, comm=selfcomm)
+    assert np.array_equal(np.asarray(res), np.asarray(arr))
+    res, tok = jax.jit(lambda x: m.allreduce(x, m.SUM, comm=selfcomm))(arr)
+    assert np.array_equal(np.asarray(res), np.asarray(arr))
+
+
+def test_allreduce_ad(selfcomm, arr):
+    f = jax.jit(lambda x: m.allreduce(x, m.SUM, comm=selfcomm)[0])
+    (t1,) = jax.linear_transpose(f, arr)(arr)
+    assert np.array_equal(np.asarray(t1), np.asarray(arr))
+    res, grad = jax.value_and_grad(lambda x: f(x).sum())(arr)
+    assert np.asarray(res) == 15.0
+    assert np.array_equal(np.asarray(grad), np.ones((3, 2)))
+    _, tangent = jax.jvp(f, (arr,), (2 * arr,))
+    assert np.array_equal(np.asarray(tangent), 2 * np.asarray(arr))
+
+
+def test_allreduce_vmap(selfcomm, arr):
+    out = jax.vmap(lambda x: m.allreduce(x, m.SUM, comm=selfcomm)[0])(arr)
+    assert np.array_equal(np.asarray(out), np.asarray(arr))
+    out = jax.jit(jax.vmap(lambda x: m.allreduce(x, m.SUM, comm=selfcomm)[0]))(arr)
+    assert np.array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_allgather(selfcomm, arr):
+    res, _ = m.allgather(arr, comm=selfcomm)
+    assert res.shape == (1, 3, 2)
+    assert np.array_equal(np.asarray(res)[0], np.asarray(arr))
+
+
+def test_alltoall(selfcomm):
+    x = jnp.arange(4.0).reshape(1, 4)
+    res, _ = m.alltoall(x, comm=selfcomm)
+    assert np.array_equal(np.asarray(res), np.asarray(x))
+
+
+def test_bcast(selfcomm, arr):
+    res, _ = m.bcast(arr, 0, comm=selfcomm)
+    assert np.array_equal(np.asarray(res), np.asarray(arr))
+
+
+def test_gather_scatter_roundtrip(selfcomm, arr):
+    g, tok = m.gather(arr, 0, comm=selfcomm)
+    assert g.shape == (1, 3, 2)
+    s, tok = m.scatter(g, 0, comm=selfcomm, token=tok)
+    assert np.array_equal(np.asarray(s), np.asarray(arr))
+
+
+def test_reduce_scan(selfcomm, arr):
+    r, tok = m.reduce(arr, m.SUM, 0, comm=selfcomm)
+    assert np.array_equal(np.asarray(r), np.asarray(arr))
+    s, tok = m.scan(arr, m.SUM, comm=selfcomm, token=tok)
+    assert np.array_equal(np.asarray(s), np.asarray(arr))
+
+
+def test_barrier(selfcomm):
+    tok = m.barrier(comm=selfcomm)
+    assert isinstance(tok, m.Token)
+
+
+def test_sendrecv(selfcomm, arr):
+    res, _ = m.sendrecv(arr, arr, 0, 0, comm=selfcomm)
+    assert np.array_equal(np.asarray(res), np.asarray(arr))
+
+
+def test_default_comm_is_self(arr):
+    # no multi-process runtime -> default comm is the size-1 world
+    res, _ = m.allreduce(arr, m.SUM)
+    assert np.array_equal(np.asarray(res), np.asarray(arr))
+    assert m.get_default_comm().size == 1
+
+
+def test_default_comm_override(selfcomm, comm1d, arr):
+    with m.default_comm(comm1d):
+        assert m.get_default_comm() is comm1d
+    assert m.get_default_comm().size == 1
+
+
+def test_scan_inside_lax_scan(selfcomm, arr):
+    # ops must be legal inside control flow (reference jax_compat.py:24-50
+    # registers its effect as control-flow-allowed for the same reason)
+    def body(carry, _):
+        y, tok = m.allreduce(carry, m.SUM, comm=selfcomm)
+        return y * 1.0, y.sum()
+
+    carry, ys = jax.lax.scan(body, arr, None, length=3)
+    assert np.array_equal(np.asarray(carry), np.asarray(arr))
+    assert ys.shape == (3,)

@@ -1,0 +1,56 @@
+"""Validation-layer tests, mirroring the reference's
+tests/test_validation.py ergonomics: in particular the traced-static
+hint (reference validation.py:77-88)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.utils.validation import check_op, check_static_int
+
+
+def test_traced_static_arg_hint(selfcomm):
+    def fn(x, root):
+        y, _ = m.bcast(x, root, comm=selfcomm)
+        return y
+
+    with pytest.raises(TypeError, match="static"):
+        jax.jit(fn)(jnp.ones(3), 0)  # root becomes a tracer
+
+    # static_argnums fixes it, as the hint suggests
+    out = jax.jit(fn, static_argnums=1)(jnp.ones(3), 0)
+    assert out.shape == (3,)
+
+
+def test_check_static_int():
+    assert check_static_int(3, "root") == 3
+    with pytest.raises(TypeError, match="integer"):
+        check_static_int(1.5, "root")
+    with pytest.raises(TypeError, match="bool"):
+        check_static_int(True, "root")
+
+
+def test_check_op():
+    assert check_op(m.SUM) is m.SUM
+    assert check_op("sum") == m.SUM
+    with pytest.raises(ValueError, match="unknown reduction"):
+        check_op("median")
+    with pytest.raises(TypeError, match="Op"):
+        check_op(42)
+
+
+def test_bad_token():
+    with pytest.raises(TypeError, match="token"):
+        m.as_token("not a token")
+
+
+def test_root_out_of_range(selfcomm, comm1d):
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError, match="out of range"):
+        m.bcast(jnp.ones(3), 5, comm=selfcomm)
+    with pytest.raises(ValueError, match="out of range"):
+        m.scatter(jnp.ones((1, 3)), -1, comm=selfcomm)
+    with pytest.raises(ValueError, match="out of range"):
+        m.reduce(jnp.ones(3), m.SUM, 99, comm=selfcomm)
