@@ -1,0 +1,92 @@
+"""Benchmark driver: shallow-water cell-update throughput on TPU.
+
+Runs the flagship workload in the published-benchmark configuration of
+the reference (domain 3600x1800, docs/shallow-water.rst:49-51) on the
+available TPU device(s) and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference's best single-accelerator result — 1x P100 at
+~4.5e8 cell-updates/s (BASELINE.md: 6.48 M cells x 434 steps / 6.28 s).
+vs_baseline > 1 means faster than the reference's GPU per chip.
+"""
+
+import json
+import sys
+import time
+
+from mpi4jax_tpu.utils.runtime import best_mesh_shape, drain
+
+BASELINE_CELL_UPDATES_PER_SEC = 4.5e8  # 1x P100, BASELINE.md
+
+
+def main():
+    import jax
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.models import shallow_water as sw
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    shape = best_mesh_shape(n_dev)
+    mesh = jax.make_mesh(
+        shape, ("y", "x"), axis_types=(jax.sharding.AxisType.Auto,) * 2
+    )
+    comm = m.MeshComm.from_mesh(mesh)
+
+    cfg = sw.SWConfig().bench_size()  # 3600 x 1800 f32
+    cells = cfg.ny * cfg.nx
+
+    init = sw.make_init(cfg, comm)
+    first = sw.make_first_step(cfg, comm)
+    steps_per_call = 25
+    multi = sw.make_multistep(cfg, comm, steps_per_call)
+
+    import numpy as np
+
+    def sync(s):
+        return drain(s.h)
+
+    state = init()
+    state = first(state)
+    # warm-up / compile
+    state = multi(state)
+    sync(state)
+
+    # calibrate: one synced call, then size a >=3s timed batch
+    t0 = time.perf_counter()
+    state = multi(state)
+    sync(state)
+    per_call = max(time.perf_counter() - t0, 1e-3)
+    calls = max(4, min(400, int(3.0 / per_call)))
+
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        state = multi(state)
+    sync(state)
+    elapsed = time.perf_counter() - t0
+    total_steps = calls * steps_per_call
+
+    assert np.isfinite(np.asarray(jax.device_get(state.h))).all(), "diverged"
+
+    rate = cells * total_steps / elapsed
+    per_chip = rate / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "shallow_water_cell_updates_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "cell-updates/s/chip",
+                "vs_baseline": round(per_chip / BASELINE_CELL_UPDATES_PER_SEC, 4),
+            }
+        )
+    )
+    print(
+        f"[bench] devices={n_dev} mesh={shape} steps={total_steps} "
+        f"wall={elapsed:.2f}s total_rate={rate:.3e}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""Shallow-water demo application for mpi4jax_tpu.
+
+The counterpart of the reference's examples/shallow_water.py, redesigned
+SPMD: instead of `mpirun -n N python shallow_water.py` with one process
+per rank, a single process shards the domain over all visible devices
+via a ("y", "x") mesh — on a TPU slice the halo exchanges ride ICI.
+
+Usage:
+
+    # quick correctness check on a small grid
+    python examples/shallow_water.py --check
+
+    # demo run (360x180 grid, 10 model days)
+    python examples/shallow_water.py
+
+    # published-benchmark configuration (3600x1800, 0.1 model days;
+    # reference numbers in BASELINE.md)
+    python examples/shallow_water.py --benchmark
+
+    # explicit decomposition (devices = py * px)
+    python examples/shallow_water.py --mesh 2 4
+"""
+
+import argparse
+import pathlib
+import sys
+
+import numpy as np
+
+# allow running straight from a checkout
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--benchmark", action="store_true")
+    p.add_argument("--check", action="store_true")
+    p.add_argument("--mesh", nargs=2, type=int, metavar=("PY", "PX"))
+    p.add_argument("--days", type=float, default=None, help="model days")
+    p.add_argument("--multistep", type=int, default=25)
+    p.add_argument(
+        "--force-cpu",
+        action="store_true",
+        help="run on virtual CPU devices (honours "
+        "--xla_force_host_platform_device_count in XLA_FLAGS)",
+    )
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.models import shallow_water as sw
+    from mpi4jax_tpu.utils.runtime import best_mesh_shape
+
+    n_dev = len(jax.devices())
+    shape = tuple(args.mesh) if args.mesh else best_mesh_shape(n_dev)
+    mesh = jax.make_mesh(
+        shape, ("y", "x"), axis_types=(jax.sharding.AxisType.Auto,) * 2
+    )
+    comm = m.MeshComm.from_mesh(mesh)
+
+    if args.benchmark:
+        cfg = sw.SWConfig().bench_size()
+        days = args.days if args.days is not None else 0.1
+    elif args.check:
+        cfg = sw.SWConfig(ny=24, nx=48)
+        days = args.days if args.days is not None else 0.02
+    else:
+        cfg = sw.SWConfig()
+        days = args.days if args.days is not None else 10.0
+
+    print(
+        f"shallow_water: grid {cfg.ny}x{cfg.nx}, mesh {shape}, "
+        f"devices {n_dev}, dt {cfg.dt:.1f}s, {days} model days",
+        file=sys.stderr,
+    )
+
+    solve = sw.make_solver(cfg, comm, num_multisteps=args.multistep)
+    state, wall, steps = solve(days * sw.DAY_IN_SECONDS)
+
+    h_local = np.asarray(jax.device_get(state.h))
+    assert np.isfinite(h_local).all(), "solution diverged"
+
+    cells = cfg.ny * cfg.nx
+    rate = cells * steps / wall if wall > 0 else float("nan")
+    print(
+        f"steps timed: {steps}, wall: {wall:.3f}s, "
+        f"{rate:.3e} cell-updates/s ({rate / n_dev:.3e} per device)",
+        file=sys.stderr,
+    )
+    if args.check:
+        print("check passed: solution finite", file=sys.stderr)
+    return rate
+
+
+if __name__ == "__main__":
+    main()

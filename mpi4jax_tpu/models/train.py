@@ -1,0 +1,167 @@
+"""Distributed training-step demo: data-parallel × tensor-parallel MLP.
+
+The reference ships these as *enabled patterns*, not a trainer: the DP
+gradient-allreduce headline example (README.rst:61-80) and the
+tensor-parallel sharded matvec with its AD-correct transpose
+(tests/collective_ops/test_allreduce_matvec.py:44-62) — SURVEY §2.4
+requires both as first-class, tested capabilities.  This module composes
+them into a real train step on mpi4jax_tpu primitives:
+
+* TP (Megatron f/g pair): W1 column-sharded, W2 row-sharded over the
+  ``tp`` mesh axis; the partial output is summed with ``allreduce`` (the
+  "g" collective).  The identity-transpose AD convention delivers the
+  correct per-shard gradients in the backward pass (the "f" side).
+* DP: per-device micro-batches over the ``dp`` axis; gradients averaged
+  with ``allreduce`` before the optimiser step.
+
+The whole step — forward, backward, both allreduce families, SGD — runs
+inside one ``shard_map`` under ``jit``: on a TPU slice it compiles to a
+single executable whose collectives ride ICI.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mpi4jax_tpu.ops import reductions
+from mpi4jax_tpu.ops.allreduce import allreduce
+from mpi4jax_tpu.ops._core import create_token
+
+__all__ = [
+    "MLPParams",
+    "init_params",
+    "make_train_step",
+    "make_global_train_step",
+]
+
+
+class MLPParams(NamedTuple):
+    w1: jax.Array  # (d_in, d_hidden / tp) column shard
+    b1: jax.Array  # (d_hidden / tp,)
+    w2: jax.Array  # (d_hidden / tp, d_out) row shard
+    b2: jax.Array  # (d_out,) replicated (only tp-rank 0's bias is added)
+
+
+def init_params(key, d_in, d_hidden, d_out, tp_size, dtype=jnp.float32):
+    """Global parameter arrays laid out for TP sharding on axis tp.
+
+    Returns arrays shaped for a ``(dp, tp)`` mesh: the hidden dimension
+    carries the tp shards.
+    """
+    if d_hidden % tp_size:
+        raise ValueError(
+            f"d_hidden={d_hidden} must be divisible by tp_size={tp_size}"
+        )
+    k1, k2 = jax.random.split(key)
+    scale1 = (2.0 / d_in) ** 0.5
+    scale2 = (2.0 / d_hidden) ** 0.5
+    w1 = jax.random.normal(k1, (d_in, d_hidden), dtype) * scale1
+    w2 = jax.random.normal(k2, (d_hidden, d_out), dtype) * scale2
+    b1 = jnp.zeros((d_hidden,), dtype)
+    b2 = jnp.zeros((d_out,), dtype)
+    return MLPParams(w1, b1, w2, b2)
+
+
+def _forward(params, x, comm_tp, token):
+    """TP forward: local matmuls + one output allreduce (the g op)."""
+    h = jax.nn.relu(x @ params.w1 + params.b1)  # (B, hid/tp) local
+    y_partial = h @ params.w2  # (B, d_out) partial sum
+    y, token = allreduce(y_partial, reductions.SUM, comm=comm_tp, token=token)
+    # bias is replicated; add once (scaled by 1/tp it would drift — add
+    # full bias after the reduce instead)
+    return y + params.b2, token
+
+
+def make_train_step(comm_dp, comm_tp, lr=1e-2):
+    """Per-device SPMD train step; call inside shard_map over (dp, tp).
+
+    ``batch = (x, targets)`` holds this device's micro-batch (identical
+    across the tp axis, sharded across dp).
+    """
+
+    def step(params, batch):
+        x, targets = batch
+        token = create_token()
+
+        def loss_fn(p):
+            y, _tok = _forward(p, x, comm_tp, token)
+            return jnp.mean((y - targets) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+
+        # DP gradient averaging (README.rst:61-80 pattern)
+        tok = create_token()
+        dp = float(comm_dp.size)
+        synced = []
+        for g in grads:
+            g_sum, tok = allreduce(g, reductions.SUM, comm=comm_dp, token=tok)
+            synced.append(g_sum / dp)
+        grads = MLPParams(*synced)
+
+        # loss is averaged too, for logging parity across devices
+        loss_sum, tok = allreduce(loss, reductions.SUM, comm=comm_dp, token=tok)
+        loss = loss_sum / dp
+
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    return step
+
+
+def make_global_train_step(mesh, comm_dp, comm_tp, lr=1e-2):
+    """Jitted global train step over a ("dp", "tp") mesh.
+
+    Parameters enter with their hidden dimension sharded over tp and
+    replicated over dp; the batch is sharded over dp.  The TP forward
+    goes through :func:`allreduce` (and its backward through the
+    identity-transpose rule); the DP gradient sync uses ``lax.psum``
+    directly so the updated parameters are *typed* replicated over dp —
+    which lets the out_specs declare them unsharded on that axis.
+    """
+    from jax import lax
+
+    dp_ax, tp_ax = comm_dp.axes[0], comm_tp.axes[0]
+    dp, tp = float(comm_dp.size), float(comm_tp.size)
+
+    param_specs = MLPParams(
+        w1=jax.P(None, tp_ax),
+        b1=jax.P(tp_ax),
+        w2=jax.P(tp_ax, None),
+        b2=jax.P(None),
+    )
+    batch_specs = (jax.P(dp_ax, None), jax.P(dp_ax, None))
+
+    def sync_grad(g, tp_sharded):
+        if tp_sharded:
+            return lax.psum(g, dp_ax) / dp
+        # replicated params: identical grads across tp; psum over both
+        # axes (÷ tp) re-establishes the replicated typing
+        return lax.psum(g, (dp_ax, tp_ax)) / (dp * tp)
+
+    def local_step(params, batch):
+        x, targets = batch
+        token = create_token()
+
+        def loss_fn(p):
+            y, _tok = _forward(p, x, comm_tp, token)
+            return jnp.mean((y - targets) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = MLPParams(
+            w1=sync_grad(grads.w1, True),
+            b1=sync_grad(grads.b1, True),
+            w2=sync_grad(grads.w2, True),
+            b2=sync_grad(grads.b2, False),
+        )
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, loss[None]
+
+    return jax.jit(
+        jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(param_specs, batch_specs),
+            out_specs=(param_specs, jax.P((dp_ax, tp_ax))),
+        )
+    )

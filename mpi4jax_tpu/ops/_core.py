@@ -160,6 +160,30 @@ def fence_out(token, *arrays):
     return token.with_stamp(out[0]), out[1:]
 
 
+def promote_vma(x, axes):
+    """Promote ``x`` to be device-varying over all of ``axes``.
+
+    JAX's collectives require a uniform varying-state across the named
+    axes; values derived from only one mesh axis (e.g. a y-coordinate
+    field on a ("y","x") comm) must be explicitly ``pvary``-ed before a
+    multi-axis collective.  No-op outside shard_map and for already-
+    varying values.
+    """
+    import jax
+
+    try:
+        vma = jax.typeof(x).vma
+    except AttributeError:
+        return x
+    missing = tuple(a for a in axes if a not in vma)
+    if missing:
+        if hasattr(lax, "pcast"):
+            x = lax.pcast(x, missing, to="varying")
+        else:
+            x = lax.pvary(x, missing)
+    return x
+
+
 def comm_key(comm):
     """Hashable identity of a communicator for send/recv matching."""
     if comm.backend == "mesh":

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi4jax_tpu.ops import reductions
-from mpi4jax_tpu.ops._core import as_token, fence_in, fence_out
+from mpi4jax_tpu.ops._core import as_token, fence_in, fence_out, promote_vma
 from mpi4jax_tpu.ops.allreduce import allreduce
 from mpi4jax_tpu.utils.validation import check_comm, check_op, check_root
 
@@ -69,6 +69,7 @@ def allgather(x, *, comm=None, token=None):
         return y, token
     if comm.backend == "mesh":
         token, (x,) = fence_in(token, x)
+        x = promote_vma(x, comm.axes)
         y = lax.all_gather(x, comm.axes, axis=0, tiled=False)
         token, (y,) = fence_out(token, y)
         return y, token
@@ -93,6 +94,7 @@ def alltoall(x, *, comm=None, token=None):
         return x, token
     if comm.backend == "mesh":
         token, (x,) = fence_in(token, x)
+        x = promote_vma(x, comm.axes)
         y = lax.all_to_all(x, comm.axes, split_axis=0, concat_axis=0, tiled=True)
         token, (y,) = fence_out(token, y)
         return y, token
@@ -137,6 +139,7 @@ def bcast(x, root, *, comm=None, token=None):
         rank = lax.axis_index(comm.axes)
         as_int = x.dtype == jnp.bool_
         xv = x.astype(jnp.int8) if as_int else x
+        xv = promote_vma(xv, comm.axes)
         masked = jnp.where(rank == root, xv, jnp.zeros_like(xv))
         y = lax.psum(masked, comm.axes)
         if as_int:
@@ -189,6 +192,7 @@ def scan(x, op, *, comm=None, token=None):
         rank = lax.axis_index(comm.axes)
         as_int = x.dtype == jnp.bool_
         acc = x.astype(jnp.int8) if as_int else x
+        acc = promote_vma(acc, comm.axes)
         dist = 1
         while dist < size:
             perm = [(r, r + dist) for r in range(size - dist)]
@@ -228,6 +232,7 @@ def scatter(x, root, *, comm=None, token=None):
         rank = lax.axis_index(comm.axes)
         as_int = x.dtype == jnp.bool_
         xv = x.astype(jnp.int8) if as_int else x
+        xv = promote_vma(xv, comm.axes)
         masked = jnp.where(rank == root, xv, jnp.zeros_like(xv))
         from_root = lax.psum(masked, comm.axes)
         y = lax.dynamic_index_in_dim(from_root, rank, axis=0, keepdims=False)

@@ -116,6 +116,9 @@ def _validate_perm(pairs, size, what):
 
 
 def _ppermute(x, axes, pairs):
+    from mpi4jax_tpu.ops._core import promote_vma
+
+    x = promote_vma(x, axes)
     if x.dtype == jnp.bool_:
         return lax.ppermute(x.astype(jnp.int8), axes, pairs).astype(jnp.bool_)
     return lax.ppermute(x, axes, pairs)

@@ -143,6 +143,9 @@ def mesh_allreduce(x, op, axes):
     ``lax.reduce`` — semantically the reference's MPI_Allreduce with an
     arbitrary MPI.Op (mpi4jax/_src/collective_ops/allreduce.py:36-66).
     """
+    from mpi4jax_tpu.ops._core import promote_vma
+
+    x = promote_vma(x, axes)
     dtype = x.dtype
     if op.name == "sum":
         if dtype == jnp.bool_:

@@ -1,0 +1,37 @@
+"""Runtime helpers shared by the bench/example drivers.
+
+``drain`` exists because ``jax.block_until_ready`` is a no-op on some
+experimental PJRT platforms (observed on the axon TPU plugin): fetching
+a scalar element forces the execution queue to finish on every backend.
+The per-tick flush role matches the reference's exit/loop hygiene
+(mpi4jax/_src/flush.py:1-12 — device_put+0 noop as a work barrier).
+"""
+
+import math
+
+import numpy as np
+
+__all__ = ["drain", "best_mesh_shape"]
+
+
+def drain(x):
+    """Block until device work producing ``x`` has finished.
+
+    ``x`` may be any jax array; returns the first element as a numpy
+    scalar (cheap single-element transfer).
+    """
+    import jax
+
+    arr = x
+    while getattr(arr, "ndim", 0) > 0:
+        arr = arr[(0,) * arr.ndim]
+    return np.asarray(jax.device_get(arr))
+
+
+def best_mesh_shape(n):
+    """Closest-to-square (py, px) with py * px == n and py <= px."""
+    best = (1, n)
+    for py in range(1, int(math.isqrt(n)) + 1):
+        if n % py == 0:
+            best = (py, n // py)
+    return best

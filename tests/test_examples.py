@@ -1,0 +1,31 @@
+"""Example-as-test (reference: tests/test_examples.py:20-24 runs the real
+shallow-water demo in CI)."""
+
+import pathlib
+import sys
+
+import pytest
+
+
+def test_shallow_water_example_runs():
+    examples = pathlib.Path(__file__).resolve().parent.parent / "examples"
+    sys.path.insert(0, str(examples))
+    try:
+        import shallow_water as demo
+
+        rate = demo.main(["--check", "--mesh", "2", "4"])
+        assert rate > 0
+    finally:
+        sys.path.remove(str(examples))
+
+
+def test_bench_entrypoint_importable():
+    root = pathlib.Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(root))
+    try:
+        import bench
+
+        assert bench.best_mesh_shape(8) == (2, 4)
+        assert bench.best_mesh_shape(7) == (1, 7)
+    finally:
+        sys.path.remove(str(root))
