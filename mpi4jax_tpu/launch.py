@@ -1,0 +1,107 @@
+"""Multi-process job launcher: the ``mpirun`` equivalent.
+
+    python -m mpi4jax_tpu.launch -np 4 prog.py [args...]
+
+Spawns N worker processes, wires the DCN-bridge bootstrap environment
+(T4J_RANK / T4J_SIZE / T4J_COORD), initialises the native runtime in
+each child before handing control to the user program, and propagates
+the first nonzero exit (terminating the rest) — the fail-fast job
+semantics of ``mpirun`` + the reference's MPI_Abort behaviour.
+
+Children default to the CPU platform (one XLA CPU per process, the
+reference's process model); override with ``--platform``.
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def child_main(argv):
+    """Entry for worker processes (internal)."""
+    prog, *prog_args = argv
+    platform = os.environ.get("T4J_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    from mpi4jax_tpu.native import runtime
+
+    runtime.ensure_initialized()
+    sys.argv = [prog] + prog_args
+    import runpy
+
+    runpy.run_path(prog, run_name="__main__")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="mpi4jax_tpu.launch")
+    parser.add_argument("-np", "--nprocs", type=int, required=False)
+    parser.add_argument("--platform", default="cpu")
+    parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("prog", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.child:
+        child_main(args.prog)
+        return 0
+
+    if not args.nprocs or not args.prog:
+        parser.error("usage: python -m mpi4jax_tpu.launch -np N prog.py ...")
+
+    n = args.nprocs
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(
+            T4J_RANK=str(rank),
+            T4J_SIZE=str(n),
+            T4J_COORD=coord,
+            T4J_PLATFORM=args.platform,
+        )
+        cmd = [
+            sys.executable,
+            "-m",
+            "mpi4jax_tpu.launch",
+            "--child",
+            *args.prog,
+        ]
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    exit_code = 0
+    try:
+        remaining = set(range(n))
+        while remaining:
+            for i in list(remaining):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                remaining.discard(i)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    # fail fast: take the rest of the job down
+                    for j in remaining:
+                        procs[j].terminate()
+            if remaining:
+                import time
+
+                time.sleep(0.05)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        exit_code = 130
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

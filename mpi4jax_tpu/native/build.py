@@ -1,0 +1,87 @@
+"""Build the native DCN bridge shared library.
+
+The reference compiles its Cython bridge with mpicc at pip-install time
+(setup.py:75-86 custom_build_ext); here the C++ bridge is compiled with
+g++ against the XLA FFI headers shipped inside jaxlib
+(``jax.ffi.include_dir()``), cached by source mtime, on first use.
+
+Also usable standalone:  python -m mpi4jax_tpu.native.build
+"""
+
+import pathlib
+import subprocess
+import sys
+
+__all__ = ["lib_path", "ensure_built", "build"]
+
+_SRC_DIR = pathlib.Path(__file__).resolve().parent / "src"
+_OUT = pathlib.Path(__file__).resolve().parent / "_t4j_dcn.so"
+_SOURCES = ["dcn.cc", "ffi.cc"]
+
+
+def lib_path():
+    return _OUT
+
+
+def _needs_build():
+    if not _OUT.exists():
+        return True
+    out_mtime = _OUT.stat().st_mtime
+    for s in _SOURCES + ["dcn.h"]:
+        if (_SRC_DIR / s).stat().st_mtime > out_mtime:
+            return True
+    return False
+
+
+def build(verbose=False):
+    import os
+    import jax.ffi
+
+    include = jax.ffi.include_dir()
+    tmp = _OUT.with_suffix(f".tmp{os.getpid()}.so")
+    cmd = [
+        "g++",
+        "-O2",
+        "-fPIC",
+        "-shared",
+        "-std=c++17",
+        "-Wall",
+        f"-I{include}",
+        *[str(_SRC_DIR / s) for s in _SOURCES],
+        "-o",
+        str(tmp),
+        "-lpthread",
+    ]
+    if verbose:
+        print(" ".join(cmd), file=sys.stderr)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
+        raise RuntimeError(
+            f"native bridge build failed:\n{proc.stderr[-4000:]}"
+        )
+    os.replace(tmp, _OUT)  # atomic: concurrent loaders never see a torn .so
+    return _OUT
+
+
+def ensure_built():
+    if not _needs_build():
+        return _OUT
+    # N launcher children may hit a cold cache simultaneously; serialise
+    # through a file lock so exactly one compiles and the rest reuse it
+    import fcntl
+
+    lock = _OUT.with_suffix(".lock")
+    with open(lock, "w") as fh:
+        fcntl.flock(fh, fcntl.LOCK_EX)
+        try:
+            if _needs_build():
+                build()
+        finally:
+            fcntl.flock(fh, fcntl.LOCK_UN)
+    return _OUT
+
+
+if __name__ == "__main__":
+    build(verbose=True)
+    print(f"built {_OUT}")

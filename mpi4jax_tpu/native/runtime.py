@@ -1,0 +1,178 @@
+"""Python side of the native DCN bridge: ctypes control plane + FFI
+target registration.
+
+Counterpart of the reference's bridge registration
+(mpi4jax/_src/xla_bridge/__init__.py:26-31): loads the shared library,
+hands the 12 typed-FFI handler symbols to XLA for the "cpu" platform,
+and exposes the process-world control API (init/rank/size/comms) that
+mpi4py provides in the reference.
+"""
+
+import atexit
+import ctypes
+import os
+
+__all__ = [
+    "available",
+    "is_initialized",
+    "ensure_initialized",
+    "world_rank",
+    "world_size",
+    "comm_handle",
+    "set_logging",
+    "finalize",
+    "HANDLER_NAMES",
+]
+
+HANDLER_NAMES = [
+    "t4j_allreduce",
+    "t4j_reduce",
+    "t4j_scan",
+    "t4j_send",
+    "t4j_recv",
+    "t4j_sendrecv",
+    "t4j_barrier",
+    "t4j_bcast",
+    "t4j_allgather",
+    "t4j_gather",
+    "t4j_scatter",
+    "t4j_alltoall",
+]
+
+_state = {"lib": None, "registered": False, "comm_cache": {}}
+
+
+def _load():
+    if _state["lib"] is not None:
+        return _state["lib"]
+    from mpi4jax_tpu.native.build import ensure_built
+
+    lib = ctypes.CDLL(str(ensure_built()))
+    lib.t4j_init.restype = ctypes.c_int
+    lib.t4j_initialized.restype = ctypes.c_int
+    lib.t4j_world_rank.restype = ctypes.c_int
+    lib.t4j_world_size.restype = ctypes.c_int
+    lib.t4j_comm_create.restype = ctypes.c_int
+    lib.t4j_comm_create.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    lib.t4j_comm_rank.restype = ctypes.c_int
+    lib.t4j_comm_rank.argtypes = [ctypes.c_int32]
+    lib.t4j_comm_size.restype = ctypes.c_int
+    lib.t4j_comm_size.argtypes = [ctypes.c_int32]
+    lib.t4j_set_logging.argtypes = [ctypes.c_int]
+    _state["lib"] = lib
+    return lib
+
+
+def available():
+    """True when this process is part of a multi-process job (launched
+    via mpi4jax_tpu.launch or with T4J_RANK/T4J_SIZE set)."""
+    return "T4J_RANK" in os.environ and "T4J_SIZE" in os.environ
+
+
+def is_initialized():
+    lib = _state["lib"]
+    return bool(lib and lib.t4j_initialized())
+
+
+def _register_ffi_targets(lib):
+    if _state["registered"]:
+        return
+    import jax.ffi
+
+    for name in HANDLER_NAMES:
+        fn = getattr(lib, name)
+        jax.ffi.register_ffi_target(
+            name, jax.ffi.pycapsule(fn), platform="cpu"
+        )
+    _state["registered"] = True
+
+
+def ensure_initialized():
+    """Bootstrap the process world (idempotent).
+
+    The analog of the reference's import-time ``from mpi4py import MPI``
+    (mpi4jax/_src/__init__.py:3), made lazy/explicit: connects the TCP
+    mesh, registers the XLA FFI targets, and installs the exit hook.
+    """
+    if is_initialized():
+        return True
+    if not available():
+        return False
+    lib = _load()
+    if lib.t4j_init() != 0:
+        raise RuntimeError("native bridge init failed (check T4J_* env)")
+    _register_ffi_targets(lib)
+    atexit.register(finalize)
+    return True
+
+
+def finalize():
+    lib = _state["lib"]
+    if lib and lib.t4j_initialized():
+        # flush pending XLA work before tearing down sockets — the
+        # reference registers the same hygiene (decorators.py:11-24,
+        # flush.py) to avoid the deadlock-on-exit class of bugs
+        try:
+            from mpi4jax_tpu.utils.runtime import drain
+            import jax
+            import jax.numpy as jnp
+
+            drain(jnp.zeros(()) + 0)
+        except Exception:
+            pass
+        lib.t4j_finalize()
+
+
+def world_rank():
+    ensure_initialized()
+    return _state["lib"].t4j_world_rank()
+
+
+def world_size():
+    ensure_initialized()
+    return _state["lib"].t4j_world_size()
+
+
+def set_logging(enabled):
+    lib = _load()
+    lib.t4j_set_logging(1 if enabled else 0)
+
+
+def _stable_ctx(ranks, context):
+    """Deterministic 30-bit channel id for a communicator.
+
+    Every member must derive the same wire context regardless of its
+    local comm-creation order (MPMD processes create comms at different
+    times), so the id is a pure function of the group + clone generation
+    — FNV-1a over the rank list and context counter.  The world comm is
+    pinned to ctx 0 natively.
+    """
+    h = 0x811C9DC5
+    for v in (*ranks, 0x7FFFFFFF, context):
+        h ^= (v + 1) & 0xFFFFFFFF
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    ctx = h & 0x3FFFFFFF
+    return ctx if ctx != 0 else 1
+
+
+def comm_handle(comm):
+    """Native handle for a ProcComm (cached per (ranks, context))."""
+    ensure_initialized()
+    key = (tuple(comm.ranks), comm.context)
+    cached = _state["comm_cache"].get(key)
+    if cached is not None:
+        return cached
+    lib = _state["lib"]
+    if len(comm.ranks) == world_size() and comm.context == 0:
+        handle = 0  # the pre-created world communicator
+    else:
+        arr = (ctypes.c_int32 * len(comm.ranks))(*comm.ranks)
+        handle = lib.t4j_comm_create(
+            arr, len(comm.ranks), _stable_ctx(comm.ranks, comm.context)
+        )
+    _state["comm_cache"][key] = handle
+    return handle

@@ -1,0 +1,877 @@
+// DCN bridge implementation: see dcn.h.
+
+#include "dcn.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <complex>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace t4j {
+
+namespace {
+
+// ---------------------------------------------------------------- logging
+
+bool g_logging = false;
+int g_rank = -1;
+int g_size = -1;
+bool g_initialized = false;
+std::atomic<bool> g_shutting_down{false};
+
+std::string call_id() {
+  // 8-char random id, matching the reference's debug-log wire format
+  // (mpi_xla_bridge.pyx:35-60).
+  static thread_local std::mt19937_64 rng(
+      std::random_device{}() ^
+      std::hash<std::thread::id>{}(std::this_thread::get_id()));
+  static const char alnum[] =
+      "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789";
+  std::string s(8, 'x');
+  for (auto& c : s) c = alnum[rng() % (sizeof(alnum) - 1)];
+  return s;
+}
+
+struct LogScope {
+  std::string id;
+  std::chrono::steady_clock::time_point start;
+  bool active;
+
+  LogScope(const char* op, const std::string& detail) : active(g_logging) {
+    if (!active) return;
+    id = call_id();
+    start = std::chrono::steady_clock::now();
+    std::fprintf(stderr, "r%d | %s | %s %s\n", g_rank, id.c_str(), op,
+                 detail.c_str());
+  }
+  ~LogScope() {
+    if (!active) return;
+    double secs = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - start)
+                      .count();
+    std::fprintf(stderr, "r%d | %s | done with code 0 (%.2e s)\n", g_rank,
+                 id.c_str(), secs);
+  }
+};
+
+[[noreturn]] void die(const char* what) {
+  std::fprintf(stderr, "r%d | t4j DCN bridge: %s returned error; aborting job\n",
+               g_rank, what);
+  std::fflush(stderr);
+  _exit(13);
+}
+
+// ------------------------------------------------------------- transport
+
+struct Frame {
+  int src;
+  int ctx;
+  int tag;
+  std::vector<uint8_t> data;
+};
+
+struct PeerSock {
+  int fd = -1;
+  std::mutex send_mu;
+};
+
+std::vector<PeerSock> g_peers;  // world_size entries; [g_rank] unused
+std::vector<std::thread> g_readers;
+
+std::mutex g_mail_mu;
+std::condition_variable g_mail_cv;
+std::deque<Frame> g_mailbox;
+
+constexpr uint32_t kMagic = 0x7446a001;
+
+struct WireHeader {
+  uint32_t magic;
+  uint32_t src;
+  uint32_t ctx;
+  uint32_t tag;  // tag + 1 so ANY(-1) never travels
+  uint64_t nbytes;
+};
+
+void write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w <= 0) die("socket write");
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r == 0) return false;  // peer closed
+    if (r < 0) {
+      // a local shutdown() wakes blocked readers with an error; that is
+      // the clean teardown path, not a transport failure
+      if (g_shutting_down.load()) return false;
+      die("socket read");
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void reader_loop(int peer, int fd) {
+  (void)peer;
+  for (;;) {
+    WireHeader h;
+    if (!read_all(fd, &h, sizeof(h))) return;  // clean shutdown
+    if (h.magic != kMagic) die("frame magic check");
+    Frame f;
+    f.src = static_cast<int>(h.src);
+    f.ctx = static_cast<int>(h.ctx);
+    f.tag = static_cast<int>(h.tag) - 1;
+    f.data.resize(h.nbytes);
+    if (h.nbytes && !read_all(fd, f.data.data(), h.nbytes))
+      die("frame body read");
+    {
+      std::lock_guard<std::mutex> lk(g_mail_mu);
+      g_mailbox.push_back(std::move(f));
+    }
+    g_mail_cv.notify_all();
+  }
+}
+
+int enc_ctx(int ctx, bool coll) { return ctx * 2 + (coll ? 1 : 0); }
+
+void raw_send(int world_dest, int ctx, int tag, const void* buf,
+              size_t nbytes) {
+  if (world_dest == g_rank) {
+    Frame f;
+    f.src = g_rank;
+    f.ctx = ctx;
+    f.tag = tag;
+    f.data.assign(static_cast<const uint8_t*>(buf),
+                  static_cast<const uint8_t*>(buf) + nbytes);
+    {
+      std::lock_guard<std::mutex> lk(g_mail_mu);
+      g_mailbox.push_back(std::move(f));
+    }
+    g_mail_cv.notify_all();
+    return;
+  }
+  PeerSock& p = g_peers[world_dest];
+  if (p.fd < 0) die("send to unconnected peer");
+  WireHeader h{kMagic, static_cast<uint32_t>(g_rank),
+               static_cast<uint32_t>(ctx), static_cast<uint32_t>(tag + 1),
+               static_cast<uint64_t>(nbytes)};
+  std::lock_guard<std::mutex> lk(p.send_mu);
+  write_all(p.fd, &h, sizeof(h));
+  if (nbytes) write_all(p.fd, buf, nbytes);
+}
+
+// Blocking matched receive from the mailbox (MPI matching semantics:
+// FIFO per (source, ctx, tag) with wildcards).
+Frame raw_recv(int world_source, int ctx, int tag) {
+  std::unique_lock<std::mutex> lk(g_mail_mu);
+  for (;;) {
+    for (auto it = g_mailbox.begin(); it != g_mailbox.end(); ++it) {
+      if (it->ctx != ctx) continue;
+      if (world_source != kAnySource && it->src != world_source) continue;
+      if (tag != kAnyTag && it->tag != tag) continue;
+      Frame f = std::move(*it);
+      g_mailbox.erase(it);
+      return f;
+    }
+    g_mail_cv.wait(lk);
+  }
+}
+
+// ------------------------------------------------------------- bootstrap
+
+int tcp_listen(uint16_t* port_out) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) die("socket");
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(*port_out);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+    die("bind");
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  *port_out = ntohs(addr.sin_port);
+  if (::listen(fd, 128) < 0) die("listen");
+  return fd;
+}
+
+int tcp_connect(const std::string& host, uint16_t port) {
+  for (int attempt = 0; attempt < 600; ++attempt) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) die("socket");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      die("inet_pton (coordinator must be an IPv4 literal)");
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  die("connect (timeout)");
+}
+
+struct PeerAddr {
+  uint32_t ip;
+  uint16_t port;
+};
+
+void bootstrap(const std::string& coord_host, uint16_t coord_port) {
+  // Every rank opens a listener for the full-mesh phase.
+  uint16_t my_port = 0;
+  int listen_fd = tcp_listen(&my_port);
+
+  std::vector<PeerAddr> table(g_size);
+
+  if (g_rank == 0) {
+    // phase 1: collect every rank's (ip, port) on the coordinator socket
+    uint16_t cport = coord_port;
+    int coord_fd = tcp_listen(&cport);
+    table[0] = PeerAddr{htonl(INADDR_LOOPBACK), my_port};
+    std::vector<int> fds(g_size, -1);
+    for (int i = 1; i < g_size; ++i) {
+      sockaddr_in peer{};
+      socklen_t len = sizeof(peer);
+      int fd = ::accept(coord_fd, reinterpret_cast<sockaddr*>(&peer), &len);
+      if (fd < 0) die("accept (coordinator)");
+      uint32_t rank_and_port[2];
+      if (!read_all(fd, rank_and_port, sizeof(rank_and_port)))
+        die("coordinator handshake");
+      int r = static_cast<int>(rank_and_port[0]);
+      if (r < 1 || r >= g_size) die("coordinator rank check");
+      table[r] = PeerAddr{peer.sin_addr.s_addr,
+                          static_cast<uint16_t>(rank_and_port[1])};
+      fds[r] = fd;
+    }
+    // phase 2: broadcast the table
+    for (int i = 1; i < g_size; ++i) {
+      write_all(fds[i], table.data(), sizeof(PeerAddr) * g_size);
+      ::close(fds[i]);
+    }
+    ::close(coord_fd);
+  } else {
+    int fd = tcp_connect(coord_host, coord_port);
+    uint32_t rank_and_port[2] = {static_cast<uint32_t>(g_rank), my_port};
+    write_all(fd, rank_and_port, sizeof(rank_and_port));
+    if (!read_all(fd, table.data(), sizeof(PeerAddr) * g_size))
+      die("coordinator table read");
+    ::close(fd);
+  }
+
+  // phase 3: full mesh -- rank i accepts from ranks > i, connects to < i.
+  g_peers = std::vector<PeerSock>(g_size);
+  for (int lower = 0; lower < g_rank; ++lower) {
+    char ip[INET_ADDRSTRLEN];
+    in_addr a{table[lower].ip};
+    ::inet_ntop(AF_INET, &a, ip, sizeof(ip));
+    std::string host = (lower == 0) ? coord_host : std::string(ip);
+    int fd = tcp_connect(host, table[lower].port);
+    uint32_t me = static_cast<uint32_t>(g_rank);
+    write_all(fd, &me, sizeof(me));
+    g_peers[lower].fd = fd;
+  }
+  for (int higher = g_rank + 1; higher < g_size; ++higher) {
+    sockaddr_in peer{};
+    socklen_t len = sizeof(peer);
+    int fd = ::accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &len);
+    if (fd < 0) die("accept (mesh)");
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    uint32_t who = 0;
+    if (!read_all(fd, &who, sizeof(who))) die("mesh handshake");
+    if (static_cast<int>(who) <= g_rank || static_cast<int>(who) >= g_size)
+      die("mesh handshake rank check");
+    g_peers[who].fd = fd;
+  }
+  ::close(listen_fd);
+
+  for (int p = 0; p < g_size; ++p) {
+    if (p == g_rank || g_peers[p].fd < 0) continue;
+    g_readers.emplace_back(reader_loop, p, g_peers[p].fd);
+  }
+}
+
+// --------------------------------------------------------- communicators
+
+struct Comm {
+  std::vector<int> ranks;  // world ranks, ascending caller order
+  int ctx;
+  int my_index;  // index of g_rank in ranks, or -1
+};
+
+std::mutex g_comm_mu;
+// deque: push_back never invalidates references to existing elements,
+// so in-flight collectives can hold Comm& across concurrent comm_create
+std::deque<Comm> g_comms;
+
+// Collective traffic uses the upper tag space so it can never collide
+// with user p2p tags (which are >= 0 and modest).
+constexpr int kCollTagBase = 1 << 24;
+
+Comm& get_comm(int handle) {
+  std::lock_guard<std::mutex> lk(g_comm_mu);
+  if (handle < 0 || handle >= static_cast<int>(g_comms.size()))
+    die("invalid communicator handle");
+  return g_comms[handle];
+}
+
+// ------------------------------------------------------------ reductions
+
+template <typename T>
+void combine_typed(ReduceOp op, const T* a, T* acc, size_t n) {
+  switch (op) {
+    case ReduceOp::kSum:
+      for (size_t i = 0; i < n; ++i) acc[i] = acc[i] + a[i];
+      return;
+    case ReduceOp::kProd:
+      for (size_t i = 0; i < n; ++i) acc[i] = acc[i] * a[i];
+      return;
+    case ReduceOp::kMin:
+      if constexpr (!std::is_same_v<T, std::complex<float>> &&
+                    !std::is_same_v<T, std::complex<double>>) {
+        for (size_t i = 0; i < n; ++i) acc[i] = a[i] < acc[i] ? a[i] : acc[i];
+        return;
+      }
+      die("MIN on complex dtype");
+    case ReduceOp::kMax:
+      if constexpr (!std::is_same_v<T, std::complex<float>> &&
+                    !std::is_same_v<T, std::complex<double>>) {
+        for (size_t i = 0; i < n; ++i) acc[i] = acc[i] < a[i] ? a[i] : acc[i];
+        return;
+      }
+      die("MAX on complex dtype");
+    default:
+      break;
+  }
+  if constexpr (std::is_integral_v<T>) {
+    switch (op) {
+      case ReduceOp::kLand:
+        for (size_t i = 0; i < n; ++i) acc[i] = (acc[i] && a[i]) ? 1 : 0;
+        return;
+      case ReduceOp::kLor:
+        for (size_t i = 0; i < n; ++i) acc[i] = (acc[i] || a[i]) ? 1 : 0;
+        return;
+      case ReduceOp::kLxor:
+        for (size_t i = 0; i < n; ++i)
+          acc[i] = ((acc[i] != 0) != (a[i] != 0)) ? 1 : 0;
+        return;
+      case ReduceOp::kBand:
+        for (size_t i = 0; i < n; ++i) acc[i] = acc[i] & a[i];
+        return;
+      case ReduceOp::kBor:
+        for (size_t i = 0; i < n; ++i) acc[i] = acc[i] | a[i];
+        return;
+      case ReduceOp::kBxor:
+        for (size_t i = 0; i < n; ++i) acc[i] = acc[i] ^ a[i];
+        return;
+      default:
+        break;
+    }
+  }
+  die("unsupported reduce op for dtype");
+}
+
+// half-precision types travel as uint16 and reduce via float
+float half_to_float(uint16_t h, bool bf16) {
+  if (bf16) {
+    uint32_t bits = static_cast<uint32_t>(h) << 16;
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+  }
+  // IEEE f16 -> f32
+  uint32_t sign = (h >> 15) & 1, exp = (h >> 10) & 0x1f, frac = h & 0x3ff;
+  uint32_t bits;
+  if (exp == 0) {
+    if (frac == 0) {
+      bits = sign << 31;
+    } else {
+      exp = 127 - 15 + 1;
+      while (!(frac & 0x400)) {
+        frac <<= 1;
+        --exp;
+      }
+      frac &= 0x3ff;
+      bits = (sign << 31) | (exp << 23) | (frac << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = (sign << 31) | 0x7f800000u | (frac << 13);
+  } else {
+    bits = (sign << 31) | ((exp - 15 + 127) << 23) | (frac << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+uint16_t float_to_half(float f, bool bf16) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  if (bf16) {
+    // round-to-nearest-even
+    uint32_t rounding = ((bits >> 16) & 1) + 0x7fff;
+    return static_cast<uint16_t>((bits + rounding) >> 16);
+  }
+  uint32_t sign = (bits >> 31) & 1, exp = (bits >> 23) & 0xff,
+           frac = bits & 0x7fffff;
+  uint16_t h;
+  if (exp >= 0xff) {
+    h = static_cast<uint16_t>((sign << 15) | 0x7c00 | (frac ? 0x200 : 0));
+  } else if (exp > 127 + 15) {
+    h = static_cast<uint16_t>((sign << 15) | 0x7c00);
+  } else if (exp < 127 - 14) {
+    h = static_cast<uint16_t>(sign << 15);  // flush tiny to zero
+  } else {
+    h = static_cast<uint16_t>((sign << 15) | ((exp - 127 + 15) << 10) |
+                              (frac >> 13));
+  }
+  return h;
+}
+
+void combine_half(ReduceOp op, const uint16_t* a, uint16_t* acc, size_t n,
+                  bool bf16) {
+  for (size_t i = 0; i < n; ++i) {
+    float x = half_to_float(a[i], bf16), y = half_to_float(acc[i], bf16);
+    float r;
+    switch (op) {
+      case ReduceOp::kSum:
+        r = y + x;
+        break;
+      case ReduceOp::kProd:
+        r = y * x;
+        break;
+      case ReduceOp::kMin:
+        r = x < y ? x : y;
+        break;
+      case ReduceOp::kMax:
+        r = y < x ? x : y;
+        break;
+      default:
+        die("unsupported reduce op for half dtype");
+    }
+    acc[i] = float_to_half(r, bf16);
+  }
+}
+
+void combine(ReduceOp op, DType dt, const void* contrib, void* acc,
+             size_t count) {
+  switch (dt) {
+    case DType::kF32:
+      return combine_typed(op, static_cast<const float*>(contrib),
+                           static_cast<float*>(acc), count);
+    case DType::kF64:
+      return combine_typed(op, static_cast<const double*>(contrib),
+                           static_cast<double*>(acc), count);
+    case DType::kI8:
+      return combine_typed(op, static_cast<const int8_t*>(contrib),
+                           static_cast<int8_t*>(acc), count);
+    case DType::kI16:
+      return combine_typed(op, static_cast<const int16_t*>(contrib),
+                           static_cast<int16_t*>(acc), count);
+    case DType::kI32:
+      return combine_typed(op, static_cast<const int32_t*>(contrib),
+                           static_cast<int32_t*>(acc), count);
+    case DType::kI64:
+      return combine_typed(op, static_cast<const int64_t*>(contrib),
+                           static_cast<int64_t*>(acc), count);
+    case DType::kU8:
+    case DType::kBool:
+      return combine_typed(op, static_cast<const uint8_t*>(contrib),
+                           static_cast<uint8_t*>(acc), count);
+    case DType::kU16:
+      return combine_typed(op, static_cast<const uint16_t*>(contrib),
+                           static_cast<uint16_t*>(acc), count);
+    case DType::kU32:
+      return combine_typed(op, static_cast<const uint32_t*>(contrib),
+                           static_cast<uint32_t*>(acc), count);
+    case DType::kU64:
+      return combine_typed(op, static_cast<const uint64_t*>(contrib),
+                           static_cast<uint64_t*>(acc), count);
+    case DType::kC64:
+      return combine_typed(op, static_cast<const std::complex<float>*>(contrib),
+                           static_cast<std::complex<float>*>(acc), count);
+    case DType::kC128:
+      return combine_typed(op,
+                           static_cast<const std::complex<double>*>(contrib),
+                           static_cast<std::complex<double>*>(acc), count);
+    case DType::kF16:
+      return combine_half(op, static_cast<const uint16_t*>(contrib),
+                          static_cast<uint16_t*>(acc), count, false);
+    case DType::kBF16:
+      return combine_half(op, static_cast<const uint16_t*>(contrib),
+                          static_cast<uint16_t*>(acc), count, true);
+  }
+  die("unknown dtype");
+}
+
+// comm-relative send/recv; coll=true routes through the internal
+// collective channel (separate wire ctx), so user-facing ANY_SOURCE /
+// ANY_TAG receives can never capture collective frames
+void csend(Comm& c, int dest_idx, int tag, const void* buf, size_t n,
+           bool coll = true) {
+  raw_send(c.ranks[dest_idx], enc_ctx(c.ctx, coll), tag, buf, n);
+}
+
+Frame crecv(Comm& c, int src_idx, int tag, bool coll = true) {
+  int world_src = src_idx == kAnySource ? kAnySource : c.ranks[src_idx];
+  return raw_recv(world_src, enc_ctx(c.ctx, coll), tag);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------- public
+
+size_t dtype_size(DType dt) {
+  switch (dt) {
+    case DType::kI8:
+    case DType::kU8:
+    case DType::kBool:
+      return 1;
+    case DType::kI16:
+    case DType::kU16:
+    case DType::kF16:
+    case DType::kBF16:
+      return 2;
+    case DType::kF32:
+    case DType::kI32:
+    case DType::kU32:
+      return 4;
+    case DType::kF64:
+    case DType::kI64:
+    case DType::kU64:
+    case DType::kC64:
+      return 8;
+    case DType::kC128:
+      return 16;
+  }
+  die("unknown dtype");
+}
+
+bool initialized() { return g_initialized; }
+int world_rank() { return g_rank; }
+int world_size() { return g_size; }
+void set_logging(bool enabled) { g_logging = enabled; }
+
+void abort_job(int code, const char* why) {
+  std::fprintf(stderr, "r%d | t4j abort: %s\n", g_rank, why);
+  std::fflush(stderr);
+  _exit(code);
+}
+
+int init_from_env() {
+  if (g_initialized) return 0;
+  const char* rank_s = std::getenv("T4J_RANK");
+  const char* size_s = std::getenv("T4J_SIZE");
+  const char* coord_s = std::getenv("T4J_COORD");
+  if (!rank_s || !size_s) return 1;  // not a multi-process job
+  g_rank = std::atoi(rank_s);
+  g_size = std::atoi(size_s);
+  if (g_size < 1 || g_rank < 0 || g_rank >= g_size) die("T4J_RANK/T4J_SIZE");
+  const char* dbg = std::getenv("MPI4JAX_TPU_DEBUG");
+  if (dbg && dbg[0] && std::strcmp(dbg, "0") != 0) g_logging = true;
+
+  if (g_size > 1) {
+    std::string coord = coord_s ? coord_s : "127.0.0.1:45677";
+    auto colon = coord.rfind(':');
+    if (colon == std::string::npos) die("T4J_COORD format (host:port)");
+    std::string host = coord.substr(0, colon);
+    uint16_t port = static_cast<uint16_t>(std::atoi(coord.c_str() + colon + 1));
+    bootstrap(host, port);
+  }
+
+  {
+    std::lock_guard<std::mutex> lk(g_comm_mu);
+    Comm world;
+    for (int i = 0; i < g_size; ++i) world.ranks.push_back(i);
+    world.ctx = 0;
+    world.my_index = g_rank;
+    g_comms.push_back(world);
+  }
+  g_initialized = true;
+  barrier(0);
+  return 0;
+}
+
+void finalize() {
+  if (!g_initialized) return;
+  barrier(0);
+  g_shutting_down.store(true);
+  // shutdown first (wakes blocked readers with EOF/error), close only
+  // after every reader has exited — closing a fd a thread is blocked on
+  // is undefined behaviour and produced spurious EBADF aborts
+  for (auto& p : g_peers) {
+    if (p.fd >= 0) ::shutdown(p.fd, SHUT_RDWR);
+  }
+  for (auto& t : g_readers) t.join();
+  g_readers.clear();
+  for (auto& p : g_peers) {
+    if (p.fd >= 0) {
+      ::close(p.fd);
+      p.fd = -1;
+    }
+  }
+  g_initialized = false;
+}
+
+int comm_create(const int* world_ranks, int n, int ctx) {
+  std::lock_guard<std::mutex> lk(g_comm_mu);
+  Comm c;
+  c.my_index = -1;
+  for (int i = 0; i < n; ++i) {
+    int r = world_ranks[i];
+    if (r < 0 || r >= g_size) die("comm_create rank range");
+    if (r == g_rank) c.my_index = i;
+    c.ranks.push_back(r);
+  }
+  // ctx is supplied by the caller as a deterministic function of
+  // (ranks, clone-generation) so every member derives the same channel
+  // id regardless of local comm-creation order (per-process counters
+  // would desynchronise under MPMD control flow)
+  c.ctx = ctx;
+  g_comms.push_back(c);
+  return static_cast<int>(g_comms.size()) - 1;
+}
+
+int comm_rank(int comm) { return get_comm(comm).my_index; }
+int comm_size(int comm) {
+  return static_cast<int>(get_comm(comm).ranks.size());
+}
+
+void send(int comm, const void* buf, size_t nbytes, int dest, int tag) {
+  Comm& c = get_comm(comm);
+  LogScope log("Send", "to " + std::to_string(dest) + " (" +
+                           std::to_string(nbytes) + " bytes, tag " +
+                           std::to_string(tag) + ")");
+  if (dest < 0 || dest >= static_cast<int>(c.ranks.size()))
+    die("send dest rank (MPI_Send)");
+  csend(c, dest, tag, buf, nbytes, /*coll=*/false);
+}
+
+void recv(int comm, void* buf, size_t nbytes, int source, int tag,
+          int* src_out, int* tag_out) {
+  Comm& c = get_comm(comm);
+  LogScope log("Recv", "from " + std::to_string(source) + " (" +
+                           std::to_string(nbytes) + " bytes, tag " +
+                           std::to_string(tag) + ")");
+  if (source != kAnySource &&
+      (source < 0 || source >= static_cast<int>(c.ranks.size())))
+    die("recv source rank (MPI_Recv)");
+  Frame f = crecv(c, source, tag, /*coll=*/false);
+  if (f.data.size() != nbytes) die("recv size mismatch");
+  std::memcpy(buf, f.data.data(), nbytes);
+  if (src_out) {
+    *src_out = 0;
+    for (size_t i = 0; i < c.ranks.size(); ++i)
+      if (c.ranks[i] == f.src) *src_out = static_cast<int>(i);
+  }
+  if (tag_out) *tag_out = f.tag;
+}
+
+void sendrecv(int comm, const void* sendbuf, void* recvbuf, size_t nbytes,
+              int source, int dest, int sendtag, int recvtag, int* src_out,
+              int* tag_out) {
+  Comm& c = get_comm(comm);
+  LogScope log("Sendrecv", "to " + std::to_string(dest) + " from " +
+                               std::to_string(source));
+  // eager sends cannot block: send first, then receive (the pattern the
+  // reference's deadlock test guards, test_send_and_recv.py:104-117)
+  csend(c, dest, sendtag, sendbuf, nbytes, /*coll=*/false);
+  Frame f = crecv(c, source, recvtag, /*coll=*/false);
+  if (f.data.size() != nbytes) die("sendrecv size mismatch");
+  std::memcpy(recvbuf, f.data.data(), nbytes);
+  if (src_out) {
+    *src_out = 0;
+    for (size_t i = 0; i < c.ranks.size(); ++i)
+      if (c.ranks[i] == f.src) *src_out = static_cast<int>(i);
+  }
+  if (tag_out) *tag_out = f.tag;
+}
+
+void barrier(int comm) {
+  Comm& c = get_comm(comm);
+  LogScope log("Barrier", "");
+  int n = static_cast<int>(c.ranks.size());
+  if (n == 1) return;
+  int me = c.my_index;
+  // dissemination barrier
+  for (int k = 1; k < n; k <<= 1) {
+    uint8_t b = 1;
+    csend(c, (me + k) % n, kCollTagBase + 1, &b, 1);
+    crecv(c, ((me - k) % n + n) % n, kCollTagBase + 1);
+  }
+}
+
+void bcast(int comm, void* buf, size_t nbytes, int root) {
+  Comm& c = get_comm(comm);
+  LogScope log("Bcast", std::to_string(nbytes) + " bytes from " +
+                            std::to_string(root));
+  int n = static_cast<int>(c.ranks.size());
+  if (n == 1) return;
+  // binomial tree rooted at `root` (rotate indices so root -> 0)
+  int me = (c.my_index - root % n + n) % n;
+  for (int k = 1; k < n; k <<= 1) {
+    if (me < k) {
+      int partner = me + k;
+      if (partner < n)
+        csend(c, (partner + root) % n, kCollTagBase + 2, buf, nbytes);
+    } else if (me < 2 * k) {
+      Frame f = crecv(c, ((me - k) + root) % n, kCollTagBase + 2);
+      if (f.data.size() != nbytes) die("bcast size mismatch");
+      std::memcpy(buf, f.data.data(), nbytes);
+    }
+  }
+}
+
+void reduce(int comm, const void* in, void* out, size_t count, DType dt,
+            ReduceOp op, int root) {
+  Comm& c = get_comm(comm);
+  LogScope log("Reduce", std::to_string(count) + " items to " +
+                             std::to_string(root));
+  int n = static_cast<int>(c.ranks.size());
+  size_t nbytes = count * dtype_size(dt);
+  std::vector<uint8_t> acc(static_cast<const uint8_t*>(in),
+                           static_cast<const uint8_t*>(in) + nbytes);
+  // binomial tree towards root (rotated)
+  int me = (c.my_index - root % n + n) % n;
+  int k = 1;
+  while (k < n) k <<= 1;
+  for (k >>= 1; k >= 1; k >>= 1) {
+    if (me < k) {
+      int partner = me + k;
+      if (partner < n) {
+        Frame f = crecv(c, (partner + root) % n, kCollTagBase + 3);
+        if (f.data.size() != nbytes) die("reduce size mismatch");
+        combine(op, dt, f.data.data(), acc.data(), count);
+      }
+    } else if (me < 2 * k) {
+      csend(c, ((me - k) + root) % n, kCollTagBase + 3, acc.data(), nbytes);
+      break;
+    }
+  }
+  if (c.my_index == root) std::memcpy(out, acc.data(), nbytes);
+}
+
+void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
+               ReduceOp op) {
+  Comm& c = get_comm(comm);
+  LogScope log("Allreduce", std::to_string(count) + " items");
+  size_t nbytes = count * dtype_size(dt);
+  reduce(comm, in, out, count, dt, op, 0);
+  if (c.my_index != 0) std::memcpy(out, in, nbytes);  // placate valgrind
+  bcast(comm, out, nbytes, 0);
+}
+
+void scan(int comm, const void* in, void* out, size_t count, DType dt,
+          ReduceOp op) {
+  Comm& c = get_comm(comm);
+  LogScope log("Scan", std::to_string(count) + " items");
+  int n = static_cast<int>(c.ranks.size());
+  size_t nbytes = count * dtype_size(dt);
+  std::memcpy(out, in, nbytes);
+  // linear inclusive prefix chain (MPI_Scan semantics)
+  if (c.my_index > 0) {
+    Frame f = crecv(c, c.my_index - 1, kCollTagBase + 4);
+    if (f.data.size() != nbytes) die("scan size mismatch");
+    std::vector<uint8_t> prefix(std::move(f.data));
+    combine(op, dt, in, prefix.data(), count);
+    std::memcpy(out, prefix.data(), nbytes);
+  }
+  if (c.my_index + 1 < n) csend(c, c.my_index + 1, kCollTagBase + 4, out, nbytes);
+}
+
+void allgather(int comm, const void* in, void* out, size_t nbytes_each) {
+  Comm& c = get_comm(comm);
+  LogScope log("Allgather", std::to_string(nbytes_each) + " bytes each");
+  gather(comm, in, out, nbytes_each, 0);
+  bcast(comm, out, nbytes_each * c.ranks.size(), 0);
+}
+
+void gather(int comm, const void* in, void* out, size_t nbytes_each,
+            int root) {
+  Comm& c = get_comm(comm);
+  LogScope log("Gather", std::to_string(nbytes_each) + " bytes each to " +
+                             std::to_string(root));
+  int n = static_cast<int>(c.ranks.size());
+  if (c.my_index == root) {
+    uint8_t* o = static_cast<uint8_t*>(out);
+    std::memcpy(o + nbytes_each * root, in, nbytes_each);
+    for (int i = 0; i < n; ++i) {
+      if (i == root) continue;
+      Frame f = crecv(c, i, kCollTagBase + 5);
+      if (f.data.size() != nbytes_each) die("gather size mismatch");
+      std::memcpy(o + nbytes_each * i, f.data.data(), nbytes_each);
+    }
+  } else {
+    csend(c, root, kCollTagBase + 5, in, nbytes_each);
+  }
+}
+
+void scatter(int comm, const void* in, void* out, size_t nbytes_each,
+             int root) {
+  Comm& c = get_comm(comm);
+  LogScope log("Scatter", std::to_string(nbytes_each) + " bytes each from " +
+                              std::to_string(root));
+  int n = static_cast<int>(c.ranks.size());
+  if (c.my_index == root) {
+    const uint8_t* i8 = static_cast<const uint8_t*>(in);
+    for (int i = 0; i < n; ++i) {
+      if (i == root) continue;
+      csend(c, i, kCollTagBase + 6, i8 + nbytes_each * i, nbytes_each);
+    }
+    std::memcpy(out, i8 + nbytes_each * root, nbytes_each);
+  } else {
+    Frame f = crecv(c, root, kCollTagBase + 6);
+    if (f.data.size() != nbytes_each) die("scatter size mismatch");
+    std::memcpy(out, f.data.data(), nbytes_each);
+  }
+}
+
+void alltoall(int comm, const void* in, void* out, size_t nbytes_each) {
+  Comm& c = get_comm(comm);
+  LogScope log("Alltoall", std::to_string(nbytes_each) + " bytes each");
+  int n = static_cast<int>(c.ranks.size());
+  int me = c.my_index;
+  const uint8_t* i8 = static_cast<const uint8_t*>(in);
+  uint8_t* o8 = static_cast<uint8_t*>(out);
+  std::memcpy(o8 + nbytes_each * me, i8 + nbytes_each * me, nbytes_each);
+  // staggered pairwise exchange
+  for (int off = 1; off < n; ++off) {
+    int to = (me + off) % n;
+    int from = ((me - off) % n + n) % n;
+    csend(c, to, kCollTagBase + 7, i8 + nbytes_each * to, nbytes_each);
+    Frame f = crecv(c, from, kCollTagBase + 7);
+    if (f.data.size() != nbytes_each) die("alltoall size mismatch");
+    std::memcpy(o8 + nbytes_each * from, f.data.data(), nbytes_each);
+  }
+}
+
+}  // namespace t4j

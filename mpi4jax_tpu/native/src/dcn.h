@@ -1,0 +1,106 @@
+// DCN bridge: TCP-based multi-process communication backend.
+//
+// Native replacement tier for the reference's Cython->libmpi bridge
+// (mpi4jax/_src/xla_bridge/mpi_xla_bridge.pyx): same responsibilities --
+// tagged point-to-point messaging with ANY_SOURCE/ANY_TAG matching,
+// collectives, abort-on-error semantics and the per-call debug log wire
+// format (mpi_xla_bridge.pyx:35-60) -- implemented over the hosts'
+// data-center network (TCP sockets) instead of libmpi, since the TPU
+// runtime environment ships no MPI.
+//
+// Process model: one OS process per rank (the reference's model,
+// SURVEY §7 "one JAX process per TPU host").  Bootstrap via environment:
+//   T4J_RANK, T4J_SIZE, T4J_COORD=host:port (rank 0 listens there).
+
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace t4j {
+
+constexpr int kAnySource = -1;
+constexpr int kAnyTag = -1;
+
+enum class ReduceOp : int32_t {
+  kSum = 0,
+  kProd = 1,
+  kMin = 2,
+  kMax = 3,
+  kLand = 4,
+  kLor = 5,
+  kLxor = 6,
+  kBand = 7,
+  kBor = 8,
+  kBxor = 9,
+};
+
+// Element dtypes, mirroring the reference's 14-entry dtype table
+// (mpi4jax/_src/utils.py:43-71).
+enum class DType : int32_t {
+  kF32 = 0,
+  kF64 = 1,
+  kI8 = 2,
+  kI16 = 3,
+  kI32 = 4,
+  kI64 = 5,
+  kU8 = 6,
+  kU16 = 7,
+  kU32 = 8,
+  kU64 = 9,
+  kBool = 10,
+  kC64 = 11,
+  kC128 = 12,
+  kF16 = 13,
+  kBF16 = 14,
+};
+
+size_t dtype_size(DType dt);
+
+// -- runtime lifecycle ----------------------------------------------------
+// All functions abort the process (after printing an MPI_Abort-style
+// message, mpi_xla_bridge.pyx:67-91) on unrecoverable transport errors.
+
+bool initialized();
+int init_from_env();  // returns 0 on success
+void finalize();
+int world_rank();
+int world_size();
+void set_logging(bool enabled);
+void abort_job(int code, const char* why);
+
+// -- communicators --------------------------------------------------------
+// A communicator is a subset of world ranks plus a context id that
+// namespaces its traffic (the clone/firewall semantics of the
+// reference's comm.py:4-11).
+int comm_create(const int* world_ranks, int n, int ctx);  // returns handle
+int comm_rank(int comm);                         // my rank within comm
+int comm_size(int comm);
+
+// -- point to point -------------------------------------------------------
+void send(int comm, const void* buf, size_t nbytes, int dest, int tag);
+// Blocks until a matching message arrives; fills *src/*tag_out with the
+// matched envelope. nbytes must match the message size exactly.
+void recv(int comm, void* buf, size_t nbytes, int source, int tag,
+          int* src_out, int* tag_out);
+void sendrecv(int comm, const void* sendbuf, void* recvbuf, size_t nbytes,
+              int source, int dest, int sendtag, int recvtag, int* src_out,
+              int* tag_out);
+
+// -- collectives ----------------------------------------------------------
+void barrier(int comm);
+void bcast(int comm, void* buf, size_t nbytes, int root);
+void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
+               ReduceOp op);
+void reduce(int comm, const void* in, void* out, size_t count, DType dt,
+            ReduceOp op, int root);
+void scan(int comm, const void* in, void* out, size_t count, DType dt,
+          ReduceOp op);
+void allgather(int comm, const void* in, void* out, size_t nbytes_each);
+void gather(int comm, const void* in, void* out, size_t nbytes_each,
+            int root);
+void scatter(int comm, const void* in, void* out, size_t nbytes_each,
+             int root);
+void alltoall(int comm, const void* in, void* out, size_t nbytes_each);
+
+}  // namespace t4j

@@ -1,0 +1,316 @@
+// XLA FFI entry points for the DCN bridge.
+//
+// The native replacement for the reference's CPU custom-call targets
+// (mpi4jax/_src/xla_bridge/mpi_xla_bridge_cpu.pyx:20-189): one typed-FFI
+// handler per op, registered from Python via jax.ffi.register_ffi_target.
+// Where the reference decodes positional scalar operands, handlers here
+// take static FFI attributes (comm handle, op code, root, tags) plus the
+// data buffer and a f32[] ordering stamp that threads the token chain
+// through the compiled program.
+//
+// Also exports the plain-C control API consumed through ctypes
+// (mpi4jax_tpu/native/runtime.py).
+
+#include <cstdint>
+#include <cstring>
+
+#include "dcn.h"
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+t4j::DType to_dtype(ffi::DataType dt) {
+  switch (dt) {
+    case ffi::F32:
+      return t4j::DType::kF32;
+    case ffi::F64:
+      return t4j::DType::kF64;
+    case ffi::S8:
+      return t4j::DType::kI8;
+    case ffi::S16:
+      return t4j::DType::kI16;
+    case ffi::S32:
+      return t4j::DType::kI32;
+    case ffi::S64:
+      return t4j::DType::kI64;
+    case ffi::U8:
+      return t4j::DType::kU8;
+    case ffi::U16:
+      return t4j::DType::kU16;
+    case ffi::U32:
+      return t4j::DType::kU32;
+    case ffi::U64:
+      return t4j::DType::kU64;
+    case ffi::PRED:
+      return t4j::DType::kBool;
+    case ffi::C64:
+      return t4j::DType::kC64;
+    case ffi::C128:
+      return t4j::DType::kC128;
+    case ffi::F16:
+      return t4j::DType::kF16;
+    case ffi::BF16:
+      return t4j::DType::kBF16;
+    default:
+      t4j::abort_job(13, "unsupported dtype in FFI call");
+  }
+}
+
+void touch_stamp(ffi::AnyBuffer& stamp, ffi::Result<ffi::AnyBuffer>& out) {
+  if (out->size_bytes() && stamp.size_bytes())
+    std::memcpy(out->untyped_data(), stamp.untyped_data(),
+                out->size_bytes());
+}
+
+ffi::Error ok() { return ffi::Error::Success(); }
+
+// ---- allreduce / reduce / scan -----------------------------------------
+
+ffi::Error AllreduceImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                         ffi::Result<ffi::AnyBuffer> y,
+                         ffi::Result<ffi::AnyBuffer> stamp_out,
+                         int32_t comm, int32_t op) {
+  t4j::allreduce(comm, x.untyped_data(), y->untyped_data(),
+                 x.element_count(), to_dtype(x.element_type()),
+                 static_cast<t4j::ReduceOp>(op));
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+ffi::Error ReduceImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                      ffi::Result<ffi::AnyBuffer> y,
+                      ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
+                      int32_t op, int32_t root) {
+  // non-root outputs mirror the input (the Python wrapper returns the
+  // input unchanged off-root, reference reduce.py:66-71)
+  std::memcpy(y->untyped_data(), x.untyped_data(), x.size_bytes());
+  t4j::reduce(comm, x.untyped_data(), y->untyped_data(), x.element_count(),
+              to_dtype(x.element_type()), static_cast<t4j::ReduceOp>(op),
+              root);
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+ffi::Error ScanImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                    ffi::Result<ffi::AnyBuffer> y,
+                    ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
+                    int32_t op) {
+  t4j::scan(comm, x.untyped_data(), y->untyped_data(), x.element_count(),
+            to_dtype(x.element_type()), static_cast<t4j::ReduceOp>(op));
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+// ---- p2p ----------------------------------------------------------------
+
+ffi::Error SendImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                    ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
+                    int32_t dest, int32_t tag) {
+  t4j::send(comm, x.untyped_data(), x.size_bytes(), dest, tag);
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+ffi::Error RecvImpl(ffi::AnyBuffer stamp, ffi::Result<ffi::AnyBuffer> y,
+                    ffi::Result<ffi::AnyBuffer> stamp_out,
+                    ffi::Result<ffi::AnyBuffer> status, int32_t comm,
+                    int32_t source, int32_t tag) {
+  int src = 0, got_tag = 0;
+  t4j::recv(comm, y->untyped_data(), y->size_bytes(), source, tag, &src,
+            &got_tag);
+  auto* st = static_cast<int32_t*>(status->untyped_data());
+  st[0] = src;
+  st[1] = got_tag;
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+ffi::Error SendrecvImpl(ffi::AnyBuffer sendbuf, ffi::AnyBuffer recvbuf,
+                        ffi::AnyBuffer stamp, ffi::Result<ffi::AnyBuffer> y,
+                        ffi::Result<ffi::AnyBuffer> stamp_out,
+                        ffi::Result<ffi::AnyBuffer> status, int32_t comm,
+                        int32_t source, int32_t dest, int32_t sendtag,
+                        int32_t recvtag) {
+  (void)recvbuf;
+  int src = 0, got_tag = 0;
+  t4j::sendrecv(comm, sendbuf.untyped_data(), y->untyped_data(),
+                y->size_bytes(), source, dest, sendtag, recvtag, &src,
+                &got_tag);
+  auto* st = static_cast<int32_t*>(status->untyped_data());
+  st[0] = src;
+  st[1] = got_tag;
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+// ---- rooted / gather family --------------------------------------------
+
+ffi::Error BarrierImpl(ffi::AnyBuffer stamp,
+                       ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm) {
+  t4j::barrier(comm);
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+ffi::Error BcastImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                     ffi::Result<ffi::AnyBuffer> y,
+                     ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
+                     int32_t root) {
+  std::memcpy(y->untyped_data(), x.untyped_data(), x.size_bytes());
+  t4j::bcast(comm, y->untyped_data(), y->size_bytes(), root);
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+ffi::Error AllgatherImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                         ffi::Result<ffi::AnyBuffer> y,
+                         ffi::Result<ffi::AnyBuffer> stamp_out,
+                         int32_t comm) {
+  t4j::allgather(comm, x.untyped_data(), y->untyped_data(), x.size_bytes());
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+ffi::Error GatherImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                      ffi::Result<ffi::AnyBuffer> y,
+                      ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
+                      int32_t root) {
+  t4j::gather(comm, x.untyped_data(), y->untyped_data(), x.size_bytes(),
+              root);
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+ffi::Error ScatterImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                       ffi::Result<ffi::AnyBuffer> y,
+                       ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
+                       int32_t root) {
+  t4j::scatter(comm, x.untyped_data(), y->untyped_data(), y->size_bytes(),
+               root);
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+ffi::Error AlltoallImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                        ffi::Result<ffi::AnyBuffer> y,
+                        ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm) {
+  int n = t4j::comm_size(comm);
+  t4j::alltoall(comm, x.untyped_data(), y->untyped_data(),
+                x.size_bytes() / static_cast<size_t>(n));
+  touch_stamp(stamp, stamp_out);
+  return ok();
+}
+
+}  // namespace
+
+// ---- handler symbol definitions ----------------------------------------
+
+#define T4J_BUF ffi::Ffi::Bind().Arg<ffi::AnyBuffer>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_allreduce, AllreduceImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("op"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_reduce, ReduceImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("op")
+                                  .Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_scan, ScanImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("op"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_send, SendImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("dest")
+                                  .Attr<int32_t>("tag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_recv, RecvImpl,
+                              T4J_BUF.Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("source")
+                                  .Attr<int32_t>("tag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_sendrecv, SendrecvImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("source")
+                                  .Attr<int32_t>("dest")
+                                  .Attr<int32_t>("sendtag")
+                                  .Attr<int32_t>("recvtag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_barrier, BarrierImpl,
+                              T4J_BUF.Ret<ffi::AnyBuffer>().Attr<int32_t>(
+                                  "comm"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_bcast, BcastImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_allgather, AllgatherImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_gather, GatherImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_scatter, ScatterImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_alltoall, AlltoallImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm"));
+
+// ---- plain-C control API (ctypes) --------------------------------------
+
+extern "C" {
+
+int t4j_init() { return t4j::init_from_env(); }
+void t4j_finalize() { t4j::finalize(); }
+int t4j_initialized() { return t4j::initialized() ? 1 : 0; }
+int t4j_world_rank() { return t4j::world_rank(); }
+int t4j_world_size() { return t4j::world_size(); }
+void t4j_set_logging(int enabled) { t4j::set_logging(enabled != 0); }
+int t4j_comm_create(const int32_t* ranks, int32_t n, int32_t ctx) {
+  return t4j::comm_create(reinterpret_cast<const int*>(ranks),
+                          static_cast<int>(n), static_cast<int>(ctx));
+}
+int t4j_comm_rank(int32_t comm) { return t4j::comm_rank(comm); }
+int t4j_comm_size(int32_t comm) { return t4j::comm_size(comm); }
+void t4j_abort(int32_t code) { t4j::abort_job(code, "user abort"); }
+
+}  // extern "C"

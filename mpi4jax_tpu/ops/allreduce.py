@@ -68,6 +68,10 @@ def _allreduce_impl(x, stamp, *, op, comm, transpose):
         y = reductions.mesh_allreduce(x, op, comm.axes)
         tok, (y,) = fence_out(tok, y)
         return y, tok.stamp
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        return _proc.proc_allreduce(x, stamp, op, comm)
     raise NotImplementedError(
         f"allreduce not implemented for backend {comm.backend!r}"
     )

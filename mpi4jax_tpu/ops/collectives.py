@@ -73,6 +73,11 @@ def allgather(x, *, comm=None, token=None):
         y = lax.all_gather(x, comm.axes, axis=0, tiled=False)
         token, (y,) = fence_out(token, y)
         return y, token
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        y, stamp = _proc.proc_allgather(x, token.stamp, comm)
+        return y, token.with_stamp(stamp)
     raise _unsupported("allgather", comm)
 
 
@@ -98,6 +103,11 @@ def alltoall(x, *, comm=None, token=None):
         y = lax.all_to_all(x, comm.axes, split_axis=0, concat_axis=0, tiled=True)
         token, (y,) = fence_out(token, y)
         return y, token
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        y, stamp = _proc.proc_alltoall(x, token.stamp, comm)
+        return y, token.with_stamp(stamp)
     raise _unsupported("alltoall", comm)
 
 
@@ -119,6 +129,11 @@ def barrier(*, comm=None, token=None):
         s = lax.psum(z, comm.axes)
         token, _ = fence_out(token, s)
         return token
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        stamp = _proc.proc_barrier(token.stamp, comm)
+        return token.with_stamp(stamp)
     raise _unsupported("barrier", comm)
 
 
@@ -146,6 +161,11 @@ def bcast(x, root, *, comm=None, token=None):
             y = y.astype(jnp.bool_)
         token, (y,) = fence_out(token, y)
         return y, token
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        y, stamp = _proc.proc_bcast(x, token.stamp, comm, root)
+        return y, token.with_stamp(stamp)
     raise _unsupported("bcast", comm)
 
 
@@ -156,7 +176,18 @@ def gather(x, root, *, comm=None, token=None):
     Mesh backend: output is ``(comm.size, *x.shape)`` on every rank (SPMD
     uniform-shape note in the module docstring).
     """
-    root = check_root(root, check_comm(comm))
+    comm_r = check_comm(comm)
+    root = check_root(root, comm_r)
+    if comm_r.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        x, comm_r, token = _prologue(x, comm_r, token)
+        y, stamp = _proc.proc_gather(x, token.stamp, comm_r, root)
+        token = token.with_stamp(stamp)
+        if comm_r.rank() != root:
+            # MPMD rank-dependent shape: unmodified input off-root
+            return x, token
+        return y, token
     del root  # value identical on every member under SPMD
     return allgather(x, comm=comm, token=token)
 
@@ -168,7 +199,14 @@ def reduce(x, op, root, *, comm=None, token=None):
     Mesh backend: result is delivered on every rank (≡ allreduce).
     """
     op = check_op(op)
-    root = check_root(root, check_comm(comm))
+    comm_r = check_comm(comm)
+    root = check_root(root, comm_r)
+    if comm_r.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        x, comm_r, token = _prologue(x, comm_r, token)
+        y, stamp = _proc.proc_reduce(x, token.stamp, op, comm_r, root)
+        return y, token.with_stamp(stamp)
     del root
     return allreduce(x, op, comm=comm, token=token)
 
@@ -204,6 +242,11 @@ def scan(x, op, *, comm=None, token=None):
             acc = acc.astype(jnp.bool_)
         token, (acc,) = fence_out(token, acc)
         return acc, token
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        y, stamp = _proc.proc_scan(x, token.stamp, op, comm)
+        return y, token.with_stamp(stamp)
     raise _unsupported("scan", comm)
 
 
@@ -218,6 +261,16 @@ def scatter(x, root, *, comm=None, token=None):
     """
     x, comm, token = _prologue(x, comm, token)
     root = check_root(root, comm)
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        if comm.rank() == root and (x.ndim == 0 or x.shape[0] != comm.size):
+            raise ValueError(
+                f"scatter input on root must have shape (comm.size, ...) "
+                f"= ({comm.size}, ...), got {x.shape}"
+            )
+        y, stamp = _proc.proc_scatter(x, token.stamp, comm, root)
+        return y, token.with_stamp(stamp)
     if x.ndim == 0 or x.shape[0] != comm.size:
         raise ValueError(
             f"scatter input must have leading dimension comm.size="

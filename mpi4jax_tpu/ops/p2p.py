@@ -156,6 +156,17 @@ def send(x, dest, tag=0, *, comm=None, token=None):
     token = as_token(token)
     tag = check_static_int(tag, "tag")
     x = jnp.asarray(x)
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        dest = check_static_int(dest, "dest")
+        if not 0 <= dest < comm.size:
+            raise ValueError(
+                f"dest={dest} out of range for communicator of size "
+                f"{comm.size}"
+            )
+        stamp = _proc.proc_send(x, token.stamp, comm, dest, tag)
+        return token.with_stamp(stamp)
     pairs = _resolve_pairs(dest, comm.size, "dest")
     _validate_perm(pairs, comm.size, "send dest")
     meta = PendingSendMeta(
@@ -181,6 +192,20 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
     token = as_token(token)
     tag = check_static_int(tag, "tag")
     x = jnp.asarray(x)
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        source = check_static_int(source, "source")
+        if source != ANY_SOURCE and not 0 <= source < comm.size:
+            raise ValueError(
+                f"source={source} out of range for communicator of size "
+                f"{comm.size}"
+            )
+        y, stamp, st = _proc.proc_recv(x, token.stamp, comm, source, tag)
+        if status is not None:
+            status.source = st[0]
+            status.tag = st[1]
+        return y, token.with_stamp(stamp)
     want_pairs = None
     source_is_any = (
         isinstance(source, (int, np.integer)) and int(source) == ANY_SOURCE
@@ -261,6 +286,25 @@ def sendrecv(
     check_static_int(recvtag, "recvtag")
     sendbuf = jnp.asarray(sendbuf)
     recvbuf = jnp.asarray(recvbuf)
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        source = check_static_int(source, "source")
+        dest = check_static_int(dest, "dest")
+        for name, r in (("source", source), ("dest", dest)):
+            if not 0 <= r < comm.size:
+                raise ValueError(
+                    f"{name}={r} out of range for communicator of size "
+                    f"{comm.size}"
+                )
+        y, stamp, st = _proc.proc_sendrecv(
+            sendbuf, recvbuf, token.stamp, comm, source, dest, sendtag,
+            recvtag,
+        )
+        if status is not None:
+            status.source = st[0]
+            status.tag = st[1]
+        return y, token.with_stamp(stamp)
     if comm.backend == "self":
         token, (y,) = fence_out(token, sendbuf)
         if status is not None:

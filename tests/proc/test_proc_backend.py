@@ -1,0 +1,294 @@
+"""Multi-process (MPMD) backend tests.
+
+The reference's pattern: run the same ops under ``mpirun -np N``
+(SURVEY §4.1) and use a subprocess harness for death tests
+(tests/collective_ops/test_common.py:13-57).  Here the launcher is
+``python -m mpi4jax_tpu.launch`` over the native DCN bridge; each test
+writes a worker script, runs it across N processes, and asserts on the
+job's combined output / exit code.
+"""
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def run_workers(body, nprocs=2, env=None, timeout=150, expect_fail=False):
+    """Launch ``body`` (worker script source) across ``nprocs`` ranks."""
+    import os
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".py", delete=False
+    ) as f:
+        f.write(textwrap.dedent(body))
+        path = f.name
+    full_env = dict(os.environ)
+    full_env["PYTHONPATH"] = str(REPO) + os.pathsep + full_env.get(
+        "PYTHONPATH", ""
+    )
+    full_env.pop("XLA_FLAGS", None)  # children need no virtual devices
+    if env:
+        full_env.update(env)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-np", str(nprocs), path],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=full_env,
+        cwd=str(REPO),
+    )
+    if expect_fail:
+        assert proc.returncode != 0, (proc.stdout, proc.stderr)
+    else:
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    return proc
+
+
+PREAMBLE = """
+import numpy as np
+import jax, jax.numpy as jnp
+import mpi4jax_tpu as m
+
+comm = m.get_default_comm()
+assert comm.backend == "proc"
+rank, size = comm.rank(), comm.size
+"""
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_collectives_battery(nprocs):
+    proc = run_workers(
+        PREAMBLE
+        + """
+x = jnp.full((4,), float(rank + 1))
+res, tok = m.allreduce(x, m.SUM, comm=comm)
+assert np.allclose(np.asarray(res), sum(range(1, size + 1)))
+res2, _ = jax.jit(lambda v: m.allreduce(v, m.SUM, comm=comm))(x)
+assert np.allclose(np.asarray(res2), sum(range(1, size + 1)))
+mx, tok = m.allreduce(x, m.MAX, comm=comm, token=tok)
+assert np.allclose(np.asarray(mx), float(size))
+b, tok = m.bcast(x * 10 if rank == 1 else jnp.zeros(4), 1, comm=comm, token=tok)
+assert np.allclose(np.asarray(b), 20.0)
+g, tok = m.allgather(jnp.array([float(rank)]), comm=comm, token=tok)
+assert np.allclose(np.asarray(g).ravel(), np.arange(size))
+s, tok = m.scan(jnp.array([1.0]), m.SUM, comm=comm, token=tok)
+assert np.allclose(np.asarray(s), rank + 1)
+a2, tok = m.alltoall(jnp.arange(float(size)) + 100 * rank, comm=comm, token=tok)
+assert np.allclose(np.asarray(a2), 100 * np.arange(size) + rank)
+r, tok = m.reduce(x, m.SUM, 0, comm=comm, token=tok)
+if rank == 0:
+    assert np.allclose(np.asarray(r), sum(range(1, size + 1)))
+else:
+    assert np.allclose(np.asarray(r), x)  # unmodified input off-root
+tok = m.barrier(comm=comm, token=tok)
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=nprocs,
+    )
+    for r in range(nprocs):
+        assert f"WORKER_OK {r}" in proc.stdout
+
+
+def test_rank_dependent_shapes_gather_scatter():
+    run_workers(
+        PREAMBLE
+        + """
+# gather: (nproc, *shape) on root, unmodified input elsewhere
+# (reference gather.py:74-87)
+x = jnp.full((3,), float(rank))
+g, tok = m.gather(x, 0, comm=comm)
+if rank == 0:
+    assert g.shape == (size, 3), g.shape
+    assert np.allclose(np.asarray(g)[:, 0], np.arange(size))
+else:
+    assert g.shape == (3,)
+    assert np.allclose(np.asarray(g), x)
+
+# scatter: root passes (nproc, rest), others a (rest) template
+# (reference scatter.py:52-58)
+if rank == 0:
+    payload = jnp.arange(float(size * 2)).reshape(size, 2)
+else:
+    payload = jnp.zeros((2,))
+sc, tok = m.scatter(payload, 0, comm=comm, token=tok)
+assert sc.shape == (2,)
+assert np.allclose(np.asarray(sc), [2 * rank, 2 * rank + 1])
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=3,
+    )
+
+
+def test_p2p_and_status():
+    run_workers(
+        PREAMBLE
+        + """
+x = jnp.full((4,), float(rank + 1))
+tok = m.create_token()
+tok = m.send(x, (rank + 1) % size, tag=5, comm=comm, token=tok)
+st = m.Status()
+y, tok = m.recv(x, (rank - 1) % size, tag=5, comm=comm, token=tok, status=st)
+assert np.allclose(np.asarray(y), float((rank - 1) % size + 1))
+assert int(np.asarray(st.source)) == (rank - 1) % size
+assert int(np.asarray(st.tag)) == 5
+
+# ANY_SOURCE / ANY_TAG
+tok = m.send(x * 2, (rank + 1) % size, tag=9, comm=comm, token=tok)
+y2, tok = m.recv(x, m.ANY_SOURCE, m.ANY_TAG, comm=comm, token=tok)
+assert np.allclose(np.asarray(y2), 2.0 * ((rank - 1) % size + 1))
+
+# jit'd send-then-recv vs recv-then-send pairing (the reference
+# deadlock regression, test_send_and_recv.py:104-117)
+def pair(v):
+    tok = m.create_token()
+    if rank == 0:
+        tok = m.send(v, 1, comm=comm, token=tok)
+        out, tok = m.recv(v, 1, comm=comm, token=tok)
+    else:
+        out, tok = m.recv(v, (rank - 1) % size, comm=comm, token=tok)
+        tok = m.send(v, (rank + 1) % size, comm=comm, token=tok)
+    return out
+if size == 2:
+    out = jax.jit(pair)(x)
+    assert np.allclose(np.asarray(out), float((1 - rank) + 1))
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=2,
+    )
+
+
+def test_grad_through_allreduce_mpmd():
+    run_workers(
+        PREAMBLE
+        + """
+# the README data-parallel pattern (README.rst:61-80): grad of a
+# replicated loss through allreduce is the local gradient (identity
+# transpose convention)
+x = jnp.ones((3, 2)) * (rank + 1)
+
+def loss(v):
+    summed, _ = m.allreduce(v, m.SUM, comm=comm)
+    return summed.sum()
+
+val, grad = jax.value_and_grad(loss)(x)
+total = sum(range(1, size + 1)) * 6.0
+assert np.allclose(float(val), total)
+assert np.allclose(np.asarray(grad), np.ones((3, 2)))
+
+# sendrecv transpose: cotangent travels the reverse ring direction
+f = jax.jit(lambda v: m.sendrecv(
+    v, v, (rank - 1) % size, (rank + 1) % size, comm=comm)[0])
+(ct,) = jax.linear_transpose(f, x)(x)
+# forward shifts +1; transpose shifts -1: we get rank+1's x
+assert np.allclose(np.asarray(ct), np.ones((3, 2)) * ((rank + 1) % size + 1))
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=2,
+    )
+
+
+def test_fail_fast_abort():
+    # one rank aborts (exit 13); the launcher must fail the whole job
+    # (reference: MPI_Abort semantics, mpi_xla_bridge.pyx:67-91 and the
+    # abort-on-error death test, test_common.py:60-88)
+    proc = run_workers(
+        PREAMBLE
+        + """
+import time
+if rank == 1:
+    from mpi4jax_tpu.native import runtime
+    runtime._state["lib"].t4j_abort(13)
+time.sleep(30)  # rank 0 would hang; the launcher must kill it
+""",
+        nprocs=2,
+        expect_fail=True,
+        timeout=60,
+    )
+    assert proc.returncode != 0
+
+
+def test_debug_log_wire_format():
+    # r{rank} | {8-char id} | {Op} ... / done with code 0 (…s)
+    # (reference wire format, mpi_xla_bridge.pyx:35-60; SURVEY §5.1)
+    import re
+
+    proc = run_workers(
+        PREAMBLE
+        + """
+x = jnp.ones((2,))
+res, tok = m.allreduce(x, m.SUM, comm=comm)
+np.asarray(res)
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=2,
+        env={"MPI4JAX_TPU_DEBUG": "1"},
+    )
+    assert re.search(r"r\d+ \| \w{8} \| Allreduce 2 items", proc.stderr)
+    assert re.search(r"r\d+ \| \w{8} \| done with code 0 \(\d", proc.stderr)
+
+
+def test_invalid_rank_raises_eagerly():
+    run_workers(
+        PREAMBLE
+        + """
+try:
+    m.send(jnp.ones(2), dest=100, comm=comm)
+except ValueError as e:
+    assert "out of range" in str(e)
+else:
+    raise AssertionError("expected ValueError for dest=100")
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=2,
+    )
+
+
+def test_any_source_never_matches_collective_frames():
+    # regression: a wildcard recv must not capture internal collective
+    # traffic (dissemination-barrier frames share the communicator)
+    run_workers(
+        PREAMBLE
+        + """
+tok = m.create_token()
+if rank == 1:
+    tok = m.send(jnp.ones(2) * 7, 0, tag=3, comm=comm, token=tok)
+if rank == 0:
+    import time
+    time.sleep(0.3)  # let rank 2's barrier frame arrive first
+y, tok = (m.recv(jnp.zeros(2), m.ANY_SOURCE, m.ANY_TAG, comm=comm, token=tok)
+          if rank == 0 else (None, tok))
+tok = m.barrier(comm=comm, token=tok)
+if rank == 0:
+    assert np.allclose(np.asarray(y), 7.0)
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=3,
+    )
+
+
+def test_divergent_comm_creation_order():
+    # regression: ranks creating communicators in different local orders
+    # must still agree on each communicator's wire channel
+    run_workers(
+        PREAMBLE
+        + """
+from mpi4jax_tpu import ProcComm
+if rank == 0:
+    # rank 0 creates a private self-comm first (skews any per-process
+    # channel counter)
+    solo = ProcComm(ranks=(0,), context=42)
+    r, _ = m.allreduce(jnp.ones(1), m.SUM, comm=solo)
+    assert np.allclose(np.asarray(r), 1.0)
+shared = ProcComm(ranks=tuple(range(size)), context=7)
+res, _ = m.allreduce(jnp.ones(2), m.SUM, comm=shared)
+assert np.allclose(np.asarray(res), float(size))
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=2,
+    )
