@@ -1,0 +1,6 @@
+"""Experimental transforms (reference: mpi4jax/experimental/__init__.py:1-5
+exports auto_tokenize only)."""
+
+from mpi4jax_tpu.experimental.tokenizer import ambient_token, auto_tokenize
+
+__all__ = ["auto_tokenize", "ambient_token"]

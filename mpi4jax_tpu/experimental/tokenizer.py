@@ -1,0 +1,93 @@
+"""auto_tokenize — automatic token threading for communication ops.
+
+Reference counterpart: ``mpi4jax.experimental.auto_tokenize``
+(mpi4jax/experimental/tokenizer.py:167-204), which re-interprets a traced
+jaxpr and re-binds every mpi primitive with one threaded token
+(tokenizer.py:108-156, register_overrides.py:18-125), recursing into
+``scan`` / ``while`` / ``cond`` / nested ``jit`` sub-jaxprs.
+
+TPU-native redesign — an *ambient token context* instead of a jaxpr
+interpreter: inside ``auto_tokenize(f)``, every communication op called
+with ``token=None`` resolves the current ambient token and commits its
+output token back (see ``as_token`` / ``commit_token`` in
+:mod:`mpi4jax_tpu.ops._core`).  Consecutive ops therefore chain on one
+token exactly as if the user had threaded it by hand, which
+
+* orders collectives on the mesh backend through data dependence, and
+* lets bare ``send``/``recv`` pairs match through the shared token's
+  pending-send queue (the property the reference's "hot potato" test
+  guards, tests/experimental/test_auto_tokenize.py:76-127).
+
+Control flow needs no special-casing: ops inside a ``lax.scan`` /
+``while_loop`` / ``cond`` body chain with each other within the body
+trace, and the chain restarts at the trace boundary (detected via a
+tracer-liveness probe) — cross-boundary ordering is already guaranteed
+by XLA's deterministic SPMD schedule and, on the multi-process backend,
+by effectful-custom-call program order.  The reference instead had to
+rewrite sub-jaxprs to carry the token (tokenizer.py:19-105); here the
+same guarantee falls out of the backends' ordering models.
+"""
+
+import functools
+
+from mpi4jax_tpu.ops._core import AmbientChain, _ambient_stack
+
+__all__ = ["auto_tokenize", "ambient_token"]
+
+# Interaction with the jit cache: ambient chaining happens at Python
+# trace time, which jax.jit's cache key cannot observe, so a jitted
+# function may be traced under one scope state and its cached executable
+# reused under another.  Both directions are benign:
+#
+# * traced in-scope, called out-of-scope — the chained program is baked
+#   into the executable and simply runs (the reference behaves the same:
+#   its runtime ordering comes from the effect system whether or not
+#   auto_tokenize re-threaded the tokens);
+# * traced out-of-scope, called in-scope — only token=None *collectives*
+#   can trace that way (a bare send/recv pair fails loudly at trace time
+#   with "no matching in-trace send"), and their cross-device ordering
+#   is still guaranteed without the chain: mesh-backend programs are
+#   SPMD (every device compiles the identical module, so XLA's schedule
+#   is consistent), and proc-backend ops are effectful FFI calls that
+#   execute in program order.
+#
+# What is NOT preserved across a jit cache hit is the link between the
+# inner ops and the *outer* ambient chain — the same trace-boundary
+# reset that applies to scan/while/cond bodies (see AmbientChain).
+
+
+def auto_tokenize(fn=None):
+    """Wrap ``fn`` so communication ops inside it auto-thread one token.
+
+    Usable as ``auto_tokenize(f)`` or ``@auto_tokenize``; the wrapped
+    function can run eagerly or under ``jax.jit`` (the reference requires
+    the decorator *outside* jit; here both orders work, since the ambient
+    context is consulted at trace time either way).
+    """
+    if fn is None:
+        return auto_tokenize
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        stack = _ambient_stack()
+        stack.append(AmbientChain())
+        try:
+            out = fn(*args, **kwargs)
+            # surface unmatched sends staged at any still-live level
+            stack[-1].resolve().assert_drained()
+        finally:
+            stack.pop()
+        return out
+
+    return wrapper
+
+
+def ambient_token():
+    """The current ambient token, or None outside auto_tokenize scopes.
+
+    Escape hatch for mixing explicit- and auto-token code: ops that need
+    the chain explicitly (e.g. to pass into a scan carry) can read it
+    here; ops called with ``token=None`` keep chaining automatically.
+    """
+    stack = _ambient_stack()
+    return stack[-1].resolve() if stack else None

@@ -19,6 +19,7 @@ trace time and the matching ``recv`` consumes it, emitting a single fused
 eager-send/matching-recv semantics at trace time instead of at runtime.
 """
 
+import threading
 from dataclasses import dataclass
 
 import jax
@@ -125,14 +126,176 @@ def create_token(arg=None):
 
 
 def as_token(token):
-    """Coerce user-supplied token values (None / array / Token) to a Token."""
+    """Coerce user-supplied token values (None / array / Token) to a Token.
+
+    Under :func:`mpi4jax_tpu.experimental.auto_tokenize`, ``token=None``
+    resolves to the ambient token instead of a fresh one, so consecutive
+    ops chain automatically (the reference's auto-token-threading
+    transform, mpi4jax/experimental/tokenizer.py:108-164, reimagined as
+    an ambient context rather than a jaxpr interpreter).
+    """
     if token is None:
+        stack = _ambient_stack()
+        if stack:
+            return stack[-1].resolve()
         return Token()
     if isinstance(token, Token):
         return token
     if isinstance(token, jax.Array) or hasattr(token, "dtype"):
         return Token(jnp.asarray(token, jnp.float32).reshape(()) * 0)
     raise TypeError(f"cannot interpret {type(token)} as a communication token")
+
+
+# -- ambient-token context (backing store for experimental.auto_tokenize) --
+
+_ambient = threading.local()
+
+
+def _ambient_stack():
+    stack = getattr(_ambient, "stack", None)
+    if stack is None:
+        stack = _ambient.stack = []
+    return stack
+
+
+def _current_trace():
+    from jax._src import core as _jcore
+
+    return _jcore.trace_ctx.trace
+
+
+def _is_ancestor(trace, current):
+    """True iff ``trace`` is ``current`` or on its parent chain."""
+    t = current
+    while t is not None:
+        if t is trace:
+            return True
+        t = getattr(t, "parent_trace", None)
+    return False
+
+
+def _pending_multiset(tok):
+    """Multiset {(payload identity, meta): count} of a token's pendings."""
+    counts = {}
+    for p, meta in zip(tok.pending, tok.pending_meta):
+        key = (id(p), meta)
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+class AmbientChain:
+    """Per-auto_tokenize-scope token chain, stratified by JAX trace.
+
+    Tokens committed inside an inner trace (a scan/while body, a cond
+    branch, a nested jit) are only valid while that trace is live; using
+    them afterwards leaks a tracer.  Each committed token is therefore
+    recorded with the trace it was created under, and lookups discard
+    levels whose trace is not an ancestor of the current one — exiting a
+    control-flow body transparently resumes the chain from the enclosing
+    trace's token.  (The reference instead rewrites control-flow
+    sub-jaxprs to carry the token through — tokenizer.py:19-105; the
+    stratification here gives the same user-visible chaining without a
+    jaxpr interpreter.)
+    """
+
+    def __init__(self):
+        self.levels = []  # [(trace, token)], outermost first
+
+    def _prune(self):
+        """Drop levels whose trace has exited, auditing their pending
+        sends: entries also tracked at the surviving outer level are fine
+        (consumption is propagated by ``commit``), live payloads staged
+        in the dead trace are hoisted out, and dead-trace payloads that
+        were never matched raise — they can never be delivered."""
+        cur = _current_trace()
+        while self.levels and not _is_ancestor(self.levels[-1][0], cur):
+            tr, tok = self.levels.pop()
+            if not tok.pending:
+                continue
+            parent_tok = self.levels[-1][1] if self.levels else Token()
+            parent_keys = _pending_multiset(parent_tok)
+            for p, meta in zip(tok.pending, tok.pending_meta):
+                key = (id(p), meta)
+                if parent_keys.get(key, 0) > 0:
+                    parent_keys[key] -= 1
+                    continue  # outer level still tracks this send
+                if isinstance(p, jax.core.Tracer) and _is_ancestor(tr, p._trace):
+                    raise RuntimeError(
+                        "a send staged inside a control-flow body / nested "
+                        f"jit (tag={meta.tag}, perm={meta.perm}) was never "
+                        "matched by a recv before its trace exited; it can "
+                        "no longer be delivered. Pair every send with a "
+                        "recv inside the same control-flow scope."
+                    )
+                # payload from an enclosing trace, staged while tracing
+                # the inner scope: still deliverable — hoist it out
+                parent_tok = parent_tok.push_send(p, meta)
+            if self.levels:
+                self.levels[-1] = (self.levels[-1][0], parent_tok)
+            elif parent_tok.pending:
+                self.levels.append((cur, parent_tok))
+        return cur
+
+    def resolve(self):
+        cur = self._prune()
+        if not self.levels:
+            self.levels.append((cur, Token()))
+        return self.levels[-1][1]
+
+    def commit(self, token):
+        cur = self._prune()
+        if self.levels and self.levels[-1][0] is cur:
+            self.levels[-1] = (cur, token)
+        else:
+            self.levels.append((cur, token))
+        # Propagate consumption: a pending entry an ancestor level tracks
+        # that is gone from the committed token was matched by a recv in
+        # this (deeper) trace — drop it from the ancestor too, or it would
+        # be delivered twice when the inner trace exits.
+        kept = _pending_multiset(token)
+        for i in range(len(self.levels) - 1):
+            tr, tok = self.levels[i]
+            if not tok.pending:
+                continue
+            avail = dict(kept)
+            new_p, new_m = [], []
+            for p, meta in zip(tok.pending, tok.pending_meta):
+                key = (id(p), meta)
+                if avail.get(key, 0) > 0:
+                    avail[key] -= 1
+                    new_p.append(p)
+                    new_m.append(meta)
+            if len(new_p) != len(tok.pending):
+                self.levels[i] = (tr, Token(tok.stamp, new_p, new_m))
+
+
+def commit_token(token):
+    """Publish an op's output token to the ambient chain (no-op when no
+    auto_tokenize scope is active)."""
+    stack = _ambient_stack()
+    if stack:
+        stack[-1].commit(token)
+    return token
+
+
+def publishes_token(fn):
+    """Decorator for public ops: commit the returned Token (if any) to the
+    ambient auto_tokenize chain."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        if isinstance(out, Token):
+            commit_token(out)
+        elif isinstance(out, tuple):
+            for item in out:
+                if isinstance(item, Token):
+                    commit_token(item)
+                    break
+        return out
+
+    return wrapper
 
 
 def token_array(token):

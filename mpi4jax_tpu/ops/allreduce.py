@@ -31,7 +31,13 @@ from jax.extend.core import Primitive
 from jax.interpreters import ad, batching, mlir
 
 from mpi4jax_tpu.ops import reductions
-from mpi4jax_tpu.ops._core import Token, as_token, fence_in, fence_out
+from mpi4jax_tpu.ops._core import (
+    Token,
+    as_token,
+    fence_in,
+    fence_out,
+    publishes_token,
+)
 from mpi4jax_tpu.utils.validation import check_comm, check_op
 
 __all__ = ["allreduce"]
@@ -40,6 +46,7 @@ allreduce_p = Primitive("mpi4jax_tpu_allreduce")
 allreduce_p.multiple_results = True
 
 
+@publishes_token
 def allreduce(x, op=reductions.SUM, *, comm=None, token=None):
     """All-reduce ``x`` with ``op`` across ``comm``.
 

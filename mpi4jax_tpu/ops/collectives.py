@@ -26,7 +26,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi4jax_tpu.ops import reductions
-from mpi4jax_tpu.ops._core import as_token, fence_in, fence_out, promote_vma
+from mpi4jax_tpu.ops._core import (
+    as_token,
+    fence_in,
+    fence_out,
+    promote_vma,
+    publishes_token,
+)
 from mpi4jax_tpu.ops.allreduce import allreduce
 from mpi4jax_tpu.utils.validation import check_comm, check_op, check_root
 
@@ -55,6 +61,7 @@ def _unsupported(name, comm):
     )
 
 
+@publishes_token
 def allgather(x, *, comm=None, token=None):
     """Gather ``x`` from every rank onto every rank.
 
@@ -81,6 +88,7 @@ def allgather(x, *, comm=None, token=None):
     raise _unsupported("allgather", comm)
 
 
+@publishes_token
 def alltoall(x, *, comm=None, token=None):
     """All-to-all block exchange.
 
@@ -111,6 +119,7 @@ def alltoall(x, *, comm=None, token=None):
     raise _unsupported("alltoall", comm)
 
 
+@publishes_token
 def barrier(*, comm=None, token=None):
     """Synchronisation barrier; returns only a token (reference:
     mpi4jax/_src/collective_ops/barrier.py:32-53).
@@ -137,6 +146,7 @@ def barrier(*, comm=None, token=None):
     raise _unsupported("barrier", comm)
 
 
+@publishes_token
 def bcast(x, root, *, comm=None, token=None):
     """Broadcast ``x`` from ``root`` to every rank (reference:
     mpi4jax/_src/collective_ops/bcast.py:36-72).
@@ -169,6 +179,7 @@ def bcast(x, root, *, comm=None, token=None):
     raise _unsupported("bcast", comm)
 
 
+@publishes_token
 def gather(x, root, *, comm=None, token=None):
     """Gather ``x`` from every rank to ``root`` (reference:
     mpi4jax/_src/collective_ops/gather.py:36-87).
@@ -192,6 +203,7 @@ def gather(x, root, *, comm=None, token=None):
     return allgather(x, comm=comm, token=token)
 
 
+@publishes_token
 def reduce(x, op, root, *, comm=None, token=None):
     """Reduce ``x`` with ``op`` to ``root`` (reference:
     mpi4jax/_src/collective_ops/reduce.py:37-71).
@@ -211,6 +223,7 @@ def reduce(x, op, root, *, comm=None, token=None):
     return allreduce(x, op, comm=comm, token=token)
 
 
+@publishes_token
 def scan(x, op, *, comm=None, token=None):
     """Inclusive prefix reduction over ranks (MPI_Scan; reference:
     mpi4jax/_src/collective_ops/scan.py:36-61).
@@ -250,6 +263,7 @@ def scan(x, op, *, comm=None, token=None):
     raise _unsupported("scan", comm)
 
 
+@publishes_token
 def scatter(x, root, *, comm=None, token=None):
     """Scatter rows of ``x`` from ``root`` (reference:
     mpi4jax/_src/collective_ops/scatter.py:36-92).

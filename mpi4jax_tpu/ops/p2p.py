@@ -44,6 +44,7 @@ from mpi4jax_tpu.ops._core import (
     comm_key,
     fence_in,
     fence_out,
+    publishes_token,
 )
 from mpi4jax_tpu.utils.validation import check_comm, check_static_int
 
@@ -144,6 +145,7 @@ def _static_source_of(pairs, size, axes):
     return jnp.asarray(src_of)[lax.axis_index(axes)]
 
 
+@publishes_token
 def send(x, dest, tag=0, *, comm=None, token=None):
     """Stage a send of ``x`` along the ``dest`` pattern; returns a token
     (reference: mpi4jax/_src/collective_ops/send.py:37-60 — returns token
@@ -179,6 +181,7 @@ def send(x, dest, tag=0, *, comm=None, token=None):
     return token.push_send(x, meta)
 
 
+@publishes_token
 def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=None):
     """Receive into the shape/dtype of template ``x`` (a template only —
     arrays are immutable; reference: mpi4jax/_src/collective_ops/
@@ -259,6 +262,7 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
     )
 
 
+@publishes_token
 def sendrecv(
     sendbuf,
     recvbuf,

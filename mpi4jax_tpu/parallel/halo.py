@@ -17,7 +17,7 @@ as the reference's clockwise ordering.
 
 import jax.numpy as jnp
 
-from mpi4jax_tpu.ops._core import as_token
+from mpi4jax_tpu.ops._core import as_token, publishes_token
 from mpi4jax_tpu.ops.p2p import sendrecv
 
 __all__ = ["halo_exchange_2d"]
@@ -39,6 +39,7 @@ def _axis_shift(arr_slice, template, comm, axis, disp, periodic, token):
     )
 
 
+@publishes_token
 def halo_exchange_2d(arr, comm, *, periodic=(False, True), token=None):
     """Exchange 1-cell halos of a local block over a ("y", "x") MeshComm.
 

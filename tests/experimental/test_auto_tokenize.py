@@ -1,0 +1,322 @@
+"""auto_tokenize coverage, mirroring the reference's
+tests/experimental/test_auto_tokenize.py (376 LoC): the "hot potato"
+message-order test that fails without tokenization (:76-127), control-flow
+coverage for fori/while/cond (:130-189), and nested jit (:301-376).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.experimental import ambient_token, auto_tokenize
+
+from tests.helpers import spmd_jit
+
+SIZE = 8
+
+
+def world_input():
+    return jnp.arange(float(SIZE))
+
+
+SHIFTED = np.roll(np.arange(8.0), 1)
+
+
+def test_send_recv_pair_without_tokens(comm1d):
+    """Bare send + recv must match through the ambient token."""
+
+    @auto_tokenize
+    def fn(x):
+        m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d)
+        y, _ = m.recv(x, lambda r: (r - 1) % SIZE, comm=comm1d)
+        return y
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), SHIFTED)
+
+
+def test_send_recv_fails_without_auto_tokenize(comm1d):
+    """Control experiment (the reference documents its hot-potato test
+    fails when tokenization is disabled): with fresh per-op tokens the
+    recv cannot see the staged send."""
+
+    def fn(x):
+        m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d)
+        y, _ = m.recv(x, lambda r: (r - 1) % SIZE, comm=comm1d)
+        return y
+
+    with pytest.raises(RuntimeError, match="no matching in-trace send"):
+        spmd_jit(comm1d, fn)(world_input())
+
+
+def test_hot_potato_fifo_order(comm1d):
+    """Two same-tag sends must be matched by recvs in FIFO order."""
+
+    @auto_tokenize
+    def fn(x):
+        m.send(x, lambda r: (r + 1) % SIZE, tag=0, comm=comm1d)
+        m.send(10 * x, lambda r: (r + 1) % SIZE, tag=0, comm=comm1d)
+        a, _ = m.recv(x, lambda r: (r - 1) % SIZE, tag=0, comm=comm1d)
+        b, _ = m.recv(x, lambda r: (r - 1) % SIZE, tag=0, comm=comm1d)
+        return 100 * a + b  # order-sensitive: a must be x, b must be 10x
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), 100 * SHIFTED + 10 * SHIFTED)
+
+
+def test_collective_chain_matches_manual_tokens(comm1d):
+    def auto(x):
+        y, _ = m.allreduce(x, m.SUM, comm=comm1d)
+        z, _ = m.allreduce(y * 2, m.MAX, comm=comm1d)
+        return z
+
+    def manual(x):
+        tok = m.create_token()
+        y, tok = m.allreduce(x, m.SUM, comm=comm1d, token=tok)
+        z, tok = m.allreduce(y * 2, m.MAX, comm=comm1d, token=tok)
+        return z
+
+    a = spmd_jit(comm1d, auto_tokenize(auto))(world_input())
+    b = spmd_jit(comm1d, manual)(world_input())
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_decorator_inside_jit(comm1d):
+    """auto_tokenize composes under jit in either nesting order (the
+    reference requires decorator-outside-jit; both work here)."""
+
+    @auto_tokenize
+    def fn(x):
+        m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d)
+        y, _ = m.recv(x, lambda r: (r - 1) % SIZE, comm=comm1d)
+        return y
+
+    out = jax.jit(spmd_jit(comm1d, fn))(world_input())
+    assert np.array_equal(np.asarray(out), SHIFTED)
+
+
+def test_fori_loop_body(comm1d):
+    """Ops inside a fori_loop body chain per iteration; the chain restarts
+    cleanly at the trace boundary afterwards."""
+
+    @auto_tokenize
+    def fn(x):
+        def body(_, s):
+            m.send(s, lambda r: (r + 1) % SIZE, comm=comm1d)
+            y, _ = m.recv(s, lambda r: (r - 1) % SIZE, comm=comm1d)
+            return y
+
+        y = jax.lax.fori_loop(0, 3, body, x)
+        # op after the loop must not pick up the dead body-trace token
+        z, _ = m.allreduce(y, m.SUM, comm=comm1d)
+        return z
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    expect = np.roll(np.arange(8.0), 3).sum() * np.ones(8)
+    assert np.allclose(np.asarray(out), expect)
+
+
+def test_while_loop_body(comm1d):
+    @auto_tokenize
+    def fn(x):
+        def cond(carry):
+            i, _ = carry
+            return i < 2
+
+        def body(carry):
+            i, s = carry
+            m.send(s, lambda r: (r + 1) % SIZE, comm=comm1d)
+            y, _ = m.recv(s, lambda r: (r - 1) % SIZE, comm=comm1d)
+            return i + 1, y
+
+        _, y = jax.lax.while_loop(cond, body, (0, x))
+        return y
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), np.roll(np.arange(8.0), 2))
+
+
+def test_cond_branches(comm1d):
+    @auto_tokenize
+    def fn(x, pred):
+        def true_branch(v):
+            y, _ = m.allreduce(v, m.SUM, comm=comm1d)
+            return y
+
+        def false_branch(v):
+            y, _ = m.allreduce(v, m.MAX, comm=comm1d)
+            return y
+
+        y = jax.lax.cond(pred, true_branch, false_branch, x)
+        # chain must survive both branch traces having committed tokens
+        z, _ = m.allreduce(y, m.SUM, comm=comm1d)
+        return z
+
+    f = spmd_jit(comm1d, lambda x: fn(x, True))
+    out = f(world_input())
+    assert np.allclose(np.asarray(out), 28.0 * 8)
+
+
+def test_nested_jit(comm1d):
+    @auto_tokenize
+    def fn(x):
+        @jax.jit
+        def inner(v):
+            m.send(v, lambda r: (r + 1) % SIZE, comm=comm1d)
+            y, _ = m.recv(v, lambda r: (r - 1) % SIZE, comm=comm1d)
+            return y
+
+        y = inner(x)
+        z, _ = m.allreduce(y, m.SUM, comm=comm1d)
+        return z
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.allclose(np.asarray(out), 28.0)
+
+
+def test_unmatched_send_raises(comm1d):
+    @auto_tokenize
+    def fn(x):
+        m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d)
+        return x
+
+    with pytest.raises(RuntimeError, match="unmatched send"):
+        spmd_jit(comm1d, fn)(world_input())
+
+
+def test_ambient_token_escape_hatch(comm1d):
+    """ambient_token() exposes the live chain for explicit threading."""
+
+    @auto_tokenize
+    def fn(x):
+        assert ambient_token() is not None
+        y, tok = m.allreduce(x, m.SUM, comm=comm1d)
+        assert ambient_token() is tok
+        return y
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.allclose(np.asarray(out), 28.0)
+
+
+def test_no_ambient_outside_scope():
+    assert ambient_token() is None
+
+
+def test_selfcomm_eager(selfcomm):
+    @auto_tokenize
+    def fn(x):
+        y, _ = m.allreduce(x, m.SUM, comm=selfcomm)
+        z, _ = m.bcast(y, 0, comm=selfcomm)
+        return z
+
+    out = fn(jnp.float32(3.0))
+    assert float(out) == 3.0
+
+
+# -- regression tests: pending sends across trace boundaries --------------
+
+
+def test_send_consumed_in_nested_jit_not_delivered_twice(comm1d):
+    """A send staged at the top level and matched inside a nested jit must
+    be consumed exactly once: the scope must close cleanly and a second
+    recv must fail loudly instead of re-delivering."""
+
+    @auto_tokenize
+    def fn(x):
+        m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d)
+
+        @jax.jit
+        def inner(v):
+            y, _ = m.recv(v, lambda r: (r - 1) % SIZE, comm=comm1d)
+            return y
+
+        return inner(x)
+
+    out = spmd_jit(comm1d, fn)(world_input())
+    assert np.array_equal(np.asarray(out), SHIFTED)
+
+    @auto_tokenize
+    def fn_double(x):
+        m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d)
+
+        @jax.jit
+        def inner(v):
+            y, _ = m.recv(v, lambda r: (r - 1) % SIZE, comm=comm1d)
+            return y
+
+        y = inner(x)
+        z, _ = m.recv(x, lambda r: (r - 1) % SIZE, comm=comm1d)
+        return y + z
+
+    with pytest.raises(RuntimeError, match="no matching in-trace send"):
+        spmd_jit(comm1d, fn_double)(world_input())
+
+
+def test_unmatched_send_in_loop_body_raises(comm1d):
+    """A send staged inside a control-flow body with no matching recv must
+    raise, not silently vanish when the body trace exits."""
+
+    @auto_tokenize
+    def fn(x):
+        def body(_, s):
+            m.send(s, lambda r: (r + 1) % SIZE, comm=comm1d)
+            return s + 1.0
+
+        y = jax.lax.fori_loop(0, 2, body, x)
+        z, _ = m.allreduce(y, m.SUM, comm=comm1d)
+        return z
+
+    with pytest.raises(RuntimeError, match="no longer be delivered"):
+        spmd_jit(comm1d, fn)(world_input())
+
+
+def test_jit_cache_reuse_across_scope_is_benign(comm1d):
+    """The jit cache key cannot see the ambient scope, so an executable
+    traced inside a scope is reused outside one.  That reuse must be
+    *benign*: the chained program is baked in and runs correctly (this
+    matches the reference, whose runtime ordering holds with or without
+    auto_tokenize re-threading the tokens)."""
+    from tests.helpers import spmd
+
+    def f(x):
+        m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d)
+        y, _ = m.recv(x, lambda r: (r - 1) % SIZE, comm=comm1d)
+        return y
+
+    jf = jax.jit(spmd(comm1d, f))
+
+    out = auto_tokenize(jf)(world_input())  # traced + cached in scope
+    assert np.array_equal(np.asarray(out), SHIFTED)
+
+    # cache hit outside any scope: runs the baked-in chained program
+    out2 = jf(world_input())
+    assert np.array_equal(np.asarray(out2), SHIFTED)
+
+    # a fresh trace outside any scope still fails loudly
+    jf2 = jax.jit(spmd(comm1d, lambda x: f(x * 1.0)))
+    with pytest.raises(RuntimeError, match="no matching in-trace send"):
+        jf2(world_input())
+
+
+def test_library_composites_join_chain(comm2d):
+    """halo_exchange_2d must commit its output token to the ambient chain
+    like every primitive op does."""
+    from mpi4jax_tpu.parallel.halo import halo_exchange_2d
+
+    observed = {}
+
+    @auto_tokenize
+    def fn(a):
+        before = ambient_token()
+        a, tok = halo_exchange_2d(a, comm2d)
+        observed["joined"] = ambient_token() is tok and tok is not before
+        return a
+
+    spec = jax.P(*comm2d.axes)
+    f = jax.jit(
+        jax.shard_map(fn, mesh=comm2d.mesh, in_specs=spec, out_specs=spec)
+    )
+    f(jnp.ones((8, 8)))
+    assert observed["joined"]
