@@ -278,21 +278,72 @@ def commit_token(token):
     return token
 
 
+_callid_counter = None  # lazy itertools.count
+
+
+def _next_callid():
+    global _callid_counter
+    if _callid_counter is None:
+        import itertools
+
+        _callid_counter = itertools.count()
+    return next(_callid_counter)
+
+
+def _debug_log(name, out, comm):
+    """Stage a per-call debug line into the computation.
+
+    Wire format follows the reference's bridge logging
+    (mpi_xla_bridge.pyx:35-60: ``r{rank} | {callid} | MPI_<Op> ...``),
+    with a sequential 8-digit call id instead of a random one (call sites
+    are compiled once; the id identifies the site, printed per execution
+    per device).  Toggled by MPI4JAX_TPU_DEBUG / utils.config.set_debug;
+    zero cost when disabled (nothing is staged at trace time).
+    """
+    import jax.debug
+
+    callid = _next_callid()
+    arrays = [o for o in jax.tree_util.tree_leaves(out) if hasattr(o, "size")]
+    nitems = int(arrays[0].size) if arrays else 0
+    try:
+        rank = comm.rank()
+    except Exception:
+        rank = -1
+    jax.debug.print(
+        "r{rank} | %08d | %s %d items" % (callid, name.capitalize(), nitems),
+        rank=rank,
+        ordered=False,
+    )
+
+
 def publishes_token(fn):
-    """Decorator for public ops: commit the returned Token (if any) to the
-    ambient auto_tokenize chain."""
+    """Instrumentation wrapper for every public op: profiler scope,
+    opt-in per-call debug logging, and publication of the returned Token
+    (if any) to the ambient auto_tokenize chain."""
     import functools
+
+    name = fn.__name__
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        out = fn(*args, **kwargs)
+        from mpi4jax_tpu.utils import config
+
+        with jax.named_scope(f"mpi4jax_tpu.{name}"):
+            out = fn(*args, **kwargs)
+        token = None
         if isinstance(out, Token):
-            commit_token(out)
+            token = out
         elif isinstance(out, tuple):
             for item in out:
                 if isinstance(item, Token):
-                    commit_token(item)
+                    token = item
                     break
+        if token is not None:
+            commit_token(token)
+        if config.debug_enabled():
+            from mpi4jax_tpu.utils.validation import check_comm
+
+            _debug_log(name, out, check_comm(kwargs.get("comm")))
         return out
 
     return wrapper

@@ -37,8 +37,20 @@ def debug_enabled():
 
 
 def set_debug(enabled):
-    """Runtime toggle (overrides the env var; None resets to env)."""
+    """Runtime toggle (overrides the env var; None resets to env).
+
+    Mirrors the reference's ``mpi_xla_bridge.set_logging``
+    (mpi_xla_bridge.pyx:38-40): also forwards to the native DCN bridge's
+    per-call logger when the multi-process backend is loaded.
+    """
     _state["debug"] = enabled
+    try:
+        from mpi4jax_tpu.native import runtime
+
+        if runtime._state["lib"] is not None:
+            runtime.set_logging(bool(enabled))
+    except Exception:
+        pass
 
 
 def fences_enabled():

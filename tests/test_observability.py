@@ -1,0 +1,97 @@
+"""Observability coverage (reference: §5.1 bridge logging, asserted with
+regexes against captured stdout in tests/collective_ops/test_common.py:
+118-165; env toggling of MPI4JAX_DEBUG).
+
+Two surfaces here: XLA-profiler name scopes baked into the lowered
+module (always on), and opt-in per-call debug lines in the reference's
+``r{rank} | {callid} | <Op> ...`` wire format.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.utils import config
+
+from tests.helpers import spmd
+
+SIZE = 8
+
+
+def test_named_scope_in_lowered_module(comm1d):
+    """Every op's profiler scope must appear in the lowered HLO, so XLA
+    profiles attribute collective time to the op that issued it."""
+
+    def fn(x):
+        tok = m.create_token()
+        y, tok = m.allreduce(x, m.SUM, comm=comm1d, token=tok)
+        y, tok = m.sendrecv(
+            y,
+            y,
+            source=lambda r: (r - 1) % SIZE,
+            dest=lambda r: (r + 1) % SIZE,
+            comm=comm1d,
+            token=tok,
+        )
+        return y
+
+    text = (
+        jax.jit(spmd(comm1d, fn))
+        .lower(jnp.arange(8.0))
+        .as_text(debug_info=True)
+    )
+    assert "mpi4jax_tpu.allreduce" in text
+    assert "mpi4jax_tpu.sendrecv" in text
+
+
+def test_debug_log_wire_format(comm1d, capfd):
+    """MPI4JAX_TPU_DEBUG output: one `r{rank} | {callid} | Op N items`
+    line per call per device."""
+    config.set_debug(True)
+    try:
+
+        def fn(x):
+            y, _ = m.allreduce(x, m.SUM, comm=comm1d)
+            return y
+
+        out = jax.jit(spmd(comm1d, fn))(jnp.arange(8.0))
+        jax.block_until_ready(out)
+        jax.effects_barrier()
+    finally:
+        config.set_debug(None)
+
+    captured = capfd.readouterr().out
+    lines = [l for l in captured.splitlines() if "Allreduce" in l]
+    assert len(lines) == SIZE, captured
+    pat = re.compile(r"^r\d+ \| \d{8} \| Allreduce 1 items$")
+    assert all(pat.match(l) for l in lines), lines
+    ranks = sorted(int(l[1 : l.index(" ")]) for l in lines)
+    assert ranks == list(range(SIZE))
+
+
+def test_debug_disabled_stages_nothing(comm1d):
+    """With debug off, no host callback may appear in the lowered IR."""
+    config.set_debug(False)
+    try:
+
+        def fn(x):
+            y, _ = m.allreduce(x, m.SUM, comm=comm1d)
+            return y
+
+        text = jax.jit(spmd(comm1d, fn)).lower(jnp.arange(8.0)).as_text()
+    finally:
+        config.set_debug(None)
+    assert "callback" not in text.lower()
+
+
+def test_env_var_toggle(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_DEBUG", "1")
+    assert config.debug_enabled()
+    monkeypatch.setenv("MPI4JAX_TPU_DEBUG", "0")
+    assert not config.debug_enabled()
+    monkeypatch.setenv("MPI4JAX_TPU_DEBUG", "junk")
+    with pytest.raises(ValueError):
+        config.debug_enabled()
