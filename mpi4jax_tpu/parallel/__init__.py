@@ -6,6 +6,12 @@ from mpi4jax_tpu.parallel.comm import (
     get_default_comm,
     set_default_comm,
 )
+from mpi4jax_tpu.parallel.halo import halo_exchange_2d
+from mpi4jax_tpu.parallel.longseq import (
+    local_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from mpi4jax_tpu.parallel.proc import ProcComm
 
 __all__ = [
@@ -13,6 +19,10 @@ __all__ = [
     "MeshComm",
     "SelfComm",
     "ProcComm",
+    "halo_exchange_2d",
+    "local_attention",
+    "ring_attention",
+    "ulysses_attention",
     "default_comm",
     "get_default_comm",
     "set_default_comm",

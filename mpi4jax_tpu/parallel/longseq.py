@@ -1,0 +1,206 @@
+"""Long-context sequence/context parallelism built on the comm primitives.
+
+The reference ships the *building blocks* for every named sequence-
+parallel scheme but no scheme itself (SURVEY §5.7): the ring step is
+``sendrecv`` to rank±1 (mpi4jax/_src/collective_ops/sendrecv.py:366-385,
+AD-reversible), and head↔sequence resharding is ``alltoall``
+(alltoall.py:35-74).  This module assembles both into first-class,
+differentiable context-parallel attention:
+
+* :func:`ring_attention` — blockwise attention with an online softmax;
+  KV blocks rotate around the communicator ring via :func:`sendrecv`,
+  one ICI nearest-neighbour ``ppermute`` per step (Liu et al. 2023,
+  "Ring Attention with Blockwise Transformers", arXiv:2310.01889 —
+  public algorithm, implemented here from the paper's math).  Memory per
+  device is O(T_local); the full sequence is never materialised.
+* :func:`ulysses_attention` — DeepSpeed-Ulysses-style resharding
+  (Jacobs et al. 2023, arXiv:2309.14509): all-to-all converts
+  sequence-sharding into head-sharding, each device runs dense attention
+  over the *full* sequence for its head subset, and a second all-to-all
+  restores sequence sharding.  One pair of ICI all-to-alls total; heads
+  must divide the ring size.
+
+Both run per-device inside ``shard_map``, are reverse-mode
+differentiable end to end (the ring's gradient traverses the ring in
+the reverse direction via the sendrecv/ppermute transpose), and thread
+the ordering token through every exchange.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4jax_tpu.ops._core import Token, as_token, publishes_token
+from mpi4jax_tpu.ops.collectives import alltoall
+from mpi4jax_tpu.ops.p2p import sendrecv
+
+__all__ = ["ring_attention", "ulysses_attention", "local_attention"]
+
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite mask value
+
+
+def local_attention(q, k, v, *, causal=False, scale=None, q_offset=0, k_offset=0):
+    """Dense single-device attention oracle: softmax(q k^T) v.
+
+    ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D].  ``*_offset`` are
+    the global positions of the first row/column (for causal masking of
+    sharded blocks).  Accumulates in float32.
+    """
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+@publishes_token
+def ring_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
+    """Context-parallel attention over a 1-D ring communicator.
+
+    Every device holds the local sequence block ``q``/``k``/``v`` of
+    shape [B, T_local, H, D] (global sequence = ring-rank-major
+    concatenation).  Returns ``(out, token)`` with ``out`` the local
+    block of softmax(QK^T)V over the *global* sequence.
+
+    Algorithm: ``comm.size`` steps of blockwise attention with running
+    (max, sum, accumulator) statistics; after each step the KV pair
+    moves to the next rank via :func:`sendrecv` (one ``ppermute``).
+    Reverse-mode AD reverses the permutation automatically — gradients
+    ride the ring the opposite way, the exact transpose contract of the
+    reference's sendrecv (sendrecv.py:366-385).
+    """
+    token = as_token(token)
+    p = comm.size
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+
+    if comm.backend == "self" or p == 1:
+        out = local_attention(q, k, v, causal=causal, scale=scale)
+        return out, token
+
+    if comm.backend != "mesh":
+        raise NotImplementedError(
+            f"ring_attention requires a mesh communicator, got "
+            f"{comm.backend!r}"
+        )
+    if len(comm.axes) != 1:
+        raise ValueError(
+            f"ring_attention needs a 1-D communicator (one mesh axis), "
+            f"got axes {comm.axes}; use comm.sub(axis)"
+        )
+
+    rank = comm.rank()
+    b, tq, h, _ = q.shape
+    tk = k.shape[1]
+    qpos = rank * tq + jnp.arange(tq)
+
+    # forward ring: the kv block moves to the next rank each step, so at
+    # step i this rank holds the block that originated at rank - i
+    perm = [(r, (r + 1) % p) for r in range(p)]
+
+    from mpi4jax_tpu.ops._core import promote_vma
+
+    # carries become device-varying after the first step; start them
+    # varying so the scan carry type is stable
+    acc0 = promote_vma(jnp.zeros((b, tq, h, d), jnp.float32), comm.axes)
+    m0 = promote_vma(jnp.full((b, h, tq), _NEG, jnp.float32), comm.axes)
+    l0 = promote_vma(jnp.zeros((b, h, tq), jnp.float32), comm.axes)
+    token = token.with_stamp(promote_vma(token.stamp, comm.axes))
+
+    def step(carry, i):
+        k_blk, v_blk, acc, m, l, stamp = carry
+        src = (rank - i) % p
+        kpos = src * tk + jnp.arange(tk)
+
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+        )
+        s = s * scale
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG)
+
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        w = jnp.exp(s - m_new[..., None])
+        l_new = l * corr + w.sum(axis=-1)
+        acc_new = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", w, v_blk.astype(jnp.float32)
+        )
+
+        tok = Token(stamp)
+        k_blk, tok = sendrecv(k_blk, k_blk, source=perm, dest=perm, comm=comm, token=tok)
+        v_blk, tok = sendrecv(v_blk, v_blk, source=perm, dest=perm, comm=comm, token=tok)
+        return (k_blk, v_blk, acc_new, m_new, l_new, tok.stamp), None
+
+    carry0 = (k, v, acc0, m0, l0, token.stamp)
+    (k_f, v_f, acc, m, l, stamp), _ = lax.scan(
+        step, carry0, jnp.arange(p), length=p
+    )
+    del k_f, v_f
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype), Token(stamp)
+
+
+@publishes_token
+def ulysses_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
+    """Ulysses-style context parallelism: all-to-all head↔sequence
+    reshard, dense local attention over the full sequence, reshard back.
+
+    ``q``/``k``/``v``: local [B, T_local, H, D] with ``H % comm.size ==
+    0``.  Cheaper than the ring when the full sequence fits in HBM for
+    ``H / p`` heads (2 collectives instead of ``p`` permutes); the ring
+    wins at extreme lengths.
+    """
+    token = as_token(token)
+    p = comm.size
+
+    if comm.backend == "self" or p == 1:
+        out = local_attention(q, k, v, causal=causal, scale=scale)
+        return out, token
+
+    if comm.backend != "mesh":
+        raise NotImplementedError(
+            f"ulysses_attention requires a mesh communicator, got "
+            f"{comm.backend!r}"
+        )
+
+    b, t, h, d = q.shape
+    if h % p:
+        raise ValueError(
+            f"ulysses_attention needs heads divisible by the ring size: "
+            f"H={h}, comm.size={p}"
+        )
+    hp = h // p
+
+    def to_heads(x, tok):
+        # [B, T, H, D] -> rows [p, T, B, hp, D] -> alltoall -> full seq
+        # for this rank's head subset [B, p*T, hp, D]
+        blocks = x.reshape(b, t, p, hp, d).transpose(2, 1, 0, 3, 4)
+        mixed, tok = alltoall(blocks, comm=comm, token=tok)
+        # row j now holds rank j's sequence block for our heads
+        return mixed.transpose(2, 0, 1, 3, 4).reshape(b, p * t, hp, d), tok
+
+    def to_seq(x, tok):
+        # inverse of to_heads
+        blocks = x.reshape(b, p, t, hp, d).transpose(1, 2, 0, 3, 4)
+        mixed, tok = alltoall(blocks, comm=comm, token=tok)
+        return mixed.transpose(2, 1, 0, 3, 4).reshape(b, t, p * hp, d), tok
+
+    qh, token = to_heads(q, token)
+    kh, token = to_heads(k, token)
+    vh, token = to_heads(v, token)
+
+    out = local_attention(qh, kh, vh, causal=causal, scale=scale)
+
+    out, token = to_seq(out, token)
+    return out, token
