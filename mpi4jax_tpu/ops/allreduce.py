@@ -72,7 +72,7 @@ def _allreduce_impl(x, stamp, *, op, comm, transpose):
         return x, tok.stamp
     if comm.backend == "mesh":
         tok, (x,) = fence_in(tok, x)
-        y = reductions.mesh_allreduce(x, op, comm.axes)
+        y = reductions.mesh_allreduce(x, op, comm.axes, comm.groups)
         tok, (y,) = fence_out(tok, y)
         return y, tok.stamp
     if comm.backend == "proc":

@@ -77,7 +77,9 @@ def allgather(x, *, comm=None, token=None):
     if comm.backend == "mesh":
         token, (x,) = fence_in(token, x)
         x = promote_vma(x, comm.axes)
-        y = lax.all_gather(x, comm.axes, axis=0, tiled=False)
+        y = lax.all_gather(
+            x, comm.axes, axis=0, tiled=False, axis_index_groups=comm.groups
+        )
         token, (y,) = fence_out(token, y)
         return y, token
     if comm.backend == "proc":
@@ -108,7 +110,10 @@ def alltoall(x, *, comm=None, token=None):
     if comm.backend == "mesh":
         token, (x,) = fence_in(token, x)
         x = promote_vma(x, comm.axes)
-        y = lax.all_to_all(x, comm.axes, split_axis=0, concat_axis=0, tiled=True)
+        y = lax.all_to_all(
+            x, comm.axes, split_axis=0, concat_axis=0, tiled=True,
+            axis_index_groups=comm.groups,
+        )
         token, (y,) = fence_out(token, y)
         return y, token
     if comm.backend == "proc":
@@ -135,7 +140,7 @@ def barrier(*, comm=None, token=None):
     if comm.backend == "mesh":
         z = jnp.zeros((), jnp.int32)
         token, (z,) = fence_in(token, z)
-        s = lax.psum(z, comm.axes)
+        s = reductions.group_psum(z, comm.axes, comm.groups)
         token, _ = fence_out(token, s)
         return token
     if comm.backend == "proc":
@@ -161,12 +166,12 @@ def bcast(x, root, *, comm=None, token=None):
         return x, token
     if comm.backend == "mesh":
         token, (x,) = fence_in(token, x)
-        rank = lax.axis_index(comm.axes)
+        rank = comm.rank()
         as_int = x.dtype == jnp.bool_
         xv = x.astype(jnp.int8) if as_int else x
         xv = promote_vma(xv, comm.axes)
         masked = jnp.where(rank == root, xv, jnp.zeros_like(xv))
-        y = lax.psum(masked, comm.axes)
+        y = reductions.group_psum(masked, comm.axes, comm.groups)
         if as_int:
             y = y.astype(jnp.bool_)
         token, (y,) = fence_out(token, y)
@@ -240,13 +245,17 @@ def scan(x, op, *, comm=None, token=None):
     if comm.backend == "mesh":
         size = comm.size
         token, (x,) = fence_in(token, x)
-        rank = lax.axis_index(comm.axes)
+        rank = comm.rank()
         as_int = x.dtype == jnp.bool_
         acc = x.astype(jnp.int8) if as_int else x
         acc = promote_vma(acc, comm.axes)
         dist = 1
         while dist < size:
-            perm = [(r, r + dist) for r in range(size - dist)]
+            perm = comm.expand_perm(
+                [(r, r + dist) for r in range(size - dist)]
+            ) if comm.groups is not None else [
+                (r, r + dist) for r in range(size - dist)
+            ]
             shifted = lax.ppermute(acc, comm.axes, perm)
             combined = op.combine(acc, shifted.astype(acc.dtype))
             acc = jnp.where(rank >= dist, combined.astype(acc.dtype), acc)
@@ -296,12 +305,12 @@ def scatter(x, root, *, comm=None, token=None):
         return y, token
     if comm.backend == "mesh":
         token, (x,) = fence_in(token, x)
-        rank = lax.axis_index(comm.axes)
+        rank = comm.rank()
         as_int = x.dtype == jnp.bool_
         xv = x.astype(jnp.int8) if as_int else x
         xv = promote_vma(xv, comm.axes)
         masked = jnp.where(rank == root, xv, jnp.zeros_like(xv))
-        from_root = lax.psum(masked, comm.axes)
+        from_root = reductions.group_psum(masked, comm.axes, comm.groups)
         y = lax.dynamic_index_in_dim(from_root, rank, axis=0, keepdims=False)
         if as_int:
             y = y.astype(jnp.bool_)

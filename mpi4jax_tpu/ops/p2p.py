@@ -125,24 +125,24 @@ def _ppermute(x, axes, pairs):
     return lax.ppermute(x, axes, pairs)
 
 
-def _recv_merge(permuted, template, pairs, size, axes):
+def _recv_merge(permuted, template, pairs, comm):
     """Ranks with no inbound message keep their recv buffer (MPI leaves
     recvbuf untouched for MPI_PROC_NULL partners)."""
+    size = comm.size
     if len(pairs) == size:
         return permuted
     has_msg = np.zeros(size, bool)
     for _, d in pairs:
         has_msg[d] = True
-    rank = lax.axis_index(axes)
-    mask = jnp.asarray(has_msg)[rank]
+    mask = jnp.asarray(has_msg)[comm.rank()]
     return jnp.where(mask, permuted, template)
 
 
-def _static_source_of(pairs, size, axes):
-    src_of = np.full(size, ANY_SOURCE, np.int32)
+def _static_source_of(pairs, comm):
+    src_of = np.full(comm.size, ANY_SOURCE, np.int32)
     for s, d in pairs:
         src_of[d] = s
-    return jnp.asarray(src_of)[lax.axis_index(axes)]
+    return jnp.asarray(src_of)[comm.rank()]
 
 
 @publishes_token
@@ -239,8 +239,8 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
             token, (y,) = fence_out(token, payload)
         elif comm.backend == "mesh":
             token, (payload,) = fence_in(token, payload)
-            y = _ppermute(payload, comm.axes, pairs)
-            y = _recv_merge(y, x, pairs, comm.size, comm.axes)
+            y = _ppermute(payload, comm.axes, comm.expand_perm(pairs))
+            y = _recv_merge(y, x, pairs, comm)
             token, (y,) = fence_out(token, y)
         else:
             raise NotImplementedError(
@@ -250,7 +250,7 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
             if comm.backend == "self":
                 status.source, status.tag = 0, meta.tag
             else:
-                status.source = _static_source_of(pairs, comm.size, comm.axes)
+                status.source = _static_source_of(pairs, comm)
                 status.tag = meta.tag
         return y, token
 
@@ -335,11 +335,11 @@ def sendrecv(
                     "permutation."
                 )
         token, (payload,) = fence_in(token, sendbuf)
-        y = _ppermute(payload, comm.axes, dpairs)
-        y = _recv_merge(y, recvbuf, dpairs, comm.size, comm.axes)
+        y = _ppermute(payload, comm.axes, comm.expand_perm(dpairs))
+        y = _recv_merge(y, recvbuf, dpairs, comm)
         token, (y,) = fence_out(token, y)
         if status is not None:
-            status.source = _static_source_of(dpairs, comm.size, comm.axes)
+            status.source = _static_source_of(dpairs, comm)
             status.tag = sendtag
         return y, token
     raise NotImplementedError(

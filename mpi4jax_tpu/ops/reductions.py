@@ -135,37 +135,65 @@ def named_op(name):
         ) from None
 
 
-def mesh_allreduce(x, op, axes):
+def group_psum(x, axes, groups=None):
+    """psum across ``axes``, independently per subgroup when ``groups``
+    is set (via grouped all_gather — shard_map's grouped psum is
+    unimplemented in current JAX)."""
+    if groups is None:
+        return lax.psum(x, axes)
+    gathered = lax.all_gather(
+        x, axes, axis=0, tiled=False, axis_index_groups=groups
+    )
+    return gathered.sum(axis=0)
+
+
+def mesh_allreduce(x, op, axes, groups=None):
     """Reduce ``x`` with ``op`` across the mesh axes, result on every device.
 
     Fast paths use native XLA collectives (data stays in HBM, rides ICI);
     operators with no native collective fall back to all_gather + local
     ``lax.reduce`` — semantically the reference's MPI_Allreduce with an
     arbitrary MPI.Op (mpi4jax/_src/collective_ops/allreduce.py:36-66).
+    ``groups`` (from a split communicator) becomes XLA's
+    axis_index_groups: one independent reduction per subgroup.
     """
     from mpi4jax_tpu.ops._core import promote_vma
 
     x = promote_vma(x, axes)
     dtype = x.dtype
+    if op.name in ("sum", "lxor") and groups is not None:
+        # shard_map's grouped psum is unimplemented in current JAX; the
+        # grouped all_gather path is, so sum per subgroup via gather+add.
+        gathered = lax.all_gather(
+            x.astype(jnp.int32) if dtype == jnp.bool_ else x,
+            axes,
+            axis=0,
+            tiled=False,
+            axis_index_groups=groups,
+        )
+        total = gathered.sum(axis=0)
+        if op.name == "lxor":
+            return total % 2 != 0
+        return total != 0 if dtype == jnp.bool_ else total
     if op.name == "sum":
         if dtype == jnp.bool_:
             return lax.psum(x.astype(jnp.int32), axes) != 0
         return lax.psum(x, axes)
     if op.name == "min":
         if dtype == jnp.bool_:
-            return lax.pmin(x.astype(jnp.int8), axes).astype(jnp.bool_)
-        return lax.pmin(x, axes)
+            return lax.pmin(x.astype(jnp.int8), axes, axis_index_groups=groups).astype(jnp.bool_)
+        return lax.pmin(x, axes, axis_index_groups=groups)
     if op.name == "max":
         if dtype == jnp.bool_:
-            return lax.pmax(x.astype(jnp.int8), axes).astype(jnp.bool_)
-        return lax.pmax(x, axes)
+            return lax.pmax(x.astype(jnp.int8), axes, axis_index_groups=groups).astype(jnp.bool_)
+        return lax.pmax(x, axes, axis_index_groups=groups)
     if op.name == "land":
-        return lax.pmin(x.astype(jnp.int8), axes).astype(jnp.bool_)
+        return lax.pmin(x.astype(jnp.int8), axes, axis_index_groups=groups).astype(jnp.bool_)
     if op.name == "lor":
-        return lax.pmax(x.astype(jnp.int8), axes).astype(jnp.bool_)
+        return lax.pmax(x.astype(jnp.int8), axes, axis_index_groups=groups).astype(jnp.bool_)
     if op.name == "lxor":
-        return lax.psum(x.astype(jnp.int32), axes) % 2 != 0
+        return lax.psum(x.astype(jnp.int32), axes, axis_index_groups=groups) % 2 != 0
     # prod / band / bor / bxor: gather then reduce locally.
-    gathered = lax.all_gather(x, axes, axis=0, tiled=False)
+    gathered = lax.all_gather(x, axes, axis=0, tiled=False, axis_index_groups=groups)
     init = jnp.asarray(op.identity(dtype), dtype)
     return lax.reduce(gathered, init, op.combine, dimensions=(0,))

@@ -96,6 +96,11 @@ class MeshComm(Comm):
     axes: tuple
     axis_sizes: tuple
     context: int = 0
+    # Result of split(): a partition of the global mesh ranks into
+    # equal-size subgroups.  Collectives then run independently per
+    # subgroup (lowering to XLA's axis_index_groups); this device's comm
+    # rank is its position within its own group.  None = whole axes.
+    groups: tuple = None
     # Convenience only (not part of identity): lets model code build
     # shard_maps from the comm.  Excluded from eq/hash.
     mesh: object = field(default=None, compare=False, repr=False)
@@ -122,15 +127,116 @@ class MeshComm(Comm):
 
     @property
     def size(self):
+        if self.groups is not None:
+            return len(self.groups[0])
+        return prod(self.axis_sizes)
+
+    @property
+    def global_size(self):
+        """Total devices across the member axes (== size unless split)."""
         return prod(self.axis_sizes)
 
     def rank(self):
         from jax import lax
 
-        return lax.axis_index(self.axes)
+        import jax.numpy as jnp
+
+        gr = lax.axis_index(self.axes)
+        if self.groups is None:
+            return gr
+        pos = np.empty(self.global_size, np.int32)
+        for g in self.groups:
+            for i, r in enumerate(g):
+                pos[r] = i
+        return jnp.asarray(pos)[gr]
+
+    def group_id(self):
+        """Traced id of this device's subgroup (its split color class)."""
+        from jax import lax
+
+        import jax.numpy as jnp
+
+        gr = lax.axis_index(self.axes)
+        if self.groups is None:
+            return gr * 0
+        gid = np.empty(self.global_size, np.int32)
+        for j, g in enumerate(self.groups):
+            for r in g:
+                gid[r] = j
+        return jnp.asarray(gid)[gr]
 
     def clone(self):
         return replace(self, context=next(_context_counter))
+
+    def split(self, color, key=None):
+        """Partition the communicator (MPI_Comm_split analog).
+
+        Under SPMD the partition must be derivable identically on every
+        device, so ``color`` and ``key`` are *static* functions of the
+        global rank (or explicit length-``global_size`` sequences), not
+        per-process runtime values as in MPI.  Members with equal color
+        form a subgroup, ordered by (key, rank); subgroups must be
+        equal-sized (one SPMD program has uniform shapes — MPI's ragged
+        split is only available on the multi-process backend).  A color
+        of None drops the rank from every subgroup (MPI_UNDEFINED);
+        such devices still execute the collectives (SPMD) but in a
+        group of their own.
+        """
+        n = self.global_size
+        colors = [color(r) for r in range(n)] if callable(color) else list(color)
+        if len(colors) != n:
+            raise ValueError(
+                f"color must cover all {n} ranks, got {len(colors)}"
+            )
+        keys = (
+            [key(r) for r in range(n)]
+            if callable(key)
+            else (list(key) if key is not None else [0] * n)
+        )
+        by_color = {}
+        dropped = []
+        for r, c in enumerate(colors):
+            if c is None:
+                dropped.append(r)
+            else:
+                by_color.setdefault(c, []).append(r)
+        groups = [
+            tuple(sorted(members, key=lambda r: (keys[r], r)))
+            for _, members in sorted(by_color.items())
+        ]
+        sizes = {len(g) for g in groups}
+        if len(sizes) > 1:
+            raise ValueError(
+                f"SPMD split requires equal-size subgroups, got sizes "
+                f"{sorted(len(g) for g in groups)}. Use the multi-process "
+                f"backend for ragged splits."
+            )
+        # MPI_UNDEFINED ranks still execute the SPMD collectives, so they
+        # are packed into equal-size groups of their own (communicating
+        # only with each other).
+        if dropped:
+            gsize = len(groups[0]) if groups else len(dropped)
+            if len(dropped) % gsize:
+                raise ValueError(
+                    f"{len(dropped)} ranks have color None but subgroups "
+                    f"have size {gsize}; under SPMD every device runs the "
+                    "collective, so dropped ranks must also pack into "
+                    "equal-size groups"
+                )
+            for i in range(0, len(dropped), gsize):
+                groups.append(tuple(dropped[i : i + gsize]))
+        return replace(self, groups=tuple(groups))
+
+    def expand_perm(self, pairs):
+        """Map (source, dest) pairs in comm-rank space to global mesh
+        ranks (identity when the comm is not split)."""
+        if self.groups is None:
+            return list(pairs)
+        out = []
+        for g in self.groups:
+            for s, d in pairs:
+                out.append((g[s], g[d]))
+        return out
 
     def sub(self, *axes):
         """Sub-communicator over a subset of axes (MPI_Cart_sub analog).
@@ -138,6 +244,11 @@ class MeshComm(Comm):
         E.g. on a ``("y", "x")`` comm, ``comm.sub("x")`` is the row
         communicator: collectives over it run independently per y-index.
         """
+        if self.groups is not None:
+            raise ValueError(
+                "cannot take an axis sub-communicator of a split "
+                "communicator; split from the parent comm instead"
+            )
         for a in axes:
             if a not in self.axes:
                 raise ValueError(f"axis {a!r} not in {self.axes}")
@@ -170,6 +281,11 @@ class MeshComm(Comm):
         edge ranks receive nothing: recv/sendrecv then return their recv
         buffer (template) unchanged, matching MPI_PROC_NULL semantics.
         """
+        if self.groups is not None:
+            raise ValueError(
+                "a split communicator has no Cartesian topology; pass an "
+                "explicit rank->partner callable or (source, dest) pairs"
+            )
         ax = self.axes.index(axis)
         n = self.axis_sizes[ax]
         grid = self.rank_grid()

@@ -39,6 +39,43 @@ class ProcComm(Comm):
 
         return ProcComm(ranks=self.ranks, context=next(_context_counter))
 
+    def split(self, color, key=None):
+        """MPI_Comm_split analog (static form, like MeshComm.split):
+        ``color``/``key`` are functions of the comm rank (or explicit
+        sequences), evaluated identically on every process.  Unlike the
+        SPMD mesh backend, ragged (unequal-size) groups are allowed —
+        each process simply joins its own subgroup's communicator.
+        Returns None (MPI_COMM_NULL) for ranks whose color is None.
+        """
+        from mpi4jax_tpu.parallel.comm import _context_counter
+
+        n = self.size
+        colors = [color(r) for r in range(n)] if callable(color) else list(color)
+        if len(colors) != n:
+            raise ValueError(
+                f"color must cover all {n} ranks, got {len(colors)}"
+            )
+        keys = (
+            [key(r) for r in range(n)]
+            if callable(key)
+            else (list(key) if key is not None else [0] * n)
+        )
+        me = self.rank()
+        if colors[me] is None:
+            return None
+        members = sorted(
+            (r for r in range(n) if colors[r] == colors[me]),
+            key=lambda r: (keys[r], r),
+        )
+        # same (deterministic) context on every member: derive from the
+        # clone counter only on the lowest member... not possible without
+        # communication, so fold the group into the wire context instead
+        # (runtime._stable_ctx hashes ranks + context; keep parent ctx).
+        return ProcComm(
+            ranks=tuple(self.ranks[r] for r in members),
+            context=self.context,
+        )
+
 
 def world_comm_if_initialized():
     """Return the world ProcComm if the native runtime is up, else None."""

@@ -1,0 +1,170 @@
+"""comm.split (MPI_Comm_split analog) on the mesh backend.
+
+The reference accepts arbitrary pre-split mpi4py communicators
+(mpi4jax/_src/comm.py, utils.py:77-96); here splitting is a first-class
+operation lowering to XLA axis_index_groups.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+
+from tests.helpers import spmd_jit
+
+SIZE = 8
+
+
+def world_input():
+    return jnp.arange(float(SIZE))
+
+
+def test_split_allreduce_per_group(comm1d):
+    half = comm1d.split(lambda r: r % 2)  # evens {0,2,4,6}, odds {1,3,5,7}
+
+    def fn(x):
+        y, _ = m.allreduce(x, m.SUM, comm=half)
+        return y
+
+    out = np.asarray(spmd_jit(comm1d, fn)(world_input()))
+    evens, odds = 0 + 2 + 4 + 6, 1 + 3 + 5 + 7
+    want = np.where(np.arange(8) % 2 == 0, evens, odds)
+    assert np.array_equal(out, want)
+
+
+def test_split_rank_and_group_id(comm1d):
+    half = comm1d.split(lambda r: r // 4)  # {0..3}, {4..7}
+
+    def fn(x):
+        return half.rank() + 10.0 * half.group_id() + 0.0 * x
+
+    out = np.asarray(spmd_jit(comm1d, fn)(world_input()))
+    want = np.array([0, 1, 2, 3, 10, 11, 12, 13], float)
+    assert np.array_equal(out, want)
+
+
+def test_split_key_reorders(comm1d):
+    # descending key: subcomm rank 0 is the highest world rank in group
+    half = comm1d.split(lambda r: r % 2, key=lambda r: -r)
+
+    def fn(x):
+        y, _ = m.bcast(x, 0, comm=half)
+        return y
+
+    out = np.asarray(spmd_jit(comm1d, fn)(world_input()))
+    # evens' root = world rank 6; odds' root = world rank 7
+    want = np.where(np.arange(8) % 2 == 0, 6.0, 7.0)
+    assert np.array_equal(out, want)
+
+
+def test_split_sendrecv_ring_within_group(comm1d):
+    half = comm1d.split(lambda r: r % 2)
+
+    def fn(x):
+        tok = m.create_token()
+        y, _ = m.sendrecv(
+            x,
+            x,
+            source=lambda r: (r - 1) % 4,
+            dest=lambda r: (r + 1) % 4,
+            comm=half,
+            token=tok,
+        )
+        return y
+
+    out = np.asarray(spmd_jit(comm1d, fn)(world_input()))
+    # evens ring: 0->2->4->6->0 ; odds ring: 1->3->5->7->1
+    want = np.array([6, 7, 0, 1, 2, 3, 4, 5], float)
+    assert np.array_equal(out, want)
+
+
+def test_split_scan_within_group(comm1d):
+    half = comm1d.split(lambda r: r // 4)
+
+    def fn(x):
+        y, _ = m.scan(x, m.SUM, comm=half)
+        return y
+
+    out = np.asarray(spmd_jit(comm1d, fn)(world_input()))
+    want = np.array([0, 1, 3, 6, 4, 9, 15, 22], float)
+    assert np.array_equal(out, want)
+
+
+def test_split_allgather_and_scatter(comm1d):
+    half = comm1d.split(lambda r: r // 4)
+
+    def fn(x):
+        g, tok = m.allgather(x, comm=half)
+        s, tok = m.scatter(2.0 * g, 0, comm=half, token=tok)
+        return g.sum() + s
+
+    out = np.asarray(spmd_jit(comm1d, fn)(world_input()))
+    g0, g1 = 0 + 1 + 2 + 3, 4 + 5 + 6 + 7
+    want = np.array(
+        [g0 + 0, g0 + 2, g0 + 4, g0 + 6, g1 + 8, g1 + 10, g1 + 12, g1 + 14],
+        float,
+    )
+    assert np.array_equal(out, want)
+
+
+def test_split_undefined_color_groups(comm1d):
+    # MPI_UNDEFINED ranks pack into their own equal-size group
+    half = comm1d.split(lambda r: 0 if r < 4 else None)
+    assert half.groups == ((0, 1, 2, 3), (4, 5, 6, 7))
+
+
+def test_ragged_split_raises(comm1d):
+    with pytest.raises(ValueError, match="equal-size"):
+        comm1d.split(lambda r: 0 if r < 3 else 1)
+
+
+def test_split_topology_guards(comm1d, comm2d):
+    half = comm1d.split(lambda r: r % 2)
+    with pytest.raises(ValueError, match="Cartesian"):
+        half.shift_perm("i", 1)
+    row_split = comm2d.split(lambda r: r // 4)
+    with pytest.raises(ValueError, match="sub-communicator"):
+        row_split.sub("x")
+
+
+def test_split_of_2d_comm_rows_equals_sub(comm2d):
+    """Splitting a (2,4) comm by row must equal the 'x' sub-comm."""
+    rows = comm2d.split(lambda r: r // 4)
+
+    def fn_split(x):
+        y, _ = m.allreduce(x, m.SUM, comm=rows)
+        return y
+
+    def fn_sub(x):
+        y, _ = m.allreduce(x, m.SUM, comm=comm2d.sub("x"))
+        return y
+
+    spec = jax.P(("y", "x"))
+    run = lambda f: np.asarray(
+        jax.jit(
+            jax.shard_map(f, mesh=comm2d.mesh, in_specs=spec, out_specs=spec)
+        )(world_input())
+    )
+    assert np.array_equal(run(fn_split), run(fn_sub))
+
+
+def test_proccomm_split_rank_math():
+    """ProcComm.split group computation (no runtime needed for the
+    pure-rank-math path when rank() is patchable)."""
+    from mpi4jax_tpu.parallel.proc import ProcComm
+
+    comm = ProcComm(ranks=(0, 1, 2, 3, 4))
+
+    class Fixed(ProcComm):
+        def rank(self):
+            return 2
+
+    c = Fixed(ranks=(0, 1, 2, 3, 4))
+    sub = c.split(lambda r: r % 2)  # rank 2 is even -> {0, 2, 4}
+    assert sub.ranks == (0, 2, 4)
+    sub2 = c.split(lambda r: r % 2, key=lambda r: -r)
+    assert sub2.ranks == (4, 2, 0)
+    assert c.split(lambda r: None if r == 2 else 0) is None
+    del comm
