@@ -253,9 +253,7 @@ def scan(x, op, *, comm=None, token=None):
         while dist < size:
             perm = comm.expand_perm(
                 [(r, r + dist) for r in range(size - dist)]
-            ) if comm.groups is not None else [
-                (r, r + dist) for r in range(size - dist)
-            ]
+            )
             shifted = lax.ppermute(acc, comm.axes, perm)
             combined = op.combine(acc, shifted.astype(acc.dtype))
             acc = jnp.where(rank >= dist, combined.astype(acc.dtype), acc)

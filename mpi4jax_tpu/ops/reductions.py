@@ -144,7 +144,10 @@ def group_psum(x, axes, groups=None):
     gathered = lax.all_gather(
         x, axes, axis=0, tiled=False, axis_index_groups=groups
     )
-    return gathered.sum(axis=0)
+    # accumulate in the input dtype: .sum() would promote sub-32-bit
+    # ints (and bool) to int32, but psum/MPI_Allreduce preserve the
+    # buffer type
+    return gathered.sum(axis=0, dtype=x.dtype)
 
 
 def mesh_allreduce(x, op, axes, groups=None):
@@ -171,7 +174,9 @@ def mesh_allreduce(x, op, axes, groups=None):
             tiled=False,
             axis_index_groups=groups,
         )
-        total = gathered.sum(axis=0)
+        # dtype= keeps the buffer type (psum semantics); .sum() alone
+        # would promote sub-32-bit ints to int32
+        total = gathered.sum(axis=0, dtype=gathered.dtype)
         if op.name == "lxor":
             return total % 2 != 0
         return total != 0 if dtype == jnp.bool_ else total

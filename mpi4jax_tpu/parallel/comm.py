@@ -173,7 +173,7 @@ class MeshComm(Comm):
 
         Under SPMD the partition must be derivable identically on every
         device, so ``color`` and ``key`` are *static* functions of the
-        global rank (or explicit length-``global_size`` sequences), not
+        communicator rank (or explicit length-``size`` sequences), not
         per-process runtime values as in MPI.  Members with equal color
         form a subgroup, ordered by (key, rank); subgroups must be
         equal-sized (one SPMD program has uniform shapes — MPI's ragged
@@ -181,8 +181,13 @@ class MeshComm(Comm):
         of None drops the rank from every subgroup (MPI_UNDEFINED);
         such devices still execute the collectives (SPMD) but in a
         group of their own.
+
+        Splitting an already-split communicator partitions *within* each
+        existing subgroup (as MPI_Comm_split on a subcomm can never
+        escape it); every subgroup is partitioned by the same color
+        function, since all devices run one SPMD program.
         """
-        n = self.global_size
+        n = self.size
         colors = [color(r) for r in range(n)] if callable(color) else list(color)
         if len(colors) != n:
             raise ValueError(
@@ -193,6 +198,8 @@ class MeshComm(Comm):
             if callable(key)
             else (list(key) if key is not None else [0] * n)
         )
+        if len(keys) != n:
+            raise ValueError(f"key must cover all {n} ranks, got {len(keys)}")
         by_color = {}
         dropped = []
         for r, c in enumerate(colors):
@@ -200,22 +207,22 @@ class MeshComm(Comm):
                 dropped.append(r)
             else:
                 by_color.setdefault(c, []).append(r)
-        groups = [
+        local_groups = [
             tuple(sorted(members, key=lambda r: (keys[r], r)))
             for _, members in sorted(by_color.items())
         ]
-        sizes = {len(g) for g in groups}
+        sizes = {len(g) for g in local_groups}
         if len(sizes) > 1:
             raise ValueError(
                 f"SPMD split requires equal-size subgroups, got sizes "
-                f"{sorted(len(g) for g in groups)}. Use the multi-process "
-                f"backend for ragged splits."
+                f"{sorted(len(g) for g in local_groups)}. Use the "
+                f"multi-process backend for ragged splits."
             )
         # MPI_UNDEFINED ranks still execute the SPMD collectives, so they
         # are packed into equal-size groups of their own (communicating
         # only with each other).
         if dropped:
-            gsize = len(groups[0]) if groups else len(dropped)
+            gsize = len(local_groups[0]) if local_groups else len(dropped)
             if len(dropped) % gsize:
                 raise ValueError(
                     f"{len(dropped)} ranks have color None but subgroups "
@@ -224,8 +231,17 @@ class MeshComm(Comm):
                     "equal-size groups"
                 )
             for i in range(0, len(dropped), gsize):
-                groups.append(tuple(dropped[i : i + gsize]))
-        return replace(self, groups=tuple(groups))
+                local_groups.append(tuple(dropped[i : i + gsize]))
+        # comm-rank-space subgroups -> global mesh ranks, per parent group
+        parents = (
+            self.groups
+            if self.groups is not None
+            else (tuple(range(self.global_size)),)
+        )
+        groups = tuple(
+            tuple(p[i] for i in lg) for p in parents for lg in local_groups
+        )
+        return replace(self, groups=groups)
 
     def expand_perm(self, pairs):
         """Map (source, dest) pairs in comm-rank space to global mesh

@@ -168,3 +168,42 @@ def test_proccomm_split_rank_math():
     assert sub2.ranks == (4, 2, 0)
     assert c.split(lambda r: None if r == 2 else 0) is None
     del comm
+
+
+def test_split_preserves_sub32bit_dtypes(comm1d):
+    # regression: the grouped-reduction gather paths used .sum(axis=0),
+    # which promotes int8/bool to int32 — allreduce then crashed at
+    # lowering (declared out dtype != computed) and bcast silently
+    # widened.  MPI_Allreduce/MPI_Bcast preserve the buffer type.
+    split = comm1d.split(lambda r: r % 2)
+    x8 = jnp.arange(8, dtype=jnp.int8)
+    out = spmd_jit(comm1d, lambda v: m.allreduce(v, m.SUM, comm=split)[0])(x8)
+    assert out.dtype == jnp.int8
+    assert np.array_equal(
+        np.asarray(out), np.where(np.arange(8) % 2 == 0, 12, 16)
+    )
+    b = spmd_jit(comm1d, lambda v: m.bcast(v, 0, comm=split)[0])(x8)
+    assert b.dtype == jnp.int8
+    assert np.array_equal(np.asarray(b), np.where(np.arange(8) % 2 == 0, 0, 1))
+    xb = jnp.array([False] * 4 + [True] * 4)
+    ob = spmd_jit(comm1d, lambda v: m.allreduce(v, m.SUM, comm=split)[0])(xb)
+    assert ob.dtype == jnp.bool_ and np.asarray(ob).all()
+
+
+def test_split_of_split_stays_inside_parent(comm1d):
+    # regression: splitting an already-split comm evaluated colors over
+    # global ranks and overwrote the partition wholesale, letting
+    # subgroups span parent groups — MPI_Comm_split on a subcomm can
+    # never escape it.  Colors now index the communicator rank.
+    half = comm1d.split(lambda r: r // 4)
+    q = half.split(lambda r: r % 2)
+    assert q.groups == ((0, 2), (1, 3), (4, 6), (5, 7))
+    out = spmd_jit(comm1d, lambda v: m.allreduce(v, m.SUM, comm=q)[0])(
+        jnp.arange(8.0)
+    )
+    assert np.array_equal(
+        np.asarray(out), [2.0, 4.0, 2.0, 4.0, 10.0, 12.0, 10.0, 12.0]
+    )
+    # color/key sequences on a split comm are length comm.size
+    with pytest.raises(ValueError, match="cover all 4 ranks"):
+        half.split([0] * 8)
