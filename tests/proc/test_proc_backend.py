@@ -9,6 +9,7 @@ job's combined output / exit code.
 """
 
 import pathlib
+import signal
 import subprocess
 import sys
 import textwrap
@@ -35,13 +36,29 @@ def run_workers(body, nprocs=2, env=None, timeout=150, expect_fail=False):
     full_env.pop("XLA_FLAGS", None)  # children need no virtual devices
     if env:
         full_env.update(env)
-    proc = subprocess.run(
+    # start_new_session puts the launcher AND its workers in one process
+    # group we can kill wholesale: on a hang, killing only the launcher
+    # would leave deadlocked workers holding the capture pipe open and
+    # the timeout would never actually fire.
+    popen = subprocess.Popen(
         [sys.executable, "-m", "mpi4jax_tpu.launch", "-np", str(nprocs), path],
-        capture_output=True,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
         text=True,
-        timeout=timeout,
         env=full_env,
         cwd=str(REPO),
+        start_new_session=True,
+    )
+    try:
+        stdout, stderr = popen.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(popen.pid), signal.SIGKILL)
+        stdout, stderr = popen.communicate()
+        raise AssertionError(
+            f"job timed out after {timeout}s\n{stdout}\n{stderr}"
+        )
+    proc = subprocess.CompletedProcess(
+        popen.args, popen.returncode, stdout, stderr
     )
     if expect_fail:
         assert proc.returncode != 0, (proc.stdout, proc.stderr)
@@ -291,4 +308,29 @@ assert np.allclose(np.asarray(res), float(size))
 print(f"WORKER_OK {rank}", flush=True)
 """,
         nprocs=2,
+    )
+
+
+def test_no_deadlock_on_exit():
+    # regression for the reference's deadlock-on-exit class of bugs
+    # (mpi4jax#22; death test at test_common.py:91-115): a p2p exchange
+    # is dispatched into XLA but never observed by the worker, which
+    # exits immediately.  The atexit hook (native/runtime.py:finalize)
+    # must drain pending device work *before* tearing down the socket
+    # mesh, or rank 0's in-flight send blocks forever against a peer
+    # whose sockets are gone.  Success = the job exits 0 inside the
+    # timeout with no explicit synchronisation in the worker.
+    run_workers(
+        PREAMBLE
+        + """
+tok = m.create_token()
+if rank == 0:
+    tok = m.send(jnp.ones(128) * 3, 1, comm=comm, token=tok)
+else:
+    y, tok = m.recv(jnp.zeros(128), 0, comm=comm, token=tok)
+print(f"WORKER_OK {rank}", flush=True)
+# no np.asarray / block_until_ready: exit with the exchange in flight
+""",
+        nprocs=2,
+        timeout=90,
     )
