@@ -39,8 +39,13 @@ def build(verbose=False):
 
     include = jax.ffi.include_dir()
     tmp = _OUT.with_suffix(f".tmp{os.getpid()}.so")
+    # compiler override mirrors the reference's MPI4JAX_BUILD_MPICC
+    # (setup.py:78); CXX is the conventional spelling here
+    cxx = os.environ.get("MPI4JAX_TPU_BUILD_CXX") or os.environ.get(
+        "CXX", "g++"
+    )
     cmd = [
-        "g++",
+        cxx,
         "-O2",
         "-fPIC",
         "-shared",

@@ -1,0 +1,48 @@
+"""Optional eager build of the native DCN bridge.
+
+``pip install .`` works with pyproject.toml alone (the bridge compiles
+lazily on first multi-process use).  This shim adds the reference's
+install-time native compilation (setup.py:75-86 custom_build_ext, which
+swaps the compiler to mpicc) as a best-effort step: if jax + g++ are
+available in the build environment the .so is prebuilt into the wheel,
+otherwise the lazy path takes over at runtime.
+
+    MPI4JAX_TPU_BUILD_NATIVE=0 python -m pip install .   # skip prebuild
+"""
+
+import os
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        super().run()
+        if os.environ.get("MPI4JAX_TPU_BUILD_NATIVE", "1") not in (
+            "0",
+            "false",
+            "off",
+        ):
+            self._try_build_native()
+
+    def _try_build_native(self):
+        try:
+            import pathlib
+            import sys
+
+            root = pathlib.Path(__file__).resolve().parent
+            sys.path.insert(0, str(root))
+            from mpi4jax_tpu.native.build import build, lib_path
+
+            build(verbose=True)
+            target_pkg = pathlib.Path(self.build_lib) / "mpi4jax_tpu" / "native"
+            if target_pkg.exists():
+                import shutil
+
+                shutil.copy2(lib_path(), target_pkg / lib_path().name)
+        except Exception as exc:  # no jax/g++ in the build env: lazy path
+            print(f"skipping native prebuild ({exc!r})")
+
+
+setup(cmdclass={"build_py": build_py_with_native})
