@@ -1,0 +1,12 @@
+# Sphinx configuration (reference analog: docs/conf.py there).
+# The docs are plain Markdown — readable as-is on any forge — and build
+# with sphinx + myst_parser when available:  sphinx-build docs docs/_build
+project = "mpi4jax_tpu"
+author = "mpi4jax_tpu developers"
+copyright = "2026, mpi4jax_tpu developers"
+
+extensions = ["myst_parser"]
+source_suffix = {".md": "markdown", ".rst": "restructuredtext"}
+master_doc = "index"
+exclude_patterns = ["_build"]
+html_theme = "alabaster"
