@@ -120,6 +120,14 @@ def _ppermute(x, axes, pairs):
     from mpi4jax_tpu.ops._core import promote_vma
 
     x = promote_vma(x, axes)
+    if all(s == d for s, d in pairs):
+        # pure self-sends (e.g. periodic wrap on a size-1 mesh axis): a
+        # CollectivePermute would deliver x to every listed rank and 0
+        # elsewhere, and callers mask non-destination ranks with the
+        # recv template anyway (_recv_merge) — so the collective is an
+        # identity with launch overhead.  Eliding it removes ~50 no-op
+        # collectives per shallow-water step on a single chip.
+        return x
     if x.dtype == jnp.bool_:
         return lax.ppermute(x.astype(jnp.int8), axes, pairs).astype(jnp.bool_)
     return lax.ppermute(x, axes, pairs)

@@ -15,6 +15,7 @@ corner cells are correct after two rounds — same transitive-corner trick
 as the reference's clockwise ordering.
 """
 
+import jax
 import jax.numpy as jnp
 
 from mpi4jax_tpu.ops._core import as_token, publishes_token
@@ -56,6 +57,10 @@ def halo_exchange_2d(arr, comm, *, periodic=(False, True), token=None):
     per_y, per_x = periodic
 
     # --- x direction: full columns (corner cells ride along) ---
+    # Ghost columns are written with single-column dynamic-update-slices.
+    # (Measured on v5e: the alternatives — one minor-dim concatenate, or
+    # iota-masked jnp.where selects — are 10% slower than DUS even
+    # though DUS makes XLA flip some layouts; see docs/shallow-water.md.)
     west_halo, token = _axis_shift(
         arr[:, -2], arr[:, 0], comm, "x", +1, per_x, token
     )
