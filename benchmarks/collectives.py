@@ -1,0 +1,103 @@
+"""Collective micro-benchmarks: bus bandwidth per op over the device mesh.
+
+The second driver metric in BASELINE.md ("allreduce GB/s at 8->256
+chips").  For each payload size the op runs inside one jitted shard_map
+over all visible devices; reported algorithmic bandwidth uses the
+standard convention (bytes * 2*(n-1)/n for allreduce, bytes * (n-1)/n
+for allgather/alltoall/ppermute-ring), so numbers are comparable with
+NCCL/MPI bus-bandwidth tables.
+
+    python benchmarks/collectives.py [--sizes-mb 1 16 64] [--ops allreduce ...]
+
+Prints one JSON line per (op, size).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes-mb", nargs="*", type=float, default=[1, 4, 16, 64])
+    p.add_argument(
+        "--ops",
+        nargs="*",
+        default=["allreduce", "allgather", "alltoall", "sendrecv"],
+    )
+    p.add_argument("--reps", type=int, default=20)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.utils.runtime import drain
+
+    n = len(jax.devices())
+    mesh = jax.make_mesh(
+        (n,), ("i",), axis_types=(jax.sharding.AxisType.Auto,)
+    )
+    comm = m.MeshComm.from_mesh(mesh)
+    ring = [(r, (r + 1) % n) for r in range(n)]
+
+    def build(op, per_dev_elems):
+        def local(x):
+            if op == "allreduce":
+                return m.allreduce(x, m.SUM, comm=comm)[0]
+            if op == "allgather":
+                return m.allgather(x, comm=comm)[0].sum(axis=0)
+            if op == "alltoall":
+                blk = x.reshape(n, -1)
+                return m.alltoall(blk, comm=comm)[0].reshape(x.shape)
+            if op == "sendrecv":
+                return m.sendrecv(x, x, source=ring, dest=ring, comm=comm)[0]
+            raise ValueError(op)
+
+        return jax.jit(
+            jax.shard_map(
+                local, mesh=mesh, in_specs=jax.P("i"), out_specs=jax.P("i")
+            )
+        )
+
+    # algorithmic-bandwidth factors (NCCL-tests convention)
+    factor = {
+        "allreduce": 2 * (n - 1) / n,
+        "allgather": (n - 1) / n,
+        "alltoall": (n - 1) / n,
+        "sendrecv": 1.0,
+    }
+
+    for op in args.ops:
+        for mb in args.sizes_mb:
+            per_dev = max(int(mb * 1e6 / 4), n)
+            per_dev -= per_dev % n  # alltoall needs a multiple of n
+            x = jnp.ones((n * per_dev,), jnp.float32)
+            fn = build(op, per_dev)
+            y = fn(x)
+            drain(y)  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(args.reps):
+                y = fn(x)
+            drain(y)
+            dt = (time.perf_counter() - t0) / args.reps
+            payload = per_dev * 4
+            busbw = payload * factor[op] / dt
+            print(
+                json.dumps(
+                    {
+                        "metric": f"{op}_busbw",
+                        "value": round(busbw / 1e9, 3),
+                        "unit": "GB/s",
+                        "devices": n,
+                        "payload_mb": round(payload / 1e6, 2),
+                        "time_us": round(dt * 1e6, 1),
+                    }
+                )
+            )
+            sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,10 @@ via data dependence instead of side-effect annotations.
 
 import jax as _jax
 
+from mpi4jax_tpu.utils.jax_compat import check_jax_version as _check_jax_version
+
+_check_jax_version()
+
 from mpi4jax_tpu.ops import (
     ANY_SOURCE,
     ANY_TAG,

@@ -6,6 +6,7 @@ from mpi4jax_tpu.parallel.comm import (
     get_default_comm,
     set_default_comm,
 )
+from mpi4jax_tpu.parallel import distributed
 from mpi4jax_tpu.parallel.halo import halo_exchange_2d
 from mpi4jax_tpu.parallel.longseq import (
     local_attention,
@@ -15,6 +16,7 @@ from mpi4jax_tpu.parallel.longseq import (
 from mpi4jax_tpu.parallel.proc import ProcComm
 
 __all__ = [
+    "distributed",
     "Comm",
     "MeshComm",
     "SelfComm",

@@ -1,0 +1,79 @@
+"""Multi-host bootstrap: the pod-scale analog of "joining the MPI job".
+
+The reference joins its distributed world by importing mpi4py at package
+import (`mpi4jax/_src/__init__.py:3` -> MPI_Init); ranks and
+communicators then come from the MPI runtime.  TPU pods use a different
+world model: every host runs the same SPMD program, `jax.distributed`
+glues the hosts' runtimes together, and the "world" is the global device
+set of a `jax.sharding.Mesh` spanning all chips (ICI within a slice, DCN
+across slices — XLA routes collectives over the right fabric).
+
+Typical pod usage:
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.parallel import distributed
+
+    distributed.initialize()          # no-op on a single host
+    comm = distributed.world_comm()   # MeshComm over every chip in the job
+    ...                               # shard_map + the 12 ops as usual
+
+For MPMD jobs (divergent per-rank programs), use the proc backend /
+launcher instead — that is the reference's one-process-per-rank model.
+"""
+
+import jax
+
+from mpi4jax_tpu.parallel.comm import MeshComm, set_default_comm
+
+__all__ = ["initialize", "world_mesh", "world_comm"]
+
+
+def initialize(**kwargs):
+    """Connect this host to the distributed JAX runtime (idempotent).
+
+    Thin wrapper over :func:`jax.distributed.initialize` (coordinator
+    address / process count / process id are auto-detected on TPU pods,
+    or passed through as keyword arguments).  Single-process sessions
+    (no cluster env, no explicit arguments) are left untouched.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (ValueError, RuntimeError):
+        if kwargs:
+            raise
+        # no coordinator/cluster detected: single-host session
+
+
+def world_mesh(axes=None):
+    """A mesh over every device in the job.
+
+    ``axes``: optional ``(names, shape)`` tuple; default is one flat
+    axis ``("world", n_global_devices)``.
+    """
+    devices = jax.devices()
+    if axes is None:
+        names, shape = ("world",), (len(devices),)
+    else:
+        names, shape = axes
+        names = tuple(names)
+        shape = tuple(shape)
+    return jax.make_mesh(
+        shape,
+        names,
+        axis_types=(jax.sharding.AxisType.Auto,) * len(names),
+        devices=devices,
+    )
+
+
+def world_comm(axes=None, *, set_default=False):
+    """MeshComm spanning the whole job (COMM_WORLD analog).
+
+    With ``set_default=True`` it also becomes the ambient communicator
+    used when ops get ``comm=None``.
+    """
+    comm = MeshComm.from_mesh(world_mesh(axes))
+    if set_default:
+        set_default_comm(comm)
+    return comm

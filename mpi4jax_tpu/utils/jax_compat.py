@@ -1,0 +1,51 @@
+"""JAX version gate.
+
+The reference warns when running against a jax newer than the last
+version it was validated with (mpi4jax/_src/jax_compat.py:59-83 +
+_latest_jax_version.txt), because it leans on jax internals.  This
+framework uses only public API (jax.shard_map / jax.P / lax collectives
+/ jax.ffi), so the gate is a soft warning with the same opt-out
+semantics, spelled MPI4JAX_TPU_NO_WARN_JAX_VERSION.
+"""
+
+import os
+import warnings
+
+# newest jax line this package's test suite has been run against
+LATEST_TESTED_JAX = (0, 9)
+
+# oldest jax with the public APIs we require (see pyproject.toml)
+MINIMUM_JAX = (0, 7)
+
+__all__ = ["check_jax_version", "LATEST_TESTED_JAX", "MINIMUM_JAX"]
+
+
+def _parse(version):
+    parts = []
+    for tok in version.split(".")[:2]:
+        digits = "".join(ch for ch in tok if ch.isdigit())
+        parts.append(int(digits or 0))
+    return tuple(parts)
+
+
+def check_jax_version(jax_version=None):
+    """Warn (once per process) when jax is newer than the tested pin or
+    error when older than the supported floor."""
+    import jax
+
+    v = _parse(jax_version or jax.__version__)
+    if v < MINIMUM_JAX:
+        raise RuntimeError(
+            f"mpi4jax_tpu requires jax>={'.'.join(map(str, MINIMUM_JAX))}, "
+            f"found {jax_version or jax.__version__}"
+        )
+    if v > LATEST_TESTED_JAX and not os.environ.get(
+        "MPI4JAX_TPU_NO_WARN_JAX_VERSION"
+    ):
+        warnings.warn(
+            f"jax {jax_version or jax.__version__} is newer than the last "
+            f"version mpi4jax_tpu was validated against "
+            f"({'.'.join(map(str, LATEST_TESTED_JAX))}.x). Things probably "
+            "work — set MPI4JAX_TPU_NO_WARN_JAX_VERSION=1 to silence this.",
+            stacklevel=3,
+        )

@@ -1,0 +1,37 @@
+"""Multi-host bootstrap helpers (single-host path; the pod path is the
+same code over jax.distributed — reference analog: import-time MPI_Init,
+mpi4jax/_src/__init__.py:3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.parallel import distributed
+
+
+def test_initialize_single_host_noop():
+    distributed.initialize()  # must not raise without a cluster
+
+
+def test_world_comm_collective():
+    comm = distributed.world_comm()
+    assert comm.size == 8
+    out = jax.jit(
+        jax.shard_map(
+            lambda v: m.allreduce(v, m.SUM, comm=comm)[0],
+            mesh=comm.mesh,
+            in_specs=jax.P("world"),
+            out_specs=jax.P("world"),
+        )
+    )(jnp.arange(8.0))
+    assert np.allclose(np.asarray(out), 28.0)
+
+
+def test_world_comm_2d_and_default():
+    comm = distributed.world_comm((("y", "x"), (2, 4)), set_default=True)
+    try:
+        assert m.get_default_comm() is comm
+        assert comm.axis_sizes == (2, 4)
+    finally:
+        m.set_default_comm(None)

@@ -1,0 +1,38 @@
+"""Version-gate shim (reference: tests/test_jax_compat.py with
+monkeypatched versions, mpi4jax/_src/jax_compat.py:59-83)."""
+
+import warnings
+
+import pytest
+
+from mpi4jax_tpu.utils import jax_compat
+
+
+def test_current_jax_accepted():
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        try:
+            jax_compat.check_jax_version()
+        except Warning:
+            pass  # newer-than-pin warning is acceptable for current jax
+
+
+def test_newer_jax_warns():
+    with pytest.warns(UserWarning, match="newer than"):
+        jax_compat.check_jax_version("99.0.0")
+
+
+def test_newer_jax_warning_silenced(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_NO_WARN_JAX_VERSION", "1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        jax_compat.check_jax_version("99.0.0")
+
+
+def test_older_jax_rejected():
+    with pytest.raises(RuntimeError, match="requires jax>="):
+        jax_compat.check_jax_version("0.4.35")
+
+
+def test_dev_version_parses():
+    jax_compat.check_jax_version("0.7.1.dev20250101")
