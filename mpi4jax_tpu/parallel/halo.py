@@ -41,43 +41,46 @@ def _axis_shift(arr_slice, template, comm, axis, disp, periodic, token):
 
 
 @publishes_token
-def halo_exchange_2d(arr, comm, *, periodic=(False, True), token=None):
-    """Exchange 1-cell halos of a local block over a ("y", "x") MeshComm.
+def halo_exchange_2d(arr, comm, *, periodic=(False, True), token=None, width=1):
+    """Exchange ``width``-cell halos of a local block over a ("y", "x")
+    MeshComm.
 
-    ``arr`` is the device-local block of shape ``(ny_local + 2,
-    nx_local + 2)`` (interior plus one ghost ring).  Returns ``(arr,
-    token)`` with ghost cells holding the neighbours' adjacent interior
-    cells.  ``periodic`` is (y, x); non-periodic edge devices keep their
-    existing ghost values (apply wall conditions separately).
+    ``arr`` is the device-local block of shape ``(ny_local + 2*width,
+    nx_local + 2*width)`` (interior plus a ``width``-deep ghost ring).
+    Returns ``(arr, token)`` with ghost cells holding the neighbours'
+    adjacent interior cells.  ``periodic`` is (y, x); non-periodic edge
+    devices keep their existing ghost values (apply wall conditions
+    separately).
 
     Works for any decomposition including 1×1 (periodic wrap becomes a
     self-permute, so single-chip runs use the identical program).
+    Ghost slabs are written with dynamic-update-slices.  (Measured on
+    v5e: the alternatives — one minor-dim concatenate, or iota-masked
+    jnp.where selects — are 10% slower than DUS even though DUS makes
+    XLA flip some layouts; see docs/shallow-water.md.)
     """
     token = as_token(token)
     per_y, per_x = periodic
+    w = width
 
-    # --- x direction: full columns (corner cells ride along) ---
-    # Ghost columns are written with single-column dynamic-update-slices.
-    # (Measured on v5e: the alternatives — one minor-dim concatenate, or
-    # iota-masked jnp.where selects — are 10% slower than DUS even
-    # though DUS makes XLA flip some layouts; see docs/shallow-water.md.)
+    # --- x direction: full-height column slabs (corners ride along) ---
     west_halo, token = _axis_shift(
-        arr[:, -2], arr[:, 0], comm, "x", +1, per_x, token
+        arr[:, -2 * w : -w], arr[:, :w], comm, "x", +1, per_x, token
     )
-    arr = arr.at[:, 0].set(west_halo)
+    arr = arr.at[:, :w].set(west_halo)
     east_halo, token = _axis_shift(
-        arr[:, 1], arr[:, -1], comm, "x", -1, per_x, token
+        arr[:, w : 2 * w], arr[:, -w:], comm, "x", -1, per_x, token
     )
-    arr = arr.at[:, -1].set(east_halo)
+    arr = arr.at[:, -w:].set(east_halo)
 
-    # --- y direction: full rows (x halos already current) ---
+    # --- y direction: full-width row slabs (x halos already current) ---
     south_halo, token = _axis_shift(
-        arr[-2, :], arr[0, :], comm, "y", +1, per_y, token
+        arr[-2 * w : -w, :], arr[:w, :], comm, "y", +1, per_y, token
     )
-    arr = arr.at[0, :].set(south_halo)
+    arr = arr.at[:w, :].set(south_halo)
     north_halo, token = _axis_shift(
-        arr[1, :], arr[-1, :], comm, "y", -1, per_y, token
+        arr[w : 2 * w, :], arr[-w:, :], comm, "y", -1, per_y, token
     )
-    arr = arr.at[-1, :].set(north_halo)
+    arr = arr.at[-w:, :].set(north_halo)
 
     return arr, token

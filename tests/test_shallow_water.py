@@ -19,15 +19,15 @@ def _auto(n):
     return (jax.sharding.AxisType.Auto,) * n
 
 
-def run_h(shape, steps=15):
+def run_h(shape, steps=15, cfg=CFG):
     mesh = jax.make_mesh(shape, ("y", "x"), axis_types=_auto(2))
     comm = m.MeshComm.from_mesh(mesh)
-    st = sw.make_init(CFG, comm)()
-    st = sw.make_first_step(CFG, comm)(st)
-    st = sw.make_multistep(CFG, comm, steps)(st)
+    st = sw.make_init(cfg, comm)()
+    st = sw.make_first_step(cfg, comm)(st)
+    st = sw.make_multistep(cfg, comm, steps)(st)
 
     def g(s):
-        return sw.gather_global(s.h, comm)[None]
+        return sw.gather_global(s.h, comm, ghost=cfg.ghost)[None]
 
     G = jax.jit(
         jax.shard_map(
@@ -126,3 +126,25 @@ def test_train_step_dp_tp():
     last = float(np.asarray(loss)[0])
     assert last < 0.3 * first  # actually learns
     assert params.w1.shape == (8, 32)  # global shapes preserved
+
+
+WIDE = sw.SWConfig(ny=24, nx=48, ghost=2)
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 4), (4, 2), (2, 1)])
+def test_wide_equals_narrow(shape):
+    # the wide-halo schedule (2 exchange rounds/step) must reproduce the
+    # narrow reference schedule (12 exchanges/step) to FMA/fusion
+    # roundoff: the same arithmetic on the same values, computed
+    # redundantly in the ghost ring instead of communicated (different
+    # XLA graphs contract multiply-adds differently, so bitwise equality
+    # is not attainable; observed drift is ~3e-7 relative)
+    h_narrow = run_h(shape)
+    h_wide = run_h(shape, cfg=WIDE)
+    np.testing.assert_allclose(h_wide, h_narrow, rtol=0, atol=1e-3)
+
+
+def test_wide_decomposition_invariance():
+    h_ref = run_h((1, 1), cfg=WIDE)
+    h = run_h((2, 4), cfg=WIDE)
+    np.testing.assert_allclose(h, h_ref, atol=2e-4)
