@@ -29,3 +29,23 @@ def test_bench_entrypoint_importable():
         assert bench.best_mesh_shape(7) == (1, 7)
     finally:
         sys.path.remove(str(root))
+
+
+def _run_example(name, argv):
+    examples = pathlib.Path(__file__).resolve().parent.parent / "examples"
+    sys.path.insert(0, str(examples))
+    try:
+        import importlib
+
+        mod = importlib.import_module(name)
+        mod.main(argv)
+    finally:
+        sys.path.remove(str(examples))
+
+
+def test_dp_tp_example_runs():
+    _run_example("data_tensor_parallel", ["--steps", "25"])
+
+
+def test_long_context_example_runs():
+    _run_example("long_context", ["--seq-per-device", "32", "--causal"])
