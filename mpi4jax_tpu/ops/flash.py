@@ -1,0 +1,172 @@
+"""Pallas TPU flash attention: the blockwise-local-attention hot op.
+
+``local_attention`` (parallel/longseq.py) is the FLOPs core of both
+sequence-parallel schemes; the dense XLA form materialises the [Tq, Tk]
+score matrix in HBM.  This kernel streams K/V blocks through VMEM with
+online-softmax statistics in scratch, so scores never leave the chip —
+the standard flash-attention schedule (Dao et al. 2022) expressed in
+Pallas (see /opt/skills/guides/pallas_guide.md for the idioms used:
+sequential minormost grid dimension as the K loop, VMEM scratch carried
+across grid steps, masking via 2-D iota).
+
+Public entry: :func:`flash_attention` with the same contract as
+``local_attention`` ([B, T, H, D] operands, float32 accumulation,
+``causal`` with static block offsets).  ``interpret=True`` runs the
+kernel on CPU for tests.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+_LANES = 128  # TPU lane width: scratch statistics are (block_q, _LANES)
+
+
+def _kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    scale,
+    causal,
+    q_offset,
+    k_offset,
+    kv_len,
+    block_q,
+    block_k,
+    num_k,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+    k = k_ref[0].astype(jnp.float32)  # [bk, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * scale  # [bq, bk]
+
+    # local (unpadded-array) positions of this block's rows/cols
+    krow = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    visible = krow < kv_len  # padded K rows never contribute
+    if causal:
+        qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        visible = visible & (qpos >= k_offset + krow)
+    s = jnp.where(visible, s, _NEG)
+
+    m_prev = m_ref[:, :1]  # [bq, 1] (lanes replicated)
+    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    w = jnp.exp(s - m_new)  # [bq, bk]
+    l_ref[...] = l_ref[...] * corr + w.sum(axis=1, keepdims=True)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        w,
+        v_ref[0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ik == num_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal", "scale", "q_offset", "k_offset", "block_q", "block_k",
+        "interpret",
+    ),
+)
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal=False,
+    scale=None,
+    q_offset=0,
+    k_offset=0,
+    block_q=128,
+    block_k=128,
+    interpret=False,
+):
+    """Blockwise attention, same contract as ``local_attention``.
+
+    ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D].  Sequence lengths
+    are padded internally to the block sizes (padded K rows are masked
+    out of the softmax; padded Q rows are dropped on return).
+    ``q_offset``/``k_offset`` are the global positions of the first
+    row/column, for causal masking of sequence-sharded blocks.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+
+    block_q = min(block_q, max(tq, 8))
+    block_k = min(block_k, max(tk, 8))
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
+
+    # [B, T, H, D] -> [B*H, T, D]
+    def fold(x, pad):
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = fold(q, pad_q), fold(k, pad_k), fold(v, pad_k)
+    nq = qf.shape[1] // block_q
+    nk = kf.shape[1] // block_k
+
+    kernel = functools.partial(
+        _kernel,
+        scale=scale,
+        causal=causal,
+        q_offset=q_offset,
+        k_offset=k_offset,
+        kv_len=tk,
+        block_q=block_q,
+        block_k=block_k,
+        num_k=nk,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, nq * block_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[:, :tq, :].reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return out

@@ -41,13 +41,33 @@ __all__ = ["ring_attention", "ulysses_attention", "local_attention"]
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite mask value
 
 
-def local_attention(q, k, v, *, causal=False, scale=None, q_offset=0, k_offset=0):
-    """Dense single-device attention oracle: softmax(q k^T) v.
+def local_attention(
+    q, k, v, *, causal=False, scale=None, q_offset=0, k_offset=0, impl="auto"
+):
+    """Single-device attention: softmax(q k^T) v.
 
     ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D].  ``*_offset`` are
     the global positions of the first row/column (for causal masking of
     sharded blocks).  Accumulates in float32.
+
+    ``impl``: ``"xla"`` — dense (materialises the [Tq, Tk] scores, the
+    oracle); ``"flash"`` — the Pallas VMEM-blocked kernel
+    (ops/flash.py); ``"auto"`` — flash on TPU, dense elsewhere.
     """
+    if impl == "auto":
+        impl = (
+            "flash"
+            if jax.default_backend() not in ("cpu", "gpu")
+            and q.shape[1] >= 128
+            else "xla"
+        )
+    if impl == "flash":
+        from mpi4jax_tpu.ops.flash import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale,
+            q_offset=q_offset, k_offset=k_offset,
+        )
     d = q.shape[-1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
