@@ -5,14 +5,15 @@ sequence-parallel schemes; the dense XLA form materialises the [Tq, Tk]
 score matrix in HBM.  This kernel streams K/V blocks through VMEM with
 online-softmax statistics in scratch, so scores never leave the chip —
 the standard flash-attention schedule (Dao et al. 2022) expressed in
-Pallas (see /opt/skills/guides/pallas_guide.md for the idioms used:
-sequential minormost grid dimension as the K loop, VMEM scratch carried
-across grid steps, masking via 2-D iota).
+Pallas idioms: sequential minormost grid dimension as the K loop, VMEM
+scratch carried across grid steps, masking via 2-D iota.
 
 Public entry: :func:`flash_attention` with the same contract as
 ``local_attention`` ([B, T, H, D] operands, float32 accumulation,
 ``causal`` with static block offsets).  ``interpret=True`` runs the
-kernel on CPU for tests.
+kernel on CPU for tests.  Reverse-mode differentiable: the backward
+pass recomputes attention densely (same cost/memory as differentiating
+the dense path; the VMEM win applies to the forward).
 """
 
 import functools
@@ -26,6 +27,7 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["flash_attention"]
 
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+_INF = float("inf")
 _LANES = 128  # TPU lane width: scratch statistics are (block_q, _LANES)
 
 
@@ -67,13 +69,19 @@ def _kernel(
     krow = ik * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    visible = krow < kv_len  # padded K rows never contribute
     if causal:
         qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
-        visible = visible & (qpos >= k_offset + krow)
-    s = jnp.where(visible, s, _NEG)
+        # causally-masked REAL keys get the finite _NEG (the dense
+        # oracle's convention: a fully-masked row degrades to uniform
+        # weights over the real keys)
+        s = jnp.where(qpos >= k_offset + krow, s, _NEG)
+    # padded K rows are excluded outright (-inf): exp(-inf - m) == 0
+    # for any finite m, and m stays finite because the scratch starts
+    # at _NEG — so padding never contributes to l, matching the
+    # unpadded oracle even for fully-masked rows
+    s = jnp.where(krow < kv_len, s, -_INF)
 
     m_prev = m_ref[:, :1]  # [bq, 1] (lanes replicated)
     m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
@@ -93,13 +101,6 @@ def _kernel(
         o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "causal", "scale", "q_offset", "k_offset", "block_q", "block_k",
-        "interpret",
-    ),
-)
 def flash_attention(
     q,
     k,
@@ -120,7 +121,75 @@ def flash_attention(
     out of the softmax; padded Q rows are dropped on return).
     ``q_offset``/``k_offset`` are the global positions of the first
     row/column, for causal masking of sequence-sharded blocks.
+
+    ``scale`` and the offsets are trace-time constants (they are baked
+    into the kernel); pass Python numbers, not traced values.
     """
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else float(scale)
+    return _flash_vjp(
+        q, k, v, bool(causal), scale, int(q_offset), int(k_offset),
+        int(block_q), int(block_k), bool(interpret),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_vjp(
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+):
+    return _flash_fwd_impl(
+        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k,
+        interpret,
+    )
+
+
+def _dense_reference(q, k, v, causal, scale, q_offset, k_offset):
+    """The oracle the kernel reproduces (longseq.local_attention's math,
+    duplicated here to avoid an import cycle); used for the backward
+    pass residual-free recompute."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def _flash_fwd(
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+):
+    out = _flash_fwd_impl(
+        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k,
+        interpret,
+    )
+    return out, (q, k, v)
+
+
+def _flash_bwd(
+    causal, scale, q_offset, k_offset, block_q, block_k, interpret, res, g
+):
+    q, k, v = res
+    # dense recompute: same FLOPs/memory as differentiating the dense
+    # path — the flash forward's VMEM win is kept, gradients stay exact
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _dense_reference(
+            q_, k_, v_, causal, scale, q_offset, k_offset
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_vjp.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_fwd_impl(
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale

@@ -57,8 +57,7 @@ def local_attention(
     if impl == "auto":
         impl = (
             "flash"
-            if jax.default_backend() not in ("cpu", "gpu")
-            and q.shape[1] >= 128
+            if jax.default_backend() in ("tpu", "axon") and q.shape[1] >= 128
             else "xla"
         )
     if impl == "flash":

@@ -75,3 +75,40 @@ def test_local_attention_impl_dispatch():
         np.asarray(local_attention(q, k, v)),
         np.asarray(local_attention(q, k, v, impl="xla")),
     )
+
+
+def test_flash_fully_masked_rows_with_padding():
+    # regression: with Tk not a multiple of block_k, fully-masked rows
+    # must normalise over the REAL key count, not the padded one —
+    # padded keys are -inf (excluded), causally-masked real keys are
+    # the finite _NEG (uniform-weights convention)
+    q, k, v = _qkv(1, 64, 100, 2, 64)
+    ref = local_attention(
+        q, k, v, causal=True, q_offset=0, k_offset=512, impl="xla"
+    )
+    out = flash_attention(
+        q, k, v, causal=True, q_offset=0, k_offset=512,
+        block_q=64, block_k=64, interpret=True,
+    )
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_grad_matches_dense():
+    # flash has a custom VJP (dense recompute): grads must match the
+    # dense path — this is what keeps ulysses_attention differentiable
+    # when auto-dispatch picks the kernel on TPU
+    q, k, v = _qkv(1, 64, 64, 2, 32)
+
+    def loss_flash(q, k, v):
+        return flash_attention(
+            q, k, v, causal=True, block_q=32, block_k=32, interpret=True
+        ).sum()
+
+    def loss_dense(q, k, v):
+        return local_attention(q, k, v, causal=True, impl="xla").sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
