@@ -35,6 +35,14 @@ def main():
     comm = m.MeshComm.from_mesh(mesh)
 
     cfg = sw.SWConfig().bench_size()  # 3600 x 1800 f32
+    if n_dev > 1:
+        # multi-chip: real ICI permutes per exchange round — the
+        # single-exchange (ghost=4) schedule's 4-permutes-per-step
+        # minimum wins; single-chip permutes are elided, so ghost=2's
+        # lighter masking wins there (see SWConfig.ghost)
+        from dataclasses import replace
+
+        cfg = replace(cfg, ghost=4)
     cells = cfg.ny * cfg.nx
 
     init = sw.make_init(cfg, comm)
@@ -53,18 +61,24 @@ def main():
     state = multi(state)
     sync(state)
 
-    # calibrate: one synced call, then size a >=3s timed batch
+    # calibrate: one synced call, then size >=2s timed batches; report
+    # the median of 3 batches (the tunnelled TPU shows ~±25% run-to-run
+    # noise from co-tenants; median is robust to a slow outlier without
+    # inflating the metric to peak-of-N)
     t0 = time.perf_counter()
     state = multi(state)
     sync(state)
     per_call = max(time.perf_counter() - t0, 1e-3)
-    calls = max(4, min(400, int(3.0 / per_call)))
+    calls = max(4, min(400, int(2.0 / per_call)))
 
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        state = multi(state)
-    sync(state)
-    elapsed = time.perf_counter() - t0
+    batches = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            state = multi(state)
+        sync(state)
+        batches.append(time.perf_counter() - t0)
+    elapsed = sorted(batches)[1]
     total_steps = calls * steps_per_call
 
     assert np.isfinite(np.asarray(jax.device_get(state.h))).all(), "diverged"

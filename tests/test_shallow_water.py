@@ -148,3 +148,21 @@ def test_wide_decomposition_invariance():
     h_ref = run_h((1, 1), cfg=WIDE)
     h = run_h((2, 4), cfg=WIDE)
     np.testing.assert_allclose(h, h_ref, atol=2e-4)
+
+
+WIDE4 = sw.SWConfig(ny=24, nx=48, ghost=4)
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 4), (4, 2), (2, 1)])
+def test_wide4_equals_narrow(shape):
+    # single-exchange schedule (1 batched round/step, viscosity fused
+    # into the local recompute) vs the narrow reference schedule
+    h_narrow = run_h(shape)
+    h_wide4 = run_h(shape, cfg=WIDE4)
+    np.testing.assert_allclose(h_wide4, h_narrow, rtol=0, atol=1e-3)
+
+
+def test_wide4_decomposition_invariance():
+    h_ref = run_h((1, 1), cfg=WIDE4)
+    h = run_h((2, 4), cfg=WIDE4)
+    np.testing.assert_allclose(h, h_ref, atol=2e-4)

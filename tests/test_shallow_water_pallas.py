@@ -1,0 +1,116 @@
+"""The fused Pallas step must reproduce the XLA wide-halo schedule
+exactly (to float32 roundoff) — on a 2-D decomposition with walls,
+periodic x, multiple tiles per device, and across multiple AB2 steps.
+Runs in interpret mode on the virtual CPU mesh (the same kernels run
+compiled on TPU; tests/conftest.py pins the CPU platform)."""
+
+import jax
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.models import shallow_water as sw
+from mpi4jax_tpu.models import sw_step_pallas as swp
+
+
+def _run_pair(cfg, comm, n_steps, block_rows):
+    init = sw.make_init(cfg, comm)
+    first = sw.make_first_step(cfg, comm)
+    multi = sw.make_multistep(cfg, comm, n_steps)
+    state_x = multi(first(init()))
+
+    firstp = swp.make_first_step_pallas(
+        cfg, comm, block_rows=block_rows, interpret=True
+    )
+    multip = swp.make_multistep_pallas(
+        cfg, comm, n_steps, block_rows=block_rows, interpret=True
+    )
+    state_p = multip(firstp(init()))
+    return state_x, state_p
+
+
+def _crop_all(state, comm):
+    """Per-device interior of every field (ghost values differ by design:
+    the pallas path clamps h's wall ghosts; tendencies differ in layout)."""
+    G = swp.G
+
+    def local(state):
+        def crop(a):
+            return a[G:-G, G:-G] if a.shape == state.h.shape else a
+
+        return sw.SWState(*(crop(f) for f in state))
+
+    specs = sw._mesh_specs(comm)
+    return jax.jit(
+        jax.shard_map(local, mesh=comm.mesh, in_specs=(specs,),
+                      out_specs=specs)
+    )(state)
+
+
+def _assert_state_close(state_x, state_p, comm, tol=2e-4, tend_tol=None):
+    state_p = _crop_all(state_p, comm)
+    state_x = _crop_all(state_x, comm)
+    for name, a, b in zip(state_x._fields, state_x, state_p):
+        a, b = np.asarray(a), np.asarray(b)
+        if name == "dv":
+            # the stored dv at the north-wall row is computed from h's
+            # wall ghost rows, which the two paths treat differently
+            # (stale vs clamped); it never reaches v (the wall condition
+            # zeroes that row every step), so it is excluded here
+            a, b = a[:-1], b[:-1]
+        this_tol = tend_tol if (tend_tol and name in ("dh", "du", "dv")) else tol
+        scale = max(np.abs(a).max(), 1e-30)
+        assert np.allclose(a, b, rtol=this_tol, atol=this_tol * scale), (
+            name,
+            np.abs(a - b).max(),
+            scale,
+        )
+
+
+@pytest.mark.parametrize("block_rows", [8, 64])
+def test_pallas_matches_wide_2d(comm2d, block_rows):
+    # 2x4 mesh; 24 local rows -> 3 tiles at block_rows=8
+    cfg = sw.SWConfig(ny=48, nx=64, ghost=2)
+    state_x, state_p = _run_pair(cfg, comm2d, 4, block_rows)
+    _assert_state_close(state_x, state_p, comm2d)
+
+
+def test_pallas_matches_wide_1d_tall():
+    # 8x1 mesh row decomposition exercises wall tiles top and bottom
+    mesh = jax.make_mesh(
+        (8, 1), ("y", "x"), axis_types=(jax.sharding.AxisType.Auto,) * 2
+    )
+    comm = m.MeshComm.from_mesh(mesh)
+    cfg = sw.SWConfig(ny=64, nx=48, ghost=2)
+    state_x, state_p = _run_pair(cfg, comm, 3, 8)
+    _assert_state_close(state_x, state_p, comm)
+
+
+def test_pallas_single_device():
+    mesh = jax.make_mesh(
+        (1, 1), ("y", "x"), axis_types=(jax.sharding.AxisType.Auto,) * 2
+    )
+    comm = m.MeshComm.from_mesh(mesh)
+    cfg = sw.SWConfig(ny=40, nx=32, ghost=2)
+    state_x, state_p = _run_pair(cfg, comm, 3, 16)
+    _assert_state_close(state_x, state_p, comm)
+
+
+def test_pallas_single_step_tight(comm2d):
+    # one bootstrap step, no roundoff accumulation: must agree to ~ulp
+    cfg = sw.SWConfig(ny=48, nx=64, ghost=2)
+    init = sw.make_init(cfg, comm2d)
+    s0 = init()
+    sx = sw.make_first_step(cfg, comm2d)(s0)
+    sp = swp.make_first_step_pallas(
+        cfg, comm2d, block_rows=8, interpret=True
+    )(s0)
+    # tendencies are tiny flux-difference cancellations: their roundoff
+    # floor is ~ulp of the pre-cancellation flux scale, so they get a
+    # looser relative tolerance
+    _assert_state_close(sx, sp, comm2d, tol=1e-6, tend_tol=1e-4)
+
+
+def test_pallas_supported_gates(comm2d):
+    assert swp.pallas_supported(sw.SWConfig(ny=48, nx=64, ghost=2), comm2d)
+    assert not swp.pallas_supported(sw.SWConfig(ny=48, nx=64, ghost=1), comm2d)
