@@ -44,6 +44,18 @@ def main(argv=None):
         help="run on virtual CPU devices (honours "
         "--xla_force_host_platform_device_count in XLA_FLAGS)",
     )
+    p.add_argument(
+        "--plot",
+        metavar="FILE.png",
+        help="save the final surface-height anomaly (the reference "
+        "gathers to rank 0 and plots, shallow_water.py:586-599 there)",
+    )
+    p.add_argument(
+        "--animate",
+        metavar="FILE.gif",
+        help="collect one frame per multistep chunk and save an "
+        "animation (the reference's matplotlib animation output)",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -78,7 +90,31 @@ def main(argv=None):
         file=sys.stderr,
     )
 
-    solve = sw.make_solver(cfg, comm, num_multisteps=args.multistep)
+    gather = None
+    if args.plot or args.animate:
+        import matplotlib  # fail in ms, not after the whole run  # noqa: F401
+
+        specs = sw._mesh_specs(comm)
+        gather = jax.jit(
+            jax.shard_map(
+                lambda s: sw.gather_global(s.h, comm, ghost=cfg.ghost)[None],
+                mesh=mesh,
+                in_specs=(specs,),
+                out_specs=jax.P(("y", "x"), None, None),
+            )
+        )
+
+    frames = []
+    on_chunk = None
+    if args.animate:
+        # frame collection rides the solver's chunk callback (timing
+        # then includes the gathers — not comparable to --benchmark)
+        def on_chunk(state, t):
+            frames.append(np.asarray(jax.device_get(gather(state)))[0])
+
+    solve = sw.make_solver(
+        cfg, comm, num_multisteps=args.multistep, on_chunk=on_chunk
+    )
     state, wall, steps = solve(days * sw.DAY_IN_SECONDS)
 
     h_local = np.asarray(jax.device_get(state.h))
@@ -93,6 +129,52 @@ def main(argv=None):
     )
     if args.check:
         print("check passed: solution finite", file=sys.stderr)
+
+    if args.plot or args.animate:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        def anomaly(h):
+            return h - cfg.depth
+
+        if args.plot:
+            fig, ax = plt.subplots(figsize=(8, 4))
+            hg = np.asarray(jax.device_get(gather(state)))[0]
+            im = ax.imshow(anomaly(hg), origin="lower", cmap="RdBu_r")
+            fig.colorbar(im, ax=ax, label="surface height anomaly [m]")
+            ax.set_title(f"shallow water, {days} model days")
+            fig.savefig(args.plot, dpi=120, bbox_inches="tight")
+            print(f"saved {args.plot}", file=sys.stderr)
+        if args.animate and not frames:
+            print(
+                "no frames collected (run shorter than one multistep "
+                "chunk) — no animation written",
+                file=sys.stderr,
+            )
+        if args.animate and frames:
+            from matplotlib import animation
+
+            fig, ax = plt.subplots(figsize=(8, 4))
+            im = ax.imshow(
+                anomaly(frames[0]), origin="lower", cmap="RdBu_r",
+                animated=True,
+            )
+            fig.colorbar(im, ax=ax, label="surface height anomaly [m]")
+
+            def update(i):
+                im.set_array(anomaly(frames[i]))
+                return (im,)
+
+            ani = animation.FuncAnimation(
+                fig, update, frames=len(frames), interval=80, blit=True
+            )
+            ani.save(args.animate, writer=animation.PillowWriter(fps=12))
+            print(
+                f"saved {args.animate} ({len(frames)} frames)",
+                file=sys.stderr,
+            )
     return rate
 
 

@@ -68,7 +68,16 @@ from mpi4jax_tpu.parallel import (
     set_default_comm,
 )
 
-__version__ = "0.1.0"
+def __getattr__(name):
+    # lazy: version resolution may shell out to git (checkout installs);
+    # don't pay that — or import anything — at package-import time
+    if name == "__version__":
+        from mpi4jax_tpu._version import get_version
+
+        version = get_version()
+        globals()["__version__"] = version
+        return version
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def has_tpu_support():

@@ -1,0 +1,50 @@
+"""Version resolution (the reference vendors versioneer for
+git-tag-derived versions, mpi4jax/_version.py + versioneer.py — SURVEY
+§2.1 #28; this is the same capability in ~40 lines on modern tooling).
+
+Resolution order:
+
+1. ``git describe`` when running from a checkout (a ``.git`` exists
+   next to the package) — tag-derived with commit distance and hash,
+   versioneer-style: ``0.1.0+12.gabc1234``; checked *first* so a stale
+   installed copy can't shadow the checkout's real version,
+2. installed package metadata,
+3. the static fallback (also what sdist-without-git builds get).
+"""
+
+import subprocess
+from pathlib import Path
+
+_FALLBACK = "0.1.0"
+
+
+def get_version():
+    root = Path(__file__).resolve().parent.parent
+    try:
+        if not (root / ".git").exists():
+            raise OSError("not a checkout")
+
+        def git(*args):
+            out = subprocess.run(
+                ["git", *args], cwd=root, capture_output=True, text=True,
+                timeout=5,
+            )
+            return out.stdout.strip() if out.returncode == 0 else ""
+
+        desc = git("describe", "--tags", "--dirty")  # fails without tags
+        if desc:
+            if desc.startswith("v"):
+                desc = desc[1:]
+            return desc.replace("-", "+", 1).replace("-", ".")
+        sha = git("rev-parse", "--short", "HEAD")
+        if sha:
+            return f"{_FALLBACK}+g{sha}"
+    except Exception:
+        pass
+    try:
+        from importlib.metadata import version
+
+        return version("mpi4jax_tpu")
+    except Exception:
+        pass
+    return _FALLBACK

@@ -743,12 +743,18 @@ def make_first_step(cfg, comm):
     )
 
 
-def make_solver(cfg, comm, num_multisteps=10):
+def make_solver(cfg, comm, num_multisteps=10, on_chunk=None):
     """Full driver: init → bootstrap step → repeated jitted multisteps.
 
     Returns ``solve(t1_seconds) -> (state, wall_seconds, n_steps)`` where
     wall time covers only the post-compile hot loop, matching the
     reference's benchmark methodology (shallow_water.py:450-470).
+
+    ``on_chunk(state, t_seconds)``, if given, is called after every
+    multistep chunk (including the warm-up one) — e.g. to collect
+    animation frames, as the reference's plotting loop does
+    (shallow_water.py:586-599 there).  Callback time is included in the
+    wall clock, so don't combine with benchmark timing.
     """
     import time
 
@@ -769,6 +775,8 @@ def make_solver(cfg, comm, num_multisteps=10):
         state = multi(state)
         t += cfg.dt * num_multisteps
         sync(state)
+        if on_chunk is not None:
+            on_chunk(state, t)
         steps = 0
         start = time.perf_counter()
         # always time at least one multistep, even if the warm-up call
@@ -777,6 +785,8 @@ def make_solver(cfg, comm, num_multisteps=10):
             state = multi(state)
             t += cfg.dt * num_multisteps
             steps += num_multisteps
+            if on_chunk is not None:
+                on_chunk(state, t)
         sync(state)
         wall = time.perf_counter() - start
         return state, wall, steps

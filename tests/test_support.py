@@ -1,0 +1,43 @@
+"""Support-code tests: capability probes, drain/flush, versioning —
+the counterparts of the reference's tests/test_has_cuda.py and
+tests/test_flush.py plus a version-shape check (versioneer analog)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m
+
+
+def test_capability_probes():
+    # on the CPU test platform: no TPU, and CUDA is never supported here
+    assert m.has_cuda_support() is False
+    assert m.has_tpu_support() in (True, False)
+    assert m.has_tpu_support() is False  # conftest pins jax_platforms=cpu
+
+
+def test_version_shape():
+    # PEP-440-ish: starts with digits, dot-separated (git-describe local
+    # parts allowed after '+')
+    assert re.match(r"^\d+\.\d+", m.__version__), m.__version__
+
+
+def test_drain_blocks_and_returns_scalar():
+    from mpi4jax_tpu.utils.runtime import drain
+
+    x = jnp.arange(16.0).reshape(4, 4) * 2
+    out = drain(x)
+    assert np.asarray(out) == 0.0  # first element
+    s = drain(jnp.float32(7))
+    assert np.asarray(s) == 7.0
+
+
+def test_drain_after_collective(comm1d):
+    from mpi4jax_tpu.utils.runtime import drain
+    from tests.helpers import spmd_jit
+
+    f = spmd_jit(comm1d, lambda x: m.allreduce(x, m.SUM, comm=comm1d)[0])
+    out = f(jnp.arange(8.0))
+    assert drain(out) == 28.0
