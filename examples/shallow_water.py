@@ -110,7 +110,9 @@ def main(argv=None):
         # frame collection rides the solver's chunk callback (timing
         # then includes the gathers — not comparable to --benchmark)
         def on_chunk(state, t):
-            frames.append(np.asarray(jax.device_get(gather(state)))[0])
+            # index on device: gather() is (n_dev, ny, nx) replicated
+            # over axis 0 — pull one global copy, not n_dev of them
+            frames.append(np.asarray(jax.device_get(gather(state)[0])))
 
     solve = sw.make_solver(
         cfg, comm, num_multisteps=args.multistep, on_chunk=on_chunk
@@ -141,7 +143,7 @@ def main(argv=None):
 
         if args.plot:
             fig, ax = plt.subplots(figsize=(8, 4))
-            hg = np.asarray(jax.device_get(gather(state)))[0]
+            hg = np.asarray(jax.device_get(gather(state)[0]))
             im = ax.imshow(anomaly(hg), origin="lower", cmap="RdBu_r")
             fig.colorbar(im, ax=ax, label="surface height anomaly [m]")
             ax.set_title(f"shallow water, {days} model days")

@@ -15,7 +15,20 @@ Resolution order:
 import subprocess
 from pathlib import Path
 
-_FALLBACK = "0.1.0"
+def _fallback():
+    """Static fallback, read from pyproject.toml when present (sdists
+    carry it) so the release number lives in exactly one place."""
+    try:
+        import tomllib
+
+        pp = Path(__file__).resolve().parent.parent / "pyproject.toml"
+        with open(pp, "rb") as f:
+            return tomllib.load(f)["project"]["version"]
+    except Exception:
+        return "0.1.0"
+
+
+_FALLBACK = _fallback()
 
 
 def get_version():
@@ -31,7 +44,11 @@ def get_version():
             )
             return out.stdout.strip() if out.returncode == 0 else ""
 
-        desc = git("describe", "--tags", "--dirty")  # fails without tags
+        # only version-shaped tags (a stray non-version tag must not
+        # leak into __version__ — versioneer's tag-prefix guard)
+        desc = git("describe", "--tags", "--dirty", "--match", "v[0-9]*")
+        if not desc:
+            desc = git("describe", "--tags", "--dirty", "--match", "[0-9]*")
         if desc:
             if desc.startswith("v"):
                 desc = desc[1:]

@@ -1,0 +1,162 @@
+"""Drop-in call-surface compatibility with the reference (mpi4jax +
+mpi4py).
+
+A user of the reference writes (README.rst:61-80 there):
+
+    from mpi4py import MPI
+    import mpi4jax
+
+    comm = MPI.COMM_WORLD
+    rank = comm.Get_rank()
+    res, token = mpi4jax.allreduce(x, op=MPI.SUM, comm=comm)
+
+The same program runs here with only the imports changed:
+
+    from mpi4jax_tpu import compat as mpi4jax
+    from mpi4jax_tpu.compat import MPI
+
+``MPI`` exposes the reduction operators (``SUM``, ``PROD``, ... — these
+ARE :class:`mpi4jax_tpu.Op` objects, no translation layer),
+``ANY_SOURCE`` / ``ANY_TAG`` / ``Status``, and ``COMM_WORLD`` — a lazy
+proxy over :func:`mpi4jax_tpu.get_default_comm` with mpi4py-style
+methods (``Get_rank``, ``Get_size``, ``Clone``, ``Split``).  The module
+itself re-exports the twelve communication functions with the
+reference's exact signatures (they already match — e.g.
+``allreduce(x, op, *, comm=None, token=None)`` mirrors
+mpi4jax/_src/collective_ops/allreduce.py:36-66) plus
+``has_cuda_support``.
+
+On the multi-process backend (``python -m mpi4jax_tpu.launch -np 4``)
+``Get_rank()`` is a Python int and per-rank control flow works exactly
+as in the reference's MPMD model.  On the mesh backend ``Get_rank()``
+is a traced value inside ``shard_map`` (SPMD — see docs/usage.md).
+"""
+
+import functools as _functools
+
+import mpi4jax_tpu as _m
+
+__all__ = [
+    "MPI",
+    "allgather",
+    "allreduce",
+    "alltoall",
+    "barrier",
+    "bcast",
+    "gather",
+    "recv",
+    "reduce",
+    "scan",
+    "scatter",
+    "send",
+    "sendrecv",
+    "has_cuda_support",
+    "create_token",
+]
+
+
+class _CommProxy:
+    """mpi4py-flavoured view of an mpi4jax_tpu communicator."""
+
+    def __init__(self, comm=None):
+        self._comm = comm
+
+    def _resolve(self):
+        return self._comm if self._comm is not None else _m.get_default_comm()
+
+    # mpi4py surface
+    def Get_rank(self):
+        return self._resolve().rank()
+
+    def Get_size(self):
+        return self._resolve().size
+
+    def Clone(self):
+        return _CommProxy(self._resolve().clone())
+
+    def Split(self, color=0, key=0):
+        """mpi4py-style Split.
+
+        On the static backends the arguments follow this library's split
+        contract: functions of rank or explicit per-rank sequences (one
+        SPMD/static program must derive the partition identically
+        everywhere); plain ints (every rank same color — a clone-like
+        split) are accepted too.
+        """
+        comm = self._resolve()
+        if isinstance(color, int):
+            if comm.backend == "proc" and comm.size > 1:
+                # mpi4py's per-process scalar color cannot be inferred
+                # here (each process would see only its own value and
+                # silently build the wrong group) — demand the static form
+                raise ValueError(
+                    "Split(color) with a per-rank scalar is ambiguous on "
+                    "the multi-process backend: every process must "
+                    "derive the full partition. Pass a function of rank "
+                    "or a length-size sequence, e.g. "
+                    "Split(lambda r: r % 2)."
+                )
+            color = [color] * comm.size
+        if isinstance(key, int):
+            key = None
+        out = comm.split(color, key)
+        return _CommProxy(out) if out is not None else None
+
+    def __repr__(self):
+        return f"compat.Comm({self._resolve()!r})"
+
+
+def _unwrap(comm):
+    return comm._resolve() if isinstance(comm, _CommProxy) else comm
+
+
+class _MPINamespace:
+    """Stand-in for ``from mpi4py import MPI`` (operators, constants,
+    Status, COMM_WORLD)."""
+
+    SUM = _m.SUM
+    PROD = _m.PROD
+    MIN = _m.MIN
+    MAX = _m.MAX
+    LAND = _m.LAND
+    LOR = _m.LOR
+    LXOR = _m.LXOR
+    BAND = _m.BAND
+    BOR = _m.BOR
+    BXOR = _m.BXOR
+    ANY_SOURCE = _m.ANY_SOURCE
+    ANY_TAG = _m.ANY_TAG
+    Status = _m.Status
+    COMM_WORLD = _CommProxy()
+
+    Op = _m.Op
+
+    def __repr__(self):
+        return "<mpi4jax_tpu.compat.MPI>"
+
+
+MPI = _MPINamespace()
+
+
+def _wrap(fn):
+    @_functools.wraps(fn)
+    def wrapper(*args, comm=None, **kwargs):
+        return fn(*args, comm=_unwrap(comm), **kwargs)
+
+    return wrapper
+
+
+allgather = _wrap(_m.allgather)
+allreduce = _wrap(_m.allreduce)
+alltoall = _wrap(_m.alltoall)
+barrier = _wrap(_m.barrier)
+bcast = _wrap(_m.bcast)
+gather = _wrap(_m.gather)
+recv = _wrap(_m.recv)
+reduce = _wrap(_m.reduce)
+scan = _wrap(_m.scan)
+scatter = _wrap(_m.scatter)
+send = _wrap(_m.send)
+sendrecv = _wrap(_m.sendrecv)
+create_token = _m.create_token
+has_cuda_support = _m.has_cuda_support

@@ -82,6 +82,16 @@ class SelfComm(Comm):
     def clone(self):
         return SelfComm(context=next(_context_counter))
 
+    def split(self, color, key=None):
+        """MPI_Comm_split on a size-1 world: the only member keeps a
+        size-1 communicator (None color -> MPI_COMM_NULL -> None)."""
+        colors = [color(0)] if callable(color) else (
+            [color] if isinstance(color, int) or color is None else list(color)
+        )
+        if colors and colors[0] is None:
+            return None
+        return self.clone()
+
 
 @dataclass(frozen=True)
 class MeshComm(Comm):

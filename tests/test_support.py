@@ -14,7 +14,6 @@ import mpi4jax_tpu as m
 def test_capability_probes():
     # on the CPU test platform: no TPU, and CUDA is never supported here
     assert m.has_cuda_support() is False
-    assert m.has_tpu_support() in (True, False)
     assert m.has_tpu_support() is False  # conftest pins jax_platforms=cpu
 
 
@@ -27,9 +26,9 @@ def test_version_shape():
 def test_drain_blocks_and_returns_scalar():
     from mpi4jax_tpu.utils.runtime import drain
 
-    x = jnp.arange(16.0).reshape(4, 4) * 2
+    x = (jnp.arange(16.0) + 1).reshape(4, 4) * 2
     out = drain(x)
-    assert np.asarray(out) == 0.0  # first element
+    assert np.asarray(out) == 2.0  # first element (nonzero on purpose)
     s = drain(jnp.float32(7))
     assert np.asarray(s) == 7.0
 
