@@ -14,8 +14,12 @@ Prints one JSON line per (op, size).
 
 import argparse
 import json
+import pathlib
 import sys
 import time
+
+# allow running straight from a checkout
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def main(argv=None):

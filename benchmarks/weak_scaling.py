@@ -1,0 +1,100 @@
+"""Weak-scaling harness for the flagship solver (the BASELINE north
+star: unmodified shallow-water on a pod at >90% weak-scaling efficiency
+vs one chip).
+
+Scales the domain with the device count (fixed cells per device), runs
+the solver over 1, 2, 4, ... all devices, and reports per-device
+throughput plus efficiency vs the 1-device run.  Use on real multi-chip
+hardware; on a virtual CPU mesh the numbers validate the harness, not
+the machine (all "devices" share one host's cores).
+
+    python benchmarks/weak_scaling.py [--cells-per-dev-k 1620] [--steps 50]
+
+Prints one JSON line per device count.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--cells-per-dev-k",
+        type=float,
+        default=6480,
+        help="thousands of cells per device (default: the published "
+        "benchmark domain on one device)",
+    )
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--ghost", type=int, default=None,
+                   help="ghost width (default: 2 for 1 device, 4 beyond)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.models import shallow_water as sw
+    from mpi4jax_tpu.utils.runtime import best_mesh_shape, drain
+
+    all_devices = jax.devices()
+    counts = []
+    n = 1
+    while n <= len(all_devices):
+        counts.append(n)
+        n *= 2
+    if counts[-1] != len(all_devices):
+        counts.append(len(all_devices))
+
+    base_rate = None
+    for n in counts:
+        py, px = best_mesh_shape(n)
+        # fixed cells per device; keep the aspect ratio ~2:1 like the
+        # published domain, rounded to multiples of the mesh
+        cells = args.cells_per_dev_k * 1e3 * n
+        ny = int((cells / 2) ** 0.5 // py) * py
+        nx = int(cells / max(ny, 1) // px) * px
+        ghost = args.ghost if args.ghost is not None else (2 if n == 1 else 4)
+        cfg = sw.SWConfig(ny=ny, nx=nx, ghost=ghost)
+        mesh = jax.make_mesh(
+            (py, px), ("y", "x"),
+            axis_types=(jax.sharding.AxisType.Auto,) * 2,
+            devices=all_devices[:n],
+        )
+        comm = m.MeshComm.from_mesh(mesh)
+        init = sw.make_init(cfg, comm)
+        first = sw.make_first_step(cfg, comm)
+        multi = sw.make_multistep(cfg, comm, args.steps)
+        s = first(init())
+        s = multi(s)
+        drain(s.h)
+        t0 = time.perf_counter()
+        s = multi(s)
+        drain(s.h)
+        dt = time.perf_counter() - t0
+        rate = ny * nx * args.steps / dt
+        per_dev = rate / n
+        if base_rate is None:
+            base_rate = per_dev
+        print(
+            json.dumps(
+                {
+                    "metric": "shallow_water_weak_scaling",
+                    "devices": n,
+                    "grid": [ny, nx],
+                    "ghost": ghost,
+                    "cell_updates_per_sec_per_dev": round(per_dev, 1),
+                    "efficiency_vs_1dev": round(per_dev / base_rate, 4),
+                }
+            )
+        )
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

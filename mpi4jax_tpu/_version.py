@@ -18,14 +18,25 @@ from pathlib import Path
 def _fallback():
     """Static fallback, read from pyproject.toml when present (sdists
     carry it) so the release number lives in exactly one place."""
+    pp = Path(__file__).resolve().parent.parent / "pyproject.toml"
     try:
         import tomllib
 
-        pp = Path(__file__).resolve().parent.parent / "pyproject.toml"
         with open(pp, "rb") as f:
             return tomllib.load(f)["project"]["version"]
     except Exception:
-        return "0.1.0"
+        pass
+    try:  # Python 3.10: no tomllib — a one-key regex suffices here
+        import re
+
+        m = re.search(
+            r'^version\s*=\s*"([^"]+)"', pp.read_text(), re.MULTILINE
+        )
+        if m:
+            return m.group(1)
+    except Exception:
+        pass
+    return "0.1.0"
 
 
 _FALLBACK = _fallback()

@@ -98,7 +98,15 @@ class _CommProxy:
                 )
             color = [color] * comm.size
         if isinstance(key, int):
-            key = None
+            if key != 0 and comm.backend == "proc" and comm.size > 1:
+                # same ambiguity as scalar colors: each process would see
+                # only its own key value
+                raise ValueError(
+                    "Split(..., key=<per-rank scalar>) is ambiguous on "
+                    "the multi-process backend; pass a function of rank "
+                    "or a length-size sequence."
+                )
+            key = None  # uniform key == default (rank) ordering
         out = comm.split(color, key)
         return _CommProxy(out) if out is not None else None
 
@@ -145,6 +153,9 @@ def _wrap(fn):
 
     return wrapper
 
+
+# the reference's experimental namespace (auto_tokenize) rides along
+from mpi4jax_tpu import experimental  # noqa: E402,F401
 
 allgather = _wrap(_m.allgather)
 allreduce = _wrap(_m.allreduce)

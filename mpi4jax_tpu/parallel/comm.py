@@ -88,7 +88,11 @@ class SelfComm(Comm):
         colors = [color(0)] if callable(color) else (
             [color] if isinstance(color, int) or color is None else list(color)
         )
-        if colors and colors[0] is None:
+        if len(colors) != 1:
+            raise ValueError(
+                f"color must cover all 1 ranks, got {len(colors)}"
+            )
+        if colors[0] is None:
             return None
         return self.clone()
 
