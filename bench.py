@@ -34,41 +34,54 @@ def main():
     )
     comm = m.MeshComm.from_mesh(mesh)
 
-    cfg = sw.SWConfig().bench_size()  # 3600 x 1800 f32
-    if n_dev > 1:
-        # multi-chip: real ICI permutes per exchange round — the
-        # single-exchange (ghost=4) schedule's 4-permutes-per-step
-        # minimum wins; single-chip permutes are elided, so ghost=2's
-        # lighter masking wins there (see SWConfig.ghost)
-        from dataclasses import replace
-
-        cfg = replace(cfg, ghost=4)
-    cells = cfg.ny * cfg.nx
-
-    init = sw.make_init(cfg, comm)
-    first = sw.make_first_step(cfg, comm)
-    steps_per_call = 25
-    multi = sw.make_multistep(cfg, comm, steps_per_call)
-
     import numpy as np
 
     def sync(s):
         return drain(s.h)
 
-    state = init()
-    state = first(state)
-    # warm-up / compile
-    state = multi(state)
-    sync(state)
+    steps_per_call = 25
 
-    # calibrate: one synced call, then size >=2s timed batches; report
-    # the median of 3 batches (the tunnelled TPU shows ~±25% run-to-run
+    # schedule autotune: the wide-halo (ghost=2) and single-exchange
+    # (ghost=4) schedules are numerically identical but trade
+    # exchange-round count against masking work — which wins depends on
+    # whether permutes are real (multi-chip ICI) or elided (one chip)
+    # and on the runtime's dispatch cost. Measure one multistep call of
+    # each and keep the faster (compile time excluded).
+    from dataclasses import replace
+
+    base = sw.SWConfig().bench_size()  # 3600 x 1800 f32
+    candidates = {}
+    for ghost in (2, 4):
+        cfg_g = replace(base, ghost=ghost)
+        init = sw.make_init(cfg_g, comm)
+        first = sw.make_first_step(cfg_g, comm)
+        multi = sw.make_multistep(cfg_g, comm, steps_per_call)
+        state = first(init())
+        state = multi(state)  # compile + warm
+        sync(state)
+        best = float("inf")
+        for _ in range(2):  # min of 2: robust to a co-tenant spike
+            t0 = time.perf_counter()
+            state = multi(state)
+            sync(state)
+            best = min(best, time.perf_counter() - t0)
+        candidates[ghost] = (best, cfg_g, multi, state)
+        print(
+            f"[bench] ghost={ghost}: {best * 1e3:.1f} ms "
+            f"per {steps_per_call} steps",
+            file=sys.stderr,
+        )
+
+    ghost = min(candidates, key=lambda g: candidates[g][0])
+    _, cfg, multi, state = candidates.pop(ghost)
+    candidates.clear()  # free the losing schedule's state before timing
+    cells = cfg.ny * cfg.nx
+
+    # size >=2s timed batches from the autotune measurement; report the
+    # median of 3 batches (the tunnelled TPU shows ~±25% run-to-run
     # noise from co-tenants; median is robust to a slow outlier without
     # inflating the metric to peak-of-N)
-    t0 = time.perf_counter()
-    state = multi(state)
-    sync(state)
-    per_call = max(time.perf_counter() - t0, 1e-3)
+    per_call = max(candidates[ghost][0], 1e-3)
     calls = max(4, min(400, int(2.0 / per_call)))
 
     batches = []

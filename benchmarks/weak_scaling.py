@@ -32,8 +32,11 @@ def main(argv=None):
         "benchmark domain on one device)",
     )
     p.add_argument("--steps", type=int, default=50)
-    p.add_argument("--ghost", type=int, default=None,
-                   help="ghost width (default: 2 for 1 device, 4 beyond)")
+    p.add_argument(
+        "--ghost", type=int, default=2,
+        help="halo schedule, held FIXED across device counts so the "
+        "efficiency ratio measures scaling, not schedule choice",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -59,7 +62,7 @@ def main(argv=None):
         cells = args.cells_per_dev_k * 1e3 * n
         ny = int((cells / 2) ** 0.5 // py) * py
         nx = int(cells / max(ny, 1) // px) * px
-        ghost = args.ghost if args.ghost is not None else (2 if n == 1 else 4)
+        ghost = args.ghost
         cfg = sw.SWConfig(ny=ny, nx=nx, ghost=ghost)
         mesh = jax.make_mesh(
             (py, px), ("y", "x"),

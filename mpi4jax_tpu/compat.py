@@ -74,7 +74,7 @@ class _CommProxy:
     def Clone(self):
         return _CommProxy(self._resolve().clone())
 
-    def Split(self, color=0, key=0):
+    def Split(self, color=0, key=None):
         """mpi4py-style Split.
 
         On the static backends the arguments follow this library's split
@@ -98,13 +98,17 @@ class _CommProxy:
                 )
             color = [color] * comm.size
         if isinstance(key, int):
-            if key != 0 and comm.backend == "proc" and comm.size > 1:
-                # same ambiguity as scalar colors: each process would see
-                # only its own key value
+            if comm.backend == "proc" and comm.size > 1:
+                # same ambiguity as scalar colors — and the guard must
+                # fire identically on EVERY process (a value-dependent
+                # check would raise on some ranks and hang the rest in
+                # the collective), so any explicit scalar is rejected;
+                # omit key (the default) for rank ordering
                 raise ValueError(
                     "Split(..., key=<per-rank scalar>) is ambiguous on "
                     "the multi-process backend; pass a function of rank "
-                    "or a length-size sequence."
+                    "or a length-size sequence (or omit key for rank "
+                    "ordering)."
                 )
             key = None  # uniform key == default (rank) ordering
         out = comm.split(color, key)

@@ -47,6 +47,12 @@ def main(argv=None):
     parser = argparse.ArgumentParser(prog="mpi4jax_tpu.launch")
     parser.add_argument("-np", "--nprocs", type=int, required=False)
     parser.add_argument("--platform", default="cpu")
+    parser.add_argument(
+        "--shims",
+        action="store_true",
+        help="prepend the mpi4py/mpi4jax import shims to the workers' "
+        "PYTHONPATH (run unmodified reference programs)",
+    )
     parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("prog", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -69,6 +75,12 @@ def main(argv=None):
             T4J_COORD=coord,
             T4J_PLATFORM=args.platform,
         )
+        if args.shims:
+            from mpi4jax_tpu import shims
+
+            env["PYTHONPATH"] = shims.path() + os.pathsep + env.get(
+                "PYTHONPATH", ""
+            )
         cmd = [
             sys.executable,
             "-m",

@@ -141,6 +141,16 @@ def as_token(token):
         return Token()
     if isinstance(token, Token):
         return token
+    from jax._src import core as _jcore
+
+    if isinstance(token, getattr(_jcore, "Token", ())) or isinstance(
+        getattr(token, "aval", None), getattr(_jcore, "AbstractToken", ())
+    ):
+        # jax.lax.create_token() value (concrete or traced — the
+        # reference's idiom, shallow_water.py:165 there): an opaque
+        # ordering token with no data — ordering here rides this
+        # library's own stamp chain
+        return Token()
     if isinstance(token, jax.Array) or hasattr(token, "dtype"):
         return Token(jnp.asarray(token, jnp.float32).reshape(()) * 0)
     raise TypeError(f"cannot interpret {type(token)} as a communication token")

@@ -1,0 +1,25 @@
+"""Import shims: run unmodified reference user programs.
+
+This directory, when prepended to ``sys.path``, provides top-level
+modules named ``mpi4py`` and ``mpi4jax`` backed by
+:mod:`mpi4jax_tpu.compat` — so a program written for the reference
+stack runs without touching its imports:
+
+    python -m mpi4jax_tpu.launch --shims -np 4 their_script.py
+
+or manually:
+
+    PYTHONPATH="$(python -m mpi4jax_tpu.shims)" python their_script.py
+
+The shims are intentionally *not* importable by default: they shadow
+real packages, so they must be opted into per-process.
+"""
+
+from pathlib import Path
+
+__all__ = ["path"]
+
+
+def path():
+    """Directory to prepend to sys.path / PYTHONPATH."""
+    return str(Path(__file__).resolve().parent)

@@ -1,0 +1,5 @@
+"""Print the shim directory (for PYTHONPATH wiring in shell scripts)."""
+
+from mpi4jax_tpu.shims import path
+
+print(path())

@@ -1,0 +1,8 @@
+"""Shim satisfying ``from mpi4py import MPI`` with the compat layer's
+MPI namespace (operators, constants, Status, COMM_WORLD proxy).
+
+Only meaningful under the mpi4jax_tpu launcher (or a single process);
+see mpi4jax_tpu/shims/__init__.py.
+"""
+
+from mpi4jax_tpu.compat import MPI  # noqa: F401
