@@ -73,7 +73,7 @@ def main():
         )
 
     ghost = min(candidates, key=lambda g: candidates[g][0])
-    _, cfg, multi, state = candidates.pop(ghost)
+    tuned_per_call, cfg, multi, state = candidates.pop(ghost)
     candidates.clear()  # free the losing schedule's state before timing
     cells = cfg.ny * cfg.nx
 
@@ -81,7 +81,7 @@ def main():
     # median of 3 batches (the tunnelled TPU shows ~±25% run-to-run
     # noise from co-tenants; median is robust to a slow outlier without
     # inflating the metric to peak-of-N)
-    per_call = max(candidates[ghost][0], 1e-3)
+    per_call = max(tuned_per_call, 1e-3)
     calls = max(4, min(400, int(2.0 / per_call)))
 
     batches = []
