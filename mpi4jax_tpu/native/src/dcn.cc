@@ -6,6 +6,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -16,6 +17,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <string>
@@ -78,11 +80,27 @@ struct LogScope {
 
 // ------------------------------------------------------------- transport
 
+// Frame payload: default-initialised allocation (new[] without parens
+// does not zero) — a std::vector resize() value-initialises, which for
+// large frames adds a full memset pass per hop.
+struct Buf {
+  std::unique_ptr<uint8_t[]> p;
+  size_t n = 0;
+
+  Buf() = default;
+  explicit Buf(size_t nbytes)
+      : p(nbytes ? new uint8_t[nbytes] : nullptr), n(nbytes) {}
+
+  uint8_t* data() { return p.get(); }
+  const uint8_t* data() const { return p.get(); }
+  size_t size() const { return n; }
+};
+
 struct Frame {
   int src;
   int ctx;
   int tag;
-  std::vector<uint8_t> data;
+  Buf data;
 };
 
 struct PeerSock {
@@ -144,7 +162,7 @@ void reader_loop(int peer, int fd) {
     f.src = static_cast<int>(h.src);
     f.ctx = static_cast<int>(h.ctx);
     f.tag = static_cast<int>(h.tag) - 1;
-    f.data.resize(h.nbytes);
+    f.data = Buf(h.nbytes);
     if (h.nbytes && !read_all(fd, f.data.data(), h.nbytes))
       die("frame body read");
     {
@@ -164,8 +182,8 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
     f.src = g_rank;
     f.ctx = ctx;
     f.tag = tag;
-    f.data.assign(static_cast<const uint8_t*>(buf),
-                  static_cast<const uint8_t*>(buf) + nbytes);
+    f.data = Buf(nbytes);
+    if (nbytes) std::memcpy(f.data.data(), buf, nbytes);
     {
       std::lock_guard<std::mutex> lk(g_mail_mu);
       g_mailbox.push_back(std::move(f));
@@ -179,8 +197,20 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
                static_cast<uint32_t>(ctx), static_cast<uint32_t>(tag + 1),
                static_cast<uint64_t>(nbytes)};
   std::lock_guard<std::mutex> lk(p.send_mu);
-  write_all(p.fd, &h, sizeof(h));
-  if (nbytes) write_all(p.fd, buf, nbytes);
+  // header + body in one syscall (one TCP segment for small frames)
+  iovec iov[2] = {{&h, sizeof(h)}, {const_cast<void*>(buf), nbytes}};
+  ssize_t w = ::writev(p.fd, iov, nbytes ? 2 : 1);
+  if (w < 0) die("socket writev");
+  size_t done = static_cast<size_t>(w);
+  if (done < sizeof(h)) {
+    write_all(p.fd, reinterpret_cast<const char*>(&h) + done,
+              sizeof(h) - done);
+    done = sizeof(h);
+  }
+  size_t body_done = done - sizeof(h);
+  if (nbytes > body_done)
+    write_all(p.fd, static_cast<const char*>(buf) + body_done,
+              nbytes - body_done);
 }
 
 // Blocking matched receive from the mailbox (MPI matching semantics:
@@ -202,11 +232,46 @@ Frame raw_recv(int world_source, int ctx, int tag) {
 
 // ------------------------------------------------------------- bootstrap
 
+// Explicit SO_*BUF disables kernel receive auto-tuning and is clamped
+// by net.core.{r,w}mem_max — on stock sysctls the clamp (~416KB) would
+// be WORSE than auto-tuning. Probe once whether the kernel honours a
+// large request; only then pin buffers (before connect/listen, so the
+// TCP window scale is negotiated with the enlarged buffer in place).
+constexpr int kWantBuf = 8 << 20;
+
+bool large_bufs_supported() {
+  static const bool ok = [] {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    int bufsz = kWantBuf;
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+    int got = 0;
+    socklen_t len = sizeof(got);
+    ::getsockopt(fd, SOL_SOCKET, SO_RCVBUF, &got, &len);
+    ::close(fd);
+    return got >= kWantBuf;  // kernel reports doubled value when honoured
+  }();
+  return ok;
+}
+
+void presize_buffers(int fd) {
+  if (!large_bufs_supported()) return;  // keep kernel auto-tuning
+  int bufsz = kWantBuf;
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+}
+
+void tune_socket(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
 int tcp_listen(uint16_t* port_out) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) die("socket");
   int one = 1;
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  presize_buffers(fd);  // accepted sockets inherit
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
@@ -220,18 +285,19 @@ int tcp_listen(uint16_t* port_out) {
   return fd;
 }
 
+
 int tcp_connect(const std::string& host, uint16_t port) {
   for (int attempt = 0; attempt < 600; ++attempt) {
     int fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) die("socket");
+    presize_buffers(fd);  // before connect: window scale negotiation
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(port);
     if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
       die("inet_pton (coordinator must be an IPv4 literal)");
     if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
-      int one = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      tune_socket(fd);
       return fd;
     }
     ::close(fd);
@@ -304,8 +370,7 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
     socklen_t len = sizeof(peer);
     int fd = ::accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &len);
     if (fd < 0) die("accept (mesh)");
-    int one = 1;
-    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    tune_socket(fd);
     uint32_t who = 0;
     if (!read_all(fd, &who, sizeof(who))) die("mesh handshake");
     if (static_cast<int>(who) <= g_rank || static_cast<int>(who) >= g_size)
@@ -801,9 +866,8 @@ void scan(int comm, const void* in, void* out, size_t count, DType dt,
   if (c.my_index > 0) {
     Frame f = crecv(c, c.my_index - 1, kCollTagBase + 4);
     if (f.data.size() != nbytes) die("scan size mismatch");
-    std::vector<uint8_t> prefix(std::move(f.data));
-    combine(op, dt, in, prefix.data(), count);
-    std::memcpy(out, prefix.data(), nbytes);
+    combine(op, dt, in, f.data.data(), count);
+    std::memcpy(out, f.data.data(), nbytes);
   }
   if (c.my_index + 1 < n) csend(c, c.my_index + 1, kCollTagBase + 4, out, nbytes);
 }
