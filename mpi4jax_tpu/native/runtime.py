@@ -63,8 +63,190 @@ def _load():
     lib.t4j_comm_size.restype = ctypes.c_int
     lib.t4j_comm_size.argtypes = [ctypes.c_int32]
     lib.t4j_set_logging.argtypes = [ctypes.c_int]
+    # data plane for the host-callback tier (TPU staging path)
+    i32, u64, vp = ctypes.c_int32, ctypes.c_uint64, ctypes.c_void_p
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.t4j_c_send.argtypes = [i32, vp, u64, i32, i32]
+    lib.t4j_c_recv.argtypes = [i32, vp, u64, i32, i32, i32p, i32p]
+    lib.t4j_c_sendrecv.argtypes = [i32, vp, vp, u64, i32, i32, i32, i32,
+                                   i32p, i32p]
+    lib.t4j_c_barrier.argtypes = [i32]
+    lib.t4j_c_bcast.argtypes = [i32, vp, u64, i32]
+    lib.t4j_c_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_c_reduce.argtypes = [i32, vp, vp, u64, i32, i32, i32]
+    lib.t4j_c_scan.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_c_allgather.argtypes = [i32, vp, vp, u64]
+    lib.t4j_c_gather.argtypes = [i32, vp, vp, u64, i32]
+    lib.t4j_c_scatter.argtypes = [i32, vp, vp, u64, i32]
+    lib.t4j_c_alltoall.argtypes = [i32, vp, vp, u64]
     _state["lib"] = lib
     return lib
+
+
+# numpy dtype -> native DType enum (dcn.h; the reference's 14-entry
+# dtype table, mpi4jax/_src/utils.py:43-71, plus bf16)
+_DTYPE_CODES = {
+    "float32": 0,
+    "float64": 1,
+    "int8": 2,
+    "int16": 3,
+    "int32": 4,
+    "int64": 5,
+    "uint8": 6,
+    "uint16": 7,
+    "uint32": 8,
+    "uint64": 9,
+    "bool": 10,
+    "complex64": 11,
+    "complex128": 12,
+    "float16": 13,
+    "bfloat16": 14,
+}
+
+
+def dtype_code(np_dtype):
+    name = str(np_dtype)
+    try:
+        return _DTYPE_CODES[name]
+    except KeyError:
+        raise ValueError(f"unsupported dtype for the native bridge: {name}")
+
+
+def _ptr(arr):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def _contig(x):
+    import numpy as np
+
+    return np.ascontiguousarray(x)
+
+
+# -- numpy-level op wrappers (host-callback data plane) --------------------
+
+
+def host_allreduce(handle, x, opcode):
+    import numpy as np
+
+    x = _contig(x)
+    out = np.empty_like(x)
+    _state["lib"].t4j_c_allreduce(
+        handle, _ptr(x), _ptr(out), x.size, dtype_code(x.dtype), opcode
+    )
+    return out
+
+
+def host_reduce(handle, x, opcode, root):
+    import numpy as np
+
+    x = _contig(x)
+    out = np.empty_like(x)
+    _state["lib"].t4j_c_reduce(
+        handle, _ptr(x), _ptr(out), x.size, dtype_code(x.dtype), opcode, root
+    )
+    if _state["lib"].t4j_comm_rank(handle) != root:
+        return x  # off-root output is the input passthrough (wrapper contract)
+    return out
+
+
+def host_scan(handle, x, opcode):
+    import numpy as np
+
+    x = _contig(x)
+    out = np.empty_like(x)
+    _state["lib"].t4j_c_scan(
+        handle, _ptr(x), _ptr(out), x.size, dtype_code(x.dtype), opcode
+    )
+    return out
+
+
+def host_barrier(handle):
+    _state["lib"].t4j_c_barrier(handle)
+
+
+def host_bcast(handle, x, root):
+    import numpy as np
+
+    x = np.array(x, order="C")  # one writable contiguous copy
+    _state["lib"].t4j_c_bcast(handle, _ptr(x), x.nbytes, root)
+    return x
+
+
+def host_allgather(handle, x):
+    import numpy as np
+
+    x = _contig(x)
+    n = _state["lib"].t4j_comm_size(handle)
+    out = np.empty((n, *x.shape), x.dtype)
+    _state["lib"].t4j_c_allgather(handle, _ptr(x), _ptr(out), x.nbytes)
+    return out
+
+
+def host_gather(handle, x, root):
+    import numpy as np
+
+    x = _contig(x)
+    n = _state["lib"].t4j_comm_size(handle)
+    out = np.empty((n, *x.shape), x.dtype)
+    _state["lib"].t4j_c_gather(handle, _ptr(x), _ptr(out), x.nbytes, root)
+    return out
+
+
+def host_scatter(handle, x, root):
+    import numpy as np
+
+    x = _contig(x)
+    lib = _state["lib"]
+    if lib.t4j_comm_rank(handle) == root:
+        out = np.empty(x.shape[1:], x.dtype)
+        nbytes_each = out.nbytes
+    else:
+        out = np.empty(x.shape, x.dtype)
+        nbytes_each = out.nbytes
+    lib.t4j_c_scatter(handle, _ptr(x), _ptr(out), nbytes_each, root)
+    return out
+
+
+def host_alltoall(handle, x):
+    import numpy as np
+
+    x = _contig(x)
+    n = _state["lib"].t4j_comm_size(handle)
+    out = np.empty_like(x)
+    _state["lib"].t4j_c_alltoall(handle, _ptr(x), _ptr(out), x.nbytes // n)
+    return out
+
+
+def host_send(handle, x, dest, tag):
+    x = _contig(x)
+    _state["lib"].t4j_c_send(handle, _ptr(x), x.nbytes, dest, tag)
+
+
+def host_recv(handle, shape, dtype, source, tag):
+    import numpy as np
+
+    out = np.empty(shape, dtype)
+    src = ctypes.c_int32(0)
+    tg = ctypes.c_int32(0)
+    _state["lib"].t4j_c_recv(
+        handle, _ptr(out), out.nbytes, source, tag,
+        ctypes.byref(src), ctypes.byref(tg),
+    )
+    return out, np.int32(src.value), np.int32(tg.value)
+
+
+def host_sendrecv(handle, sendbuf, recvbuf, source, dest, sendtag, recvtag):
+    import numpy as np
+
+    sendbuf = _contig(sendbuf)
+    out = np.empty(recvbuf.shape, recvbuf.dtype)
+    src = ctypes.c_int32(0)
+    tg = ctypes.c_int32(0)
+    _state["lib"].t4j_c_sendrecv(
+        handle, _ptr(sendbuf), _ptr(out), out.nbytes, source, dest,
+        sendtag, recvtag, ctypes.byref(src), ctypes.byref(tg),
+    )
+    return out, np.int32(src.value), np.int32(tg.value)
 
 
 def available():

@@ -239,26 +239,26 @@ Frame raw_recv(int world_source, int ctx, int tag) {
 // TCP window scale is negotiated with the enlarged buffer in place).
 constexpr int kWantBuf = 8 << 20;
 
-bool large_bufs_supported() {
-  static const bool ok = [] {
-    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) return false;
-    int bufsz = kWantBuf;
-    ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
-    int got = 0;
-    socklen_t len = sizeof(got);
-    ::getsockopt(fd, SOL_SOCKET, SO_RCVBUF, &got, &len);
-    ::close(fd);
-    return got >= kWantBuf;  // kernel reports doubled value when honoured
-  }();
-  return ok;
+bool buf_honoured(int optname) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  int bufsz = kWantBuf;
+  ::setsockopt(fd, SOL_SOCKET, optname, &bufsz, sizeof(bufsz));
+  int got = 0;
+  socklen_t len = sizeof(got);
+  ::getsockopt(fd, SOL_SOCKET, optname, &got, &len);
+  ::close(fd);
+  return got >= kWantBuf;  // kernel reports doubled value when honoured
 }
 
 void presize_buffers(int fd) {
-  if (!large_bufs_supported()) return;  // keep kernel auto-tuning
+  // each direction is governed by its own sysctl (wmem_max / rmem_max):
+  // pin only the side the kernel honours, keep auto-tuning on the other
+  static const bool snd_ok = buf_honoured(SO_SNDBUF);
+  static const bool rcv_ok = buf_honoured(SO_RCVBUF);
   int bufsz = kWantBuf;
-  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
-  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+  if (snd_ok) ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+  if (rcv_ok) ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
 }
 
 void tune_socket(int fd) {

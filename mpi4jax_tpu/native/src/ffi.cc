@@ -313,4 +313,66 @@ int t4j_comm_rank(int32_t comm) { return t4j::comm_rank(comm); }
 int t4j_comm_size(int32_t comm) { return t4j::comm_size(comm); }
 void t4j_abort(int32_t code) { t4j::abort_job(code, "user abort"); }
 
+// ctypes data plane: used by the host-callback tier (TPU jits stage
+// HBM->host via jax io_callback, then these run the wire ops — the
+// analog of the reference's GPU COPY_TO_HOST staging path,
+// mpi_xla_bridge_gpu.pyx:211-251).
+
+void t4j_c_send(int32_t comm, const void* buf, uint64_t nbytes, int32_t dest,
+                int32_t tag) {
+  t4j::send(comm, buf, nbytes, dest, tag);
+}
+void t4j_c_recv(int32_t comm, void* buf, uint64_t nbytes, int32_t source,
+                int32_t tag, int32_t* src_out, int32_t* tag_out) {
+  int s = 0, t = 0;
+  t4j::recv(comm, buf, nbytes, source, tag, &s, &t);
+  if (src_out) *src_out = s;
+  if (tag_out) *tag_out = t;
+}
+void t4j_c_sendrecv(int32_t comm, const void* sendbuf, void* recvbuf,
+                    uint64_t nbytes, int32_t source, int32_t dest,
+                    int32_t sendtag, int32_t recvtag, int32_t* src_out,
+                    int32_t* tag_out) {
+  int s = 0, t = 0;
+  t4j::sendrecv(comm, sendbuf, recvbuf, nbytes, source, dest, sendtag,
+                recvtag, &s, &t);
+  if (src_out) *src_out = s;
+  if (tag_out) *tag_out = t;
+}
+void t4j_c_barrier(int32_t comm) { t4j::barrier(comm); }
+void t4j_c_bcast(int32_t comm, void* buf, uint64_t nbytes, int32_t root) {
+  t4j::bcast(comm, buf, nbytes, root);
+}
+void t4j_c_allreduce(int32_t comm, const void* in, void* out, uint64_t count,
+                     int32_t dt, int32_t op) {
+  t4j::allreduce(comm, in, out, count, static_cast<t4j::DType>(dt),
+                 static_cast<t4j::ReduceOp>(op));
+}
+void t4j_c_reduce(int32_t comm, const void* in, void* out, uint64_t count,
+                  int32_t dt, int32_t op, int32_t root) {
+  t4j::reduce(comm, in, out, count, static_cast<t4j::DType>(dt),
+              static_cast<t4j::ReduceOp>(op), root);
+}
+void t4j_c_scan(int32_t comm, const void* in, void* out, uint64_t count,
+                int32_t dt, int32_t op) {
+  t4j::scan(comm, in, out, count, static_cast<t4j::DType>(dt),
+            static_cast<t4j::ReduceOp>(op));
+}
+void t4j_c_allgather(int32_t comm, const void* in, void* out,
+                     uint64_t nbytes_each) {
+  t4j::allgather(comm, in, out, nbytes_each);
+}
+void t4j_c_gather(int32_t comm, const void* in, void* out,
+                  uint64_t nbytes_each, int32_t root) {
+  t4j::gather(comm, in, out, nbytes_each, root);
+}
+void t4j_c_scatter(int32_t comm, const void* in, void* out,
+                   uint64_t nbytes_each, int32_t root) {
+  t4j::scatter(comm, in, out, nbytes_each, root);
+}
+void t4j_c_alltoall(int32_t comm, const void* in, void* out,
+                    uint64_t nbytes_each) {
+  t4j::alltoall(comm, in, out, nbytes_each);
+}
+
 }  // extern "C"

@@ -48,11 +48,57 @@ def _handle(comm):
     return np.int32(runtime.comm_handle(comm))
 
 
+def _staged():
+    """True when arrays live on an accelerator: route ops through
+    ``io_callback`` (device->host staging handled by JAX) instead of the
+    CPU FFI custom call — the analog of the reference's GPU
+    COPY_TO_HOST path (mpi_xla_bridge_gpu.pyx:211-251; there the bridge
+    cudaMemcpys manually, here the runtime stages for us).
+
+    Requires a runtime with host-callback support (standard libtpu has
+    it; the experimental axon tunnel does not).
+    ``MPI4JAX_TPU_FORCE_STAGED=1`` forces this path on CPU, for testing
+    the staging tier without an accelerator.
+    """
+    import os
+
+    from mpi4jax_tpu.utils.config import truthy
+
+    if truthy(os.environ.get("MPI4JAX_TPU_FORCE_STAGED"), default=False):
+        return True
+    return jax.default_backend() != "cpu"
+
+
 def _call(name, results, *operands, **attrs):
     import jax.ffi
 
     fn = jax.ffi.ffi_call(name, results, has_side_effect=True)
     return fn(*operands, **attrs)
+
+
+def _io(py_fn, results, *operands):
+    from jax.experimental import io_callback
+
+    # ordered=False: ordered IO effects need runtime token support some
+    # experimental PJRT plugins lack (observed on axon). Ordering is
+    # already guaranteed by data dependence — every op threads the stamp
+    # through its callback — which is this library's ordering model
+    # everywhere else (ops/_core.py docstring).
+    return io_callback(py_fn, results, *operands, ordered=False)
+
+
+def _staged_data(comm, out_sds, host_fn, x, stamp):
+    """Shared staged-tier shape for data-in/data-out ops: stages ``x``
+    to host, runs ``host_fn(runtime, handle, np_x) -> np_out``, threads
+    the stamp through for ordering."""
+    from mpi4jax_tpu.native import runtime
+
+    h = int(_handle(comm))
+
+    def cb(x_, stamp_):
+        return host_fn(runtime, h, np.asarray(x_)), stamp_
+
+    return _io(cb, (out_sds, _STAMP), x, stamp)
 
 
 def _sds(x):
@@ -64,6 +110,12 @@ _STATUS = jax.ShapeDtypeStruct((2,), np.int32)
 
 
 def proc_allreduce(x, stamp, op, comm):
+    if _staged():
+        code = _OP_CODES[op.name]
+        return _staged_data(
+            comm, _sds(x),
+            lambda rt, h, a: rt.host_allreduce(h, a, code), x, stamp,
+        )
     return _call(
         "t4j_allreduce",
         (_sds(x), _STAMP),
@@ -75,6 +127,12 @@ def proc_allreduce(x, stamp, op, comm):
 
 
 def proc_reduce(x, stamp, op, comm, root):
+    if _staged():
+        code = _OP_CODES[op.name]
+        return _staged_data(
+            comm, _sds(x),
+            lambda rt, h, a: rt.host_reduce(h, a, code, root), x, stamp,
+        )
     return _call(
         "t4j_reduce",
         (_sds(x), _STAMP),
@@ -87,6 +145,12 @@ def proc_reduce(x, stamp, op, comm, root):
 
 
 def proc_scan(x, stamp, op, comm):
+    if _staged():
+        code = _OP_CODES[op.name]
+        return _staged_data(
+            comm, _sds(x),
+            lambda rt, h, a: rt.host_scan(h, a, code), x, stamp,
+        )
     return _call(
         "t4j_scan",
         (_sds(x), _STAMP),
@@ -98,11 +162,26 @@ def proc_scan(x, stamp, op, comm):
 
 
 def proc_barrier(stamp, comm):
+    if _staged():
+        from mpi4jax_tpu.native import runtime
+
+        h = int(_handle(comm))
+
+        def cb(stamp_):
+            runtime.host_barrier(h)
+            return stamp_
+
+        return _io(cb, _STAMP, stamp)
     (out,) = _call("t4j_barrier", (_STAMP,), stamp, comm=_handle(comm))
     return out
 
 
 def proc_bcast(x, stamp, comm, root):
+    if _staged():
+        return _staged_data(
+            comm, _sds(x),
+            lambda rt, h, a: rt.host_bcast(h, a, root), x, stamp,
+        )
     return _call(
         "t4j_bcast",
         (_sds(x), _STAMP),
@@ -115,6 +194,10 @@ def proc_bcast(x, stamp, comm, root):
 
 def proc_allgather(x, stamp, comm):
     out = jax.ShapeDtypeStruct((comm.size, *jnp.shape(x)), jnp.result_type(x))
+    if _staged():
+        return _staged_data(
+            comm, out, lambda rt, h, a: rt.host_allgather(h, a), x, stamp
+        )
     return _call(
         "t4j_allgather", (out, _STAMP), x, stamp, comm=_handle(comm)
     )
@@ -122,6 +205,11 @@ def proc_allgather(x, stamp, comm):
 
 def proc_gather(x, stamp, comm, root):
     out = jax.ShapeDtypeStruct((comm.size, *jnp.shape(x)), jnp.result_type(x))
+    if _staged():
+        return _staged_data(
+            comm, out,
+            lambda rt, h, a: rt.host_gather(h, a, root), x, stamp,
+        )
     return _call(
         "t4j_gather",
         (out, _STAMP),
@@ -137,6 +225,11 @@ def proc_scatter(x, stamp, comm, root):
     # other ranks pass a (rest)-shaped template (scatter.py:52-58)
     shape = jnp.shape(x)[1:] if comm.rank() == root else jnp.shape(x)
     out = jax.ShapeDtypeStruct(shape, jnp.result_type(x))
+    if _staged():
+        return _staged_data(
+            comm, out,
+            lambda rt, h, a: rt.host_scatter(h, a, root), x, stamp,
+        )
     return _call(
         "t4j_scatter",
         (out, _STAMP),
@@ -148,10 +241,24 @@ def proc_scatter(x, stamp, comm, root):
 
 
 def proc_alltoall(x, stamp, comm):
+    if _staged():
+        return _staged_data(
+            comm, _sds(x), lambda rt, h, a: rt.host_alltoall(h, a), x, stamp
+        )
     return _call("t4j_alltoall", (_sds(x), _STAMP), x, stamp, comm=_handle(comm))
 
 
 def proc_send(x, stamp, comm, dest, tag):
+    if _staged():
+        from mpi4jax_tpu.native import runtime
+
+        h = int(_handle(comm))
+
+        def cb(x_, stamp_):
+            runtime.host_send(h, np.asarray(x_), dest, tag)
+            return stamp_
+
+        return _io(cb, _STAMP, x, stamp)
     (out,) = _call(
         "t4j_send",
         (_STAMP,),
@@ -166,6 +273,18 @@ def proc_send(x, stamp, comm, dest, tag):
 
 def proc_recv(template, stamp, comm, source, tag):
     """Returns (data, stamp, status[2])."""
+    if _staged():
+        from mpi4jax_tpu.native import runtime
+
+        h = int(_handle(comm))
+        shape = jnp.shape(template)
+        dtype = jnp.result_type(template)
+
+        def cb(stamp_):
+            out, src, tg = runtime.host_recv(h, shape, dtype, source, tag)
+            return out, stamp_, np.array([src, tg], np.int32)
+
+        return _io(cb, (_sds(template), _STAMP, _STATUS), stamp)
     return _call(
         "t4j_recv",
         (_sds(template), _STAMP, _STATUS),
@@ -185,6 +304,21 @@ sendrecv_p.multiple_results = True
 def _sendrecv_impl(sendbuf, recvbuf, stamp, *, comm, source, dest, sendtag,
                    recvtag, _must_transpose):
     del _must_transpose
+    if _staged():
+        from mpi4jax_tpu.native import runtime
+
+        h = int(_handle(comm))
+
+        def cb(sendbuf_, recvbuf_, stamp_):
+            out, src, tg = runtime.host_sendrecv(
+                h, np.asarray(sendbuf_), np.asarray(recvbuf_), source, dest,
+                sendtag, recvtag,
+            )
+            return out, stamp_, np.array([src, tg], np.int32)
+
+        return _io(
+            cb, (_sds(recvbuf), _STAMP, _STATUS), sendbuf, recvbuf, stamp
+        )
     return _call(
         "t4j_sendrecv",
         (_sds(recvbuf), _STAMP, _STATUS),
