@@ -1,0 +1,64 @@
+"""Dtype battery through the native bridge's C++ combine paths —
+covers the hand-written f16/bf16 conversion kernels, complex, bool and
+integer ops in dcn.cc (the reference's 14-dtype table,
+mpi4jax/_src/utils.py:43-71)."""
+
+from tests.proc.test_proc_backend import run_workers
+
+
+def test_allreduce_dtype_battery():
+    res = run_workers(
+        """
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)  # real f64/i64/c128 paths
+        import jax.numpy as jnp
+        import numpy as np
+        import mpi4jax_tpu as m
+
+        comm = m.get_default_comm()
+        rank, size = comm.rank(), comm.size
+
+        def check(x, op, expected, what):
+            y, _ = m.allreduce(jnp.asarray(x), op, comm=comm)
+            got = np.asarray(jax.device_get(y))
+            assert np.allclose(
+                got.astype(np.float64)
+                if got.dtype != np.complex64 else got,
+                expected,
+            ), (what, got, expected)
+
+        base = np.arange(4.0)
+        # floats incl. the C++ half-precision conversion kernels
+        for dt in (jnp.float32, jnp.float64, jnp.float16, jnp.bfloat16):
+            check((base + rank).astype(dt), m.SUM,
+                  2 * base + 1, f"sum {dt.__name__}")
+            check((base + rank).astype(dt), m.MAX, base + 1,
+                  f"max {dt.__name__}")
+        # complex sum (both widths)
+        z = (base + rank) * (1 + 1j)
+        for cdt in (jnp.complex64, jnp.complex128):
+            y, _ = m.allreduce(jnp.asarray(z, cdt), m.SUM, comm=comm)
+            assert np.allclose(np.asarray(y), (2 * base + 1) * (1 + 1j))
+        # bool logicals
+        flags = jnp.asarray([rank == 0, True, False, rank == 1])
+        y, _ = m.allreduce(flags, m.LOR, comm=comm)
+        assert np.array_equal(np.asarray(y), [True, True, False, True]), y
+        y, _ = m.allreduce(flags, m.LAND, comm=comm)
+        assert np.array_equal(np.asarray(y), [False, True, False, False]), y
+        # integer bitwise
+        ints = jnp.asarray([0b1100, 0b1010], jnp.int32) >> rank
+        y, _ = m.allreduce(ints, m.BXOR, comm=comm)
+        assert np.array_equal(np.asarray(y), [0b1100 ^ 0b110, 0b1010 ^ 0b101]), y
+        # int min/prod
+        v = jnp.asarray([3 + rank, 7 - rank], jnp.int64)
+        y, _ = m.allreduce(v, m.MIN, comm=comm)
+        assert np.array_equal(np.asarray(y), [3, 6]), y
+        y, _ = m.allreduce(v, m.PROD, comm=comm)
+        assert np.array_equal(np.asarray(y), [12, 42]), y
+        print(f"rank {rank} dtypes ok")
+        """,
+        nprocs=2,
+    )
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert res.stdout.count("dtypes ok") == 2, res.stdout
