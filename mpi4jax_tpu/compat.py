@@ -143,6 +143,16 @@ class _MPINamespace:
 
     Op = _m.Op
 
+    @staticmethod
+    def get_vendor():
+        """mpi4py.MPI.get_vendor analog: identifies this backend."""
+        import re
+
+        import mpi4jax_tpu
+
+        nums = re.findall(r"\d+", mpi4jax_tpu.__version__)[:3]
+        return ("mpi4jax_tpu", tuple(int(p) for p in nums) or (0,))
+
     def __repr__(self):
         return "<mpi4jax_tpu.compat.MPI>"
 

@@ -51,23 +51,36 @@ std::string call_id() {
 
 struct LogScope {
   std::string id;
+  std::string op;
   std::chrono::steady_clock::time_point start;
   bool active;
 
-  LogScope(const char* op, const std::string& detail) : active(g_logging) {
+  // Wire format follows the reference's bridge
+  // (mpi_xla_bridge.pyx:47-52, 95-450): stdout, "r{rank} | {8-char id} |
+  // MPI_<Op> <detail>" then "... | MPI_<Op> done with code 0 (1.23e-04s)".
+  // Detail quantities are in bytes where this layer works on bytes (the
+  // reference's Cython layer sees item counts; the FFI handlers here
+  // only carry counts for reductions).
+  LogScope(const char* op_, const std::string& detail) : op(op_),
+                                                         active(g_logging) {
     if (!active) return;
     id = call_id();
     start = std::chrono::steady_clock::now();
-    std::fprintf(stderr, "r%d | %s | %s %s\n", g_rank, id.c_str(), op,
-                 detail.c_str());
+    if (detail.empty())
+      std::fprintf(stdout, "r%d | %s | %s\n", g_rank, id.c_str(), op.c_str());
+    else
+      std::fprintf(stdout, "r%d | %s | %s %s\n", g_rank, id.c_str(),
+                   op.c_str(), detail.c_str());
+    std::fflush(stdout);
   }
   ~LogScope() {
     if (!active) return;
     double secs = std::chrono::duration<double>(
                       std::chrono::steady_clock::now() - start)
                       .count();
-    std::fprintf(stderr, "r%d | %s | done with code 0 (%.2e s)\n", g_rank,
-                 id.c_str(), secs);
+    std::fprintf(stdout, "r%d | %s | %s done with code 0 (%.2es)\n", g_rank,
+                 id.c_str(), op.c_str(), secs);
+    std::fflush(stdout);
   }
 };
 
@@ -733,9 +746,9 @@ int comm_size(int comm) {
 
 void send(int comm, const void* buf, size_t nbytes, int dest, int tag) {
   Comm& c = get_comm(comm);
-  LogScope log("Send", "to " + std::to_string(dest) + " (" +
-                           std::to_string(nbytes) + " bytes, tag " +
-                           std::to_string(tag) + ")");
+  LogScope log("MPI_Send", "-> " + std::to_string(dest) + " with tag " +
+                             std::to_string(tag) + " and " +
+                             std::to_string(nbytes) + " bytes");
   if (dest < 0 || dest >= static_cast<int>(c.ranks.size()))
     die("send dest rank (MPI_Send)");
   csend(c, dest, tag, buf, nbytes, /*coll=*/false);
@@ -744,9 +757,9 @@ void send(int comm, const void* buf, size_t nbytes, int dest, int tag) {
 void recv(int comm, void* buf, size_t nbytes, int source, int tag,
           int* src_out, int* tag_out) {
   Comm& c = get_comm(comm);
-  LogScope log("Recv", "from " + std::to_string(source) + " (" +
-                           std::to_string(nbytes) + " bytes, tag " +
-                           std::to_string(tag) + ")");
+  LogScope log("MPI_Recv", "<- " + std::to_string(source) + " with tag " +
+                             std::to_string(tag) + " and " +
+                             std::to_string(nbytes) + " bytes");
   if (source != kAnySource &&
       (source < 0 || source >= static_cast<int>(c.ranks.size())))
     die("recv source rank (MPI_Recv)");
@@ -765,8 +778,10 @@ void sendrecv(int comm, const void* sendbuf, void* recvbuf, size_t nbytes,
               int source, int dest, int sendtag, int recvtag, int* src_out,
               int* tag_out) {
   Comm& c = get_comm(comm);
-  LogScope log("Sendrecv", "to " + std::to_string(dest) + " from " +
-                               std::to_string(source));
+  LogScope log("MPI_Sendrecv", "<- " + std::to_string(source) +
+                                 " (tag " + std::to_string(recvtag) +
+                                 ") / -> " + std::to_string(dest) +
+                                 " (tag " + std::to_string(sendtag) + ")");
   // eager sends cannot block: send first, then receive (the pattern the
   // reference's deadlock test guards, test_send_and_recv.py:104-117)
   csend(c, dest, sendtag, sendbuf, nbytes, /*coll=*/false);
@@ -783,7 +798,7 @@ void sendrecv(int comm, const void* sendbuf, void* recvbuf, size_t nbytes,
 
 void barrier(int comm) {
   Comm& c = get_comm(comm);
-  LogScope log("Barrier", "");
+  LogScope log("MPI_Barrier", "");
   int n = static_cast<int>(c.ranks.size());
   if (n == 1) return;
   int me = c.my_index;
@@ -797,8 +812,8 @@ void barrier(int comm) {
 
 void bcast(int comm, void* buf, size_t nbytes, int root) {
   Comm& c = get_comm(comm);
-  LogScope log("Bcast", std::to_string(nbytes) + " bytes from " +
-                            std::to_string(root));
+  LogScope log("MPI_Bcast", "-> " + std::to_string(root) + " with " +
+                              std::to_string(nbytes) + " bytes");
   int n = static_cast<int>(c.ranks.size());
   if (n == 1) return;
   // binomial tree rooted at `root` (rotate indices so root -> 0)
@@ -819,8 +834,8 @@ void bcast(int comm, void* buf, size_t nbytes, int root) {
 void reduce(int comm, const void* in, void* out, size_t count, DType dt,
             ReduceOp op, int root) {
   Comm& c = get_comm(comm);
-  LogScope log("Reduce", std::to_string(count) + " items to " +
-                             std::to_string(root));
+  LogScope log("MPI_Reduce", "-> " + std::to_string(root) + " with " +
+                               std::to_string(count) + " items");
   int n = static_cast<int>(c.ranks.size());
   size_t nbytes = count * dtype_size(dt);
   std::vector<uint8_t> acc(static_cast<const uint8_t*>(in),
@@ -848,7 +863,7 @@ void reduce(int comm, const void* in, void* out, size_t count, DType dt,
 void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
                ReduceOp op) {
   Comm& c = get_comm(comm);
-  LogScope log("Allreduce", std::to_string(count) + " items");
+  LogScope log("MPI_Allreduce", "with " + std::to_string(count) + " items");
   size_t nbytes = count * dtype_size(dt);
   reduce(comm, in, out, count, dt, op, 0);
   if (c.my_index != 0) std::memcpy(out, in, nbytes);  // placate valgrind
@@ -858,7 +873,7 @@ void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
 void scan(int comm, const void* in, void* out, size_t count, DType dt,
           ReduceOp op) {
   Comm& c = get_comm(comm);
-  LogScope log("Scan", std::to_string(count) + " items");
+  LogScope log("MPI_Scan", "with " + std::to_string(count) + " items");
   int n = static_cast<int>(c.ranks.size());
   size_t nbytes = count * dtype_size(dt);
   std::memcpy(out, in, nbytes);
@@ -874,7 +889,8 @@ void scan(int comm, const void* in, void* out, size_t count, DType dt,
 
 void allgather(int comm, const void* in, void* out, size_t nbytes_each) {
   Comm& c = get_comm(comm);
-  LogScope log("Allgather", std::to_string(nbytes_each) + " bytes each");
+  LogScope log("MPI_Allgather", "sending " + std::to_string(nbytes_each) +
+                                  " bytes each");
   gather(comm, in, out, nbytes_each, 0);
   bcast(comm, out, nbytes_each * c.ranks.size(), 0);
 }
@@ -882,8 +898,8 @@ void allgather(int comm, const void* in, void* out, size_t nbytes_each) {
 void gather(int comm, const void* in, void* out, size_t nbytes_each,
             int root) {
   Comm& c = get_comm(comm);
-  LogScope log("Gather", std::to_string(nbytes_each) + " bytes each to " +
-                             std::to_string(root));
+  LogScope log("MPI_Gather", "-> " + std::to_string(root) + " sending " +
+                               std::to_string(nbytes_each) + " bytes each");
   int n = static_cast<int>(c.ranks.size());
   if (c.my_index == root) {
     uint8_t* o = static_cast<uint8_t*>(out);
@@ -902,8 +918,8 @@ void gather(int comm, const void* in, void* out, size_t nbytes_each,
 void scatter(int comm, const void* in, void* out, size_t nbytes_each,
              int root) {
   Comm& c = get_comm(comm);
-  LogScope log("Scatter", std::to_string(nbytes_each) + " bytes each from " +
-                              std::to_string(root));
+  LogScope log("MPI_Scatter", "-> " + std::to_string(root) + " sending " +
+                                std::to_string(nbytes_each) + " bytes each");
   int n = static_cast<int>(c.ranks.size());
   if (c.my_index == root) {
     const uint8_t* i8 = static_cast<const uint8_t*>(in);
@@ -921,7 +937,8 @@ void scatter(int comm, const void* in, void* out, size_t nbytes_each,
 
 void alltoall(int comm, const void* in, void* out, size_t nbytes_each) {
   Comm& c = get_comm(comm);
-  LogScope log("Alltoall", std::to_string(nbytes_each) + " bytes each");
+  LogScope log("MPI_Alltoall", "sending " + std::to_string(nbytes_each) +
+                                 " bytes each");
   int n = static_cast<int>(c.ranks.size());
   int me = c.my_index;
   const uint8_t* i8 = static_cast<const uint8_t*>(in);

@@ -320,7 +320,8 @@ def _debug_log(name, out, comm):
     except Exception:
         rank = -1
     jax.debug.print(
-        "r{rank} | %08d | %s %d items" % (callid, name.capitalize(), nitems),
+        "r{rank} | %08d | MPI_%s with %d items"
+        % (callid, name.capitalize(), nitems),
         rank=rank,
         ordered=False,
     )

@@ -303,7 +303,14 @@ sendrecv_p.multiple_results = True
 
 def _sendrecv_impl(sendbuf, recvbuf, stamp, *, comm, source, dest, sendtag,
                    recvtag, _must_transpose):
-    del _must_transpose
+    if _must_transpose:
+        # only pure forward mode can leak a flipped marker to execution;
+        # reverse mode transposes it back (the reference's scheme:
+        # sendrecv.py:128-133 error, :320-361 jvp marker flip)
+        raise RuntimeError(
+            "forward-mode differentiation through sendrecv is not "
+            "supported on the multi-process backend; use reverse mode"
+        )
     if _staged():
         from mpi4jax_tpu.native import runtime
 
@@ -341,13 +348,68 @@ def _sendrecv_abstract(sendbuf, recvbuf, stamp, **kw):
     )
 
 
-def _sendrecv_jvp(primals, tangents, **kw):
-    # forward-mode through an asymmetric exchange is ill-defined; the
-    # reference hard-errors the same way (sendrecv.py:128-133)
-    raise RuntimeError(
-        "forward-mode differentiation through sendrecv is not supported "
-        "on the multi-process backend"
+def _zero_like(x):
+    if hasattr(ad.Zero, "from_primal_value"):
+        return ad.Zero.from_primal_value(x)
+    return ad.Zero.from_value(x)
+
+
+def _sendrecv_jvp(primals, tangents, *, comm, source, dest, sendtag, recvtag,
+                  _must_transpose):
+    # the reference's rule (sendrecv.py:320-361): tangent exchange binds
+    # with the _must_transpose marker flipped — executable only after a
+    # transpose flips it back (reverse mode); pure forward mode then
+    # errors at execution, exactly as the reference's lowering does
+    sendbuf, recvbuf, stamp = primals
+    st, rt, _ = tangents
+    st = jnp.zeros_like(sendbuf) if type(st) is ad.Zero else st
+    rt = jnp.zeros_like(recvbuf) if type(rt) is ad.Zero else rt
+    val, stamp_out, status = sendrecv_p.bind(
+        sendbuf, recvbuf, stamp, comm=comm, source=source, dest=dest,
+        sendtag=sendtag, recvtag=recvtag, _must_transpose=_must_transpose,
     )
+    jvp, jstamp, jstatus = sendrecv_p.bind(
+        st, rt, stamp_out, comm=comm, source=source, dest=dest,
+        sendtag=sendtag, recvtag=recvtag,
+        _must_transpose=not _must_transpose,
+    )
+    return (
+        (val, stamp_out, status),
+        (jvp, _zero_like(jstamp), _zero_like(jstatus)),
+    )
+
+
+def _sendrecv_batch(args, dims, *, comm, source, dest, sendtag, recvtag,
+                    _must_transpose):
+    # one exchange of the whole batch (the reference's batch rule,
+    # sendrecv.py:291-319)
+    sendbuf, recvbuf, stamp = args
+    bd_s, bd_r, bd_t = dims
+    if bd_t is not None:
+        raise NotImplementedError("batched tokens are not supported")
+    if bd_s is None and bd_r is None:
+        raise ValueError("sendrecv batch rule called without batched data")
+
+    def tile(unbatched, axis, n):
+        """Insert a batch dim of size n at ``axis`` (send/recv buffers
+        may have different base shapes)."""
+        shape = list(unbatched.shape)
+        shape.insert(axis, n)
+        return jnp.broadcast_to(jnp.expand_dims(unbatched, axis), shape)
+
+    if bd_s is None:
+        sendbuf = tile(sendbuf, bd_r, recvbuf.shape[bd_r])
+        bd_s = bd_r
+    if bd_r is None:
+        recvbuf = tile(recvbuf, bd_s, sendbuf.shape[bd_s])
+        bd_r = bd_s
+    if bd_s != bd_r:
+        sendbuf = jnp.moveaxis(sendbuf, bd_s, bd_r)
+    out = sendrecv_p.bind(
+        sendbuf, recvbuf, stamp, comm=comm, source=source, dest=dest,
+        sendtag=sendtag, recvtag=recvtag, _must_transpose=_must_transpose,
+    )
+    return out, (bd_r, None, None)
 
 
 def _sendrecv_transpose(cts, sendbuf, recvbuf, stamp, *, comm, source, dest,
@@ -369,7 +431,9 @@ def _sendrecv_transpose(cts, sendbuf, recvbuf, stamp, *, comm, source, dest,
         _must_transpose=not _must_transpose,
     )
     send_ct = res if ad.is_undefined_primal(sendbuf) else None
-    recv_ct = None
+    recv_ct = (
+        ad.Zero(recvbuf.aval) if ad.is_undefined_primal(recvbuf) else None
+    )
     stamp_ct = (
         ad.Zero(stamp.aval) if ad.is_undefined_primal(stamp) else None
     )
@@ -380,6 +444,7 @@ sendrecv_p.def_impl(_sendrecv_impl)
 sendrecv_p.def_abstract_eval(_sendrecv_abstract)
 ad.primitive_jvps[sendrecv_p] = _sendrecv_jvp
 ad.primitive_transposes[sendrecv_p] = _sendrecv_transpose
+batching.primitive_batchers[sendrecv_p] = _sendrecv_batch
 mlir.register_lowering(
     sendrecv_p, mlir.lower_fun(_sendrecv_impl, multiple_results=True)
 )

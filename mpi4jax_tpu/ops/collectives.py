@@ -101,8 +101,10 @@ def alltoall(x, *, comm=None, token=None):
     x, comm, token = _prologue(x, comm, token)
     if x.ndim == 0 or x.shape[0] != comm.size:
         raise ValueError(
-            f"alltoall input must have leading dimension comm.size="
-            f"{comm.size}, got shape {x.shape}"
+            # wording matches the reference's check (alltoall.py:62-64
+            # there; its own test suite asserts on the phrase)
+            f"alltoall input must have shape (nproc, ...) with nproc == "
+            f"comm.size={comm.size}, got shape {x.shape}"
         )
     if comm.backend == "self":
         token, (x,) = fence_out(token, x)
@@ -287,15 +289,18 @@ def scatter(x, root, *, comm=None, token=None):
 
         if comm.rank() == root and (x.ndim == 0 or x.shape[0] != comm.size):
             raise ValueError(
-                f"scatter input on root must have shape (comm.size, ...) "
-                f"= ({comm.size}, ...), got {x.shape}"
+                # reference wording (scatter.py:77-81 there)
+                f"Scatter input must have shape (nproc, ...) with nproc "
+                f"== comm.size={comm.size} on root, got shape {x.shape}"
             )
         y, stamp = _proc.proc_scatter(x, token.stamp, comm, root)
         return y, token.with_stamp(stamp)
     if x.ndim == 0 or x.shape[0] != comm.size:
         raise ValueError(
-            f"scatter input must have leading dimension comm.size="
-            f"{comm.size}, got shape {x.shape}"
+            # wording matches the reference's check (scatter.py:77-81
+            # there; its own test suite asserts on the phrase)
+            f"Scatter input must have shape (nproc, ...) with nproc == "
+            f"comm.size={comm.size}, got shape {x.shape}"
         )
     if comm.backend == "self":
         y = x[0]

@@ -55,12 +55,50 @@ class Status:
     """Output status for recv/sendrecv (MPI.Status analog).
 
     ``source`` and ``tag`` are filled on return; ``source`` may be a
-    traced per-device value on the mesh backend.
+    traced per-device value on the mesh backend.  The mpi4py accessor
+    methods (``Get_source``/``Get_tag``/``Get_error``) are provided for
+    call-compatibility with reference user code.
     """
 
     def __init__(self):
         self.source = None
         self.tag = None
+
+    def Get_source(self):
+        return self.source
+
+    def Get_tag(self):
+        return self.tag
+
+    def Get_error(self):
+        return 0
+
+
+def _deliver_status(status, st):
+    """Fill a Status object, working under jit too.
+
+    Eager values are assigned synchronously (the old behaviour).  Under
+    a trace, the reference bakes the MPI_Status struct's address into
+    the executable and writes through it at execution time
+    (sendrecv.py status out-param; utils.py:35-39 pointer plumbing);
+    the JAX-native equivalent is a debug callback that receives the
+    concrete envelope each run and mutates the object — read the status
+    after the op's results are materialised (or ``jax.effects_barrier``)
+    just as the reference requires the execution to have happened.
+    """
+    import jax
+
+    if not isinstance(st, jax.core.Tracer):
+        vals = np.asarray(st)
+        status.source = int(vals[0])
+        status.tag = int(vals[1])
+        return
+
+    def setter(vals):
+        status.source = int(vals[0])
+        status.tag = int(vals[1])
+
+    jax.debug.callback(setter, st)
 
 
 def _resolve_pairs(spec, size, role):
@@ -214,8 +252,7 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
             )
         y, stamp, st = _proc.proc_recv(x, token.stamp, comm, source, tag)
         if status is not None:
-            status.source = st[0]
-            status.tag = st[1]
+            _deliver_status(status, st)
         return y, token.with_stamp(stamp)
     want_pairs = None
     source_is_any = (
@@ -314,8 +351,7 @@ def sendrecv(
             recvtag,
         )
         if status is not None:
-            status.source = st[0]
-            status.tag = st[1]
+            _deliver_status(status, st)
         return y, token.with_stamp(stamp)
     if comm.backend == "self":
         token, (y,) = fence_out(token, sendbuf)

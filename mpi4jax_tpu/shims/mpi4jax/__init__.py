@@ -1,0 +1,9 @@
+"""Shim satisfying ``import mpi4jax`` with the compat layer (the twelve
+ops with the reference's signatures, has_cuda_support, experimental
+namespace as a real subpackage so ``from mpi4jax.experimental import
+auto_tokenize`` works)."""
+
+from mpi4jax_tpu.compat import *  # noqa: F401,F403
+from mpi4jax_tpu.compat import MPI, create_token  # noqa: F401
+from mpi4jax_tpu import Token, __version__  # noqa: F401
+from . import experimental  # noqa: F401

@@ -1,0 +1,4 @@
+"""Minimal internals shim: only the pieces of the reference's private
+``mpi4jax._src`` namespace that user-facing programs/tests reasonably
+touch. The full internal surface (Cython bridge modules, decorators) is
+implementation-specific to the reference and intentionally absent."""

@@ -1,0 +1,13 @@
+"""Shim for mpi4jax._src.flush (flush.py:1-12 there: block until
+pending XLA work is done)."""
+
+
+def flush(platform=None):
+    del platform
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4jax_tpu.utils.runtime import drain
+
+    drain(jnp.zeros(()) + 0)
+    del jax

@@ -1,0 +1,4 @@
+"""Shim for the reference's experimental namespace
+(mpi4jax/experimental/__init__.py:1-5 exports auto_tokenize only)."""
+
+from mpi4jax_tpu.experimental import auto_tokenize  # noqa: F401

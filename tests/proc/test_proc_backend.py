@@ -198,12 +198,21 @@ total = sum(range(1, size + 1)) * 6.0
 assert np.allclose(float(val), total)
 assert np.allclose(np.asarray(grad), np.ones((3, 2)))
 
-# sendrecv transpose: cotangent travels the reverse ring direction
-f = jax.jit(lambda v: m.sendrecv(
-    v, v, (rank - 1) % size, (rank + 1) % size, comm=comm)[0])
-(ct,) = jax.linear_transpose(f, x)(x)
-# forward shifts +1; transpose shifts -1: we get rank+1's x
+# sendrecv vjp: cotangent travels the reverse ring direction (the
+# reference's transpose contract, sendrecv.py:364-383; pure forward
+# mode errors by design there and here, sendrecv.py:128-133)
+f = lambda v: m.sendrecv(
+    v, v, (rank - 1) % size, (rank + 1) % size, comm=comm)[0]
+_, vjp = jax.vjp(f, x)
+(ct,) = vjp(x)
+# forward shifts +1; cotangent shifts -1: we get rank+1's x
 assert np.allclose(np.asarray(ct), np.ones((3, 2)) * ((rank + 1) % size + 1))
+
+try:
+    jax.jvp(f, (x,), (x,))
+    raise SystemExit("forward mode unexpectedly succeeded")
+except RuntimeError as e:
+    assert "forward-mode" in str(e), e
 print(f"WORKER_OK {rank}", flush=True)
 """,
         nprocs=2,
@@ -246,8 +255,13 @@ print(f"WORKER_OK {rank}", flush=True)
         nprocs=2,
         env={"MPI4JAX_TPU_DEBUG": "1"},
     )
-    assert re.search(r"r\d+ \| \w{8} \| Allreduce 2 items", proc.stderr)
-    assert re.search(r"r\d+ \| \w{8} \| done with code 0 \(\d", proc.stderr)
+    out = proc.stdout
+    assert re.search(r"r\d+ \| \w{8} \| MPI_Allreduce with 2 items", out), out
+    assert re.search(
+        r"r\d+ \| \w{8} \| MPI_Allreduce done with code 0 "
+        r"\(\d\.\d{2}e[+-]?\d+s\)",
+        out,
+    ), out
 
 
 def test_invalid_rank_raises_eagerly():

@@ -66,7 +66,7 @@ def test_debug_log_wire_format(comm1d, capfd):
     captured = capfd.readouterr().out
     lines = [l for l in captured.splitlines() if "Allreduce" in l]
     assert len(lines) == SIZE, captured
-    pat = re.compile(r"^r\d+ \| \d{8} \| Allreduce 1 items$")
+    pat = re.compile(r"^r\d+ \| \d{8} \| MPI_Allreduce with 1 items$")
     assert all(pat.match(l) for l in lines), lines
     ranks = sorted(int(l[1 : l.index(" ")]) for l in lines)
     assert ranks == list(range(SIZE))
