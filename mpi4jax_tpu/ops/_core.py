@@ -288,43 +288,89 @@ def commit_token(token):
     return token
 
 
-_callid_counter = None  # lazy itertools.count
+# ops whose debug log uses the reference's MPI_<Op> wire name
+_LOGGED_OPS = {
+    "allgather", "allreduce", "alltoall", "barrier", "bcast", "gather",
+    "recv", "reduce", "scan", "scatter", "send", "sendrecv",
+}
+
+_ALNUM = (
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+)
 
 
-def _next_callid():
-    global _callid_counter
-    if _callid_counter is None:
-        import itertools
-
-        _callid_counter = itertools.count()
-    return next(_callid_counter)
+def _first_array(tree):
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "dtype") and hasattr(leaf, "size"):
+            return leaf
+    return None
 
 
-def _debug_log(name, out, comm):
-    """Stage a per-call debug line into the computation.
+def _debug_begin(name, args, kwargs, comm):
+    """Stage the reference-format begin line and start the call timer.
 
-    Wire format follows the reference's bridge logging
-    (mpi_xla_bridge.pyx:35-60: ``r{rank} | {callid} | MPI_<Op> ...``),
-    with a sequential 8-digit call id instead of a random one (call sites
-    are compiled once; the id identifies the site, printed per execution
-    per device).  Toggled by MPI4JAX_TPU_DEBUG / utils.config.set_debug;
-    zero cost when disabled (nothing is staged at trace time).
+    Wire format follows the reference's bridge logging exactly
+    (mpi_xla_bridge.pyx:47-60): ``r{rank} | {8-char random id} |
+    MPI_<Op> with {n} items`` at execution time, then a matching
+    ``MPI_<Op> done with code 0 (1.23e-04s)`` line from
+    :func:`_debug_end`.  Toggled by MPI4JAX_TPU_DEBUG /
+    utils.config.set_debug; zero cost when disabled (nothing is staged
+    at trace time).  The id/timer state is per call *site*; concurrent
+    executions of one site may interleave ids (debug tooling only).
     """
+    import random
+    import time
+
     import jax.debug
 
-    callid = _next_callid()
-    arrays = [o for o in jax.tree_util.tree_leaves(out) if hasattr(o, "size")]
-    nitems = int(arrays[0].size) if arrays else 0
+    arr = _first_array((args, kwargs))
+    nitems = int(arr.size) if arr is not None else 0
+    opname = "MPI_" + name.capitalize()
+    state = {}
     try:
         rank = comm.rank()
     except Exception:
         rank = -1
-    jax.debug.print(
-        "r{rank} | %08d | MPI_%s with %d items"
-        % (callid, name.capitalize(), nitems),
-        rank=rank,
-        ordered=False,
-    )
+
+    def begin_cb(rank_val, *_deps):
+        # state keyed by rank: one jit execution runs this once per
+        # device in the process, and each device's done line must carry
+        # its own id/timer
+        rid = "".join(random.choices(_ALNUM, k=8))
+        state[int(rank_val)] = (rid, time.perf_counter())
+        print(
+            f"r{int(rank_val)} | {rid} | {opname} with {nitems} items",
+            flush=True,
+        )
+
+    deps = (arr,) if arr is not None else ()
+    jax.debug.callback(begin_cb, jnp.asarray(rank), *deps)
+    state["opname"] = opname
+    state["rank"] = rank
+    return state
+
+
+def _debug_end(state, out):
+    import time
+
+    import jax.debug
+
+    opname = state["opname"]
+
+    def end_cb(rank_val, *_deps):
+        rid, t0 = state.get(
+            int(rank_val), ("????????", time.perf_counter())
+        )
+        dt = time.perf_counter() - t0
+        print(
+            f"r{int(rank_val)} | {rid} | {opname} done with code 0 "
+            f"({dt:.2e}s)",
+            flush=True,
+        )
+
+    arr = _first_array(out)
+    deps = (arr,) if arr is not None else ()
+    jax.debug.callback(end_cb, jnp.asarray(state["rank"]), *deps)
 
 
 def publishes_token(fn):
@@ -339,6 +385,13 @@ def publishes_token(fn):
     def wrapper(*args, **kwargs):
         from mpi4jax_tpu.utils import config
 
+        log_state = None
+        if config.debug_enabled() and name in _LOGGED_OPS:
+            from mpi4jax_tpu.utils.validation import check_comm
+
+            log_state = _debug_begin(
+                name, args, kwargs, check_comm(kwargs.get("comm"))
+            )
         with jax.named_scope(f"mpi4jax_tpu.{name}"):
             out = fn(*args, **kwargs)
         token = None
@@ -351,10 +404,8 @@ def publishes_token(fn):
                     break
         if token is not None:
             commit_token(token)
-        if config.debug_enabled():
-            from mpi4jax_tpu.utils.validation import check_comm
-
-            _debug_log(name, out, check_comm(kwargs.get("comm")))
+        if log_state is not None:
+            _debug_end(log_state, out)
         return out
 
     return wrapper

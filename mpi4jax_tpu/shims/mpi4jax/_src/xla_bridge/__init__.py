@@ -1,0 +1,18 @@
+"""Shim for mpi4jax._src.xla_bridge: the logging toggles.
+
+The reference seeds bridge logging from MPI4JAX_DEBUG at (re)import
+(xla_bridge/__init__.py:18-22 there); mirrored here so
+``importlib.reload`` re-reads the environment the same way.
+"""
+
+import os
+
+from mpi4jax_tpu.utils import config as _config
+
+_env = os.environ.get("MPI4JAX_DEBUG")
+if _env is not None:
+    _config.set_debug(_env not in ("", "0"))
+
+from . import mpi_xla_bridge  # noqa: E402,F401
+
+HAS_GPU_EXT = False

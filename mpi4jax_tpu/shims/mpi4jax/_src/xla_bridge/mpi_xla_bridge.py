@@ -1,0 +1,13 @@
+"""Shim for mpi4jax._src.xla_bridge.mpi_xla_bridge: set_logging /
+get_logging (mpi_xla_bridge.pyx:35-44 there), mapped onto this
+library's debug-log switch (same wire format, utils/config.py)."""
+
+from mpi4jax_tpu.utils import config as _config
+
+
+def set_logging(enable):
+    _config.set_debug(bool(enable))
+
+
+def get_logging():
+    return bool(_config.debug_enabled())

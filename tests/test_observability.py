@@ -48,8 +48,8 @@ def test_named_scope_in_lowered_module(comm1d):
 
 
 def test_debug_log_wire_format(comm1d, capfd):
-    """MPI4JAX_TPU_DEBUG output: one `r{rank} | {callid} | Op N items`
-    line per call per device."""
+    """MPI4JAX_TPU_DEBUG output: the reference's begin/done line pair
+    per call per device (mpi_xla_bridge.pyx:47-60 wire format)."""
     config.set_debug(True)
     try:
 
@@ -64,12 +64,31 @@ def test_debug_log_wire_format(comm1d, capfd):
         config.set_debug(None)
 
     captured = capfd.readouterr().out
-    lines = [l for l in captured.splitlines() if "Allreduce" in l]
-    assert len(lines) == SIZE, captured
-    pat = re.compile(r"^r\d+ \| \d{8} \| MPI_Allreduce with 1 items$")
-    assert all(pat.match(l) for l in lines), lines
-    ranks = sorted(int(l[1 : l.index(" ")]) for l in lines)
+    begins = [
+        l for l in captured.splitlines()
+        if "MPI_Allreduce with" in l
+    ]
+    dones = [
+        l for l in captured.splitlines()
+        if "MPI_Allreduce done with code 0" in l
+    ]
+    assert len(begins) == SIZE, captured
+    assert len(dones) == SIZE, captured
+    bpat = re.compile(r"^r\d+ \| \w{8} \| MPI_Allreduce with 1 items$")
+    dpat = re.compile(
+        r"^r\d+ \| \w{8} \| MPI_Allreduce done with code 0 "
+        r"\(\d\.\d{2}e[+-]?\d+s\)$"
+    )
+    assert all(bpat.match(l) for l in begins), begins
+    assert all(dpat.match(l) for l in dones), dones
+    ranks = sorted(int(l[1 : l.index(" ")]) for l in begins)
     assert ranks == list(range(SIZE))
+
+    def ids_by_rank(lines):
+        return {l.split(" | ")[0]: l.split(" | ")[1] for l in lines}
+
+    # each rank's begin/done pair must carry the same call id
+    assert ids_by_rank(begins) == ids_by_rank(dones), (begins, dones)
 
 
 def test_debug_disabled_stages_nothing(comm1d):
