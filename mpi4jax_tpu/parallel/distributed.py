@@ -25,7 +25,13 @@ import jax
 
 from mpi4jax_tpu.parallel.comm import MeshComm, set_default_comm
 
-__all__ = ["initialize", "world_mesh", "world_comm"]
+__all__ = [
+    "initialize",
+    "world_mesh",
+    "world_comm",
+    "slice_mesh",
+    "slice_comms",
+]
 
 
 def initialize(**kwargs):
@@ -77,3 +83,53 @@ def world_comm(axes=None, *, set_default=False):
     if set_default:
         set_default_comm(comm)
     return comm
+
+
+def _slice_index(device):
+    idx = getattr(device, "slice_index", None)
+    return 0 if idx is None else int(idx)
+
+
+def slice_mesh():
+    """A ``("slice", "chip")`` mesh making the ICI/DCN boundary explicit.
+
+    On a multi-slice job, collectives over the ``chip`` axis ride ICI
+    within each slice and collectives over the ``slice`` axis cross DCN
+    — the fabric split of the reference's cross-node vs intra-node MPI
+    (SURVEY §5.8: slice-local vs cross-slice subgroup detection).
+    Single-slice (and CPU) jobs degenerate to shape ``(1, n)``.
+    """
+    import numpy as np
+
+    devices = jax.devices()
+    slices = sorted({_slice_index(d) for d in devices})
+    by_slice = [
+        sorted(
+            (d for d in devices if _slice_index(d) == s),
+            key=lambda d: d.id,
+        )
+        for s in slices
+    ]
+    if len({len(b) for b in by_slice}) != 1:
+        raise ValueError(
+            "slices have unequal chip counts: "
+            f"{[len(b) for b in by_slice]}"
+        )
+    arr = np.array(by_slice, dtype=object)
+    return jax.sharding.Mesh(
+        arr,
+        ("slice", "chip"),
+        axis_types=(jax.sharding.AxisType.Auto,) * 2,
+    )
+
+
+def slice_comms():
+    """(world, intra_slice, cross_slice) communicators on the slice mesh.
+
+    ``intra_slice`` collectives run independently per slice over ICI;
+    ``cross_slice`` collectives connect corresponding chips of every
+    slice over DCN (the two-tier topology of SURVEY §5.8).
+    """
+    mesh = slice_mesh()
+    world = MeshComm.from_mesh(mesh)
+    return world, world.sub("chip"), world.sub("slice")

@@ -35,3 +35,40 @@ def test_world_comm_2d_and_default():
         assert comm.axis_sizes == (2, 4)
     finally:
         m.set_default_comm(None)
+
+
+def test_slice_mesh_and_comms():
+    # on the CPU test platform every device reports slice 0, so the mesh
+    # degenerates to (1, n) — the same program that runs multi-slice
+    from mpi4jax_tpu.parallel import distributed
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import mpi4jax_tpu as m
+
+    mesh = distributed.slice_mesh()
+    assert mesh.axis_names == ("slice", "chip")
+    assert mesh.devices.shape == (1, 8)
+
+    world, intra, cross = distributed.slice_comms()
+    assert world.size == 8 and intra.size == 8 and cross.size == 1
+
+    def fn(x):
+        a, tok = m.allreduce(x, m.SUM, comm=intra)   # ICI tier
+        b, tok = m.allreduce(x, m.SUM, comm=cross, token=tok)  # DCN tier
+        c, tok = m.allreduce(x, m.SUM, comm=world, token=tok)
+        return a, b, c
+
+    f = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=jax.P(("slice", "chip")),
+            out_specs=jax.P(("slice", "chip")),
+        )
+    )
+    a, b, c = f(jnp.arange(8.0))
+    assert np.array_equal(np.asarray(a), np.full(8, 28.0))  # whole slice
+    assert np.array_equal(np.asarray(b), np.arange(8.0))    # 1-slice: identity
+    assert np.array_equal(np.asarray(c), np.full(8, 28.0))
