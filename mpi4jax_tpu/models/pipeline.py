@@ -1,0 +1,130 @@
+"""Pipeline parallelism built from the communication primitives.
+
+The reference names the ring step (`sendrecv` to rank±1) as its "PP
+building block" and prescribes "PP microbatch loops in `lax.scan`"
+(SURVEY §2.4).  This module delivers that block as a working schedule:
+a GPipe-style pipeline where each rank of a ``pp`` communicator owns
+one stage, activations hand off along the chain via :func:`sendrecv`
+(one `ppermute` per tick on ICI), and the microbatch loop is a single
+``lax.scan`` — so the whole pipeline, bubbles and all, is one XLA
+executable.  Reverse-mode differentiation works end to end: the
+transpose of the forward handoff is the backward handoff in the
+opposite direction (the reference's sendrecv transpose contract,
+sendrecv.py:366-385).
+
+Schedule: with S stages and M microbatches, the scan runs T = M + S - 1
+ticks.  At tick t, stage s computes microbatch (t - s) when that index
+is valid; invalid (bubble) slots compute on zeros and are masked out.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4jax_tpu.ops._core import as_token, promote_vma
+from mpi4jax_tpu.ops.p2p import sendrecv
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, comm, *, token=None):
+    """Run a stage-sharded function as a pipeline over ``comm``.
+
+    Must be called inside the ``shard_map`` that shards stages over the
+    (single-axis) ``comm``.
+
+    Args:
+      stage_fn: ``(params, activation) -> activation`` — this rank's
+        stage (uniform signature across stages; rank-dependent behaviour
+        belongs in ``stage_params``).
+      stage_params: this rank's stage parameters.
+      microbatches: ``(M, mb, ...)`` — the input microbatches. Only
+        stage 0 reads them; other ranks pass the same-shaped array
+        (contents ignored) so the SPMD program is uniform.
+      comm: single-axis MeshComm; rank = stage index.
+      token: optional ordering token.
+
+    Returns:
+      ``(outputs, token)`` where ``outputs`` is ``(M, mb, ...)`` holding
+      the final-stage results on the **last** rank (other ranks hold
+      zeros — gather/bcast explicitly if every rank needs them,
+      mirroring the reference's rooted-output convention).
+    """
+    token = as_token(token)
+    if len(comm.axes) != 1:
+        raise ValueError("pipeline_apply needs a single-axis communicator")
+    n_stages = comm.size
+    n_micro = microbatches.shape[0]
+    rank = comm.rank()
+    mb_shape = microbatches.shape[1:]
+
+    fwd = [(r, r + 1) for r in range(n_stages - 1)]  # stage r -> r+1
+
+    # probe the activation shape/dtype: stage outputs must be uniform
+    # (pipeline handoff needs a static wire shape)
+    out_shape = jax.eval_shape(
+        stage_fn, stage_params, jax.ShapeDtypeStruct(
+            mb_shape, microbatches.dtype
+        )
+    )
+    if out_shape.shape != mb_shape or out_shape.dtype != microbatches.dtype:
+        raise ValueError(
+            "pipeline_apply requires shape/dtype-preserving stages (the "
+            "handoff wire doubles as the next stage's input): stage_fn "
+            f"maps {mb_shape}/{microbatches.dtype} -> "
+            f"{out_shape.shape}/{out_shape.dtype}"
+        )
+
+    def tick(carry, t):
+        incoming, outputs, token = carry
+        # stage 0 feeds itself from the microbatch buffer; other stages
+        # use the activation handed off at the previous tick
+        mb_idx = t - rank
+        valid = (mb_idx >= 0) & (mb_idx < n_micro)
+        safe_idx = jnp.clip(mb_idx, 0, n_micro - 1)
+        x0 = lax.dynamic_index_in_dim(
+            microbatches, safe_idx, keepdims=False
+        ).astype(incoming.dtype)
+        a_in = jnp.where(rank == 0, x0, incoming)
+        a_out = stage_fn(stage_params, a_in)
+        a_out = jnp.where(valid, a_out, jnp.zeros_like(a_out))
+        # last stage banks its result; everyone ships downstream
+        is_last = rank == n_stages - 1
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(
+                valid & is_last,
+                a_out,
+                lax.dynamic_index_in_dim(outputs, safe_idx, keepdims=False),
+            ),
+            safe_idx,
+            0,
+        )
+        if fwd:
+            incoming, token = sendrecv(
+                a_out,
+                jnp.zeros_like(a_out),
+                source=fwd,
+                dest=fwd,
+                comm=comm,
+                token=token,
+            )
+        else:
+            incoming = a_out
+        return (incoming, outputs, token), None
+
+    # the carries become device-varying after the first handoff; start
+    # them varying so the scan carry type is stable
+    incoming0 = promote_vma(
+        jnp.zeros(out_shape.shape, out_shape.dtype), comm.axes
+    )
+    outputs0 = promote_vma(
+        jnp.zeros((n_micro, *out_shape.shape), out_shape.dtype), comm.axes
+    )
+    token = token.with_stamp(promote_vma(token.stamp, comm.axes))
+    (_, outputs, token), _ = lax.scan(
+        tick,
+        (incoming0, outputs0, token),
+        jnp.arange(n_micro + n_stages - 1),
+    )
+    return outputs, token
