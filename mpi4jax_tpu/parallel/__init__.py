@@ -13,10 +13,13 @@ from mpi4jax_tpu.parallel.longseq import (
     ring_attention,
     ulysses_attention,
 )
+from mpi4jax_tpu.parallel import moe
+from mpi4jax_tpu.parallel.moe import expert_combine, expert_dispatch
 from mpi4jax_tpu.parallel.proc import ProcComm
 
 __all__ = [
     "distributed",
+    "moe",
     "Comm",
     "MeshComm",
     "SelfComm",
@@ -25,6 +28,8 @@ __all__ = [
     "local_attention",
     "ring_attention",
     "ulysses_attention",
+    "expert_dispatch",
+    "expert_combine",
     "default_comm",
     "get_default_comm",
     "set_default_comm",

@@ -1,0 +1,73 @@
+"""Expert parallelism built on the ``alltoall`` building block.
+
+The reference names ``alltoall`` as its expert-dispatch primitive
+(SURVEY §2.4 "Ulysses-style sequence parallel / EP dispatch building
+block", alltoall.py:35-74 there).  This module composes it into the
+standard MoE data path: tokens bucketed by destination expert, one
+``alltoall`` to deliver each expert its work, expert computation local,
+and the inverse ``alltoall`` + unsort to put results back in token
+order.  Differentiable end to end (``alltoall`` transposes to itself
+with the inverse layout).
+
+Capacity model: fixed capacity per (source rank, expert) of
+``tokens // n_experts`` — the capacity-factor-1.0 regime.  Callers pad
+or drop to balanced assignments first (static shapes are what make the
+dispatch one fused ICI collective instead of a host gather).
+"""
+
+import jax.numpy as jnp
+
+from mpi4jax_tpu.ops._core import as_token
+from mpi4jax_tpu.ops.collectives import alltoall
+
+__all__ = ["expert_dispatch", "expert_combine"]
+
+
+def expert_dispatch(x, expert_idx, comm, *, token=None):
+    """Route tokens to experts (expert e = rank e of ``comm``).
+
+    Must be called inside the comm's ``shard_map``.
+
+    Args:
+      x: ``(T, d)`` local tokens; ``T`` must be divisible by
+        ``comm.size``.
+      expert_idx: ``(T,)`` int — destination expert per token. Must be
+        **balanced**: exactly ``T // n_experts`` tokens per expert
+        (capacity factor 1.0).
+      comm: single-axis communicator; one expert per rank.
+
+    Returns:
+      ``(expert_input, order, token)`` where ``expert_input`` is
+      ``(n_ranks, capacity, d)`` — this rank's expert's tokens, one
+      capacity block per source rank — and ``order`` is the local sort
+      permutation needed by :func:`expert_combine`.
+    """
+    token = as_token(token)
+    n = comm.size
+    t_local, d = x.shape
+    if t_local % n:
+        raise ValueError(
+            f"token count {t_local} not divisible by {n} experts"
+        )
+    cap = t_local // n
+    # stable bucket-by-expert; balancedness makes the reshape exact
+    order = jnp.argsort(expert_idx, stable=True)
+    buckets = x[order].reshape(n, cap, d)
+    expert_input, token = alltoall(buckets, comm=comm, token=token)
+    return expert_input, order, token
+
+
+def expert_combine(expert_output, order, comm, *, token=None):
+    """Inverse of :func:`expert_dispatch`: return results to their
+    source ranks and original token order.
+
+    ``expert_output``: ``(n_ranks, capacity, d)`` — the local expert's
+    results, still grouped by source rank.
+    """
+    token = as_token(token)
+    n, cap, d = expert_output.shape
+    back, token = alltoall(expert_output, comm=comm, token=token)
+    flat = back.reshape(n * cap, d)
+    # O(T) permutation inverse (a second argsort would re-sort)
+    inv = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+    return flat[inv], token
