@@ -20,6 +20,56 @@ from mpi4jax_tpu.utils.runtime import best_mesh_shape, drain
 BASELINE_CELL_UPDATES_PER_SEC = 4.5e8  # 1x P100, BASELINE.md
 
 
+def allreduce_bandwidth(comm, reps=10, mb=64):
+    """allreduce GB/s on the live devices (second BASELINE.md metric).
+
+    With n > 1 devices this is NCCL-convention bus bandwidth
+    (``bytes * 2*(n-1)/n / t``).  On a single chip the collective is
+    elided by XLA, so the number reported is the payload rate of the
+    full dispatch+execute path (the quantity still bounds the op's cost
+    in a 1-chip program).  Timing/convention shared with the CLI sweep
+    (benchmarks/collectives.py).
+    """
+    from benchmarks.collectives import bench_op
+
+    busbw, _dt, _payload = bench_op(comm, "allreduce", mb, reps=reps)
+    return busbw / 1e9
+
+
+def virtual_mesh_busbw(timeout=600):
+    """8-device virtual-mesh allreduce bus bandwidth via subprocess
+    (the axon sitecustomize pins jax_platforms, so the CPU mesh needs
+    its own process)."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "collectives.py"
+    try:
+        out = subprocess.run(
+            [
+                sys.executable, str(script), "--cpu-mesh", "8",
+                "--sizes-mb", "16", "--reps", "10", "--ops", "allreduce",
+            ],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # stray non-JSON output (warnings etc.)
+            if rec.get("metric") == "allreduce_busbw":
+                return rec["value"]
+        if out.returncode != 0:
+            print(
+                f"[bench] virtual-mesh sweep rc={out.returncode}: "
+                f"{out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] virtual-mesh sweep failed: {exc}", file=sys.stderr)
+    return None
+
+
 def main():
     import jax
 
@@ -98,6 +148,22 @@ def main():
 
     rate = cells * total_steps / elapsed
     per_chip = rate / n_dev
+
+    # second BASELINE.md metric: allreduce GB/s (real chip + 8-device
+    # virtual mesh), carried as extra keys on the same driver-parsed
+    # line.  Guarded: a failure here must not discard the already-
+    # measured shallow-water result.
+    del state, multi, candidates
+    extras = {}
+    try:
+        extras["allreduce_gbps"] = round(allreduce_bandwidth(comm), 2)
+        extras["allreduce_devices"] = n_dev
+    except Exception as exc:  # noqa: BLE001
+        print(f"[bench] allreduce sweep failed: {exc}", file=sys.stderr)
+    vmesh_gbps = virtual_mesh_busbw()
+    if vmesh_gbps is not None:
+        extras["allreduce_busbw_cpu8_gbps"] = vmesh_gbps
+
     print(
         json.dumps(
             {
@@ -105,6 +171,7 @@ def main():
                 "value": round(per_chip, 1),
                 "unit": "cell-updates/s/chip",
                 "vs_baseline": round(per_chip / BASELINE_CELL_UPDATES_PER_SEC, 4),
+                **extras,
             }
         )
     )

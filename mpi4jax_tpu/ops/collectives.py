@@ -153,13 +153,62 @@ def barrier(*, comm=None, token=None):
     raise _unsupported("barrier", comm)
 
 
+def _bcast_schedule(size, nbytes):
+    """Pick the bcast schedule.
+
+    ``tree`` (binomial ppermute ladder) does ``ceil(log2 n)`` rounds —
+    latency-optimal on high-latency fabrics, total traffic
+    ``~payload*log2(n)``.  ``psum`` (masked all-reduce) costs one ring
+    all-reduce, ``~2*(n-1)/n*payload``.  Measured on the 8-device
+    virtual mesh (docs/performance.md "bcast schedule measurement")
+    psum wins at every payload from 4 KB to 64 MB, so it is the
+    default; override with MPI4JAX_TPU_BCAST=tree|psum.
+    """
+    import os
+
+    del size, nbytes
+    forced = os.environ.get("MPI4JAX_TPU_BCAST")
+    if forced in ("tree", "psum"):
+        return forced
+    return "psum"
+
+
+def _bcast_psum(xv, root, comm):
+    """Masked all-reduce: non-root contributions zeroed, one psum
+    delivers the root's value everywhere."""
+    rank = comm.rank()
+    masked = jnp.where(rank == root, xv, jnp.zeros_like(xv))
+    return reductions.group_psum(masked, comm.axes, comm.groups)
+
+
+def _bcast_tree(xv, root, comm):
+    """Binomial-tree broadcast: round k ppermutes the payload from the
+    first ``2**k`` (root-relative) ranks to the next ``2**k``."""
+    size = comm.size
+    rank = comm.rank()
+    vrank = (rank - root) % size  # traced; perms below are static
+    acc = jnp.where(rank == root, xv, jnp.zeros_like(xv))
+    k = 1
+    while k < size:
+        pairs = [
+            ((v + root) % size, (v + k + root) % size)
+            for v in range(min(k, size - k))
+        ]
+        shifted = lax.ppermute(acc, comm.axes, comm.expand_perm(pairs))
+        acc = jnp.where((vrank >= k) & (vrank < 2 * k), shifted, acc)
+        k *= 2
+    return acc
+
+
 @publishes_token
 def bcast(x, root, *, comm=None, token=None):
     """Broadcast ``x`` from ``root`` to every rank (reference:
     mpi4jax/_src/collective_ops/bcast.py:36-72).
 
-    Implemented as a masked ``psum``: every non-root contribution is
-    zeroed, so one ICI all-reduce delivers the root's value everywhere.
+    Two mesh schedules (selected by :func:`_bcast_schedule`): masked
+    ``psum`` by default (measured fastest at every payload size), with
+    a binomial ``ppermute`` tree available via ``MPI4JAX_TPU_BCAST=tree``
+    for high-latency fabrics.
     """
     x, comm, token = _prologue(x, comm, token)
     root = check_root(root, comm)
@@ -168,12 +217,13 @@ def bcast(x, root, *, comm=None, token=None):
         return x, token
     if comm.backend == "mesh":
         token, (x,) = fence_in(token, x)
-        rank = comm.rank()
         as_int = x.dtype == jnp.bool_
         xv = x.astype(jnp.int8) if as_int else x
         xv = promote_vma(xv, comm.axes)
-        masked = jnp.where(rank == root, xv, jnp.zeros_like(xv))
-        y = reductions.group_psum(masked, comm.axes, comm.groups)
+        if _bcast_schedule(comm.size, xv.size * xv.dtype.itemsize) == "tree":
+            y = _bcast_tree(xv, root, comm)
+        else:
+            y = _bcast_psum(xv, root, comm)
         if as_int:
             y = y.astype(jnp.bool_)
         token, (y,) = fence_out(token, y)
@@ -313,10 +363,27 @@ def scatter(x, root, *, comm=None, token=None):
         xv = x.astype(jnp.int8) if as_int else x
         xv = promote_vma(xv, comm.axes)
         masked = jnp.where(rank == root, xv, jnp.zeros_like(xv))
-        from_root = reductions.group_psum(masked, comm.axes, comm.groups)
-        y = lax.dynamic_index_in_dim(from_root, rank, axis=0, keepdims=False)
+        # reduce-scatter of the masked buffer: rank r receives
+        # sum_over_ranks(row r) = root's row r.  O(payload) on the wire
+        # (ring reduce-scatter), vs O(size*payload) for a full psum.
+        y = _scatter_sum(masked, comm)
         if as_int:
             y = y.astype(jnp.bool_)
         token, (y,) = fence_out(token, y)
         return y, token
     raise _unsupported("scatter", comm)
+
+
+def _scatter_sum(masked, comm):
+    """``psum_scatter`` row ``rank`` of the summed buffer to each rank."""
+    if comm.groups is None:
+        return lax.psum_scatter(
+            masked, comm.axes, scatter_dimension=0, tiled=False
+        )
+    return lax.psum_scatter(
+        masked,
+        comm.axes,
+        scatter_dimension=0,
+        axis_index_groups=comm.groups,
+        tiled=False,
+    )

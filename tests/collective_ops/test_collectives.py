@@ -77,6 +77,19 @@ def test_bcast(comm1d, root):
     assert np.array_equal(np.asarray(out), np.full(SIZE, 10.0 * root))
 
 
+@pytest.mark.parametrize("schedule", ["tree", "psum"])
+@pytest.mark.parametrize("root", [0, 3])
+def test_bcast_schedules_agree(comm1d, root, schedule, monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_BCAST", schedule)
+
+    def fn(x):
+        y, _ = m.bcast(x * 10, root, comm=comm1d)
+        return y
+
+    out = _run(comm1d, fn)
+    assert np.array_equal(np.asarray(out), np.full(SIZE, 10.0 * root))
+
+
 def test_bcast_bool(comm1d):
     def fn(x):
         y, _ = m.bcast(x[0] > 2, 5, comm=comm1d)
