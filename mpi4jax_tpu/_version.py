@@ -12,6 +12,7 @@ Resolution order:
 3. the static fallback (also what sdist-without-git builds get).
 """
 
+import re
 import subprocess
 from pathlib import Path
 
@@ -42,6 +43,23 @@ def _fallback():
 _FALLBACK = _fallback()
 
 
+def _munge_describe(desc):
+    """git-describe output -> PEP 440 version string."""
+    if desc.startswith("v"):
+        desc = desc[1:]
+    # pre-release tags (v0.1.0-rc1 / -a2 / -b3) become PEP 440
+    # pre-release segments (0.1.0rc1) — NOT local versions
+    # ('0.1.0+rc1' would sort *after* 0.1.0)
+    desc = re.sub(
+        r"^(\d[\d.]*)-(rc|a|b|alpha|beta)\.?(\d+)",
+        lambda m: m.group(1)
+        + {"alpha": "a", "beta": "b"}.get(m.group(2), m.group(2))
+        + m.group(3),
+        desc,
+    )
+    return desc.replace("-", "+", 1).replace("-", ".")
+
+
 def get_version():
     root = Path(__file__).resolve().parent.parent
     try:
@@ -61,9 +79,7 @@ def get_version():
         if not desc:
             desc = git("describe", "--tags", "--dirty", "--match", "[0-9]*")
         if desc:
-            if desc.startswith("v"):
-                desc = desc[1:]
-            return desc.replace("-", "+", 1).replace("-", ".")
+            return _munge_describe(desc)
         sha = git("rev-parse", "--short", "HEAD")
         if sha:
             return f"{_FALLBACK}+g{sha}"

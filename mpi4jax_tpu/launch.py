@@ -30,7 +30,7 @@ def child_main(argv):
     """Entry for worker processes (internal)."""
     prog, *prog_args = argv
     platform = os.environ.get("T4J_PLATFORM")
-    if platform:
+    if platform and platform != "default":
         import jax
 
         jax.config.update("jax_platforms", platform)
@@ -46,7 +46,13 @@ def child_main(argv):
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="mpi4jax_tpu.launch")
     parser.add_argument("-np", "--nprocs", type=int, required=False)
-    parser.add_argument("--platform", default="cpu")
+    parser.add_argument(
+        "--platform",
+        default="cpu",
+        help="jax platform to pin workers to (default: cpu). Pass "
+        "'default' to leave the site/environment platform untouched — "
+        "e.g. to run workers against a real accelerator.",
+    )
     parser.add_argument(
         "--shims",
         action="store_true",

@@ -698,10 +698,14 @@ def _mesh_specs(comm):
     return SWState(*([spec] * 6))
 
 
-def make_multistep(cfg, comm, num_steps):
+def make_multistep(cfg, comm, num_steps, *, donate=False):
     """Jitted global function advancing the model ``num_steps`` steps —
     the reference's ``do_multistep`` (shallow_water.py:415-420): the whole
     loop is one XLA executable.
+
+    ``donate=True`` donates the input state's buffers (in-place update;
+    the passed-in state is consumed).  Saves one full state copy per
+    call — use it for ``state = multi(state)``-style driver loops.
     """
 
     def local_fn(state):
@@ -715,7 +719,8 @@ def make_multistep(cfg, comm, num_steps):
     return jax.jit(
         jax.shard_map(
             local_fn, mesh=comm.mesh, in_specs=(specs,), out_specs=specs
-        )
+        ),
+        donate_argnums=(0,) if donate else (),
     )
 
 

@@ -9,6 +9,8 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <cerrno>
+
 #include <atomic>
 #include <chrono>
 #include <complex>
@@ -142,6 +144,7 @@ void write_all(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
   while (n > 0) {
     ssize_t w = ::write(fd, p, n);
+    if (w < 0 && errno == EINTR) continue;  // signal without SA_RESTART
     if (w <= 0) die("socket write");
     p += w;
     n -= static_cast<size_t>(w);
@@ -153,6 +156,7 @@ bool read_all(int fd, void* buf, size_t n) {
   while (n > 0) {
     ssize_t r = ::read(fd, p, n);
     if (r == 0) return false;  // peer closed
+    if (r < 0 && errno == EINTR) continue;  // signal without SA_RESTART
     if (r < 0) {
       // a local shutdown() wakes blocked readers with an error; that is
       // the clean teardown path, not a transport failure
@@ -212,7 +216,10 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
   std::lock_guard<std::mutex> lk(p.send_mu);
   // header + body in one syscall (one TCP segment for small frames)
   iovec iov[2] = {{&h, sizeof(h)}, {const_cast<void*>(buf), nbytes}};
-  ssize_t w = ::writev(p.fd, iov, nbytes ? 2 : 1);
+  ssize_t w;
+  do {
+    w = ::writev(p.fd, iov, nbytes ? 2 : 1);
+  } while (w < 0 && errno == EINTR);  // signal without SA_RESTART
   if (w < 0) die("socket writev");
   size_t done = static_cast<size_t>(w);
   if (done < sizeof(h)) {
@@ -674,7 +681,10 @@ int init_from_env() {
   g_rank = std::atoi(rank_s);
   g_size = std::atoi(size_s);
   if (g_size < 1 || g_rank < 0 || g_rank >= g_size) die("T4J_RANK/T4J_SIZE");
-  const char* dbg = std::getenv("MPI4JAX_TPU_DEBUG");
+  // The native LogScope has its own switch, separate from the Python
+  // layer's MPI4JAX_TPU_DEBUG: with both keyed to one var every MPI
+  // call would log two begin/done pairs with different call ids.
+  const char* dbg = std::getenv("MPI4JAX_TPU_NATIVE_DEBUG");
   if (dbg && dbg[0] && std::strcmp(dbg, "0") != 0) g_logging = true;
 
   if (g_size > 1) {

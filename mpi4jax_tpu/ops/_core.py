@@ -22,6 +22,8 @@ eager-send/matching-recv semantics at trace time instead of at runtime.
 import threading
 from dataclasses import dataclass
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -306,6 +308,29 @@ def _first_array(tree):
     return None
 
 
+def _rid_str(code):
+    """8-char call id from a 32-bit code (the reference uses 8 random
+    alphanumerics, mpi_xla_bridge.pyx:47-52)."""
+    chars = []
+    code = int(code) & 0xFFFFFFFF
+    for _ in range(8):
+        code, r = divmod(code, len(_ALNUM))
+        chars.append(_ALNUM[r])
+    return "".join(chars)
+
+
+# per-execution timers, keyed by the execution-unique (rank, call id):
+# concurrent executions of one call site cannot collide on the key
+_debug_timers = {}
+_debug_timers_mu = threading.Lock()
+
+
+def _scalar(v):
+    """First element of a possibly-batched callback operand (vmap may
+    hand the callback a stacked value; the id is replicated)."""
+    return int(np.ravel(np.asarray(v))[0])
+
+
 def _debug_begin(name, args, kwargs, comm):
     """Stage the reference-format begin line and start the call timer.
 
@@ -315,62 +340,99 @@ def _debug_begin(name, args, kwargs, comm):
     ``MPI_<Op> done with code 0 (1.23e-04s)`` line from
     :func:`_debug_end`.  Toggled by MPI4JAX_TPU_DEBUG /
     utils.config.set_debug; zero cost when disabled (nothing is staged
-    at trace time).  The id/timer state is per call *site*; concurrent
-    executions of one site may interleave ids (debug tooling only).
+    at trace time).
+
+    Structure (three callbacks per op, for transform-safety AND
+    execution-unique pairing):
+
+    * a ``pure_callback`` whose only operands are the rank and a
+      trace-time nonce generates the per-execution id and a fallback
+      start time.  Keeping user data out of its operands keeps it out
+      of reach of JVP/vmap traces — ``pure_callback`` supports neither
+      (the reference suite runs grad/vmap tests with logging enabled).
+    * the begin/done lines print from ``jax.debug.callback`` (which is
+      transform-proof by design), data-dependent on the op's
+      operands/results for best-effort placement, carrying the id.
+    * timers pair begin→done through :data:`_debug_timers` keyed by the
+      unique id, so concurrent executions of one call site cannot
+      mispair (the done callback falls back to the generated start time
+      if it somehow runs before its begin — callbacks are unordered).
     """
     import random
     import time
 
-    import jax.debug
-
     arr = _first_array((args, kwargs))
     nitems = int(arr.size) if arr is not None else 0
     opname = "MPI_" + name.capitalize()
-    state = {}
     try:
         rank = comm.rank()
     except Exception:
         rank = -1
 
-    def begin_cb(rank_val, *_deps):
-        # state keyed by rank: one jit execution runs this once per
-        # device in the process, and each device's done line must carry
-        # its own id/timer
-        rid = "".join(random.choices(_ALNUM, k=8))
-        state[int(rank_val)] = (rid, time.perf_counter())
+    def gen_cb(rank_val, _nonce):
+        hi, lo = divmod(time.perf_counter_ns(), 1 << 31)
+        return (
+            np.uint32(random.getrandbits(32)),
+            np.int32(hi),
+            np.int32(lo),
+        )
+
+    # trace-time nonce: makes each call site's generator unique so XLA
+    # can't CSE two otherwise-identical callbacks into one id
+    nonce = jnp.uint32(random.getrandbits(32))
+    rid, t_hi, t_lo = jax.pure_callback(
+        gen_cb,
+        (
+            jax.ShapeDtypeStruct((), np.uint32),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+        ),
+        jnp.asarray(rank),
+        nonce,
+    )
+
+    def begin_cb(rank_val, rid_val, *_deps):
+        r, i = _scalar(rank_val), _scalar(rid_val)
+        with _debug_timers_mu:
+            # bound the dict: entries orphan when a done callback ran
+            # before its begin (unordered callbacks) or an execution
+            # aborted between the two; evict oldest-inserted first
+            while len(_debug_timers) >= 4096:
+                _debug_timers.pop(next(iter(_debug_timers)))
+            _debug_timers[(r, i)] = time.perf_counter_ns()
         print(
-            f"r{int(rank_val)} | {rid} | {opname} with {nitems} items",
+            f"r{r} | {_rid_str(i)} | {opname} with {nitems} items",
             flush=True,
         )
 
     deps = (arr,) if arr is not None else ()
-    jax.debug.callback(begin_cb, jnp.asarray(rank), *deps)
-    state["opname"] = opname
-    state["rank"] = rank
-    return state
+    jax.debug.callback(begin_cb, jnp.asarray(rank), rid, *deps)
+    return {"opname": opname, "rank": rank, "carry": (rid, t_hi, t_lo)}
 
 
 def _debug_end(state, out):
     import time
 
-    import jax.debug
-
     opname = state["opname"]
 
-    def end_cb(rank_val, *_deps):
-        rid, t0 = state.get(
-            int(rank_val), ("????????", time.perf_counter())
-        )
-        dt = time.perf_counter() - t0
+    def end_cb(rank_val, rid, t_hi, t_lo, *_deps):
+        r, i = _scalar(rank_val), _scalar(rid)
+        with _debug_timers_mu:
+            t0_ns = _debug_timers.pop(
+                (r, i), (_scalar(t_hi) << 31) + _scalar(t_lo)
+            )
+        dt = (time.perf_counter_ns() - t0_ns) / 1e9
         print(
-            f"r{int(rank_val)} | {rid} | {opname} done with code 0 "
+            f"r{r} | {_rid_str(i)} | {opname} done with code 0 "
             f"({dt:.2e}s)",
             flush=True,
         )
 
     arr = _first_array(out)
     deps = (arr,) if arr is not None else ()
-    jax.debug.callback(end_cb, jnp.asarray(state["rank"]), *deps)
+    jax.debug.callback(
+        end_cb, jnp.asarray(state["rank"]), *state["carry"], *deps
+    )
 
 
 def publishes_token(fn):

@@ -41,6 +41,20 @@ _OP_CODES = {
 }
 
 
+def _op_code(op):
+    """Wire code for a built-in reduction op; user-defined ops have no
+    native encoding on the multi-process backend."""
+    if getattr(op, "is_user", False):
+        raise NotImplementedError(
+            f"user-defined reduction op {op.name!r} is not supported on "
+            "the multi-process (proc) backend: the native bridge reduces "
+            "with a fixed op table. Use a built-in op, or run the "
+            "reduction on the mesh backend (MeshComm), where arbitrary "
+            "Op.create combines lower to on-device code."
+        )
+    return _OP_CODES[op.name]
+
+
 def _handle(comm):
     from mpi4jax_tpu.native import runtime
 
@@ -76,7 +90,38 @@ def _call(name, results, *operands, **attrs):
     return fn(*operands, **attrs)
 
 
+_hcb_state = {"supported": None}
+
+
+def host_callback_supported():
+    """Probe (once) whether the default backend can run host callbacks.
+
+    Standard libtpu/CUDA PJRT can; the experimental axon tunnel raises
+    UNIMPLEMENTED ("does not support host send/recv callbacks").  CPU
+    always can.
+    """
+    if _hcb_state["supported"] is None:
+        if jax.default_backend() == "cpu":
+            _hcb_state["supported"] = True
+        else:
+            from jax.experimental import io_callback
+
+            try:
+                out = io_callback(
+                    lambda v: np.asarray(v),
+                    jax.ShapeDtypeStruct((), np.float32),
+                    jnp.float32(0),
+                )
+                jax.block_until_ready(out)
+                _hcb_state["supported"] = True
+            except Exception:
+                _hcb_state["supported"] = False
+    return _hcb_state["supported"]
+
+
 def _io(py_fn, results, *operands):
+    if not host_callback_supported():
+        return _eager_host_hop(py_fn, results, operands)
     from jax.experimental import io_callback
 
     # ordered=False: ordered IO effects need runtime token support some
@@ -85,6 +130,31 @@ def _io(py_fn, results, *operands):
     # through its callback — which is this library's ordering model
     # everywhere else (ops/_core.py docstring).
     return io_callback(py_fn, results, *operands, ordered=False)
+
+
+def _eager_host_hop(py_fn, results, operands):
+    """Explicit staging for runtimes with no host-callback support (the
+    axon tunnel): device_get the operands, run the host collective,
+    device_put the results back — the reference's COPY_TO_HOST hop
+    (mpi_xla_bridge_gpu.pyx:211-251) done eagerly at the op boundary.
+
+    Only possible outside jit: under a trace there is no way to reach
+    the host mid-executable without callback support.
+    """
+    import jax.core
+
+    if any(isinstance(o, jax.core.Tracer) for o in operands):
+        raise NotImplementedError(
+            "this accelerator runtime has no host-callback support, so "
+            "multi-process (proc) collectives cannot run inside jit. "
+            "Call the op eagerly (outside jit), or run the process on "
+            "the CPU backend, or use a MeshComm for in-jit collectives."
+        )
+    host_ops = [np.asarray(jax.device_get(o)) for o in operands]
+    out = py_fn(*host_ops)
+    if isinstance(results, (tuple, list)):
+        return tuple(jax.device_put(np.asarray(r)) for r in out)
+    return jax.device_put(np.asarray(out))
 
 
 def _staged_data(comm, out_sds, host_fn, x, stamp):
@@ -111,7 +181,7 @@ _STATUS = jax.ShapeDtypeStruct((2,), np.int32)
 
 def proc_allreduce(x, stamp, op, comm):
     if _staged():
-        code = _OP_CODES[op.name]
+        code = _op_code(op)
         return _staged_data(
             comm, _sds(x),
             lambda rt, h, a: rt.host_allreduce(h, a, code), x, stamp,
@@ -122,13 +192,13 @@ def proc_allreduce(x, stamp, op, comm):
         x,
         stamp,
         comm=_handle(comm),
-        op=np.int32(_OP_CODES[op.name]),
+        op=np.int32(_op_code(op)),
     )
 
 
 def proc_reduce(x, stamp, op, comm, root):
     if _staged():
-        code = _OP_CODES[op.name]
+        code = _op_code(op)
         return _staged_data(
             comm, _sds(x),
             lambda rt, h, a: rt.host_reduce(h, a, code, root), x, stamp,
@@ -139,14 +209,14 @@ def proc_reduce(x, stamp, op, comm, root):
         x,
         stamp,
         comm=_handle(comm),
-        op=np.int32(_OP_CODES[op.name]),
+        op=np.int32(_op_code(op)),
         root=np.int32(root),
     )
 
 
 def proc_scan(x, stamp, op, comm):
     if _staged():
-        code = _OP_CODES[op.name]
+        code = _op_code(op)
         return _staged_data(
             comm, _sds(x),
             lambda rt, h, a: rt.host_scan(h, a, code), x, stamp,
@@ -157,7 +227,7 @@ def proc_scan(x, stamp, op, comm):
         x,
         stamp,
         comm=_handle(comm),
-        op=np.int32(_OP_CODES[op.name]),
+        op=np.int32(_op_code(op)),
     )
 
 

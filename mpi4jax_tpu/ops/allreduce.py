@@ -91,7 +91,7 @@ def _allreduce_abstract_eval(x, stamp, *, op, comm, transpose):
 def _allreduce_jvp(primals, tangents, *, op, comm, transpose):
     # Reference semantics: tangent rides the same token chain as the
     # primal so the two collectives stay ordered (allreduce.py:164-179).
-    if op.name != "sum":
+    if op.name != "sum" or op.is_user:
         raise NotImplementedError(
             "JVP of allreduce is only defined for op=SUM "
             "(reference: allreduce.py:168-171)"
@@ -111,7 +111,7 @@ def _allreduce_jvp(primals, tangents, *, op, comm, transpose):
 
 
 def _allreduce_transpose(cts, x, stamp, *, op, comm, transpose):
-    if op.name != "sum":
+    if op.name != "sum" or op.is_user:
         raise NotImplementedError(
             "transpose of allreduce is only defined for op=SUM"
         )

@@ -307,7 +307,9 @@ def scan(x, op, *, comm=None, token=None):
                 [(r, r + dist) for r in range(size - dist)]
             )
             shifted = lax.ppermute(acc, comm.axes, perm)
-            combined = op.combine(acc, shifted.astype(acc.dtype))
+            # lower-rank prefix on the left: correct for non-commutative
+            # (user-defined, commute=False) operators
+            combined = op.combine(shifted.astype(acc.dtype), acc)
             acc = jnp.where(rank >= dist, combined.astype(acc.dtype), acc)
             dist *= 2
         if as_int:

@@ -139,7 +139,9 @@ def _resolve_pairs(spec, size, role):
         f"(all {size} devices would target the same rank, which is not a "
         f"permutation). Pass a callable rank->partner (e.g. "
         f"lambda r: (r + 1) % size), an explicit list of (source, dest) "
-        f"pairs, or use comm.shift_perm(axis, disp)."
+        f"pairs, or use comm.shift_perm(axis, disp). Per-rank integer "
+        f"addressing, as in MPI, works on the multi-process backend "
+        f"(python -m mpi4jax_tpu.launch)."
     )
 
 
@@ -378,10 +380,21 @@ def sendrecv(
                     f"{sorted(spairs)}. They must describe one global "
                     "permutation."
                 )
-        token, (payload,) = fence_in(token, sendbuf)
-        y = _ppermute(payload, comm.axes, comm.expand_perm(dpairs))
-        y = _recv_merge(y, recvbuf, dpairs, comm)
-        token, (y,) = fence_out(token, y)
+        pairs_global = comm.expand_perm(dpairs)
+        if all(s == d for s, d in pairs_global):
+            # pure self-exchange (periodic wrap on a size-1 mesh axis):
+            # no data crosses devices, so there is no cross-device
+            # ordering to enforce — skip the token fences entirely.
+            # This lets XLA fuse across the op: on a single chip the
+            # whole solver step becomes a handful of fusions instead of
+            # being cut at every (elided) exchange.
+            y = _recv_merge(_ppermute(sendbuf, comm.axes, pairs_global),
+                            recvbuf, dpairs, comm)
+        else:
+            token, (payload,) = fence_in(token, sendbuf)
+            y = _ppermute(payload, comm.axes, pairs_global)
+            y = _recv_merge(y, recvbuf, dpairs, comm)
+            token, (y,) = fence_out(token, y)
         if status is not None:
             status.source = _static_source_of(dpairs, comm)
             status.tag = sendtag

@@ -37,25 +37,70 @@ __all__ = [
 
 @dataclass(frozen=True)
 class Op:
-    """A reduction operator usable as a static (hashable) primitive param."""
+    """A reduction operator usable as a static (hashable) primitive param.
+
+    User-defined operators are constructed with :meth:`Op.create` — the
+    analog of ``MPI.Op.Create`` (the reference passes such handles
+    straight to MPI_Allreduce, mpi4jax/_src/utils.py:77-96).  Two
+    ``create`` calls yield distinct ops even with the same name (the
+    combine function's identity participates in equality/hashing), so a
+    recompile is keyed correctly.
+    """
 
     name: str
+    user_combine: object = None  # callable (a, b) -> c, elementwise
+    user_identity: object = None  # scalar identity element, or None
+    commute: bool = True
+
+    @classmethod
+    def create(cls, combine, *, name="user_op", identity=None, commute=True):
+        """Build a user-defined reduction operator (MPI.Op.Create analog).
+
+        ``combine`` must be an associative, elementwise, jax-traceable
+        binary function (MPI imposes the same associativity
+        requirement).  ``commute=False`` guarantees rank-order
+        application, like MPI's commute flag.  ``identity`` is optional
+        and unused by the current lowerings (reductions fold over the
+        gathered operands in rank order).
+        """
+        if not callable(combine):
+            raise TypeError("combine must be callable, got " + repr(combine))
+        return cls(
+            name=name,
+            user_combine=combine,
+            user_identity=identity,
+            commute=commute,
+        )
+
+    @property
+    def is_user(self):
+        return self.user_combine is not None
 
     def combine(self, a, b):
+        if self.is_user:
+            return self.user_combine(a, b)
         return _COMBINE[self.name](a, b)
 
     def identity(self, dtype):
+        if self.is_user:
+            if self.user_identity is None:
+                raise ValueError(
+                    f"user-defined op {self.name!r} has no identity element"
+                )
+            return np.asarray(self.user_identity, dtype)
         return _IDENTITY[self.name](dtype)
 
     @property
     def is_logical(self):
-        return self.name in ("land", "lor", "lxor")
+        return not self.is_user and self.name in ("land", "lor", "lxor")
 
     @property
     def is_bitwise(self):
-        return self.name in ("band", "bor", "bxor")
+        return not self.is_user and self.name in ("band", "bor", "bxor")
 
     def __repr__(self):
+        if self.is_user:
+            return f"mpi4jax_tpu.Op.create({self.name!r})"
         return f"mpi4jax_tpu.{self.name.upper()}"
 
 
@@ -164,6 +209,17 @@ def mesh_allreduce(x, op, axes, groups=None):
 
     x = promote_vma(x, axes)
     dtype = x.dtype
+    if op.is_user:
+        # User-defined op (MPI.Op.Create analog): all_gather, then fold
+        # the per-rank operands IN RANK ORDER — correct for
+        # non-commutative ops, matching MPI's commute=False contract.
+        gathered = lax.all_gather(
+            x, axes, axis=0, tiled=False, axis_index_groups=groups
+        )
+        acc = gathered[0]
+        for i in range(1, gathered.shape[0]):
+            acc = op.combine(acc, gathered[i])
+        return acc
     if op.name in ("sum", "lxor") and groups is not None:
         # shard_map's grouped psum is unimplemented in current JAX; the
         # grouped all_gather path is, so sum per subgroup via gather+add.

@@ -42,8 +42,11 @@ def initialize(**kwargs):
     or passed through as keyword arguments).  Single-process sessions
     (no cluster env, no explicit arguments) are left untouched.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    # NB: probe initialization state WITHOUT jax.process_count() — that
+    # call initialises the XLA backend, after which
+    # jax.distributed.initialize refuses to run
+    if jax.distributed.is_initialized():
+        return
     try:
         jax.distributed.initialize(**kwargs)
     except (ValueError, RuntimeError):

@@ -15,6 +15,8 @@ corner cells are correct after two rounds — same transitive-corner trick
 as the reference's clockwise ordering.
 """
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -25,11 +27,17 @@ __all__ = ["halo_exchange_2d", "halo_exchange_2d_batch"]
 
 
 def _axis_shift(arr_slice, template, comm, axis, disp, periodic, token):
-    """One directional exchange along ``axis`` (disp = ±1)."""
+    """One directional exchange along ``axis`` (disp = ±1).
+
+    Returns ``(halo, token)``; ``halo is None`` signals a global no-op
+    (non-periodic shift on a size-1 axis) — every device keeps its
+    existing ghost values, so the caller can skip the ghost write
+    entirely instead of re-writing identical values.
+    """
     sub = comm.sub(axis)
     pairs = sub.shift_perm(axis, disp, periodic=periodic)
     if not pairs:
-        return template, token
+        return None, token
     return sendrecv(
         arr_slice,
         template,
@@ -101,7 +109,7 @@ def _exchange(arrs, comm, *, periodic, token, width, stack):
                 jnp.stack(slabs), jnp.stack(templates), comm, axis, disp,
                 per, token,
             )
-            return list(halo)
+            return [None] * len(slabs) if halo is None else list(halo)
         out = []
         for slab, template in zip(slabs, templates):
             halo, token = _axis_shift(
@@ -110,28 +118,36 @@ def _exchange(arrs, comm, *, periodic, token, width, stack):
             out.append(halo)
         return out
 
+    def write(arrs, halo, region):
+        # halo[i] is None on a global no-op shift: ghosts already hold
+        # the right values, skip the (identical) write
+        return [
+            a if halo[i] is None else a.at[region].set(halo[i])
+            for i, a in enumerate(arrs)
+        ]
+
     # --- x direction: full-height column slabs (corners ride along) ---
     halo = shift(
         [a[:, -2 * w : -w] for a in arrs], [a[:, :w] for a in arrs],
         "x", +1, per_x,
     )
-    arrs = [a.at[:, :w].set(halo[i]) for i, a in enumerate(arrs)]
+    arrs = write(arrs, halo, np.s_[:, :w])
     halo = shift(
         [a[:, w : 2 * w] for a in arrs], [a[:, -w:] for a in arrs],
         "x", -1, per_x,
     )
-    arrs = [a.at[:, -w:].set(halo[i]) for i, a in enumerate(arrs)]
+    arrs = write(arrs, halo, np.s_[:, -w:])
 
     # --- y direction: full-width row slabs (x halos already current) ---
     halo = shift(
         [a[-2 * w : -w, :] for a in arrs], [a[:w, :] for a in arrs],
         "y", +1, per_y,
     )
-    arrs = [a.at[:w, :].set(halo[i]) for i, a in enumerate(arrs)]
+    arrs = write(arrs, halo, np.s_[:w, :])
     halo = shift(
         [a[w : 2 * w, :] for a in arrs], [a[-w:, :] for a in arrs],
         "y", -1, per_y,
     )
-    arrs = [a.at[-w:, :].set(halo[i]) for i, a in enumerate(arrs)]
+    arrs = write(arrs, halo, np.s_[-w:, :])
 
     return arrs, token

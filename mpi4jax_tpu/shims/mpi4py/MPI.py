@@ -1,0 +1,17 @@
+"""The ``mpi4py.MPI`` shim submodule.
+
+Mirrors every public attribute of the compat layer's MPI namespace
+(operators, constants, Status, COMM_WORLD proxy, get_vendor) as module
+globals, so both ``from mpi4py import MPI`` and ``import mpi4py.MPI``
+resolve to one module with the full surface.
+"""
+
+import sys as _sys
+
+from mpi4jax_tpu.compat import MPI as _ns
+
+_mod = _sys.modules[__name__]
+for _k in dir(_ns):
+    if not _k.startswith("_"):
+        setattr(_mod, _k, getattr(_ns, _k))
+del _sys, _mod, _k

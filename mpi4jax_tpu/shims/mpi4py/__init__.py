@@ -1,0 +1,14 @@
+"""Shim package satisfying both ``from mpi4py import MPI`` and
+``import mpi4py.MPI`` (both forms are common in reference user code)
+with the compat layer's MPI namespace (operators, constants, Status,
+COMM_WORLD proxy).
+
+Only meaningful under the mpi4jax_tpu launcher (or a single process);
+see mpi4jax_tpu/shims/__init__.py.
+"""
+
+from . import MPI  # noqa: F401  (relative: this package is imported
+# both as top-level ``mpi4py`` — via the shim path — and as
+# ``mpi4jax_tpu.shims.mpi4py``)
+
+__all__ = ["MPI"]

@@ -4,9 +4,14 @@ Mirrors the reference's env-var config surface
 (mpi4jax/_src/decorators.py:37-42 truthy parser; MPI4JAX_DEBUG at
 xla_bridge/__init__.py:22) with the ``MPI4JAX_TPU_`` prefix:
 
-* ``MPI4JAX_TPU_DEBUG``      — per-call wire-format logging on host paths
-* ``MPI4JAX_TPU_NO_FENCE``   — drop optimization-barrier token fences
-                               (perf experiments only; ordering becomes UB)
+* ``MPI4JAX_TPU_DEBUG``        — per-call wire-format logging (Python op
+                                 layer; the reference's MPI4JAX_DEBUG)
+* ``MPI4JAX_TPU_NATIVE_DEBUG`` — the native DCN bridge's own LogScope
+                                 (separate switch so one MPI call never
+                                 logs two begin/done pairs)
+* ``MPI4JAX_TPU_NO_FENCE``     — drop optimization-barrier token fences
+                                 (perf experiments only; ordering
+                                 becomes UB)
 """
 
 import os
@@ -40,17 +45,12 @@ def set_debug(enabled):
     """Runtime toggle (overrides the env var; None resets to env).
 
     Mirrors the reference's ``mpi_xla_bridge.set_logging``
-    (mpi_xla_bridge.pyx:38-40): also forwards to the native DCN bridge's
-    per-call logger when the multi-process backend is loaded.
+    (mpi_xla_bridge.pyx:38-40).  Toggles the Python-layer per-op log
+    only; the native DCN bridge's LogScope has its own switch
+    (``MPI4JAX_TPU_NATIVE_DEBUG`` / ``native.runtime.set_logging``) so
+    one MPI call never logs two begin/done pairs with different ids.
     """
     _state["debug"] = enabled
-    try:
-        from mpi4jax_tpu.native import runtime
-
-        if runtime._state["lib"] is not None:
-            runtime.set_logging(bool(enabled))
-    except Exception:
-        pass
 
 
 def fences_enabled():

@@ -1,0 +1,147 @@
+"""User-defined reduction operators (Op.create — the MPI.Op.Create
+analog; the reference forwards such handles straight to MPI_Allreduce,
+mpi4jax/_src/utils.py:77-96 + collective_ops/allreduce.py:36-66)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+
+SIZE = 8
+
+
+def _run(comm, fn, x=None):
+    f = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=comm.mesh,
+            in_specs=jax.P(comm.axes),
+            out_specs=jax.P(comm.axes),
+        )
+    )
+    return f(jnp.arange(float(SIZE)) if x is None else x)
+
+
+def test_create_requires_callable():
+    with pytest.raises(TypeError, match="callable"):
+        m.Op.create("not-a-function")
+
+
+def test_custom_commutative_matches_builtin(comm1d):
+    my_max = m.Op.create(jnp.maximum, name="my_max")
+
+    def fn(x):
+        y, _ = m.allreduce(x, my_max, comm=comm1d)
+        return y
+
+    out = _run(comm1d, fn)
+    assert np.array_equal(np.asarray(out), np.full(SIZE, 7.0))
+
+
+def test_custom_noncommutative_rank_order(comm1d):
+    # MPI commute=False contract: operands combined in rank order.
+    # LEFT keeps the lowest rank's operand, RIGHT the highest's.
+    left = m.Op.create(lambda a, b: a, name="left", commute=False)
+    right = m.Op.create(lambda a, b: b, name="right", commute=False)
+
+    def fn(x):
+        lo, tok = m.allreduce(x, left, comm=comm1d)
+        hi, tok = m.allreduce(x, right, comm=comm1d, token=tok)
+        return lo * 10 + hi
+
+    out = _run(comm1d, fn)
+    assert np.array_equal(np.asarray(out), np.full(SIZE, 0.0 * 10 + 7.0))
+
+
+def test_custom_scan_rank_order(comm1d):
+    # inclusive prefix with RIGHT-projection == each rank's own value;
+    # with LEFT-projection == rank 0's value everywhere.  Exercises the
+    # ladder's lower-rank-on-the-left operand order.
+    left = m.Op.create(lambda a, b: a, name="left", commute=False)
+    right = m.Op.create(lambda a, b: b, name="right", commute=False)
+
+    def fn(x):
+        a, tok = m.scan(x, left, comm=comm1d)
+        b, tok = m.scan(x, right, comm=comm1d, token=tok)
+        return a * 10 + b
+
+    out = np.asarray(_run(comm1d, fn))
+    assert np.array_equal(out, np.zeros(SIZE) * 10 + np.arange(8.0))
+
+
+def test_custom_scan_associative(comm1d):
+    # a genuinely mixing associative op: 2x2 matrix product flattened
+    # into the last axis (affine-recurrence composition — the classic
+    # non-commutative scan payload)
+    def matmul2(a, b):
+        a2 = a.reshape(*a.shape[:-1], 2, 2)
+        b2 = b.reshape(*b.shape[:-1], 2, 2)
+        return jnp.matmul(a2, b2).reshape(a.shape)
+
+    op = m.Op.create(matmul2, name="matmul2", commute=False)
+    # per-rank matrix [[1, r], [0, 1]]; prefix product = [[1, sum r], [0, 1]]
+    def fn(x):
+        r = x[0]
+        mat = jnp.stack([1.0, r, 0.0, 1.0])[None]  # (1, 4) per rank
+        y, _ = m.scan(mat, op, comm=comm1d)
+        return y
+
+    out = np.asarray(_run(comm1d, fn))  # (8, 4)
+    prefix = np.cumsum(np.arange(8.0))
+    expected = np.stack(
+        [np.ones(8), prefix, np.zeros(8), np.ones(8)], axis=1
+    )
+    assert np.allclose(out, expected)
+
+
+def test_custom_reduce(comm1d):
+    my_sum = m.Op.create(jnp.add, name="my_sum")
+
+    def fn(x):
+        y, _ = m.reduce(x, my_sum, 0, comm=comm1d)
+        return y
+
+    out = _run(comm1d, fn)
+    assert np.asarray(out)[0] == 28.0
+
+
+def test_custom_op_self_backend(selfcomm):
+    op = m.Op.create(jnp.minimum, name="my_min")
+    y, _ = m.allreduce(jnp.float32(3.0), op, comm=selfcomm)
+    assert float(y) == 3.0
+    s, _ = m.scan(jnp.float32(4.0), op, comm=selfcomm)
+    assert float(s) == 4.0
+
+
+def test_custom_op_not_differentiable(comm1d):
+    op = m.Op.create(jnp.add, name="sum")  # even named "sum"
+
+    def fn(x):
+        def loss(v):
+            return m.allreduce(v, op, comm=comm1d)[0].sum()
+
+        return jax.grad(loss)(x)
+
+    with pytest.raises(NotImplementedError, match="op=SUM"):
+        _run(comm1d, fn)
+
+
+def test_custom_op_hash_identity():
+    f = jnp.add
+    a = m.Op.create(f, name="x")
+    b = m.Op.create(f, name="x")
+    c = m.Op.create(jnp.multiply, name="x")
+    assert a == b  # same combine fn + name
+    assert a != c  # different combine fn, despite same name
+    assert hash(a) == hash(b)
+
+
+def test_custom_op_rejected_on_proc_backend():
+    from mpi4jax_tpu.ops._proc import _op_code
+
+    op = m.Op.create(jnp.add, name="weird")
+    with pytest.raises(NotImplementedError, match="mesh backend"):
+        _op_code(op)
+    assert _op_code(m.SUM) == 0

@@ -19,7 +19,9 @@ import pytest
 REPO = pathlib.Path(__file__).resolve().parent.parent.parent
 
 
-def run_workers(body, nprocs=2, env=None, timeout=150, expect_fail=False):
+def run_workers(
+    body, nprocs=2, env=None, timeout=150, expect_fail=False, launch_args=()
+):
     """Launch ``body`` (worker script source) across ``nprocs`` ranks."""
     import os
     import tempfile
@@ -41,7 +43,10 @@ def run_workers(body, nprocs=2, env=None, timeout=150, expect_fail=False):
     # would leave deadlocked workers holding the capture pipe open and
     # the timeout would never actually fire.
     popen = subprocess.Popen(
-        [sys.executable, "-m", "mpi4jax_tpu.launch", "-np", str(nprocs), path],
+        [
+            sys.executable, "-m", "mpi4jax_tpu.launch",
+            "-np", str(nprocs), *launch_args, path,
+        ],
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
@@ -262,6 +267,30 @@ print(f"WORKER_OK {rank}", flush=True)
         r"\(\d\.\d{2}e[+-]?\d+s\)",
         out,
     ), out
+
+
+def test_native_debug_log_wire_format():
+    # the native DCN bridge's own LogScope, on its separate switch
+    # (MPI4JAX_TPU_NATIVE_DEBUG): same reference wire format, logged
+    # from C++ around the actual wire operation
+    import re
+
+    proc = run_workers(
+        PREAMBLE
+        + """
+x = jnp.ones((2,))
+res, tok = m.allreduce(x, m.SUM, comm=comm)
+np.asarray(res)
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=2,
+        env={"MPI4JAX_TPU_NATIVE_DEBUG": "1", "MPI4JAX_TPU_DEBUG": "0"},
+    )
+    out = proc.stdout
+    assert re.search(r"r\d+ \| \w{8} \| MPI_Allreduce", out), out
+    # only the native layer logged: exactly one begin line per rank
+    begins = re.findall(r"MPI_Allreduce with", out)
+    assert len(begins) == 2, out
 
 
 def test_invalid_rank_raises_eagerly():

@@ -6,6 +6,63 @@ MPI4JAX_TPU_FORCE_STAGED=1 exercises the identical code path on CPU."""
 from tests.proc.test_proc_backend import run_workers
 
 
+def test_staged_ops_real_accelerator():
+    """One proc-backend op set with arrays genuinely on an accelerator.
+
+    With host-callback support the io_callback path stages HBM->host;
+    without it (axon tunnel) the eager device_get/put hop runs.  Skips
+    itself when the worker only sees CPU devices.
+    """
+    res = run_workers(
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import mpi4jax_tpu as m
+
+        if jax.default_backend() == "cpu":
+            print("no accelerator visible; skipping")
+            raise SystemExit(0)
+
+        comm = m.get_default_comm()
+        assert comm.backend == "proc", comm
+        x = jnp.arange(4.0)  # lives on the accelerator
+        assert "cpu" not in str(x.device).lower(), x.device
+
+        tok = m.create_token()
+        y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        g, tok = m.allgather(x[:2], comm=comm, token=tok)
+        b, tok = m.bcast(x * 3, 0, comm=comm, token=tok)
+        tok = m.barrier(comm=comm, token=tok)
+        assert "cpu" not in str(y.device).lower(), y.device  # result back on device
+        assert np.allclose(np.asarray(y), np.arange(4.0) * comm.size), y
+        assert np.asarray(g).shape == (comm.size, 2), g
+        assert np.allclose(np.asarray(b), 3 * np.arange(4.0)), b
+
+        from mpi4jax_tpu.ops._proc import host_callback_supported
+        if not host_callback_supported():
+            # without callbacks, in-jit proc collectives must raise the
+            # curated guidance, not a raw UNIMPLEMENTED from the runtime
+            try:
+                jax.jit(lambda v: m.allreduce(v, m.SUM, comm=comm)[0])(x)
+                raise AssertionError("expected NotImplementedError under jit")
+            except NotImplementedError as e:
+                assert "host-callback" in str(e), e
+
+        path = "io_callback" if host_callback_supported() else "eager hop"
+        print(f"rank {comm.rank()} real-accelerator staged ok via {path}")
+        """,
+        nprocs=1,
+        timeout=300,
+        launch_args=("--platform", "default"),
+    )
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert (
+        "real-accelerator staged ok" in res.stdout
+        or "skipping" in res.stdout
+    ), (res.stdout, res.stderr)
+
+
 def test_staged_ops_across_processes():
     res = run_workers(
         """

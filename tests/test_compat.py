@@ -72,3 +72,33 @@ def test_compat_sendrecv_status(comm1d):
 
     out = spmd_jit(comm1d, fn)(jnp.arange(8.0))
     assert np.array_equal(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_shim_supports_both_mpi4py_import_forms():
+    """Reference user code uses both ``from mpi4py import MPI`` and
+    ``import mpi4py.MPI``; the shim package must satisfy both in one
+    process and hand back the same module."""
+    import subprocess
+    import sys
+
+    from mpi4jax_tpu import shims
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import mpi4py.MPI as M1\n"
+        "from mpi4py import MPI as M2\n"
+        "assert M1 is M2\n"
+        "assert M1.SUM.name == 'sum'\n"
+        "assert callable(M1.get_vendor)\n"
+        "print('ok')\n"
+    )
+    env_path = shims.path() + ":" + ":".join(sys.path)
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env={"PYTHONPATH": env_path, "PATH": "/usr/bin:/bin"},
+        timeout=120,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "ok" in out.stdout

@@ -91,6 +91,55 @@ def test_debug_log_wire_format(comm1d, capfd):
     assert ids_by_rank(begins) == ids_by_rank(dones), (begins, dones)
 
 
+def test_debug_ids_survive_concurrent_executions(comm1d, capfd):
+    """Two executions of ONE jitted call site running concurrently must
+    emit correctly paired begin/done ids (the id and start time are
+    threaded through the computation, not kept in per-site state)."""
+    import threading
+
+    config.set_debug(True)
+    try:
+
+        def fn(x):
+            y, _ = m.allreduce(x, m.SUM, comm=comm1d)
+            return y
+
+        jitted = jax.jit(spmd(comm1d, fn))
+        jax.block_until_ready(jitted(jnp.arange(8.0)))  # compile outside
+        capfd.readouterr()  # drop warm-up lines
+
+        results = []
+
+        def run():
+            results.append(jax.block_until_ready(jitted(jnp.arange(8.0))))
+
+        threads = [threading.Thread(target=run) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        jax.effects_barrier()
+    finally:
+        config.set_debug(None)
+
+    captured = capfd.readouterr().out
+    begins, dones = {}, {}
+    for line in captured.splitlines():
+        if "MPI_Allreduce" not in line:
+            continue
+        rank, rid, rest = line.split(" | ", 2)
+        bucket = dones if "done" in rest else begins
+        bucket.setdefault((rank, rid), 0)
+        bucket[(rank, rid)] += 1
+    # 4 runs x 8 devices, every (rank, id) pair appears exactly once on
+    # each side, and the id sets match exactly — no '????????' orphans,
+    # no reused or crossed ids
+    assert sum(begins.values()) == 4 * SIZE, captured
+    assert begins == dones, captured
+    assert all(n == 1 for n in begins.values()), captured
+    assert not any("????????" in line for line in captured.splitlines())
+
+
 def test_debug_disabled_stages_nothing(comm1d):
     """With debug off, no host callback may appear in the lowered IR."""
     config.set_debug(False)

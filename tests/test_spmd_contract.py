@@ -1,0 +1,91 @@
+"""The SPMD matching contract (docs/sharp-bits.md "The matching
+contract, case by case"): every mesh-backend divergence from MPI raises
+with guidance that names the escape hatch.  These tests pin the
+guidance text."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mpi4jax_tpu as m
+
+
+def test_bare_int_dest_names_launcher(comm1d):
+    with pytest.raises(ValueError) as e:
+        m.send(jnp.zeros(3), dest=1, comm=comm1d)
+    msg = str(e.value)
+    assert "ambiguous under SPMD" in msg
+    assert "mpi4jax_tpu.launch" in msg  # proc-backend escape hatch
+    assert "shift_perm" in msg  # the SPMD-native alternative
+
+
+def test_bare_int_source_names_launcher(comm1d):
+    with pytest.raises(ValueError) as e:
+        m.recv(jnp.zeros(3), source=2, comm=comm1d)
+    assert "mpi4jax_tpu.launch" in str(e.value)
+
+
+def test_unmatched_recv_names_proc_backend(comm1d):
+    def fn(x):
+        y, _ = m.recv(x, comm=comm1d)
+        return y
+
+    with pytest.raises(RuntimeError) as e:
+        jax.shard_map(
+            fn, mesh=comm1d.mesh, in_specs=jax.P("i"), out_specs=jax.P("i")
+        )(jnp.arange(8.0))
+    msg = str(e.value)
+    assert "same trace" in msg
+    assert "multi-process" in msg  # escape hatch
+
+
+def test_ragged_split_names_proc_backend(comm1d):
+    # colors 0:5 ranks / 1:3 ranks -> ragged
+    with pytest.raises(ValueError) as e:
+        comm1d.split(lambda r: 0 if r < 5 else 1)
+    msg = str(e.value)
+    assert "equal-size subgroups" in msg
+    assert "multi-process" in msg  # escape hatch
+
+
+def test_traced_root_hint(comm1d):
+    # a tracer leaking into a static arg must point at static_argnums
+    # (the reference's validation hint, validation.py:77-88 there)
+    def fn(x):
+        y, _ = m.bcast(x, root=jnp.int32(0), comm=comm1d)
+        return y
+
+    with pytest.raises(TypeError) as e:
+        jax.jit(
+            jax.shard_map(
+                fn, mesh=comm1d.mesh, in_specs=jax.P("i"), out_specs=jax.P("i")
+            )
+        )(jnp.arange(8.0))
+    assert "static" in str(e.value).lower()
+
+
+def test_any_source_is_trace_time_fifo(comm1d):
+    # Not an error: ANY_SOURCE on the mesh backend deterministically
+    # matches the EARLIEST staged send (documented trace-time FIFO).
+    ring = [(r, (r + 1) % 8) for r in range(8)]
+    back = [((r + 1) % 8, r) for r in range(8)]
+
+    def fn(x):
+        tok = m.send(x, ring, tag=7, comm=comm1d)
+        tok = m.send(x * 2, back, tag=9, comm=comm1d, token=tok)
+        st = m.Status()
+        y, tok = m.recv(x, comm=comm1d, token=tok, status=st)  # ANY/ANY
+        z, tok = m.recv(x, comm=comm1d, token=tok)
+        return y * 1000 + z
+
+    out = jax.jit(
+        jax.shard_map(
+            fn, mesh=comm1d.mesh, in_specs=jax.P("i"), out_specs=jax.P("i")
+        )
+    )(jnp.arange(8.0))
+    import numpy as np
+
+    arr = np.arange(8.0)
+    first = np.roll(arr, 1)  # earliest staged send: the tag-7 ring
+    second = np.roll(arr * 2, -1)
+    assert np.array_equal(np.asarray(out), first * 1000 + second)

@@ -40,3 +40,16 @@ def test_drain_after_collective(comm1d):
     f = spmd_jit(comm1d, lambda x: m.allreduce(x, m.SUM, comm=comm1d)[0])
     out = f(jnp.arange(8.0))
     assert drain(out) == 28.0
+
+
+def test_version_prerelease_tags_are_pep440():
+    """v0.1.0-rc1 must become the PEP 440 pre-release 0.1.0rc1 (which
+    sorts BEFORE 0.1.0), not the local version 0.1.0+rc1 (after)."""
+    from mpi4jax_tpu._version import _munge_describe as munge
+
+    assert munge("v0.1.0-rc1") == "0.1.0rc1"
+    assert munge("v0.1.0-rc1-3-gabc12") == "0.1.0rc1+3.gabc12"
+    assert munge("v0.2.0-alpha.2") == "0.2.0a2"
+    assert munge("v0.1.0-beta2") == "0.1.0b2"
+    assert munge("v0.1.0-5-gdef00") == "0.1.0+5.gdef00"
+    assert munge("v0.1.0") == "0.1.0"
