@@ -37,7 +37,20 @@ def main(argv=None):
         help="halo schedule, held FIXED across device counts so the "
         "efficiency ratio measures scaling, not schedule choice",
     )
+    p.add_argument(
+        "--cpu-mesh",
+        type=int,
+        default=0,
+        metavar="N",
+        help="force an N-device virtual CPU mesh (validates the harness "
+        "without real chips)",
+    )
     args = p.parse_args(argv)
+
+    if args.cpu_mesh:
+        from benchmarks.collectives import force_cpu_mesh
+
+        force_cpu_mesh(args.cpu_mesh)
 
     import jax
 

@@ -72,6 +72,17 @@ class Op:
             commute=commute,
         )
 
+    @classmethod
+    def Create(cls, function, commute=False):
+        """mpi4py-spelled alias of :meth:`create` (``MPI.Op.Create``).
+
+        mpi4py's op functions mutate raw buffers; here ``function`` must
+        be an elementwise, jax-traceable ``(a, b) -> c`` — the
+        functional equivalent (documented in docs/api.md).  Defaults
+        ``commute=False`` exactly as mpi4py does.
+        """
+        return cls.create(function, name="user_op", commute=commute)
+
     @property
     def is_user(self):
         return self.user_combine is not None

@@ -145,3 +145,18 @@ def test_custom_op_rejected_on_proc_backend():
     with pytest.raises(NotImplementedError, match="mesh backend"):
         _op_code(op)
     assert _op_code(m.SUM) == 0
+
+
+def test_op_create_mpi4py_spelling(comm1d):
+    # compat path: MPI.Op.Create(fn, commute) — mpi4py's exact spelling
+    from mpi4jax_tpu.compat import MPI
+
+    op = MPI.Op.Create(jnp.minimum, commute=True)
+    assert op.is_user and op.commute
+
+    def fn(x):
+        y, _ = m.allreduce(x, op, comm=comm1d)
+        return y
+
+    out = _run(comm1d, fn)
+    assert np.array_equal(np.asarray(out), np.zeros(SIZE))
