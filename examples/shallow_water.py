@@ -56,6 +56,14 @@ def main(argv=None):
         help="collect one frame per multistep chunk and save an "
         "animation (the reference's matplotlib animation output)",
     )
+    p.add_argument(
+        "--checkpoint",
+        metavar="DIR",
+        help="save resumable checkpoints every --checkpoint-every "
+        "chunks; a rerun with the same DIR resumes from the latest "
+        "(timing then includes checkpoint writes)",
+    )
+    p.add_argument("--checkpoint-every", type=int, default=1)
     args = p.parse_args(argv)
 
     import jax
@@ -115,7 +123,12 @@ def main(argv=None):
             frames.append(np.asarray(jax.device_get(gather(state)[0])))
 
     solve = sw.make_solver(
-        cfg, comm, num_multisteps=args.multistep, on_chunk=on_chunk
+        cfg,
+        comm,
+        num_multisteps=args.multistep,
+        on_chunk=on_chunk,
+        checkpoint_dir=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
     )
     state, wall, steps = solve(days * sw.DAY_IN_SECONDS)
 

@@ -9,6 +9,9 @@ from mpi4jax_tpu.parallel.comm import (
 from mpi4jax_tpu.parallel import distributed
 from mpi4jax_tpu.parallel.halo import halo_exchange_2d
 from mpi4jax_tpu.parallel.longseq import (
+    zigzag_indices,
+    zigzag_shard,
+    zigzag_unshard,
     local_attention,
     ring_attention,
     ulysses_attention,
@@ -27,6 +30,9 @@ __all__ = [
     "halo_exchange_2d",
     "local_attention",
     "ring_attention",
+    "zigzag_indices",
+    "zigzag_shard",
+    "zigzag_unshard",
     "ulysses_attention",
     "expert_dispatch",
     "expert_combine",

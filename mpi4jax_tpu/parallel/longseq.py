@@ -36,7 +36,14 @@ from mpi4jax_tpu.ops._core import Token, as_token, publishes_token
 from mpi4jax_tpu.ops.collectives import alltoall
 from mpi4jax_tpu.ops.p2p import sendrecv
 
-__all__ = ["ring_attention", "ulysses_attention", "local_attention"]
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "local_attention",
+    "zigzag_indices",
+    "zigzag_shard",
+    "zigzag_unshard",
+]
 
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite mask value
 
@@ -81,8 +88,59 @@ def local_attention(
     return out.astype(q.dtype)
 
 
+def zigzag_indices(p, t_global):
+    """Global sequence positions each rank holds under the zigzag layout.
+
+    Rank r holds chunks ``r`` and ``2p-1-r`` of the 2p equal chunks —
+    the standard balanced-causal layout (Megatron context parallelism):
+    every rank then owns one "early" and one "late" chunk, so causal
+    masking wastes the same ~half of the score blocks on every rank
+    instead of idling rank 0 while rank p-1 computes everything.
+
+    Returns an int32 array of shape ``(p, t_global // p)``.
+    """
+    if t_global % (2 * p):
+        raise ValueError(
+            f"zigzag layout needs the global sequence divisible by "
+            f"2*comm.size = {2 * p}, got T={t_global}"
+        )
+    c = t_global // (2 * p)
+    import numpy as _np
+
+    rows = [
+        _np.concatenate(
+            [
+                _np.arange(r * c, (r + 1) * c),
+                _np.arange((2 * p - 1 - r) * c, (2 * p - r) * c),
+            ]
+        )
+        for r in range(p)
+    ]
+    return _np.stack(rows).astype(_np.int32)
+
+
+def zigzag_shard(x, p, axis=1):
+    """Reorder a globally-ordered array so a plain rank-major shard over
+    ``axis`` gives each rank its zigzag chunks (apply before sharding)."""
+    idx = zigzag_indices(p, x.shape[axis]).reshape(-1)
+    return jnp.take(x, jnp.asarray(idx), axis=axis)
+
+
+def zigzag_unshard(x, p, axis=1):
+    """Inverse of :func:`zigzag_shard` on the gathered global array."""
+    import numpy as _np
+
+    idx = zigzag_indices(p, x.shape[axis]).reshape(-1)
+    inv = _np.empty_like(idx)
+    inv[idx] = _np.arange(idx.size, dtype=_np.int32)
+    return jnp.take(x, jnp.asarray(inv), axis=axis)
+
+
 @publishes_token
-def ring_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
+def ring_attention(
+    q, k, v, comm, *, causal=False, scale=None, token=None,
+    layout="contiguous",
+):
     """Context-parallel attention over a 1-D ring communicator.
 
     Every device holds the local sequence block ``q``/``k``/``v`` of
@@ -96,6 +154,15 @@ def ring_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
     Reverse-mode AD reverses the permutation automatically — gradients
     ride the ring the opposite way, the exact transpose contract of the
     reference's sendrecv (sendrecv.py:366-385).
+
+    ``layout``: ``"contiguous"`` — rank r holds global positions
+    ``[r*T_local, (r+1)*T_local)``; ``"zigzag"`` — rank r holds chunks
+    ``r`` and ``2p-1-r`` (see :func:`zigzag_indices`), which balances
+    the causal-masking work across ranks (with contiguous blocks the
+    last rank attends to everything while rank 0 sees one block; the
+    ring is a barrier per step, so the slowest rank paces everyone).
+    Use :func:`zigzag_shard`/:func:`zigzag_unshard` to convert global
+    arrays.
     """
     token = as_token(token)
     p = comm.size
@@ -117,10 +184,28 @@ def ring_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
             f"got axes {comm.axes}; use comm.sub(axis)"
         )
 
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(
+            f"layout must be 'contiguous' or 'zigzag', got {layout!r}"
+        )
     rank = comm.rank()
     b, tq, h, _ = q.shape
     tk = k.shape[1]
-    qpos = rank * tq + jnp.arange(tq)
+    if layout == "zigzag":
+        if tq != tk:
+            raise ValueError(
+                f"zigzag layout requires equal q/kv block lengths, got "
+                f"Tq={tq}, Tk={tk} (the chunk table is shared)"
+            )
+        if tq % 2:
+            raise ValueError(
+                f"zigzag layout needs an even local block length "
+                f"(two chunks per rank), got T_local={tq}"
+            )
+        pos_table = jnp.asarray(zigzag_indices(p, p * tq))
+        qpos = pos_table[rank]
+    else:
+        qpos = rank * tq + jnp.arange(tq)
 
     # forward ring: the kv block moves to the next rank each step, so at
     # step i this rank holds the block that originated at rank - i
@@ -135,14 +220,18 @@ def ring_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
     l0 = promote_vma(jnp.zeros((b, h, tq), jnp.float32), comm.axes)
     token = token.with_stamp(promote_vma(token.stamp, comm.axes))
 
-    def attend(k_blk, v_blk, acc, m, l, kpos):
+    def attend(q_sub, qpos_sub, k_blk, v_blk, acc, m, l, kpos, *, mask):
+        """Online-softmax update of (acc, m, l) for the q rows in
+        ``q_sub``; ``mask=False`` asserts full visibility (no masking
+        work, no wasted score FLOPs beyond the block itself)."""
         s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+            "bqhd,bkhd->bhqk", q_sub, k_blk,
+            preferred_element_type=jnp.float32,
         )
         s = s * scale
-        if causal:
-            mask = qpos[:, None] >= kpos[None, :]
-            s = jnp.where(mask[None, None], s, _NEG)
+        if mask:
+            vis = qpos_sub[:, None] >= kpos[None, :]
+            s = jnp.where(vis[None, None], s, _NEG)
 
         m_new = jnp.maximum(m, s.max(axis=-1))
         corr = jnp.exp(m - m_new)
@@ -153,24 +242,78 @@ def ring_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
         )
         return acc_new, m_new, l_new
 
+    c = tq // 2  # zigzag chunk length
+
+    def zigzag_causal_update(i, src, k_blk, v_blk, acc, m, l):
+        """Chunk-level causal schedule for the zigzag layout.
+
+        Rank r's q chunks are (r, 2p-1-r); the step-i kv block holds
+        src's chunks (src, 2p-1-src).  Chunk-pair visibility collapses
+        to three cases, two of which need NO elementwise mask and only
+        HALF the block's scores — this is where the zigzag layout's
+        balance comes from (every rank does the same half-block of
+        work per off-diagonal step, vs the contiguous layout where one
+        rank computes a full block while another skips it):
+
+        * i == 0 (src == rank): the local block — diagonal chunks, one
+          masked full attend.
+        * src < rank: every q row sees ONLY src's early chunk
+          (k rows [:c]); late chunk entirely in the future.
+        * src > rank: only the late q chunk (rows [c:]) sees anything,
+          and it sees the WHOLE kv block.
+        """
+
+        def diag():
+            return attend(
+                q, qpos, k_blk, v_blk, acc, m, l, pos_table[src], mask=True
+            )
+
+        def lower():  # src < rank: all q vs early k chunk, unmasked
+            return attend(
+                q, qpos, k_blk[:, :c], v_blk[:, :c], acc, m, l, None,
+                mask=False,
+            )
+
+        def upper():  # src > rank: late q chunk vs full kv, unmasked
+            a2, m2, l2 = attend(
+                q[:, c:], None, k_blk, v_blk,
+                acc[:, c:], m[..., c:], l[..., c:], None, mask=False,
+            )
+            return (
+                acc.at[:, c:].set(a2),
+                m.at[..., c:].set(m2),
+                l.at[..., c:].set(l2),
+            )
+
+        return lax.cond(
+            i == 0, diag, lambda: lax.cond(src < rank, lower, upper)
+        )
+
     def step(carry, i):
         k_blk, v_blk, acc, m, l, stamp = carry
         src = (rank - i) % p
-        kpos = src * tk + jnp.arange(tk)
 
-        if causal:
+        if causal and layout == "zigzag":
+            acc, m, l = zigzag_causal_update(i, src, k_blk, v_blk, acc, m, l)
+        elif causal:
+            kpos = src * tk + jnp.arange(tk)
             # blocks entirely in this rank's future contribute nothing:
             # skip the attention math (the communication still happens —
             # the ring must keep rotating). Saves ~half the FLOPs of a
-            # causal ring on average.
+            # causal ring on average, but unevenly: at step i only the
+            # ranks with src <= rank do work (the zigzag layout is the
+            # balanced alternative).
             block_visible = qpos[-1] >= kpos[0]
             acc, m, l = lax.cond(
                 block_visible,
-                lambda: attend(k_blk, v_blk, acc, m, l, kpos),
+                lambda: attend(q, qpos, k_blk, v_blk, acc, m, l, kpos, mask=True),
                 lambda: (acc, m, l),
             )
         else:
-            acc, m, l = attend(k_blk, v_blk, acc, m, l, kpos)
+            kpos = None
+            acc, m, l = attend(
+                q, qpos, k_blk, v_blk, acc, m, l, kpos, mask=False
+            )
 
         tok = Token(stamp)
         k_blk, tok = sendrecv(k_blk, k_blk, source=perm, dest=perm, comm=comm, token=tok)

@@ -1,0 +1,138 @@
+"""Checkpoint / resume for sharded state (SURVEY §5.4: the reference has
+no checkpointing — its closest analog is gathering the solution to rank
+0 for post-processing, examples/shallow_water.py:586-593 there; this
+module makes resumable state a first-class subsystem).
+
+Built on orbax (the TPU-native checkpoint stack): each device writes its
+own shards (OCDBT), so saving a pod-sharded pytree never funnels the
+whole state through one host — the distributed analog of the
+reference's gather-to-root, without the gather.
+
+    from mpi4jax_tpu.utils import checkpoint as ckpt
+
+    ckpt.save(path, {"state": state, "step": step})
+    restored = ckpt.restore(path, like={"state": state, "step": step})
+
+``like`` supplies shapes/dtypes/shardings (pass the live pytree or one
+built from ``jax.eval_shape``); restored arrays come back with the same
+sharding they were saved from, ready to feed the next jitted step.
+"""
+
+import pathlib
+
+import jax
+
+__all__ = ["save", "restore", "latest_step", "Manager"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save(path, tree, *, force=True):
+    """Write ``tree`` (any pytree of arrays / scalars) to ``path``.
+
+    Safe for sharded arrays: every process writes only its addressable
+    shards.  ``force=True`` overwrites an existing checkpoint.
+    """
+    path = pathlib.Path(path).absolute()
+    ckptr = _checkpointer()
+    ckptr.save(path, tree, force=force)
+    ckptr.wait_until_finished()
+    ckptr.close()
+
+
+def restore(path, *, like):
+    """Read a pytree written by :func:`save`.
+
+    ``like`` is a pytree matching the saved structure whose leaves
+    provide shape/dtype/sharding — pass the live state (its values are
+    not read) or abstract leaves from ``jax.eval_shape`` with shardings
+    attached.
+    """
+    path = pathlib.Path(path).absolute()
+    abstract = jax.tree.map(_abstractify, like)
+    ckptr = _checkpointer()
+    try:
+        return ckptr.restore(path, abstract)
+    finally:
+        ckptr.close()
+
+
+def _abstractify(leaf):
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        sharding = getattr(leaf, "sharding", None)
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sharding)
+    return leaf
+
+
+def latest_step(directory):
+    """Highest step number saved by a :class:`Manager` in ``directory``,
+    or None."""
+    import orbax.checkpoint as ocp
+
+    directory = pathlib.Path(directory).absolute()
+    if not directory.exists():
+        return None
+    mgr = ocp.CheckpointManager(directory)
+    try:
+        return mgr.latest_step()
+    finally:
+        mgr.close()
+
+
+class Manager:
+    """Stepped checkpoint series with retention — resume-after-failure
+    for long solver / training runs (the elastic-recovery building block
+    the reference lacks, SURVEY §5.3/§5.4).
+
+        with checkpoint.Manager(dir, max_to_keep=3) as mgr:
+            start = mgr.latest_step() or 0
+            state = mgr.restore(start, like=state) if start else state
+            for step in range(start, n):
+                state = advance(state)
+                mgr.maybe_save(step + 1, state, every=100)
+    """
+
+    def __init__(self, directory, *, max_to_keep=3):
+        import orbax.checkpoint as ocp
+
+        self._mgr = ocp.CheckpointManager(
+            pathlib.Path(directory).absolute(),
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def save(self, step, tree):
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+
+    def maybe_save(self, step, tree, *, every):
+        if every and step % every == 0:
+            self.save(step, tree)
+            return True
+        return False
+
+    def restore(self, step, *, like):
+        import orbax.checkpoint as ocp
+
+        abstract = jax.tree.map(_abstractify, like)
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
