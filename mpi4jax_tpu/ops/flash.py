@@ -124,8 +124,22 @@ def flash_attention(
 
     ``scale`` and the offsets are trace-time constants (they are baked
     into the kernel); pass Python numbers, not traced values.
+
+    Grouped-query attention (``k``/``v`` with fewer heads, ``Hq % Hkv
+    == 0``) is supported by repeating kv heads before the kernel — the
+    VMEM streaming win is kept, at Hq/Hkv× kv HBM footprint; gradients
+    flow back through the repeat (summed per kv head).
     """
     d = q.shape[-1]
+    hq, hk = q.shape[2], k.shape[2]
+    if hq != hk:
+        if hq % hk:
+            raise ValueError(
+                f"flash_attention: query heads must be a multiple of kv "
+                f"heads, got Hq={hq}, Hkv={hk}"
+            )
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
     scale = (1.0 / math.sqrt(d)) if scale is None else float(scale)
     return _flash_vjp(
         q, k, v, bool(causal), scale, int(q_offset), int(k_offset),

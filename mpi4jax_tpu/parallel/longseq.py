@@ -48,19 +48,63 @@ __all__ = [
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite mask value
 
 
+def _check_gqa(hq, hk, where):
+    if hq % hk:
+        raise ValueError(
+            f"{where}: query heads must be a multiple of kv heads "
+            f"(grouped-query attention), got Hq={hq}, Hkv={hk}"
+        )
+
+
+def _scores(q, k, scale):
+    """q·kᵀ with GQA support: query head h attends kv head ``h // g``
+    (g = Hq/Hkv).  Returns [B, Hq, Tq, Tk] f32 scores."""
+    b, tq, hq, d = q.shape
+    hk = k.shape[2]
+    if hq == hk:
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
+    else:
+        _check_gqa(hq, hk, "attention")
+        g = hq // hk
+        qg = q.reshape(b, tq, hk, g, d)
+        s = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+        ).reshape(b, hq, tq, k.shape[1])
+    return s * scale
+
+
+def _weighted_values(w, v, hq):
+    """w·v with GQA support; ``w``: [B, Hq, Tq, Tk], ``v``: [B, Tk, Hkv, D]."""
+    hk = v.shape[2]
+    if hq == hk:
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    g = hq // hk
+    b, _, tq, tk = w.shape
+    wg = w.reshape(b, hk, g, tq, tk)
+    return jnp.einsum("bhgqk,bkhd->bqhgd", wg, v).reshape(
+        b, tq, hq, v.shape[-1]
+    )
+
+
 def local_attention(
     q, k, v, *, causal=False, scale=None, q_offset=0, k_offset=0, impl="auto"
 ):
     """Single-device attention: softmax(q k^T) v.
 
-    ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D].  ``*_offset`` are
-    the global positions of the first row/column (for causal masking of
-    sharded blocks).  Accumulates in float32.
+    ``q``: [B, Tq, Hq, D]; ``k``/``v``: [B, Tk, Hkv, D] with
+    ``Hq % Hkv == 0`` — grouped-query attention (query head h attends
+    kv head ``h // (Hq/Hkv)``; Hkv == Hq is plain MHA, Hkv == 1 is
+    MQA).  ``*_offset`` are the global positions of the first
+    row/column (for causal masking of sharded blocks).  Accumulates in
+    float32.
 
     ``impl``: ``"xla"`` — dense (materialises the [Tq, Tk] scores, the
     oracle); ``"flash"`` — the Pallas VMEM-blocked kernel
     (ops/flash.py); ``"auto"`` — flash on TPU, dense elsewhere.
     """
+    _check_gqa(q.shape[2], k.shape[2], "local_attention")
     if impl == "auto":
         impl = (
             "flash"
@@ -76,15 +120,14 @@ def local_attention(
         )
     d = q.shape[-1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    s = s * scale
+    s = _scores(q, k, scale)
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(k.shape[1])
         mask = qpos[:, None] >= kpos[None, :]
         s = jnp.where(mask[None, None], s, _NEG)
     w = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+    out = _weighted_values(w.astype(v.dtype), v, q.shape[2])
     return out.astype(q.dtype)
 
 
@@ -169,6 +212,14 @@ def ring_attention(
     d = q.shape[-1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
 
+    # validate BEFORE the single-rank shortcut, so a bad layout string /
+    # GQA mismatch fails in 1-device tests too, not first at scale
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(
+            f"layout must be 'contiguous' or 'zigzag', got {layout!r}"
+        )
+    _check_gqa(q.shape[2], k.shape[2], "ring_attention")
+
     if comm.backend == "self" or p == 1:
         out = local_attention(q, k, v, causal=causal, scale=scale)
         return out, token
@@ -184,10 +235,6 @@ def ring_attention(
             f"got axes {comm.axes}; use comm.sub(axis)"
         )
 
-    if layout not in ("contiguous", "zigzag"):
-        raise ValueError(
-            f"layout must be 'contiguous' or 'zigzag', got {layout!r}"
-        )
     rank = comm.rank()
     b, tq, h, _ = q.shape
     tk = k.shape[1]
@@ -224,11 +271,7 @@ def ring_attention(
         """Online-softmax update of (acc, m, l) for the q rows in
         ``q_sub``; ``mask=False`` asserts full visibility (no masking
         work, no wasted score FLOPs beyond the block itself)."""
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q_sub, k_blk,
-            preferred_element_type=jnp.float32,
-        )
-        s = s * scale
+        s = _scores(q_sub, k_blk, scale)
         if mask:
             vis = qpos_sub[:, None] >= kpos[None, :]
             s = jnp.where(vis[None, None], s, _NEG)
@@ -237,9 +280,9 @@ def ring_attention(
         corr = jnp.exp(m - m_new)
         w = jnp.exp(s - m_new[..., None])
         l_new = l * corr + w.sum(axis=-1)
-        acc_new = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", w, v_blk.astype(jnp.float32)
-        )
+        acc_new = acc * corr.transpose(0, 2, 1)[
+            ..., None
+        ] + _weighted_values(w, v_blk.astype(jnp.float32), q_sub.shape[2])
         return acc_new, m_new, l_new
 
     c = tq // 2  # zigzag chunk length
@@ -353,16 +396,25 @@ def ulysses_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
         )
 
     b, t, h, d = q.shape
-    if h % p:
-        raise ValueError(
-            f"ulysses_attention needs heads divisible by the ring size: "
-            f"H={h}, comm.size={p}"
-        )
-    hp = h // p
+    hk = k.shape[2]
+    _check_gqa(h, hk, "ulysses_attention")
+    for name, heads in (("query", h), ("kv", hk)):
+        if heads % p:
+            raise ValueError(
+                f"ulysses_attention needs {name} heads divisible by the "
+                f"ring size: H={heads}, comm.size={p}"
+                + (
+                    " (for GQA with fewer kv heads than ranks, repeat kv "
+                    "heads to a multiple of comm.size first)"
+                    if name == "kv"
+                    else ""
+                )
+            )
 
     def to_heads(x, tok):
         # [B, T, H, D] -> rows [p, T, B, hp, D] -> alltoall -> full seq
         # for this rank's head subset [B, p*T, hp, D]
+        hp = x.shape[2] // p
         blocks = x.reshape(b, t, p, hp, d).transpose(2, 1, 0, 3, 4)
         mixed, tok = alltoall(blocks, comm=comm, token=tok)
         # row j now holds rank j's sequence block for our heads
@@ -370,6 +422,7 @@ def ulysses_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
 
     def to_seq(x, tok):
         # inverse of to_heads
+        hp = x.shape[2]
         blocks = x.reshape(b, p, t, hp, d).transpose(1, 2, 0, 3, 4)
         mixed, tok = alltoall(blocks, comm=comm, token=tok)
         return mixed.transpose(2, 1, 0, 3, 4).reshape(b, t, p * hp, d), tok

@@ -1,0 +1,141 @@
+"""Grouped-query attention (Hkv < Hq) across the attention stack: the
+dense path, the flash kernel, and both context-parallel schemes, all
+against a kv-head-repeated MHA oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4jax_tpu.parallel import (
+    local_attention,
+    ring_attention,
+    ulysses_attention,
+    zigzag_shard,
+    zigzag_unshard,
+)
+
+SIZE = 8
+B, T, HQ, HK, D = 2, 32, 8, 2, 16
+
+
+def gqa_qkv(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, T, HQ, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, HK, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, HK, D), jnp.float32)
+    return q, k, v
+
+
+def oracle(q, k, v, causal):
+    g = q.shape[2] // k.shape[2]
+    return local_attention(
+        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
+        causal=causal, impl="xla",
+    )
+
+
+from tests.parallel.test_longseq import run_sharded  # shared harness
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_local_gqa_matches_repeated_mha(causal):
+    q, k, v = gqa_qkv()
+    got = local_attention(q, k, v, causal=causal, impl="xla")
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(oracle(q, k, v, causal)),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_dense(causal):
+    from mpi4jax_tpu.ops.flash import flash_attention
+
+    q, k, v = gqa_qkv(seed=1)
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(oracle(q, k, v, causal)),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_flash_gqa_grads():
+    from mpi4jax_tpu.ops.flash import flash_attention
+
+    q, k, v = gqa_qkv(seed=2)
+
+    def loss_flash(q_, k_, v_):
+        return (flash_attention(q_, k_, v_, causal=True, interpret=True) ** 2).sum()
+
+    def loss_dense(q_, k_, v_):
+        return (oracle(q_, k_, v_, True) ** 2).sum()
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for g_, w_ in zip(got, want):
+        assert g_.shape == w_.shape  # kv grads keep the Hkv head count
+        np.testing.assert_allclose(
+            np.asarray(g_), np.asarray(w_), rtol=3e-4, atol=3e-4
+        )
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gqa_matches_dense(comm1d, causal, layout):
+    q, k, v = gqa_qkv(seed=3)
+
+    def fn(ql, kl, vl):
+        out, _ = ring_attention(
+            ql, kl, vl, comm1d, causal=causal, layout=layout
+        )
+        return out
+
+    if layout == "zigzag":
+        got = run_sharded(
+            comm1d, fn,
+            zigzag_shard(q, SIZE), zigzag_shard(k, SIZE), zigzag_shard(v, SIZE),
+        )
+        got = zigzag_unshard(got, SIZE)
+    else:
+        got = run_sharded(comm1d, fn, q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(oracle(q, k, v, causal)),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_gqa_matches_dense(comm1d, causal):
+    # HK = 8 here: kv heads must divide the ring size on ulysses
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (B, T, 16, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, 8, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, 8, D), jnp.float32)
+
+    def fn(ql, kl, vl):
+        out, _ = ulysses_attention(ql, kl, vl, comm1d, causal=causal)
+        return out
+
+    got = run_sharded(comm1d, fn, q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(oracle(q, k, v, causal)),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_ulysses_gqa_kv_heads_guidance(comm1d):
+    q, k, v = gqa_qkv()  # HK=2 < SIZE=8
+
+    def fn(ql, kl, vl):
+        out, _ = ulysses_attention(ql, kl, vl, comm1d)
+        return out
+
+    with pytest.raises(ValueError, match="repeat kv"):
+        run_sharded(comm1d, fn, q, k, v)
+
+
+def test_gqa_head_mismatch_raises():
+    q, k, v = gqa_qkv()
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        local_attention(q, k[:, :, :1].repeat(3, axis=2), v, impl="xla")
