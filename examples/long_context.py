@@ -32,11 +32,27 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--seq-per-device", type=int, default=256)
     p.add_argument("--heads", type=int, default=8)
+    p.add_argument(
+        "--kv-heads",
+        type=int,
+        default=None,
+        help="fewer kv heads than query heads = grouped-query attention "
+        "(default: same as --heads)",
+    )
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--causal", action="store_true")
+    p.add_argument(
+        "--force-cpu",
+        action="store_true",
+        help="run on virtual CPU devices (honours "
+        "--xla_force_host_platform_device_count in XLA_FLAGS)",
+    )
     args = p.parse_args(argv)
 
     import jax
+
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import mpi4jax_tpu as m
     from mpi4jax_tpu.parallel import longseq
@@ -48,37 +64,53 @@ def main(argv=None):
     comm = m.MeshComm.from_mesh(mesh)
 
     B, S, H, D = 2, args.seq_per_device * n, args.heads, args.head_dim
+    HK = args.kv_heads if args.kv_heads is not None else H
     assert H % n == 0, "heads must divide the ring size for Ulysses"
     keys = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
-    k = jax.random.normal(keys[1], (B, S, H, D), jnp.float32)
-    v = jax.random.normal(keys[2], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, HK, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, HK, D), jnp.float32)
 
     def run(scheme):
-        def local(q, k, v):
-            fn = (
-                longseq.ring_attention
-                if scheme == "ring"
-                else longseq.ulysses_attention
-            )
-            out, _ = fn(q, k, v, comm, causal=args.causal)
+        def local(ql, kl, vl):
+            if scheme == "ring":
+                out, _ = longseq.ring_attention(ql, kl, vl, comm, causal=args.causal)
+            elif scheme == "ring-zigzag":
+                # balanced-causal layout: every rank does the same
+                # half-block of work per ring step
+                out, _ = longseq.ring_attention(
+                    ql, kl, vl, comm, causal=args.causal, layout="zigzag"
+                )
+            else:
+                out, _ = longseq.ulysses_attention(ql, kl, vl, comm, causal=args.causal)
             return out
 
-        return jax.jit(
+        arrs = (q, k, v)
+        if scheme == "ring-zigzag":
+            arrs = tuple(longseq.zigzag_shard(a, n) for a in arrs)
+        out = jax.jit(
             jax.shard_map(
                 local,
                 mesh=mesh,
                 in_specs=(jax.P(None, "sp"),) * 3,
                 out_specs=jax.P(None, "sp"),
             )
-        )(q, k, v)
+        )(*arrs)
+        if scheme == "ring-zigzag":
+            out = longseq.zigzag_unshard(out, n)
+        return out
 
-    reference = longseq.local_attention(q, k, v, causal=args.causal)
-    for scheme in ("ring", "ulysses"):
+    reference = longseq.local_attention(q, k, v, causal=args.causal, impl="xla")
+    schemes = ["ring", "ring-zigzag"]
+    if HK % n == 0:
+        schemes.append("ulysses")
+    else:
+        print(f"ulysses skipped: kv heads {HK} not divisible by {n} devices")
+    for scheme in schemes:
         out = run(scheme)
         err = float(jnp.max(jnp.abs(out - reference)))
         print(
-            f"{scheme:8s}: global seq {S} over {n} devices "
+            f"{scheme:12s}: global seq {S} over {n} devices "
             f"({args.seq_per_device}/device), max |err| vs single-device "
             f"attention = {err:.2e}"
         )

@@ -49,3 +49,12 @@ def test_dp_tp_example_runs():
 
 def test_long_context_example_runs():
     _run_example("long_context", ["--seq-per-device", "32", "--causal"])
+
+
+def test_long_context_example_gqa():
+    # grouped-query attention path (kv heads < query heads); ulysses
+    # self-skips when kv heads don't divide the device count
+    _run_example(
+        "long_context",
+        ["--seq-per-device", "32", "--causal", "--kv-heads", "2"],
+    )
