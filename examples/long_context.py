@@ -65,7 +65,6 @@ def main(argv=None):
 
     B, S, H, D = 2, args.seq_per_device * n, args.heads, args.head_dim
     HK = args.kv_heads if args.kv_heads is not None else H
-    assert H % n == 0, "heads must divide the ring size for Ulysses"
     keys = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
     k = jax.random.normal(keys[1], (B, S, HK, D), jnp.float32)
@@ -101,11 +100,21 @@ def main(argv=None):
         return out
 
     reference = longseq.local_attention(q, k, v, causal=args.causal, impl="xla")
-    schemes = ["ring", "ring-zigzag"]
-    if HK % n == 0:
+    schemes = ["ring"]
+    if S % (2 * n) == 0:
+        schemes.append("ring-zigzag")
+    else:
+        print(
+            f"ring-zigzag skipped: sequence {S} not divisible by "
+            f"2*{n} devices"
+        )
+    if H % n == 0 and HK % n == 0:
         schemes.append("ulysses")
     else:
-        print(f"ulysses skipped: kv heads {HK} not divisible by {n} devices")
+        print(
+            f"ulysses skipped: heads {H}/{HK} not both divisible by "
+            f"{n} devices"
+        )
     for scheme in schemes:
         out = run(scheme)
         err = float(jnp.max(jnp.abs(out - reference)))

@@ -261,11 +261,21 @@ def ring_attention(
     from mpi4jax_tpu.ops._core import promote_vma
 
     # carries become device-varying after the first step; start them
-    # varying so the scan carry type is stable
-    acc0 = promote_vma(jnp.zeros((b, tq, h, d), jnp.float32), comm.axes)
-    m0 = promote_vma(jnp.full((b, h, tq), _NEG, jnp.float32), comm.axes)
-    l0 = promote_vma(jnp.zeros((b, h, tq), jnp.float32), comm.axes)
-    token = token.with_stamp(promote_vma(token.stamp, comm.axes))
+    # varying so the scan carry type is stable.  The target set is the
+    # ring axis PLUS whatever axes the operands already vary on — on a
+    # multi-axis mesh (e.g. dp×tp×sp) q/k/v vary on every axis, and a
+    # carry promoted to "sp" alone would type-mismatch attend's outputs.
+    try:
+        operand_vma = (
+            jax.typeof(q).vma | jax.typeof(k).vma | jax.typeof(v).vma
+        )
+    except AttributeError:
+        operand_vma = frozenset()
+    carry_axes = tuple(dict.fromkeys((*comm.axes, *sorted(operand_vma))))
+    acc0 = promote_vma(jnp.zeros((b, tq, h, d), jnp.float32), carry_axes)
+    m0 = promote_vma(jnp.full((b, h, tq), _NEG, jnp.float32), carry_axes)
+    l0 = promote_vma(jnp.zeros((b, h, tq), jnp.float32), carry_axes)
+    token = token.with_stamp(promote_vma(token.stamp, carry_axes))
 
     def attend(q_sub, qpos_sub, k_blk, v_blk, acc, m, l, kpos, *, mask):
         """Online-softmax update of (acc, m, l) for the q rows in
