@@ -52,6 +52,7 @@ from mpi4jax_tpu.ops import (
     gather,
     recv,
     reduce,
+    reduce_scatter,
     scan,
     scatter,
     send,
@@ -134,6 +135,7 @@ __all__ = [
     "has_tpu_support",
     "recv",
     "reduce",
+    "reduce_scatter",
     "scan",
     "scatter",
     "send",

@@ -33,6 +33,7 @@ __all__ = [
     "init_params",
     "make_train_step",
     "make_global_train_step",
+    "make_global_zero_train_step",
 ]
 
 
@@ -115,12 +116,12 @@ def make_global_train_step(mesh, comm_dp, comm_tp, lr=1e-2):
     Parameters enter with their hidden dimension sharded over tp and
     replicated over dp; the batch is sharded over dp.  The TP forward
     goes through :func:`allreduce` (and its backward through the
-    identity-transpose rule); the DP gradient sync uses ``lax.psum``
-    directly so the updated parameters are *typed* replicated over dp —
-    which lets the out_specs declare them unsharded on that axis.
+    identity-transpose rule); the DP gradient sync rides shard_map's
+    vma-aware AD — differentiating w.r.t. a param typed *replicated*
+    over dp automatically psums its cotangent over dp (the transpose of
+    replication is a sum), which also leaves the updated parameters
+    typed replicated as the out_specs require.
     """
-    from jax import lax
-
     dp_ax, tp_ax = comm_dp.axes[0], comm_tp.axes[0]
     dp, tp = float(comm_dp.size), float(comm_tp.size)
 
@@ -133,11 +134,17 @@ def make_global_train_step(mesh, comm_dp, comm_tp, lr=1e-2):
     batch_specs = (jax.P(dp_ax, None), jax.P(dp_ax, None))
 
     def sync_grad(g, tp_sharded):
+        # shard_map's AD has ALREADY psum'ed each param's cotangent over
+        # every mesh axis the param is replicated on — an explicit psum
+        # here would double-count the gradient (it did, until round 2:
+        # 2x on the tp-sharded params, dp*tp x on b2, silently absorbed
+        # into the learning rate by the convergence test).  Only the
+        # local-mean → global-mean loss scaling remains:
         if tp_sharded:
-            return lax.psum(g, dp_ax) / dp
-        # replicated params: identical grads across tp; psum over both
-        # axes (÷ tp) re-establishes the replicated typing
-        return lax.psum(g, (dp_ax, tp_ax)) / (dp * tp)
+            return g / dp
+        # replicated params additionally got the (identical) tp copies
+        # summed
+        return g / (dp * tp)
 
     def local_step(params, batch):
         x, targets = batch
@@ -165,3 +172,129 @@ def make_global_train_step(mesh, comm_dp, comm_tp, lr=1e-2):
             out_specs=(param_specs, jax.P((dp_ax, tp_ax))),
         )
     )
+
+
+def make_global_zero_train_step(mesh, comm_dp, comm_tp, lr=1e-2, momentum=0.9):
+    """ZeRO-1-style train step: optimizer state sharded over ``dp``.
+
+    The canonical :func:`~mpi4jax_tpu.reduce_scatter` pattern: instead of
+    all-reducing gradients and keeping a full momentum buffer on every
+    data-parallel rank, the loss is differentiated w.r.t. **dp-varying**
+    params (so the cotangents stay per-device partial sums — no
+    automatic dp-psum), each parameter's flattened partial gradient is
+    **reduce-scattered** over ``dp`` — performing AD's dp-reduction and
+    the ZeRO sharding in one O(payload) collective; rank ``r`` receives
+    only chunk ``r`` — the momentum update runs on that 1/dp-sized
+    shard, and the updated shard is rebroadcast into the replicated
+    parameters.  The momentum memory per device drops by ``dp``×; the
+    wire cost is unchanged (reduce_scatter + re-broadcast ≡ the
+    allreduce of the plain step — the classic ZeRO identity).
+
+    The rebroadcast is a masked ``psum`` rather than ``all_gather``
+    because shard_map's value-typing can statically see a psum output is
+    replicated (all_gather outputs are varying-typed, which the
+    replicated param out_specs would reject).
+
+    Returns ``(step, init_opt_state)``:
+
+    * ``step(params, opt_state, batch) -> (params, opt_state, loss)`` —
+      jitted over the global mesh;
+    * ``init_opt_state(params) -> opt_state`` — jitted; momentum buffers
+      of global shape ``(dp, tp * ceil(local_size / dp))`` per parameter,
+      sharded over ``(dp, tp)`` (each device stores exactly its chunk).
+    """
+    from jax import lax
+
+    from mpi4jax_tpu.ops._core import promote_vma
+    from mpi4jax_tpu.ops.collectives import reduce_scatter
+
+    dp_ax, tp_ax = comm_dp.axes[0], comm_tp.axes[0]
+    dpn = comm_dp.size
+    dp, tp = float(comm_dp.size), float(comm_tp.size)
+
+    param_specs = MLPParams(
+        w1=jax.P(None, tp_ax),
+        b1=jax.P(tp_ax),
+        w2=jax.P(tp_ax, None),
+        b2=jax.P(None),
+    )
+    tp_sharded = MLPParams(w1=True, b1=True, w2=True, b2=False)
+    batch_specs = (jax.P(dp_ax, None), jax.P(dp_ax, None))
+    state_specs = MLPParams(*([jax.P(dp_ax, tp_ax)] * 4))
+
+    def _chunk(n):
+        return -(-n // dpn)  # ceil(local param size / dp)
+
+    def local_init(params):
+        return MLPParams(
+            *(jnp.zeros((1, _chunk(p.size)), p.dtype) for p in params)
+        )
+
+    init_opt_state = jax.jit(
+        jax.shard_map(
+            local_init, mesh=mesh, in_specs=(param_specs,),
+            out_specs=state_specs,
+        )
+    )
+
+    def local_step(params, vstate, batch):
+        x, targets = batch
+        token = create_token()
+
+        # Differentiate w.r.t. dp-VARYING params: the cotangent then
+        # stays this device's partial batch gradient (shard_map's AD
+        # only auto-psums over axes a param is replicated on), and the
+        # reduce_scatter below performs the dp-reduction AND the ZeRO
+        # sharding in a single collective — replacing the allreduce
+        # entirely, not re-sharding an already-reduced gradient.
+        p_var = jax.tree.map(
+            lambda a: promote_vma(a, (dp_ax,)), params
+        )
+
+        def loss_fn(p):
+            y, _tok = _forward(p, x, comm_tp, token)
+            return jnp.mean((y - targets) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p_var)
+
+        rank = comm_dp.rank()
+        tok = create_token()
+        new_p, new_v = [], []
+        for p, g, v, is_tp in zip(params, grads, vstate, tp_sharded):
+            n, chunk = p.size, _chunk(p.size)
+            pad = dpn * chunk - n
+            # local-mean → global-mean scaling; b2 (tp-replicated) also
+            # got its identical tp copies auto-summed
+            scale = dp if is_tp else dp * tp
+            gflat = jnp.pad(g.reshape(-1) / scale, (0, pad))
+            # rank r receives the dp-mean of its parameter chunk
+            gsh, tok = reduce_scatter(
+                gflat.reshape(dpn, chunk), comm=comm_dp, token=tok
+            )
+            v1 = momentum * v[0] + gsh
+            psh = lax.dynamic_slice(
+                jnp.pad(p.reshape(-1), (0, pad)), (rank * chunk,), (chunk,)
+            )
+            u = psh - lr * v1
+            # rebroadcast the updated shard: masked psum == all_gather
+            # value-wise, but typed replicated over dp
+            buf = jnp.where(
+                jnp.arange(dpn)[:, None] == rank, u[None, :], jnp.zeros((), u.dtype)
+            )
+            if is_tp:
+                pnew = lax.psum(buf, dp_ax)
+            else:
+                pnew = lax.psum(buf, (dp_ax, tp_ax)) / tp
+            new_p.append(pnew.reshape(-1)[:n].reshape(p.shape))
+            new_v.append(v1[None])
+        return MLPParams(*new_p), MLPParams(*new_v), loss[None]
+
+    step = jax.jit(
+        jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(param_specs, state_specs, batch_specs),
+            out_specs=(param_specs, state_specs, jax.P((dp_ax, tp_ax))),
+        )
+    )
+    return step, init_opt_state

@@ -43,6 +43,7 @@ __all__ = [
     "bcast",
     "gather",
     "reduce",
+    "reduce_scatter",
     "scan",
     "scatter",
 ]
@@ -278,6 +279,81 @@ def reduce(x, op, root, *, comm=None, token=None):
         return y, token.with_stamp(stamp)
     del root
     return allreduce(x, op, comm=comm, token=token)
+
+
+@publishes_token
+def reduce_scatter(x, op=reductions.SUM, *, comm=None, token=None):
+    """Reduce ``x`` with ``op`` across ranks and scatter the result by
+    row blocks (``MPI_Reduce_scatter_block``): rank ``r`` receives the
+    reduction over all ranks of row ``r``.
+
+    An **extension op** — not one of the reference's twelve (mpi4jax has
+    no reduce_scatter; its MPI parent is standard) — included because it
+    is the native TPU collective: with ``op=SUM`` it lowers to one
+    ``lax.psum_scatter``, the ring reduce-scatter the ICI torus is
+    optimised for, at O(payload) wire cost where ``allreduce`` of the
+    same data costs ~2x.  Gradient sharding (ZeRO-style optimizer
+    partitioning) is the canonical use — see
+    ``models/train.py:make_global_zero_train_step``.
+
+    ``x`` must have shape ``(comm.size, *rest)`` on every rank; the
+    result has shape ``rest``.  Identity: ``reduce_scatter(x)`` on rank
+    ``r`` equals ``allreduce(x)[r]``.  Differentiable for ``op=SUM``
+    (the composition transposes to an ``all_gather``).  Non-SUM and
+    user-defined ops ride an ``all_to_all`` + rank-ordered local fold
+    (correct for ``commute=False`` operators).
+    """
+    x, comm, token = _prologue(x, comm, token)
+    op = check_op(op)
+    if x.ndim == 0 or x.shape[0] != comm.size:
+        raise ValueError(
+            f"reduce_scatter input must have shape (nproc, ...) with "
+            f"nproc == comm.size={comm.size}, got shape {x.shape}"
+        )
+    as_int = x.dtype == jnp.bool_
+
+    def fold_rows(rows):
+        # rank-ordered left fold (axis 0 is source-rank order after the
+        # exchange) — the commute=False contract
+        acc = rows[0]
+        for i in range(1, comm.size):
+            acc = op.combine(acc, rows[i])
+        return acc
+
+    if comm.backend == "self":
+        y = x[0]
+        token, (y,) = fence_out(token, y)
+        return y, token
+    if comm.backend == "mesh":
+        token, (x,) = fence_in(token, x)
+        xv = promote_vma(x, comm.axes)
+        if op.name == "sum" and not op.is_user:
+            # bool rides the int8 psum_scatter like scatter does; the
+            # final nonzero→True cast matches the general path's fold
+            y = _scatter_sum(xv.astype(jnp.int8) if as_int else xv, comm)
+            if as_int:
+                y = y.astype(jnp.bool_)
+        else:
+            xv = xv.astype(jnp.int8) if as_int else xv
+            rows = lax.all_to_all(
+                xv, comm.axes, split_axis=0, concat_axis=0, tiled=True,
+                axis_index_groups=comm.groups,
+            )
+            y = fold_rows(rows)
+            if as_int:
+                y = y.astype(jnp.bool_)
+        token, (y,) = fence_out(token, y)
+        return y, token
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        xv = x.astype(jnp.int8) if as_int else x
+        rows, stamp = _proc.proc_alltoall(xv, token.stamp, comm)
+        y = fold_rows(rows)
+        if as_int:
+            y = y.astype(jnp.bool_)
+        return y, token.with_stamp(stamp)
+    raise _unsupported("reduce_scatter", comm)
 
 
 @publishes_token

@@ -1,0 +1,207 @@
+"""reduce_scatter (extension op, MPI_Reduce_scatter_block semantics):
+value tests against the allreduce identity, non-SUM / user-defined /
+bool operators, grouped (split) comms, and the AD battery for SUM
+(composition transposes to all_gather).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+
+SIZE = 8
+
+
+def _run(comm, fn, x, in_specs, out_specs, jit=True):
+    f = jax.shard_map(fn, mesh=comm.mesh, in_specs=in_specs, out_specs=out_specs)
+    if jit:
+        f = jax.jit(f)
+    return f(x)
+
+
+@pytest.mark.parametrize("jit", [True, False])
+def test_reduce_scatter_sum(comm1d, jit):
+    # every device contributes rows scaled by its rank; row r of the sum
+    # lands on rank r
+    x = jnp.arange(float(SIZE))  # sharded: device r holds [r]
+
+    def fn(v):
+        # v is (1,): this device's rank value; contribution to rank r is
+        # v * (r+1)
+        rows = jnp.broadcast_to(v, (SIZE, 1)) * jnp.arange(1.0, SIZE + 1)[:, None]
+        y, _ = m.reduce_scatter(rows, comm=comm1d)
+        return y
+
+    out = _run(
+        comm1d, fn, x, in_specs=jax.P(comm1d.axes), out_specs=jax.P(comm1d.axes)
+    )
+    # rank r receives sum_src (src * (r+1)) = (r+1) * sum(0..7)
+    expected = np.arange(1.0, SIZE + 1) * np.arange(float(SIZE)).sum()
+    assert np.allclose(np.asarray(out), expected)
+
+
+def test_reduce_scatter_equals_allreduce_row(comm1d):
+    # identity: reduce_scatter(x)[rank] == allreduce(x)[rank]
+    key = jax.random.PRNGKey(0)
+    xs = jax.random.normal(key, (SIZE, SIZE, 3))  # per-device (SIZE, 3)
+
+    def fn(v):
+        rs, _ = m.reduce_scatter(v[0], comm=comm1d)
+        ar, _ = m.allreduce(v[0], comm=comm1d)
+        rank = comm1d.rank()
+        return (rs - jax.lax.dynamic_index_in_dim(ar, rank, 0, False))[None]
+
+    out = _run(
+        comm1d, fn, xs,
+        in_specs=jax.P(comm1d.axes, None, None),
+        out_specs=jax.P(comm1d.axes, None),
+    )
+    assert np.allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("opname, expect", [
+    ("max", lambda cols: cols.max(0)),
+    ("min", lambda cols: cols.min(0)),
+    ("prod", lambda cols: cols.prod(0)),
+])
+def test_reduce_scatter_named_ops(comm1d, opname, expect):
+    rng = np.random.RandomState(1)
+    xs = rng.randint(1, 5, size=(SIZE, SIZE)).astype(np.float32)
+
+    def fn(v):
+        y, _ = m.reduce_scatter(v[0], op=opname, comm=comm1d)
+        return y[None]
+
+    out = _run(
+        comm1d, fn, jnp.asarray(xs),
+        in_specs=jax.P(comm1d.axes, None),
+        out_specs=jax.P(comm1d.axes),
+    )
+    assert np.allclose(np.asarray(out), expect(xs))
+
+
+def test_reduce_scatter_user_op_rank_order(comm1d):
+    # non-commutative user op: a*0.5 + b — result depends on fold order,
+    # so this pins the rank-ordered (commute=False) contract
+    op = m.Op.create(lambda a, b: 0.5 * a + b, name="halfsum", commute=False)
+    xs = jnp.arange(float(SIZE * SIZE)).reshape(SIZE, SIZE)
+
+    def fn(v):
+        y, _ = m.reduce_scatter(v[0], op=op, comm=comm1d)
+        return y[None]
+
+    out = _run(
+        comm1d, fn, xs,
+        in_specs=jax.P(comm1d.axes, None),
+        out_specs=jax.P(comm1d.axes),
+    )
+    cols = np.asarray(xs)  # contribution of src s to rank r: xs[s, r]
+    expected = []
+    for r in range(SIZE):
+        acc = cols[0, r]
+        for s in range(1, SIZE):
+            acc = 0.5 * acc + cols[s, r]
+        expected.append(acc)
+    assert np.allclose(np.asarray(out), np.asarray(expected))
+
+
+def test_reduce_scatter_bool(comm1d):
+    # LOR via op="lor" on bool payloads
+    xs = np.zeros((SIZE, SIZE), bool)
+    xs[3, 5] = True  # only src 3 contributes, to rank 5
+
+    def fn(v):
+        y, _ = m.reduce_scatter(v[0], op="lor", comm=comm1d)
+        return y[None].astype(jnp.float32)
+
+    out = _run(
+        comm1d, fn, jnp.asarray(xs),
+        in_specs=jax.P(comm1d.axes, None),
+        out_specs=jax.P(comm1d.axes),
+    )
+    expected = np.zeros(SIZE)
+    expected[5] = 1.0
+    assert np.array_equal(np.asarray(out), expected)
+
+
+def test_reduce_scatter_wrong_leading_dim(comm1d):
+    with pytest.raises(ValueError, match=r"shape \(nproc, ...\)"):
+        _run(
+            comm1d,
+            lambda v: m.reduce_scatter(v, comm=comm1d)[0],
+            jnp.arange(float(SIZE)),
+            in_specs=jax.P(comm1d.axes),
+            out_specs=jax.P(comm1d.axes),
+        )
+
+
+def test_reduce_scatter_split_groups(comm1d):
+    # split into two 4-rank halves: reductions stay within each half
+    sub = comm1d.split(lambda r: r // 4)
+    xs = jnp.ones((SIZE, 4))
+
+    def fn(v):
+        y, _ = m.reduce_scatter(v[0], comm=sub)
+        return y[None]
+
+    out = _run(
+        comm1d, fn, xs,
+        in_specs=jax.P(comm1d.axes, None),
+        out_specs=jax.P(comm1d.axes),
+    )
+    assert np.allclose(np.asarray(out), 4.0)
+
+
+def test_reduce_scatter_grad(comm1d):
+    # d/dx of sum(reduce_scatter(x)) — every element of every rank's
+    # input contributes exactly once, so the grad is all-ones
+    xs = jax.random.normal(jax.random.PRNGKey(2), (SIZE, SIZE, 2))
+
+    def fn(v):
+        def loss(u):
+            y, _ = m.reduce_scatter(u, comm=comm1d)
+            return (y * y).sum()
+
+        val, g = jax.value_and_grad(loss)(v[0])
+        return g[None]
+
+    out = _run(
+        comm1d, fn, xs,
+        in_specs=jax.P(comm1d.axes, None, None),
+        out_specs=jax.P(comm1d.axes, None, None),
+    )
+    # analytic: grad wrt x_src[r] = 2 * (sum over srcs of x[r]) — the
+    # cotangent of psum_scatter is an all_gather of 2*y
+    sums = np.asarray(xs).sum(axis=0)  # (SIZE, 2) row r = rank r's result
+    expected = 2.0 * np.broadcast_to(sums[None], (SIZE, SIZE, 2))
+    assert np.allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_reduce_scatter_jvp(comm1d):
+    xs = jax.random.normal(jax.random.PRNGKey(3), (SIZE, SIZE))
+    ts = jax.random.normal(jax.random.PRNGKey(4), (SIZE, SIZE))
+
+    def fn(v, t):
+        def f(u):
+            y, _ = m.reduce_scatter(u, comm=comm1d)
+            return y
+
+        y, yt = jax.jvp(f, (v[0],), (t[0],))
+        return y[None], yt[None]
+
+    f = jax.shard_map(
+        fn, mesh=comm1d.mesh,
+        in_specs=(jax.P(comm1d.axes, None), jax.P(comm1d.axes, None)),
+        out_specs=(jax.P(comm1d.axes), jax.P(comm1d.axes)),
+    )
+    y, yt = jax.jit(f)(xs, ts)
+    assert np.allclose(np.asarray(y), np.asarray(xs).sum(0), atol=1e-5)
+    assert np.allclose(np.asarray(yt), np.asarray(ts).sum(0), atol=1e-5)
+
+
+def test_reduce_scatter_self():
+    comm = m.SelfComm()
+    y, _ = m.reduce_scatter(jnp.arange(3.0)[None], comm=comm)
+    assert np.array_equal(np.asarray(y), np.arange(3.0))
