@@ -1,0 +1,250 @@
+"""Mixture-of-experts decoder transformer over a ``(dp, tp, sp)`` mesh.
+
+Extends the dense composition showcase (models/transformer.py) with the
+last parallelism family the library ships: **expert parallelism** on the
+``alltoall`` building block (the reference names alltoall as its
+expert-dispatch primitive — SURVEY §2.4, reference alltoall.py:35-74).
+The ``sp`` mesh axis does double duty: the sequence axis for ring
+attention *and* the expert-parallel axis for the MoE MLP — experts live
+sharded across the same devices whose token shards they serve, so the
+dispatch/combine pair rides two ICI ``all_to_all``s per layer.
+
+Routing is **local expert choice** (per-device, capacity factor 1):
+each expert takes its top-``capacity`` tokens *of this device's token
+shard*, where ``capacity = local_tokens / n_experts``.  This is chosen
+over token-choice top-k because it is perfectly load-balanced by
+construction — every (source device, expert) bucket has identical
+static shape, which is what turns the dispatch into one fused ICI
+collective instead of a host gather — and needs no auxiliary balancing
+loss.  Tokens chosen by several experts receive the gate-weighted sum;
+tokens chosen by none pass through the residual only.
+
+Differentiable end to end: gates through ``top_k``'s value gradient,
+dispatch/combine through ``alltoall``'s self-inverse transpose, the
+dense path through the Megatron f/g allreduce pair and the ring
+(sendrecv-transpose) attention — one SGD step matches the unsharded
+oracle (tests/parallel/test_moe_transformer.py).
+"""
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4jax_tpu.ops.collectives import alltoall
+from mpi4jax_tpu.models.transformer import (
+    _ce,
+    _rmsnorm,
+    make_global_train_step as _make_dense_train_step,
+)
+from mpi4jax_tpu.parallel.longseq import local_attention
+
+__all__ = [
+    "MoEConfig",
+    "MoEBlockParams",
+    "MoEParams",
+    "init_params",
+    "make_global_train_step",
+    "reference_loss",
+]
+
+
+class MoEConfig(NamedTuple):
+    vocab: int = 64
+    d_model: int = 32
+    layers: int = 2
+    heads: int = 4
+    kv_heads: int = 2
+    head_dim: int = 8
+    experts: int = 4      # total experts; must divide by the sp size
+    d_ff: int = 64        # per-expert FFN width
+    eps: float = 1e-6
+
+
+class MoEBlockParams(NamedTuple):
+    ln1: jax.Array  # (L, d)            replicated
+    wq: jax.Array   # (L, d, Hq*dh)     column-sharded over tp
+    wk: jax.Array   # (L, d, Hkv*dh)    column-sharded over tp
+    wv: jax.Array   # (L, d, Hkv*dh)    column-sharded over tp
+    wo: jax.Array   # (L, Hq*dh, d)     row-sharded over tp
+    ln2: jax.Array  # (L, d)            replicated
+    wr: jax.Array   # (L, d, E)         router, replicated
+    w1e: jax.Array  # (L, E, d, F)      expert-sharded over sp (dim 1)
+    w2e: jax.Array  # (L, E, F, d)      expert-sharded over sp (dim 1)
+
+
+class MoEParams(NamedTuple):
+    embed: jax.Array
+    blocks: MoEBlockParams
+    ln_f: jax.Array
+    head: jax.Array
+
+
+def init_params(key, cfg, dtype=jnp.float32):
+    c = cfg
+    ks = jax.random.split(key, 9)
+
+    def norm(k, shape, fan_in):
+        return jax.random.normal(k, shape, dtype) * (1.0 / math.sqrt(fan_in))
+
+    L, d, dh, E = c.layers, c.d_model, c.head_dim, c.experts
+    blocks = MoEBlockParams(
+        ln1=jnp.ones((L, d), dtype),
+        wq=norm(ks[0], (L, d, c.heads * dh), d),
+        wk=norm(ks[1], (L, d, c.kv_heads * dh), d),
+        wv=norm(ks[2], (L, d, c.kv_heads * dh), d),
+        wo=norm(ks[3], (L, c.heads * dh, d), c.heads * dh),
+        ln2=jnp.ones((L, d), dtype),
+        wr=norm(ks[4], (L, d, E), d),
+        w1e=norm(ks[5], (L, E, d, c.d_ff), d),
+        w2e=norm(ks[6], (L, E, c.d_ff, d), c.d_ff),
+    )
+    return MoEParams(
+        embed=norm(ks[7], (c.vocab, d), d),
+        blocks=blocks,
+        ln_f=jnp.ones((d,), dtype),
+        head=norm(ks[8], (d, c.vocab), d),
+    )
+
+
+def param_specs(tp_ax, sp_ax):
+    blocks = MoEBlockParams(
+        ln1=jax.P(None, None),
+        wq=jax.P(None, None, tp_ax),
+        wk=jax.P(None, None, tp_ax),
+        wv=jax.P(None, None, tp_ax),
+        wo=jax.P(None, tp_ax, None),
+        ln2=jax.P(None, None),
+        wr=jax.P(None, None, None),
+        w1e=jax.P(None, sp_ax, None, None),
+        w2e=jax.P(None, sp_ax, None, None),
+    )
+    return MoEParams(
+        embed=jax.P(None, None),
+        blocks=blocks,
+        ln_f=jax.P(None),
+        head=jax.P(None, None),
+    )
+
+
+def _route_local(xt, wr, n_experts):
+    """Local expert-choice routing on this device's ``(T, d)`` tokens.
+
+    Returns ``(gates, idx)`` each ``(E, capacity)``: expert ``e`` takes
+    its ``capacity = T // E`` highest-probability local tokens.
+    """
+    t = xt.shape[0]
+    if t % n_experts:
+        raise ValueError(
+            f"local token count {t} must be divisible by experts="
+            f"{n_experts} (capacity-1 expert choice)"
+        )
+    cap = t // n_experts
+    probs = jax.nn.softmax(xt @ wr, axis=-1)  # (T, E)
+    gates, idx = lax.top_k(probs.T, cap)  # (E, cap) each
+    return gates, idx
+
+
+def _expert_ffn(recv, w1e, w2e):
+    """Per-slot expert FFN: ``recv`` is (src, e_local, cap, d)."""
+    h = jnp.einsum("seci,eif->secf", recv, w1e)
+    h = jax.nn.gelu(h)
+    return jnp.einsum("secf,efi->seci", h, w2e)
+
+
+def _moe_ffn(h, wr, w1e, w2e, cfg, comm_ep, token):
+    """MoE MLP: route → alltoall dispatch → expert FFN → alltoall
+    combine → gate-weighted scatter-add.  ``h``: (b, s_local, d)."""
+    ep = comm_ep.size
+    e_local = cfg.experts // ep
+    b, s, d = h.shape
+    xt = h.reshape(b * s, d)
+    gates, idx = _route_local(xt, wr, cfg.experts)
+    buckets = xt[idx]  # (E, cap, d), expert-major
+    # expert e lives on ep-rank e // e_local: grouping experts by
+    # destination is a reshape because the layout is contiguous
+    cap = buckets.shape[1]
+    send = buckets.reshape(ep, e_local, cap, d)
+    recv, token = alltoall(send, comm=comm_ep, token=token)
+    out = _expert_ffn(recv, w1e, w2e)  # (src, e_local, cap, d)
+    back, token = alltoall(out, comm=comm_ep, token=token)
+    vals = back.reshape(cfg.experts, cap, d)
+    y = jnp.zeros_like(xt).at[idx.reshape(-1)].add(
+        (gates[..., None] * vals).reshape(-1, d)
+    )
+    return y.reshape(b, s, d), token
+
+
+def _moe_mlp(h2, bp, cfg, comm_tp, comm_sp, token):
+    """MLP-sublayer callback for the shared transformer scaffold."""
+    return _moe_ffn(h2, bp.wr, bp.w1e, bp.w2e, cfg, comm_sp, token)
+
+
+def make_global_train_step(mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1):
+    """Jitted global train step over a ``(dp, tp, sp)`` mesh with the
+    MoE MLP expert-sharded over ``sp``.
+
+    Delegates to the dense transformer's step builder (one scaffold —
+    attention, grad sync, jit/shard_map wrapper — shared between both
+    models) with the MoE sublayer and expert-sharded PartitionSpecs
+    substituted.  Additionally requires ``cfg.experts % comm_sp.size
+    == 0`` and the per-device token count divisible by ``cfg.experts``.
+    """
+    if cfg.experts % comm_sp.size:
+        raise ValueError(
+            f"cfg.experts={cfg.experts} must be divisible by the "
+            f"expert-parallel (sp) size {comm_sp.size}"
+        )
+    return _make_dense_train_step(
+        mesh, comm_dp, comm_tp, comm_sp, cfg, lr,
+        mlp=_moe_mlp,
+        specs=param_specs(comm_tp.axes[0], comm_sp.axes[0]),
+    )
+
+
+def reference_loss(params, tokens, targets, cfg, dp, sp):
+    """Unsharded oracle replicating the sharded semantics exactly.
+
+    Expert *selection* is per-device (local expert choice), so the
+    oracle partitions the global batch into the same ``(dp, sp)`` token
+    blocks the mesh would hold and routes within each block; the expert
+    FFN itself is pointwise per token, so which device hosted an expert
+    is irrelevant to the value.
+    """
+    b, s = tokens.shape
+    b_loc, s_loc = b // dp, s // sp
+    x = params.embed[tokens]
+
+    def moe_block(xt, wr, w1e, w2e):
+        gates, idx = _route_local(xt, wr, cfg.experts)
+        vals = _expert_ffn(
+            xt[idx][None], w1e, w2e
+        )[0]  # (E, cap, d): all experts local
+        return jnp.zeros_like(xt).at[idx.reshape(-1)].add(
+            (gates[..., None] * vals).reshape(-1, xt.shape[-1])
+        )
+
+    def layer(x, bp):
+        h = _rmsnorm(x, bp.ln1, cfg.eps)
+        q = (h @ bp.wq).reshape(b, s, cfg.heads, cfg.head_dim)
+        k = (h @ bp.wk).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        v = (h @ bp.wv).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        attn = local_attention(q, k, v, causal=True, impl="xla")
+        x = x + attn.reshape(b, s, -1) @ bp.wo
+        h2 = _rmsnorm(x, bp.ln2, cfg.eps)
+        # route within each (dp, sp) block, exactly as the mesh does
+        blocks = h2.reshape(dp, b_loc, sp, s_loc, cfg.d_model)
+        blocks = blocks.transpose(0, 2, 1, 3, 4).reshape(
+            dp * sp, b_loc * s_loc, cfg.d_model
+        )
+        m = jax.vmap(lambda xt: moe_block(xt, bp.wr, bp.w1e, bp.w2e))(blocks)
+        m = m.reshape(dp, sp, b_loc, s_loc, cfg.d_model).transpose(
+            0, 2, 1, 3, 4
+        ).reshape(b, s, cfg.d_model)
+        return x + m, None
+
+    x, _ = lax.scan(layer, x, params.blocks)
+    x = _rmsnorm(x, params.ln_f, cfg.eps)
+    return _ce(x @ params.head, targets)

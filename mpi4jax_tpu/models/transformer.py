@@ -138,7 +138,14 @@ def _f_collective(x, comm, token):
     return res, token.with_stamp(stamp)
 
 
-def _forward_sharded(params, tokens, cfg, comm_tp, comm_sp, mesh_axes):
+def _dense_mlp(h2, bp, cfg, comm_tp, comm_sp, token):
+    """Megatron MLP: column-sharded up, row-sharded down, g-allreduce."""
+    h2, token = _f_collective(h2, comm_tp, token)
+    m_part = jax.nn.gelu(h2 @ bp.w1) @ bp.w2
+    return allreduce(m_part, reductions.SUM, comm=comm_tp, token=token)
+
+
+def _forward_sharded(params, tokens, cfg, comm_tp, comm_sp, mesh_axes, mlp=None):
     """Per-device forward; call inside shard_map over (dp, tp, sp).
 
     ``tokens``: local [B_local, S_local] int32.  Activations are
@@ -146,9 +153,14 @@ def _forward_sharded(params, tokens, cfg, comm_tp, comm_sp, mesh_axes):
     the full axis set of the enclosing shard_map: activations are
     typed varying over all of it (collective outputs vary on their own
     axis, so the layer-scan carry must start that way too).
+
+    ``mlp(h2, bp, cfg, comm_tp, comm_sp, token) -> (out, token)`` is
+    the MLP sublayer (post-ln2); defaults to the dense Megatron pair —
+    models/moe_transformer.py substitutes the expert-parallel MoE here.
     """
     from mpi4jax_tpu.ops._core import promote_vma
 
+    mlp = mlp or _dense_mlp
     tp = comm_tp.size
     dh = cfg.head_dim
     hq_l, hk_l = cfg.heads // tp, cfg.kv_heads // tp
@@ -171,9 +183,7 @@ def _forward_sharded(params, tokens, cfg, comm_tp, comm_sp, mesh_axes):
         x = x + a
 
         h2 = _rmsnorm(x, bp.ln2, cfg.eps)
-        h2, token = _f_collective(h2, comm_tp, token)
-        m_part = jax.nn.gelu(h2 @ bp.w1) @ bp.w2
-        m, _token = allreduce(m_part, reductions.SUM, comm=comm_tp, token=token)
+        m, _token = mlp(h2, bp, cfg, comm_tp, comm_sp, token)
         return x + m, None
 
     x, _ = lax.scan(layer, x, params.blocks)
@@ -187,13 +197,19 @@ def _ce(logits, targets):
     return -picked.mean()
 
 
-def make_global_train_step(mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1):
+def make_global_train_step(
+    mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1, *, mlp=None, specs=None
+):
     """Jitted global train step over a ``(dp, tp, sp)`` mesh.
 
     ``batch = (tokens, targets)``, both global ``[B, S]`` int32 sharded
     ``(dp, sp)`` (targets are the caller's shifted next tokens — the
     shift crosses sp shard boundaries, so it is done globally).
     Returns ``(new_params, loss)``.
+
+    ``mlp`` / ``specs`` substitute the MLP sublayer and the parameter
+    PartitionSpecs (see :func:`_forward_sharded`; used by the MoE
+    variant, models/moe_transformer.py).
     """
     dp_ax = comm_dp.axes[0]
     tp_ax = comm_tp.axes[0]
@@ -209,7 +225,7 @@ def make_global_train_step(mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1):
                 f"heads than tp ranks, replicate kv heads to tp first)"
             )
 
-    specs = param_specs(tp_ax)
+    specs = param_specs(tp_ax) if specs is None else specs
     batch_specs = (jax.P(dp_ax, sp_ax), jax.P(dp_ax, sp_ax))
 
     def sync_grad(g, spec):
@@ -223,7 +239,9 @@ def make_global_train_step(mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1):
             return g / n_data
         # replicated: g additionally summed over tp, but the
         # f-collectives made each rank's grad the FULL tp-sum already,
-        # so the automatic tp-sum overcounts by tp
+        # so the automatic tp-sum overcounts by tp.  (sp-sharded MoE
+        # expert params land here too: their cross-device contributions
+        # arrive through the alltoall transpose — same scaling class.)
         return g / (n_data * tp)
 
     def local_step(params, batch):
@@ -231,7 +249,8 @@ def make_global_train_step(mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1):
 
         def loss_fn(p):
             logits = _forward_sharded(
-                p, tokens, cfg, comm_tp, comm_sp, (dp_ax, tp_ax, sp_ax)
+                p, tokens, cfg, comm_tp, comm_sp, (dp_ax, tp_ax, sp_ax),
+                mlp=mlp,
             )
             return _ce(logits, targets)
 

@@ -1,0 +1,152 @@
+"""The MoE (expert-parallel) transformer train step vs an unsharded
+oracle: dp×tp×sp mesh where sp doubles as the expert axis — local
+expert-choice routing, alltoall dispatch/combine, ring attention, TP
+f/g, DP sync, one SGD step against identical math on one device."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.models import moe_transformer as moe
+
+CFG = moe.MoEConfig(
+    vocab=32, d_model=16, layers=2, heads=4, kv_heads=2, head_dim=8,
+    experts=4, d_ff=32,
+)
+B, S = 4, 16
+DP, TP, SP = 2, 2, 2
+
+
+@pytest.fixture(scope="module")
+def mesh3d():
+    return jax.make_mesh(
+        (DP, TP, SP),
+        ("dp", "tp", "sp"),
+        axis_types=(jax.sharding.AxisType.Auto,) * 3,
+    )
+
+
+@pytest.fixture(scope="module")
+def comms(mesh3d):
+    world = m.MeshComm.from_mesh(mesh3d)
+    return world.sub("dp"), world.sub("tp"), world.sub("sp")
+
+
+def batch(seed=0):
+    kt = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(kt, (B, S), 0, CFG.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    return tokens, targets
+
+
+def test_moe_train_step_matches_oracle(mesh3d, comms):
+    comm_dp, comm_tp, comm_sp = comms
+    params = moe.init_params(jax.random.PRNGKey(1), CFG)
+    tokens, targets = batch()
+
+    step = moe.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=1e-1
+    )
+    new_params, loss = step(params, (tokens, targets))
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: moe.reference_loss(p, tokens, targets, CFG, DP, SP)
+    )(params)
+    ref_new = jax.tree.map(lambda p, g: p - 1e-1 * g, params, ref_grads)
+
+    np.testing.assert_allclose(
+        float(np.asarray(loss)[0]), float(ref_loss), rtol=2e-5, atol=2e-5
+    )
+    names = [
+        "embed", "ln1", "wq", "wk", "wv", "wo", "ln2", "wr", "w1e",
+        "w2e", "ln_f", "head",
+    ]
+    for name, got, want in zip(
+        names, jax.tree.leaves(new_params), jax.tree.leaves(ref_new)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-4, atol=3e-4,
+            err_msg=name,
+        )
+
+
+def test_moe_loss_decreases(mesh3d, comms):
+    comm_dp, comm_tp, comm_sp = comms
+    params = moe.init_params(jax.random.PRNGKey(2), CFG)
+    tokens, targets = batch(seed=3)
+    step = moe.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=3e-1
+    )
+    losses = []
+    for _ in range(8):
+        params, loss = step(params, (tokens, targets))
+        losses.append(float(np.asarray(loss)[0]))
+    assert losses[-1] < losses[0] * 0.8, losses
+    assert np.isfinite(losses).all()
+
+
+def test_moe_experts_divisibility(mesh3d, comms):
+    comm_dp, comm_tp, comm_sp = comms
+    with pytest.raises(ValueError, match="divisible by the expert"):
+        moe.make_global_train_step(
+            mesh3d, comm_dp, comm_tp, comm_sp, CFG._replace(experts=3)
+        )
+
+
+def test_moe_token_capacity_check(mesh3d, comms):
+    # per-device token count not divisible by experts -> curated error
+    comm_dp, comm_tp, comm_sp = comms
+    cfg = CFG._replace(experts=SP * 3)  # 6 experts, T_local=16 not div.
+    step = moe.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, cfg
+    )
+    with pytest.raises(ValueError, match="divisible by experts"):
+        step(moe.init_params(jax.random.PRNGKey(0), cfg), batch())
+
+
+def test_route_local_selects_top_capacity():
+    key = jax.random.PRNGKey(5)
+    xt = jax.random.normal(key, (8, 4))
+    wr = jax.random.normal(jax.random.PRNGKey(6), (4, 2))
+    gates, idx = moe._route_local(xt, wr, 2)
+    assert gates.shape == (2, 4) and idx.shape == (2, 4)
+    probs = jax.nn.softmax(xt @ wr, axis=-1)
+    for e in range(2):
+        # each expert's picks are its top-capacity local tokens
+        want = np.argsort(-np.asarray(probs[:, e]))[:4]
+        assert set(np.asarray(idx[e]).tolist()) == set(want.tolist())
+
+
+def test_combine_gate_weighted_sum_and_unpicked_zero():
+    # combine semantics through the real _moe_ffn dispatch path (ep=1
+    # via SelfComm): a token picked by k experts receives the sum of
+    # the k gate-weighted expert outputs; an unpicked token gets zero
+    cfg = moe.MoEConfig(d_model=4, experts=2, d_ff=8)
+    comm = m.SelfComm()
+    b, s, d = 1, 8, 4
+    h = jax.random.normal(jax.random.PRNGKey(7), (b, s, d))
+    wr = jax.random.normal(jax.random.PRNGKey(8), (d, 2))
+    w1e = jax.random.normal(jax.random.PRNGKey(9), (2, d, 8))
+    w2e = jax.random.normal(jax.random.PRNGKey(10), (2, 8, d))
+
+    out, _tok = moe._moe_ffn(h, wr, w1e, w2e, cfg, comm, None)
+
+    # numpy loop oracle
+    xt = np.asarray(h).reshape(s, d)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(xt) @ wr, axis=-1))
+    expected = np.zeros_like(xt)
+    picked = set()
+    for e in range(2):
+        top = np.argsort(-probs[:, e], kind="stable")[:4]
+        for t in top:
+            picked.add(int(t))
+            hmid = np.asarray(jax.nn.gelu(jnp.asarray(xt[t]) @ w1e[e]))
+            expected[t] += probs[t, e] * (hmid @ np.asarray(w2e[e]))
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(s, d), expected, rtol=1e-5, atol=1e-5
+    )
+    unpicked = [t for t in range(s) if t not in picked]
+    for t in unpicked:
+        np.testing.assert_array_equal(np.asarray(out).reshape(s, d)[t], 0.0)
