@@ -35,11 +35,11 @@ from jax import lax
 
 from mpi4jax_tpu.ops.collectives import alltoall
 from mpi4jax_tpu.models.transformer import (
+    _attn_residual,
     _ce,
     _rmsnorm,
     make_global_train_step as _make_dense_train_step,
 )
-from mpi4jax_tpu.parallel.longseq import local_attention
 
 __all__ = [
     "MoEConfig",
@@ -227,12 +227,7 @@ def reference_loss(params, tokens, targets, cfg, dp, sp):
         )
 
     def layer(x, bp):
-        h = _rmsnorm(x, bp.ln1, cfg.eps)
-        q = (h @ bp.wq).reshape(b, s, cfg.heads, cfg.head_dim)
-        k = (h @ bp.wk).reshape(b, s, cfg.kv_heads, cfg.head_dim)
-        v = (h @ bp.wv).reshape(b, s, cfg.kv_heads, cfg.head_dim)
-        attn = local_attention(q, k, v, causal=True, impl="xla")
-        x = x + attn.reshape(b, s, -1) @ bp.wo
+        x = _attn_residual(x, bp, cfg)
         h2 = _rmsnorm(x, bp.ln2, cfg.eps)
         # route within each (dp, sp) block, exactly as the mesh does
         blocks = h2.reshape(dp, b_loc, sp, s_loc, cfg.d_model)

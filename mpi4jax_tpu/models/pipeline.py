@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from mpi4jax_tpu.ops._core import as_token, promote_vma
+from mpi4jax_tpu.ops._core import as_token, promote_vma, vma_of
 from mpi4jax_tpu.ops.p2p import sendrecv
 
 __all__ = ["pipeline_apply"]
@@ -114,14 +114,24 @@ def pipeline_apply(stage_fn, stage_params, microbatches, comm, *, token=None):
         return (incoming, outputs, token), None
 
     # the carries become device-varying after the first handoff; start
-    # them varying so the scan carry type is stable
+    # them varying so the scan carry type is stable.  The activations
+    # also inherit any varying axes the inputs/params carry from an
+    # enclosing mesh (e.g. a dp axis sharding the microbatches), so the
+    # carry axes are the union.
+    carry_axes = list(comm.axes)
+    for leaf in jax.tree.leaves((microbatches, stage_params)):
+        for ax in vma_of(leaf) or ():
+            if ax not in carry_axes:
+                carry_axes.append(ax)
+    carry_axes = tuple(carry_axes)
+
     incoming0 = promote_vma(
-        jnp.zeros(out_shape.shape, out_shape.dtype), comm.axes
+        jnp.zeros(out_shape.shape, out_shape.dtype), carry_axes
     )
     outputs0 = promote_vma(
-        jnp.zeros((n_micro, *out_shape.shape), out_shape.dtype), comm.axes
+        jnp.zeros((n_micro, *out_shape.shape), out_shape.dtype), carry_axes
     )
-    token = token.with_stamp(promote_vma(token.stamp, comm.axes))
+    token = token.with_stamp(promote_vma(token.stamp, carry_axes))
     (_, outputs, token), _ = lax.scan(
         tick,
         (incoming0, outputs0, token),

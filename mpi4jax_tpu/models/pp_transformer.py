@@ -1,0 +1,137 @@
+"""Pipeline-parallel decoder transformer over a ``(dp, pp)`` mesh.
+
+Completes the transformer-parallelism matrix: models/transformer.py
+composes dp×tp×sp (Megatron + ring attention), moe_transformer.py adds
+ep — this module runs the *same* decoder (identical parameters and
+math: :func:`~mpi4jax_tpu.models.transformer.init_params` /
+``reference_loss`` are reused verbatim) with its **layers sharded into
+pipeline stages** over ``pp``, scheduled by
+:func:`~mpi4jax_tpu.models.pipeline.pipeline_apply` — the GPipe
+microbatch loop in one ``lax.scan``, activations handed off by
+``sendrecv`` (one ICI ``ppermute`` per tick), gradients riding the
+reversed handoff (the reference's sendrecv transpose contract,
+sendrecv.py:366-385 there).
+
+Gradient flow is the interesting part: each device differentiates its
+*locally masked* loss (nonzero only on the last stage), and the
+cotangents for earlier stages' layers arrive **through the transposed
+pipeline** — there is no explicit cross-stage gradient collective to
+get wrong.  Replicated params (embedding, final head) contribute from
+exactly one stage each (the ``rank == 0`` feed and the last-stage
+readout), so shard_map's automatic pp-psum of their cotangents adds
+zeros from the other stages — no overcount, no extra scaling.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4jax_tpu.models.pipeline import pipeline_apply
+from mpi4jax_tpu.models.transformer import (
+    _ce,
+    _rmsnorm,
+    TransformerConfig,
+    dense_layer,
+    init_params,
+    param_specs as _dense_param_specs,
+    reference_loss,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "reference_loss",
+    "param_specs",
+    "make_global_train_step",
+]
+
+
+def param_specs(pp_ax):
+    """Layers sharded into stages over ``pp``; everything else
+    replicated (no tp in the pipeline variant)."""
+    dense = _dense_param_specs(tp_ax=None)
+    blocks = type(dense.blocks)(
+        *(jax.P(pp_ax, *spec[1:]) for spec in dense.blocks)
+    )
+    return dense._replace(blocks=blocks)
+
+
+def _stage_fn(cfg, stage_blocks, a):
+    """This rank's layer slice: scan the local blocks over the
+    activation (shape-preserving, as the pipeline wire requires)."""
+    from mpi4jax_tpu.ops._core import promote_vma, vma_of
+
+    # the layer scan's carry must match the blocks' varying axes from
+    # tick 0 (pipeline_apply's shape probe passes an unvarying template)
+    a = promote_vma(a, vma_of(stage_blocks.ln1) or ())
+
+    def f(x, bp):
+        return dense_layer(x, bp, cfg), None
+
+    out, _ = lax.scan(f, a, stage_blocks)
+    return out
+
+
+def make_global_train_step(mesh, comm_dp, comm_pp, cfg, n_micro, lr=1e-1):
+    """Jitted global train step over a ``(dp, pp)`` mesh.
+
+    ``batch = (tokens, targets)``, global ``[B, S]`` int32 sharded over
+    ``dp``; each dp group runs an independent pipeline of
+    ``comm_pp.size`` stages with ``n_micro`` microbatches.  Requires
+    ``cfg.layers % comm_pp.size == 0`` and the per-dp-group batch
+    divisible by ``n_micro``.  Returns ``(new_params, loss)``.
+    """
+    dp_ax, pp_ax = comm_dp.axes[0], comm_pp.axes[0]
+    dp = float(comm_dp.size)
+    stages = comm_pp.size
+    if cfg.layers % stages:
+        raise ValueError(
+            f"cfg.layers={cfg.layers} must be divisible by the pipeline "
+            f"size {stages} (equal layer slices per stage)"
+        )
+
+    specs = param_specs(pp_ax)
+    batch_specs = (jax.P(dp_ax, None), jax.P(dp_ax, None))
+
+    def local_step(params, batch):
+        tokens, targets = batch  # (B_loc, S) int32
+        b_loc, s = tokens.shape
+        if b_loc % n_micro:
+            raise ValueError(
+                f"per-dp-group batch {b_loc} must be divisible by "
+                f"n_micro={n_micro}"
+            )
+        mb = b_loc // n_micro
+
+        def loss_fn(p):
+            x = p.embed[tokens]  # every rank embeds; stage 0's feed wins
+            mbs = x.reshape(n_micro, mb, s, cfg.d_model)
+            out, _tok = pipeline_apply(
+                partial(_stage_fn, cfg), p.blocks, mbs, comm_pp
+            )
+            h = _rmsnorm(out.reshape(b_loc, s, cfg.d_model), p.ln_f, cfg.eps)
+            logits = h @ p.head
+            # valid only on the last stage; masked elsewhere so each
+            # device's loss is exactly its pipeline's contribution
+            is_last = comm_pp.rank() == stages - 1
+            return jnp.where(is_last, _ce(logits, targets), 0.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # blocks are pp-sharded (no automatic sum); replicated params'
+        # automatic (dp, pp)-psum adds zeros from non-contributing
+        # stages — every param class needs only the dp mean scaling
+        grads = jax.tree.map(lambda g: g / dp, grads)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        loss = lax.psum(loss, (dp_ax, pp_ax)) / dp
+        return params, loss[None]
+
+    return jax.jit(
+        jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, batch_specs),
+            out_specs=(specs, jax.P((dp_ax, pp_ax))),
+        )
+    )

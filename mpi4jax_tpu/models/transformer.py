@@ -270,21 +270,32 @@ def make_global_train_step(
     )
 
 
+def _attn_residual(x, bp, cfg):
+    """Unsharded attention sublayer: ln1 → QKV → causal attention → wo,
+    plus the residual.  THE single copy of the dense layer's attention
+    math — the oracles and the pipeline stage all call it."""
+    b, s, _ = x.shape
+    h = _rmsnorm(x, bp.ln1, cfg.eps)
+    q = (h @ bp.wq).reshape(b, s, cfg.heads, cfg.head_dim)
+    k = (h @ bp.wk).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    v = (h @ bp.wv).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    attn = local_attention(q, k, v, causal=True, impl="xla")
+    return x + attn.reshape(b, s, -1) @ bp.wo
+
+
+def dense_layer(x, bp, cfg):
+    """One full unsharded decoder layer (attention + dense MLP)."""
+    x = _attn_residual(x, bp, cfg)
+    h2 = _rmsnorm(x, bp.ln2, cfg.eps)
+    return x + jax.nn.gelu(h2 @ bp.w1) @ bp.w2
+
+
 def reference_loss(params, tokens, targets, cfg):
     """Unsharded oracle: identical math on one device."""
-    b, s = tokens.shape
     x = params.embed[tokens]
 
     def layer(x, bp):
-        h = _rmsnorm(x, bp.ln1, cfg.eps)
-        q = (h @ bp.wq).reshape(b, s, cfg.heads, cfg.head_dim)
-        k = (h @ bp.wk).reshape(b, s, cfg.kv_heads, cfg.head_dim)
-        v = (h @ bp.wv).reshape(b, s, cfg.kv_heads, cfg.head_dim)
-        attn = local_attention(q, k, v, causal=True, impl="xla")
-        x = x + attn.reshape(b, s, -1) @ bp.wo
-        h2 = _rmsnorm(x, bp.ln2, cfg.eps)
-        x = x + jax.nn.gelu(h2 @ bp.w1) @ bp.w2
-        return x, None
+        return dense_layer(x, bp, cfg), None
 
     x, _ = lax.scan(layer, x, params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)

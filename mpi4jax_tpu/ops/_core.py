@@ -498,6 +498,18 @@ def fence_out(token, *arrays):
     return token.with_stamp(out[0]), out[1:]
 
 
+def vma_of(x):
+    """``x``'s varying-manual-axes tuple, or ``None`` when the aval has
+    no vma typing at all (older JAX) — callers treating None as "no
+    axes" should use ``vma_of(x) or ()``."""
+    import jax
+
+    try:
+        return tuple(jax.typeof(x).vma)
+    except AttributeError:
+        return None
+
+
 def promote_vma(x, axes):
     """Promote ``x`` to be device-varying over all of ``axes``.
 
@@ -507,11 +519,8 @@ def promote_vma(x, axes):
     multi-axis collective.  No-op outside shard_map and for already-
     varying values.
     """
-    import jax
-
-    try:
-        vma = jax.typeof(x).vma
-    except AttributeError:
+    vma = vma_of(x)
+    if vma is None:
         return x
     missing = tuple(a for a in axes if a not in vma)
     if missing:

@@ -103,6 +103,20 @@ s, tok = m.scan(jnp.array([1.0]), m.SUM, comm=comm, token=tok)
 assert np.allclose(np.asarray(s), rank + 1)
 a2, tok = m.alltoall(jnp.arange(float(size)) + 100 * rank, comm=comm, token=tok)
 assert np.allclose(np.asarray(a2), 100 * np.arange(size) + rank)
+rs, tok = m.reduce_scatter(
+    jnp.arange(float(size * 2)).reshape(size, 2) * (rank + 1), comm=comm, token=tok
+)
+assert np.allclose(
+    np.asarray(rs),
+    np.arange(size * 2.0).reshape(size, 2)[rank] * sum(range(1, size + 1)),
+)
+rs_mx, tok = m.reduce_scatter(
+    jnp.arange(float(size * 2)).reshape(size, 2) * (rank + 1),
+    op=m.MAX, comm=comm, token=tok,
+)
+assert np.allclose(
+    np.asarray(rs_mx), np.arange(size * 2.0).reshape(size, 2)[rank] * size
+)
 r, tok = m.reduce(x, m.SUM, 0, comm=comm, token=tok)
 if rank == 0:
     assert np.allclose(np.asarray(r), sum(range(1, size + 1)))
