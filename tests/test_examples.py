@@ -47,6 +47,17 @@ def test_dp_tp_example_runs():
     _run_example("data_tensor_parallel", ["--steps", "25"])
 
 
+def test_dp_tp_example_zero():
+    _run_example("data_tensor_parallel", ["--steps", "25", "--zero"])
+
+
+@pytest.mark.parametrize("mode", ["dense", "moe", "pp"])
+def test_transformer_training_example(mode):
+    _run_example(
+        "transformer_training", ["--mode", mode, "--steps", "6"]
+    )
+
+
 def test_long_context_example_runs():
     _run_example("long_context", ["--seq-per-device", "32", "--causal"])
 
