@@ -25,9 +25,10 @@ def allreduce_bandwidth(comm, reps=10, mb=64):
 
     With n > 1 devices this is NCCL-convention bus bandwidth
     (``bytes * 2*(n-1)/n / t``).  On a single chip the collective is
-    elided by XLA, so the number reported is the payload rate of the
-    full dispatch+execute path (the quantity still bounds the op's cost
-    in a 1-chip program).  Timing/convention shared with the CLI sweep
+    elided by XLA, so the number reported is the call site's residual
+    rate under the scan-loop convention — largely the amortised host
+    round-trip floor (the quantity still bounds a 1-chip program's
+    per-op cost).  Timing/convention shared with the CLI sweep
     (benchmarks/collectives.py).
     """
     from benchmarks.collectives import bench_op

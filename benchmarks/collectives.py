@@ -5,9 +5,13 @@ chips").  For each payload size the op runs inside one jitted shard_map
 over all visible devices; reported algorithmic bandwidth uses the
 standard convention (bytes * 2*(n-1)/n for allreduce, bytes * (n-1)/n
 for allgather/alltoall/ppermute-ring), so numbers are comparable with
-NCCL/MPI bus-bandwidth tables.  On a single device the collectives are
-elided by XLA, so the factor falls back to 1.0 and the number is the
-payload rate of the full dispatch+execute path.
+NCCL/MPI bus-bandwidth tables.  Timing also follows the NCCL-tests loop
+convention: all ``reps`` iterations run inside ONE executable
+(``lax.scan``) and the host syncs once, so the per-call host round trip
+is amortised over the reps.  On a single device the collectives are
+elided by XLA; the factor falls back to 1.0 and the number is the
+residual call-site rate — mostly the amortised round-trip floor (see
+docs/performance.md).
 
     python benchmarks/collectives.py [--sizes-mb 1 16 64] [--ops allreduce ...]
 
@@ -50,7 +54,7 @@ def busbw_factor(op, n):
     }[op]
 
 
-def bench_op(comm, op, mb, reps=20, warm=1):
+def bench_op(comm, op, mb, reps=20):
     """Time ``op`` at ``mb`` MB per-device payload on ``comm``'s mesh.
 
     Returns ``(busbw_bytes_per_sec, seconds_per_call, payload_bytes)``.
@@ -92,10 +96,20 @@ def bench_op(comm, op, mb, reps=20, warm=1):
         # c: per-device (1,) carry.  The operand is built on-device,
         # per-shard (a global jnp.ones would transiently materialize
         # n*payload on one device) and depends on the previous call's
-        # output so chained calls can't overlap.
-        x = jnp.ones((per_dev,), jnp.float32) + c[0]
-        y = local(x)
-        return y.ravel()[:1].astype(jnp.float32) + 0.0 * c
+        # output so chained calls can't overlap.  The ``reps`` loop is
+        # INSIDE the executable (lax.scan): all iterations are enqueued
+        # back to back and the host syncs once — the NCCL-tests timing
+        # convention; a host dispatch per call would dominate small/
+        # medium payloads on a tunnelled runtime.
+        from jax import lax
+
+        def body(carry, _):
+            x = jnp.ones((per_dev,), jnp.float32) + carry[0]
+            y = local(x)
+            return y.ravel()[:1].astype(jnp.float32) + 0.0 * carry, None
+
+        out, _ = lax.scan(body, c, None, length=reps)
+        return out
 
     fn = jax.jit(
         jax.shard_map(
@@ -107,9 +121,7 @@ def bench_op(comm, op, mb, reps=20, warm=1):
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        c = carry
-        for _ in range(reps):
-            c = fn(c)
+        c = fn(carry)
         drain(c)
         best = min(best, (time.perf_counter() - t0) / reps)
     payload = per_dev * 4
