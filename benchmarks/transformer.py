@@ -1,0 +1,130 @@
+"""Transformer train-step throughput (tokens/s) on the device mesh.
+
+Model-level companion to the solver bench (bench.py) and the collective
+micro-bench (benchmarks/collectives.py): times the flagship dense
+dp×tp×sp transformer train step (models/transformer.py — Megatron f/g +
+ring attention + DP, all collectives on the mesh) end to end, forward +
+backward + SGD in one jitted shard_map executable.
+
+Prints one JSON line: tokens/s, the model-FLOPs estimate (6·N·tokens
+per step, the standard convention), and the config.  Uses the
+fastest-of-k batch estimator (see bench.py — the tunnelled chip shows
+heavy co-tenant noise).
+
+    python benchmarks/transformer.py [--bf16] [--batch 8] [--seq 1024]
+    python benchmarks/transformer.py --cpu-mesh 8   # virtual 2x2x2 mesh
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--d-ff", type=int, default=2048)
+    p.add_argument("--vocab", type=int, default=32768)
+    p.add_argument("--bf16", action="store_true", help="bf16 params/activations")
+    p.add_argument("--batches", type=int, default=8, help="timed batches (min taken)")
+    p.add_argument("--cpu-mesh", type=int, default=0, metavar="N")
+    args = p.parse_args(argv)
+
+    if args.cpu_mesh:
+        from benchmarks.collectives import force_cpu_mesh
+
+        force_cpu_mesh(args.cpu_mesh)
+
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.models import transformer as tfm
+    from mpi4jax_tpu.utils.runtime import drain
+
+    n = len(jax.devices())
+    if n % 4 == 0:
+        shape = (n // 4, 2, 2)
+    elif n == 2:
+        shape = (1, 2, 1)
+    else:
+        shape = (1, 1, 1)
+    mesh = jax.make_mesh(
+        shape, ("dp", "tp", "sp"),
+        axis_types=(jax.sharding.AxisType.Auto,) * 3,
+    )
+    world = m.MeshComm.from_mesh(mesh)
+    dp, tp, sp = world.sub("dp"), world.sub("tp"), world.sub("sp")
+
+    cfg = tfm.TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model, layers=args.layers,
+        heads=args.heads, kv_heads=args.kv_heads,
+        head_dim=args.d_model // args.heads, d_ff=args.d_ff,
+    )
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+    step = tfm.make_global_train_step(mesh, dp, tp, sp, cfg, lr=1e-3)
+
+    b = args.batch * dp.size
+    s = args.seq * sp.size
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, cfg.vocab)
+    batch = (tokens, jnp.roll(tokens, -1, axis=1))
+
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tokens_per_step = b * s
+
+    params, loss = step(params, batch)  # compile + warm
+    drain(loss)
+
+    # steps per timed batch sized from one measured step (~1s batches)
+    t0 = time.perf_counter()
+    params, loss = step(params, batch)
+    drain(loss)
+    per_step = max(time.perf_counter() - t0, 1e-4)
+    steps = max(1, min(50, int(1.0 / per_step)))
+
+    walls = []
+    for _ in range(args.batches):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, loss = step(params, batch)
+        drain(loss)
+        walls.append(time.perf_counter() - t0)
+    best = min(walls) / steps
+
+    import numpy as np
+
+    assert np.isfinite(np.asarray(loss, dtype=np.float32)).all(), "diverged"
+
+    tps = tokens_per_step / best
+    model_tflops = 6.0 * n_params * tokens_per_step / best / 1e12
+    print(
+        json.dumps(
+            {
+                "metric": "transformer_train_tokens_per_sec",
+                "value": round(tps, 1),
+                "unit": "tokens/s",
+                "devices": n,
+                "mesh": list(shape),
+                "params_m": round(n_params / 1e6, 1),
+                "dtype": "bf16" if args.bf16 else "f32",
+                "batch": b,
+                "seq": s,
+                "step_ms": round(best * 1e3, 2),
+                "model_tflops_per_sec": round(model_tflops, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,21 @@ _INF = float("inf")
 _LANES = 128  # TPU lane width: scratch statistics are (block_q, _LANES)
 
 
+def _union_vma_sds(shape, dtype, *arrays):
+    """ShapeDtypeStruct carrying the union of the operands' varying
+    manual axes (required by shard_map's vma checking for pallas_call
+    outputs); plain struct on JAX builds without vma typing."""
+    from mpi4jax_tpu.ops._core import vma_of
+
+    vmas = [vma_of(a) for a in arrays]
+    if all(v is None for v in vmas):
+        return jax.ShapeDtypeStruct(shape, dtype)
+    axes = set()
+    for v in vmas:
+        axes.update(v or ())
+    return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(axes))
+
+
 def _kernel(
     q_ref,
     k_ref,
@@ -242,7 +257,11 @@ def _flash_fwd_impl(
             pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, nq * block_q, d), q.dtype),
+        # inside shard_map the output varies over the union of the
+        # operands' varying axes; check_vma requires it spelled out
+        out_shape=_union_vma_sds(
+            (b * h, nq * block_q, d), q.dtype, qf, kf, vf
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),

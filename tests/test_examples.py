@@ -58,6 +58,21 @@ def test_transformer_training_example(mode):
     )
 
 
+def test_transformer_bench_runs_tiny():
+    root = pathlib.Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(root))
+    try:
+        from benchmarks import transformer as tb
+
+        tb.main([
+            "--batch", "2", "--seq", "64", "--layers", "2",
+            "--d-model", "64", "--d-ff", "128", "--vocab", "256",
+            "--batches", "2",
+        ])
+    finally:
+        sys.path.remove(str(root))
+
+
 def test_long_context_example_runs():
     _run_example("long_context", ["--seq-per-device", "32", "--causal"])
 
