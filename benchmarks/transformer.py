@@ -58,6 +58,7 @@ def main(argv=None):
         shape = (1, 2, 1)
     else:
         shape = (1, 1, 1)
+    n = shape[0] * shape[1] * shape[2]  # devices actually benched
     mesh = jax.make_mesh(
         shape, ("dp", "tp", "sp"),
         axis_types=(jax.sharding.AxisType.Auto,) * 3,

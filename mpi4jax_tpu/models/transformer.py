@@ -145,7 +145,10 @@ def _dense_mlp(h2, bp, cfg, comm_tp, comm_sp, token):
     return allreduce(m_part, reductions.SUM, comm=comm_tp, token=token)
 
 
-def _forward_sharded(params, tokens, cfg, comm_tp, comm_sp, mesh_axes, mlp=None):
+def _forward_sharded(
+    params, tokens, cfg, comm_tp, comm_sp, mesh_axes, mlp=None,
+    sequence="ring",
+):
     """Per-device forward; call inside shard_map over (dp, tp, sp).
 
     ``tokens``: local [B_local, S_local] int32.  Activations are
@@ -157,14 +160,26 @@ def _forward_sharded(params, tokens, cfg, comm_tp, comm_sp, mesh_axes, mlp=None)
     ``mlp(h2, bp, cfg, comm_tp, comm_sp, token) -> (out, token)`` is
     the MLP sublayer (post-ln2); defaults to the dense Megatron pair —
     models/moe_transformer.py substitutes the expert-parallel MoE here.
+
+    ``sequence`` picks the context-parallel attention scheme over sp:
+    ``"ring"`` (KV blocks rotate, sendrecv transpose carries the
+    gradient) or ``"ulysses"`` (two all-to-alls reshard heads↔sequence
+    around full-sequence local attention).  Both compute exact
+    attention — the same oracle covers either.
     """
     from mpi4jax_tpu.ops._core import promote_vma
+    from mpi4jax_tpu.parallel.longseq import ulysses_attention
 
     mlp = mlp or _dense_mlp
     tp = comm_tp.size
     dh = cfg.head_dim
     hq_l, hk_l = cfg.heads // tp, cfg.kv_heads // tp
     b, s = tokens.shape
+    if sequence not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sequence must be 'ring' or 'ulysses', got {sequence!r}"
+        )
+    seq_attn = ring_attention if sequence == "ring" else ulysses_attention
 
     x = promote_vma(params.embed[tokens], mesh_axes)  # (B, S_local, d)
 
@@ -175,7 +190,7 @@ def _forward_sharded(params, tokens, cfg, comm_tp, comm_sp, mesh_axes, mlp=None)
         q = (h @ bp.wq).reshape(b, s, hq_l, dh)
         k = (h @ bp.wk).reshape(b, s, hk_l, dh)
         v = (h @ bp.wv).reshape(b, s, hk_l, dh)
-        attn, token = ring_attention(
+        attn, token = seq_attn(
             q, k, v, comm_sp, causal=True, token=token
         )
         a_part = attn.reshape(b, s, hq_l * dh) @ bp.wo
@@ -198,7 +213,8 @@ def _ce(logits, targets):
 
 
 def make_global_train_step(
-    mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1, *, mlp=None, specs=None
+    mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1, *, mlp=None, specs=None,
+    sequence="ring",
 ):
     """Jitted global train step over a ``(dp, tp, sp)`` mesh.
 
@@ -209,11 +225,18 @@ def make_global_train_step(
 
     ``mlp`` / ``specs`` substitute the MLP sublayer and the parameter
     PartitionSpecs (see :func:`_forward_sharded`; used by the MoE
-    variant, models/moe_transformer.py).
+    variant, models/moe_transformer.py).  ``sequence`` picks the
+    context-parallel attention scheme ("ring" or "ulysses" — the
+    latter needs the per-tp-rank head counts divisible by the sp
+    size).
     """
     dp_ax = comm_dp.axes[0]
     tp_ax = comm_tp.axes[0]
     sp_ax = comm_sp.axes[0]
+    if sequence not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sequence must be 'ring' or 'ulysses', got {sequence!r}"
+        )
     n_data = float(comm_dp.size * comm_sp.size)
     tp = float(comm_tp.size)
     for name, heads in (("heads", cfg.heads), ("kv_heads", cfg.kv_heads)):
@@ -224,6 +247,17 @@ def make_global_train_step(
                 f"{name}/tp heads; for MQA-style configs with fewer kv "
                 f"heads than tp ranks, replicate kv heads to tp first)"
             )
+    if sequence == "ulysses" and comm_sp.size > 1:
+        # checked after tp-divisibility so invalid-everywhere configs
+        # get the general diagnosis, not ulysses-specific advice
+        for name, heads in (("heads", cfg.heads), ("kv_heads", cfg.kv_heads)):
+            if (heads // comm_tp.size) % comm_sp.size:
+                raise ValueError(
+                    f"sequence='ulysses' needs cfg.{name}/tp divisible by "
+                    f"the sp size: {heads}//{comm_tp.size} per tp rank, "
+                    f"sp={comm_sp.size} (for GQA, repeat kv heads or use "
+                    f"sequence='ring')"
+                )
 
     specs = param_specs(tp_ax) if specs is None else specs
     batch_specs = (jax.P(dp_ax, sp_ax), jax.P(dp_ax, sp_ax))
@@ -250,7 +284,7 @@ def make_global_train_step(
         def loss_fn(p):
             logits = _forward_sharded(
                 p, tokens, cfg, comm_tp, comm_sp, (dp_ax, tp_ax, sp_ax),
-                mlp=mlp,
+                mlp=mlp, sequence=sequence,
             )
             return _ce(logits, targets)
 

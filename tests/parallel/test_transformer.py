@@ -95,3 +95,39 @@ def test_head_divisibility_required(mesh3d, comms):
             tfm.make_global_train_step(
                 mesh3d, comm_dp, comm_tp, comm_sp, bad
             )
+
+
+def test_ulysses_sequence_matches_oracle(mesh3d, comms):
+    # same oracle as the ring: ulysses computes exact attention, only
+    # the collective schedule differs (2 alltoalls vs p ppermutes).
+    # kv_heads=4 so heads/tp=2 divides sp=2 (the GQA config can't).
+    cfg = CFG._replace(kv_heads=4)
+    comm_dp, comm_tp, comm_sp = comms
+    params = tfm.init_params(jax.random.PRNGKey(5), cfg)
+    tokens, targets = batch(seed=6)
+
+    step = tfm.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1, sequence="ulysses"
+    )
+    new_params, loss = step(params, (tokens, targets))
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.reference_loss(p, tokens, targets, cfg)
+    )(params)
+    ref_new = jax.tree.map(lambda p, g: p - 1e-1 * g, params, ref_grads)
+
+    np.testing.assert_allclose(
+        float(np.asarray(loss)[0]), float(ref_loss), rtol=2e-5, atol=2e-5
+    )
+    for got, want in zip(jax.tree.leaves(new_params), jax.tree.leaves(ref_new)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_ulysses_gqa_divisibility_error(mesh3d, comms):
+    comm_dp, comm_tp, comm_sp = comms
+    with pytest.raises(ValueError, match="ulysses"):
+        tfm.make_global_train_step(
+            mesh3d, comm_dp, comm_tp, comm_sp, CFG, sequence="ulysses"
+        )
