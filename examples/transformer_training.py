@@ -39,6 +39,12 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--micro", type=int, default=2, help="pp microbatches")
     p.add_argument(
+        "--checkpoint", default=None, metavar="DIR",
+        help="save params every --checkpoint-every steps; a rerun with "
+        "the same DIR resumes from the latest step bit-identically",
+    )
+    p.add_argument("--checkpoint-every", type=int, default=5)
+    p.add_argument(
         "--force-cpu", action="store_true",
         help="run on 8 virtual CPU devices regardless of platform",
     )
@@ -122,17 +128,46 @@ def main(argv=None):
     tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, cfg.vocab)
     batch = (tokens, jnp.roll(tokens, -1, axis=1))
 
+    mgr = None
+    start = 0
+    if args.checkpoint:
+        from mpi4jax_tpu.utils import checkpoint as ckpt
+
+        mgr = ckpt.Manager(args.checkpoint, max_to_keep=2)
+        last = mgr.latest_step()
+        if last is not None:
+            tree = mgr.restore(last, like={"params": params})
+            # back to host arrays: restored leaves are committed to a
+            # single device, which the multi-device jit would reject —
+            # uncommitted inputs it re-shards automatically
+            params = jax.tree.map(np.asarray, tree["params"])
+            start = last
+            print(f"resumed from step {start}")
+
     print(f"{args.mode}: {label}, batch {b}x{s}, {n} devices")
     loss0 = None
-    for i in range(args.steps):
-        params, loss = step(params, batch)
-        val = float(np.asarray(loss)[0])
-        if loss0 is None:
-            loss0 = val
-        if i % 5 == 0:
-            print(f"step {i:4d}  loss {val:.4f}")
-    print(f"loss {loss0:.4f} -> {val:.4f}")
-    assert val < loss0, "training did not reduce the loss"
+    val = None
+    try:
+        for i in range(start, args.steps):
+            params, loss = step(params, batch)
+            val = float(np.asarray(loss)[0])
+            if loss0 is None:
+                loss0 = val
+            if i % 5 == 0:
+                print(f"step {i:4d}  loss {val:.4f}")
+            if mgr is not None:
+                mgr.maybe_save(
+                    i + 1, {"params": params}, every=args.checkpoint_every
+                )
+    finally:
+        # drain any in-flight async save even on interrupt — losing the
+        # newest checkpoint defeats the flag's purpose
+        if mgr is not None:
+            mgr.close()
+    if val is not None:
+        print(f"loss {loss0:.4f} -> {val:.4f}")
+        assert start > 0 or val < loss0, "training did not reduce the loss"
+    return params
 
 
 if __name__ == "__main__":

@@ -58,6 +58,32 @@ def test_transformer_training_example(mode):
     )
 
 
+def test_transformer_training_resume_bit_identical(tmp_path):
+    # interrupted-and-resumed training must land on the same bits as an
+    # uninterrupted run (the solver's resume contract, applied to the
+    # model trainer)
+    import importlib
+    import numpy as np
+
+    examples = pathlib.Path(__file__).resolve().parent.parent / "examples"
+    sys.path.insert(0, str(examples))
+    try:
+        demo = importlib.import_module("transformer_training")
+        full = demo.main(["--steps", "8"])
+        ck = str(tmp_path / "ck")
+        demo.main(["--steps", "4", "--checkpoint", ck, "--checkpoint-every", "2"])
+        resumed = demo.main(
+            ["--steps", "8", "--checkpoint", ck, "--checkpoint-every", "2"]
+        )
+    finally:
+        sys.path.remove(str(examples))
+
+    import jax
+
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_transformer_bench_runs_tiny():
     root = pathlib.Path(__file__).resolve().parent.parent
     sys.path.insert(0, str(root))
