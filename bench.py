@@ -37,6 +37,29 @@ def allreduce_bandwidth(comm, reps=10, mb=64):
     return busbw / 1e9
 
 
+def transformer_tokens_per_sec(timeout=600):
+    """Model-level extra metric: dense-transformer train-step tokens/s
+    on the live devices (benchmarks/transformer.py), run in-process —
+    a second process cannot share the TPU chip.  Bounded by SIGALRM so
+    a wedged run cannot discard the already-measured primary metric."""
+    import signal
+
+    from benchmarks.transformer import run
+
+    def _alarm(signum, frame):
+        raise TimeoutError(f"transformer bench exceeded {timeout}s")
+
+    prev = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(timeout)
+    try:
+        rec = run(bf16=True, batches=6)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+    print(f"[bench] transformer: {rec}", file=sys.stderr)
+    return rec["value"]
+
+
 def virtual_mesh_busbw(timeout=600):
     """8-device virtual-mesh allreduce bus bandwidth via subprocess
     (the axon sitecustomize pins jax_platforms, so the CPU mesh needs
@@ -174,6 +197,12 @@ def main():
     vmesh_gbps = virtual_mesh_busbw()
     if vmesh_gbps is not None:
         extras["allreduce_busbw_cpu8_gbps"] = vmesh_gbps
+    try:
+        extras["transformer_train_tokens_per_sec_bf16"] = (
+            transformer_tokens_per_sec()
+        )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] transformer bench failed: {exc}", file=sys.stderr)
 
     print(
         json.dumps(

@@ -24,26 +24,13 @@ import time
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
-def main(argv=None):
-    p = argparse.ArgumentParser()
-    p.add_argument("--batch", type=int, default=8)
-    p.add_argument("--seq", type=int, default=1024)
-    p.add_argument("--layers", type=int, default=8)
-    p.add_argument("--d-model", type=int, default=512)
-    p.add_argument("--heads", type=int, default=8)
-    p.add_argument("--kv-heads", type=int, default=8)
-    p.add_argument("--d-ff", type=int, default=2048)
-    p.add_argument("--vocab", type=int, default=32768)
-    p.add_argument("--bf16", action="store_true", help="bf16 params/activations")
-    p.add_argument("--batches", type=int, default=8, help="timed batches (min taken)")
-    p.add_argument("--cpu-mesh", type=int, default=0, metavar="N")
-    args = p.parse_args(argv)
-
-    if args.cpu_mesh:
-        from benchmarks.collectives import force_cpu_mesh
-
-        force_cpu_mesh(args.cpu_mesh)
-
+def run(
+    batch=8, seq=1024, layers=8, d_model=512, heads=8, kv_heads=8,
+    d_ff=2048, vocab=32768, bf16=False, batches=8,
+):
+    """Measure the train step; returns the JSON-ready record dict.
+    Importable so ``bench.py`` can run it in-process (a second process
+    cannot share the TPU chip)."""
     import jax
     import jax.numpy as jnp
 
@@ -67,37 +54,37 @@ def main(argv=None):
     dp, tp, sp = world.sub("dp"), world.sub("tp"), world.sub("sp")
 
     cfg = tfm.TransformerConfig(
-        vocab=args.vocab, d_model=args.d_model, layers=args.layers,
-        heads=args.heads, kv_heads=args.kv_heads,
-        head_dim=args.d_model // args.heads, d_ff=args.d_ff,
+        vocab=vocab, d_model=d_model, layers=layers,
+        heads=heads, kv_heads=kv_heads,
+        head_dim=d_model // heads, d_ff=d_ff,
     )
-    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    dtype = jnp.bfloat16 if bf16 else jnp.float32
     params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     step = tfm.make_global_train_step(mesh, dp, tp, sp, cfg, lr=1e-3)
 
-    b = args.batch * dp.size
-    s = args.seq * sp.size
+    b = batch * dp.size
+    s = seq * sp.size
     tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, cfg.vocab)
-    batch = (tokens, jnp.roll(tokens, -1, axis=1))
+    data = (tokens, jnp.roll(tokens, -1, axis=1))
 
     n_params = sum(x.size for x in jax.tree.leaves(params))
     tokens_per_step = b * s
 
-    params, loss = step(params, batch)  # compile + warm
+    params, loss = step(params, data)  # compile + warm
     drain(loss)
 
     # steps per timed batch sized from one measured step (~1s batches)
     t0 = time.perf_counter()
-    params, loss = step(params, batch)
+    params, loss = step(params, data)
     drain(loss)
     per_step = max(time.perf_counter() - t0, 1e-4)
     steps = max(1, min(50, int(1.0 / per_step)))
 
     walls = []
-    for _ in range(args.batches):
+    for _ in range(batches):
         t0 = time.perf_counter()
         for _ in range(steps):
-            params, loss = step(params, batch)
+            params, loss = step(params, data)
         drain(loss)
         walls.append(time.perf_counter() - t0)
     best = min(walls) / steps
@@ -108,21 +95,49 @@ def main(argv=None):
 
     tps = tokens_per_step / best
     model_tflops = 6.0 * n_params * tokens_per_step / best / 1e12
+    return {
+        "metric": "transformer_train_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "devices": n,
+        "mesh": list(shape),
+        "params_m": round(n_params / 1e6, 1),
+        "dtype": "bf16" if bf16 else "f32",
+        "batch": b,
+        "seq": s,
+        "step_ms": round(best * 1e3, 2),
+        "model_tflops_per_sec": round(model_tflops, 2),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--d-ff", type=int, default=2048)
+    p.add_argument("--vocab", type=int, default=32768)
+    p.add_argument("--bf16", action="store_true", help="bf16 params/activations")
+    p.add_argument("--batches", type=int, default=8, help="timed batches (min taken)")
+    p.add_argument("--cpu-mesh", type=int, default=0, metavar="N")
+    args = p.parse_args(argv)
+
+    if args.cpu_mesh:
+        from benchmarks.collectives import force_cpu_mesh
+
+        force_cpu_mesh(args.cpu_mesh)
+
     print(
         json.dumps(
-            {
-                "metric": "transformer_train_tokens_per_sec",
-                "value": round(tps, 1),
-                "unit": "tokens/s",
-                "devices": n,
-                "mesh": list(shape),
-                "params_m": round(n_params / 1e6, 1),
-                "dtype": "bf16" if args.bf16 else "f32",
-                "batch": b,
-                "seq": s,
-                "step_ms": round(best * 1e3, 2),
-                "model_tflops_per_sec": round(model_tflops, 2),
-            }
+            run(
+                batch=args.batch, seq=args.seq, layers=args.layers,
+                d_model=args.d_model, heads=args.heads,
+                kv_heads=args.kv_heads, d_ff=args.d_ff, vocab=args.vocab,
+                bf16=args.bf16, batches=args.batches,
+            )
         )
     )
 

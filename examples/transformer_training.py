@@ -143,6 +143,11 @@ def main(argv=None):
             params = jax.tree.map(np.asarray, tree["params"])
             start = last
             print(f"resumed from step {start}")
+            if start >= args.steps:
+                print(
+                    f"checkpoint already at step {start} >= --steps "
+                    f"{args.steps}; nothing to train"
+                )
 
     print(f"{args.mode}: {label}, batch {b}x{s}, {n} devices")
     loss0 = None

@@ -80,7 +80,10 @@ def test_transformer_training_resume_bit_identical(tmp_path):
 
     import jax
 
-    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(resumed)):
+    assert jax.tree.structure(full) == jax.tree.structure(resumed)
+    for a, b in zip(
+        jax.tree.leaves(full), jax.tree.leaves(resumed), strict=True
+    ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
