@@ -37,25 +37,37 @@ def allreduce_bandwidth(comm, reps=10, mb=64):
     return busbw / 1e9
 
 
-def transformer_tokens_per_sec(timeout=600):
+def transformer_tokens_per_sec(fallback_record, timeout=600):
     """Model-level extra metric: dense-transformer train-step tokens/s
     on the live devices (benchmarks/transformer.py), run in-process —
-    a second process cannot share the TPU chip.  Bounded by SIGALRM so
-    a wedged run cannot discard the already-measured primary metric."""
-    import signal
+    a second process cannot share the TPU chip.
+
+    Guarded by a watchdog THREAD (not SIGALRM: a wedge inside a jaxlib
+    blocking call never re-enters the interpreter, so a Python signal
+    handler would never fire): on timeout the watchdog prints the
+    already-measured ``fallback_record`` as the driver's JSON line and
+    hard-exits, so a hung extra cannot discard the primary metric."""
+    import os
+    import threading
 
     from benchmarks.transformer import run
 
-    def _alarm(signum, frame):
-        raise TimeoutError(f"transformer bench exceeded {timeout}s")
+    def _bail():
+        print(json.dumps(fallback_record), flush=True)
+        print(
+            f"[bench] transformer bench exceeded {timeout}s; emitted "
+            "primary metric without it",
+            file=sys.stderr,
+        )
+        os._exit(0)
 
-    prev = signal.signal(signal.SIGALRM, _alarm)
-    signal.alarm(timeout)
+    watchdog = threading.Timer(timeout, _bail)
+    watchdog.daemon = True
+    watchdog.start()
     try:
         rec = run(bf16=True, batches=6)
     finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, prev)
+        watchdog.cancel()
     print(f"[bench] transformer: {rec}", file=sys.stderr)
     return rec["value"]
 
@@ -197,24 +209,24 @@ def main():
     vmesh_gbps = virtual_mesh_busbw()
     if vmesh_gbps is not None:
         extras["allreduce_busbw_cpu8_gbps"] = vmesh_gbps
+
+    def record():
+        return {
+            "metric": "shallow_water_cell_updates_per_sec_per_chip",
+            "value": round(per_chip, 1),
+            "unit": "cell-updates/s/chip",
+            "vs_baseline": round(per_chip / BASELINE_CELL_UPDATES_PER_SEC, 4),
+            **extras,
+        }
+
     try:
         extras["transformer_train_tokens_per_sec_bf16"] = (
-            transformer_tokens_per_sec()
+            transformer_tokens_per_sec(record())
         )
     except Exception as exc:  # noqa: BLE001 — bench must still emit its line
         print(f"[bench] transformer bench failed: {exc}", file=sys.stderr)
 
-    print(
-        json.dumps(
-            {
-                "metric": "shallow_water_cell_updates_per_sec_per_chip",
-                "value": round(per_chip, 1),
-                "unit": "cell-updates/s/chip",
-                "vs_baseline": round(per_chip / BASELINE_CELL_UPDATES_PER_SEC, 4),
-                **extras,
-            }
-        )
-    )
+    print(json.dumps(record()))
     print(
         f"[bench] devices={n_dev} mesh={shape} steps={total_steps} "
         f"wall={elapsed:.2f}s total_rate={rate:.3e}",

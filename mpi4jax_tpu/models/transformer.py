@@ -147,7 +147,7 @@ def _dense_mlp(h2, bp, cfg, comm_tp, comm_sp, token):
 
 def _forward_sharded(
     params, tokens, cfg, comm_tp, comm_sp, mesh_axes, mlp=None,
-    sequence="ring",
+    sequence="ring", remat=False,
 ):
     """Per-device forward; call inside shard_map over (dp, tp, sp).
 
@@ -201,6 +201,13 @@ def _forward_sharded(
         m, _token = mlp(h2, bp, cfg, comm_tp, comm_sp, token)
         return x + m, None
 
+    if remat:
+        # rematerialise each layer in the backward pass: activation
+        # memory drops from O(layers) to O(1) layers (plus the scan
+        # carry) at ~1/3 extra FLOPs — the standard long-context lever
+        # on HBM-bound chips.  The collectives re-execute under remat;
+        # token ordering is per-layer-instance so replay is safe.
+        layer = jax.checkpoint(layer)
     x, _ = lax.scan(layer, x, params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)
     return x @ params.head  # (B, S_local, V) logits
@@ -214,7 +221,7 @@ def _ce(logits, targets):
 
 def make_global_train_step(
     mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1, *, mlp=None, specs=None,
-    sequence="ring",
+    sequence="ring", remat=False,
 ):
     """Jitted global train step over a ``(dp, tp, sp)`` mesh.
 
@@ -228,7 +235,9 @@ def make_global_train_step(
     variant, models/moe_transformer.py).  ``sequence`` picks the
     context-parallel attention scheme ("ring" or "ulysses" — the
     latter needs the per-tp-rank head counts divisible by the sp
-    size).
+    size).  ``remat=True`` wraps each layer in ``jax.checkpoint`` —
+    activation memory O(1) layers instead of O(layers), ~1/3 extra
+    FLOPs; gradients are unchanged (same math, recomputed).
     """
     dp_ax = comm_dp.axes[0]
     tp_ax = comm_tp.axes[0]
@@ -284,7 +293,7 @@ def make_global_train_step(
         def loss_fn(p):
             logits = _forward_sharded(
                 p, tokens, cfg, comm_tp, comm_sp, (dp_ax, tp_ax, sp_ax),
-                mlp=mlp, sequence=sequence,
+                mlp=mlp, sequence=sequence, remat=remat,
             )
             return _ce(logits, targets)
 

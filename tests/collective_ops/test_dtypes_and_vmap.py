@@ -50,6 +50,26 @@ def test_allreduce_sum_dtypes(comm1d, dtype):
 
 
 @pytest.mark.parametrize("dtype", DTYPES, ids=lambda d: jnp.dtype(d).name)
+def test_reduce_scatter_sum_dtypes(comm1d, dtype):
+    # extension op: same dtype battery as allreduce; identity
+    # reduce_scatter(x)[rank] == allreduce-sum of the per-rank rows
+    x = _world(dtype)
+
+    def fn(v):
+        rows = jnp.broadcast_to(v, (SIZE, 1))
+        y, _ = m.reduce_scatter(rows, comm=comm1d)
+        return y
+
+    out = spmd_jit(comm1d, fn)(x)
+    assert out.dtype == x.dtype, (out.dtype, x.dtype)
+    if dtype == jnp.bool_:
+        expected = np.full(SIZE, True)  # one rank contributes True
+    else:
+        expected = np.full(SIZE, np.asarray(x).sum(), np.asarray(x).dtype)
+    assert np.array_equal(np.asarray(out), expected), out
+
+
+@pytest.mark.parametrize("dtype", DTYPES, ids=lambda d: jnp.dtype(d).name)
 def test_bcast_allgather_dtypes(comm1d, dtype):
     x = _world(dtype)
     b = spmd_jit(comm1d, lambda v: m.bcast(v, 3, comm=comm1d)[0])(x)

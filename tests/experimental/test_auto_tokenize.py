@@ -83,6 +83,26 @@ def test_collective_chain_matches_manual_tokens(comm1d):
     assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_reduce_scatter_in_chain(comm1d):
+    # the extension op rides the same ambient-token machinery
+    def auto(x):
+        y, _ = m.allreduce(x, m.SUM, comm=comm1d)
+        rows = jnp.broadcast_to(y[0], (SIZE, 1))
+        z, _ = m.reduce_scatter(rows, comm=comm1d)
+        return z
+
+    def manual(x):
+        tok = m.create_token()
+        y, tok = m.allreduce(x, m.SUM, comm=comm1d, token=tok)
+        rows = jnp.broadcast_to(y[0], (SIZE, 1))
+        z, tok = m.reduce_scatter(rows, comm=comm1d, token=tok)
+        return z
+
+    a = spmd_jit(comm1d, auto_tokenize(auto))(world_input())
+    b = spmd_jit(comm1d, manual)(world_input())
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_decorator_inside_jit(comm1d):
     """auto_tokenize composes under jit in either nesting order (the
     reference requires decorator-outside-jit; both work here)."""
