@@ -52,7 +52,11 @@ def transformer_tokens_per_sec(fallback_record, timeout=600):
 
     from benchmarks.transformer import run
 
+    done = threading.Event()
+
     def _bail():
+        if done.is_set():  # run() finished just before the timer fired
+            return
         print(json.dumps(fallback_record), flush=True)
         print(
             f"[bench] transformer bench exceeded {timeout}s; emitted "
@@ -66,6 +70,7 @@ def transformer_tokens_per_sec(fallback_record, timeout=600):
     watchdog.start()
     try:
         rec = run(bf16=True, batches=6)
+        done.set()
     finally:
         watchdog.cancel()
     print(f"[bench] transformer: {rec}", file=sys.stderr)

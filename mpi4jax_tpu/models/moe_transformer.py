@@ -182,7 +182,9 @@ def _moe_mlp(h2, bp, cfg, comm_tp, comm_sp, token):
     return _moe_ffn(h2, bp.wr, bp.w1e, bp.w2e, cfg, comm_sp, token)
 
 
-def make_global_train_step(mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1):
+def make_global_train_step(
+    mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1, *, remat=False
+):
     """Jitted global train step over a ``(dp, tp, sp)`` mesh with the
     MoE MLP expert-sharded over ``sp``.
 
@@ -201,6 +203,7 @@ def make_global_train_step(mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1):
         mesh, comm_dp, comm_tp, comm_sp, cfg, lr,
         mlp=_moe_mlp,
         specs=param_specs(comm_tp.axes[0], comm_sp.axes[0]),
+        remat=remat,
     )
 
 

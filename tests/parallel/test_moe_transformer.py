@@ -87,6 +87,29 @@ def test_moe_loss_decreases(mesh3d, comms):
     assert np.isfinite(losses).all()
 
 
+def test_moe_remat_matches_plain(mesh3d, comms):
+    # the MoE sublayer's alltoall pair must also replay correctly under
+    # jax.checkpoint
+    comm_dp, comm_tp, comm_sp = comms
+    params = moe.init_params(jax.random.PRNGKey(11), CFG)
+    tokens, targets = batch(seed=12)
+    plain = moe.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=1e-1
+    )
+    rstep = moe.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=1e-1, remat=True
+    )
+    p1, l1 = plain(params, (tokens, targets))
+    p2, l2 = rstep(params, (tokens, targets))
+    np.testing.assert_allclose(
+        float(np.asarray(l1)[0]), float(np.asarray(l2)[0]), rtol=1e-6
+    )
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2), strict=True):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
 def test_moe_experts_divisibility(mesh3d, comms):
     comm_dp, comm_tp, comm_sp = comms
     with pytest.raises(ValueError, match="divisible by the expert"):

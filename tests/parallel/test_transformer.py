@@ -125,18 +125,22 @@ def test_ulysses_sequence_matches_oracle(mesh3d, comms):
         )
 
 
-def test_remat_matches_plain(mesh3d, comms):
+@pytest.mark.parametrize("sequence", ["ring", "ulysses"])
+def test_remat_matches_plain(mesh3d, comms, sequence):
     # jax.checkpoint on each layer: same math recomputed — the update
     # must match the non-remat step bitwise-closely (identical graph
-    # values; only scheduling differs)
+    # values; only scheduling differs).  Covers both context-parallel
+    # schemes' collectives replaying under remat.
+    cfg = CFG if sequence == "ring" else CFG._replace(kv_heads=4)
     comm_dp, comm_tp, comm_sp = comms
-    params = tfm.init_params(jax.random.PRNGKey(7), CFG)
+    params = tfm.init_params(jax.random.PRNGKey(7), cfg)
     tokens, targets = batch(seed=8)
     plain = tfm.make_global_train_step(
-        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=1e-1
+        mesh3d, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1, sequence=sequence
     )
     rstep = tfm.make_global_train_step(
-        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=1e-1, remat=True
+        mesh3d, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1, sequence=sequence,
+        remat=True,
     )
     p1, l1 = plain(params, (tokens, targets))
     p2, l2 = rstep(params, (tokens, targets))
