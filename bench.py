@@ -53,24 +53,29 @@ def transformer_tokens_per_sec(fallback_record, timeout=600):
     from benchmarks.transformer import run
 
     done = threading.Event()
+    lock = threading.Lock()  # serialises bail vs success so at most one
+    # emitter exists: _bail exits while holding it, and the success path
+    # sets done under it before main can ever print
 
     def _bail():
-        if done.is_set():  # run() finished just before the timer fired
-            return
-        print(json.dumps(fallback_record), flush=True)
-        print(
-            f"[bench] transformer bench exceeded {timeout}s; emitted "
-            "primary metric without it",
-            file=sys.stderr,
-        )
-        os._exit(0)
+        with lock:
+            if done.is_set():  # run() finished before the timer fired
+                return
+            print(json.dumps(fallback_record), flush=True)
+            print(
+                f"[bench] transformer bench exceeded {timeout}s; emitted "
+                "primary metric without it",
+                file=sys.stderr,
+            )
+            os._exit(0)
 
     watchdog = threading.Timer(timeout, _bail)
     watchdog.daemon = True
     watchdog.start()
     try:
         rec = run(bf16=True, batches=6)
-        done.set()
+        with lock:
+            done.set()
     finally:
         watchdog.cancel()
     print(f"[bench] transformer: {rec}", file=sys.stderr)
