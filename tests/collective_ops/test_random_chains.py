@@ -117,6 +117,66 @@ OPS = [
 ]
 
 
+# the adjoint-differentiable subset: every op here is a LINEAR map of
+# the global state whose JAX transpose is the true adjoint, so the
+# gradient of sum(chain(x)) is the transpose applied to ones —
+# computable exactly in numpy from basis vectors.  ``allreduce`` is
+# deliberately absent: its AD contract is the reference's
+# identity-transpose convention (transpose(allreduce) = identity, NOT
+# the adjoint — see ops/allreduce.py), pinned by its own test battery.
+LINEAR_OPS = [
+    (_jx_bcast, _np_bcast),
+    (_jx_allgather_next, _np_allgather_next),
+    (_jx_alltoall, _np_alltoall),
+    (_jx_reduce_scatter, _np_reduce_scatter),
+    (_jx_scan, _np_scan),
+    (_jx_scatter, _np_scatter),
+    (_jx_ring, _np_ring),
+]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_chain_grads_match_linear_oracle(comm1d, seed):
+    rng = np.random.RandomState(100 + seed)
+    chain = [LINEAR_OPS[i] for i in rng.randint(0, len(LINEAR_OPS), size=5)]
+    init = rng.randint(0, 5, size=(SIZE, SIZE)).astype(np.float32)
+
+    def np_chain(rows):
+        for _, np_fn in chain:
+            rows = np_fn(rows)
+        return rows
+
+    # gradient oracle by linearity: d sum(A x) / d x_ij = sum(A e_ij)
+    expected = np.zeros((SIZE, SIZE), np.float32)
+    for i in range(SIZE):
+        for j in range(SIZE):
+            e = np.zeros((SIZE, SIZE), np.float32)
+            e[i, j] = 1.0
+            expected[i, j] = np_chain(e).sum()
+
+    def local(v):
+        def loss(x):
+            tok = m.create_token()
+            for jx_fn, _ in chain:
+                x, tok = jx_fn(x, comm1d, tok)
+            return x.sum()  # global loss = sum of per-device sums
+
+        g = jax.grad(loss)(v[0])
+        return g[None]
+
+    f = jax.jit(
+        jax.shard_map(
+            local, mesh=comm1d.mesh,
+            in_specs=jax.P(comm1d.axes, None),
+            out_specs=jax.P(comm1d.axes, None),
+        )
+    )
+    out = f(jnp.asarray(init))
+    np.testing.assert_allclose(
+        np.asarray(out), expected, rtol=1e-5, atol=1e-5, err_msg=str(seed)
+    )
+
+
 @pytest.mark.parametrize("seed", range(8))
 def test_random_chain_matches_numpy_oracle(comm1d, seed):
     rng = np.random.RandomState(seed)
