@@ -42,7 +42,9 @@ __all__ = [
     "TransformerParams",
     "init_params",
     "make_global_train_step",
+    "make_global_decode",
     "reference_loss",
+    "reference_greedy_decode",
 ]
 
 
@@ -120,6 +122,17 @@ def param_specs(tp_ax):
         ln_f=jax.P(None),
         head=jax.P(None, None),
     )
+
+
+def _check_tp_divisibility(cfg, tp):
+    for name, heads in (("heads", cfg.heads), ("kv_heads", cfg.kv_heads)):
+        if heads % tp:
+            raise ValueError(
+                f"cfg.{name}={heads} must be divisible by the tensor-"
+                f"parallel size {tp} (each tp rank owns "
+                f"{name}/tp heads; for MQA-style configs with fewer kv "
+                f"heads than tp ranks, replicate kv heads to tp first)"
+            )
 
 
 def _rmsnorm(x, g, eps):
@@ -248,14 +261,7 @@ def make_global_train_step(
         )
     n_data = float(comm_dp.size * comm_sp.size)
     tp = float(comm_tp.size)
-    for name, heads in (("heads", cfg.heads), ("kv_heads", cfg.kv_heads)):
-        if heads % comm_tp.size:
-            raise ValueError(
-                f"cfg.{name}={heads} must be divisible by the tensor-"
-                f"parallel size {comm_tp.size} (each tp rank owns "
-                f"{name}/tp heads; for MQA-style configs with fewer kv "
-                f"heads than tp ranks, replicate kv heads to tp first)"
-            )
+    _check_tp_divisibility(cfg, comm_tp.size)
     if sequence == "ulysses" and comm_sp.size > 1:
         # checked after tp-divisibility so invalid-everywhere configs
         # get the general diagnosis, not ulysses-specific advice
@@ -343,3 +349,156 @@ def reference_loss(params, tokens, targets, cfg):
     x, _ = lax.scan(layer, x, params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)
     return _ce(x @ params.head, targets)
+
+
+# --------------------------- inference -----------------------------
+
+
+def _decode_step_sharded(params, cache, last_tok, pos, cfg, comm_tp, hq_l, hk_l):
+    """One greedy decode step on the local tp shard.
+
+    ``cache``: (layers, 2, B, S_max, Hkv_local, dh) — K/V per layer.
+    ``last_tok``: (B,) int32; ``pos``: scalar int32 write position.
+    Returns (cache, next_tok, logits).
+    """
+    dh = cfg.head_dim
+    b = last_tok.shape[0]
+    x = params.embed[last_tok][:, None, :]  # (B, 1, d)
+    token = create_token()
+
+    def layer(carry, inputs):
+        x, token = carry
+        bp, kv = inputs
+        h = _rmsnorm(x, bp.ln1, cfg.eps)
+        h, token = _f_collective(h, comm_tp, token)
+        q = (h @ bp.wq).reshape(b, 1, hq_l, dh)
+        k_new = (h @ bp.wk).reshape(b, 1, hk_l, dh)
+        v_new = (h @ bp.wv).reshape(b, 1, hk_l, dh)
+        k_cache = lax.dynamic_update_slice(kv[0], k_new, (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(kv[1], v_new, (0, pos, 0, 0))
+        # attend over positions <= pos (masked full-cache attention;
+        # q_offset=pos makes the causal mask pass exactly those)
+        attn = local_attention(
+            q, k_cache, v_cache, causal=True, q_offset=pos, impl="xla"
+        )
+        a_part = attn.reshape(b, 1, hq_l * dh) @ bp.wo
+        a, token = allreduce(a_part, reductions.SUM, comm=comm_tp, token=token)
+        x = x + a
+        h2 = _rmsnorm(x, bp.ln2, cfg.eps)
+        h2, token = _f_collective(h2, comm_tp, token)
+        m_part = jax.nn.gelu(h2 @ bp.w1) @ bp.w2
+        m, token = allreduce(m_part, reductions.SUM, comm=comm_tp, token=token)
+        return (x + m, token), jnp.stack([k_cache, v_cache])
+
+    (x, _token), cache = lax.scan(layer, (x, token), (params.blocks, cache))
+    x = _rmsnorm(x, params.ln_f, cfg.eps)
+    logits = (x @ params.head)[:, 0, :]  # (B, V)
+    return cache, jnp.argmax(logits, axis=-1).astype(last_tok.dtype), logits
+
+
+def make_global_decode(mesh, comm_dp, comm_tp, cfg, max_len):
+    """Jitted greedy autoregressive decoder over a ``(dp, tp)`` mesh.
+
+    ``decode(params, prompt)``: ``prompt`` is global ``[B, P]`` int32
+    sharded over dp (tp-replicated).  Prefill processes the prompt one
+    position at a time through the same KV-cached step as generation
+    (simple and exactly equivalent; batch-prefill is an optimisation,
+    not a semantics change), then generates ``max_len - P`` greedy
+    tokens.  Returns global ``[B, max_len]`` int32 — prompt followed by
+    the generated continuation.  Matches
+    :func:`reference_greedy_decode` exactly (same math; tp roundoff
+    only).
+    """
+    dp_ax, tp_ax = comm_dp.axes[0], comm_tp.axes[0]
+    tp = comm_tp.size
+    _check_tp_divisibility(cfg, tp)
+    hq_l, hk_l = cfg.heads // tp, cfg.kv_heads // tp
+    specs = param_specs(tp_ax)
+
+    def local_decode(params, prompt):
+        from mpi4jax_tpu.ops._core import promote_vma
+
+        b, p_len = prompt.shape
+        if p_len > max_len:
+            raise ValueError(
+                f"prompt length {p_len} exceeds max_len={max_len} "
+                f"(the decoder's static sequence budget)"
+            )
+        prompt = promote_vma(prompt, (dp_ax, tp_ax))
+        cache = promote_vma(
+            jnp.zeros(
+                (cfg.layers, 2, b, max_len, hk_l, cfg.head_dim),
+                params.embed.dtype,
+            ),
+            (dp_ax, tp_ax),
+        )
+        out = promote_vma(
+            jnp.zeros((b, max_len), prompt.dtype), (dp_ax, tp_ax)
+        )
+        out = lax.dynamic_update_slice(out, prompt, (0, 0))
+
+        def step(carry, pos):
+            # pos runs 0..max_len-2, so pos+1 is always a valid slot
+            cache, out = carry
+            last = lax.dynamic_index_in_dim(
+                out, pos, axis=1, keepdims=False
+            )
+            cache, nxt, _logits = _decode_step_sharded(
+                params, cache, last, pos, cfg, comm_tp, hq_l, hk_l
+            )
+            # inside the prompt, keep the given token; past it, append
+            # the greedy choice
+            cur = lax.dynamic_index_in_dim(out, pos + 1, axis=1, keepdims=False)
+            write = jnp.where(pos + 1 < p_len, cur, nxt)
+            out = lax.dynamic_update_slice(out, write[:, None], (0, pos + 1))
+            return (cache, out), None
+
+        (cache, out), _ = lax.scan(
+            step, (cache, out), jnp.arange(max_len - 1)
+        )
+        # every tp rank computed the identical sequence, but collective
+        # outputs are varying-typed; a masked psum re-establishes the
+        # replicated typing the out_specs declare
+        tp_rank = lax.axis_index(tp_ax)
+        return lax.psum(
+            jnp.where(tp_rank == 0, out, jnp.zeros((), out.dtype)), tp_ax
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            local_decode,
+            mesh=mesh,
+            in_specs=(specs, jax.P(dp_ax, None)),
+            out_specs=jax.P(dp_ax, None),
+        )
+    )
+
+
+def reference_greedy_decode(params, prompt, cfg, max_len):
+    """Unsharded oracle: full-sequence recompute per position."""
+    b, p_len = prompt.shape
+    if p_len > max_len:
+        raise ValueError(
+            f"prompt length {p_len} exceeds max_len={max_len}"
+        )
+    out = jnp.zeros((b, max_len), prompt.dtype)
+    out = lax.dynamic_update_slice(out, prompt, (0, 0))
+
+    def body(pos, out):
+        x = params.embed[out]
+
+        def layer(x, bp):
+            return dense_layer(x, bp, cfg), None
+
+        x, _ = lax.scan(layer, x, params.blocks)
+        x = _rmsnorm(x, params.ln_f, cfg.eps)
+        logits = x @ params.head  # (B, max_len, V)
+        step_logits = lax.dynamic_index_in_dim(
+            logits, pos, axis=1, keepdims=False
+        )
+        nxt = jnp.argmax(step_logits, axis=-1).astype(out.dtype)
+        cur = lax.dynamic_index_in_dim(out, pos + 1, axis=1, keepdims=False)
+        write = jnp.where(pos + 1 < p_len, cur, nxt)
+        return lax.dynamic_update_slice(out, write[:, None], (0, pos + 1))
+
+    return lax.fori_loop(0, max_len - 1, body, out)
