@@ -45,6 +45,12 @@ def main(argv=None):
     )
     p.add_argument("--checkpoint-every", type=int, default=5)
     p.add_argument(
+        "--generate", type=int, default=0, metavar="N",
+        help="after training (dense mode), greedily decode N tokens "
+        "from the first training sequence's prefix (TP-sharded KV "
+        "cache)",
+    )
+    p.add_argument(
         "--force-cpu", action="store_true",
         help="run on 8 virtual CPU devices regardless of platform",
     )
@@ -172,6 +178,21 @@ def main(argv=None):
     if val is not None:
         print(f"loss {loss0:.4f} -> {val:.4f}")
         assert start > 0 or val < loss0, "training did not reduce the loss"
+
+    if args.generate and args.mode != "dense":
+        print("--generate is only supported with --mode dense; skipping")
+    elif args.generate:
+        # inference round trip on the trained weights: prefix of the
+        # first training sequence -> greedy continuation
+        prefix = 4
+        max_len = prefix + args.generate
+        decode = tfm.make_global_decode(mesh, dp, tp, cfg, max_len)
+        prompt = jnp.broadcast_to(
+            tokens[:1, :prefix], (dp.size, prefix)
+        )
+        out = np.asarray(decode(params, prompt))
+        print(f"prompt  {out[0, :prefix].tolist()}")
+        print(f"decoded {out[0, prefix:].tolist()}")
     return params
 
 

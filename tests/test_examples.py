@@ -58,6 +58,13 @@ def test_transformer_training_example(mode):
     )
 
 
+def test_transformer_training_generate():
+    _run_example(
+        "transformer_training",
+        ["--mode", "dense", "--steps", "6", "--generate", "4"],
+    )
+
+
 def test_transformer_training_resume_bit_identical(tmp_path):
     # interrupted-and-resumed training must land on the same bits as an
     # uninterrupted run (the solver's resume contract, applied to the
