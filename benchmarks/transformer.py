@@ -26,48 +26,108 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 def run(
     batch=8, seq=1024, layers=8, d_model=512, heads=8, kv_heads=8,
-    d_ff=2048, vocab=32768, bf16=False, batches=8,
+    d_ff=2048, vocab=32768, bf16=False, batches=8, mode="dense",
+    micro=None,
 ):
-    """Measure the train step; returns the JSON-ready record dict.
-    Importable so ``bench.py`` can run it in-process (a second process
-    cannot share the TPU chip)."""
+    """Measure the train step of the chosen parallelism family
+    (``mode``: "dense", "moe", or "pp"); returns the JSON-ready record
+    dict.  Importable so ``bench.py`` can run it in-process (a second
+    process cannot share the TPU chip)."""
     import jax
     import jax.numpy as jnp
 
     import mpi4jax_tpu as m
-    from mpi4jax_tpu.models import transformer as tfm
     from mpi4jax_tpu.utils.runtime import drain
 
     n = len(jax.devices())
-    if n % 4 == 0:
-        shape = (n // 4, 2, 2)
-    elif n == 2:
-        shape = (1, 2, 1)
+    if mode == "pp":
+        from mpi4jax_tpu.models import pp_transformer as ppt
+
+        pp_n = min(n, 4) if n > 1 else 1
+        shape = (n // pp_n, pp_n)
+        n = shape[0] * shape[1]
+        mesh = jax.make_mesh(
+            shape, ("dp", "pp"), axis_types=(jax.sharding.AxisType.Auto,) * 2
+        )
+        world = m.MeshComm.from_mesh(mesh)
+        dp, pp = world.sub("dp"), world.sub("pp")
+        rounded = max(layers, pp_n) - max(layers, pp_n) % pp_n
+        if rounded != layers:
+            print(
+                f"[transformer-bench] pp: layers {layers} -> {rounded} "
+                f"(multiple of {pp_n} stages)",
+                file=sys.stderr,
+            )
+        layers = rounded
+        cfg = ppt.TransformerConfig(
+            vocab=vocab, d_model=d_model, layers=layers,
+            heads=heads, kv_heads=kv_heads,
+            head_dim=d_model // heads, d_ff=d_ff,
+        )
+        dtype = jnp.bfloat16 if bf16 else jnp.float32
+        params = ppt.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+        micro = micro or min(4, batch)
+        if batch % micro:
+            raise ValueError(
+                f"--batch {batch} must be divisible by the microbatch "
+                f"count {micro} (pass --micro)"
+            )
+        step = ppt.make_global_train_step(
+            mesh, dp, pp, cfg, n_micro=micro, lr=1e-3
+        )
+        b = batch * dp.size
+        s = seq
     else:
-        shape = (1, 1, 1)
-    n = shape[0] * shape[1] * shape[2]  # devices actually benched
-    mesh = jax.make_mesh(
-        shape, ("dp", "tp", "sp"),
-        axis_types=(jax.sharding.AxisType.Auto,) * 3,
-    )
-    world = m.MeshComm.from_mesh(mesh)
-    dp, tp, sp = world.sub("dp"), world.sub("tp"), world.sub("sp")
+        if n % 4 == 0:
+            shape = (n // 4, 2, 2)
+        elif n == 2:
+            shape = (1, 2, 1)
+        else:
+            shape = (1, 1, 1)
+        n = shape[0] * shape[1] * shape[2]  # devices actually benched
+        mesh = jax.make_mesh(
+            shape, ("dp", "tp", "sp"),
+            axis_types=(jax.sharding.AxisType.Auto,) * 3,
+        )
+        world = m.MeshComm.from_mesh(mesh)
+        dp, tp, sp = world.sub("dp"), world.sub("tp"), world.sub("sp")
+        dtype = jnp.bfloat16 if bf16 else jnp.float32
 
-    cfg = tfm.TransformerConfig(
-        vocab=vocab, d_model=d_model, layers=layers,
-        heads=heads, kv_heads=kv_heads,
-        head_dim=d_model // heads, d_ff=d_ff,
-    )
-    dtype = jnp.bfloat16 if bf16 else jnp.float32
-    params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
-    step = tfm.make_global_train_step(mesh, dp, tp, sp, cfg, lr=1e-3)
+        if mode == "moe":
+            from mpi4jax_tpu.models import moe_transformer as moe
 
-    b = batch * dp.size
-    s = seq * sp.size
+            cfg = moe.MoEConfig(
+                vocab=vocab, d_model=d_model, layers=layers,
+                heads=heads, kv_heads=kv_heads,
+                head_dim=d_model // heads,
+                experts=4 * sp.size, d_ff=d_ff,
+            )
+            params = moe.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+            step = moe.make_global_train_step(mesh, dp, tp, sp, cfg, lr=1e-3)
+        else:
+            from mpi4jax_tpu.models import transformer as tfm
+
+            cfg = tfm.TransformerConfig(
+                vocab=vocab, d_model=d_model, layers=layers,
+                heads=heads, kv_heads=kv_heads,
+                head_dim=d_model // heads, d_ff=d_ff,
+            )
+            params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
+            step = tfm.make_global_train_step(mesh, dp, tp, sp, cfg, lr=1e-3)
+
+        b = batch * dp.size
+        s = seq * sp.size
     tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, cfg.vocab)
     data = (tokens, jnp.roll(tokens, -1, axis=1))
 
     n_params = sum(x.size for x in jax.tree.leaves(params))
+    # FLOPs convention uses ACTIVE params: for MoE each token is
+    # processed by exactly one expert-width FFN (expert choice,
+    # capacity 1), so the (E-1)/E inactive expert weights are excluded
+    n_active = n_params
+    if mode == "moe":
+        expert_sz = params.blocks.w1e.size + params.blocks.w2e.size
+        n_active = n_params - expert_sz + expert_sz // cfg.experts
     tokens_per_step = b * s
 
     params, loss = step(params, data)  # compile + warm
@@ -94,14 +154,18 @@ def run(
     assert np.isfinite(np.asarray(loss, dtype=np.float32)).all(), "diverged"
 
     tps = tokens_per_step / best
-    model_tflops = 6.0 * n_params * tokens_per_step / best / 1e12
+    model_tflops = 6.0 * n_active * tokens_per_step / best / 1e12
     return {
-        "metric": "transformer_train_tokens_per_sec",
+        "metric": f"transformer_{mode}_train_tokens_per_sec"
+        if mode != "dense" else "transformer_train_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/s",
         "devices": n,
         "mesh": list(shape),
         "params_m": round(n_params / 1e6, 1),
+        "params_active_m": round(n_active / 1e6, 1),
+        "layers": cfg.layers,
+        **({"n_micro": micro} if mode == "pp" else {}),
         "dtype": "bf16" if bf16 else "f32",
         "batch": b,
         "seq": s,
@@ -122,6 +186,8 @@ def main(argv=None):
     p.add_argument("--vocab", type=int, default=32768)
     p.add_argument("--bf16", action="store_true", help="bf16 params/activations")
     p.add_argument("--batches", type=int, default=8, help="timed batches (min taken)")
+    p.add_argument("--mode", choices=("dense", "moe", "pp"), default="dense")
+    p.add_argument("--micro", type=int, default=None, help="pp microbatches")
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N")
     args = p.parse_args(argv)
 
@@ -136,7 +202,8 @@ def main(argv=None):
                 batch=args.batch, seq=args.seq, layers=args.layers,
                 d_model=args.d_model, heads=args.heads,
                 kv_heads=args.kv_heads, d_ff=args.d_ff, vocab=args.vocab,
-                bf16=args.bf16, batches=args.batches,
+                bf16=args.bf16, batches=args.batches, mode=args.mode,
+                micro=args.micro,
             )
         )
     )

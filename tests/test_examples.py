@@ -94,14 +94,15 @@ def test_transformer_training_resume_bit_identical(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_transformer_bench_runs_tiny():
+@pytest.mark.parametrize("mode", ["dense", "moe", "pp"])
+def test_transformer_bench_runs_tiny(mode):
     root = pathlib.Path(__file__).resolve().parent.parent
     sys.path.insert(0, str(root))
     try:
         from benchmarks import transformer as tb
 
         tb.main([
-            "--batch", "2", "--seq", "64", "--layers", "2",
+            "--mode", mode, "--batch", "2", "--seq", "64", "--layers", "2",
             "--d-model", "64", "--d-ff", "128", "--vocab", "256",
             "--batches", "2",
         ])
