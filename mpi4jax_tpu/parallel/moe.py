@@ -15,12 +15,19 @@ or drop to balanced assignments first (static shapes are what make the
 dispatch one fused ICI collective instead of a host gather).
 """
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from mpi4jax_tpu.ops._core import as_token
 from mpi4jax_tpu.ops.collectives import alltoall
 
-__all__ = ["expert_dispatch", "expert_combine"]
+__all__ = [
+    "expert_dispatch",
+    "expert_combine",
+    "topk_route",
+    "topk_moe",
+]
 
 
 def expert_dispatch(x, expert_idx, comm, *, token=None):
@@ -55,6 +62,78 @@ def expert_dispatch(x, expert_idx, comm, *, token=None):
     buckets = x[order].reshape(n, cap, d)
     expert_input, token = alltoall(buckets, comm=comm, token=token)
     return expert_input, order, token
+
+
+def topk_route(scores, k, capacity):
+    """Token-choice top-k routing with per-expert capacity (the
+    GShard / Switch scheme, vs the expert-choice scheme of
+    models/moe_transformer.py).
+
+    Each token picks its ``k`` highest-scoring experts; each expert
+    accepts at most ``capacity`` of the tokens that chose it, in score
+    order — the rest overflow and are dropped (their combine
+    contribution is zero; the residual connection carries them).  All
+    shapes are static, so the result feeds one fused dispatch.
+
+    Args:
+      scores: ``(T, E)`` router probabilities (post-softmax).
+      k: experts per token.
+      capacity: slots per expert.
+
+    Returns ``(idx, gate, valid)``, each ``(E, capacity)``:
+      ``idx[e, c]`` — source-token index of expert ``e``'s slot ``c``;
+      ``gate[e, c]`` — that token's score for ``e``;
+      ``valid[e, c]`` — False for unfilled / overflow slots.
+    """
+    t, n_experts = scores.shape
+    # each token's chosen experts: (T, k)
+    top_scores, top_experts = lax.top_k(scores, k)
+    # per (token, expert): the score if chosen, else -inf
+    chose = jnp.full((t, n_experts), -jnp.inf, scores.dtype)
+    chose = chose.at[jnp.arange(t)[:, None], top_experts].set(top_scores)
+    # each expert takes its top-capacity choosers by score
+    gate, idx = lax.top_k(chose.T, capacity)  # (E, cap)
+    valid = jnp.isfinite(gate)
+    gate = jnp.where(valid, gate, jnp.zeros((), gate.dtype))
+    return idx, gate, valid
+
+
+def topk_moe(x, scores, expert_fn, comm, *, k=1, capacity=None, token=None):
+    """Full token-choice MoE layer: route → alltoall dispatch → expert
+    compute → alltoall combine → gate-weighted scatter-add.
+
+    Experts are ``comm.size`` (one per rank, as :func:`expert_dispatch`).
+    ``expert_fn(x_slot)`` maps the local expert's ``(n_src*capacity, d)``
+    buffer elementwise per token.  Dropped (overflow) tokens contribute
+    zero; tokens keep their gate weighting.  Differentiable end to end
+    (the reference's alltoall building block; gates through the score
+    gradient).
+
+    ``capacity`` defaults to ``ceil(k * T / E)`` (capacity factor 1).
+    Returns ``(y, token)`` with ``y`` shaped like ``x``.
+    """
+    token = as_token(token)
+    n = comm.size
+    t, d = x.shape
+    if scores.shape != (t, n):
+        raise ValueError(
+            f"scores must be (tokens, n_experts)=({t}, {n}), got "
+            f"{scores.shape}"
+        )
+    if capacity is None:
+        capacity = -(-k * t // n)
+    idx, gate, valid = topk_route(scores, k, capacity)
+    buckets = x[idx] * valid[..., None].astype(x.dtype)  # (E, cap, d)
+    # one expert per rank: deliver each expert its buckets from every
+    # source rank
+    sent, token = alltoall(buckets, comm=comm, token=token)
+    # (n_src, cap, d) -> flatten source dim for the expert
+    out = expert_fn(sent.reshape(n * capacity, d)).reshape(n, capacity, d)
+    vals, token = alltoall(out, comm=comm, token=token)  # (E, cap, d)
+    y = jnp.zeros_like(x).at[idx.reshape(-1)].add(
+        (gate[..., None] * vals).reshape(-1, d)
+    )
+    return y, token
 
 
 def expert_combine(expert_output, order, comm, *, token=None):
