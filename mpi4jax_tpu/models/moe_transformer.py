@@ -61,6 +61,8 @@ class MoEConfig(NamedTuple):
     experts: int = 4      # total experts; must divide by the sp size
     d_ff: int = 64        # per-expert FFN width
     eps: float = 1e-6
+    routing: str = "expert_choice"  # or "topk" (GShard/Switch)
+    router_k: int = 2     # experts per token under routing="topk"
 
 
 class MoEBlockParams(NamedTuple):
@@ -154,6 +156,29 @@ def _expert_ffn(recv, w1e, w2e):
     return jnp.einsum("secf,efi->seci", h, w2e)
 
 
+def _route(xt, wr, cfg):
+    """Dispatch-ready routing under either scheme.
+
+    Returns ``(gates, idx, buckets)`` each expert-major ``(E, cap, …)``;
+    expert-choice buckets are always fully valid, topk buckets zero
+    their unfilled/overflow slots (their gate is zero too).
+    """
+    if cfg.routing == "topk":
+        from mpi4jax_tpu.parallel.moe import default_capacity, topk_route
+
+        scores = jax.nn.softmax(xt @ wr, axis=-1)
+        cap = default_capacity(cfg.router_k, xt.shape[0], cfg.experts)
+        idx, gates, valid = topk_route(scores, cfg.router_k, cap)
+        return gates, idx, xt[idx] * valid[..., None].astype(xt.dtype)
+    if cfg.routing != "expert_choice":
+        raise ValueError(
+            f"cfg.routing must be 'expert_choice' or 'topk', got "
+            f"{cfg.routing!r}"
+        )
+    gates, idx = _route_local(xt, wr, cfg.experts)
+    return gates, idx, xt[idx]
+
+
 def _moe_ffn(h, wr, w1e, w2e, cfg, comm_ep, token):
     """MoE MLP: route → alltoall dispatch → expert FFN → alltoall
     combine → gate-weighted scatter-add.  ``h``: (b, s_local, d)."""
@@ -161,8 +186,7 @@ def _moe_ffn(h, wr, w1e, w2e, cfg, comm_ep, token):
     e_local = cfg.experts // ep
     b, s, d = h.shape
     xt = h.reshape(b * s, d)
-    gates, idx = _route_local(xt, wr, cfg.experts)
-    buckets = xt[idx]  # (E, cap, d), expert-major
+    gates, idx, buckets = _route(xt, wr, cfg)  # (E, cap, ...)
     # expert e lives on ep-rank e // e_local: grouping experts by
     # destination is a reshape because the layout is contiguous
     cap = buckets.shape[1]
@@ -221,9 +245,9 @@ def reference_loss(params, tokens, targets, cfg, dp, sp):
     x = params.embed[tokens]
 
     def moe_block(xt, wr, w1e, w2e):
-        gates, idx = _route_local(xt, wr, cfg.experts)
+        gates, idx, buckets = _route(xt, wr, cfg)
         vals = _expert_ffn(
-            xt[idx][None], w1e, w2e
+            buckets[None], w1e, w2e
         )[0]  # (E, cap, d): all experts local
         return jnp.zeros_like(xt).at[idx.reshape(-1)].add(
             (gates[..., None] * vals).reshape(-1, xt.shape[-1])

@@ -17,7 +17,12 @@ from mpi4jax_tpu.parallel.longseq import (
     ulysses_attention,
 )
 from mpi4jax_tpu.parallel import moe
-from mpi4jax_tpu.parallel.moe import expert_combine, expert_dispatch
+from mpi4jax_tpu.parallel.moe import (
+    expert_combine,
+    expert_dispatch,
+    topk_moe,
+    topk_route,
+)
 from mpi4jax_tpu.parallel.proc import ProcComm
 
 __all__ = [

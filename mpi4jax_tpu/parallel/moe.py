@@ -15,7 +15,6 @@ or drop to balanced assignments first (static shapes are what make the
 dispatch one fused ICI collective instead of a host gather).
 """
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -25,6 +24,7 @@ from mpi4jax_tpu.ops.collectives import alltoall
 __all__ = [
     "expert_dispatch",
     "expert_combine",
+    "default_capacity",
     "topk_route",
     "topk_moe",
 ]
@@ -62,6 +62,11 @@ def expert_dispatch(x, expert_idx, comm, *, token=None):
     buckets = x[order].reshape(n, cap, d)
     expert_input, token = alltoall(buckets, comm=comm, token=token)
     return expert_input, order, token
+
+
+def default_capacity(k, tokens, n_experts):
+    """Capacity-factor-1 default: ``ceil(k * tokens / n_experts)``."""
+    return -(-k * tokens // n_experts)
 
 
 def topk_route(scores, k, capacity):
@@ -121,7 +126,7 @@ def topk_moe(x, scores, expert_fn, comm, *, k=1, capacity=None, token=None):
             f"{scores.shape}"
         )
     if capacity is None:
-        capacity = -(-k * t // n)
+        capacity = default_capacity(k, t, n)
     idx, gate, valid = topk_route(scores, k, capacity)
     buckets = x[idx] * valid[..., None].astype(x.dtype)  # (E, cap, d)
     # one expert per rank: deliver each expert its buckets from every

@@ -41,18 +41,20 @@ def batch(seed=0):
     return tokens, targets
 
 
-def test_moe_train_step_matches_oracle(mesh3d, comms):
+@pytest.mark.parametrize("routing", ["expert_choice", "topk"])
+def test_moe_train_step_matches_oracle(mesh3d, comms, routing):
+    cfg = CFG._replace(routing=routing)
     comm_dp, comm_tp, comm_sp = comms
-    params = moe.init_params(jax.random.PRNGKey(1), CFG)
+    params = moe.init_params(jax.random.PRNGKey(1), cfg)
     tokens, targets = batch()
 
     step = moe.make_global_train_step(
-        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=1e-1
+        mesh3d, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1
     )
     new_params, loss = step(params, (tokens, targets))
 
     ref_loss, ref_grads = jax.value_and_grad(
-        lambda p: moe.reference_loss(p, tokens, targets, CFG, DP, SP)
+        lambda p: moe.reference_loss(p, tokens, targets, cfg, DP, SP)
     )(params)
     ref_new = jax.tree.map(lambda p, g: p - 1e-1 * g, params, ref_grads)
 
