@@ -96,9 +96,25 @@ def has_tpu_support():
 
 
 def has_cuda_support():
-    """Compatibility shim for the reference API: always False here (this
-    framework targets TPU; CUDA staging is the reference's GPU path)."""
-    return False
+    """True if a CUDA device backs the default JAX platform.
+
+    Reference analog: ``mpi4jax.has_cuda_support()``
+    (mpi4jax/_src/utils.py:102-108) — there it reports whether the CUDA
+    XLA extension was *built*; here the staged (``io_callback``) native
+    tier is platform-generic, so the question is simply whether CUDA
+    devices are live: the same HBM↔host staging that serves TPU serves
+    them (tests/proc/test_staged_backend.py::test_staged_ops_cuda).
+    """
+    try:
+        if not any(d.platform == "gpu" for d in _jax.devices()):
+            return False
+        # 'gpu' covers ROCm too — require the backend to really be CUDA
+        from jax.extend import backend as _jxb
+
+        version = getattr(_jxb.get_backend(), "platform_version", "")
+        return "cuda" in version.lower()
+    except RuntimeError:
+        return False
 
 
 __all__ = [

@@ -54,6 +54,11 @@ __all__ = ["auto_tokenize", "ambient_token"]
 # What is NOT preserved across a jit cache hit is the link between the
 # inner ops and the *outer* ambient chain — the same trace-boundary
 # reset that applies to scan/while/cond bodies (see AmbientChain).
+#
+# Both directions are pinned by tests (cache-hit asserted, not assumed):
+# tests/experimental/test_auto_tokenize.py::
+#   test_jit_cache_reuse_across_scope_is_benign   (traced in, called out)
+#   test_jit_cache_reuse_into_scope_is_benign     (traced out, called in)
 
 
 def auto_tokenize(fn=None):

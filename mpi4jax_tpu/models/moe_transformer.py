@@ -63,6 +63,8 @@ class MoEConfig(NamedTuple):
     eps: float = 1e-6
     routing: str = "expert_choice"  # or "topk" (GShard/Switch)
     router_k: int = 2     # experts per token under routing="topk"
+    aux_weight: float = 0.0  # Switch load-balancing loss weight
+    z_weight: float = 0.0    # ST-MoE router z-loss weight (typ. 1e-3)
 
 
 class MoEBlockParams(NamedTuple):
@@ -131,20 +133,21 @@ def param_specs(tp_ax, sp_ax):
     )
 
 
-def _route_local(xt, wr, n_experts):
-    """Local expert-choice routing on this device's ``(T, d)`` tokens.
+def _route_local(logits, n_experts):
+    """Local expert-choice routing on this device's ``(T, E)`` router
+    logits.
 
     Returns ``(gates, idx)`` each ``(E, capacity)``: expert ``e`` takes
     its ``capacity = T // E`` highest-probability local tokens.
     """
-    t = xt.shape[0]
+    t = logits.shape[0]
     if t % n_experts:
         raise ValueError(
             f"local token count {t} must be divisible by experts="
             f"{n_experts} (capacity-1 expert choice)"
         )
     cap = t // n_experts
-    probs = jax.nn.softmax(xt @ wr, axis=-1)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
     gates, idx = lax.top_k(probs.T, cap)  # (E, cap) each
     return gates, idx
 
@@ -159,34 +162,59 @@ def _expert_ffn(recv, w1e, w2e):
 def _route(xt, wr, cfg):
     """Dispatch-ready routing under either scheme.
 
-    Returns ``(gates, idx, buckets)`` each expert-major ``(E, cap, …)``;
-    expert-choice buckets are always fully valid, topk buckets zero
-    their unfilled/overflow slots (their gate is zero too).
+    Returns ``(gates, idx, buckets, aux)`` with the first three
+    expert-major ``(E, cap, …)``; expert-choice buckets are always
+    fully valid, topk buckets zero their unfilled/overflow slots (their
+    gate is zero too).  ``aux`` is the weighted auxiliary-loss scalar
+    (Switch load-balancing + router z-loss per ``cfg.aux_weight`` /
+    ``cfg.z_weight``), or ``None`` when both weights are zero (so the
+    default config's jaxpr is unchanged).
     """
-    if cfg.routing == "topk":
-        from mpi4jax_tpu.parallel.moe import default_capacity, topk_route
+    from mpi4jax_tpu.parallel.moe import (
+        default_capacity,
+        load_balancing_loss,
+        router_z_loss,
+        topk_route,
+    )
 
-        scores = jax.nn.softmax(xt @ wr, axis=-1)
+    logits = xt @ wr
+    if cfg.routing == "topk":
+        scores = jax.nn.softmax(logits, axis=-1)
         cap = default_capacity(cfg.router_k, xt.shape[0], cfg.experts)
         idx, gates, valid = topk_route(scores, cfg.router_k, cap)
-        return gates, idx, xt[idx] * valid[..., None].astype(xt.dtype)
-    if cfg.routing != "expert_choice":
+        buckets = xt[idx] * valid[..., None].astype(xt.dtype)
+    elif cfg.routing == "expert_choice":
+        gates, idx = _route_local(logits, cfg.experts)
+        scores, buckets = None, xt[idx]
+    else:
         raise ValueError(
             f"cfg.routing must be 'expert_choice' or 'topk', got "
             f"{cfg.routing!r}"
         )
-    gates, idx = _route_local(xt, wr, cfg.experts)
-    return gates, idx, xt[idx]
+    aux = None
+    if cfg.aux_weight or cfg.z_weight:
+        aux = jnp.zeros((), jnp.float32)
+        if cfg.z_weight:
+            aux = aux + cfg.z_weight * router_z_loss(logits)
+        if cfg.aux_weight and cfg.routing == "topk":
+            # expert choice is load-balanced by construction; the
+            # balance loss only applies to token-choice routing
+            aux = aux + cfg.aux_weight * load_balancing_loss(
+                scores, cfg.router_k
+            )
+    return gates, idx, buckets, aux
 
 
 def _moe_ffn(h, wr, w1e, w2e, cfg, comm_ep, token):
     """MoE MLP: route → alltoall dispatch → expert FFN → alltoall
-    combine → gate-weighted scatter-add.  ``h``: (b, s_local, d)."""
+    combine → gate-weighted scatter-add.  ``h``: (b, s_local, d).
+    Returns ``(y, token)`` — or ``(y, token, aux)`` when the config
+    enables auxiliary router losses."""
     ep = comm_ep.size
     e_local = cfg.experts // ep
     b, s, d = h.shape
     xt = h.reshape(b * s, d)
-    gates, idx, buckets = _route(xt, wr, cfg)  # (E, cap, ...)
+    gates, idx, buckets, aux = _route(xt, wr, cfg)  # (E, cap, ...)
     # expert e lives on ep-rank e // e_local: grouping experts by
     # destination is a reshape because the layout is contiguous
     cap = buckets.shape[1]
@@ -198,7 +226,10 @@ def _moe_ffn(h, wr, w1e, w2e, cfg, comm_ep, token):
     y = jnp.zeros_like(xt).at[idx.reshape(-1)].add(
         (gates[..., None] * vals).reshape(-1, d)
     )
-    return y.reshape(b, s, d), token
+    y = y.reshape(b, s, d)
+    if aux is None:
+        return y, token
+    return y, token, aux
 
 
 def _moe_mlp(h2, bp, cfg, comm_tp, comm_sp, token):
@@ -216,7 +247,10 @@ def make_global_train_step(
     attention, grad sync, jit/shard_map wrapper — shared between both
     models) with the MoE sublayer and expert-sharded PartitionSpecs
     substituted.  Additionally requires ``cfg.experts % comm_sp.size
-    == 0`` and the per-device token count divisible by ``cfg.experts``.
+    == 0``; under ``routing="expert_choice"`` the per-device token
+    count must also be divisible by ``cfg.experts`` (capacity-1 expert
+    choice), while ``routing="topk"`` uses ceil capacity and has no
+    such requirement.
     """
     if cfg.experts % comm_sp.size:
         raise ValueError(
@@ -238,22 +272,27 @@ def reference_loss(params, tokens, targets, cfg, dp, sp):
     oracle partitions the global batch into the same ``(dp, sp)`` token
     blocks the mesh would hold and routes within each block; the expert
     FFN itself is pointwise per token, so which device hosted an expert
-    is irrelevant to the value.
+    is irrelevant to the value.  When the config enables auxiliary
+    router losses, the oracle adds the mean over blocks of the
+    per-block (layer-summed) aux — exactly what the sharded step's
+    ``psum(local_loss)/(n_data·tp)`` reduces to.
     """
     b, s = tokens.shape
     b_loc, s_loc = b // dp, s // sp
     x = params.embed[tokens]
 
     def moe_block(xt, wr, w1e, w2e):
-        gates, idx, buckets = _route(xt, wr, cfg)
+        gates, idx, buckets, aux = _route(xt, wr, cfg)
         vals = _expert_ffn(
             buckets[None], w1e, w2e
         )[0]  # (E, cap, d): all experts local
-        return jnp.zeros_like(xt).at[idx.reshape(-1)].add(
+        y = jnp.zeros_like(xt).at[idx.reshape(-1)].add(
             (gates[..., None] * vals).reshape(-1, xt.shape[-1])
         )
+        return y, (jnp.zeros((), jnp.float32) if aux is None else aux)
 
-    def layer(x, bp):
+    def layer(carry, bp):
+        x, aux = carry
         x = _attn_residual(x, bp, cfg)
         h2 = _rmsnorm(x, bp.ln2, cfg.eps)
         # route within each (dp, sp) block, exactly as the mesh does
@@ -261,12 +300,102 @@ def reference_loss(params, tokens, targets, cfg, dp, sp):
         blocks = blocks.transpose(0, 2, 1, 3, 4).reshape(
             dp * sp, b_loc * s_loc, cfg.d_model
         )
-        m = jax.vmap(lambda xt: moe_block(xt, bp.wr, bp.w1e, bp.w2e))(blocks)
+        m, aux_blocks = jax.vmap(
+            lambda xt: moe_block(xt, bp.wr, bp.w1e, bp.w2e)
+        )(blocks)
         m = m.reshape(dp, sp, b_loc, s_loc, cfg.d_model).transpose(
             0, 2, 1, 3, 4
         ).reshape(b, s, cfg.d_model)
-        return x + m, None
+        return (x + m, aux + aux_blocks.mean()), None
 
-    x, _ = lax.scan(layer, x, params.blocks)
+    (x, aux), _ = lax.scan(layer, (x, jnp.zeros((), jnp.float32)), params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)
-    return _ce(x @ params.head, targets)
+    return _ce(x @ params.head, targets) + aux
+
+
+def routing_report(params, tokens, cfg, dp=1, sp=1):
+    """Router-quality diagnostics for ``routing="topk"`` (unsharded;
+    same per-``(dp, sp)``-block routing as the mesh step).
+
+    Returns a dict of concrete floats/arrays:
+      ``load`` — ``(E,)`` fraction of routing assignments per expert
+        (pre-capacity), averaged over blocks and layers; uniform = 1/E;
+      ``balance_loss`` — unweighted Switch load-balancing loss (1 =
+        perfectly balanced, up to E at collapse);
+      ``z_loss`` — unweighted router z-loss;
+      ``dropped_fraction`` — fraction of assignments that overflowed
+        expert capacity (the VERDICT-named drop metric).
+
+    Expert-choice routing is load-balanced by construction (every
+    expert takes exactly ``T/E`` tokens), so the report refuses it
+    rather than printing constants.
+    """
+    from mpi4jax_tpu.parallel.moe import (
+        default_capacity,
+        dropped_fraction,
+        router_z_loss,
+        topk_route,
+    )
+
+    if cfg.routing != "topk":
+        raise ValueError(
+            "routing_report applies to routing='topk' only; expert-"
+            "choice routing is load-balanced by construction"
+        )
+    b, s = tokens.shape
+    b_loc, s_loc = b // dp, s // sp
+    t_loc = b_loc * s_loc
+    cap = default_capacity(cfg.router_k, t_loc, cfg.experts)
+    x = params.embed[tokens]
+
+    def block_pass(xt, bp):
+        """One routing pass per block: the MoE sublayer output AND the
+        diagnostics, from the same logits/route (no duplicate dispatch
+        logic to keep in sync with _route)."""
+        logits = xt @ bp.wr
+        probs = jax.nn.softmax(logits, axis=-1)
+        idx, gates, valid = topk_route(probs, cfg.router_k, cap)
+        buckets = xt[idx] * valid[..., None].astype(xt.dtype)
+        vals = _expert_ffn(buckets[None], bp.w1e, bp.w2e)[0]
+        y = jnp.zeros_like(xt).at[idx.reshape(-1)].add(
+            (gates[..., None] * vals).reshape(-1, xt.shape[-1])
+        )
+        _, top = lax.top_k(probs, cfg.router_k)
+        counts = jnp.zeros((cfg.experts,), jnp.float32).at[
+            top.reshape(-1)
+        ].add(1.0)
+        f = counts / (t_loc * cfg.router_k)  # assignment fractions
+        stats = (
+            f,
+            cfg.experts * jnp.sum(f * probs.mean(0)),  # Switch balance
+            router_z_loss(logits),
+            dropped_fraction(valid, t_loc, cfg.router_k),
+        )
+        return y, stats
+
+    loads, balances, zs, drops = [], [], [], []
+    for li in range(cfg.layers):
+        bp = jax.tree.map(lambda p: p[li], params.blocks)
+        x_attn = _attn_residual(x, bp, cfg)
+        h2 = _rmsnorm(x_attn, bp.ln2, cfg.eps)
+        blocks = h2.reshape(dp, b_loc, sp, s_loc, cfg.d_model)
+        blocks = blocks.transpose(0, 2, 1, 3, 4).reshape(
+            dp * sp, t_loc, cfg.d_model
+        )
+        m, (load, bal, z, drop) = jax.vmap(
+            lambda xt: block_pass(xt, bp)
+        )(blocks)
+        loads.append(load.mean(0))
+        balances.append(bal.mean())
+        zs.append(z.mean())
+        drops.append(drop.mean())
+        m = m.reshape(dp, sp, b_loc, s_loc, cfg.d_model).transpose(
+            0, 2, 1, 3, 4
+        ).reshape(b, s, cfg.d_model)
+        x = x_attn + m
+    return {
+        "load": jnp.stack(loads).mean(0),
+        "balance_loss": float(jnp.stack(balances).mean()),
+        "z_loss": float(jnp.stack(zs).mean()),
+        "dropped_fraction": float(jnp.stack(drops).mean()),
+    }

@@ -173,6 +173,10 @@ def _forward_sharded(
     ``mlp(h2, bp, cfg, comm_tp, comm_sp, token) -> (out, token)`` is
     the MLP sublayer (post-ln2); defaults to the dense Megatron pair —
     models/moe_transformer.py substitutes the expert-parallel MoE here.
+    An mlp may instead return ``(out, token, aux)`` with ``aux`` a
+    scalar auxiliary-loss contribution (e.g. MoE load-balancing / router
+    z-loss); the per-layer contributions are summed and returned beside
+    the logits.
 
     ``sequence`` picks the context-parallel attention scheme over sp:
     ``"ring"`` (KV blocks rotate, sendrecv transpose carries the
@@ -195,8 +199,10 @@ def _forward_sharded(
     seq_attn = ring_attention if sequence == "ring" else ulysses_attention
 
     x = promote_vma(params.embed[tokens], mesh_axes)  # (B, S_local, d)
+    aux0 = promote_vma(jnp.zeros((), jnp.float32), mesh_axes)
 
-    def layer(x, bp):
+    def layer(carry, bp):
+        x, aux = carry
         token = create_token()
         h = _rmsnorm(x, bp.ln1, cfg.eps)
         h, token = _f_collective(h, comm_tp, token)
@@ -211,8 +217,11 @@ def _forward_sharded(
         x = x + a
 
         h2 = _rmsnorm(x, bp.ln2, cfg.eps)
-        m, _token = mlp(h2, bp, cfg, comm_tp, comm_sp, token)
-        return x + m, None
+        res = mlp(h2, bp, cfg, comm_tp, comm_sp, token)
+        m = res[0]
+        if len(res) > 2:  # (out, token, aux) — MoE auxiliary losses
+            aux = aux + res[2]
+        return (x + m, aux), None
 
     if remat:
         # rematerialise each layer in the backward pass: activation
@@ -221,9 +230,9 @@ def _forward_sharded(
         # on HBM-bound chips.  The collectives re-execute under remat;
         # token ordering is per-layer-instance so replay is safe.
         layer = jax.checkpoint(layer)
-    x, _ = lax.scan(layer, x, params.blocks)
+    (x, aux), _ = lax.scan(layer, (x, aux0), params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)
-    return x @ params.head  # (B, S_local, V) logits
+    return x @ params.head, aux  # (B, S_local, V) logits, aux-loss sum
 
 
 def _ce(logits, targets):
@@ -297,11 +306,11 @@ def make_global_train_step(
         tokens, targets = batch
 
         def loss_fn(p):
-            logits = _forward_sharded(
+            logits, aux = _forward_sharded(
                 p, tokens, cfg, comm_tp, comm_sp, (dp_ax, tp_ax, sp_ax),
                 mlp=mlp, sequence=sequence, remat=remat,
             )
-            return _ce(logits, targets)
+            return _ce(logits, targets) + aux
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         grads = jax.tree.map(sync_grad, grads, specs)

@@ -15,6 +15,7 @@ or drop to balanced assignments first (static shapes are what make the
 dispatch one fused ICI collective instead of a host gather).
 """
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -27,7 +28,53 @@ __all__ = [
     "default_capacity",
     "topk_route",
     "topk_moe",
+    "load_balancing_loss",
+    "router_z_loss",
+    "dropped_fraction",
 ]
+
+
+def load_balancing_loss(probs, k=1):
+    """Switch/GShard auxiliary load-balancing loss, generalised top-k.
+
+    ``E * Σ_e f_e · P_e`` where ``f_e`` is the fraction of the ``T*k``
+    routing assignments that chose expert ``e`` (pre-capacity — drops
+    don't change what the router *wanted*) and ``P_e`` the mean router
+    probability of ``e`` (Switch Transformer eq. 4, arXiv:2101.03961;
+    GShard arXiv:2006.16668).  Equal to 1 at perfect balance, up to
+    ``E`` at full collapse.  The gradient flows through ``P`` only
+    (``f`` is discrete) — the standard estimator.
+
+    Args:
+      probs: ``(T, E)`` post-softmax router probabilities.
+      k: experts per token the router selects.
+    """
+    t, n_experts = probs.shape
+    _, top = lax.top_k(probs, k)
+    chosen = jnp.zeros((t, n_experts), probs.dtype)
+    chosen = chosen.at[jnp.arange(t)[:, None], top].set(1.0)
+    f = lax.stop_gradient(chosen.sum(0) / (t * k))
+    return n_experts * jnp.sum(f * probs.mean(0))
+
+
+def router_z_loss(logits):
+    """Router z-loss (ST-MoE, arXiv:2202.08906 eq. 5): mean squared
+    ``logsumexp`` of the router logits.  Keeps logits small so the
+    softmax stays in its well-conditioned range; typical weight 1e-3.
+
+    Args:
+      logits: ``(T, E)`` pre-softmax router logits.
+    """
+    z = jax.nn.logsumexp(logits, axis=-1)
+    return jnp.mean(jnp.square(z))
+
+
+def dropped_fraction(valid, n_tokens, k=1):
+    """Fraction of the ``n_tokens * k`` routing assignments that
+    overflowed expert capacity (``valid`` as returned by
+    :func:`topk_route`).  0 = nothing dropped."""
+    kept = valid.sum()
+    return 1.0 - kept / (n_tokens * k)
 
 
 def expert_dispatch(x, expert_idx, comm, *, token=None):
@@ -81,7 +128,11 @@ def topk_route(scores, k, capacity):
     shapes are static, so the result feeds one fused dispatch.
 
     Args:
-      scores: ``(T, E)`` router probabilities (post-softmax).
+      scores: ``(T, E)`` router scores — post-softmax probabilities in
+        the usual case, but any non-NaN values work, including the
+        raw-logits-with-``-inf``-masking idiom: slot validity is derived
+        from how many tokens actually chose each expert, never from the
+        score's finiteness, and a ``-inf``-scored choice gates to 0.
       k: experts per token.
       capacity: slots per expert.
 
@@ -92,14 +143,22 @@ def topk_route(scores, k, capacity):
     """
     t, n_experts = scores.shape
     # each token's chosen experts: (T, k)
-    top_scores, top_experts = lax.top_k(scores, k)
-    # per (token, expert): the score if chosen, else -inf
-    chose = jnp.full((t, n_experts), -jnp.inf, scores.dtype)
-    chose = chose.at[jnp.arange(t)[:, None], top_experts].set(top_scores)
-    # each expert takes its top-capacity choosers by score
-    gate, idx = lax.top_k(chose.T, capacity)  # (E, cap)
-    valid = jnp.isfinite(gate)
-    gate = jnp.where(valid, gate, jnp.zeros((), gate.dtype))
+    _, top_experts = lax.top_k(scores, k)
+    chosen = jnp.zeros((t, n_experts), bool)
+    chosen = chosen.at[jnp.arange(t)[:, None], top_experts].set(True)
+    # sort key: the score where chosen (clamped finite so a legitimate
+    # -inf-scored choice still outranks every non-chooser), -inf
+    # elsewhere.  Each expert takes its top-capacity choosers by score.
+    safe = jnp.maximum(scores, jnp.finfo(scores.dtype).min)
+    key = jnp.where(chosen, safe, -jnp.inf)
+    _, idx = lax.top_k(key.T, capacity)  # (E, cap)
+    # validity = slot ordinal < chooser count (not score finiteness)
+    count = jnp.minimum(chosen.sum(0), capacity)  # (E,)
+    valid = jnp.arange(capacity)[None, :] < count[:, None]
+    gate = jnp.take_along_axis(scores.T, idx, axis=1)
+    gate = jnp.where(
+        valid & jnp.isfinite(gate), gate, jnp.zeros((), gate.dtype)
+    )
     return idx, gate, valid
 
 

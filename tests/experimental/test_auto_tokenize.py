@@ -309,15 +309,55 @@ def test_jit_cache_reuse_across_scope_is_benign(comm1d):
 
     out = auto_tokenize(jf)(world_input())  # traced + cached in scope
     assert np.array_equal(np.asarray(out), SHIFTED)
+    assert jf._cache_size() == 1
 
     # cache hit outside any scope: runs the baked-in chained program
     out2 = jf(world_input())
     assert np.array_equal(np.asarray(out2), SHIFTED)
+    assert jf._cache_size() == 1  # reused, not retraced
 
     # a fresh trace outside any scope still fails loudly
     jf2 = jax.jit(spmd(comm1d, lambda x: f(x * 1.0)))
     with pytest.raises(RuntimeError, match="no matching in-trace send"):
         jf2(world_input())
+
+
+def test_jit_cache_reuse_into_scope_is_benign(comm1d):
+    """Opposite direction of the jit-cache edge: a function traced
+    OUTSIDE any scope (only token=None *collectives* can trace that way
+    — a bare send/recv fails loudly, previous test) whose cached
+    executable is then reused INSIDE an auto_tokenize scope.  Pins the
+    documented behaviour (experimental/tokenizer.py): the executable
+    runs correctly (collective ordering never depended on the chain),
+    it is a genuine cache hit, and the inner ops do NOT retroactively
+    join the outer ambient chain — the same trace-boundary reset that
+    applies to scan/while/cond bodies."""
+    from tests.helpers import spmd
+
+    def f(x):
+        y, _ = m.allreduce(x, m.SUM, comm=comm1d)
+        return y * 2.0
+
+    jf = jax.jit(spmd(comm1d, f))
+    expected = np.full(SIZE, 2.0 * np.arange(float(SIZE)).sum())
+
+    out = jf(world_input())  # traced + cached outside any scope
+    assert np.array_equal(np.asarray(out), expected)
+    assert jf._cache_size() == 1
+
+    observed = {}
+
+    @auto_tokenize
+    def scoped(x):
+        before = ambient_token()
+        y = jf(x)  # cache hit: the scope is invisible to the cache key
+        observed["chain_untouched"] = ambient_token() is before
+        return y
+
+    out2 = scoped(world_input())
+    assert np.array_equal(np.asarray(out2), expected)
+    assert jf._cache_size() == 1  # reused, not retraced
+    assert observed["chain_untouched"]  # no link to the outer chain
 
 
 def test_library_composites_join_chain(comm2d):

@@ -170,6 +170,34 @@ def test_topk_route_overflow_drops_lowest():
     assert not np.asarray(valid[1]).any()
 
 
+def test_topk_route_inf_masked_logits():
+    # the raw-logits-with--inf-masking idiom: slot validity is derived
+    # from chooser counts, not score finiteness, so a token whose k
+    # picks include a masked (-inf) expert still occupies a zero-gated
+    # slot instead of being misread as an unfilled expert.
+    neg = -jnp.inf
+    scores = jnp.asarray(
+        [[1.0, neg, neg],   # token 0: only expert 0 unmasked
+         [0.5, 2.0, neg],   # token 1: experts 0, 1
+         [0.2, 1.5, neg]],  # token 2: experts 0, 1
+        jnp.float32,
+    )
+    idx, gate, valid = topk_route(scores, k=2, capacity=3)
+    # expert 0: chosen by all three tokens, finite gates
+    assert np.asarray(valid[0]).all()
+    np.testing.assert_allclose(np.sort(np.asarray(gate[0])), [0.2, 0.5, 1.0])
+    # expert 1: tokens 1 and 2 chose it with finite scores; token 0's
+    # forced second pick (ties break low) lands here too -> THREE valid
+    # slots, the -inf one gated to exactly 0, finite ones undisplaced
+    assert np.asarray(valid[1]).all()
+    assert sorted(np.asarray(idx[1]).tolist()) == [0, 1, 2]
+    np.testing.assert_allclose(np.sort(np.asarray(gate[1])), [0.0, 1.5, 2.0])
+    # expert 2: no choosers at all -> unfilled
+    assert not np.asarray(valid[2]).any()
+    # nothing non-finite leaks into gates
+    assert np.isfinite(np.asarray(gate)).all()
+
+
 def test_topk_moe_matches_dense_oracle():
     mesh, comm = _mesh_comm()
     t_loc = 16

@@ -112,6 +112,93 @@ def test_moe_remat_matches_plain(mesh3d, comms):
         )
 
 
+def test_moe_aux_losses_match_oracle(mesh3d, comms):
+    """With the Switch balance loss and router z-loss enabled, the
+    sharded step's total loss (CE + mean-over-blocks aux) must still
+    match the unsharded oracle — pinning the aux scaling through the
+    psum/(n_data·tp) reduction."""
+    cfg = CFG._replace(routing="topk", aux_weight=0.02, z_weight=1e-3)
+    comm_dp, comm_tp, comm_sp = comms
+    params = moe.init_params(jax.random.PRNGKey(21), cfg)
+    tokens, targets = batch(seed=22)
+
+    step = moe.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1
+    )
+    new_params, loss = step(params, (tokens, targets))
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: moe.reference_loss(p, tokens, targets, cfg, DP, SP)
+    )(params)
+    np.testing.assert_allclose(
+        float(np.asarray(loss)[0]), float(ref_loss), rtol=2e-5, atol=2e-5
+    )
+    # aux must actually contribute: the same batch without aux gives a
+    # strictly different loss
+    plain = moe.reference_loss(
+        params, tokens, targets, cfg._replace(aux_weight=0.0, z_weight=0.0),
+        DP, SP,
+    )
+    assert abs(float(ref_loss) - float(plain)) > 1e-6
+    # and the router still receives finite, nonzero gradients
+    g_wr = np.asarray(ref_grads.blocks.wr)
+    assert np.isfinite(g_wr).all() and np.abs(g_wr).max() > 0
+    ref_new = jax.tree.map(lambda p, g: p - 1e-1 * g, params, ref_grads)
+    for got, want in zip(
+        jax.tree.leaves(new_params), jax.tree.leaves(ref_new), strict=True
+    ):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-4, atol=3e-4
+        )
+
+
+def test_aux_loss_training_reduces_imbalance():
+    """The VERDICT-named routing-quality property: starting from a
+    router skewed hard toward expert 0, training WITH the balance loss
+    must end up measurably more balanced (and dropping fewer tokens)
+    than the identical run WITHOUT it.  Uses the unsharded oracle loss
+    (the mesh step matches it exactly — tests above), so the comparison
+    is deterministic and fast."""
+    cfg0 = CFG._replace(routing="topk", router_k=2)
+    params = moe.init_params(jax.random.PRNGKey(31), cfg0)
+    # skew: amplified router weights saturate the softmax, giving a
+    # genuinely imbalanced, token-dropping, large-logit starting point
+    params = params._replace(
+        blocks=params.blocks._replace(wr=params.blocks.wr * 8.0)
+    )
+    tokens, targets = batch(seed=32)
+    report0 = moe.routing_report(params, tokens, cfg0, DP, SP)
+    assert report0["balance_loss"] > 1.3  # measurably imbalanced start
+    assert report0["dropped_fraction"] > 0.2
+    assert report0["z_loss"] > 50.0
+
+    def train(cfg, steps=25, lr=0.3):
+        p = params
+        grad = jax.jit(jax.grad(
+            lambda p: moe.reference_loss(p, tokens, targets, cfg, DP, SP)
+        ))
+        for _ in range(steps):
+            p = jax.tree.map(lambda w, g: w - lr * g, p, grad(p))
+        return p
+
+    p_aux = train(cfg0._replace(aux_weight=0.05, z_weight=1e-3))
+    p_plain = train(cfg0)
+    r_aux = moe.routing_report(p_aux, tokens, cfg0, DP, SP)
+    r_plain = moe.routing_report(p_plain, tokens, cfg0, DP, SP)
+    assert r_aux["balance_loss"] < r_plain["balance_loss"]
+    assert r_aux["balance_loss"] < report0["balance_loss"]
+    assert r_aux["dropped_fraction"] < r_plain["dropped_fraction"]
+    assert r_aux["z_loss"] < r_plain["z_loss"]  # z-loss shrinks logits
+    # load is a proper distribution either way
+    np.testing.assert_allclose(np.asarray(r_aux["load"]).sum(), 1.0, rtol=1e-5)
+
+
+def test_routing_report_refuses_expert_choice():
+    params = moe.init_params(jax.random.PRNGKey(41), CFG)
+    with pytest.raises(ValueError, match="balanced by construction"):
+        moe.routing_report(params, batch()[0], CFG, DP, SP)
+
+
 def test_moe_experts_divisibility(mesh3d, comms):
     comm_dp, comm_tp, comm_sp = comms
     with pytest.raises(ValueError, match="divisible by the expert"):
@@ -135,7 +222,7 @@ def test_route_local_selects_top_capacity():
     key = jax.random.PRNGKey(5)
     xt = jax.random.normal(key, (8, 4))
     wr = jax.random.normal(jax.random.PRNGKey(6), (4, 2))
-    gates, idx = moe._route_local(xt, wr, 2)
+    gates, idx = moe._route_local(xt @ wr, 2)
     assert gates.shape == (2, 4) and idx.shape == (2, 4)
     probs = jax.nn.softmax(xt @ wr, axis=-1)
     for e in range(2):

@@ -3,6 +3,8 @@ the reference's GPU COPY_TO_HOST path (mpi_xla_bridge_gpu.pyx:211-251).
 On real accelerators jax stages HBM->host around the io_callback; here
 MPI4JAX_TPU_FORCE_STAGED=1 exercises the identical code path on CPU."""
 
+import pytest
+
 from tests.proc.test_proc_backend import run_workers
 
 
@@ -61,6 +63,62 @@ def test_staged_ops_real_accelerator():
         "real-accelerator staged ok" in res.stdout
         or "skipping" in res.stdout
     ), (res.stdout, res.stderr)
+
+
+def test_staged_ops_cuda():
+    """The CUDA leg of the staged tier (reference GPU path analog,
+    mpi_xla_bridge_gpu.pyx:211-251): identical op set with the workers
+    pinned to ``JAX_PLATFORMS=cuda``, so the io_callback stages GPU
+    HBM↔host exactly as it does TPU HBM↔host.  Skips wherever no CUDA
+    jaxlib/device is present (this image is TPU-only) — the guard, not
+    the hardware, is what keeps ``has_cuda_support()`` honest.
+    """
+    import subprocess
+    import sys
+
+    probe = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import jax; jax.config.update('jax_platforms', 'cuda'); "
+            "print(len(jax.devices()))",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    if probe.returncode != 0 or not probe.stdout.strip().isdigit():
+        pytest.skip("no CUDA backend available")
+
+    res = run_workers(
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import mpi4jax_tpu as m
+
+        assert jax.default_backend() == "gpu", jax.default_backend()
+        assert m.has_cuda_support()
+
+        comm = m.get_default_comm()
+        assert comm.backend == "proc", comm
+        x = jnp.arange(4.0)  # lives on the GPU
+        assert "cuda" in str(x.device).lower(), x.device
+
+        tok = m.create_token()
+        y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        g, tok = m.allgather(x[:2], comm=comm, token=tok)
+        b, tok = m.bcast(x * 3, 0, comm=comm, token=tok)
+        tok = m.barrier(comm=comm, token=tok)
+        assert "cuda" in str(y.device).lower(), y.device
+        assert np.allclose(np.asarray(y), np.arange(4.0) * comm.size), y
+        assert np.asarray(g).shape == (comm.size, 2), g
+        assert np.allclose(np.asarray(b), 3 * np.arange(4.0)), b
+        print(f"rank {comm.rank()} cuda staged ok")
+        """,
+        nprocs=1,
+        timeout=300,
+        launch_args=("--platform", "cuda"),
+    )
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "cuda staged ok" in res.stdout, (res.stdout, res.stderr)
 
 
 def test_staged_ops_across_processes():
