@@ -57,6 +57,11 @@ class TransformerConfig(NamedTuple):
     head_dim: int = 8
     d_ff: int = 64
     eps: float = 1e-6
+    # single-device attention kernel ("auto" / "flash" / "xla", see
+    # parallel.longseq.local_attention); only reaches the sp=1 shortcut
+    # and the ulysses full-sequence call — the multi-rank ring path has
+    # its own blockwise schedule
+    attn_impl: str = "auto"
 
 
 class BlockParams(NamedTuple):
@@ -210,7 +215,8 @@ def _forward_sharded(
         k = (h @ bp.wk).reshape(b, s, hk_l, dh)
         v = (h @ bp.wv).reshape(b, s, hk_l, dh)
         attn, token = seq_attn(
-            q, k, v, comm_sp, causal=True, token=token
+            q, k, v, comm_sp, causal=True, token=token,
+            impl=getattr(cfg, "attn_impl", "auto"),
         )
         a_part = attn.reshape(b, s, hq_l * dh) @ bp.wo
         a, token = allreduce(a_part, reductions.SUM, comm=comm_tp, token=token)
@@ -229,7 +235,18 @@ def _forward_sharded(
         # carry) at ~1/3 extra FLOPs — the standard long-context lever
         # on HBM-bound chips.  The collectives re-execute under remat;
         # token ordering is per-layer-instance so replay is safe.
-        layer = jax.checkpoint(layer)
+        # remat="dots" keeps every batched-matmul output (qkv/o/mlp
+        # projections) and recomputes only the cheap rest — in practice
+        # the attention internals, whose [T, T] score tensors are the
+        # memory hog — recovering most of full-remat's memory saving at
+        # a fraction of its ~1/3 FLOP overhead.
+        if remat == "dots":
+            layer = jax.checkpoint(
+                layer,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        else:
+            layer = jax.checkpoint(layer)
     (x, aux), _ = lax.scan(layer, (x, aux0), params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)
     return x @ params.head, aux  # (B, S_local, V) logits, aux-loss sum
@@ -405,24 +422,75 @@ def _decode_step_sharded(params, cache, last_tok, pos, cfg, comm_tp, hq_l, hk_l)
     return cache, jnp.argmax(logits, axis=-1).astype(last_tok.dtype), logits
 
 
-def make_global_decode(mesh, comm_dp, comm_tp, cfg, max_len):
+def _prefill_sharded(params, prompt, cfg, comm_tp, hq_l, hk_l, max_len):
+    """Batched prefill on the local tp shard: one causal forward pass
+    over the whole prompt, writing every prompt position's K/V into the
+    (max_len-budget) cache and returning the greedy next token after
+    the last prompt position.
+
+    Identical math to running :func:`_decode_step_sharded` position by
+    position — the attention is causal and the projections are
+    per-position — but the matmuls are [B, P, ·] instead of P
+    sequential [B, 1, ·] calls, so the prompt costs one MXU-shaped
+    forward instead of P dispatches.
+    """
+    dh = cfg.head_dim
+    b, p_len = prompt.shape
+    x = params.embed[prompt]  # (B, P, d)
+    token = create_token()
+    pad = max_len - p_len
+
+    def layer(carry, bp):
+        x, token = carry
+        h = _rmsnorm(x, bp.ln1, cfg.eps)
+        h, token = _f_collective(h, comm_tp, token)
+        q = (h @ bp.wq).reshape(b, p_len, hq_l, dh)
+        k = (h @ bp.wk).reshape(b, p_len, hk_l, dh)
+        v = (h @ bp.wv).reshape(b, p_len, hk_l, dh)
+        attn = local_attention(q, k, v, causal=True, impl="xla")
+        a_part = attn.reshape(b, p_len, hq_l * dh) @ bp.wo
+        a, token = allreduce(a_part, reductions.SUM, comm=comm_tp, token=token)
+        x = x + a
+        h2 = _rmsnorm(x, bp.ln2, cfg.eps)
+        h2, token = _f_collective(h2, comm_tp, token)
+        m_part = jax.nn.gelu(h2 @ bp.w1) @ bp.w2
+        m, token = allreduce(m_part, reductions.SUM, comm=comm_tp, token=token)
+        kv = jnp.stack([
+            jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0))),
+            jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0))),
+        ])
+        return (x + m, token), kv
+
+    (x, _token), cache = lax.scan(layer, (x, token), params.blocks)
+    x = _rmsnorm(x, params.ln_f, cfg.eps)
+    logits = (x[:, -1, :] @ params.head)  # (B, V): last prompt position
+    return cache, jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+
+
+def make_global_decode(mesh, comm_dp, comm_tp, cfg, max_len, *, prefill="batched"):
     """Jitted greedy autoregressive decoder over a ``(dp, tp)`` mesh.
 
     ``decode(params, prompt)``: ``prompt`` is global ``[B, P]`` int32
-    sharded over dp (tp-replicated).  Prefill processes the prompt one
-    position at a time through the same KV-cached step as generation
-    (simple and exactly equivalent; batch-prefill is an optimisation,
-    not a semantics change), then generates ``max_len - P`` greedy
-    tokens.  Returns global ``[B, max_len]`` int32 — prompt followed by
-    the generated continuation.  Matches
-    :func:`reference_greedy_decode` exactly (same math; tp roundoff
-    only).
+    sharded over dp (tp-replicated).  ``prefill="batched"`` (default)
+    processes the whole prompt in ONE causal forward pass that fills
+    the KV cache — the prompt costs a single MXU-shaped forward instead
+    of P sequential steps; ``prefill="stepwise"`` keeps the
+    position-at-a-time path (same math, the original formulation — the
+    equivalence is pinned by tests/parallel/test_decode.py).  Then
+    generates ``max_len - P`` greedy tokens.  Returns global
+    ``[B, max_len]`` int32 — prompt followed by the generated
+    continuation.  Matches :func:`reference_greedy_decode` exactly
+    (same math; tp roundoff only).
     """
     dp_ax, tp_ax = comm_dp.axes[0], comm_tp.axes[0]
     tp = comm_tp.size
     _check_tp_divisibility(cfg, tp)
     hq_l, hk_l = cfg.heads // tp, cfg.kv_heads // tp
     specs = param_specs(tp_ax)
+    if prefill not in ("batched", "stepwise"):
+        raise ValueError(
+            f"prefill must be 'batched' or 'stepwise', got {prefill!r}"
+        )
 
     def local_decode(params, prompt):
         from mpi4jax_tpu.ops._core import promote_vma
@@ -434,20 +502,32 @@ def make_global_decode(mesh, comm_dp, comm_tp, cfg, max_len):
                 f"(the decoder's static sequence budget)"
             )
         prompt = promote_vma(prompt, (dp_ax, tp_ax))
-        cache = promote_vma(
-            jnp.zeros(
-                (cfg.layers, 2, b, max_len, hk_l, cfg.head_dim),
-                params.embed.dtype,
-            ),
-            (dp_ax, tp_ax),
-        )
         out = promote_vma(
             jnp.zeros((b, max_len), prompt.dtype), (dp_ax, tp_ax)
         )
         out = lax.dynamic_update_slice(out, prompt, (0, 0))
 
+        if prefill == "batched" and p_len > 1:
+            cache, nxt = _prefill_sharded(
+                params, prompt, cfg, comm_tp, hq_l, hk_l, max_len
+            )
+            if p_len < max_len:
+                out = lax.dynamic_update_slice(
+                    out, nxt[:, None], (0, p_len)
+                )
+            start = p_len  # positions start..max_len-2 remain
+        else:
+            cache = promote_vma(
+                jnp.zeros(
+                    (cfg.layers, 2, b, max_len, hk_l, cfg.head_dim),
+                    params.embed.dtype,
+                ),
+                (dp_ax, tp_ax),
+            )
+            start = 0
+
         def step(carry, pos):
-            # pos runs 0..max_len-2, so pos+1 is always a valid slot
+            # pos runs start..max_len-2, so pos+1 is always a valid slot
             cache, out = carry
             last = lax.dynamic_index_in_dim(
                 out, pos, axis=1, keepdims=False
@@ -463,7 +543,7 @@ def make_global_decode(mesh, comm_dp, comm_tp, cfg, max_len):
             return (cache, out), None
 
         (cache, out), _ = lax.scan(
-            step, (cache, out), jnp.arange(max_len - 1)
+            step, (cache, out), jnp.arange(start, max_len - 1)
         )
         # every tp rank computed the identical sequence, but collective
         # outputs are varying-typed; a masked psum re-establishes the

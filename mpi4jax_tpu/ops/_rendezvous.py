@@ -1,0 +1,145 @@
+"""Host-side rendezvous matching for mesh-backend p2p with *runtime*
+semantics (SURVEY §7 hard part 1).
+
+The mesh backend matches ``send``/``recv`` pairs at trace time whenever
+the pattern is static (ops/p2p.py) — zero runtime cost, deadlock-free
+by construction.  What trace-time matching cannot express is the
+reference's execution-time envelope matching
+(mpi4jax/_src/collective_ops/recv.py:39-47, where libmpi matches
+``ANY_SOURCE``/``ANY_TAG`` when the message actually arrives):
+
+* a **data-dependent destination** — ``send(x, dest)`` where ``dest``
+  is a traced per-rank value, unknowable at trace time;
+* a **wildcard recv with no trace-time match** — the message will come
+  from a send whose destination is itself runtime-valued.
+
+Those ops route through this engine: an in-process mailbox with MPI
+matching semantics (arrival order per destination; a recv takes the
+EARLIEST-arrived message whose envelope matches its ``source``/``tag``
+wants, wildcards matching anything).  Each device's op runs an
+``io_callback`` — posts are non-blocking, takes block on a condition
+variable until a matching envelope arrives (or a configurable timeout
+diagnoses the deadlock).  Device-side ordering rides the token stamp
+through the callbacks, the library's universal ordering model
+(ops/_core.py).
+
+This is the single-host analog of the DCN matching engine
+(native/src/dcn.cc) that serves the multi-process backend; the proc
+tier keeps serving true cross-process MPMD.
+"""
+
+import os
+import threading
+
+import numpy as np
+
+ANY = -1  # matches ops._core.ANY_SOURCE / ANY_TAG
+
+
+def _timeout():
+    try:
+        return float(os.environ.get("MPI4JAX_TPU_RENDEZVOUS_TIMEOUT", "60"))
+    except ValueError:
+        return 60.0
+
+
+class Engine:
+    """Thread-safe mailbox with MPI envelope matching.
+
+    Messages are keyed by ``(comm_key, dest_rank)``; within a mailbox
+    they queue in arrival order.  ``take`` returns the earliest message
+    whose ``(source, tag)`` envelope matches the caller's wants —
+    exactly MPI's matching rule for a single-threaded receiver.
+    """
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._boxes = {}  # (comm_key, dest) -> [(source, tag, payload)]
+        # set when any take times out: wakes every other blocked take
+        # promptly instead of letting each serve its own full timeout
+        # (which would otherwise stall the process again at interpreter
+        # exit while jax drains the still-blocked callbacks).  Cleared
+        # automatically once the blocked cohort has drained, so a retry
+        # in the same process starts clean.
+        self._poisoned = False
+        self._waiters = 0
+
+    def post(self, key, source, dest, tag, payload):
+        with self._cv:
+            self._boxes.setdefault((key, dest), []).append(
+                (source, tag, payload)
+            )
+            self._cv.notify_all()
+
+    def _match(self, box, want_source, want_tag):
+        for i, (src, tag, _payload) in enumerate(box):
+            if want_source != ANY and src != want_source:
+                continue
+            if want_tag != ANY and tag != want_tag:
+                continue
+            return i
+        return None
+
+    def take(self, key, rank, want_source, want_tag, timeout=None):
+        timeout = _timeout() if timeout is None else timeout
+        with self._cv:
+            idx = None
+
+            def ready():
+                nonlocal idx
+                box = self._boxes.get((key, rank))
+                if box:
+                    idx = self._match(box, want_source, want_tag)
+                    if idx is not None:
+                        return True  # a real match always wins
+                return self._poisoned
+
+            self._waiters += 1
+            try:
+                if not self._cv.wait_for(ready, timeout=timeout):
+                    self._poisoned = True  # free the other blocked ranks
+                    self._cv.notify_all()
+                    raise RuntimeError(
+                        f"rendezvous recv on rank {rank} timed out after "
+                        f"{timeout:.0f}s waiting for a message matching "
+                        f"source="
+                        f"{'ANY' if want_source == ANY else want_source}, "
+                        f"tag={'ANY' if want_tag == ANY else want_tag}. "
+                        "Either the matching send never executes (deadlock "
+                        "— check every rank posts its send before blocking "
+                        "in recv, i.e. the ops share one token chain) or "
+                        "it targets a different rank/tag. Raise "
+                        "MPI4JAX_TPU_RENDEZVOUS_TIMEOUT if the send is "
+                        "just slow."
+                    )
+                if idx is None:  # woken by poisoning, not by a match
+                    raise RuntimeError(
+                        f"rendezvous recv on rank {rank} aborted: another "
+                        "rank's rendezvous recv timed out (deadlock "
+                        "propagated — see that rank's error for the "
+                        "diagnosis)"
+                    )
+                src, tag, payload = self._boxes[(key, rank)].pop(idx)
+            finally:
+                self._waiters -= 1
+                if self._waiters == 0:
+                    self._poisoned = False  # cohort drained: start clean
+        return payload, src, tag
+
+    def reset(self):
+        """Drop all queued messages and clear poisoning (new run /
+        test isolation)."""
+        with self._cv:
+            self._boxes.clear()
+            self._poisoned = False
+
+    def pending_count(self):
+        with self._cv:
+            return sum(len(b) for b in self._boxes.values())
+
+
+_engine = Engine()
+
+
+def engine():
+    return _engine

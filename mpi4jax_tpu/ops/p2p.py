@@ -29,6 +29,16 @@ pending-send queue, and the matching ``recv`` pops it and emits the fused
 runtime rendezvous cost.  The deadlock-freedom the reference must test
 for (tests/collective_ops/test_send_and_recv.py:104-117) holds by
 construction: a ppermute cannot deadlock.
+
+Patterns that trace-time matching cannot express fall back to the
+**host rendezvous** tier (ops/_rendezvous.py): a ``send`` whose ``dest``
+is a traced (data-dependent) per-rank value posts its payload to the
+in-process matching engine via ``io_callback``, and a wildcard ``recv``
+with no trace-time match takes the earliest-arriving envelope match at
+execution time — the reference's runtime ``ANY_SOURCE``/``ANY_TAG``
+semantics (recv.py:39-47), with the Status reporting the true runtime
+source.  Single-host scope (the engine is per-process); true
+cross-process MPMD stays on the proc backend.
 """
 
 import numpy as np
@@ -193,6 +203,106 @@ def _static_source_of(pairs, comm):
     return jnp.asarray(src_of)[comm.rank()]
 
 
+def _is_runtime_rank(spec):
+    """A p2p partner given as a traced per-rank value (data-dependent
+    routing) — only resolvable at execution time."""
+    import jax
+
+    return isinstance(spec, jax.core.Tracer)
+
+
+def _rendezvous_send(x, dest, tag, comm, token):
+    """Mesh send with a runtime destination: post the local shard to the
+    host matching engine (ops/_rendezvous.py) via io_callback."""
+    import jax
+    from jax.experimental import io_callback
+
+    from mpi4jax_tpu.ops._core import promote_vma
+    from mpi4jax_tpu.ops._rendezvous import engine
+
+    key = comm_key(comm)
+    size = comm.size
+    token, (x,) = fence_in(token, x)
+
+    def post_cb(rank_v, dest_v, payload, stamp):
+        dest_i = int(dest_v)
+        if not 0 <= dest_i < size:
+            raise RuntimeError(
+                f"rendezvous send: dest={dest_i} out of range for "
+                f"communicator of size {size} (runtime-valued dest)"
+            )
+        engine().post(
+            key, int(rank_v), dest_i, int(tag), np.asarray(payload).copy()
+        )
+        return np.asarray(stamp)
+
+    stamp = io_callback(
+        post_cb,
+        jax.ShapeDtypeStruct((), np.float32),
+        comm.rank(), dest, x, token.stamp,
+        ordered=False,
+    )
+    return token.with_stamp(promote_vma(stamp, comm.axes))
+
+
+def _rendezvous_recv(x, source, tag, comm, token, status):
+    """Mesh recv with runtime envelope matching: block in an
+    io_callback until the engine has a message whose (source, tag)
+    matches; Status reports the true runtime source."""
+    import jax
+    from jax.experimental import io_callback
+
+    from mpi4jax_tpu.ops._core import promote_vma
+    from mpi4jax_tpu.ops._rendezvous import engine
+
+    key = comm_key(comm)
+    if _is_runtime_rank(source):
+        want = source
+    else:
+        # only ANY_SOURCE reaches here through recv(): a static source
+        # either trace-matches, raises the bare-int guidance, or raises
+        # the no-matching-send error — so the non-traced case IS the
+        # engine wildcard
+        want = jnp.int32(ANY_SOURCE)
+    token, _ = fence_in(token)
+
+    shape, dtype = tuple(x.shape), x.dtype
+
+    def take_cb(rank_v, want_v, stamp):
+        payload, src, tg = engine().take(
+            key, int(rank_v), int(want_v), int(tag)
+        )
+        payload = np.asarray(payload)
+        if payload.shape != shape or payload.dtype != np.dtype(dtype):
+            raise RuntimeError(
+                f"rendezvous recv on rank {int(rank_v)}: matched message "
+                f"has shape/dtype {payload.shape}/{payload.dtype}, but "
+                f"the recv template expects {shape}/{np.dtype(dtype)}"
+            )
+        return payload, np.int32(src), np.int32(tg), np.asarray(stamp)
+
+    y, src, tg, stamp = io_callback(
+        take_cb,
+        (
+            jax.ShapeDtypeStruct(shape, dtype),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((), np.int32),
+            jax.ShapeDtypeStruct((), np.float32),
+        ),
+        comm.rank(), want, token.stamp,
+        ordered=False,
+    )
+    y = promote_vma(y, comm.axes)
+    token = token.with_stamp(promote_vma(stamp, comm.axes))
+    if status is not None:
+        # mesh-backend Status convention (class docstring): the fields
+        # are per-device traced values — here the TRUE runtime envelope
+        # as matched by the engine, not a trace-time reconstruction
+        status.source = promote_vma(src, comm.axes)
+        status.tag = promote_vma(tg, comm.axes)
+    return y, token
+
+
 @publishes_token
 def send(x, dest, tag=0, *, comm=None, token=None):
     """Stage a send of ``x`` along the ``dest`` pattern; returns a token
@@ -217,6 +327,10 @@ def send(x, dest, tag=0, *, comm=None, token=None):
             )
         stamp = _proc.proc_send(x, token.stamp, comm, dest, tag)
         return token.with_stamp(stamp)
+    if comm.backend == "mesh" and _is_runtime_rank(dest):
+        # data-dependent destination: only the host rendezvous tier can
+        # route it (trace-time matching needs a static pattern)
+        return _rendezvous_send(x, dest, tag, comm, token)
     pairs = _resolve_pairs(dest, comm.size, "dest")
     _validate_perm(pairs, comm.size, "send dest")
     meta = PendingSendMeta(
@@ -256,6 +370,9 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
         if status is not None:
             _deliver_status(status, st)
         return y, token.with_stamp(stamp)
+    if comm.backend == "mesh" and _is_runtime_rank(source):
+        # runtime-valued source: no static pattern to match against
+        return _rendezvous_recv(x, source, tag, comm, token, status)
     want_pairs = None
     source_is_any = (
         isinstance(source, (int, np.integer)) and int(source) == ANY_SOURCE
@@ -301,6 +418,12 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
                 status.tag = meta.tag
         return y, token
 
+    if comm.backend == "mesh" and source_is_any:
+        # wildcard recv with no trace-time match: the message must be
+        # coming from a runtime-routed send — match it at execution
+        # time through the host engine (reference recv.py:39-47
+        # semantics; Status reports the true runtime source)
+        return _rendezvous_recv(x, source, tag, comm, token, status)
     raise RuntimeError(
         "recv found no matching in-trace send on this token. Under SPMD, "
         "send and recv must be paired within the same trace (the send "

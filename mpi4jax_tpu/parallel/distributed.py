@@ -31,6 +31,7 @@ __all__ = [
     "world_comm",
     "slice_mesh",
     "slice_comms",
+    "two_tier_allreduce",
 ]
 
 
@@ -136,3 +137,55 @@ def slice_comms():
     mesh = slice_mesh()
     world = MeshComm.from_mesh(mesh)
     return world, world.sub("chip"), world.sub("slice")
+
+
+def two_tier_allreduce(x, op, intra, inter, *, token=None):
+    """World allreduce over a two-fabric topology whose slices are
+    SEPARATE jax runtimes: the ``intra`` MeshComm reduces this host's
+    chips over ICI, the ``inter`` ProcComm reduces the per-slice
+    partials across hosts over the C++ DCN bridge (TCP), and the world
+    result comes back replicated across the local mesh.
+
+    On a single multi-slice jax job, a plain :func:`world_comm`
+    allreduce does all of this in one XLA collective (XLA itself routes
+    ICI vs DCN — :func:`slice_comms` exposes the split).  This helper
+    is the explicit composition for the launcher's process model, where
+    each "slice" is its own jax world glued to the others only by the
+    proc bridge — the reference's cross-node MPI tier (SURVEY §5.8).
+    Exercised across two real processes by
+    tests/proc/test_cross_slice.py.
+
+    Args:
+      x: global array sharded over ``intra``'s mesh axes (leading dim).
+      op: reduction op (e.g. ``SUM``).
+      intra: MeshComm over this process's devices (the ICI tier).
+      inter: ProcComm over the launcher job's processes (the DCN tier).
+
+    Returns ``(world, token)`` — ``world`` shaped like ``x``, every
+    element holding the across-all-slices reduction.
+    """
+    import jax.numpy as jnp
+
+    from mpi4jax_tpu.ops._core import as_token
+    from mpi4jax_tpu.ops.allreduce import allreduce
+
+    token = as_token(token)
+    spec = jax.P(intra.axes)
+
+    def local(v):
+        y, _tok = allreduce(v, op, comm=intra)
+        return y
+
+    slice_red = jax.jit(
+        jax.shard_map(local, mesh=intra.mesh, in_specs=spec, out_specs=spec)
+    )(x)
+    # every dim-0 row now holds the slice partial (P(axes) shards dim 0
+    # over ALL the mesh axes jointly); stage row 0 to the host for the
+    # DCN hop (the proc tier's wire is host-side anyway, and an eager
+    # multi-device-committed operand would otherwise drag the
+    # side-effecting FFI call through the SPMD partitioner)
+    import numpy as np
+
+    partial = np.asarray(jax.device_get(slice_red[0]))
+    world, token = allreduce(partial, op, comm=inter, token=token)
+    return jnp.broadcast_to(world, x.shape), token

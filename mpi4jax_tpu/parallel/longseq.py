@@ -182,7 +182,7 @@ def zigzag_unshard(x, p, axis=1):
 @publishes_token
 def ring_attention(
     q, k, v, comm, *, causal=False, scale=None, token=None,
-    layout="contiguous",
+    layout="contiguous", impl="auto",
 ):
     """Context-parallel attention over a 1-D ring communicator.
 
@@ -197,6 +197,11 @@ def ring_attention(
     Reverse-mode AD reverses the permutation automatically — gradients
     ride the ring the opposite way, the exact transpose contract of the
     reference's sendrecv (sendrecv.py:366-385).
+
+    ``impl`` selects the single-device attention kernel (see
+    :func:`local_attention`) for the ``comm.size == 1`` shortcut; the
+    multi-rank ring path always uses its own blockwise online-softmax
+    updates (the ring IS the flash-style blocking, at shard granularity).
 
     ``layout``: ``"contiguous"`` — rank r holds global positions
     ``[r*T_local, (r+1)*T_local)``; ``"zigzag"`` — rank r holds chunks
@@ -221,7 +226,7 @@ def ring_attention(
     _check_gqa(q.shape[2], k.shape[2], "ring_attention")
 
     if comm.backend == "self" or p == 1:
-        out = local_attention(q, k, v, causal=causal, scale=scale)
+        out = local_attention(q, k, v, causal=causal, scale=scale, impl=impl)
         return out, token
 
     if comm.backend != "mesh":
@@ -383,7 +388,9 @@ def ring_attention(
 
 
 @publishes_token
-def ulysses_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
+def ulysses_attention(
+    q, k, v, comm, *, causal=False, scale=None, token=None, impl="auto"
+):
     """Ulysses-style context parallelism: all-to-all head↔sequence
     reshard, dense local attention over the full sequence, reshard back.
 
@@ -396,7 +403,7 @@ def ulysses_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
     p = comm.size
 
     if comm.backend == "self" or p == 1:
-        out = local_attention(q, k, v, causal=causal, scale=scale)
+        out = local_attention(q, k, v, causal=causal, scale=scale, impl=impl)
         return out, token
 
     if comm.backend != "mesh":
@@ -441,7 +448,7 @@ def ulysses_attention(q, k, v, comm, *, causal=False, scale=None, token=None):
     kh, token = to_heads(k, token)
     vh, token = to_heads(v, token)
 
-    out = local_attention(qh, kh, vh, causal=causal, scale=scale)
+    out = local_attention(qh, kh, vh, causal=causal, scale=scale, impl=impl)
 
     out, token = to_seq(out, token)
     return out, token

@@ -31,12 +31,15 @@ def comms(mesh2d):
     return world.sub("dp"), world.sub("tp")
 
 
-def test_decode_matches_oracle(mesh2d, comms):
+@pytest.mark.parametrize("prefill", ["batched", "stepwise"])
+def test_decode_matches_oracle(mesh2d, comms, prefill):
     comm_dp, comm_tp = comms
     params = tfm.init_params(jax.random.PRNGKey(1), CFG)
     prompt = jax.random.randint(jax.random.PRNGKey(2), (B, P), 0, CFG.vocab)
 
-    decode = tfm.make_global_decode(mesh2d, comm_dp, comm_tp, CFG, MAX)
+    decode = tfm.make_global_decode(
+        mesh2d, comm_dp, comm_tp, CFG, MAX, prefill=prefill
+    )
     got = decode(params, prompt)
 
     want = tfm.reference_greedy_decode(params, prompt, CFG, MAX)
@@ -46,14 +49,30 @@ def test_decode_matches_oracle(mesh2d, comms):
     np.testing.assert_array_equal(got, want)
 
 
-def test_decode_prompt_only_roundtrip(mesh2d, comms):
+@pytest.mark.parametrize("prefill", ["batched", "stepwise"])
+def test_decode_prompt_only_roundtrip(mesh2d, comms, prefill):
     # max_len == prompt length: nothing generated, prompt returned
     comm_dp, comm_tp = comms
     params = tfm.init_params(jax.random.PRNGKey(3), CFG)
     prompt = jax.random.randint(jax.random.PRNGKey(4), (B, 6), 0, CFG.vocab)
-    decode = tfm.make_global_decode(mesh2d, comm_dp, comm_tp, CFG, 6)
+    decode = tfm.make_global_decode(
+        mesh2d, comm_dp, comm_tp, CFG, 6, prefill=prefill
+    )
     out = decode(params, prompt)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
+def test_decode_single_token_prompt(mesh2d, comms):
+    # p_len == 1: the batched path degrades to stepwise (a 1-token
+    # prefill IS one step); both must match the oracle
+    comm_dp, comm_tp = comms
+    params = tfm.init_params(jax.random.PRNGKey(9), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(10), (B, 1), 0, CFG.vocab)
+    decode = tfm.make_global_decode(mesh2d, comm_dp, comm_tp, CFG, 8)
+    want = tfm.reference_greedy_decode(params, prompt, CFG, 8)
+    np.testing.assert_array_equal(
+        np.asarray(decode(params, prompt)), np.asarray(want)
+    )
 
 
 def test_decode_prompt_longer_than_budget_errors(mesh2d, comms):

@@ -1,0 +1,227 @@
+"""Host-rendezvous tier for mesh p2p (ops/_rendezvous.py): runtime
+(execution-time) envelope matching — the reference's ANY_SOURCE/ANY_TAG
+semantics (mpi4jax recv.py:39-47) on the mesh backend, where trace-time
+matching cannot resolve a data-dependent destination.  The VERDICT r2
+#4 done-bar lives here: two (and eight) mesh ranks exchange with
+``source=ANY_SOURCE`` and the Status reports the TRUE runtime source.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.ops._rendezvous import Engine, engine
+
+from tests.helpers import spmd_jit
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine():
+    engine().reset()
+    yield
+    assert engine().pending_count() == 0, "rendezvous messages leaked"
+    engine().reset()
+
+
+@pytest.fixture()
+def comm1d():
+    mesh = jax.make_mesh(
+        (SIZE,), ("p",), axis_types=(jax.sharding.AxisType.Auto,)
+    )
+    return m.MeshComm.from_mesh(mesh)
+
+
+# ------------------------- engine unit tests -------------------------
+
+
+def test_engine_matches_in_arrival_order():
+    e = Engine()
+    e.post("k", source=3, dest=0, tag=7, payload=np.float32(30.0))
+    e.post("k", source=5, dest=0, tag=7, payload=np.float32(50.0))
+    p, src, tag = e.take("k", 0, want_source=-1, want_tag=-1)
+    assert (float(p), src, tag) == (30.0, 3, 7)  # earliest arrival
+    p, src, tag = e.take("k", 0, want_source=-1, want_tag=-1)
+    assert (float(p), src, tag) == (50.0, 5, 7)
+
+
+def test_engine_specific_envelope_skips_nonmatching():
+    e = Engine()
+    e.post("k", source=1, dest=0, tag=1, payload=np.float32(1.0))
+    e.post("k", source=2, dest=0, tag=2, payload=np.float32(2.0))
+    # specific tag matches the SECOND message even though first arrived
+    p, src, tag = e.take("k", 0, want_source=-1, want_tag=2)
+    assert (src, tag) == (2, 2)
+    # specific source likewise
+    p, src, tag = e.take("k", 0, want_source=1, want_tag=-1)
+    assert (src, tag) == (1, 1)
+
+
+def test_engine_timeout_message():
+    e = Engine()
+    with pytest.raises(RuntimeError, match="timed out.*source=ANY"):
+        e.take("k", 4, want_source=-1, want_tag=-1, timeout=0.1)
+
+
+def test_engine_timeout_poisons_other_waiters_then_recovers():
+    # one rank's timeout must free the OTHER blocked ranks promptly
+    # (not after their own full timeouts — which would stall process
+    # exit while jax drains the blocked callbacks), and the poison must
+    # clear once the cohort drains so a later exchange works.
+    import threading
+    import time
+
+    e = Engine()
+    errors = {}
+
+    def waiter():
+        t0 = time.monotonic()
+        try:
+            e.take("k", 1, want_source=-1, want_tag=-1, timeout=30)
+        except RuntimeError as exc:
+            errors["waiter"] = (str(exc), time.monotonic() - t0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)  # let the waiter block
+    with pytest.raises(RuntimeError, match="timed out"):
+        e.take("k", 0, want_source=-1, want_tag=-1, timeout=0.2)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    msg, waited = errors["waiter"]
+    assert "aborted" in msg and "propagated" in msg
+    assert waited < 5  # freed by poisoning, not its own 30s timeout
+    # cohort drained -> poison cleared: a fresh exchange succeeds
+    e.post("k", source=2, dest=0, tag=0, payload=np.float32(7.0))
+    p, src, _tag = e.take("k", 0, want_source=-1, want_tag=-1, timeout=1)
+    assert (float(p), src) == (7.0, 2)
+
+
+def test_engine_keys_isolate_comms():
+    e = Engine()
+    e.post("a", source=0, dest=1, tag=0, payload=np.float32(1.0))
+    with pytest.raises(RuntimeError, match="timed out"):
+        e.take("b", 1, want_source=-1, want_tag=-1, timeout=0.1)
+    e.take("a", 1, want_source=-1, want_tag=-1)
+
+
+# --------------------- mesh-backend integration ----------------------
+
+
+def test_runtime_dest_anysource_status_reports_true_source(comm1d):
+    """The done-bar scenario: every rank sends to a DATA-DEPENDENT
+    destination (unknowable at trace time), every rank receives with
+    source=ANY_SOURCE — the payload arrives and the Status carries the
+    true runtime source rank."""
+    shift = 3
+
+    def fn(x):
+        r = jax.lax.axis_index("p")
+        dest = (r + shift) % SIZE  # traced: runtime routing
+        tok = m.create_token()
+        tok = m.send(x, dest, tag=5, comm=comm1d, token=tok)
+        status = m.Status()
+        y, tok = m.recv(
+            x, source=m.ANY_SOURCE, tag=m.ANY_TAG, comm=comm1d, token=tok,
+            status=status,
+        )
+        # mesh Status convention: traced per-device values — return them
+        return (
+            y[0],
+            status.source.astype(jnp.float32),
+            status.tag.astype(jnp.float32),
+        )
+
+    x = jnp.arange(float(SIZE))
+    f = spmd_jit(comm1d, lambda v: jnp.stack(fn(v)).reshape(1, 3))
+    out = np.asarray(f(x)).reshape(SIZE, 3)
+    np.testing.assert_array_equal(out[:, 0], np.roll(np.arange(8.0), shift))
+    np.testing.assert_array_equal(out[:, 1], (np.arange(8) - shift) % SIZE)
+    np.testing.assert_array_equal(out[:, 2], 5.0)
+
+
+def test_runtime_source_specific_rank(comm1d):
+    """recv with a TRACED specific source: the engine holds back other
+    ranks' messages and delivers exactly the wanted envelope."""
+
+    def fn(x):
+        r = jax.lax.axis_index("p")
+        tok = m.create_token()
+        # two rendezvous sends per rank: to r+1 (tag 0) and r+2 (tag 1)
+        tok = m.send(x * 10, (r + 1) % SIZE, tag=0, comm=comm1d, token=tok)
+        tok = m.send(x * 100, (r + 2) % SIZE, tag=1, comm=comm1d, token=tok)
+        st = m.Status()
+        want = (r - 2) % SIZE  # traced source: the tag-1 sender
+        y, tok = m.recv(
+            x, source=want, tag=1, comm=comm1d, token=tok, status=st
+        )
+        st2 = m.Status()
+        z, tok = m.recv(
+            x, source=m.ANY_SOURCE, tag=0, comm=comm1d, token=tok, status=st2
+        )
+        return (
+            y[0], z[0],
+            st.source.astype(jnp.float32),
+            st2.source.astype(jnp.float32),
+        )
+
+    x = jnp.arange(float(SIZE))
+    f = spmd_jit(comm1d, lambda v: jnp.stack(fn(v)).reshape(1, 4))
+    out = np.asarray(f(x)).reshape(SIZE, 4)
+    base = np.arange(8.0)
+    np.testing.assert_array_equal(out[:, 0], np.roll(base, 2) * 100)
+    np.testing.assert_array_equal(out[:, 1], np.roll(base, 1) * 10)
+    np.testing.assert_array_equal(out[:, 2], (np.arange(8) - 2) % SIZE)
+    np.testing.assert_array_equal(out[:, 3], (np.arange(8) - 1) % SIZE)
+
+
+def test_runtime_dest_out_of_range_fails_loudly(comm1d):
+    def fn(x):
+        r = jax.lax.axis_index("p")
+        tok = m.send(x, r + SIZE, comm=comm1d, token=m.create_token())
+        _ = tok
+        return x
+
+    with pytest.raises(Exception, match="out of range"):
+        # force materialisation: callback errors surface on the result,
+        # not at (async) dispatch
+        np.asarray(spmd_jit(comm1d, fn)(jnp.arange(float(SIZE))))
+    engine().reset()  # ranks that posted before the failure
+
+
+def test_rendezvous_recv_timeout_diagnoses_deadlock(comm1d, monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_RENDEZVOUS_TIMEOUT", "1")
+
+    def fn(x):
+        st = m.Status()
+        y, _ = m.recv(
+            x, source=m.ANY_SOURCE, comm=comm1d, token=m.create_token(),
+            status=st,
+        )
+        return y
+
+    with pytest.raises(Exception, match="timed out"):
+        np.asarray(spmd_jit(comm1d, fn)(jnp.arange(float(SIZE))))
+
+
+def test_static_path_still_trace_matches(comm1d):
+    """A static send/recv pair must keep using the zero-cost trace-time
+    path — nothing may reach the engine."""
+
+    def fn(x):
+        tok = m.create_token()
+        tok = m.send(x, lambda r: (r + 1) % SIZE, comm=comm1d, token=tok)
+        y, tok = m.recv(
+            x, lambda r: (r - 1) % SIZE, comm=comm1d, token=tok
+        )
+        return y
+
+    out = spmd_jit(comm1d, fn)(jnp.arange(float(SIZE)))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.roll(np.arange(8.0), 1)
+    )
+    assert engine().pending_count() == 0

@@ -25,9 +25,36 @@ def test_bare_int_source_names_launcher(comm1d):
     assert "mpi4jax_tpu.launch" in str(e.value)
 
 
-def test_unmatched_recv_names_proc_backend(comm1d):
+def test_unmatched_wildcard_recv_is_runtime_matched(comm1d, monkeypatch):
+    # Contract change in round 3 (VERDICT r2 #4): a WILDCARD recv with
+    # no trace-time match no longer raises at trace time — it IS the
+    # runtime-matching path (host rendezvous, ops/_rendezvous.py).  A
+    # lone one therefore diagnoses the deadlock at execution time with
+    # the curated timeout error.
+    import numpy as np
+
+    monkeypatch.setenv("MPI4JAX_TPU_RENDEZVOUS_TIMEOUT", "1")
+
     def fn(x):
         y, _ = m.recv(x, comm=comm1d)
+        return y
+
+    with pytest.raises(Exception, match="timed out") as e:
+        np.asarray(
+            jax.shard_map(
+                fn, mesh=comm1d.mesh, in_specs=jax.P("i"),
+                out_specs=jax.P("i"),
+            )(jnp.arange(8.0))
+        )
+    assert "deadlock" in str(e.value)  # the diagnosis, with guidance
+
+
+def test_unmatched_static_recv_names_proc_backend(comm1d):
+    # a STATIC-pattern recv with no staged send keeps the trace-time
+    # error (it can never be satisfied at runtime either — the matching
+    # send would have been staged in this same trace)
+    def fn(x):
+        y, _ = m.recv(x, source=lambda r: (r - 1) % 8, comm=comm1d)
         return y
 
     with pytest.raises(RuntimeError) as e:
