@@ -480,6 +480,19 @@ def main():
     except Exception as exc:  # noqa: BLE001 — bench must still emit its line
         print(f"[bench] large-transformer bench failed: {exc}", file=sys.stderr)
 
+    # inference-side extra: greedy-decode throughput through the
+    # TP-sharded KV cache (batched prefill), benchmarks/transformer.py
+    try:
+        from benchmarks.transformer import run_decode
+
+        dec = _run_with_watchdog(
+            lambda: run_decode(bf16=True, batches=3), record, 600,
+            "decode bench",
+        )
+        extras["decode_tokens_per_sec_bf16"] = dec["value"]
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] decode bench failed: {exc}", file=sys.stderr)
+
     print(json.dumps(record()))
     print(
         f"[bench] devices={n_dev} mesh={shape} steps={total_steps} "

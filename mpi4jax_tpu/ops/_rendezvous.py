@@ -64,12 +64,28 @@ class Engine:
         self._poisoned = False
         self._waiters = 0
 
+    @staticmethod
+    def _log(make_line):
+        """DEBUG-gated engine trace, in the spirit of the wire-format op
+        logging (ops/_core.py _debug_begin): one line per post/match so
+        a runtime-matching bug is reconstructible from the transcript.
+        Toggled by the same MPI4JAX_TPU_DEBUG switch.  Takes a LAZY
+        line producer so the disabled path pays no formatting."""
+        from mpi4jax_tpu.utils.config import debug_enabled
+
+        if debug_enabled():
+            print(make_line(), flush=True)
+
     def post(self, key, source, dest, tag, payload):
         with self._cv:
             self._boxes.setdefault((key, dest), []).append(
                 (source, tag, payload)
             )
             self._cv.notify_all()
+        self._log(
+            lambda: f"r{source} | rendezvous | post -> r{dest} tag={tag} "
+            f"({payload.size} items)"
+        )
 
     def _match(self, box, want_source, want_tag):
         for i, (src, tag, _payload) in enumerate(box):
@@ -124,6 +140,12 @@ class Engine:
                 self._waiters -= 1
                 if self._waiters == 0:
                     self._poisoned = False  # cohort drained: start clean
+        self._log(
+            lambda: f"r{rank} | rendezvous | matched <- r{src} tag={tag} "
+            f"(wanted source="
+            f"{'ANY' if want_source == ANY else want_source}, "
+            f"tag={'ANY' if want_tag == ANY else want_tag})"
+        )
         return payload, src, tag
 
     def reset(self):

@@ -9,54 +9,67 @@ simulated slice boundary.  Reference obligation analog: the
 ``mpirun -np 2`` CI tier (SURVEY §4.1).
 """
 
+import pytest
+
 from tests.proc.test_proc_backend import run_workers
 
+_WORKER = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import mpi4jax_tpu as m
+from mpi4jax_tpu.parallel.distributed import two_tier_allreduce
 
-def test_world_allreduce_crosses_slice_boundary():
+CHIPS = {chips}
+inter = m.get_default_comm()          # DCN tier: processes over TCP
+assert inter.backend == "proc", inter
+nslices = inter.size
+rank = inter.rank()
+
+assert len(jax.devices()) == CHIPS    # this worker's "slice"
+mesh = jax.make_mesh(
+    (CHIPS,), ("chip",), axis_types=(jax.sharding.AxisType.Auto,)
+)
+intra = m.MeshComm.from_mesh(mesh)    # ICI tier
+
+# slice r's chip c holds row filled with 100*r + c: every value in the
+# world is distinct, and other slices' rows carry offsets this slice
+# cannot produce locally
+x = (jnp.arange(float(CHIPS)) + 100.0 * rank)[:, None] * jnp.ones((1, 3))
+
+world, tok = two_tier_allreduce(x, m.SUM, intra, inter)
+
+vals = np.concatenate(
+    [np.arange(float(CHIPS)) + 100.0 * r for r in range(nslices)]
+)
+want = vals.sum()                      # dense oracle over every chip
+got = np.asarray(world)
+assert got.shape == x.shape, got.shape
+assert np.allclose(got, want), (got, want)
+
+# the slice-local partial differs per host: matching the oracle PROVES
+# the DCN hop carried the other slices' contributions
+local_only = float(np.asarray(x[:, 0]).sum())
+assert not np.isclose(want, local_only)
+print(f"rank {rank} cross-slice allreduce ok ({local_only} -> {want})")
+"""
+
+
+@pytest.mark.parametrize(
+    "nslices,chips", [(2, 4), (4, 2)], ids=["2x4", "4x2"]
+)
+def test_world_allreduce_crosses_slice_boundary(nslices, chips):
     res = run_workers(
-        """
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        import jax.numpy as jnp
-        import numpy as np
-        import mpi4jax_tpu as m
-        from mpi4jax_tpu.parallel.distributed import two_tier_allreduce
-
-        inter = m.get_default_comm()          # DCN tier: 2 processes/TCP
-        assert inter.backend == "proc", inter
-        assert inter.size == 2
-        rank = inter.rank()
-
-        assert len(jax.devices()) == 4        # this worker's "slice"
-        mesh = jax.make_mesh(
-            (4,), ("chip",), axis_types=(jax.sharding.AxisType.Auto,)
-        )
-        intra = m.MeshComm.from_mesh(mesh)    # ICI tier: 4 chips
-
-        # slice r's chip c holds row filled with 100*r + c: every value
-        # in the world is distinct, and the other slice's rows carry a
-        # +100 offset this slice cannot produce locally
-        x = (jnp.arange(4.0) + 100.0 * rank)[:, None] * jnp.ones((1, 3))
-
-        world, tok = two_tier_allreduce(x, m.SUM, intra, inter)
-
-        vals = np.concatenate([np.arange(4.0), np.arange(4.0) + 100.0])
-        want = vals.sum()                      # dense oracle: 412
-        got = np.asarray(world)
-        assert got.shape == x.shape, got.shape
-        assert np.allclose(got, want), (got, want)
-
-        # the slice-local partial differs on each host (6 vs 406):
-        # matching the oracle PROVES the DCN hop carried the other
-        # slice's contribution
-        local_only = float(np.asarray(x).sum())
-        assert not np.isclose(want, local_only)
-        print(f"rank {rank} cross-slice allreduce ok ({local_only} -> {want})")
-        """,
-        nprocs=2,
-        env={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+        # .replace, not .format — the worker body's own f-strings use
+        # braces that .format would try to substitute
+        _WORKER.replace("{chips}", str(chips)),
+        nprocs=nslices,
+        env={
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={chips}"
+        },
     )
     assert res.returncode == 0, (res.stdout, res.stderr)
-    assert res.stdout.count("cross-slice allreduce ok") == 2, (
+    assert res.stdout.count("cross-slice allreduce ok") == nslices, (
         res.stdout, res.stderr,
     )

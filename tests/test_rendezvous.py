@@ -109,6 +109,24 @@ def test_engine_keys_isolate_comms():
     e.take("a", 1, want_source=-1, want_tag=-1)
 
 
+def test_engine_debug_logging(capsys):
+    # §5.1 observability parity for the new tier: one line per post and
+    # per match under the library-wide MPI4JAX_TPU_DEBUG switch
+    from mpi4jax_tpu.utils.config import set_debug
+
+    e = Engine()
+    set_debug(True)
+    try:
+        e.post("k", source=1, dest=0, tag=5, payload=np.zeros(3, np.float32))
+        e.take("k", 0, want_source=-1, want_tag=5, timeout=1)
+    finally:
+        set_debug(None)  # None resets to the env var, not a pinned False
+    out = capsys.readouterr().out
+    assert "r1 | rendezvous | post -> r0 tag=5 (3 items)" in out
+    assert "r0 | rendezvous | matched <- r1 tag=5" in out
+    assert "wanted source=ANY, tag=5" in out
+
+
 # --------------------- mesh-backend integration ----------------------
 
 
