@@ -11,9 +11,12 @@ scratch carried across grid steps, masking via 2-D iota.
 Public entry: :func:`flash_attention` with the same contract as
 ``local_attention`` ([B, T, H, D] operands, float32 accumulation,
 ``causal`` with static block offsets).  ``interpret=True`` runs the
-kernel on CPU for tests.  Reverse-mode differentiable: the backward
-pass recomputes attention densely (same cost/memory as differentiating
-the dense path; the VMEM win applies to the forward).
+kernel on CPU for tests.  Reverse-mode differentiable with a BLOCKWISE
+backward (the standard dFlashAttention pair): the forward additionally
+saves the per-row log-sum-exp, and two kernels recompute scores per
+block — one accumulating (dK, dV) per key block over query blocks, one
+accumulating dQ per query block over key blocks — so the backward
+never materialises the [Tq, Tk] score matrix either.
 """
 
 import functools
@@ -51,10 +54,7 @@ def _kernel(
     k_ref,
     v_ref,
     o_ref,
-    acc_ref,
-    m_ref,
-    l_ref,
-    *,
+    *rest,
     scale,
     causal,
     q_offset,
@@ -63,7 +63,13 @@ def _kernel(
     block_q,
     block_k,
     num_k,
+    with_lse,
 ):
+    if with_lse:
+        m_out_ref, l_out_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        m_out_ref, l_out_ref = None, None
+        acc_ref, m_ref, l_ref = rest
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -122,6 +128,15 @@ def _kernel(
     @pl.when(ik == num_k - 1)
     def _finalize():
         o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+        if with_lse:
+            # softmax residuals for the backward, stored (rows, 1) —
+            # the trailing singleton keeps the block Mosaic-legal.  m
+            # and l are saved SEPARATELY, never fused into m + log(l):
+            # on fully-masked rows m == _NEG (~-2.4e38) absorbs log(n)
+            # entirely in float32, which would inflate the recomputed
+            # weights from 1/n to 1 and scale dV by n.
+            m_out_ref[0] = m_ref[:, :1]
+            l_out_ref[0] = l_ref[:, :1]
 
 
 def flash_attention(
@@ -140,8 +155,9 @@ def flash_attention(
     """Blockwise attention, same contract as ``local_attention``.
 
     Block sizes default to 512 — measured ~2.6x faster than the
-    original 128x128 on v5e at seq 2048 (less grid/revisit overhead,
-    fuller MXU; docs/performance.md) — and are clamped down for short
+    original 128x128 on v5e at seq 2048 within one phase (less
+    grid/revisit overhead, fuller MXU; absolute times swing ±30% with
+    co-tenancy — docs/performance.md) — and are clamped down for short
     sequences.
 
     ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D].  Sequence lengths
@@ -185,68 +201,251 @@ def _flash_vjp(
     )
 
 
-def _dense_reference(q, k, v, causal, scale, q_offset, k_offset):
-    """The oracle the kernel reproduces (longseq.local_attention's math,
-    duplicated here to avoid an import cycle); used for the backward
-    pass residual-free recompute."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    s = s * scale
-    if causal:
-        qpos = q_offset + jnp.arange(q.shape[1])
-        kpos = k_offset + jnp.arange(k.shape[1])
-        mask = qpos[:, None] >= kpos[None, :]
-        s = jnp.where(mask[None, None], s, _NEG)
-    w = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
-    return out.astype(q.dtype)
-
-
 def _flash_fwd(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
 ):
-    out = _flash_fwd_impl(
+    out, m_res, l_res = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k,
-        interpret,
+        interpret, with_lse=True,
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, m_res, l_res)
+
+
+def _bwd_block(
+    q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, *, iq, ik, scale,
+    causal, q_offset, k_offset, kv_len, block_q, block_k,
+):
+    """Shared per-block backward math: recompute masked scores and the
+    softmax weights from the saved (m, l) statistics, then form ds —
+    the cotangent of the RAW scores.  ``ds`` is zeroed outside the
+    visible set exactly as the dense oracle's ``where`` vjp does (this
+    is what keeps the fully-masked-row uniform-weights convention
+    gradient-exact: those rows produce p == 1/n but ds == 0)."""
+    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+    k = k_ref[0].astype(jnp.float32)  # [bk, D]
+    v = v_ref[0].astype(jnp.float32)  # [bk, D]
+    g = g_ref[0].astype(jnp.float32)  # [bq, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * scale
+    krow = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    visible = krow < kv_len
+    if causal:
+        qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        causal_ok = qpos >= k_offset + krow
+        visible = visible & causal_ok
+        s = jnp.where(causal_ok, s, _NEG)
+    s = jnp.where(krow < kv_len, s, -_INF)
+    # p from the saved statistics ((rows, 1) columns broadcast across
+    # the block): exp(s - m) / l — NOT exp(s - (m + log l)), whose f32
+    # fusion loses log(l) against the huge _NEG on fully-masked rows
+    # and would inflate those rows' weights from 1/n to 1.  Padded q
+    # rows carry m == +inf (host-side padding) so p is exactly 0 there.
+    p = jnp.exp(s - m_ref[0]) / l_ref[0]  # [bq, bk]
+    dp = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta_ref[0]) * scale
+    ds = jnp.where(visible, ds, 0.0)
+    return q, k, g, p, ds
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale, causal, q_offset, k_offset, kv_len,
+    block_q, block_k, num_q,
+):
+    """dK/dV: one key block per middle grid index, accumulated over the
+    (sequential, minormost) query blocks."""
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q, _k, g, p, ds = _bwd_block(
+        q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, iq=iq,
+        ik=ik, scale=scale, causal=causal, q_offset=q_offset,
+        k_offset=k_offset, kv_len=kv_len, block_q=block_q,
+        block_k=block_k,
+    )
+    # dV += P^T @ dO ; dK += dS^T @ Q   (contract the q-block dim)
+    dv_acc[...] += jax.lax.dot_general(
+        p, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dk_acc[...] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(iq == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, dq_ref, dq_acc,
+    *, scale, causal, q_offset, k_offset, kv_len, block_q, block_k,
+    num_k,
+):
+    """dQ: one query block per middle grid index, accumulated over the
+    (sequential, minormost) key blocks."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    _q, k, _g, _p, ds = _bwd_block(
+        q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, iq=iq,
+        ik=ik, scale=scale, causal=causal, q_offset=q_offset,
+        k_offset=k_offset, kv_len=kv_len, block_q=block_q,
+        block_k=block_k,
+    )
+    dq_acc[...] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ik == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _flash_bwd(
     causal, scale, q_offset, k_offset, block_q, block_k, interpret, res, g
 ):
-    q, k, v = res
-    # dense recompute: same FLOPs/memory as differentiating the dense
-    # path — the flash forward's VMEM win is kept, gradients stay exact
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _dense_reference(
-            q_, k_, v_, causal, scale, q_offset, k_offset
-        ),
-        q, k, v,
+    q, k, v, out, m_res, l_res = res
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    block_q, block_k, pad_q, pad_k = _blocks(tq, tk, block_q, block_k)
+
+    qf = _fold(q, pad_q, b, h, d)
+    kf = _fold(k, pad_k, b, h, d)
+    vf = _fold(v, pad_k, b, h, d)
+    gf = _fold(g, pad_q, b, h, d)
+    outf = _fold(out, pad_q, b, h, d)
+    # the standard softmax-vjp identity: delta_i = Σ_k P_ik dP_ik
+    #                                            = rowsum(dO * O);
+    # trailing singleton keeps the (1, block_q, 1) blocks Mosaic-legal
+    delta = (gf.astype(jnp.float32) * outf.astype(jnp.float32)).sum(
+        -1, keepdims=True
     )
-    return vjp(g)
+    # padded q rows: m == +inf (and l == 1, not 0 — a 0 would turn the
+    # harmless p into nan, and 0 * nan poisons the accumulators) makes
+    # their softmax weights exactly 0
+    m_pad = jnp.pad(
+        m_res, ((0, 0), (0, pad_q)), constant_values=_INF
+    ).astype(jnp.float32)[..., None]
+    l_pad = jnp.pad(
+        l_res, ((0, 0), (0, pad_q)), constant_values=1.0
+    ).astype(jnp.float32)[..., None]
+
+    nq = qf.shape[1] // block_q
+    nk = kf.shape[1] // block_k
+    common = dict(
+        scale=scale, causal=causal, q_offset=q_offset, k_offset=k_offset,
+        kv_len=tk, block_q=block_q, block_k=block_k,
+    )
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    row_major_q = [
+        pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, num_q=nq, **common),
+        grid=(b * h, nk, nq),
+        in_specs=row_major_q,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+        ],
+        out_shape=(
+            _union_vma_sds((b * h, nk * block_k, d), k.dtype, qf, kf, vf, gf),
+            _union_vma_sds((b * h, nk * block_k, d), v.dtype, qf, kf, vf, gf),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, m_pad, l_pad, delta)
+
+    row_major_k = [
+        pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, num_k=nk, **common),
+        grid=(b * h, nq, nk),
+        in_specs=row_major_k,
+        out_specs=qspec,
+        out_shape=_union_vma_sds(
+            (b * h, nq * block_q, d), q.dtype, qf, kf, vf, gf
+        ),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, m_pad, l_pad, delta)
+
+    return (
+        _unfold(dq, tq, b, h, d),
+        _unfold(dk, tk, b, h, d),
+        _unfold(dv, tk, b, h, d),
+    )
 
 
 _flash_vjp.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _blocks(tq, tk, block_q, block_k):
+    """Clamped block sizes and padding shared by forward and backward
+    (they MUST agree: the backward re-pads the forward's residuals)."""
+    block_q = min(block_q, max(tq, 8))
+    block_k = min(block_k, max(tk, 8))
+    return block_q, block_k, (-tq) % block_q, (-tk) % block_k
+
+
+def _fold(x, pad, b, h, d):
+    """[B, T, H, D] -> [B*H, T(+pad), D]."""
+    x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+
+def _unfold(x, tq, b, h, d):
+    """Inverse of :func:`_fold` (drops the padding)."""
+    return x[:, :tq, :].reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
 def _flash_fwd_impl(
-    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k,
+    interpret, with_lse=False,
 ):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
 
-    block_q = min(block_q, max(tq, 8))
-    block_k = min(block_k, max(tk, 8))
-    pad_q = (-tq) % block_q
-    pad_k = (-tk) % block_k
-
-    # [B, T, H, D] -> [B*H, T, D]
-    def fold(x, pad):
-        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    qf, kf, vf = fold(q, pad_q), fold(k, pad_k), fold(v, pad_k)
+    block_q, block_k, pad_q, pad_k = _blocks(tq, tk, block_q, block_k)
+    qf = _fold(q, pad_q, b, h, d)
+    kf = _fold(k, pad_k, b, h, d)
+    vf = _fold(v, pad_k, b, h, d)
     nq = qf.shape[1] // block_q
     nk = kf.shape[1] // block_k
 
@@ -260,8 +459,29 @@ def _flash_fwd_impl(
         block_q=block_q,
         block_k=block_k,
         num_k=nk,
+        with_lse=with_lse,
     )
-    out = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+    ]
+    # inside shard_map the output varies over the union of the
+    # operands' varying axes; check_vma requires it spelled out
+    out_shape = [
+        _union_vma_sds((b * h, nq * block_q, d), q.dtype, qf, kf, vf),
+    ]
+    if with_lse:
+        for _ in range(2):  # m and l residuals
+            out_specs.append(
+                pl.BlockSpec(
+                    (1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)
+                )
+            )
+            out_shape.append(
+                _union_vma_sds(
+                    (b * h, nq * block_q, 1), jnp.float32, qf, kf, vf
+                )
+            )
+    res = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
         in_specs=[
@@ -269,12 +489,8 @@ def _flash_fwd_impl(
             pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-        # inside shard_map the output varies over the union of the
-        # operands' varying axes; check_vma requires it spelled out
-        out_shape=_union_vma_sds(
-            (b * h, nq * block_q, d), q.dtype, qf, kf, vf
-        ),
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=tuple(out_shape) if with_lse else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -283,5 +499,7 @@ def _flash_fwd_impl(
         interpret=interpret,
     )(qf, kf, vf)
 
-    out = out[:, :tq, :].reshape(b, h, tq, d).transpose(0, 2, 1, 3)
-    return out
+    if with_lse:
+        out, m_res, l_res = res
+        return _unfold(out, tq, b, h, d), m_res[:, :tq, 0], l_res[:, :tq, 0]
+    return _unfold(res, tq, b, h, d)
