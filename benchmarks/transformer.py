@@ -56,6 +56,14 @@ SIZES = {
         batch=8, seq=2048, layers=16, d_model=2048, heads=16,
         kv_heads=16, d_ff=8192, remat=True,
     ),
+    # long-context demonstration: seq 8192 through the blockwise flash
+    # forward+backward with remat — a configuration the dense attention
+    # path cannot run at all on this chip (the [T, T] f32 score
+    # residuals alone exceed HBM)
+    "long": dict(
+        batch=2, seq=8192, layers=12, d_model=1024, heads=16,
+        kv_heads=16, d_ff=4096, remat=True, attn_impl="flash",
+    ),
 }
 
 
@@ -393,6 +401,7 @@ def main(argv=None):
 
     preset = dict(SIZES[args.size]) if args.size else {}
     remat = preset.pop("remat", False) or args.remat
+    preset_attn = preset.pop("attn_impl", None)
 
     def pick(name, default):
         explicit = getattr(args, name)
@@ -413,6 +422,11 @@ def main(argv=None):
         rec = run_decode(prompt=args.prompt, max_len=args.max_len, **kw)
     else:
         impl = args.attn_impl
+        if impl in ("auto", "autotune") and preset_attn:
+            # a preset pin overrides autotune too: `long` forces flash
+            # because the dense autotune leg cannot even compile at
+            # seq 8192 on this chip
+            impl = preset_attn
         if impl == "autotune":
             impl = autotune_attn_impl(
                 batch=kw["batch"], seq=kw["seq"], heads=kw["heads"],

@@ -325,7 +325,8 @@ def _flash_bwd(
     q, k, v, out, m_res, l_res = res
     b, tq, h, d = q.shape
     tk = k.shape[1]
-    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    # scale is a nondiff arg already resolved to a float by
+    # flash_attention before the custom_vjp — no re-defaulting here
     block_q, block_k, pad_q, pad_k = _blocks(tq, tk, block_q, block_k)
 
     qf = _fold(q, pad_q, b, h, d)
