@@ -441,7 +441,8 @@ def _flash_fwd_impl(
 ):
     b, tq, h, d = q.shape
     tk = k.shape[1]
-    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    # scale arrives as a resolved float (flash_attention defaults it
+    # before the custom_vjp) — no re-defaulting here or in _flash_bwd
 
     block_q, block_k, pad_q, pad_k = _blocks(tq, tk, block_q, block_k)
     qf = _fold(q, pad_q, b, h, d)
