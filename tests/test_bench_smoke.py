@@ -5,17 +5,20 @@ come from the driver run.
 """
 
 import json
+import pathlib
 import subprocess
 import sys
 
 import numpy as np
 import pytest
 
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
 
 def _run_cli(*args, timeout=300):
     res = subprocess.run(
-        [sys.executable, "benchmarks/transformer.py", *args],
-        capture_output=True, text=True, timeout=timeout,
+        [sys.executable, str(REPO / "benchmarks" / "transformer.py"), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
     )
     assert res.returncode == 0, (res.stdout, res.stderr)
     # last stdout line is the JSON record
@@ -53,11 +56,22 @@ def test_size_presets_resolve():
         assert rec["seq"] == 128  # 64 * sp(2): the override won
 
 
+def _import_bench():
+    # repo-anchored import: bench.py lives at the repo root, which is
+    # only on sys.path when pytest is invoked from there
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    return bench
+
+
 def test_bench_calibrations_run_on_cpu():
     # the in-run rooflines must execute anywhere (values only mean
     # something on the chip, but a crash here would hang the driver's
     # record)
-    import bench
+    bench = _import_bench()
 
     gbps = bench.hbm_copy_bandwidth(mb=8, chain=2, reps=2)
     assert np.isfinite(gbps) and gbps > 0
@@ -66,7 +80,7 @@ def test_bench_calibrations_run_on_cpu():
 
 
 def test_watchdog_passthrough_and_fallback_callable():
-    from bench import _run_with_watchdog
+    _run_with_watchdog = _import_bench()._run_with_watchdog
 
     # success path returns fn's value and never emits the fallback
     out = _run_with_watchdog(lambda: 42, {"metric": "x"}, 30, "smoke")

@@ -226,6 +226,31 @@ def test_rendezvous_recv_timeout_diagnoses_deadlock(comm1d, monkeypatch):
         np.asarray(spmd_jit(comm1d, fn)(jnp.arange(float(SIZE))))
 
 
+def test_rendezvous_is_forward_only(comm1d):
+    """The documented AD contract (docs/sharp-bits.md): the rendezvous
+    tier has no transpose — differentiating through it fails loudly at
+    TRACE time (so no messages leak into the engine), and routes that
+    must carry gradients use the static trace-time path."""
+
+    def fn(x):
+        r = jax.lax.axis_index("p")
+        tok = m.send(x, (r + 1) % SIZE, comm=comm1d, token=m.create_token())
+        y, _ = m.recv(x, source=m.ANY_SOURCE, comm=comm1d, token=tok)
+        return (y ** 2).sum()
+
+    g = jax.grad(
+        lambda x: jax.shard_map(
+            fn, mesh=comm1d.mesh, in_specs=jax.P("p"), out_specs=jax.P()
+        )(x)
+    )
+    # pin the CONTRACT, not jax's wording: differentiation fails with
+    # some trace-time exception (currently "IO callbacks do not support
+    # JVP") and, critically, no message ever reached the engine
+    with pytest.raises(Exception):
+        np.asarray(g(jnp.arange(float(SIZE))))
+    assert engine().pending_count() == 0  # trace-time failure: no leaks
+
+
 def test_static_path_still_trace_matches(comm1d):
     """A static send/recv pair must keep using the zero-cost trace-time
     path — nothing may reach the engine."""
