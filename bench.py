@@ -212,9 +212,10 @@ def transformer_large_mfu(fallback_record, timeout=1200):
     remat = cfg.pop("remat", False)
 
     def job():
+        # (the probe clamps its own batch to 8 — see autotune_attn_impl)
         impl = autotune_attn_impl(
-            batch=cfg["batch"], seq=cfg["seq"], heads=cfg["heads"],
-            head_dim=cfg["d_model"] // cfg["heads"],
+            batch=cfg["batch"], seq=cfg["seq"],
+            heads=cfg["heads"], head_dim=cfg["d_model"] // cfg["heads"],
         )
         return run(
             bf16=True, batches=6, remat=remat, attn_impl=impl, **cfg

@@ -46,14 +46,15 @@ SIZES = {
         d_ff=2048,
     ),
     # compute-bound configuration for the MFU demonstration: ~940M
-    # params, d_model 2048, seq 2048, remat'd layers.  6·N·tokens
-    # FLOPs dominate HBM traffic and per-token overheads (CE/embed) at
-    # this size, so the step lands on the MXU roofline instead of the
-    # bandwidth one — measured 75.8 TFLOP/s (38.5% nameplate MFU) on
-    # the virtualised v5e slice; note the remat overhead (~8N actual vs
-    # the 6N convention) puts true MXU throughput ~1/3 higher still.
+    # params, d_model 2048, seq 2048, batch 16, remat'd layers.
+    # 6·N·tokens FLOPs dominate HBM traffic and per-token overheads
+    # (CE/embed) at this size, so the step lands on the MXU roofline
+    # instead of the bandwidth one — measured 97.7 TFLOP/s (49.6%
+    # nameplate MFU) with the autotuned flash fwd+bwd on the
+    # virtualised v5e slice; the remat overhead (~8N actual vs the 6N
+    # convention) puts true MXU throughput ~1/3 higher still.
     "large": dict(
-        batch=8, seq=2048, layers=16, d_model=2048, heads=16,
+        batch=16, seq=2048, layers=16, d_model=2048, heads=16,
         kv_heads=16, d_ff=8192, remat=True,
     ),
     # long-context demonstration: seq 8192 through the blockwise flash
@@ -81,13 +82,18 @@ def autotune_attn_impl(batch=8, seq=2048, heads=16, head_dim=64, chain=4,
     at the bench shape and return the faster impl name.
 
     The Pallas flash kernel and XLA's fused dense attention trade
-    places depending on runtime (on the tunnelled/virtualised chip XLA
-    currently wins ~2x; on dedicated hardware flash should win at long
-    sequence) — measuring is cheaper than guessing, and the big config
-    then compiles once with the winner.  Returns "auto" off-TPU or on
-    any failure.
+    places depending on phase/shape — measuring is cheaper than
+    guessing, and the big config then compiles once with the winner.
+    Returns "auto" off-TPU or on any failure.
+
+    The probe batch is clamped to 8 regardless of the caller's: the
+    flash/dense ratio is batch-invariant, and the dense leg's [T, T]
+    score residuals at larger batches can OOM the probe before it
+    measures anything.
     """
     import time as _time
+
+    batch = min(batch, 8)
 
     import jax
     import jax.numpy as jnp
