@@ -31,8 +31,6 @@ tier keeps serving true cross-process MPMD.
 import os
 import threading
 
-import numpy as np
-
 ANY = -1  # matches ops._core.ANY_SOURCE / ANY_TAG
 
 
