@@ -39,6 +39,19 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--micro", type=int, default=2, help="pp microbatches")
     p.add_argument(
+        "--routing", choices=("expert_choice", "topk"),
+        default="expert_choice",
+        help="moe routing scheme (topk = GShard/Switch token choice)",
+    )
+    p.add_argument(
+        "--aux-weight", type=float, default=0.0,
+        help="Switch load-balancing loss weight (topk routing)",
+    )
+    p.add_argument(
+        "--z-weight", type=float, default=0.0,
+        help="ST-MoE router z-loss weight (typical 1e-3)",
+    )
+    p.add_argument(
         "--checkpoint", default=None, metavar="DIR",
         help="save params every --checkpoint-every steps; a rerun with "
         "the same DIR resumes from the latest step bit-identically",
@@ -104,6 +117,8 @@ def main(argv=None):
             cfg = moe.MoEConfig(
                 vocab=64, d_model=32, layers=2, heads=4, kv_heads=2,
                 head_dim=8, experts=4 * sp.size, d_ff=64,
+                routing=args.routing, aux_weight=args.aux_weight,
+                z_weight=args.z_weight,
             )
             params = moe.init_params(jax.random.PRNGKey(0), cfg)
             step = moe.make_global_train_step(mesh, dp, tp, sp, cfg, lr=3e-1)
@@ -178,6 +193,20 @@ def main(argv=None):
     if val is not None:
         print(f"loss {loss0:.4f} -> {val:.4f}")
         assert start > 0 or val < loss0, "training did not reduce the loss"
+
+    if args.mode != "moe" and (
+        args.routing != "expert_choice" or args.aux_weight or args.z_weight
+    ):
+        print("--routing/--aux-weight/--z-weight apply to --mode moe only")
+    if args.mode == "moe" and args.routing == "topk":
+        # router-quality diagnostics on the trained weights (§5.5):
+        # per-expert load, unweighted balance/z losses, dropped tokens
+        rep = moe.routing_report(params, tokens, cfg, dp.size, sp.size)
+        load = ", ".join(f"{v:.3f}" for v in np.asarray(rep["load"]))
+        print(
+            f"router: load [{load}]  balance {rep['balance_loss']:.3f}  "
+            f"z {rep['z_loss']:.3f}  dropped {rep['dropped_fraction']:.3f}"
+        )
 
     if args.generate and args.mode != "dense":
         print("--generate is only supported with --mode dense; skipping")
