@@ -79,6 +79,21 @@ def test_bench_calibrations_run_on_cpu():
     assert np.isfinite(tflops) and tflops > 0
 
 
+def test_single_emitter_contract(capsys):
+    # every exit path (phase bails, global deadline, final print) goes
+    # through one gate: exactly ONE json record ever reaches stdout
+    bench = _import_bench()
+    bench._emit_state["done"] = False
+    try:
+        assert bench._emit_record({"m": 1}) is True
+        assert bench._emit_record({"m": 2}) is False  # loser no-ops
+        assert bench._emit_record(lambda: {"m": 3}) is False
+    finally:
+        bench._emit_state["done"] = False
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert out == ['{"m": 1}']
+
+
 def test_watchdog_passthrough_and_fallback_callable():
     _run_with_watchdog = _import_bench()._run_with_watchdog
 
