@@ -211,6 +211,24 @@ def _is_runtime_rank(spec):
     return isinstance(spec, jax.core.Tracer)
 
 
+def _check_tag(tag, rendezvous_ok):
+    """Tags are static on the trace-time matching paths (matching keys on
+    the value); the rendezvous tier accepts traced tags (they ride the
+    io_callback operands).  ADVICE r3: a traced tag used to fall through
+    to a generic concretization error."""
+    if _is_runtime_rank(tag):
+        if rendezvous_ok:
+            return tag
+        raise TypeError(
+            "tag must be a static (trace-time) integer here: trace-time "
+            "send/recv matching keys on the tag value. A traced "
+            "(runtime-valued) tag is supported only on the mesh backend's "
+            "rendezvous tier — a send with a traced dest, or a recv with "
+            "a traced source or source=ANY_SOURCE."
+        )
+    return check_static_int(tag, "tag")
+
+
 def _rendezvous_send(x, dest, tag, comm, token):
     """Mesh send with a runtime destination: post the local shard to the
     host matching engine (ops/_rendezvous.py) via io_callback."""
@@ -224,22 +242,33 @@ def _rendezvous_send(x, dest, tag, comm, token):
     size = comm.size
     token, (x,) = fence_in(token, x)
 
-    def post_cb(rank_v, dest_v, payload, stamp):
+    def post_cb(rank_v, dest_v, tag_v, payload, stamp):
         dest_i = int(dest_v)
         if not 0 <= dest_i < size:
             raise RuntimeError(
                 f"rendezvous send: dest={dest_i} out of range for "
                 f"communicator of size {size} (runtime-valued dest)"
             )
+        tag_i = int(tag_v)
+        if tag_i < 0:
+            # a computed tag that lands on -1 would otherwise become the
+            # ANY wildcard in the engine — silent mismatched delivery
+            raise RuntimeError(
+                f"rendezvous send: tag={tag_i} is negative (runtime-"
+                "valued tags must be >= 0; wildcards are recv-only)"
+            )
         engine().post(
-            key, int(rank_v), dest_i, int(tag), np.asarray(payload).copy()
+            key, int(rank_v), dest_i, tag_i, np.asarray(payload).copy()
         )
         return np.asarray(stamp)
 
+    # tag rides the operands, not the closure: a traced (runtime-valued)
+    # tag is then just as legal as a runtime dest (ADVICE r3 — a closure
+    # int(tag) on a tracer died with a generic concretization error)
     stamp = io_callback(
         post_cb,
         jax.ShapeDtypeStruct((), np.float32),
-        comm.rank(), dest, x, token.stamp,
+        comm.rank(), dest, jnp.int32(tag), x, token.stamp,
         ordered=False,
     )
     return token.with_stamp(promote_vma(stamp, comm.axes))
@@ -268,9 +297,20 @@ def _rendezvous_recv(x, source, tag, comm, token, status):
 
     shape, dtype = tuple(x.shape), x.dtype
 
-    def take_cb(rank_v, want_v, stamp):
+    tag_is_traced = _is_runtime_rank(tag)
+
+    def take_cb(rank_v, want_v, tag_v, stamp):
+        tag_i = int(tag_v)
+        if tag_is_traced and tag_i < 0:
+            # only the STATIC ANY_TAG constant may wildcard: a computed
+            # traced tag that evaluates to -1 is a bug, not a wildcard
+            raise RuntimeError(
+                f"rendezvous recv on rank {int(rank_v)}: runtime-valued "
+                f"tag={tag_i} is negative (pass the static ANY_TAG "
+                "constant for a wildcard)"
+            )
         payload, src, tg = engine().take(
-            key, int(rank_v), int(want_v), int(tag)
+            key, int(rank_v), int(want_v), tag_i
         )
         payload = np.asarray(payload)
         if payload.shape != shape or payload.dtype != np.dtype(dtype):
@@ -289,7 +329,7 @@ def _rendezvous_recv(x, source, tag, comm, token, status):
             jax.ShapeDtypeStruct((), np.int32),
             jax.ShapeDtypeStruct((), np.float32),
         ),
-        comm.rank(), want, token.stamp,
+        comm.rank(), want, jnp.int32(tag), token.stamp,
         ordered=False,
     )
     y = promote_vma(y, comm.axes)
@@ -314,11 +354,11 @@ def send(x, dest, tag=0, *, comm=None, token=None):
     """
     comm = check_comm(comm)
     token = as_token(token)
-    tag = check_static_int(tag, "tag")
     x = jnp.asarray(x)
     if comm.backend == "proc":
         from mpi4jax_tpu.ops import _proc
 
+        tag = check_static_int(tag, "tag")
         dest = check_static_int(dest, "dest")
         if not 0 <= dest < comm.size:
             raise ValueError(
@@ -330,7 +370,8 @@ def send(x, dest, tag=0, *, comm=None, token=None):
     if comm.backend == "mesh" and _is_runtime_rank(dest):
         # data-dependent destination: only the host rendezvous tier can
         # route it (trace-time matching needs a static pattern)
-        return _rendezvous_send(x, dest, tag, comm, token)
+        return _rendezvous_send(x, dest, _check_tag(tag, True), comm, token)
+    tag = _check_tag(tag, False)
     pairs = _resolve_pairs(dest, comm.size, "dest")
     _validate_perm(pairs, comm.size, "send dest")
     meta = PendingSendMeta(
@@ -355,11 +396,11 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
     """
     comm = check_comm(comm)
     token = as_token(token)
-    tag = check_static_int(tag, "tag")
     x = jnp.asarray(x)
     if comm.backend == "proc":
         from mpi4jax_tpu.ops import _proc
 
+        tag = check_static_int(tag, "tag")
         source = check_static_int(source, "source")
         if source != ANY_SOURCE and not 0 <= source < comm.size:
             raise ValueError(
@@ -370,13 +411,20 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
         if status is not None:
             _deliver_status(status, st)
         return y, token.with_stamp(stamp)
-    if comm.backend == "mesh" and _is_runtime_rank(source):
-        # runtime-valued source: no static pattern to match against
-        return _rendezvous_recv(x, source, tag, comm, token, status)
-    want_pairs = None
     source_is_any = (
         isinstance(source, (int, np.integer)) and int(source) == ANY_SOURCE
     )
+    if comm.backend == "mesh" and (
+        _is_runtime_rank(source) or (_is_runtime_rank(tag) and source_is_any)
+    ):
+        # runtime-valued source (no static pattern to match against) or
+        # a traced tag (trace-time matching cannot key on it): match at
+        # execution time in the host engine
+        return _rendezvous_recv(
+            x, source, _check_tag(tag, True), comm, token, status
+        )
+    tag = _check_tag(tag, False)
+    want_pairs = None
     if not source_is_any:
         want_pairs = frozenset(
             _validate_perm(

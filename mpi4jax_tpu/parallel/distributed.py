@@ -176,16 +176,25 @@ def two_tier_allreduce(x, op, intra, inter, *, token=None):
         y, _tok = allreduce(v, op, comm=intra)
         return y
 
+    n_shards = intra.size
+    if x.shape[0] % n_shards:
+        raise ValueError(
+            f"two_tier_allreduce: x.shape[0]={x.shape[0]} must be divisible "
+            f"by the intra communicator's size ({n_shards}) — the leading "
+            "dim is sharded over the intra mesh axes"
+        )
     slice_red = jax.jit(
         jax.shard_map(local, mesh=intra.mesh, in_specs=spec, out_specs=spec)
     )(x)
-    # every dim-0 row now holds the slice partial (P(axes) shards dim 0
-    # over ALL the mesh axes jointly); stage row 0 to the host for the
-    # DCN hop (the proc tier's wire is host-side anyway, and an eager
-    # multi-device-committed operand would otherwise drag the
+    # after the intra allreduce every shard position along dim 0 holds the
+    # SAME reduced block of shape (x.shape[0] // n_shards, ...); stage one
+    # full block (not just row 0 — shards may hold several rows) to the
+    # host for the DCN hop (the proc tier's wire is host-side anyway, and
+    # an eager multi-device-committed operand would otherwise drag the
     # side-effecting FFI call through the SPMD partitioner)
     import numpy as np
 
-    partial = np.asarray(jax.device_get(slice_red[0]))
+    block = x.shape[0] // n_shards
+    partial = np.asarray(jax.device_get(slice_red[:block]))
     world, token = allreduce(partial, op, comm=inter, token=token)
-    return jnp.broadcast_to(world, x.shape), token
+    return jnp.tile(jnp.asarray(world), (n_shards,) + (1,) * (x.ndim - 1)), token

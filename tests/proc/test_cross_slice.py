@@ -33,26 +33,31 @@ mesh = jax.make_mesh(
 )
 intra = m.MeshComm.from_mesh(mesh)    # ICI tier
 
-# slice r's chip c holds row filled with 100*r + c: every value in the
-# world is distinct, and other slices' rows carry offsets this slice
-# cannot produce locally
-x = (jnp.arange(float(CHIPS)) + 100.0 * rank)[:, None] * jnp.ones((1, 3))
+# slice r's chip c holds TWO rows (multi-row shards — ADVICE r3 medium)
+# filled with 100*r + c and 100*r + c + 0.5: every value in the world is
+# distinct, and other slices' rows carry offsets this slice cannot
+# produce locally
+base = jnp.repeat(jnp.arange(float(CHIPS)), 2) + 100.0 * rank
+x = (base + jnp.tile(jnp.array([0.0, 0.5]), CHIPS))[:, None] * jnp.ones((1, 3))
 
 world, tok = two_tier_allreduce(x, m.SUM, intra, inter)
 
-vals = np.concatenate(
-    [np.arange(float(CHIPS)) + 100.0 * r for r in range(nslices)]
+# dense oracle: block position p sums the p-th row of every chip's shard
+# on every slice, then the result tiles over the CHIPS shard positions
+per_chip = np.stack(
+    [np.array([c, c + 0.5]) + 100.0 * r
+     for r in range(nslices) for c in range(CHIPS)]
 )
-want = vals.sum()                      # dense oracle over every chip
+want = np.tile(per_chip.sum(axis=0), CHIPS)[:, None] * np.ones((1, 3))
 got = np.asarray(world)
 assert got.shape == x.shape, got.shape
-assert np.allclose(got, want), (got, want)
+assert np.allclose(got, want), (got[:, 0], want[:, 0])
 
 # the slice-local partial differs per host: matching the oracle PROVES
 # the DCN hop carried the other slices' contributions
-local_only = float(np.asarray(x[:, 0]).sum())
-assert not np.isclose(want, local_only)
-print(f"rank {rank} cross-slice allreduce ok ({local_only} -> {want})")
+local_only = np.asarray(x[:, 0]).reshape(CHIPS, 2).sum(axis=0)
+assert not np.allclose(np.tile(local_only, CHIPS), want[:, 0])
+print(f"rank {rank} cross-slice allreduce ok ({local_only} -> {want[0, 0]})")
 """
 
 

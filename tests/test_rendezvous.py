@@ -197,6 +197,32 @@ def test_runtime_source_specific_rank(comm1d):
     np.testing.assert_array_equal(out[:, 3], (np.arange(8) - 1) % SIZE)
 
 
+def test_runtime_traced_tag(comm1d):
+    """A TRACED (runtime-valued) tag on the rendezvous tier (ADVICE r3:
+    this used to die with a generic concretization error from an
+    ``int(tag)`` in the callback closure).  Each rank sends with tag =
+    its own rank; the receiver asks for the tag its expected sender
+    carries, so matching must use the runtime tag value."""
+    shift = 2
+
+    def fn(x):
+        r = jax.lax.axis_index("p")
+        tok = m.create_token()
+        tok = m.send(x, (r + shift) % SIZE, tag=r, comm=comm1d, token=tok)
+        st = m.Status()
+        y, tok = m.recv(
+            x, source=m.ANY_SOURCE, tag=(r - shift) % SIZE,
+            comm=comm1d, token=tok, status=st,
+        )
+        return y[0], st.tag.astype(jnp.float32)
+
+    x = jnp.arange(float(SIZE))
+    f = spmd_jit(comm1d, lambda v: jnp.stack(fn(v)).reshape(1, 2))
+    out = np.asarray(f(x)).reshape(SIZE, 2)
+    np.testing.assert_array_equal(out[:, 0], np.roll(np.arange(8.0), shift))
+    np.testing.assert_array_equal(out[:, 1], (np.arange(8) - shift) % SIZE)
+
+
 def test_runtime_dest_out_of_range_fails_loudly(comm1d):
     def fn(x):
         r = jax.lax.axis_index("p")
