@@ -1,0 +1,141 @@
+"""DCN-bridge bus bandwidth: allreduce over N OS processes (the proc
+tier — one process per rank, data over the native C++ transport in
+native/src/dcn.cc).
+
+This is the loopback analog of the reference's ``mpirun -np N`` tier,
+where libmpi's shm BTL moves intra-host traffic through shared memory
+(the reference gets that for free: mpi_xla_bridge.pyx:149-167 just
+calls MPI_Allreduce).  Run under the launcher:
+
+    python -m mpi4jax_tpu.launch -np 8 benchmarks/proc_busbw.py \
+        [--mb 64] [--reps 10] [--op allreduce]
+
+Rank 0 prints one JSON line: NCCL-convention bus bandwidth
+(``bytes * 2*(n-1)/n / t`` for allreduce).
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=float, default=64.0)
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--op", default="allreduce",
+                    choices=["allreduce", "allgather", "alltoall"])
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+
+    comm = m.get_default_comm()
+    assert comm.backend == "proc", "run under python -m mpi4jax_tpu.launch"
+    n = comm.size
+    rank = comm.rank()
+
+    per = int(args.mb * 1e6 / 4)
+    per -= per % max(n, 1)
+    x = jnp.ones((per,), jnp.float32)
+    nbytes = per * 4
+
+    def call(v, tok):
+        if args.op == "allreduce":
+            return m.allreduce(v, m.SUM, comm=comm, token=tok)
+        if args.op == "allgather":
+            y, tok = m.allgather(v, comm=comm, token=tok)
+            return y[0], tok
+        blk = v.reshape(n, -1)
+        y, tok = m.alltoall(blk, comm=comm, token=tok)
+        return y.reshape(v.shape), tok
+
+    # warm (compile + first-touch of transport buffers)
+    tok = m.create_token()
+    y, tok = call(x, tok)
+    np.asarray(y)
+
+    best = float("inf")
+    for _ in range(3):
+        tok = m.barrier(comm=comm, token=tok)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            y, tok = call(x, tok)
+        np.asarray(y)  # materialise: all reps done
+        dt = (time.perf_counter() - t0) / args.reps
+        best = min(best, dt)
+
+    # NCCL-tests algorithmic factors relative to the PER-RANK payload:
+    # allgather receives n-1 peer blocks per rank, so its busbw is
+    # send_bytes*(n-1)/t; alltoall ships (n-1)/n of the send buffer
+    factor = {
+        "allreduce": 2 * (n - 1) / n,
+        "allgather": float(n - 1),
+        "alltoall": (n - 1) / n,
+    }[args.op]
+    busbw = nbytes * factor / best
+
+    rec = {
+        "metric": f"{args.op}_busbw_proc{n}",
+        "value": round(busbw / 1e9, 3),
+        "unit": "GB/s",
+        "nprocs": n,
+        "payload_mb": nbytes / 1e6,
+        "sec_per_call": round(best, 6),
+    }
+    if rank == 0 and args.op == "allreduce":
+        # In-run machine-relative ceiling (the same calibration pattern
+        # as bench.py's HBM probe): the shm arena must move
+        # (5n+1)*S bytes of memory traffic per S-byte allreduce
+        # (n stage-in copies, an (n+1)-stream fold, n copy-outs — see
+        # docs/performance.md), and every byte moves through however
+        # many cores the host gives the job.  With C = measured
+        # single-core copy rate (payload bytes/s, i.e. traffic/2) and
+        # k = cores available, ceiling busbw = 2C*k*factor/(5n+1).
+        copy_gbps = _copy_rate_gbps()
+        cores = _cores()
+        ceiling = 2 * copy_gbps * min(cores, n) * factor / (5 * n + 1)
+        rec["single_core_copy_gbps"] = round(copy_gbps, 2)
+        rec["cores_available"] = cores
+        rec["ceiling_gbps"] = round(ceiling, 3)
+        rec["pct_of_ceiling"] = round(100 * busbw / 1e9 / ceiling, 1)
+    if rank == 0:
+        print(json.dumps(rec), flush=True)
+
+
+def _cores():
+    import os
+
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:
+        return os.cpu_count() or 1
+
+
+def _copy_rate_gbps():
+    """Measured copy payload rate (GB/s) of one core, cold-ish buffers
+    — the primitive every arena phase is built from."""
+    import numpy as np
+
+    src = np.random.default_rng(0).random((16 << 20) // 8)  # 16 MB of f64
+    dst = np.empty_like(src)
+    np.copyto(dst, src)  # warm page tables
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        best = min(best, time.perf_counter() - t0)
+    return src.nbytes / best / 1e9
+
+
+if __name__ == "__main__":
+    main()

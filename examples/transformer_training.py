@@ -36,6 +36,11 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--mode", choices=("dense", "moe", "pp"), default="dense")
+    p.add_argument(
+        "--schedule", choices=("gpipe", "1f1b"), default="gpipe",
+        help="pipeline schedule for --mode pp (1f1b = interleaved "
+        "fwd/bwd, bounded activation memory)",
+    )
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--micro", type=int, default=2, help="pp microbatches")
     p.add_argument(
@@ -140,11 +145,15 @@ def main(argv=None):
         )
         params = ppt.init_params(jax.random.PRNGKey(0), cfg)
         step = ppt.make_global_train_step(
-            mesh, dp, pp, cfg, n_micro=args.micro, lr=3e-1
+            mesh, dp, pp, cfg, n_micro=args.micro, lr=3e-1,
+            schedule=args.schedule,
         )
         b = 2 * args.micro * dp_n
         s = 16
-        label = f"mesh ({dp_n}, {pp_n}) (dp x pp), {args.micro} microbatches"
+        label = (
+            f"mesh ({dp_n}, {pp_n}) (dp x pp), {args.micro} microbatches, "
+            f"{args.schedule} schedule"
+        )
 
     tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, cfg.vocab)
     batch = (tokens, jnp.roll(tokens, -1, axis=1))

@@ -72,6 +72,11 @@ def main(argv=None):
 
     n = args.nprocs
     coord = f"127.0.0.1:{_free_port()}"
+    # unique job id: namespaces the bridge's same-host shm segments so
+    # concurrent/successive jobs can never collide on stale segments
+    import uuid
+
+    job = uuid.uuid4().hex[:12]
     procs = []
     for rank in range(n):
         env = dict(os.environ)
@@ -80,6 +85,7 @@ def main(argv=None):
             T4J_SIZE=str(n),
             T4J_COORD=coord,
             T4J_PLATFORM=args.platform,
+            T4J_JOB=job,
         )
         if args.shims:
             from mpi4jax_tpu import shims

@@ -2,19 +2,32 @@
 
 The reference names the ring step (`sendrecv` to rank±1) as its "PP
 building block" and prescribes "PP microbatch loops in `lax.scan`"
-(SURVEY §2.4).  This module delivers that block as a working schedule:
-a GPipe-style pipeline where each rank of a ``pp`` communicator owns
-one stage, activations hand off along the chain via :func:`sendrecv`
-(one `ppermute` per tick on ICI), and the microbatch loop is a single
-``lax.scan`` — so the whole pipeline, bubbles and all, is one XLA
-executable.  Reverse-mode differentiation works end to end: the
-transpose of the forward handoff is the backward handoff in the
-opposite direction (the reference's sendrecv transpose contract,
-sendrecv.py:366-385).
+(SURVEY §2.4).  This module delivers that block as two working
+schedules, both running the microbatch loop as a single ``lax.scan``
+(the whole pipeline, bubbles and all, is one XLA executable) with
+activations handed off along the chain via :func:`sendrecv` (one ICI
+``ppermute`` per tick):
 
-Schedule: with S stages and M microbatches, the scan runs T = M + S - 1
-ticks.  At tick t, stage s computes microbatch (t - s) when that index
-is valid; invalid (bubble) slots compute on zeros and are masked out.
+* **GPipe** (:func:`pipeline_apply`): forward-only schedule;
+  reverse-mode AD transposes the scan, so the executed program is
+  all-forwards-then-all-backwards and the scan residuals stash every
+  microbatch's activations (O(M) memory).  The transpose of the
+  forward handoff is the backward handoff in the opposite direction
+  (the reference's sendrecv transpose contract, sendrecv.py:366-385).
+* **1F1B** (:func:`pipeline_train`): the production schedule — each
+  steady-state tick runs one forward AND one backward microbatch per
+  stage, cotangents flowing upstream on a second ``sendrecv`` wire.
+  The backward is built manually (per-stage ``jax.vjp`` with
+  forward recompute, i.e. remat), so in-flight activations are bounded
+  by the ring stash of ``min(M, 2S-1)`` microbatch *inputs* instead of
+  GPipe's M× per-layer residuals.
+
+GPipe tick math: T = M + S - 1 ticks; at tick t, stage s computes
+microbatch (t - s) when valid.  1F1B tick math: T = M + 2(S-1) ticks;
+at tick t stage s forwards microbatch ``t - s`` and backwards
+microbatch ``t - (2(S-1) - s)`` (the last stage backwards a microbatch
+in the same tick it forwards it — the loss cotangent is local).
+Invalid (bubble) slots compute on stashed/zero data and are masked out.
 """
 
 import jax
@@ -24,7 +37,7 @@ from jax import lax
 from mpi4jax_tpu.ops._core import as_token, promote_vma, vma_of
 from mpi4jax_tpu.ops.p2p import sendrecv
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_train"]
 
 
 def pipeline_apply(stage_fn, stage_params, microbatches, comm, *, token=None):
@@ -138,3 +151,206 @@ def pipeline_apply(stage_fn, stage_params, microbatches, comm, *, token=None):
         jnp.arange(n_micro + n_stages - 1),
     )
     return outputs, token
+
+
+def _carry_axes_for(comm, *trees):
+    """Union of the comm's axes and any varying axes the inputs carry
+    from an enclosing mesh (shared by both schedules)."""
+    axes = list(comm.axes)
+    for leaf in jax.tree.leaves(trees):
+        for ax in vma_of(leaf) or ():
+            if ax not in axes:
+                axes.append(ax)
+    return tuple(axes)
+
+
+def pipeline_train(
+    stage_fn, stage_params, head_fn, head_params, microbatches, extras,
+    comm, *, token=None,
+):
+    """1F1B pipeline schedule with a manually built backward.
+
+    The production schedule (Megatron/PipeDream-flush): after warmup,
+    every tick runs one forward AND one backward microbatch per stage,
+    so at most ``2S-1`` microbatch inputs are in flight per stage —
+    GPipe (``jax.grad`` over :func:`pipeline_apply`) stashes all ``M``
+    microbatches' per-layer residuals instead.  The backward recomputes
+    each stage's forward from the stashed input (``jax.vjp``), i.e.
+    rematerialisation is built into the schedule.
+
+    Tick math (S stages, M microbatches, T = M + 2(S-1) ticks): stage
+    ``s`` forwards microbatch ``t - s`` and backwards microbatch
+    ``t - (2(S-1) - s)``.  The last stage backwards a microbatch in the
+    tick it forwards it (the loss cotangent is local); cotangents for
+    earlier stages ride an upstream ``sendrecv`` wire, one tick behind
+    the downstream stage's backward — the explicit form of the
+    reference's "gradients travel the reverse network direction"
+    contract (sendrecv.py:366-385).
+
+    Args:
+      stage_fn: ``(stage_params, a) -> a`` shape/dtype-preserving stage.
+      stage_params: this rank's stage parameters (pp-sharded pytree).
+      head_fn: ``(head_params, a, extra) -> scalar`` per-microbatch loss
+        head, applied to the LAST stage's output (other ranks compute it
+        masked — the SPMD program is uniform).
+      head_params: loss-head parameters (replicated pytree).
+      microbatches: ``(M, mb, ...)`` inputs; only stage 0 reads them.
+      extras: ``(M, ...)`` pytree of per-microbatch loss inputs (e.g.
+        targets), indexed at the last stage.
+      comm: single-axis MeshComm; rank = stage index.
+
+    Returns ``(loss_sum, d_stage_params, d_head_params, d_microbatches,
+    token)``: the SUM over microbatches of the per-microbatch losses and
+    its gradients (divide by M for the mean).  ``d_head_params`` is
+    nonzero only on the last stage and ``d_microbatches`` only on stage
+    0 — psum over the pp axis (which shard_map does automatically for
+    replicated outputs) adds zeros from the other stages.
+    """
+    token = as_token(token)
+    if len(comm.axes) != 1:
+        raise ValueError("pipeline_train needs a single-axis communicator")
+    n_stages = comm.size
+    n_micro = microbatches.shape[0]
+    rank = comm.rank()
+    mb_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+
+    fwd = [(r, r + 1) for r in range(n_stages - 1)]  # activations s -> s+1
+    bwd = [(r + 1, r) for r in range(n_stages - 1)]  # cotangents s+1 -> s
+
+    out_sd = jax.eval_shape(
+        stage_fn, stage_params, jax.ShapeDtypeStruct(mb_shape, dtype)
+    )
+    if out_sd.shape != mb_shape or out_sd.dtype != dtype:
+        raise ValueError(
+            "pipeline_train requires shape/dtype-preserving stages, got "
+            f"{mb_shape}/{dtype} -> {out_sd.shape}/{out_sd.dtype}"
+        )
+
+    stash_k = min(n_micro, 2 * n_stages - 1)
+    is_first = rank == 0
+    is_last = rank == n_stages - 1
+    lag = 2 * (n_stages - 1)  # bwd of mb i at stage s runs at i + lag - s
+
+    carry_axes = _carry_axes_for(
+        comm, microbatches, extras, stage_params, head_params
+    )
+    # Both param trees must be DEVICE-VARYING before the per-tick vjps:
+    # differentiating wrt an unvarying (replicated-over-some-axis) input
+    # makes jax's replication rule psum the cotangent across that axis —
+    # which would mix every stage's head-vjp of its *mid-pipeline*
+    # activations into the last stage's gradient, and silently pre-sum
+    # stage grads over any enclosing data-parallel axis the caller then
+    # double-counts.  Varying params keep every vjp local: ALL returned
+    # gradients are strictly per-device, and the caller owns every
+    # cross-device reduction (psum over pp adds zeros from the masked
+    # stages; psum over dp sums the groups).
+    head_params, stage_params = jax.tree.map(
+        lambda x: promote_vma(jnp.asarray(x), carry_axes),
+        (head_params, stage_params),
+    )
+
+    def tick(carry, t):
+        (incoming_a, incoming_g, stash, loss_acc, d_stage, d_head,
+         d_mbs, token) = carry
+
+        # ---- forward slot: microbatch f = t - rank
+        f_idx = t - rank
+        f_valid = (f_idx >= 0) & (f_idx < n_micro)
+        f_safe = jnp.clip(f_idx, 0, n_micro - 1)
+        x0 = lax.dynamic_index_in_dim(
+            microbatches, f_safe, keepdims=False
+        ).astype(dtype)
+        a_in = jnp.where(is_first, x0, incoming_a)
+        a_out = stage_fn(stage_params, a_in)
+        a_out = jnp.where(f_valid, a_out, jnp.zeros_like(a_out))
+        # masked write: during drain, invalid fwd slots must not clobber
+        # the stash entry a still-pending backward will read
+        stash_slot = f_safe % stash_k
+        prev_entry = lax.dynamic_index_in_dim(
+            stash, stash_slot, keepdims=False
+        )
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(f_valid, a_in, prev_entry), stash_slot, 0
+        )
+
+        # loss head on this tick's forward (meaningful on the last
+        # stage; the cotangent seeds the SAME tick's backward there)
+        extra_f = jax.tree.map(
+            lambda e: lax.dynamic_index_in_dim(e, f_safe, keepdims=False),
+            extras,
+        )
+        loss_mb, head_vjp = jax.vjp(head_fn, head_params, a_out, extra_f)
+        seed = promote_vma(
+            jnp.ones((), loss_mb.dtype), vma_of(loss_mb) or ()
+        )
+        d_head_mb, g_self, _ = head_vjp(seed)
+        take_loss = f_valid & is_last
+        loss_acc = loss_acc + jnp.where(take_loss, loss_mb, 0.0)
+        d_head = jax.tree.map(
+            lambda acc, g: acc + jnp.where(take_loss, g, jnp.zeros_like(g)),
+            d_head, d_head_mb,
+        )
+
+        # ---- backward slot: microbatch b = t - (lag - rank)
+        b_idx = t - (lag - rank)
+        b_valid = (b_idx >= 0) & (b_idx < n_micro)
+        b_safe = jnp.clip(b_idx, 0, n_micro - 1)
+        a_stash = lax.dynamic_index_in_dim(
+            stash, b_safe % stash_k, keepdims=False
+        )
+        # remat: rebuild this stage's vjp at the stashed input
+        _, stage_vjp = jax.vjp(stage_fn, stage_params, a_stash)
+        g_out = jnp.where(is_last, g_self, incoming_g)
+        d_stage_mb, d_a_in = stage_vjp(g_out.astype(out_sd.dtype))
+        d_stage = jax.tree.map(
+            lambda acc, g: acc + jnp.where(b_valid, g, jnp.zeros_like(g)),
+            d_stage, d_stage_mb,
+        )
+        d_a_in = jnp.where(b_valid, d_a_in, jnp.zeros_like(d_a_in))
+        d_mbs = lax.dynamic_update_index_in_dim(
+            d_mbs,
+            jnp.where(
+                b_valid & is_first,
+                d_a_in,
+                lax.dynamic_index_in_dim(d_mbs, b_safe, keepdims=False),
+            ),
+            b_safe,
+            0,
+        )
+
+        # ---- wires: activations downstream, cotangents upstream
+        if fwd:
+            incoming_a, token = sendrecv(
+                a_out, jnp.zeros_like(a_out), source=fwd, dest=fwd,
+                comm=comm, token=token,
+            )
+            incoming_g, token = sendrecv(
+                d_a_in, jnp.zeros_like(d_a_in), source=bwd, dest=bwd,
+                comm=comm, token=token,
+            )
+        else:
+            incoming_a, incoming_g = a_out, d_a_in
+        return (
+            (incoming_a, incoming_g, stash, loss_acc, d_stage, d_head,
+             d_mbs, token),
+            None,
+        )
+
+    def dev0(x):
+        return promote_vma(jnp.zeros(x.shape, x.dtype), carry_axes)
+
+    carry0 = (
+        dev0(jax.ShapeDtypeStruct(mb_shape, dtype)),           # incoming_a
+        dev0(jax.ShapeDtypeStruct(mb_shape, out_sd.dtype)),    # incoming_g
+        dev0(jax.ShapeDtypeStruct((stash_k, *mb_shape), dtype)),  # stash
+        promote_vma(jnp.zeros((), jnp.float32), carry_axes),   # loss_acc
+        jax.tree.map(dev0, jax.eval_shape(lambda p: p, stage_params)),
+        jax.tree.map(dev0, jax.eval_shape(lambda p: p, head_params)),
+        dev0(jax.ShapeDtypeStruct((n_micro, *mb_shape), out_sd.dtype)),
+        token.with_stamp(promote_vma(token.stamp, carry_axes)),
+    )
+    (_, _, _, loss_sum, d_stage, d_head, d_mbs, token), _ = lax.scan(
+        tick, carry0, jnp.arange(n_micro + lag)
+    )
+    return loss_sum, d_stage, d_head, d_mbs, token

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from mpi4jax_tpu.models.pipeline import pipeline_apply
+from mpi4jax_tpu.models.pipeline import pipeline_apply, pipeline_train
 from mpi4jax_tpu.models.transformer import (
     _ce,
     _rmsnorm,
@@ -74,7 +74,9 @@ def _stage_fn(cfg, stage_blocks, a):
     return out
 
 
-def make_global_train_step(mesh, comm_dp, comm_pp, cfg, n_micro, lr=1e-1):
+def make_global_train_step(
+    mesh, comm_dp, comm_pp, cfg, n_micro, lr=1e-1, schedule="gpipe"
+):
     """Jitted global train step over a ``(dp, pp)`` mesh.
 
     ``batch = (tokens, targets)``, global ``[B, S]`` int32 sharded over
@@ -82,7 +84,18 @@ def make_global_train_step(mesh, comm_dp, comm_pp, cfg, n_micro, lr=1e-1):
     ``comm_pp.size`` stages with ``n_micro`` microbatches.  Requires
     ``cfg.layers % comm_pp.size == 0`` and the per-dp-group batch
     divisible by ``n_micro``.  Returns ``(new_params, loss)``.
+
+    ``schedule``: ``"gpipe"`` differentiates the forward pipeline with
+    ``jax.grad`` (all-forward-then-all-backward; scan residuals stash
+    every microbatch), ``"1f1b"`` runs the interleaved
+    :func:`~mpi4jax_tpu.models.pipeline.pipeline_train` schedule
+    (bounded in-flight activations, built-in remat).  Both are
+    oracle-equal to the dense model — tests/parallel/test_pp_transformer.
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"schedule must be 'gpipe' or '1f1b', got {schedule!r}"
+        )
     dp_ax, pp_ax = comm_dp.axes[0], comm_pp.axes[0]
     dp = float(comm_dp.size)
     stages = comm_pp.size
@@ -105,26 +118,76 @@ def make_global_train_step(mesh, comm_dp, comm_pp, cfg, n_micro, lr=1e-1):
             )
         mb = b_loc // n_micro
 
-        def loss_fn(p):
-            x = p.embed[tokens]  # every rank embeds; stage 0's feed wins
-            mbs = x.reshape(n_micro, mb, s, cfg.d_model)
-            out, _tok = pipeline_apply(
-                partial(_stage_fn, cfg), p.blocks, mbs, comm_pp
-            )
-            h = _rmsnorm(out.reshape(b_loc, s, cfg.d_model), p.ln_f, cfg.eps)
-            logits = h @ p.head
-            # valid only on the last stage; masked elsewhere so each
-            # device's loss is exactly its pipeline's contribution
-            is_last = comm_pp.rank() == stages - 1
-            return jnp.where(is_last, _ce(logits, targets), 0.0)
+        if schedule == "gpipe":
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+            def loss_fn(p):
+                x = p.embed[tokens]  # every rank embeds; stage 0 wins
+                mbs = x.reshape(n_micro, mb, s, cfg.d_model)
+                out, _tok = pipeline_apply(
+                    partial(_stage_fn, cfg), p.blocks, mbs, comm_pp
+                )
+                h = _rmsnorm(
+                    out.reshape(b_loc, s, cfg.d_model), p.ln_f, cfg.eps
+                )
+                logits = h @ p.head
+                # valid only on the last stage; masked elsewhere so each
+                # device's loss is exactly its pipeline's contribution
+                is_last = comm_pp.rank() == stages - 1
+                return jnp.where(is_last, _ce(logits, targets), 0.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+        else:  # 1f1b: manual backward through the interleaved schedule
+            x = params.embed[tokens]
+            mbs = x.reshape(n_micro, mb, s, cfg.d_model)
+            tmbs = targets.reshape(n_micro, mb, s)
+
+            def head_fn(hp, a, tgt):
+                ln_f, head = hp
+                h = _rmsnorm(a, ln_f, cfg.eps)
+                return _ce(h @ head, tgt)
+
+            loss_sum, d_blocks, (d_ln_f, d_head), d_mbs, _tok = (
+                pipeline_train(
+                    partial(_stage_fn, cfg), params.blocks,
+                    head_fn, (params.ln_f, params.head),
+                    mbs, tmbs, comm_pp,
+                )
+            )
+            # per-microbatch losses are means over 1/M of the batch:
+            # sum/M == the gpipe path's whole-batch mean (and same for
+            # the gradients)
+            loss = loss_sum / n_micro
+            dx = d_mbs.reshape(b_loc, s, cfg.d_model) / n_micro
+            d_embed = jnp.zeros_like(params.embed).at[tokens].add(
+                dx.astype(params.embed.dtype)
+            )
+            grads = params._replace(
+                embed=d_embed,
+                blocks=jax.tree.map(lambda g: g / n_micro, d_blocks),
+                ln_f=d_ln_f / n_micro,
+                head=d_head / n_micro,
+            )
+            # match gpipe's AD-inserted psums for replicated params:
+            # embed/ln_f/head get contributions from one stage each (pp
+            # sum adds zeros elsewhere), and EVERY grad class sums over
+            # dp (the AD path does this via the replication rule; the
+            # manual path must do it explicitly)
+            grads = grads._replace(
+                embed=lax.psum(grads.embed, pp_ax),
+                ln_f=lax.psum(grads.ln_f, pp_ax),
+                head=lax.psum(grads.head, pp_ax),
+            )
+            grads = jax.tree.map(lambda g: lax.psum(g, dp_ax), grads)
+            loss = lax.psum(loss, pp_ax)
         # blocks are pp-sharded (no automatic sum); replicated params'
         # automatic (dp, pp)-psum adds zeros from non-contributing
         # stages — every param class needs only the dp mean scaling
         grads = jax.tree.map(lambda g: g / dp, grads)
         params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-        loss = lax.psum(loss, (dp_ax, pp_ax)) / dp
+        if schedule == "gpipe":
+            loss = lax.psum(loss, (dp_ax, pp_ax)) / dp
+        else:
+            loss = lax.psum(loss, dp_ax) / dp
         return params, loss[None]
 
     return jax.jit(

@@ -16,18 +16,45 @@ __all__ = ["lib_path", "ensure_built", "build"]
 
 _SRC_DIR = pathlib.Path(__file__).resolve().parent / "src"
 _OUT = pathlib.Path(__file__).resolve().parent / "_t4j_dcn.so"
-_SOURCES = ["dcn.cc", "ffi.cc"]
+_SOURCES = ["dcn.cc", "shm.cc", "ffi.cc"]
 
 
 def lib_path():
     return _OUT
 
 
+_HEADERS = ["dcn.h", "shm.h"]
+
+
+def _machine_key():
+    """CPU-feature fingerprint: the cached .so contains -march=native
+    codegen, so a package dir shared across heterogeneous hosts (NFS
+    conda env) must rebuild per machine instead of SIGILL-ing."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
 def _needs_build():
     if not _OUT.exists():
         return True
+    key_file = _OUT.with_suffix(".buildinfo")
+    try:
+        if key_file.read_text().strip() != _machine_key():
+            return True
+    except OSError:
+        return True
     out_mtime = _OUT.stat().st_mtime
-    for s in _SOURCES + ["dcn.h"]:
+    for s in _SOURCES + _HEADERS:
         if (_SRC_DIR / s).stat().st_mtime > out_mtime:
             return True
     return False
@@ -44,28 +71,42 @@ def build(verbose=False):
     cxx = os.environ.get("MPI4JAX_TPU_BUILD_CXX") or os.environ.get(
         "CXX", "g++"
     )
-    cmd = [
-        cxx,
-        "-O2",
-        "-fPIC",
-        "-shared",
-        "-std=c++17",
-        "-Wall",
-        f"-I{include}",
-        *[str(_SRC_DIR / s) for s in _SOURCES],
-        "-o",
-        str(tmp),
-        "-lpthread",
-    ]
-    if verbose:
-        print(" ".join(cmd), file=sys.stderr)
-    proc = subprocess.run(cmd, capture_output=True, text=True)
+    def cmd_for(extra):
+        return [
+            cxx,
+            "-O3",
+            *extra,
+            "-fPIC",
+            "-shared",
+            "-std=c++17",
+            "-Wall",
+            f"-I{include}",
+            *[str(_SRC_DIR / s) for s in _SOURCES],
+            "-o",
+            str(tmp),
+            "-lpthread",
+            "-lrt",
+        ]
+
+    # -march=native vectorises the reduction combines (the shm arena's
+    # fold is memory-bound only when SIMD keeps up); the library is
+    # JIT-built per machine on first use, so native codegen is safe.
+    # Fall back to portable flags if the toolchain rejects it.
+    proc = None
+    for extra in (["-march=native"], []):
+        cmd = cmd_for(extra)
+        if verbose:
+            print(" ".join(cmd), file=sys.stderr)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode == 0:
+            break
     if proc.returncode != 0:
         tmp.unlink(missing_ok=True)
         raise RuntimeError(
             f"native bridge build failed:\n{proc.stderr[-4000:]}"
         )
     os.replace(tmp, _OUT)  # atomic: concurrent loaders never see a torn .so
+    _OUT.with_suffix(".buildinfo").write_text(_machine_key() + "\n")
     return _OUT
 
 

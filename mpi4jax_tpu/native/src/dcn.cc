@@ -1,6 +1,7 @@
 // DCN bridge implementation: see dcn.h.
 
 #include "dcn.h"
+#include "shm.h"
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -329,7 +330,51 @@ int tcp_connect(const std::string& host, uint16_t port) {
 struct PeerAddr {
   uint32_t ip;
   uint16_t port;
+  uint16_t pad;
+  uint64_t host_fp;  // same value <=> same host (shm-transport eligible)
 };
+static_assert(sizeof(PeerAddr) == 16, "PeerAddr wire layout");
+
+std::vector<uint64_t> g_host_fps;  // world_size entries
+std::string g_job;                 // unique job id (shm segment namespace)
+
+uint64_t host_fingerprint() {
+  // FNV-1a over the boot uuid (unique per host+boot), the hostname,
+  // and the IPC + mount namespace identities: two ranks only count as
+  // "same host" for the shm transport when they share the kernel AND
+  // can actually see one another's /dev/shm — containers on one node
+  // share boot_id but have distinct ns inodes.
+  uint64_t h = 1469598103934665603ULL;
+  auto mix = [&h](const char* s, size_t n) {
+    for (size_t i = 0; i < n; ++i) {
+      h ^= static_cast<uint8_t>(s[i]);
+      h *= 1099511628211ULL;
+    }
+  };
+  FILE* f = std::fopen("/proc/sys/kernel/random/boot_id", "r");
+  if (f) {
+    char buf[64] = {0};
+    size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
+    std::fclose(f);
+    mix(buf, n);
+  }
+  char host[256] = {0};
+  ::gethostname(host, sizeof(host) - 1);
+  mix(host, std::strlen(host));
+  for (const char* ns : {"/proc/self/ns/ipc", "/proc/self/ns/mnt"}) {
+    char link[128] = {0};
+    ssize_t n = ::readlink(ns, link, sizeof(link) - 1);
+    if (n > 0) mix(link, static_cast<size_t>(n));
+  }
+  // T4J_NO_SHM rides the fingerprint: a rank with shm disabled must
+  // never be classified same-host by ENABLED peers, or a divergent env
+  // (hand-launched ranks) would split the transport — member 0 falling
+  // straight to TCP while the others block in the agreement rounds.
+  // Mixed-in (not zeroed) so an all-disabled job still agrees among
+  // itself and falls back together through the ok=0 round.
+  if (shm::disabled()) mix("t4j-no-shm", 10);
+  return h ? h : 1;
+}
 
 void bootstrap(const std::string& coord_host, uint16_t coord_port) {
   // Every rank opens a listener for the full-mesh phase.
@@ -338,11 +383,14 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
 
   std::vector<PeerAddr> table(g_size);
 
+  uint64_t my_fp = host_fingerprint();
+
   if (g_rank == 0) {
-    // phase 1: collect every rank's (ip, port) on the coordinator socket
+    // phase 1: collect every rank's (ip, port, host_fp) on the
+    // coordinator socket
     uint16_t cport = coord_port;
     int coord_fd = tcp_listen(&cport);
-    table[0] = PeerAddr{htonl(INADDR_LOOPBACK), my_port};
+    table[0] = PeerAddr{htonl(INADDR_LOOPBACK), my_port, 0, my_fp};
     std::vector<int> fds(g_size, -1);
     for (int i = 1; i < g_size; ++i) {
       sockaddr_in peer{};
@@ -352,10 +400,12 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
       uint32_t rank_and_port[2];
       if (!read_all(fd, rank_and_port, sizeof(rank_and_port)))
         die("coordinator handshake");
+      uint64_t fp = 0;
+      if (!read_all(fd, &fp, sizeof(fp))) die("coordinator fp handshake");
       int r = static_cast<int>(rank_and_port[0]);
       if (r < 1 || r >= g_size) die("coordinator rank check");
       table[r] = PeerAddr{peer.sin_addr.s_addr,
-                          static_cast<uint16_t>(rank_and_port[1])};
+                          static_cast<uint16_t>(rank_and_port[1]), 0, fp};
       fds[r] = fd;
     }
     // phase 2: broadcast the table
@@ -368,10 +418,14 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
     int fd = tcp_connect(coord_host, coord_port);
     uint32_t rank_and_port[2] = {static_cast<uint32_t>(g_rank), my_port};
     write_all(fd, rank_and_port, sizeof(rank_and_port));
+    write_all(fd, &my_fp, sizeof(my_fp));
     if (!read_all(fd, table.data(), sizeof(PeerAddr) * g_size))
       die("coordinator table read");
     ::close(fd);
   }
+
+  g_host_fps.resize(g_size);
+  for (int i = 0; i < g_size; ++i) g_host_fps[i] = table[i].host_fp;
 
   // phase 3: full mesh -- rank i accepts from ranks > i, connects to < i.
   g_peers = std::vector<PeerSock>(g_size);
@@ -411,6 +465,9 @@ struct Comm {
   std::vector<int> ranks;  // world ranks, ascending caller order
   int ctx;
   int my_index;  // index of g_rank in ranks, or -1
+  // same-host shm collective arena (lazy; nullptr = use TCP algorithms)
+  shm::Arena* arena = nullptr;
+  bool arena_checked = false;
 };
 
 std::mutex g_comm_mu;
@@ -427,6 +484,101 @@ Comm& get_comm(int handle) {
   if (handle < 0 || handle >= static_cast<int>(g_comms.size()))
     die("invalid communicator handle");
   return g_comms[handle];
+}
+
+// Arena negotiation runs over the TCP collective channel with reserved
+// tags, so it can never collide with user traffic or collectives.
+constexpr int kArenaTagCreated = kCollTagBase + 9;
+constexpr int kArenaTagAttach = kCollTagBase + 10;
+constexpr int kArenaTagFinal = kCollTagBase + 11;
+
+void csend(Comm& c, int dest_idx, int tag, const void* buf, size_t n,
+           bool coll);
+Frame crecv(Comm& c, int src_idx, int tag, bool coll);
+
+// Same-host shm arena for a communicator (lazy).  Eligible when every
+// member's bootstrap host fingerprint matches ours — then collectives
+// move through shared memory instead of TCP frames (the role libmpi's
+// shm BTL plays for the reference, mpi_xla_bridge.pyx:149-167).
+//
+// Setup is an explicit agreement protocol so the transport choice can
+// never split the communicator (a rank silently falling back to TCP
+// while its peers wait in shm would deadlock the job):
+//   1. member 0 creates + fully initialises the segment, then tells
+//      everyone whether that worked;
+//   2. the others attach (no polling: the segment provably exists) and
+//      report success back to member 0;
+//   3. member 0 broadcasts the AND of every report — the arena is used
+//      only when every member attached, else every member drops it and
+//      the whole comm stays on TCP.
+// The three rounds ride the TCP collective channel, which is always up.
+shm::Arena* negotiate_arena(Comm& c) {
+  int n = static_cast<int>(c.ranks.size());
+  // fingerprints come from one bootstrap table, so this predicate is
+  // identical on every member: either all enter the rounds or none do
+  bool same_host = n > 1 && c.my_index >= 0 && !shm::disabled() &&
+                   static_cast<int>(g_host_fps.size()) == g_size;
+  if (same_host) {
+    for (int r : c.ranks)
+      if (g_host_fps[r] != g_host_fps[g_rank]) {
+        same_host = false;
+        break;
+      }
+  }
+  if (!same_host) return nullptr;
+
+  shm::Arena* a = nullptr;
+  uint8_t ok = 0;
+  if (c.my_index == 0) {
+    a = shm::create(g_job.c_str(), c.ctx, n);
+    ok = a != nullptr;
+    for (int i = 1; i < n; ++i)
+      csend(c, i, kArenaTagCreated, &ok, 1, true);
+  } else {
+    Frame f = crecv(c, 0, kArenaTagCreated, true);
+    ok = f.data.size() == 1 ? f.data.data()[0] : 0;
+    if (ok) {
+      a = shm::attach(g_job.c_str(), c.ctx, n, c.my_index);
+      ok = a != nullptr;
+    }
+  }
+  if (c.my_index == 0) {
+    for (int i = 1; i < n; ++i) {
+      Frame f = crecv(c, i, kArenaTagAttach, true);
+      ok &= f.data.size() == 1 ? f.data.data()[0] : 0;
+    }
+    for (int i = 1; i < n; ++i)
+      csend(c, i, kArenaTagFinal, &ok, 1, true);
+  } else {
+    csend(c, 0, kArenaTagAttach, &ok, 1, true);
+    Frame f = crecv(c, 0, kArenaTagFinal, true);
+    ok = f.data.size() == 1 ? f.data.data()[0] : 0;
+  }
+  if (!ok && a) {
+    shm::destroy(a);
+    a = nullptr;
+  }
+  // every member holds a mapping now, so drop the NAME immediately: an
+  // abnormal exit (die/_exit/SIGKILL) can then never leak the segment —
+  // the kernel frees the tmpfs pages with the last mapping
+  if (a) shm::unlink_name(a);
+  return a;
+}
+
+shm::Arena* comm_arena(Comm& c) {
+  {
+    std::lock_guard<std::mutex> lk(g_comm_mu);
+    if (c.arena_checked) return c.arena;
+  }
+  // Negotiation happens OUTSIDE the registry mutex: it blocks on TCP
+  // rounds, and holding g_comm_mu there would stall every unrelated
+  // bridge call in the process.  Concurrent first-collectives on the
+  // SAME comm cannot happen (MPI serialises collectives per comm).
+  shm::Arena* a = negotiate_arena(c);
+  std::lock_guard<std::mutex> lk(g_comm_mu);
+  c.arena = a;
+  c.arena_checked = true;
+  return c.arena;
 }
 
 // ------------------------------------------------------------ reductions
@@ -567,6 +719,9 @@ void combine_half(ReduceOp op, const uint16_t* a, uint16_t* acc, size_t n,
   }
 }
 
+}  // namespace (reopened below: combine is linked from shm.cc)
+
+namespace detail {
 void combine(ReduceOp op, DType dt, const void* contrib, void* acc,
              size_t count) {
   switch (dt) {
@@ -617,6 +772,11 @@ void combine(ReduceOp op, DType dt, const void* contrib, void* acc,
   }
   die("unknown dtype");
 }
+}  // namespace detail
+
+namespace {
+
+using detail::combine;
 
 // comm-relative send/recv; coll=true routes through the internal
 // collective channel (separate wire ctx), so user-facing ANY_SOURCE /
@@ -687,6 +847,19 @@ int init_from_env() {
   const char* dbg = std::getenv("MPI4JAX_TPU_NATIVE_DEBUG");
   if (dbg && dbg[0] && std::strcmp(dbg, "0") != 0) g_logging = true;
 
+  // unique job id namespaces the shm segments (launcher sets T4J_JOB;
+  // fall back to a sanitised coordinator address + uid)
+  const char* job_s = std::getenv("T4J_JOB");
+  if (job_s && job_s[0]) {
+    g_job = job_s;
+  } else {
+    g_job = coord_s ? coord_s : "local";
+    g_job += "_u" + std::to_string(::getuid());
+  }
+  for (auto& ch : g_job)
+    if (!std::isalnum(static_cast<unsigned char>(ch))) ch = '_';
+  if (g_job.size() > 80) g_job.resize(80);
+
   if (g_size > 1) {
     std::string coord = coord_s ? coord_s : "127.0.0.1:45677";
     auto colon = coord.rfind(':');
@@ -712,6 +885,14 @@ int init_from_env() {
 void finalize() {
   if (!g_initialized) return;
   barrier(0);
+  {
+    std::lock_guard<std::mutex> lk(g_comm_mu);
+    for (auto& c : g_comms) {
+      if (c.arena) shm::destroy(c.arena);
+      c.arena = nullptr;
+      c.arena_checked = true;
+    }
+  }
   g_shutting_down.store(true);
   // shutdown first (wakes blocked readers with EOF/error), close only
   // after every reader has exited — closing a fd a thread is blocked on
@@ -811,6 +992,7 @@ void barrier(int comm) {
   LogScope log("MPI_Barrier", "");
   int n = static_cast<int>(c.ranks.size());
   if (n == 1) return;
+  if (shm::Arena* a = comm_arena(c)) return shm::barrier(a);
   int me = c.my_index;
   // dissemination barrier
   for (int k = 1; k < n; k <<= 1) {
@@ -826,6 +1008,7 @@ void bcast(int comm, void* buf, size_t nbytes, int root) {
                               std::to_string(nbytes) + " bytes");
   int n = static_cast<int>(c.ranks.size());
   if (n == 1) return;
+  if (shm::Arena* a = comm_arena(c)) return shm::bcast(a, buf, nbytes, root);
   // binomial tree rooted at `root` (rotate indices so root -> 0)
   int me = (c.my_index - root % n + n) % n;
   for (int k = 1; k < n; k <<= 1) {
@@ -847,6 +1030,8 @@ void reduce(int comm, const void* in, void* out, size_t count, DType dt,
   LogScope log("MPI_Reduce", "-> " + std::to_string(root) + " with " +
                                std::to_string(count) + " items");
   int n = static_cast<int>(c.ranks.size());
+  if (shm::Arena* a = comm_arena(c))
+    return shm::reduce(a, in, out, count, dt, op, root);
   size_t nbytes = count * dtype_size(dt);
   std::vector<uint8_t> acc(static_cast<const uint8_t*>(in),
                            static_cast<const uint8_t*>(in) + nbytes);
@@ -874,6 +1059,8 @@ void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
                ReduceOp op) {
   Comm& c = get_comm(comm);
   LogScope log("MPI_Allreduce", "with " + std::to_string(count) + " items");
+  if (shm::Arena* a = comm_arena(c))
+    return shm::allreduce(a, in, out, count, dt, op);
   size_t nbytes = count * dtype_size(dt);
   reduce(comm, in, out, count, dt, op, 0);
   if (c.my_index != 0) std::memcpy(out, in, nbytes);  // placate valgrind
@@ -884,6 +1071,8 @@ void scan(int comm, const void* in, void* out, size_t count, DType dt,
           ReduceOp op) {
   Comm& c = get_comm(comm);
   LogScope log("MPI_Scan", "with " + std::to_string(count) + " items");
+  if (shm::Arena* a = comm_arena(c))
+    return shm::scan(a, in, out, count, dt, op);
   int n = static_cast<int>(c.ranks.size());
   size_t nbytes = count * dtype_size(dt);
   std::memcpy(out, in, nbytes);
@@ -901,6 +1090,8 @@ void allgather(int comm, const void* in, void* out, size_t nbytes_each) {
   Comm& c = get_comm(comm);
   LogScope log("MPI_Allgather", "sending " + std::to_string(nbytes_each) +
                                   " bytes each");
+  if (shm::Arena* a = comm_arena(c))
+    return shm::allgather(a, in, out, nbytes_each);
   gather(comm, in, out, nbytes_each, 0);
   bcast(comm, out, nbytes_each * c.ranks.size(), 0);
 }
@@ -910,6 +1101,8 @@ void gather(int comm, const void* in, void* out, size_t nbytes_each,
   Comm& c = get_comm(comm);
   LogScope log("MPI_Gather", "-> " + std::to_string(root) + " sending " +
                                std::to_string(nbytes_each) + " bytes each");
+  if (shm::Arena* a = comm_arena(c))
+    return shm::gather(a, in, out, nbytes_each, root);
   int n = static_cast<int>(c.ranks.size());
   if (c.my_index == root) {
     uint8_t* o = static_cast<uint8_t*>(out);
@@ -930,6 +1123,8 @@ void scatter(int comm, const void* in, void* out, size_t nbytes_each,
   Comm& c = get_comm(comm);
   LogScope log("MPI_Scatter", "-> " + std::to_string(root) + " sending " +
                                 std::to_string(nbytes_each) + " bytes each");
+  if (shm::Arena* a = comm_arena(c))
+    return shm::scatter(a, in, out, nbytes_each, root);
   int n = static_cast<int>(c.ranks.size());
   if (c.my_index == root) {
     const uint8_t* i8 = static_cast<const uint8_t*>(in);
@@ -949,6 +1144,8 @@ void alltoall(int comm, const void* in, void* out, size_t nbytes_each) {
   Comm& c = get_comm(comm);
   LogScope log("MPI_Alltoall", "sending " + std::to_string(nbytes_each) +
                                  " bytes each");
+  if (shm::Arena* a = comm_arena(c))
+    return shm::alltoall(a, in, out, nbytes_each);
   int n = static_cast<int>(c.ranks.size());
   int me = c.my_index;
   const uint8_t* i8 = static_cast<const uint8_t*>(in);

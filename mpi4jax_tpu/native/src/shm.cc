@@ -1,0 +1,584 @@
+// Same-host shared-memory collective arena: see shm.h.
+
+#include "shm.h"
+
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <sched.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+namespace t4j {
+namespace shm {
+
+namespace {
+
+constexpr uint32_t kMagic = 0x7446a0AA;
+constexpr int kMaxRanks = 64;
+constexpr size_t kAlign = 64;
+
+// Fold chunk: small enough that the accumulator segment stays cache-hot
+// across the n-1 pairwise combines, so the effective fold traffic is
+// ~(n+1) streams instead of 3*(n-1).
+constexpr size_t kFoldChunkBytes = 256 << 10;
+
+size_t slot_cap() {
+  static size_t cap = [] {
+    const char* s = std::getenv("T4J_SHM_SLOT_MB");
+    long mb = s ? std::atol(s) : 8;
+    if (mb < 1) mb = 1;
+    if (mb > 256) mb = 256;
+    return static_cast<size_t>(mb) << 20;
+  }();
+  return cap;
+}
+
+struct Hdr {
+  std::atomic<uint32_t> magic;
+  std::atomic<uint32_t> progress;  // futex word: bumped on every update
+  std::atomic<uint32_t> waiters;
+  uint32_t n;
+  uint64_t cap;
+  // Monotone piece counters (never reset; all members execute the same
+  // collective sequence, an MPI-contract invariant, so local piece
+  // numbering agrees across ranks).
+  std::atomic<uint64_t> staged[kMaxRanks];    // pieces staged into slot
+  std::atomic<uint64_t> seg_done[kMaxRanks];  // pieces whose segment fold ran
+  std::atomic<uint64_t> acked[kMaxRanks];     // pieces fully consumed
+};
+
+[[noreturn]] void die(const char* what) {
+  std::fprintf(stderr, "t4j shm arena: %s failed (errno %d); aborting job\n",
+               what, errno);
+  std::fflush(stderr);
+  _exit(13);
+}
+
+void futex_wait(std::atomic<uint32_t>* w, uint32_t val) {
+  timespec ts{2, 0};  // bounded: re-check the predicate at least every 2s
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(w), FUTEX_WAIT, val, &ts,
+          nullptr, 0);
+}
+
+void futex_wake_all(std::atomic<uint32_t>* w) {
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(w), FUTEX_WAKE, INT32_MAX,
+          nullptr, nullptr, 0);
+}
+
+double now_s() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + 1e-9 * ts.tv_nsec;
+}
+
+double wait_limit_s() {
+  static double lim = [] {
+    const char* s = std::getenv("T4J_SHM_TIMEOUT");
+    double v = s ? std::atof(s) : 300.0;
+    return v > 0 ? v : 300.0;
+  }();
+  return lim;
+}
+
+}  // namespace
+
+struct Arena {
+  Hdr* h = nullptr;
+  uint8_t* base = nullptr;  // mmap base
+  size_t total = 0;
+  int n = 0;
+  int me = 0;
+  uint64_t pieces = 0;  // local count of pieces processed on this comm
+  std::string name;
+  bool creator = false;
+  // T4J_SHM_PROF=1 phase accounting (printed at destroy)
+  double t_gate = 0, t_stage = 0, t_wait_staged = 0, t_fold = 0,
+         t_wait_folded = 0, t_out = 0;
+
+  uint8_t* slot(int r) const {
+    return base + sizeof(Hdr) + static_cast<size_t>(r) * h->cap;
+  }
+  uint8_t* result() const {
+    return base + sizeof(Hdr) + static_cast<size_t>(n) * h->cap;
+  }
+};
+
+namespace {
+
+void bump(Hdr* h) {
+  h->progress.fetch_add(1, std::memory_order_release);
+  if (h->waiters.load(std::memory_order_acquire) > 0)
+    futex_wake_all(&h->progress);
+}
+
+template <class Pred>
+void wait_for(Hdr* h, Pred ok) {
+  // Single-core-friendly: spinning starves the peer that would satisfy
+  // the predicate, so yield almost immediately and fall back to futex.
+  for (int s = 0; s < 4; ++s) {
+    if (ok()) return;
+#if defined(__x86_64__) || defined(__i386__)
+    __builtin_ia32_pause();
+#endif
+  }
+  for (int s = 0; s < 16; ++s) {
+    if (ok()) return;
+    ::sched_yield();
+  }
+  double t0 = now_s();
+  for (;;) {
+    uint32_t seen = h->progress.load(std::memory_order_acquire);
+    if (ok()) return;
+    h->waiters.fetch_add(1, std::memory_order_acq_rel);
+    if (!ok()) futex_wait(&h->progress, seen);
+    h->waiters.fetch_sub(1, std::memory_order_acq_rel);
+    if (ok()) return;
+    if (now_s() - t0 > wait_limit_s()) {
+      std::fprintf(stderr,
+                   "t4j shm arena: collective stalled > %.0fs (deadlock or "
+                   "dead peer); aborting job\n",
+                   wait_limit_s());
+      std::fflush(stderr);
+      _exit(13);
+    }
+  }
+}
+
+uint64_t min_over(const std::atomic<uint64_t>* arr, int n) {
+  uint64_t m = UINT64_MAX;
+  for (int i = 0; i < n; ++i) {
+    uint64_t v = arr[i].load(std::memory_order_acquire);
+    if (v < m) m = v;
+  }
+  return m;
+}
+
+// Gate for reusing slots and the result buffer: everyone must have
+// fully consumed piece p-1.
+void wait_consumed(Hdr* h, uint64_t p) {
+  wait_for(h, [&] { return min_over(h->acked, h->n) >= p - 1; });
+}
+
+void wait_staged(Hdr* h, uint64_t p) {
+  wait_for(h, [&] { return min_over(h->staged, h->n) >= p; });
+}
+
+void wait_folded(Hdr* h, uint64_t p) {
+  wait_for(h, [&] { return min_over(h->seg_done, h->n) >= p; });
+}
+
+// Segment split of `count` elements over n ranks (remainder spread over
+// the first ranks), in elements.
+void segment(size_t count, int n, int r, size_t* start, size_t* len) {
+  size_t base = count / n, rem = count % n;
+  *start = r * base + (static_cast<size_t>(r) < rem ? r : rem);
+  *len = base + (static_cast<size_t>(r) < rem ? 1 : 0);
+}
+
+// The shared piece-iteration scaffold: every collective streams its
+// payload in slot-capacity pieces, each piece gated on full consumption
+// of the previous one (slot + result reuse fencing), with the
+// zero-length case running exactly one synchronization piece so empty
+// payloads still order like collectives.  The per-op body receives
+// (done_units, piece_units, p) and must end by storing acked[me]=p.
+template <class Body>
+void for_pieces(Arena* a, size_t total_units, size_t cap_units, Body body) {
+  for (size_t done = 0; done < total_units || done == 0;
+       done += cap_units) {
+    size_t left = total_units - done;
+    size_t piece = left < cap_units ? left : cap_units;
+    uint64_t p = ++a->pieces;
+    wait_consumed(a->h, p);
+    body(done, piece, p);
+    if (total_units == 0) break;
+  }
+}
+
+// Pairwise fold of segment [start, start+len) elements across all n
+// slots into dst, chunked so the accumulator stays cache-hot.
+void fold_segment(Arena* a, size_t start_el, size_t len_el, DType dt,
+                  ReduceOp op, uint8_t* dst) {
+  size_t esz = dtype_size(dt);
+  size_t chunk_el = kFoldChunkBytes / esz;
+  if (chunk_el == 0) chunk_el = 1;
+  for (size_t off = 0; off < len_el; off += chunk_el) {
+    size_t m = len_el - off < chunk_el ? len_el - off : chunk_el;
+    size_t byte_off = (start_el + off) * esz;
+    uint8_t* acc = dst + off * esz;
+    std::memcpy(acc, a->slot(0) + byte_off, m * esz);
+    for (int k = 1; k < a->n; ++k)
+      detail::combine(op, dt, a->slot(k) + byte_off, acc, m);
+  }
+}
+
+}  // namespace
+
+bool disabled() {
+  const char* off = std::getenv("T4J_NO_SHM");
+  return off && off[0] && std::strcmp(off, "0") != 0;
+}
+
+namespace {
+
+void arena_name(char* buf, size_t bufsz, const char* job, int ctx) {
+  std::snprintf(buf, bufsz, "/t4j_%s_c%d", job, ctx);
+}
+
+size_t arena_total(int n, size_t cap) {
+  size_t total = sizeof(Hdr) + (static_cast<size_t>(n) + 1) * cap;
+  return (total + kAlign - 1) & ~(kAlign - 1);
+}
+
+Arena* map_arena(int fd, const char* name, int n, size_t total,
+                 int my_index) {
+  void* m = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (m == MAP_FAILED) return nullptr;
+#ifdef MADV_HUGEPAGE
+  // best-effort THP: the arena is written 4KB-page-dense by big
+  // memcpys, so 2MB mappings cut TLB pressure on every phase
+  ::madvise(m, total, MADV_HUGEPAGE);
+#endif
+  Arena* a = new Arena;
+  a->base = static_cast<uint8_t*>(m);
+  a->h = reinterpret_cast<Hdr*>(a->base);
+  a->total = total;
+  a->n = n;
+  a->me = my_index;
+  a->name = name;
+  a->creator = my_index == 0;
+  return a;
+}
+
+}  // namespace
+
+Arena* create(const char* job, int ctx, int n) {
+  if (disabled() || n < 2 || n > kMaxRanks) return nullptr;
+  char name[200];
+  arena_name(name, sizeof(name), job, ctx);
+  size_t cap = slot_cap();
+  size_t total = arena_total(n, cap);
+
+  // a crashed prior run with the same (job, ctx) — possible only for
+  // hand-set T4J_* envs; the launcher's T4J_JOB is a fresh uuid — may
+  // have left a stale segment whose counters would corrupt matching:
+  // always start from a fresh inode (attachers open ONLY after the
+  // agreement round that follows full initialisation, so they can
+  // never see the unlinked one)
+  ::shm_unlink(name);
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;  // no /dev/shm: fall back to TCP
+  if (::ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  Arena* a = map_arena(fd, name, n, total, 0);
+  if (!a) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  Hdr* h = a->h;
+  h->n = static_cast<uint32_t>(n);
+  h->cap = cap;
+  h->progress.store(0, std::memory_order_relaxed);
+  h->waiters.store(0, std::memory_order_relaxed);
+  for (int i = 0; i < kMaxRanks; ++i) {
+    h->staged[i].store(0, std::memory_order_relaxed);
+    h->seg_done[i].store(0, std::memory_order_relaxed);
+    h->acked[i].store(0, std::memory_order_relaxed);
+  }
+  h->magic.store(kMagic, std::memory_order_release);
+  return a;
+}
+
+Arena* attach(const char* job, int ctx, int n, int my_index) {
+  if (disabled() || n < 2 || n > kMaxRanks || my_index <= 0) return nullptr;
+  char name[200];
+  arena_name(name, sizeof(name), job, ctx);
+  size_t cap = slot_cap();
+  size_t total = arena_total(n, cap);
+
+  // no O_CREAT: the creator fully initialised the segment before the
+  // agreement round delivered us here, so it must exist and be sized
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(total)) {
+    ::close(fd);
+    return nullptr;
+  }
+  Arena* a = map_arena(fd, name, n, total, my_index);
+  if (!a) return nullptr;
+  if (a->h->magic.load(std::memory_order_acquire) != kMagic ||
+      a->h->cap != cap || a->h->n != static_cast<uint32_t>(n)) {
+    ::munmap(a->base, a->total);
+    delete a;
+    return nullptr;
+  }
+  return a;
+}
+
+void unlink_name(Arena* a) {
+  if (a && a->creator && !a->name.empty()) {
+    ::shm_unlink(a->name.c_str());
+    a->name.clear();  // destroy() must not unlink a reused name
+  }
+}
+
+void destroy(Arena* a) {
+  if (!a) return;
+  if (a->t_gate + a->t_stage + a->t_fold + a->t_out > 0) {
+    std::fprintf(stderr,
+                 "t4j shm prof r%d: gate %.1fms stage %.1fms wait_staged "
+                 "%.1fms fold %.1fms wait_folded %.1fms out %.1fms\n",
+                 a->me, a->t_gate * 1e3, a->t_stage * 1e3,
+                 a->t_wait_staged * 1e3, a->t_fold * 1e3,
+                 a->t_wait_folded * 1e3, a->t_out * 1e3);
+  }
+  unlink_name(a);  // normally already done right after the agreement
+  ::munmap(a->base, a->total);
+  delete a;
+}
+
+// ------------------------------------------------------------- collectives
+
+void allreduce(Arena* a, const void* in, void* out, size_t count, DType dt,
+               ReduceOp op) {
+  Hdr* h = a->h;
+  size_t esz = dtype_size(dt);
+  const uint8_t* src = static_cast<const uint8_t*>(in);
+  uint8_t* dst = static_cast<uint8_t*>(out);
+  static const bool prof = [] {
+    const char* s = std::getenv("T4J_SHM_PROF");
+    return s && s[0] && std::strcmp(s, "0") != 0;
+  }();
+  for_pieces(a, count, h->cap / esz, [&](size_t done, size_t piece,
+                                         uint64_t p) {
+    double t1 = prof ? now_s() : 0;
+    std::memcpy(a->slot(a->me), src + done * esz, piece * esz);
+    h->staged[a->me].store(p, std::memory_order_release);
+    bump(h);
+    double t2 = prof ? now_s() : 0;
+    wait_staged(h, p);
+    double t3 = prof ? now_s() : 0;
+    size_t seg_start, seg_len;
+    segment(piece, a->n, a->me, &seg_start, &seg_len);
+    if (seg_len)
+      fold_segment(a, seg_start, seg_len, dt, op,
+                   a->result() + seg_start * esz);
+    h->seg_done[a->me].store(p, std::memory_order_release);
+    bump(h);
+    double t4 = prof ? now_s() : 0;
+    wait_folded(h, p);
+    double t5 = prof ? now_s() : 0;
+    std::memcpy(dst + done * esz, a->result(), piece * esz);
+    h->acked[a->me].store(p, std::memory_order_release);
+    bump(h);
+    if (prof) {
+      double t6 = now_s();
+      a->t_stage += t2 - t1;
+      a->t_wait_staged += t3 - t2;
+      a->t_fold += t4 - t3;
+      a->t_wait_folded += t5 - t4;
+      a->t_out += t6 - t5;
+    }
+  });
+}
+
+void reduce(Arena* a, const void* in, void* out, size_t count, DType dt,
+            ReduceOp op, int root) {
+  Hdr* h = a->h;
+  size_t esz = dtype_size(dt);
+  const uint8_t* src = static_cast<const uint8_t*>(in);
+  uint8_t* dst = static_cast<uint8_t*>(out);
+  for_pieces(a, count, h->cap / esz, [&](size_t done, size_t piece,
+                                         uint64_t p) {
+    std::memcpy(a->slot(a->me), src + done * esz, piece * esz);
+    h->staged[a->me].store(p, std::memory_order_release);
+    bump(h);
+    wait_staged(h, p);
+    size_t seg_start, seg_len;
+    segment(piece, a->n, a->me, &seg_start, &seg_len);
+    if (seg_len)
+      fold_segment(a, seg_start, seg_len, dt, op,
+                   a->result() + seg_start * esz);
+    h->seg_done[a->me].store(p, std::memory_order_release);
+    bump(h);
+    if (a->me == root) {
+      wait_folded(h, p);
+      std::memcpy(dst + done * esz, a->result(), piece * esz);
+    }
+    h->acked[a->me].store(p, std::memory_order_release);
+    bump(h);
+  });
+}
+
+void scan(Arena* a, const void* in, void* out, size_t count, DType dt,
+          ReduceOp op) {
+  // Inclusive prefix: rank r folds slots[0..r].  O(n^2) total combine
+  // work across ranks, but each rank's pass is one cache-chunked sweep.
+  Hdr* h = a->h;
+  size_t esz = dtype_size(dt);
+  for_pieces(a, count, h->cap / esz, [&](size_t done, size_t piece,
+                                         uint64_t p) {
+    const uint8_t* src = static_cast<const uint8_t*>(in);
+    uint8_t* dst = static_cast<uint8_t*>(out);
+    std::memcpy(a->slot(a->me), src + done * esz, piece * esz);
+    h->staged[a->me].store(p, std::memory_order_release);
+    bump(h);
+    // need slots 0..me staged; waiting for all keeps the gates uniform
+    wait_staged(h, p);
+    size_t chunk_el = kFoldChunkBytes / esz;
+    if (chunk_el == 0) chunk_el = 1;
+    for (size_t off = 0; off < piece; off += chunk_el) {
+      size_t m = piece - off < chunk_el ? piece - off : chunk_el;
+      uint8_t* acc = dst + (done + off) * esz;
+      std::memcpy(acc, a->slot(0) + off * esz, m * esz);
+      for (int k = 1; k <= a->me; ++k)
+        detail::combine(op, dt, a->slot(k) + off * esz, acc, m);
+    }
+    h->seg_done[a->me].store(p, std::memory_order_release);
+    h->acked[a->me].store(p, std::memory_order_release);
+    bump(h);
+  });
+}
+
+void bcast(Arena* a, void* buf, size_t nbytes, int root) {
+  Hdr* h = a->h;
+  uint8_t* b = static_cast<uint8_t*>(buf);
+  for_pieces(a, nbytes, h->cap, [&](size_t done, size_t piece, uint64_t p) {
+    if (a->me == root) {
+      std::memcpy(a->result(), b + done, piece);
+      h->staged[a->me].store(p, std::memory_order_release);
+      bump(h);
+    } else {
+      wait_for(h, [&] {
+        return h->staged[root].load(std::memory_order_acquire) >= p;
+      });
+      std::memcpy(b + done, a->result(), piece);
+      h->staged[a->me].store(p, std::memory_order_release);
+    }
+    h->seg_done[a->me].store(p, std::memory_order_release);
+    h->acked[a->me].store(p, std::memory_order_release);
+    bump(h);
+  });
+}
+
+void allgather(Arena* a, const void* in, void* out, size_t nbytes_each) {
+  Hdr* h = a->h;
+  const uint8_t* src = static_cast<const uint8_t*>(in);
+  uint8_t* dst = static_cast<uint8_t*>(out);
+  for_pieces(a, nbytes_each, h->cap, [&](size_t done, size_t piece,
+                                         uint64_t p) {
+    std::memcpy(a->slot(a->me), src + done, piece);
+    h->staged[a->me].store(p, std::memory_order_release);
+    bump(h);
+    wait_staged(h, p);
+    for (int k = 0; k < a->n; ++k)
+      std::memcpy(dst + k * nbytes_each + done, a->slot(k), piece);
+    h->seg_done[a->me].store(p, std::memory_order_release);
+    h->acked[a->me].store(p, std::memory_order_release);
+    bump(h);
+  });
+}
+
+void gather(Arena* a, const void* in, void* out, size_t nbytes_each,
+            int root) {
+  Hdr* h = a->h;
+  const uint8_t* src = static_cast<const uint8_t*>(in);
+  uint8_t* dst = static_cast<uint8_t*>(out);
+  for_pieces(a, nbytes_each, h->cap, [&](size_t done, size_t piece,
+                                         uint64_t p) {
+    std::memcpy(a->slot(a->me), src + done, piece);
+    h->staged[a->me].store(p, std::memory_order_release);
+    bump(h);
+    if (a->me == root) {
+      wait_staged(h, p);
+      for (int k = 0; k < a->n; ++k)
+        std::memcpy(dst + k * nbytes_each + done, a->slot(k), piece);
+    }
+    h->seg_done[a->me].store(p, std::memory_order_release);
+    h->acked[a->me].store(p, std::memory_order_release);
+    bump(h);
+  });
+}
+
+void scatter(Arena* a, const void* in, void* out, size_t nbytes_each,
+             int root) {
+  Hdr* h = a->h;
+  // root's input is n blocks of nbytes_each; stream block-piece-wise so
+  // a block piece always fits the (shared) result buffer
+  const uint8_t* src = static_cast<const uint8_t*>(in);
+  uint8_t* dst = static_cast<uint8_t*>(out);
+  size_t blk_cap = h->cap / static_cast<size_t>(a->n);
+  if (blk_cap == 0) blk_cap = 1;
+  for_pieces(a, nbytes_each, blk_cap, [&](size_t done, size_t piece,
+                                          uint64_t p) {
+    if (a->me == root) {
+      uint8_t* r = a->result();
+      for (int k = 0; k < a->n; ++k)
+        std::memcpy(r + k * piece, src + k * nbytes_each + done, piece);
+      std::memcpy(dst + done, src + root * nbytes_each + done, piece);
+      h->staged[a->me].store(p, std::memory_order_release);
+      bump(h);
+    } else {
+      wait_for(h, [&] {
+        return h->staged[root].load(std::memory_order_acquire) >= p;
+      });
+      std::memcpy(dst + done, a->result() + a->me * piece, piece);
+      h->staged[a->me].store(p, std::memory_order_release);
+    }
+    h->seg_done[a->me].store(p, std::memory_order_release);
+    h->acked[a->me].store(p, std::memory_order_release);
+    bump(h);
+  });
+}
+
+void alltoall(Arena* a, const void* in, void* out, size_t nbytes_each) {
+  Hdr* h = a->h;
+  const uint8_t* src = static_cast<const uint8_t*>(in);
+  uint8_t* dst = static_cast<uint8_t*>(out);
+  size_t blk_cap = h->cap / static_cast<size_t>(a->n);
+  if (blk_cap == 0) blk_cap = 1;
+  for_pieces(a, nbytes_each, blk_cap, [&](size_t done, size_t piece,
+                                          uint64_t p) {
+    uint8_t* s = a->slot(a->me);
+    for (int k = 0; k < a->n; ++k)
+      std::memcpy(s + k * piece, src + k * nbytes_each + done, piece);
+    h->staged[a->me].store(p, std::memory_order_release);
+    bump(h);
+    wait_staged(h, p);
+    for (int k = 0; k < a->n; ++k)
+      std::memcpy(dst + k * nbytes_each + done, a->slot(k) + a->me * piece,
+                  piece);
+    h->seg_done[a->me].store(p, std::memory_order_release);
+    h->acked[a->me].store(p, std::memory_order_release);
+    bump(h);
+  });
+}
+
+void barrier(Arena* a) {
+  Hdr* h = a->h;
+  for_pieces(a, 0, 1, [&](size_t, size_t, uint64_t p) {
+    h->staged[a->me].store(p, std::memory_order_release);
+    bump(h);
+    wait_staged(h, p);
+    h->seg_done[a->me].store(p, std::memory_order_release);
+    h->acked[a->me].store(p, std::memory_order_release);
+    bump(h);
+  });
+}
+
+}  // namespace shm
+}  // namespace t4j

@@ -1,0 +1,75 @@
+// Same-host shared-memory collective arena (internal).
+//
+// The reference's intra-host data plane is libmpi's shared-memory BTL,
+// which its bridge inherits for free (mpi_xla_bridge.pyx:149-167 just
+// calls MPI_Allreduce).  This is the native equivalent for the DCN
+// bridge: when every member of a communicator lives on one host, its
+// collectives run through a POSIX shm segment — per-rank contribution
+// slots plus a result buffer, synchronized with futex-backed monotone
+// counters — instead of the TCP frame path.  Cross-host communicators
+// keep the TCP algorithms (dcn.cc).
+//
+// Memory traffic per allreduce of S bytes over n ranks: n stage-in
+// copies (n*S), one segment-parallel fold (each rank folds its 1/n of
+// the result across all n slots: ~(n+1)*S read+write total), n
+// copy-outs (n*S) — the minimum a one-copy-in/one-copy-out shm design
+// can do.  On a multi-core host the per-rank copies and per-segment
+// folds run concurrently; on a single core the total is the bound (see
+// docs/performance.md "single-core ceiling").
+
+#pragma once
+
+#include <cstddef>
+
+#include "dcn.h"
+
+namespace t4j {
+namespace shm {
+
+struct Arena;  // opaque
+
+// Two-phase setup, driven by dcn.cc's agreement protocol (the comm's
+// member 0 creates and fully initialises the segment, THEN the others
+// attach — orderd by TCP agreement rounds, so attachers never poll and
+// a failed rank makes every member fall back to TCP together):
+//   create: unlink any stale segment, create O_EXCL, init header.
+//   attach: open the existing segment (no O_CREAT), validate.
+// Either returns nullptr on failure (caller must then agree the whole
+// comm onto the TCP path).  T4J_NO_SHM=1 disables shm entirely.
+// `job` uniquely names the launcher job; `ctx` the comm.
+Arena* create(const char* job, int ctx, int n);
+Arena* attach(const char* job, int ctx, int n, int my_index);
+
+bool disabled();  // T4J_NO_SHM / n-range gate shared with dcn.cc
+
+// Remove the segment NAME once every member has attached (the mappings
+// stay valid).  After this, no crash/abort path can leak the segment:
+// the kernel frees the tmpfs pages when the last member's mapping dies
+// with its process.
+void unlink_name(Arena* a);
+
+void destroy(Arena* a);  // munmap (+ unlink from the creator)
+
+void allreduce(Arena* a, const void* in, void* out, size_t count, DType dt,
+               ReduceOp op);
+void reduce(Arena* a, const void* in, void* out, size_t count, DType dt,
+            ReduceOp op, int root);
+void scan(Arena* a, const void* in, void* out, size_t count, DType dt,
+          ReduceOp op);
+void bcast(Arena* a, void* buf, size_t nbytes, int root);
+void allgather(Arena* a, const void* in, void* out, size_t nbytes_each);
+void gather(Arena* a, const void* in, void* out, size_t nbytes_each,
+            int root);
+void scatter(Arena* a, const void* in, void* out, size_t nbytes_each,
+             int root);
+void alltoall(Arena* a, const void* in, void* out, size_t nbytes_each);
+void barrier(Arena* a);
+
+}  // namespace shm
+
+namespace detail {
+// dtype-dispatched pairwise combine (implemented in dcn.cc): acc op= a.
+void combine(ReduceOp op, DType dt, const void* a, void* acc, size_t count);
+}  // namespace detail
+
+}  // namespace t4j

@@ -325,6 +325,18 @@ _debug_timers = {}
 _debug_timers_mu = threading.Lock()
 
 
+def _debug_emit(line):
+    """One atomic line to stdout: concurrent executions emit from
+    multiple callback threads, and ``print`` writes text and newline
+    separately — torn lines would corrupt the debug-log wire format the
+    observability tests (and any log parser) key on."""
+    import sys
+
+    with _debug_timers_mu:
+        sys.stdout.write(line + "\n")
+        sys.stdout.flush()
+
+
 def _scalar(v):
     """First element of a possibly-batched callback operand (vmap may
     hand the callback a stacked value; the id is replicated)."""
@@ -400,10 +412,7 @@ def _debug_begin(name, args, kwargs, comm):
             while len(_debug_timers) >= 4096:
                 _debug_timers.pop(next(iter(_debug_timers)))
             _debug_timers[(r, i)] = time.perf_counter_ns()
-        print(
-            f"r{r} | {_rid_str(i)} | {opname} with {nitems} items",
-            flush=True,
-        )
+        _debug_emit(f"r{r} | {_rid_str(i)} | {opname} with {nitems} items")
 
     deps = (arr,) if arr is not None else ()
     jax.debug.callback(begin_cb, jnp.asarray(rank), rid, *deps)
@@ -422,10 +431,8 @@ def _debug_end(state, out):
                 (r, i), (_scalar(t_hi) << 31) + _scalar(t_lo)
             )
         dt = (time.perf_counter_ns() - t0_ns) / 1e9
-        print(
-            f"r{r} | {_rid_str(i)} | {opname} done with code 0 "
-            f"({dt:.2e}s)",
-            flush=True,
+        _debug_emit(
+            f"r{r} | {_rid_str(i)} | {opname} done with code 0 ({dt:.2e}s)"
         )
 
     arr = _first_array(out)

@@ -36,13 +36,15 @@ def batch(seed=0):
     return tokens, jnp.roll(tokens, -1, axis=1)
 
 
-def test_pp_train_step_matches_oracle(mesh2d, comms):
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pp_train_step_matches_oracle(mesh2d, comms, schedule):
     comm_dp, comm_pp = comms
     params = ppt.init_params(jax.random.PRNGKey(1), CFG)
     tokens, targets = batch()
 
     step = ppt.make_global_train_step(
-        mesh2d, comm_dp, comm_pp, CFG, n_micro=2, lr=1e-1
+        mesh2d, comm_dp, comm_pp, CFG, n_micro=2, lr=1e-1,
+        schedule=schedule,
     )
     new_params, loss = step(params, (tokens, targets))
 
@@ -68,25 +70,29 @@ def test_pp_train_step_matches_oracle(mesh2d, comms):
 
 
 @pytest.mark.parametrize("n_micro", [1, 4])
-def test_pp_microbatch_count_invariance(mesh2d, comms, n_micro):
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pp_microbatch_count_invariance(mesh2d, comms, schedule, n_micro):
     # the schedule (bubble pattern) must not change the math
     comm_dp, comm_pp = comms
     params = ppt.init_params(jax.random.PRNGKey(2), CFG)
     tokens, targets = batch(seed=3)
     step = ppt.make_global_train_step(
-        mesh2d, comm_dp, comm_pp, CFG, n_micro=n_micro, lr=1e-1
+        mesh2d, comm_dp, comm_pp, CFG, n_micro=n_micro, lr=1e-1,
+        schedule=schedule,
     )
     _, loss = step(params, (tokens, targets))
     ref = float(ppt.reference_loss(params, tokens, targets, CFG))
     np.testing.assert_allclose(float(np.asarray(loss)[0]), ref, rtol=2e-5)
 
 
-def test_pp_loss_decreases(mesh2d, comms):
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pp_loss_decreases(mesh2d, comms, schedule):
     comm_dp, comm_pp = comms
     params = ppt.init_params(jax.random.PRNGKey(4), CFG)
     tokens, targets = batch(seed=5)
     step = ppt.make_global_train_step(
-        mesh2d, comm_dp, comm_pp, CFG, n_micro=2, lr=3e-1
+        mesh2d, comm_dp, comm_pp, CFG, n_micro=2, lr=3e-1,
+        schedule=schedule,
     )
     losses = []
     for _ in range(8):

@@ -1,0 +1,137 @@
+"""Same-host shm arena (native/src/shm.cc): the DCN bridge's intra-host
+transport.  Verifies (a) the full collective battery is correct through
+the arena at 8 ranks, (b) payloads larger than the slot capacity stream
+piece-wise, (c) the TCP frame algorithms still work when the arena is
+disabled (the cross-host fallback), and (d) both transports agree.
+
+Reference analog: libmpi's shm BTL serves the reference's intra-host
+ranks transparently (mpi_xla_bridge.pyx:149-167); `mpirun -np N` on one
+machine exercises it the same way this file drives the launcher.
+"""
+
+import pytest
+
+from tests.proc.test_proc_backend import run_workers, PREAMBLE
+
+_BATTERY = """
+import os
+x = jnp.arange(24.0).reshape(4, 6) + 100 * rank
+
+y, tok = m.allreduce(x, m.SUM, comm=comm)
+want = sum(np.arange(24.0).reshape(4, 6) + 100 * r for r in range(size))
+assert np.allclose(np.asarray(y), want), "allreduce"
+
+mx, tok = m.allreduce(x, m.MAX, comm=comm, token=tok)
+assert np.allclose(np.asarray(mx),
+                   np.arange(24.0).reshape(4, 6) + 100 * (size - 1)), "max"
+
+b, tok = m.bcast(x if rank == 2 else jnp.zeros_like(x), 2, comm=comm, token=tok)
+assert np.allclose(np.asarray(b),
+                   np.arange(24.0).reshape(4, 6) + 200), "bcast"
+
+g, tok = m.allgather(jnp.array([float(rank)]), comm=comm, token=tok)
+assert np.allclose(np.asarray(g).ravel(), np.arange(size)), "allgather"
+
+r, tok = m.reduce(x, m.SUM, 1, comm=comm, token=tok)
+if rank == 1:
+    assert np.allclose(np.asarray(r), want), "reduce root"
+else:
+    assert np.allclose(np.asarray(r), x), "reduce off-root"
+
+s, tok = m.scan(jnp.array([float(rank + 1)]), m.SUM, comm=comm, token=tok)
+assert np.allclose(np.asarray(s), sum(range(1, rank + 2))), "scan"
+
+a2, tok = m.alltoall(jnp.arange(float(size)) + 100 * rank, comm=comm, token=tok)
+assert np.allclose(np.asarray(a2), 100 * np.arange(size) + rank), "alltoall"
+
+if rank == 0:
+    payload = jnp.arange(float(size * 3)).reshape(size, 3)
+else:
+    payload = jnp.zeros((3,))
+sc, tok = m.scatter(payload, 0, comm=comm, token=tok)
+assert np.allclose(np.asarray(sc), [3 * rank, 3 * rank + 1, 3 * rank + 2]), "scatter"
+
+ga, tok = m.gather(jnp.full((2,), float(rank)), 0, comm=comm, token=tok)
+if rank == 0:
+    assert np.allclose(np.asarray(ga), np.repeat(np.arange(size), 2).reshape(size, 2)), "gather"
+
+tok = m.barrier(comm=comm, token=tok)
+
+# sub-communicator (own arena, distinct ctx): evens and odds
+sub = comm.split(color=lambda r: r % 2, key=lambda r: r)
+z, _ = m.allreduce(jnp.array([float(rank)]), m.SUM, comm=sub)
+members = [r for r in range(size) if r % 2 == rank % 2]
+assert np.allclose(np.asarray(z), float(sum(members))), "split allreduce"
+
+print(f"WORKER_OK {rank}", flush=True)
+"""
+
+
+def _check(proc, n):
+    for r in range(n):
+        assert f"WORKER_OK {r}" in proc.stdout, (proc.stdout, proc.stderr)
+
+
+def test_arena_battery_8_ranks():
+    _check(run_workers(PREAMBLE + _BATTERY, nprocs=8), 8)
+
+
+def test_arena_multi_piece_streaming():
+    # payloads >> slot capacity: T4J_SHM_SLOT_MB=1 forces piece-wise
+    # streaming (3 MB payload -> 3+ pieces per collective)
+    proc = run_workers(
+        PREAMBLE
+        + """
+n = 750_000  # 3 MB of f32
+x = jnp.arange(float(n)) * (rank + 1)
+y, tok = m.allreduce(x, m.SUM, comm=comm)
+total = sum(range(1, size + 1))
+assert np.allclose(np.asarray(y), np.arange(float(n)) * total), "large allreduce"
+b, tok = m.bcast(x if rank == 0 else jnp.zeros(n), 0, comm=comm, token=tok)
+assert np.allclose(np.asarray(b), np.arange(float(n))), "large bcast"
+g, tok = m.allgather(x[:200_000], comm=comm, token=tok)
+assert np.allclose(
+    np.asarray(g),
+    np.stack([np.arange(200_000.0) * (r + 1) for r in range(size)]),
+), "large allgather"
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=3,
+        env={"T4J_SHM_SLOT_MB": "1"},
+    )
+    _check(proc, 3)
+
+
+def test_tcp_fallback_agrees():
+    # T4J_NO_SHM=1 must route through the TCP frame algorithms (the
+    # cross-host path) and produce identical results
+    proc = run_workers(
+        PREAMBLE + _BATTERY, nprocs=4, env={"T4J_NO_SHM": "1"}
+    )
+    _check(proc, 4)
+
+
+def test_arena_dtypes():
+    # the arena folds raw bytes via the shared combine table: cover the
+    # non-f32 dtypes incl. the half types that reduce via float
+    proc = run_workers(
+        PREAMBLE
+        + """
+for dt, op, want in [
+    ("float64", m.SUM, float(sum(range(1, size + 1)))),
+    ("int32", m.PROD, float(np.prod(np.arange(1, size + 1)))),
+    ("int64", m.MAX, float(size)),
+    ("bfloat16", m.SUM, float(sum(range(1, size + 1)))),
+    ("float16", m.MIN, 1.0),
+]:
+    v = (jnp.ones((17,)) * (rank + 1)).astype(dt)
+    y, _ = m.allreduce(v, op, comm=comm)
+    assert np.allclose(np.asarray(y).astype("float64"), want), (dt, np.asarray(y))
+b = jnp.arange(8) % 2 == 0 if rank == 0 else jnp.zeros(8, bool)
+y, _ = m.allreduce(b, m.LOR, comm=comm)
+assert np.array_equal(np.asarray(y), np.arange(8) % 2 == 0), "bool lor"
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=4,
+    )
+    _check(proc, 4)

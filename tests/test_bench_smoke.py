@@ -75,7 +75,7 @@ def test_bench_calibrations_run_on_cpu():
 
     gbps = bench.hbm_copy_bandwidth(mb=8, chain=2, reps=2)
     assert np.isfinite(gbps) and gbps > 0
-    tflops = bench.matmul_roofline_tflops(dim=256, chain=2, reps=2)
+    tflops = bench.matmul_roofline_tflops(shapes=((256, 2),), reps=2)
     assert np.isfinite(tflops) and tflops > 0
 
 
