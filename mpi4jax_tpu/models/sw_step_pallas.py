@@ -1,5 +1,23 @@
 """Fused Pallas TPU kernels for the shallow-water wide-halo step.
 
+.. admonition:: RETIRED — research appendix, not a production path
+   (round 4)
+
+   Nothing in the package selects these kernels; the XLA step is the
+   default everywhere and the only benched path.  On the target
+   runtime the kernel is **measurably slower** (5.8 ms vs 3.3 ms per
+   step): the stencil's shifted reads lower to Mosaic lane-roll /
+   sublane-shift shuffles that run at the measured 0.03–0.05 Tops/s
+   VPU-shuffle floor, so the kernel is shuffle-bound long before its
+   HBM-traffic savings (the design goal below) can matter — and that
+   bound is structural to the stencil shape, not a block-size tuning
+   issue (docs/shallow-water.md "Hardware calibration notes").  The
+   module stays in the tree as (a) the equivalence-tested record of
+   why the XLA path is the default, and (b) a ready scaffold for
+   hardware/toolchains where the shuffle-vs-bandwidth tradeoff flips.
+   The flash-attention kernel (ops/flash.py) is the package's
+   rent-paying Pallas path.
+
 The XLA form of :func:`mpi4jax_tpu.models.shallow_water._step_wide`
 materialises ~10 intermediate full-size fields per step (hc, fluxes,
 vorticity, kinetic energy, viscosity gradients), each a full HBM

@@ -68,8 +68,8 @@ def _load():
     i32p = ctypes.POINTER(ctypes.c_int32)
     lib.t4j_c_send.argtypes = [i32, vp, u64, i32, i32]
     lib.t4j_c_recv.argtypes = [i32, vp, u64, i32, i32, i32p, i32p]
-    lib.t4j_c_sendrecv.argtypes = [i32, vp, vp, u64, i32, i32, i32, i32,
-                                   i32p, i32p]
+    lib.t4j_c_sendrecv.argtypes = [i32, vp, u64, vp, u64, i32, i32, i32,
+                                   i32, i32p, i32p]
     lib.t4j_c_barrier.argtypes = [i32]
     lib.t4j_c_bcast.argtypes = [i32, vp, u64, i32]
     lib.t4j_c_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
@@ -243,8 +243,8 @@ def host_sendrecv(handle, sendbuf, recvbuf, source, dest, sendtag, recvtag):
     src = ctypes.c_int32(0)
     tg = ctypes.c_int32(0)
     _state["lib"].t4j_c_sendrecv(
-        handle, _ptr(sendbuf), _ptr(out), out.nbytes, source, dest,
-        sendtag, recvtag, ctypes.byref(src), ctypes.byref(tg),
+        handle, _ptr(sendbuf), sendbuf.nbytes, _ptr(out), out.nbytes,
+        source, dest, sendtag, recvtag, ctypes.byref(src), ctypes.byref(tg),
     )
     return out, np.int32(src.value), np.int32(tg.value)
 

@@ -965,20 +965,21 @@ void recv(int comm, void* buf, size_t nbytes, int source, int tag,
   if (tag_out) *tag_out = f.tag;
 }
 
-void sendrecv(int comm, const void* sendbuf, void* recvbuf, size_t nbytes,
-              int source, int dest, int sendtag, int recvtag, int* src_out,
-              int* tag_out) {
+void sendrecv(int comm, const void* sendbuf, size_t send_nbytes,
+              void* recvbuf, size_t recv_nbytes, int source, int dest,
+              int sendtag, int recvtag, int* src_out, int* tag_out) {
   Comm& c = get_comm(comm);
   LogScope log("MPI_Sendrecv", "<- " + std::to_string(source) +
                                  " (tag " + std::to_string(recvtag) +
                                  ") / -> " + std::to_string(dest) +
                                  " (tag " + std::to_string(sendtag) + ")");
   // eager sends cannot block: send first, then receive (the pattern the
-  // reference's deadlock test guards, test_send_and_recv.py:104-117)
-  csend(c, dest, sendtag, sendbuf, nbytes, /*coll=*/false);
+  // reference's deadlock test guards, test_send_and_recv.py:104-117).
+  // Send and recv sizes are independent (MPI_Sendrecv semantics).
+  csend(c, dest, sendtag, sendbuf, send_nbytes, /*coll=*/false);
   Frame f = crecv(c, source, recvtag, /*coll=*/false);
-  if (f.data.size() != nbytes) die("sendrecv size mismatch");
-  std::memcpy(recvbuf, f.data.data(), nbytes);
+  if (f.data.size() != recv_nbytes) die("sendrecv size mismatch");
+  std::memcpy(recvbuf, f.data.data(), recv_nbytes);
   if (src_out) {
     *src_out = 0;
     for (size_t i = 0; i < c.ranks.size(); ++i)

@@ -83,9 +83,11 @@ void send(int comm, const void* buf, size_t nbytes, int dest, int tag);
 // matched envelope. nbytes must match the message size exactly.
 void recv(int comm, void* buf, size_t nbytes, int source, int tag,
           int* src_out, int* tag_out);
-void sendrecv(int comm, const void* sendbuf, void* recvbuf, size_t nbytes,
-              int source, int dest, int sendtag, int recvtag, int* src_out,
-              int* tag_out);
+// Send and receive sizes are independent, as in MPI_Sendrecv (the
+// reference allows differing buffer shapes, sendrecv.py:41-103).
+void sendrecv(int comm, const void* sendbuf, size_t send_nbytes,
+              void* recvbuf, size_t recv_nbytes, int source, int dest,
+              int sendtag, int recvtag, int* src_out, int* tag_out);
 
 // -- collectives ----------------------------------------------------------
 void barrier(int comm);

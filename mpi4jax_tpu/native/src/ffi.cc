@@ -135,9 +135,9 @@ ffi::Error SendrecvImpl(ffi::AnyBuffer sendbuf, ffi::AnyBuffer recvbuf,
                         int32_t recvtag) {
   (void)recvbuf;
   int src = 0, got_tag = 0;
-  t4j::sendrecv(comm, sendbuf.untyped_data(), y->untyped_data(),
-                y->size_bytes(), source, dest, sendtag, recvtag, &src,
-                &got_tag);
+  t4j::sendrecv(comm, sendbuf.untyped_data(), sendbuf.size_bytes(),
+                y->untyped_data(), y->size_bytes(), source, dest, sendtag,
+                recvtag, &src, &got_tag);
   auto* st = static_cast<int32_t*>(status->untyped_data());
   st[0] = src;
   st[1] = got_tag;
@@ -329,13 +329,14 @@ void t4j_c_recv(int32_t comm, void* buf, uint64_t nbytes, int32_t source,
   if (src_out) *src_out = s;
   if (tag_out) *tag_out = t;
 }
-void t4j_c_sendrecv(int32_t comm, const void* sendbuf, void* recvbuf,
-                    uint64_t nbytes, int32_t source, int32_t dest,
+void t4j_c_sendrecv(int32_t comm, const void* sendbuf,
+                    uint64_t send_nbytes, void* recvbuf,
+                    uint64_t recv_nbytes, int32_t source, int32_t dest,
                     int32_t sendtag, int32_t recvtag, int32_t* src_out,
                     int32_t* tag_out) {
   int s = 0, t = 0;
-  t4j::sendrecv(comm, sendbuf, recvbuf, nbytes, source, dest, sendtag,
-                recvtag, &s, &t);
+  t4j::sendrecv(comm, sendbuf, send_nbytes, recvbuf, recv_nbytes, source,
+                dest, sendtag, recvtag, &s, &t);
   if (src_out) *src_out = s;
   if (tag_out) *tag_out = t;
 }

@@ -12,7 +12,6 @@
 #include <unistd.h>
 
 #include <atomic>
-#include <cerrno>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -57,13 +56,6 @@ struct Hdr {
   std::atomic<uint64_t> acked[kMaxRanks];     // pieces fully consumed
 };
 
-[[noreturn]] void die(const char* what) {
-  std::fprintf(stderr, "t4j shm arena: %s failed (errno %d); aborting job\n",
-               what, errno);
-  std::fflush(stderr);
-  _exit(13);
-}
-
 void futex_wait(std::atomic<uint32_t>* w, uint32_t val) {
   timespec ts{2, 0};  // bounded: re-check the predicate at least every 2s
   syscall(SYS_futex, reinterpret_cast<uint32_t*>(w), FUTEX_WAIT, val, &ts,
@@ -81,16 +73,25 @@ double now_s() {
   return ts.tv_sec + 1e-9 * ts.tv_nsec;
 }
 
-double wait_limit_s() {
+// T4J_SHM_TIMEOUT (seconds) opts into fail-fast aborts on a stalled
+// collective; unset, a stall WARNS once and keeps waiting — matching
+// the TCP transport, which blocks indefinitely (a slow peer compiling
+// a big program must not convert into a killed job).
+double wait_warn_s() { return 300.0; }
+
+double wait_abort_s() {
   static double lim = [] {
     const char* s = std::getenv("T4J_SHM_TIMEOUT");
-    double v = s ? std::atof(s) : 300.0;
-    return v > 0 ? v : 300.0;
+    return s ? std::atof(s) : 0.0;  // 0 = never abort
   }();
   return lim;
 }
 
 }  // namespace
+
+constexpr size_t hdr_span() {
+  return (sizeof(Hdr) + kAlign - 1) & ~(kAlign - 1);
+}
 
 struct Arena {
   Hdr* h = nullptr;
@@ -106,10 +107,10 @@ struct Arena {
          t_wait_folded = 0, t_out = 0;
 
   uint8_t* slot(int r) const {
-    return base + sizeof(Hdr) + static_cast<size_t>(r) * h->cap;
+    return base + hdr_span() + static_cast<size_t>(r) * h->cap;
   }
   uint8_t* result() const {
-    return base + sizeof(Hdr) + static_cast<size_t>(n) * h->cap;
+    return base + hdr_span() + static_cast<size_t>(n) * h->cap;
   }
 };
 
@@ -136,6 +137,7 @@ void wait_for(Hdr* h, Pred ok) {
     ::sched_yield();
   }
   double t0 = now_s();
+  bool warned = false;
   for (;;) {
     uint32_t seen = h->progress.load(std::memory_order_acquire);
     if (ok()) return;
@@ -143,11 +145,22 @@ void wait_for(Hdr* h, Pred ok) {
     if (!ok()) futex_wait(&h->progress, seen);
     h->waiters.fetch_sub(1, std::memory_order_acq_rel);
     if (ok()) return;
-    if (now_s() - t0 > wait_limit_s()) {
+    double waited = now_s() - t0;
+    if (!warned && waited > wait_warn_s()) {
+      warned = true;
       std::fprintf(stderr,
-                   "t4j shm arena: collective stalled > %.0fs (deadlock or "
-                   "dead peer); aborting job\n",
-                   wait_limit_s());
+                   "t4j shm arena: collective waiting > %.0fs for a peer "
+                   "(slow rank or deadlock); still waiting — set "
+                   "T4J_SHM_TIMEOUT=<s> for fail-fast abort\n",
+                   wait_warn_s());
+      std::fflush(stderr);
+    }
+    double abort_s = wait_abort_s();
+    if (abort_s > 0 && waited > abort_s) {
+      std::fprintf(stderr,
+                   "t4j shm arena: collective stalled > %.0fs "
+                   "(T4J_SHM_TIMEOUT); aborting job\n",
+                   abort_s);
       std::fflush(stderr);
       _exit(13);
     }
@@ -191,6 +204,14 @@ void segment(size_t count, int n, int r, size_t* start, size_t* len) {
 // zero-length case running exactly one synchronization piece so empty
 // payloads still order like collectives.  The per-op body receives
 // (done_units, piece_units, p) and must end by storing acked[me]=p.
+bool prof_enabled() {
+  static const bool on = [] {
+    const char* s = std::getenv("T4J_SHM_PROF");
+    return s && s[0] && std::strcmp(s, "0") != 0;
+  }();
+  return on;
+}
+
 template <class Body>
 void for_pieces(Arena* a, size_t total_units, size_t cap_units, Body body) {
   for (size_t done = 0; done < total_units || done == 0;
@@ -198,7 +219,9 @@ void for_pieces(Arena* a, size_t total_units, size_t cap_units, Body body) {
     size_t left = total_units - done;
     size_t piece = left < cap_units ? left : cap_units;
     uint64_t p = ++a->pieces;
+    double t0 = prof_enabled() ? now_s() : 0;
     wait_consumed(a->h, p);
+    if (prof_enabled()) a->t_gate += now_s() - t0;
     body(done, piece, p);
     if (total_units == 0) break;
   }
@@ -235,8 +258,9 @@ void arena_name(char* buf, size_t bufsz, const char* job, int ctx) {
 }
 
 size_t arena_total(int n, size_t cap) {
-  size_t total = sizeof(Hdr) + (static_cast<size_t>(n) + 1) * cap;
-  return (total + kAlign - 1) & ~(kAlign - 1);
+  // hdr_span (not sizeof) so slot 0 and everything after start
+  // cache-line-aligned
+  return hdr_span() + (static_cast<size_t>(n) + 1) * cap;
 }
 
 Arena* map_arena(int fd, const char* name, int n, size_t total,
@@ -359,10 +383,7 @@ void allreduce(Arena* a, const void* in, void* out, size_t count, DType dt,
   size_t esz = dtype_size(dt);
   const uint8_t* src = static_cast<const uint8_t*>(in);
   uint8_t* dst = static_cast<uint8_t*>(out);
-  static const bool prof = [] {
-    const char* s = std::getenv("T4J_SHM_PROF");
-    return s && s[0] && std::strcmp(s, "0") != 0;
-  }();
+  const bool prof = prof_enabled();
   for_pieces(a, count, h->cap / esz, [&](size_t done, size_t piece,
                                          uint64_t p) {
     double t1 = prof ? now_s() : 0;

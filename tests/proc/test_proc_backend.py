@@ -391,3 +391,30 @@ print(f"WORKER_OK {rank}", flush=True)
         nprocs=2,
         timeout=90,
     )
+
+
+def test_sendrecv_differing_shapes():
+    # MPI_Sendrecv allows the send and recv buffers to differ in shape
+    # (reference sendrecv.py:41-103); the mesh tier cannot express this
+    # (uniform SPMD wire) but the proc tier must — with the send size
+    # taken from the SEND buffer, not the recv template (a round-4 fix:
+    # the bridge used to read send bytes at the recv size).
+    run_workers(
+        PREAMBLE
+        + """
+# ring: rank sends (rank+1)*2 elements, receives from the left
+send = jnp.full(((rank + 1) * 2,), float(rank))
+left = (rank - 1) % size
+recv_template = jnp.zeros((left + 1) * 2)
+st = m.Status()
+y, tok = m.sendrecv(
+    send, recv_template, source=left, dest=(rank + 1) % size,
+    comm=comm, status=st,
+)
+assert y.shape == ((left + 1) * 2,), y.shape
+assert np.allclose(np.asarray(y), float(left)), np.asarray(y)
+assert int(np.asarray(st.source)) == left
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=3,
+    )
