@@ -34,6 +34,37 @@ _INF = float("inf")
 _LANES = 128  # TPU lane width: scratch statistics are (block_q, _LANES)
 
 
+def _tri_iq_ik(t):
+    """Row-major lower-triangle index: flat ``t`` -> (iq, ik) with
+    ik <= iq.  The float sqrt is exact for any realistic block count;
+    the two `where` guards absorb boundary roundoff anyway."""
+    tf = t.astype(jnp.float32)
+    iq = jnp.floor((jnp.sqrt(8.0 * tf + 1.0) - 1.0) / 2.0).astype(jnp.int32)
+    tri = iq * (iq + 1) // 2
+    iq = jnp.where(t < tri, iq - 1, iq)
+    iq = jnp.where(t >= (iq + 1) * (iq + 2) // 2, iq + 1, iq)
+    ik = t - iq * (iq + 1) // 2
+    return iq, ik
+
+
+def _tri_gate(causal, q_offset, k_offset, tq, tk, pad_q, pad_k, block_q,
+              block_k):
+    """True when the squashed-triangle causal grid applies: square
+    unsharded causal attention with no padding and equal blocks.  The
+    triangle grid visits only the ~half of the blocks the causal mask
+    keeps (and masks only the diagonal ones), measured ~1.4x over the
+    rectangular grid at seq 8192 (docs/performance.md); sharded
+    (offset) and padded cases keep the general rectangular path."""
+    return (
+        causal
+        and q_offset == k_offset
+        and tq == tk
+        and pad_q == 0
+        and pad_k == 0
+        and block_q == block_k
+    )
+
+
 def _union_vma_sds(shape, dtype, *arrays):
     """ShapeDtypeStruct carrying the union of the operands' varying
     manual axes (required by shard_map's vma checking for pallas_call
@@ -64,14 +95,22 @@ def _kernel(
     block_k,
     num_k,
     with_lse,
+    triangle,
 ):
     if with_lse:
         m_out_ref, l_out_ref, acc_ref, m_ref, l_ref = rest
     else:
         m_out_ref, l_out_ref = None, None
         acc_ref, m_ref, l_ref = rest
-    iq = pl.program_id(1)
-    ik = pl.program_id(2)
+    if triangle:
+        # squashed causal grid: only the lower-triangle blocks are
+        # visited (the rest are fully masked anyway), and only the
+        # diagonal block pays the mask/iota VPU work — measured ~1.4x
+        # at seq 8192 over the rectangular grid + full masking
+        iq, ik = _tri_iq_ik(pl.program_id(1))
+    else:
+        iq = pl.program_id(1)
+        ik = pl.program_id(2)
 
     @pl.when(ik == 0)
     def _init():
@@ -79,12 +118,7 @@ def _kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    def _compute():
-        # NB: causal block-SKIPPING (pl.when around this body for fully
-        # masked blocks) was measured and rejected: it read slightly
-        # slower at 2048x2048 (12.3 vs 11.6 ms) — the kernel is
-        # pipeline-bound, and the conditional costs more than the saved
-        # half-block FLOPs.
+    def _compute(mask_causal):
         q = q_ref[0].astype(jnp.float32)  # [bq, D]
         k = k_ref[0].astype(jnp.float32)  # [bk, D]
         s = jax.lax.dot_general(
@@ -92,11 +126,12 @@ def _kernel(
         )
         s = s * scale  # [bq, bk]
 
-        # local (unpadded-array) positions of this block's rows/cols
-        krow = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        if causal:
+        if mask_causal or not triangle:
+            # local (unpadded-array) positions of this block's rows/cols
+            krow = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+        if mask_causal:
             qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -104,11 +139,13 @@ def _kernel(
             # oracle's convention: a fully-masked row degrades to uniform
             # weights over the real keys)
             s = jnp.where(qpos >= k_offset + krow, s, _NEG)
-        # padded K rows are excluded outright (-inf): exp(-inf - m) == 0
-        # for any finite m, and m stays finite because the scratch starts
-        # at _NEG — so padding never contributes to l, matching the
-        # unpadded oracle even for fully-masked rows
-        s = jnp.where(krow < kv_len, s, -_INF)
+        if not triangle:
+            # padded K rows are excluded outright (-inf): exp(-inf - m)
+            # == 0 for any finite m, and m stays finite because the
+            # scratch starts at _NEG — so padding never contributes to
+            # l, matching the unpadded oracle even for fully-masked
+            # rows.  (The triangle path is gated on zero padding.)
+            s = jnp.where(krow < kv_len, s, -_INF)
 
         m_prev = m_ref[:, :1]  # [bq, 1] (lanes replicated)
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
@@ -123,9 +160,25 @@ def _kernel(
             preferred_element_type=jnp.float32,
         )
 
-    _compute()
+    if triangle:
+        @pl.when(ik == iq)
+        def _diag():
+            _compute(True)
 
-    @pl.when(ik == num_k - 1)
+        @pl.when(ik != iq)
+        def _interior():
+            _compute(False)
+    else:
+        # NB on this path causal block-SKIPPING (pl.when around the body
+        # for fully masked blocks) was measured and rejected at 2048
+        # (12.3 vs 11.6 ms); the triangle grid above is the form of
+        # skipping that does pay (no visit, no DMA, no conditional on
+        # the hot interior blocks).
+        _compute(causal)
+
+    last = (ik == iq) if triangle else (ik == num_k - 1)
+
+    @pl.when(last)
     def _finalize():
         o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
         if with_lse:
@@ -213,14 +266,18 @@ def _flash_fwd(
 
 def _bwd_block(
     q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, *, iq, ik, scale,
-    causal, q_offset, k_offset, kv_len, block_q, block_k,
+    mask_causal, mask_kv, q_offset, k_offset, kv_len, block_q, block_k,
 ):
     """Shared per-block backward math: recompute masked scores and the
     softmax weights from the saved (m, l) statistics, then form ds —
     the cotangent of the RAW scores.  ``ds`` is zeroed outside the
     visible set exactly as the dense oracle's ``where`` vjp does (this
     is what keeps the fully-masked-row uniform-weights convention
-    gradient-exact: those rows produce p == 1/n but ds == 0)."""
+    gradient-exact: those rows produce p == 1/n but ds == 0).
+
+    ``mask_causal``/``mask_kv`` select which mask terms this block
+    needs: the triangle grid's interior blocks are fully visible and
+    unpadded, so they skip the iota/where VPU work entirely."""
     q = q_ref[0].astype(jnp.float32)  # [bq, D]
     k = k_ref[0].astype(jnp.float32)  # [bk, D]
     v = v_ref[0].astype(jnp.float32)  # [bk, D]
@@ -229,18 +286,22 @@ def _bwd_block(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     s = s * scale
-    krow = ik * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
-    visible = krow < kv_len
-    if causal:
+    visible = None
+    if mask_causal or mask_kv:
+        krow = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+    if mask_kv:
+        visible = krow < kv_len
+    if mask_causal:
         qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
         causal_ok = qpos >= k_offset + krow
-        visible = visible & causal_ok
+        visible = causal_ok if visible is None else (visible & causal_ok)
         s = jnp.where(causal_ok, s, _NEG)
-    s = jnp.where(krow < kv_len, s, -_INF)
+    if mask_kv:
+        s = jnp.where(krow < kv_len, s, -_INF)
     # p from the saved statistics ((rows, 1) columns broadcast across
     # the block): exp(s - m) / l — NOT exp(s - (m + log l)), whose f32
     # fusion loses log(l) against the huge _NEG on fully-masked rows
@@ -251,40 +312,70 @@ def _bwd_block(
         g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     ds = p * (dp - delta_ref[0]) * scale
-    ds = jnp.where(visible, ds, 0.0)
+    if visible is not None:
+        ds = jnp.where(visible, ds, 0.0)
     return q, k, g, p, ds
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc, *, scale, causal, q_offset, k_offset, kv_len,
-    block_q, block_k, num_q,
+    block_q, block_k, num_q, triangle,
 ):
-    """dK/dV: one key block per middle grid index, accumulated over the
-    (sequential, minormost) query blocks."""
-    ik = pl.program_id(1)
-    iq = pl.program_id(2)
+    """dK/dV: one key block per (middle) row, accumulated over the
+    sequential query blocks.  On the triangle grid the visible set is
+    ``iq >= ik``: the flat index walks key-block rows with iq ascending
+    ik..n-1, the diagonal block is the only one needing the mask, and
+    the fully-masked iq < ik blocks are never visited at all."""
+    if triangle:
+        # reverse the fwd's lower-triangle walk: rows keyed by ik, iq
+        # ascending within each row
+        n = num_q
+        total = n * (n + 1) // 2
+        a, bb = _tri_iq_ik(total - 1 - pl.program_id(1))
+        ik = n - 1 - a
+        iq = n - 1 - bb
+    else:
+        ik = pl.program_id(1)
+        iq = pl.program_id(2)
 
-    @pl.when(iq == 0)
+    first = (iq == ik) if triangle else (iq == 0)
+    last = iq == num_q - 1
+
+    @pl.when(first)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q, _k, g, p, ds = _bwd_block(
-        q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, iq=iq,
-        ik=ik, scale=scale, causal=causal, q_offset=q_offset,
-        k_offset=k_offset, kv_len=kv_len, block_q=block_q,
-        block_k=block_k,
-    )
-    # dV += P^T @ dO ; dK += dS^T @ Q   (contract the q-block dim)
-    dv_acc[...] += jax.lax.dot_general(
-        p, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    dk_acc[...] += jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    def _accumulate(mask_causal):
+        q, _k, g, p, ds = _bwd_block(
+            q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, iq=iq,
+            ik=ik, scale=scale, mask_causal=mask_causal,
+            mask_kv=not triangle, q_offset=q_offset, k_offset=k_offset,
+            kv_len=kv_len, block_q=block_q, block_k=block_k,
+        )
+        # dV += P^T @ dO ; dK += dS^T @ Q   (contract the q-block dim)
+        dv_acc[...] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    @pl.when(iq == num_q - 1)
+    if triangle:
+        @pl.when(iq == ik)
+        def _diag():
+            _accumulate(True)
+
+        @pl.when(iq != ik)
+        def _interior():
+            _accumulate(False)
+    else:
+        _accumulate(causal)
+
+    @pl.when(last)
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -293,28 +384,48 @@ def _bwd_dkv_kernel(
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, dq_ref, dq_acc,
     *, scale, causal, q_offset, k_offset, kv_len, block_q, block_k,
-    num_k,
+    num_k, triangle,
 ):
-    """dQ: one query block per middle grid index, accumulated over the
-    (sequential, minormost) key blocks."""
-    iq = pl.program_id(1)
-    ik = pl.program_id(2)
+    """dQ: one query block per (middle) row, accumulated over the
+    sequential key blocks (triangle: ik ascending 0..iq, diagonal
+    masked, nothing above it visited)."""
+    if triangle:
+        iq, ik = _tri_iq_ik(pl.program_id(1))
+    else:
+        iq = pl.program_id(1)
+        ik = pl.program_id(2)
 
-    @pl.when(ik == 0)
+    first = ik == 0
+    last = (ik == iq) if triangle else (ik == num_k - 1)
+
+    @pl.when(first)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    _q, k, _g, _p, ds = _bwd_block(
-        q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, iq=iq,
-        ik=ik, scale=scale, causal=causal, q_offset=q_offset,
-        k_offset=k_offset, kv_len=kv_len, block_q=block_q,
-        block_k=block_k,
-    )
-    dq_acc[...] += jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    def _accumulate(mask_causal):
+        _q, k, _g, _p, ds = _bwd_block(
+            q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, iq=iq,
+            ik=ik, scale=scale, mask_causal=mask_causal,
+            mask_kv=not triangle, q_offset=q_offset, k_offset=k_offset,
+            kv_len=kv_len, block_q=block_q, block_k=block_k,
+        )
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    @pl.when(ik == num_k - 1)
+    if triangle:
+        @pl.when(ik == iq)
+        def _diag():
+            _accumulate(True)
+
+        @pl.when(ik != iq)
+        def _interior():
+            _accumulate(False)
+    else:
+        _accumulate(causal)
+
+    @pl.when(last)
     def _finalize():
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
@@ -352,27 +463,52 @@ def _flash_bwd(
 
     nq = qf.shape[1] // block_q
     nk = kf.shape[1] // block_k
+    triangle = _tri_gate(
+        causal, q_offset, k_offset, tq, tk, pad_q, pad_k, block_q, block_k
+    )
     common = dict(
         scale=scale, causal=causal, q_offset=q_offset, k_offset=k_offset,
-        kv_len=tk, block_q=block_q, block_k=block_k,
+        kv_len=tk, block_q=block_q, block_k=block_k, triangle=triangle,
     )
-    qspec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
-    row_major_q = [
-        pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
-        pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0)),
-    ]
+    n_tri = nq * (nq + 1) // 2
+
+    if triangle:
+        def dkv_qmap(bh, t):
+            a, bb = _tri_iq_ik(n_tri - 1 - t)
+            return (bh, nq - 1 - bb, 0)
+
+        def dkv_kmap(bh, t):
+            a, bb = _tri_iq_ik(n_tri - 1 - t)
+            return (bh, nq - 1 - a, 0)
+
+        dkv_grid = (b * h, n_tri)
+    else:
+        def dkv_qmap(bh, ik, iq):
+            return (bh, iq, 0)
+
+        def dkv_kmap(bh, ik, iq):
+            return (bh, ik, 0)
+
+        dkv_grid = (b * h, nk, nq)
+
+    def specs_for(qmap, kmap):
+        return [
+            pl.BlockSpec((1, block_q, d), qmap),
+            pl.BlockSpec((1, block_k, d), kmap),
+            pl.BlockSpec((1, block_k, d), kmap),
+            pl.BlockSpec((1, block_q, d), qmap),
+            pl.BlockSpec((1, block_q, 1), qmap),
+            pl.BlockSpec((1, block_q, 1), qmap),
+            pl.BlockSpec((1, block_q, 1), qmap),
+        ]
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, num_q=nq, **common),
-        grid=(b * h, nk, nq),
-        in_specs=row_major_q,
+        grid=dkv_grid,
+        in_specs=specs_for(dkv_qmap, dkv_kmap),
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), dkv_kmap),
+            pl.BlockSpec((1, block_k, d), dkv_kmap),
         ],
         out_shape=(
             _union_vma_sds((b * h, nk * block_k, d), k.dtype, qf, kf, vf, gf),
@@ -385,20 +521,30 @@ def _flash_bwd(
         interpret=interpret,
     )(qf, kf, vf, gf, m_pad, l_pad, delta)
 
-    row_major_k = [
-        pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-        pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
-    ]
+    if triangle:
+        def dq_qmap(bh, t):
+            iq, _ik = _tri_iq_ik(t)
+            return (bh, iq, 0)
+
+        def dq_kmap(bh, t):
+            _iq, ik = _tri_iq_ik(t)
+            return (bh, ik, 0)
+
+        dq_grid = (b * h, n_tri)
+    else:
+        def dq_qmap(bh, iq, ik):
+            return (bh, iq, 0)
+
+        def dq_kmap(bh, iq, ik):
+            return (bh, ik, 0)
+
+        dq_grid = (b * h, nq, nk)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, num_k=nk, **common),
-        grid=(b * h, nq, nk),
-        in_specs=row_major_k,
-        out_specs=qspec,
+        grid=dq_grid,
+        in_specs=specs_for(dq_qmap, dq_kmap),
+        out_specs=pl.BlockSpec((1, block_q, d), dq_qmap),
         out_shape=_union_vma_sds(
             (b * h, nq * block_q, d), q.dtype, qf, kf, vf, gf
         ),
@@ -450,6 +596,9 @@ def _flash_fwd_impl(
     vf = _fold(v, pad_k, b, h, d)
     nq = qf.shape[1] // block_q
     nk = kf.shape[1] // block_k
+    triangle = _tri_gate(
+        causal, q_offset, k_offset, tq, tk, pad_q, pad_k, block_q, block_k
+    )
 
     kernel = functools.partial(
         _kernel,
@@ -462,10 +611,28 @@ def _flash_fwd_impl(
         block_k=block_k,
         num_k=nk,
         with_lse=with_lse,
+        triangle=triangle,
     )
-    out_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-    ]
+    if triangle:
+        grid = (b * h, nq * (nq + 1) // 2)
+
+        def qmap(bh, t):
+            iq, _ik = _tri_iq_ik(t)
+            return (bh, iq, 0)
+
+        def kmap(bh, t):
+            _iq, ik = _tri_iq_ik(t)
+            return (bh, ik, 0)
+    else:
+        grid = (b * h, nq, nk)
+
+        def qmap(bh, iq, ik):
+            return (bh, iq, 0)
+
+        def kmap(bh, iq, ik):
+            return (bh, ik, 0)
+
+    out_specs = [pl.BlockSpec((1, block_q, d), qmap)]
     # inside shard_map the output varies over the union of the
     # operands' varying axes; check_vma requires it spelled out
     out_shape = [
@@ -473,11 +640,7 @@ def _flash_fwd_impl(
     ]
     if with_lse:
         for _ in range(2):  # m and l residuals
-            out_specs.append(
-                pl.BlockSpec(
-                    (1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)
-                )
-            )
+            out_specs.append(pl.BlockSpec((1, block_q, 1), qmap))
             out_shape.append(
                 _union_vma_sds(
                     (b * h, nq * block_q, 1), jnp.float32, qf, kf, vf
@@ -485,11 +648,11 @@ def _flash_fwd_impl(
             )
     res = pl.pallas_call(
         kernel,
-        grid=(b * h, nq, nk),
+        grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_q, d), qmap),
+            pl.BlockSpec((1, block_k, d), kmap),
+            pl.BlockSpec((1, block_k, d), kmap),
         ],
         out_specs=out_specs if with_lse else out_specs[0],
         out_shape=tuple(out_shape) if with_lse else out_shape[0],

@@ -22,10 +22,11 @@ def _qkv(B, T, TK, H, D, dtype=jnp.float32, seed=0):
 CASES = [
     # B, Tq, Tk, H, D, causal, q_offset, k_offset
     (2, 128, 128, 4, 64, False, 0, 0),
-    (1, 256, 256, 2, 64, True, 0, 0),
+    (1, 256, 256, 2, 64, True, 0, 0),  # triangle grid (square causal)
     (2, 100, 100, 3, 64, False, 0, 0),  # sequence padding path
     (1, 96, 160, 2, 32, True, 64, 0),  # ragged q/k + block offset
     (1, 64, 64, 1, 128, True, 128, 64),
+    (1, 512, 512, 1, 64, True, 0, 0),  # triangle grid, 8x8 blocks (T=36)
 ]
 
 
