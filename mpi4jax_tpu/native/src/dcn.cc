@@ -127,6 +127,15 @@ struct PeerSock {
 std::vector<PeerSock> g_peers;  // world_size entries; [g_rank] unused
 std::vector<std::thread> g_readers;
 
+// Same-host p2p fast path: frames to same-host peers ride SPSC shm
+// byte pipes in the same wire format as the sockets (shm.h), drained
+// by one reader thread per source into the same mailbox — matching
+// semantics and per-pair ordering are exactly the TCP tier's.  ALL
+// frames for a pair use one transport, so ordering can never split.
+shm::PipeSeg* g_my_pipes = nullptr;
+std::vector<shm::Pipe*> g_tx_pipes;   // world-indexed; nullptr = TCP
+std::vector<std::thread> g_pipe_readers;
+
 std::mutex g_mail_mu;
 std::condition_variable g_mail_cv;
 std::deque<Frame> g_mailbox;
@@ -209,11 +218,21 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
     g_mail_cv.notify_all();
     return;
   }
-  PeerSock& p = g_peers[world_dest];
-  if (p.fd < 0) die("send to unconnected peer");
   WireHeader h{kMagic, static_cast<uint32_t>(g_rank),
                static_cast<uint32_t>(ctx), static_cast<uint32_t>(tag + 1),
                static_cast<uint64_t>(nbytes)};
+  if (world_dest < static_cast<int>(g_tx_pipes.size()) &&
+      g_tx_pipes[world_dest]) {
+    shm::Pipe* pipe = g_tx_pipes[world_dest];
+    PeerSock& pp = g_peers[world_dest];
+    std::lock_guard<std::mutex> lk(pp.send_mu);  // one producer per pipe
+    if (!shm::pipe_write(pipe, &h, sizeof(h), g_shutting_down) ||
+        (nbytes && !shm::pipe_write(pipe, buf, nbytes, g_shutting_down)))
+      die("shm pipe write during shutdown");
+    return;
+  }
+  PeerSock& p = g_peers[world_dest];
+  if (p.fd < 0) die("send to unconnected peer");
   std::lock_guard<std::mutex> lk(p.send_mu);
   // header + body in one syscall (one TCP segment for small frames)
   iovec iov[2] = {{&h, sizeof(h)}, {const_cast<void*>(buf), nbytes}};
@@ -376,6 +395,128 @@ uint64_t host_fingerprint() {
   return h ? h : 1;
 }
 
+void pipe_reader_loop(int peer, shm::Pipe* pipe) {
+  (void)peer;
+  for (;;) {
+    WireHeader h;
+    if (!shm::pipe_read(pipe, &h, sizeof(h), g_shutting_down))
+      return;  // shutdown
+    if (h.magic != kMagic) die("pipe frame magic check");
+    Frame f;
+    f.src = static_cast<int>(h.src);
+    f.ctx = static_cast<int>(h.ctx);
+    f.tag = static_cast<int>(h.tag) - 1;
+    f.data = Buf(h.nbytes);
+    if (h.nbytes &&
+        !shm::pipe_read(pipe, f.data.data(), h.nbytes, g_shutting_down))
+      return;
+    {
+      std::lock_guard<std::mutex> lk(g_mail_mu);
+      g_mailbox.push_back(std::move(f));
+    }
+    g_mail_cv.notify_all();
+  }
+}
+
+// Wire up the same-host pipe transport after the bootstrap table (and
+// host fingerprints) exist.  Like the collective arena, the transport
+// choice is AGREED over TCP so a partial failure can never split a
+// pair across transports or aim a pipe at a reader-less segment:
+//   round 1: every rank creates its own inbound segment, then the
+//     group leader gathers "created" bytes and broadcasts the AND —
+//     only after that does anyone attach (so a stale leaked segment
+//     from a crashed prior run can never be attached: every name was
+//     just unlinked+recreated by its owner);
+//   round 2: attach results are gathered/broadcast the same way, and
+//     pipes go live (g_tx_pipes published, readers started) only when
+//     EVERY member succeeded — otherwise everyone drops to TCP.
+// The agreement frames ride raw TCP (g_tx_pipes is still empty while
+// the rounds run, so raw_send cannot route them through a pipe).
+constexpr int kPipeTagCreated = (1 << 24) + 12;
+constexpr int kPipeTagFinal = (1 << 24) + 13;
+
+void setup_pipes() {
+  g_tx_pipes.assign(g_size, nullptr);
+  if (g_size < 2 || static_cast<int>(g_host_fps.size()) != g_size) return;
+  std::vector<int> local;  // same-host world ranks, ascending (incl. me)
+  for (int r = 0; r < g_size; ++r)
+    if (g_host_fps[r] == g_host_fps[g_rank]) local.push_back(r);
+  if (local.size() < 2) return;
+  int leader = local[0];
+  int wctx = enc_ctx(0, /*coll=*/true);  // world comm's collective channel
+
+  auto agree = [&](uint8_t mine, int tag) -> uint8_t {
+    uint8_t ok = mine;
+    if (g_rank == leader) {
+      for (int r : local) {
+        if (r == leader) continue;
+        Frame f = raw_recv(r, wctx, tag);
+        ok &= f.data.size() == 1 ? f.data.data()[0] : 0;
+      }
+      for (int r : local) {
+        if (r == leader) continue;
+        raw_send(r, wctx, tag, &ok, 1);
+      }
+    } else {
+      raw_send(leader, wctx, tag, &mine, 1);
+      Frame f = raw_recv(leader, wctx, tag);
+      ok = f.data.size() == 1 ? f.data.data()[0] : 0;
+    }
+    return ok;
+  };
+
+  auto slot_of = [&](int dest, int src) {
+    // source slot within dest's inbound segment: index of src in the
+    // ascending same-host list with dest itself excluded
+    int slot = 0;
+    for (int r : local) {
+      if (r == dest) continue;
+      if (r == src) return slot;
+      ++slot;
+    }
+    return -1;
+  };
+  int n_sources = static_cast<int>(local.size()) - 1;
+
+  g_my_pipes = shm::pipes_create(g_job.c_str(), g_rank, n_sources);
+  if (!agree(g_my_pipes != nullptr, kPipeTagCreated)) {
+    if (g_my_pipes) {
+      shm::pipes_destroy(g_my_pipes);
+      g_my_pipes = nullptr;
+    }
+    return;
+  }
+
+  std::vector<shm::Pipe*> tx(g_size, nullptr);
+  bool all_ok = true;
+  for (int r : local) {
+    if (r == g_rank) continue;
+    tx[r] = shm::pipe_attach(g_job.c_str(), r, slot_of(r, g_rank),
+                             n_sources);
+    if (!tx[r]) {
+      all_ok = false;
+      break;
+    }
+  }
+  if (!agree(all_ok, kPipeTagFinal)) {
+    for (auto*& t : tx)
+      if (t) {
+        shm::pipe_close(t);
+        t = nullptr;
+      }
+    shm::pipes_destroy(g_my_pipes);
+    g_my_pipes = nullptr;
+    return;
+  }
+  g_tx_pipes = std::move(tx);  // publish: raw_send may now route pipes
+  for (int r : local) {
+    if (r == g_rank) continue;
+    g_pipe_readers.emplace_back(
+        pipe_reader_loop, r,
+        shm::pipe_of(g_my_pipes, slot_of(g_rank, r)));
+  }
+}
+
 void bootstrap(const std::string& coord_host, uint16_t coord_port) {
   // Every rank opens a listener for the full-mesh phase.
   uint16_t my_port = 0;
@@ -457,6 +598,7 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
     if (p == g_rank || g_peers[p].fd < 0) continue;
     g_readers.emplace_back(reader_loop, p, g_peers[p].fd);
   }
+  setup_pipes();
 }
 
 // --------------------------------------------------------- communicators
@@ -879,6 +1021,10 @@ int init_from_env() {
   }
   g_initialized = true;
   barrier(0);
+  // every same-host peer has attached its tx views by now (attach
+  // happens inside bootstrap, before this barrier): drop the segment
+  // name so no crash can leak it
+  if (g_my_pipes) shm::pipes_unlink(g_my_pipes);
   return 0;
 }
 
@@ -894,6 +1040,26 @@ void finalize() {
     }
   }
   g_shutting_down.store(true);
+  // wake every pipe waiter (readers blocked on empty, writers on full):
+  // they observe g_shutting_down and exit
+  if (g_my_pipes)
+    for (int i = 0;; ++i) {
+      shm::Pipe* p = shm::pipe_of(g_my_pipes, i);
+      if (!p) break;
+      shm::pipe_wake(p);
+    }
+  for (auto* tx : g_tx_pipes)
+    if (tx) shm::pipe_wake(tx);
+  for (auto& t : g_pipe_readers) t.join();
+  g_pipe_readers.clear();
+  for (auto*& tx : g_tx_pipes) {
+    if (tx) shm::pipe_close(tx);
+    tx = nullptr;
+  }
+  if (g_my_pipes) {
+    shm::pipes_destroy(g_my_pipes);
+    g_my_pipes = nullptr;
+  }
   // shutdown first (wakes blocked readers with EOF/error), close only
   // after every reader has exited — closing a fd a thread is blocked on
   // is undefined behaviour and produced spurious EBADF aborts

@@ -16,6 +16,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <vector>
 
 namespace t4j {
 namespace shm {
@@ -599,6 +600,318 @@ void barrier(Arena* a) {
     h->acked[a->me].store(p, std::memory_order_release);
     bump(h);
   });
+}
+
+
+// ------------------------------------------------------- p2p byte pipes
+
+namespace {
+
+constexpr uint32_t kPipeMagic = 0x7446a0BB;
+
+size_t pipe_cap() {
+  static size_t cap = [] {
+    const char* s = std::getenv("T4J_SHM_PIPE_MB");
+    long mb = s ? std::atol(s) : 4;
+    if (mb < 1) mb = 1;
+    if (mb > 64) mb = 64;
+    return static_cast<size_t>(mb) << 20;
+  }();
+  return cap;
+}
+
+struct PipeHdr {
+  // producer-written line: the consumer reads it, but each side's
+  // STORES stay on its own cache line (no false-sharing ping-pong on
+  // the data path)
+  std::atomic<uint64_t> head;       // bytes ever written
+  std::atomic<uint32_t> prod_bell;  // futex: bumped by producer
+  std::atomic<uint32_t> prod_waiters;
+  uint8_t pad0[48];
+  // consumer-written line
+  std::atomic<uint64_t> tail;       // bytes ever read
+  std::atomic<uint32_t> cons_bell;  // futex: bumped by consumer
+  std::atomic<uint32_t> cons_waiters;
+  uint8_t pad1[48];
+};
+static_assert(sizeof(PipeHdr) == 128, "PipeHdr: two cache lines");
+
+struct SegHdr {
+  std::atomic<uint32_t> magic;
+  uint32_t n;
+  uint64_t cap;
+};
+
+size_t seg_span() {
+  return (sizeof(SegHdr) + kAlign - 1) & ~(kAlign - 1);
+}
+
+size_t pipe_stride(size_t cap) {
+  return (sizeof(PipeHdr) + cap + kAlign - 1) & ~(kAlign - 1);
+}
+
+size_t pipes_total(int n, size_t cap) {
+  return seg_span() + static_cast<size_t>(n) * pipe_stride(cap);
+}
+
+void pipes_name(char* buf, size_t bufsz, const char* job, int rank) {
+  std::snprintf(buf, bufsz, "/t4j_%s_p2p_r%d", job, rank);
+}
+
+}  // namespace
+
+struct Pipe {
+  PipeHdr* h = nullptr;
+  uint8_t* buf = nullptr;
+  size_t cap = 0;
+  // set only on sender-attached views (owns the mapping)
+  uint8_t* owned_base = nullptr;
+  size_t owned_total = 0;
+};
+
+struct PipeSeg {
+  uint8_t* base = nullptr;
+  size_t total = 0;
+  int n = 0;
+  std::string name;
+  std::vector<Pipe> pipes;
+};
+
+namespace {
+
+void pipe_fill(PipeSeg* seg) {
+  SegHdr* sh = reinterpret_cast<SegHdr*>(seg->base);
+  size_t cap = sh->cap;
+  size_t stride = pipe_stride(cap);
+  seg->pipes.resize(seg->n);
+  uint8_t* p = seg->base + seg_span();
+  for (int i = 0; i < seg->n; ++i) {
+    seg->pipes[i].h = reinterpret_cast<PipeHdr*>(p);
+    seg->pipes[i].buf = p + sizeof(PipeHdr);
+    seg->pipes[i].cap = cap;
+    p += stride;
+  }
+}
+
+}  // namespace
+
+PipeSeg* pipes_create(const char* job, int my_rank, int n_sources) {
+  if (disabled() || n_sources < 1) return nullptr;
+  char name[200];
+  pipes_name(name, sizeof(name), job, my_rank);
+  size_t cap = pipe_cap();
+  size_t total = pipes_total(n_sources, cap);
+  ::shm_unlink(name);
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (::ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  void* m = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (m == MAP_FAILED) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+#ifdef MADV_HUGEPAGE
+  ::madvise(m, total, MADV_HUGEPAGE);
+#endif
+  PipeSeg* seg = new PipeSeg;
+  seg->base = static_cast<uint8_t*>(m);
+  seg->total = total;
+  seg->n = n_sources;
+  seg->name = name;
+  SegHdr* sh = reinterpret_cast<SegHdr*>(seg->base);
+  sh->n = static_cast<uint32_t>(n_sources);
+  sh->cap = cap;
+  pipe_fill(seg);
+  for (auto& p : seg->pipes) {
+    p.h->head.store(0, std::memory_order_relaxed);
+    p.h->tail.store(0, std::memory_order_relaxed);
+    p.h->prod_bell.store(0, std::memory_order_relaxed);
+    p.h->cons_bell.store(0, std::memory_order_relaxed);
+    p.h->prod_waiters.store(0, std::memory_order_relaxed);
+    p.h->cons_waiters.store(0, std::memory_order_relaxed);
+  }
+  sh->magic.store(kPipeMagic, std::memory_order_release);
+  return seg;
+}
+
+Pipe* pipe_of(PipeSeg* seg, int slot) {
+  if (!seg || slot < 0 || slot >= seg->n) return nullptr;
+  return &seg->pipes[slot];
+}
+
+Pipe* pipe_attach(const char* job, int dest_rank, int slot, int n_sources) {
+  if (disabled() || slot < 0 || slot >= n_sources) return nullptr;
+  char name[200];
+  pipes_name(name, sizeof(name), job, dest_rank);
+  size_t cap = pipe_cap();
+  size_t total = pipes_total(n_sources, cap);
+  int fd = -1;
+  for (int i = 0; i < 5000; ++i) {  // creation races attach at init
+    fd = ::shm_open(name, O_RDWR, 0600);
+    if (fd >= 0) break;
+    ::usleep(1000);
+  }
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(total)) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* m = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (m == MAP_FAILED) return nullptr;
+  SegHdr* sh = reinterpret_cast<SegHdr*>(m);
+  for (int i = 0; i < 5000; ++i) {
+    if (sh->magic.load(std::memory_order_acquire) == kPipeMagic) break;
+    ::usleep(1000);
+  }
+  if (sh->magic.load(std::memory_order_acquire) != kPipeMagic ||
+      sh->cap != cap || sh->n != static_cast<uint32_t>(n_sources)) {
+    ::munmap(m, total);
+    return nullptr;
+  }
+  PipeSeg tmp;
+  tmp.base = static_cast<uint8_t*>(m);
+  tmp.n = n_sources;
+  pipe_fill(&tmp);
+  Pipe* p = new Pipe(tmp.pipes[slot]);
+  p->owned_base = static_cast<uint8_t*>(m);
+  p->owned_total = total;
+  return p;
+}
+
+namespace {
+
+// Wait until pred() or shutdown; bell is the futex word the OTHER side
+// bumps, waiters the counter it checks before the wake syscall.
+template <class Pred>
+bool pipe_wait(std::atomic<uint32_t>* bell, std::atomic<uint32_t>* waiters,
+               const std::atomic<bool>& shutdown, Pred pred) {
+  for (int s = 0; s < 64; ++s) {
+    if (pred()) return true;
+    if (shutdown.load(std::memory_order_acquire)) return false;
+#if defined(__x86_64__) || defined(__i386__)
+    __builtin_ia32_pause();
+#endif
+  }
+  for (int s = 0; s < 16; ++s) {
+    if (pred()) return true;
+    if (shutdown.load(std::memory_order_acquire)) return false;
+    ::sched_yield();
+  }
+  for (;;) {
+    uint32_t seen = bell->load(std::memory_order_acquire);
+    if (pred()) return true;
+    if (shutdown.load(std::memory_order_acquire)) return false;
+    waiters->fetch_add(1, std::memory_order_acq_rel);
+    if (!pred() && !shutdown.load(std::memory_order_acquire))
+      futex_wait(bell, seen);
+    waiters->fetch_sub(1, std::memory_order_acq_rel);
+  }
+}
+
+void pipe_bump(std::atomic<uint32_t>* bell, std::atomic<uint32_t>* waiters) {
+  bell->fetch_add(1, std::memory_order_release);
+  if (waiters->load(std::memory_order_acquire) > 0) futex_wake_all(bell);
+}
+
+}  // namespace
+
+bool pipe_write(Pipe* p, const void* data, size_t n,
+                const std::atomic<bool>& shutdown) {
+  const uint8_t* src = static_cast<const uint8_t*>(data);
+  PipeHdr* h = p->h;
+  size_t cap = p->cap;
+  while (n > 0) {
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    size_t free = cap - static_cast<size_t>(head - tail);
+    if (free == 0) {
+      if (!pipe_wait(&h->cons_bell, &h->prod_waiters, shutdown, [&] {
+            return cap - static_cast<size_t>(
+                             h->head.load(std::memory_order_relaxed) -
+                             h->tail.load(std::memory_order_acquire)) > 0;
+          }))
+        return false;
+      continue;
+    }
+    size_t chunk = n < free ? n : free;
+    size_t off = static_cast<size_t>(head % cap);
+    size_t first = chunk < cap - off ? chunk : cap - off;
+    std::memcpy(p->buf + off, src, first);
+    if (chunk > first) std::memcpy(p->buf, src + first, chunk - first);
+    h->head.store(head + chunk, std::memory_order_release);
+    pipe_bump(&h->prod_bell, &h->cons_waiters);
+    src += chunk;
+    n -= chunk;
+  }
+  return true;
+}
+
+bool pipe_read(Pipe* p, void* data, size_t n,
+               const std::atomic<bool>& shutdown) {
+  uint8_t* dst = static_cast<uint8_t*>(data);
+  PipeHdr* h = p->h;
+  size_t cap = p->cap;
+  while (n > 0) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    size_t avail = static_cast<size_t>(head - tail);
+    if (avail == 0) {
+      if (!pipe_wait(&h->prod_bell, &h->cons_waiters, shutdown, [&] {
+            return h->head.load(std::memory_order_acquire) !=
+                   h->tail.load(std::memory_order_relaxed);
+          }))
+        return false;
+      continue;
+    }
+    size_t chunk = n < avail ? n : avail;
+    size_t off = static_cast<size_t>(tail % cap);
+    size_t first = chunk < cap - off ? chunk : cap - off;
+    std::memcpy(dst, p->buf + off, first);
+    if (chunk > first) std::memcpy(dst + first, p->buf, chunk - first);
+    h->tail.store(tail + chunk, std::memory_order_release);
+    pipe_bump(&h->cons_bell, &h->prod_waiters);
+    dst += chunk;
+    n -= chunk;
+  }
+  return true;
+}
+
+void pipe_wake(Pipe* p) {
+  if (!p) return;
+  // bump BEFORE waking: a waiter that just validated the old bell
+  // value must fail the kernel's futex value check instead of sleeping
+  // through the wake (it would only recover via the 2s timeout)
+  p->h->prod_bell.fetch_add(1, std::memory_order_release);
+  p->h->cons_bell.fetch_add(1, std::memory_order_release);
+  futex_wake_all(&p->h->prod_bell);
+  futex_wake_all(&p->h->cons_bell);
+}
+
+void pipes_unlink(PipeSeg* seg) {
+  if (seg && !seg->name.empty()) {
+    ::shm_unlink(seg->name.c_str());
+    seg->name.clear();
+  }
+}
+
+void pipes_destroy(PipeSeg* seg) {
+  if (!seg) return;
+  pipes_unlink(seg);
+  ::munmap(seg->base, seg->total);
+  delete seg;
+}
+
+void pipe_close(Pipe* p) {
+  if (!p) return;
+  if (p->owned_base) ::munmap(p->owned_base, p->owned_total);
+  delete p;
 }
 
 }  // namespace shm

@@ -19,6 +19,7 @@
 
 #pragma once
 
+#include <atomic>
 #include <cstddef>
 
 #include "dcn.h"
@@ -64,6 +65,43 @@ void scatter(Arena* a, const void* in, void* out, size_t nbytes_each,
              int root);
 void alltoall(Arena* a, const void* in, void* out, size_t nbytes_each);
 void barrier(Arena* a);
+
+// ---- p2p byte pipes (same-host send/recv fast path) --------------------
+//
+// One SPSC blocking byte pipe per same-host ordered pair, living in a
+// segment owned by the RECEIVER (one segment per process, a pipe slot
+// per same-host source).  The dcn transport writes the exact TCP wire
+// format (WireHeader + payload) into the pipe instead of the loopback
+// socket; a reader thread per source drains into the same mailbox, so
+// matching semantics and per-pair ordering are identical to TCP.
+
+struct PipeSeg;  // receiver-owned segment (opaque)
+struct Pipe;     // one directional pipe endpoint (opaque)
+
+// Create my inbound segment with `n_sources` pipes (my_rank names it).
+PipeSeg* pipes_create(const char* job, int my_rank, int n_sources);
+// Receiver-side view of pipe `slot` in my own segment.
+Pipe* pipe_of(PipeSeg* seg, int slot);
+// Sender side: attach to `dest_rank`'s segment and take pipe `slot`
+// (retries briefly — creation races attach at init).  nullptr = fall
+// back to TCP for this peer.
+Pipe* pipe_attach(const char* job, int dest_rank, int slot, int n_sources);
+
+// Blocking byte stream.  Returns false when `shutdown` became true
+// while waiting (teardown); partial progress is fine then — the job is
+// exiting.
+bool pipe_write(Pipe* p, const void* data, size_t n,
+                const std::atomic<bool>& shutdown);
+bool pipe_read(Pipe* p, void* data, size_t n,
+               const std::atomic<bool>& shutdown);
+
+// Wake every waiter on the pipe (teardown: blocked readers/writers
+// re-check `shutdown` and bail).
+void pipe_wake(Pipe* p);
+
+void pipes_unlink(PipeSeg* seg);   // drop the NAME once every sender attached
+void pipes_destroy(PipeSeg* seg);  // munmap receiver view
+void pipe_close(Pipe* p);          // munmap a sender's attached view
 
 }  // namespace shm
 

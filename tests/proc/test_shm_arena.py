@@ -135,3 +135,41 @@ print(f"WORKER_OK {rank}", flush=True)
         nprocs=4,
     )
     _check(proc, 4)
+
+
+def test_p2p_pipes_and_tcp_fallback_agree():
+    # p2p frames ride the same-host shm byte pipes (round 4); with
+    # T4J_NO_SHM=1 the same traffic rides TCP loopback.  Both must
+    # deliver identical matching semantics (tags, ANY_SOURCE, order).
+    body = (
+        PREAMBLE
+        + """
+tok = m.create_token()
+x = jnp.full((5,), float(rank + 1))
+tok = m.send(x, (rank + 1) % size, tag=7, comm=comm, token=tok)
+st = m.Status()
+y, tok = m.recv(x, (rank - 1) % size, tag=7, comm=comm, token=tok, status=st)
+assert np.allclose(np.asarray(y), float((rank - 1) % size + 1))
+assert int(np.asarray(st.source)) == (rank - 1) % size
+
+# ordering: two sends same pair, distinct tags, wildcard recvs must
+# deliver in posting order (MPI non-overtaking)
+tok = m.send(x * 10, (rank + 1) % size, tag=1, comm=comm, token=tok)
+tok = m.send(x * 20, (rank + 1) % size, tag=2, comm=comm, token=tok)
+a, tok = m.recv(x, m.ANY_SOURCE, m.ANY_TAG, comm=comm, token=tok)
+b, tok = m.recv(x, m.ANY_SOURCE, m.ANY_TAG, comm=comm, token=tok)
+left = (rank - 1) % size + 1
+assert np.allclose(np.asarray(a), left * 10.0), np.asarray(a)
+assert np.allclose(np.asarray(b), left * 20.0), np.asarray(b)
+
+# a 6MB frame exceeds the 4MB pipe buffer: must stream through in
+# chunks (the pipe is a blocking byte FIFO, not a frame ring)
+big = jnp.arange(1_500_000, dtype=jnp.float32) * (rank + 1)
+tok = m.send(big, (rank + 1) % size, tag=9, comm=comm, token=tok)
+z, tok = m.recv(big, (rank - 1) % size, tag=9, comm=comm, token=tok)
+assert np.allclose(np.asarray(z), np.arange(1_500_000, dtype=np.float32) * ((rank - 1) % size + 1))
+print(f"WORKER_OK {rank}", flush=True)
+"""
+    )
+    _check(run_workers(body, nprocs=3), 3)
+    _check(run_workers(body, nprocs=3, env={"T4J_NO_SHM": "1"}), 3)
