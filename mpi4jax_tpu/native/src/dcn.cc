@@ -508,6 +508,10 @@ void setup_pipes() {
     g_my_pipes = nullptr;
     return;
   }
+  // every peer holds its attached mapping now (the round-2 agreement
+  // proves it): drop the segment NAME immediately, shrinking the crash
+  // window that could leak /dev/shm to the few ms of setup itself
+  shm::pipes_unlink(g_my_pipes);
   g_tx_pipes = std::move(tx);  // publish: raw_send may now route pipes
   for (int r : local) {
     if (r == g_rank) continue;
@@ -1021,10 +1025,6 @@ int init_from_env() {
   }
   g_initialized = true;
   barrier(0);
-  // every same-host peer has attached its tx views by now (attach
-  // happens inside bootstrap, before this barrier): drop the segment
-  // name so no crash can leak it
-  if (g_my_pipes) shm::pipes_unlink(g_my_pipes);
   return 0;
 }
 

@@ -118,8 +118,12 @@ struct Arena {
 namespace {
 
 void bump(Hdr* h) {
-  h->progress.fetch_add(1, std::memory_order_release);
-  if (h->waiters.load(std::memory_order_acquire) > 0)
+  // seq_cst on both: a release-RMW followed by an acquire load would
+  // let a weakly-ordered CPU hoist the waiters check above the bump
+  // (and the data publish), losing a wakeup against a waiter that
+  // registered in between — a 2s futex-timeout stall per occurrence
+  h->progress.fetch_add(1, std::memory_order_seq_cst);
+  if (h->waiters.load(std::memory_order_seq_cst) > 0)
     futex_wake_all(&h->progress);
 }
 
@@ -750,12 +754,10 @@ Pipe* pipe_attach(const char* job, int dest_rank, int slot, int n_sources) {
   pipes_name(name, sizeof(name), job, dest_rank);
   size_t cap = pipe_cap();
   size_t total = pipes_total(n_sources, cap);
-  int fd = -1;
-  for (int i = 0; i < 5000; ++i) {  // creation races attach at init
-    fd = ::shm_open(name, O_RDWR, 0600);
-    if (fd >= 0) break;
-    ::usleep(1000);
-  }
+  // no retry needed: the caller's agreement round confirmed every
+  // owner's pipes_create (which publishes the magic before returning)
+  // completed before anyone attaches
+  int fd = ::shm_open(name, O_RDWR, 0600);
   if (fd < 0) return nullptr;
   struct stat st;
   if (::fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(total)) {
@@ -766,10 +768,6 @@ Pipe* pipe_attach(const char* job, int dest_rank, int slot, int n_sources) {
   ::close(fd);
   if (m == MAP_FAILED) return nullptr;
   SegHdr* sh = reinterpret_cast<SegHdr*>(m);
-  for (int i = 0; i < 5000; ++i) {
-    if (sh->magic.load(std::memory_order_acquire) == kPipeMagic) break;
-    ::usleep(1000);
-  }
   if (sh->magic.load(std::memory_order_acquire) != kPipeMagic ||
       sh->cap != cap || sh->n != static_cast<uint32_t>(n_sources)) {
     ::munmap(m, total);
@@ -816,8 +814,10 @@ bool pipe_wait(std::atomic<uint32_t>* bell, std::atomic<uint32_t>* waiters,
 }
 
 void pipe_bump(std::atomic<uint32_t>* bell, std::atomic<uint32_t>* waiters) {
-  bell->fetch_add(1, std::memory_order_release);
-  if (waiters->load(std::memory_order_acquire) > 0) futex_wake_all(bell);
+  // seq_cst pair: see bump() — prevents the lost-wakeup reordering on
+  // weakly-ordered CPUs
+  bell->fetch_add(1, std::memory_order_seq_cst);
+  if (waiters->load(std::memory_order_seq_cst) > 0) futex_wake_all(bell);
 }
 
 }  // namespace

@@ -82,9 +82,9 @@ struct Pipe;     // one directional pipe endpoint (opaque)
 PipeSeg* pipes_create(const char* job, int my_rank, int n_sources);
 // Receiver-side view of pipe `slot` in my own segment.
 Pipe* pipe_of(PipeSeg* seg, int slot);
-// Sender side: attach to `dest_rank`'s segment and take pipe `slot`
-// (retries briefly — creation races attach at init).  nullptr = fall
-// back to TCP for this peer.
+// Sender side: attach to `dest_rank`'s segment and take pipe `slot`.
+// Called only after the agreement round confirmed the owner created
+// and initialised the segment.  nullptr = fall back to TCP.
 Pipe* pipe_attach(const char* job, int dest_rank, int slot, int n_sources);
 
 // Blocking byte stream.  Returns false when `shutdown` became true

@@ -58,6 +58,13 @@ def test_transformer_training_example(mode):
     )
 
 
+def test_transformer_training_example_1f1b():
+    _run_example(
+        "transformer_training",
+        ["--mode", "pp", "--schedule", "1f1b", "--steps", "6"],
+    )
+
+
 def test_transformer_training_generate():
     _run_example(
         "transformer_training",
