@@ -173,3 +173,57 @@ print(f"WORKER_OK {rank}", flush=True)
     )
     _check(run_workers(body, nprocs=3), 3)
     _check(run_workers(body, nprocs=3, env={"T4J_NO_SHM": "1"}), 3)
+
+
+def test_divergent_env_cannot_split_transport():
+    """A rank with T4J_NO_SHM=1 while its peers have shm enabled (the
+    hand-launched divergent-env case) must drop the WHOLE group to TCP
+    consistently — the disabled bit rides the host fingerprint, so an
+    enabled rank never classifies a disabled one as shm-eligible.
+    Before that fix this scenario deadlocked: the disabled rank went
+    straight to the TCP collective while peers waited in the shm
+    agreement rounds."""
+    proc = run_workers(
+        """
+import os
+if os.environ["T4J_RANK"] == "1":
+    os.environ["T4J_NO_SHM"] = "1"  # BEFORE the bridge initialises
+"""
+        + PREAMBLE
+        + """
+x = jnp.arange(12.0) * (rank + 1)
+y, tok = m.allreduce(x, m.SUM, comm=comm)
+assert np.allclose(np.asarray(y), np.arange(12.0) * sum(range(1, size + 1)))
+tok = m.send(x, (rank + 1) % size, tag=3, comm=comm, token=tok)
+z, tok = m.recv(x, (rank - 1) % size, tag=3, comm=comm, token=tok)
+assert np.allclose(np.asarray(z), np.arange(12.0) * ((rank - 1) % size + 1))
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=3,
+        timeout=120,
+    )
+    _check(proc, 3)
+
+
+def test_divergent_slot_size_drops_group_to_tcp():
+    """Mismatched T4J_SHM_SLOT_MB across ranks makes the arena attach
+    fail its cap validation; the agreement round must then drop every
+    member to the TCP algorithms together (no hang, right answers)."""
+    proc = run_workers(
+        """
+import os
+if os.environ["T4J_RANK"] == "0":
+    os.environ["T4J_SHM_SLOT_MB"] = "2"  # others keep the default 8
+"""
+        + PREAMBLE
+        + """
+x = jnp.arange(10.0) + 100 * rank
+y, tok = m.allreduce(x, m.SUM, comm=comm)
+want = sum(np.arange(10.0) + 100 * r for r in range(size))
+assert np.allclose(np.asarray(y), want)
+print(f"WORKER_OK {rank}", flush=True)
+""",
+        nprocs=3,
+        timeout=120,
+    )
+    _check(proc, 3)
