@@ -78,7 +78,17 @@ double now_s() {
 // collective; unset, a stall WARNS once and keeps waiting — matching
 // the TCP transport, which blocks indefinitely (a slow peer compiling
 // a big program must not convert into a killed job).
-double wait_warn_s() { return 300.0; }
+// T4J_SHM_WARN (seconds, default 300) tunes when that one-time warning
+// fires, for hosts where a legitimately slow first collective (large
+// compile on a busy box) outlives the default (ADVICE r4).
+double wait_warn_s() {
+  static double lim = [] {
+    const char* s = std::getenv("T4J_SHM_WARN");
+    double v = s ? std::atof(s) : 0.0;
+    return v > 0.0 ? v : 300.0;
+  }();
+  return lim;
+}
 
 double wait_abort_s() {
   static double lim = [] {
@@ -155,7 +165,8 @@ void wait_for(Hdr* h, Pred ok) {
       warned = true;
       std::fprintf(stderr,
                    "t4j shm arena: collective waiting > %.0fs for a peer "
-                   "(slow rank or deadlock); still waiting — set "
+                   "(slow rank or deadlock); still waiting — tune this "
+                   "warning with T4J_SHM_WARN=<s>, or set "
                    "T4J_SHM_TIMEOUT=<s> for fail-fast abort\n",
                    wait_warn_s());
       std::fflush(stderr);

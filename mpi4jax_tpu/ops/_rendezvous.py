@@ -34,6 +34,15 @@ import threading
 
 ANY = -1  # matches ops._core.ANY_SOURCE / ANY_TAG
 
+# Every diagnosed failure this module raises starts with one of these
+# prefixes; the atexit hook absorbs ONLY failures carrying them, so an
+# unrelated error whose text merely contains "rendezvous" still surfaces
+# through jax's own drain (ADVICE r4).
+_DIAG_MARKERS = (
+    "rendezvous recv on rank",
+    "rendezvous send:",
+)
+
 
 @atexit.register
 def _absorb_failed_dispatches():
@@ -61,10 +70,10 @@ def _absorb_failed_dispatches():
         try:
             token.block_until_ready()
         except Exception as e:  # noqa: BLE001 — classify, don't handle
-            if "rendezvous" not in str(e):
-                foreign_failure = True  # not ours: keep jax's diagnostic
-            else:
+            if any(m in str(e) for m in _DIAG_MARKERS):
                 absorbed += 1
+            else:
+                foreign_failure = True  # not ours: keep jax's diagnostic
     if absorbed:
         # a fire-and-forget program (result never materialised) would
         # otherwise exit with NO trace of the failure: one concise line
@@ -77,7 +86,9 @@ def _absorb_failed_dispatches():
             "results; see MPI4JAX_TPU_RENDEZVOUS_TIMEOUT docs)",
             file=sys.stderr,
         )
-    if not foreign_failure:
+    if absorbed and not foreign_failure:
+        # clear only when something WAS absorbed: a clean exit (or a
+        # purely foreign failure) keeps jax's bookkeeping untouched
         runtime_tokens.clear()
 
 

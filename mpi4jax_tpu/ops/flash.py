@@ -54,15 +54,29 @@ def _tri_gate(causal, q_offset, k_offset, tq, tk, pad_q, pad_k, block_q,
     triangle grid visits only the ~half of the blocks the causal mask
     keeps (and masks only the diagonal ones), measured ~1.4x over the
     rectangular grid at seq 8192 (docs/performance.md); sharded
-    (offset) and padded cases keep the general rectangular path."""
-    return (
+    (offset) and padded cases keep the general rectangular path.
+
+    The flat triangle index is inverted with a float32 sqrt
+    (:func:`_tri_iq_ik`) whose ±1 boundary guards absorb at most one
+    index of error.  At the 2^22 flat-index cap, ``8*t+1`` ≈ 2^25 — a
+    couple of f32 ulps of representation error plus the sqrt's
+    half-ulp, i.e. an absolute error on ``sqrt ≈ 2^12.5`` of ~1e-3,
+    far below the ±1 the guards absorb (the guards would only be
+    outrun near ``t ≈ 2^45``).  The static cap keeps that argument
+    comfortably valid instead of letting an extreme block count
+    (~2896 query blocks — seq ≈ 1.5M at block 512) silently mis-map
+    blocks (ADVICE r4)."""
+    if not (
         causal
         and q_offset == k_offset
         and tq == tk
         and pad_q == 0
         and pad_k == 0
         and block_q == block_k
-    )
+    ):
+        return False
+    nq = tq // block_q
+    return nq * (nq + 1) // 2 <= 1 << 22
 
 
 def _union_vma_sds(shape, dtype, *arrays):

@@ -56,7 +56,11 @@ from mpi4jax_tpu.ops._core import (
     fence_out,
     publishes_token,
 )
-from mpi4jax_tpu.utils.validation import check_comm, check_static_int
+from mpi4jax_tpu.utils.validation import (
+    check_comm,
+    check_rank_range,
+    check_static_int,
+)
 
 __all__ = ["send", "recv", "sendrecv", "Status", "ANY_SOURCE", "ANY_TAG"]
 
@@ -211,6 +215,15 @@ def _is_runtime_rank(spec):
     return isinstance(spec, jax.core.Tracer)
 
 
+def _is_static_rank_int(spec):
+    """A partner given as a plain static int — bools are rejected on
+    every rank-taking path (``check_static_int`` semantics), so they
+    must not slip through to the rendezvous routes either."""
+    return isinstance(spec, (int, np.integer)) and not isinstance(
+        spec, (bool, np.bool_)
+    )
+
+
 def _check_tag(tag, rendezvous_ok):
     """Tags are static on the trace-time matching paths (matching keys on
     the value); the rendezvous tier accepts traced tags (they ride the
@@ -223,8 +236,9 @@ def _check_tag(tag, rendezvous_ok):
             "tag must be a static (trace-time) integer here: trace-time "
             "send/recv matching keys on the tag value. A traced "
             "(runtime-valued) tag is supported only on the mesh backend's "
-            "rendezvous tier — a send with a traced dest, or a recv with "
-            "a traced source or source=ANY_SOURCE."
+            "rendezvous tier — send with an int or traced dest, or recv "
+            "with an int or traced source or source=ANY_SOURCE (pattern-"
+            "list partners stay trace-matched and need a static tag)."
         )
     return check_static_int(tag, "tag")
 
@@ -288,11 +302,10 @@ def _rendezvous_recv(x, source, tag, comm, token, status):
     if _is_runtime_rank(source):
         want = source
     else:
-        # only ANY_SOURCE reaches here through recv(): a static source
-        # either trace-matches, raises the bare-int guidance, or raises
-        # the no-matching-send error — so the non-traced case IS the
-        # engine wildcard
-        want = jnp.int32(ANY_SOURCE)
+        # a static source reaches here either as the ANY_SOURCE wildcard
+        # or as a specific rank paired with a traced tag (ADVICE r4) —
+        # the engine matches both shapes at runtime
+        want = jnp.int32(int(source))
     token, _ = fence_in(token)
 
     shape, dtype = tuple(x.shape), x.dtype
@@ -359,17 +372,21 @@ def send(x, dest, tag=0, *, comm=None, token=None):
         from mpi4jax_tpu.ops import _proc
 
         tag = check_static_int(tag, "tag")
-        dest = check_static_int(dest, "dest")
-        if not 0 <= dest < comm.size:
-            raise ValueError(
-                f"dest={dest} out of range for communicator of size "
-                f"{comm.size}"
-            )
+        dest = check_rank_range(
+            check_static_int(dest, "dest"), "dest", comm.size
+        )
         stamp = _proc.proc_send(x, token.stamp, comm, dest, tag)
         return token.with_stamp(stamp)
-    if comm.backend == "mesh" and _is_runtime_rank(dest):
-        # data-dependent destination: only the host rendezvous tier can
-        # route it (trace-time matching needs a static pattern)
+    if comm.backend == "mesh" and (
+        _is_runtime_rank(dest)
+        or (_is_runtime_rank(tag) and _is_static_rank_int(dest))
+    ):
+        # data-dependent destination (trace-time matching needs a static
+        # pattern) or a traced tag on a single-rank dest (the matching
+        # recv keys on the runtime tag value, so both sides must meet in
+        # the engine; ADVICE r4) — route through the host rendezvous tier
+        if not _is_runtime_rank(dest):
+            dest = check_rank_range(dest, "dest", comm.size)
         return _rendezvous_send(x, dest, _check_tag(tag, True), comm, token)
     tag = _check_tag(tag, False)
     pairs = _resolve_pairs(dest, comm.size, "dest")
@@ -402,11 +419,8 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
 
         tag = check_static_int(tag, "tag")
         source = check_static_int(source, "source")
-        if source != ANY_SOURCE and not 0 <= source < comm.size:
-            raise ValueError(
-                f"source={source} out of range for communicator of size "
-                f"{comm.size}"
-            )
+        if source != ANY_SOURCE:
+            source = check_rank_range(source, "source", comm.size)
         y, stamp, st = _proc.proc_recv(x, token.stamp, comm, source, tag)
         if status is not None:
             _deliver_status(status, st)
@@ -415,11 +429,15 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
         isinstance(source, (int, np.integer)) and int(source) == ANY_SOURCE
     )
     if comm.backend == "mesh" and (
-        _is_runtime_rank(source) or (_is_runtime_rank(tag) and source_is_any)
+        _is_runtime_rank(source)
+        or (_is_runtime_rank(tag) and _is_static_rank_int(source))
     ):
         # runtime-valued source (no static pattern to match against) or
-        # a traced tag (trace-time matching cannot key on it): match at
-        # execution time in the host engine
+        # a traced tag (trace-time matching cannot key on it; the engine
+        # matches any static-int or wildcard source at runtime, ADVICE
+        # r4): match at execution time in the host engine
+        if not _is_runtime_rank(source) and not source_is_any:
+            source = check_rank_range(source, "source", comm.size)
         return _rendezvous_recv(
             x, source, _check_tag(tag, True), comm, token, status
         )
@@ -511,14 +529,12 @@ def sendrecv(
     if comm.backend == "proc":
         from mpi4jax_tpu.ops import _proc
 
-        source = check_static_int(source, "source")
-        dest = check_static_int(dest, "dest")
-        for name, r in (("source", source), ("dest", dest)):
-            if not 0 <= r < comm.size:
-                raise ValueError(
-                    f"{name}={r} out of range for communicator of size "
-                    f"{comm.size}"
-                )
+        source = check_rank_range(
+            check_static_int(source, "source"), "source", comm.size
+        )
+        dest = check_rank_range(
+            check_static_int(dest, "dest"), "dest", comm.size
+        )
         y, stamp, st = _proc.proc_sendrecv(
             sendbuf, recvbuf, token.stamp, comm, source, dest, sendtag,
             recvtag,

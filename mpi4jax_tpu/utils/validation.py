@@ -12,7 +12,13 @@ import numpy as np
 
 import jax.core
 
-__all__ = ["check_static_int", "check_comm", "check_op", "check_root"]
+__all__ = [
+    "check_static_int",
+    "check_rank_range",
+    "check_comm",
+    "check_op",
+    "check_root",
+]
 
 
 def _is_tracer(x):
@@ -34,6 +40,19 @@ def check_static_int(value, name, allow_none=False):
     if isinstance(value, (int, np.integer)):
         return int(value)
     raise TypeError(f"{name} must be an integer, got {type(value).__name__}")
+
+
+def check_rank_range(value, name, size):
+    """Validate a static partner rank: an int (bools rejected, matching
+    :func:`check_static_int`) in ``[0, size)``."""
+    if isinstance(value, (bool, np.bool_)):
+        raise TypeError(f"{name} must be an integer, got bool")
+    value = int(value)
+    if not 0 <= value < size:
+        raise ValueError(
+            f"{name}={value} out of range for communicator of size {size}"
+        )
+    return value
 
 
 def check_comm(comm):

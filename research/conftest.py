@@ -1,0 +1,29 @@
+"""Harness for the opt-in research appendix suite: same virtual
+8-device CPU slice as tests/conftest.py (run with ``pytest research/``)."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import pytest  # noqa: E402
+
+N_DEVICES = 8
+
+
+@pytest.fixture(scope="session")
+def comm2d():
+    from mpi4jax_tpu import MeshComm
+
+    mesh = jax.make_mesh(
+        (2, 4), ("y", "x"), axis_types=(jax.sharding.AxisType.Auto,) * 2
+    )
+    return MeshComm.from_mesh(mesh)

@@ -1,7 +1,8 @@
 """Fused Pallas TPU kernels for the shallow-water wide-halo step.
 
 .. admonition:: RETIRED — research appendix, not a production path
-   (round 4)
+   (round 4; moved out of the package into ``research/`` in round 5 —
+   its equivalence suite is the opt-in ``pytest research/``)
 
    Nothing in the package selects these kernels; the XLA step is the
    default everywhere and the only benched path.  On the target

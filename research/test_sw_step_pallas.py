@@ -1,8 +1,15 @@
 """The fused Pallas step must reproduce the XLA wide-halo schedule
 exactly (to float32 roundoff) — on a 2-D decomposition with walls,
 periodic x, multiple tiles per device, and across multiple AB2 steps.
-Runs in interpret mode on the virtual CPU mesh (the same kernels run
-compiled on TPU; tests/conftest.py pins the CPU platform)."""
+Runs in interpret mode on the virtual CPU mesh (this file's conftest
+pins the CPU platform).
+
+Opt-in appendix suite (the kernel is retired from the package — see
+sw_step_pallas.py's docstring): run with ``pytest research/``; the
+default suite (testpaths = tests/) does not collect it."""
+
+import pathlib
+import sys
 
 import jax
 import numpy as np
@@ -10,7 +17,9 @@ import pytest
 
 import mpi4jax_tpu as m
 from mpi4jax_tpu.models import shallow_water as sw
-from mpi4jax_tpu.models import sw_step_pallas as swp
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+import sw_step_pallas as swp  # noqa: E402
 
 
 def _run_pair(cfg, comm, n_steps, block_rows):

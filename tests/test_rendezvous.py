@@ -223,6 +223,83 @@ def test_runtime_traced_tag(comm1d):
     np.testing.assert_array_equal(out[:, 1], (np.arange(8) - shift) % SIZE)
 
 
+def test_traced_tag_static_partner_roundtrip():
+    """ADVICE r4: a traced (runtime-valued) tag combined with STATIC int
+    partners routes BOTH send and recv through the rendezvous tier —
+    previously recv raised TypeError unless the source was traced or
+    ANY_SOURCE, and send required a traced dest."""
+    mesh = jax.make_mesh(
+        (1,), ("q",), axis_types=(jax.sharding.AxisType.Auto,)
+    )
+    comm = m.MeshComm.from_mesh(mesh)
+
+    def fn(x, tagv):
+        tag = tagv[0].astype(jnp.int32)  # traced, runtime-valued
+        tok = m.create_token()
+        tok = m.send(x * 2.0, 0, tag=tag, comm=comm, token=tok)
+        st = m.Status()
+        y, tok = m.recv(
+            x, source=0, tag=tag, comm=comm, token=tok, status=st
+        )
+        return jnp.concatenate(
+            [
+                y,
+                jnp.stack(
+                    [
+                        st.source.astype(jnp.float32),
+                        st.tag.astype(jnp.float32),
+                    ]
+                ),
+            ]
+        )
+
+    f = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(jax.P("q"), jax.P()),
+            out_specs=jax.P("q"),
+        )
+    )
+    out = np.asarray(f(jnp.arange(4.0), jnp.array([7], jnp.int32)))
+    np.testing.assert_array_equal(out[:4], 2.0 * np.arange(4.0))
+    assert out[4] == 0.0  # Status.source: the static partner
+    assert out[5] == 7.0  # Status.tag: the runtime tag value
+
+
+def test_traced_tag_static_partner_out_of_range():
+    """The static partner on the traced-tag rendezvous route is still
+    range-checked at trace time."""
+    mesh = jax.make_mesh(
+        (1,), ("q",), axis_types=(jax.sharding.AxisType.Auto,)
+    )
+    comm = m.MeshComm.from_mesh(mesh)
+
+    def bad_recv(x, tagv):
+        y, _ = m.recv(
+            x, source=5, tag=tagv[0].astype(jnp.int32), comm=comm,
+            token=m.create_token(),
+        )
+        return y
+
+    def bad_send(x, tagv):
+        tok = m.send(
+            x, 5, tag=tagv[0].astype(jnp.int32), comm=comm,
+            token=m.create_token(),
+        )
+        _ = tok
+        return x
+
+    for bad in (bad_recv, bad_send):
+        with pytest.raises(ValueError, match="out of range"):
+            jax.jit(
+                jax.shard_map(
+                    bad, mesh=mesh, in_specs=(jax.P("q"), jax.P()),
+                    out_specs=jax.P("q"),
+                )
+            )(jnp.arange(4.0), jnp.array([7], jnp.int32))
+
+
 def test_runtime_dest_out_of_range_fails_loudly(comm1d):
     def fn(x):
         r = jax.lax.axis_index("p")
