@@ -33,6 +33,7 @@ is a traced value inside ``shard_map`` (SPMD — see docs/usage.md).
 """
 
 import functools as _functools
+import sys as _sys
 
 import mpi4jax_tpu as _m
 
@@ -168,6 +169,50 @@ def _wrap(fn):
     return wrapper
 
 
+_MPI_ERR_RANK = 6  # the canonical MPI error class for an invalid rank
+
+
+def _wrap_p2p(fn, mpi_op):
+    """p2p wrapper that additionally reproduces the reference bridge's
+    death wire format on an invalid partner rank.
+
+    The reference aborts at *execution* time with
+    ``r{rank} | MPI_{op} returned error code {ierr}: {err} - aborting``
+    on stderr (mpi_xla_bridge.pyx:75-91; pinned by the reference's own
+    tests/collective_ops/test_common.py::test_abort_on_error).  This
+    library rejects the bad rank *earlier* — an eager trace-time
+    ValueError naming it — which is the better diagnostic, but the
+    observable death contract is part of the compat surface: emit the
+    reference's line before the raise, so tooling that greps stderr
+    for it keeps working.  The process still dies by the (clearer)
+    exception; under the launcher, fail-fast kills the job exactly as
+    MPI_Abort would."""
+
+    @_functools.wraps(fn)
+    def wrapper(*args, comm=None, **kwargs):
+        comm = _unwrap(comm)
+        try:
+            return fn(*args, comm=comm, **kwargs)
+        except ValueError as e:
+            if "out of range for communicator" in str(e):
+                try:
+                    from mpi4jax_tpu.utils.validation import check_comm
+
+                    rank = check_comm(comm).rank()
+                    rank = rank if isinstance(rank, int) else 0
+                except Exception:  # traced rank (mesh) or no default
+                    rank = 0
+                print(
+                    f"r{rank} | MPI_{mpi_op} returned error code "
+                    f"{_MPI_ERR_RANK}: {e} - aborting",
+                    file=_sys.stderr,
+                    flush=True,
+                )
+            raise
+
+    return wrapper
+
+
 # the reference's experimental namespace (auto_tokenize) rides along
 from mpi4jax_tpu import experimental  # noqa: E402,F401
 
@@ -177,11 +222,11 @@ alltoall = _wrap(_m.alltoall)
 barrier = _wrap(_m.barrier)
 bcast = _wrap(_m.bcast)
 gather = _wrap(_m.gather)
-recv = _wrap(_m.recv)
+recv = _wrap_p2p(_m.recv, "Recv")
 reduce = _wrap(_m.reduce)
 scan = _wrap(_m.scan)
 scatter = _wrap(_m.scatter)
-send = _wrap(_m.send)
-sendrecv = _wrap(_m.sendrecv)
+send = _wrap_p2p(_m.send, "Send")
+sendrecv = _wrap_p2p(_m.sendrecv, "Sendrecv")
 create_token = _m.create_token
 has_cuda_support = _m.has_cuda_support

@@ -206,25 +206,32 @@ def _forward_sharded(
     x = promote_vma(params.embed[tokens], mesh_axes)  # (B, S_local, d)
     aux0 = promote_vma(jnp.zeros((), jnp.float32), mesh_axes)
 
+    from jax.ad_checkpoint import checkpoint_name
+
     def layer(carry, bp):
         x, aux = carry
         token = create_token()
         h = _rmsnorm(x, bp.ln1, cfg.eps)
         h, token = _f_collective(h, comm_tp, token)
-        q = (h @ bp.wq).reshape(b, s, hq_l, dh)
-        k = (h @ bp.wk).reshape(b, s, hk_l, dh)
-        v = (h @ bp.wv).reshape(b, s, hk_l, dh)
+        # checkpoint_name tags are inert except under remat="names",
+        # whose policy saves exactly these tensors (see below)
+        q = checkpoint_name((h @ bp.wq).reshape(b, s, hq_l, dh), "qkv")
+        k = checkpoint_name((h @ bp.wk).reshape(b, s, hk_l, dh), "qkv")
+        # v is tagged apart: the names policy recomputes it (one cheap
+        # [t,d]x[d,d] matmul off the already-recomputed h) — the 128 MB
+        # per layer it would pin is what lets batch 16 fit in HBM
+        v = checkpoint_name((h @ bp.wv).reshape(b, s, hk_l, dh), "v_proj")
         attn, token = seq_attn(
             q, k, v, comm_sp, causal=True, token=token,
             impl=getattr(cfg, "attn_impl", "auto"),
         )
         a_part = attn.reshape(b, s, hq_l * dh) @ bp.wo
         a, token = allreduce(a_part, reductions.SUM, comm=comm_tp, token=token)
-        x = x + a
+        x = x + checkpoint_name(a, "attn_out")
 
         h2 = _rmsnorm(x, bp.ln2, cfg.eps)
         res = mlp(h2, bp, cfg, comm_tp, comm_sp, token)
-        m = res[0]
+        m = checkpoint_name(res[0], "mlp_out")
         if len(res) > 2:  # (out, token, aux) — MoE auxiliary losses
             aux = aux + res[2]
         return (x + m, aux), None
@@ -240,27 +247,58 @@ def _forward_sharded(
         # the attention internals, whose [T, T] score tensors are the
         # memory hog — recovering most of full-remat's memory saving at
         # a fraction of its ~1/3 FLOP overhead.
+        # remat="names" is the measured sweet spot on bandwidth-starved
+        # chips (docs/performance.md step timeline): keep FOUR
+        # [tokens, d]-sized tensors per layer (q, k, attn-out, mlp-out
+        # — v is tagged "v_proj", deliberately outside the save list)
+        # and recompute only the cheap glue (rmsnorms, residual adds,
+        # gelu) plus v, the single wide w1 matmul, and the flash
+        # forward — ~0.9N recompute FLOPs vs full remat's 2N, at ~1/13
+        # of the activation memory the dots policy would pin (it saves
+        # the [tokens, d_ff] w1 outputs; this policy's whole point is
+        # NOT saving those).
         if remat == "dots":
             layer = jax.checkpoint(
                 layer,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             )
-        else:
+        elif remat == "names":
+            layer = jax.checkpoint(
+                layer,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "qkv", "attn_out", "mlp_out"
+                ),
+            )
+        elif remat is True:
             layer = jax.checkpoint(layer)
+        else:
+            raise ValueError(
+                f"remat must be False, True, 'dots' or 'names', got "
+                f"{remat!r}"
+            )
     (x, aux), _ = lax.scan(layer, (x, aux0), params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)
     return x @ params.head, aux  # (B, S_local, V) logits, aux-loss sum
 
 
 def _ce(logits, targets):
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return -picked.mean()
+    """Streaming cross-entropy: ``mean(lse - logits[target])``.
+
+    Mathematically identical to log_softmax + gather (the picked
+    log-probability IS ``logits[target] - logsumexp``), but never
+    materialises a float32 ``[B, S, V]`` tensor: the f32 conversion
+    fuses into the logsumexp reductions, so XLA reads the bf16 logits
+    and writes only ``[B, S]`` statistics.  The log_softmax form cost
+    ~12 GB/step of f32 HBM round-trips on the MFU config's
+    ``[16, 2048, 32768]`` logits (step timeline, docs/performance.md)."""
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)
+    return (lse - picked[..., 0].astype(jnp.float32)).mean()
 
 
 def make_global_train_step(
     mesh, comm_dp, comm_tp, comm_sp, cfg, lr=1e-1, *, mlp=None, specs=None,
-    sequence="ring", remat=False,
+    sequence="ring", remat=False, donate=False,
 ):
     """Jitted global train step over a ``(dp, tp, sp)`` mesh.
 
@@ -277,6 +315,9 @@ def make_global_train_step(
     size).  ``remat=True`` wraps each layer in ``jax.checkpoint`` —
     activation memory O(1) layers instead of O(layers), ~1/3 extra
     FLOPs; gradients are unchanged (same math, recomputed).
+    ``remat="dots"`` / ``remat="names"`` select partial policies (see
+    ``_forward_sharded``); ``donate=True`` donates the params argument
+    to the update (training-loop idiom).
     """
     dp_ax = comm_dp.axes[0]
     tp_ax = comm_tp.axes[0]
@@ -341,7 +382,11 @@ def make_global_train_step(
             mesh=mesh,
             in_specs=(specs, batch_specs),
             out_specs=(specs, jax.P((dp_ax, tp_ax, sp_ax))),
-        )
+        ),
+        # donate=True releases the old params' buffers to the update
+        # (the training-loop idiom `params, loss = step(params, ...)`);
+        # callers that reuse params after the call keep the default
+        donate_argnums=(0,) if donate else (),
     )
 
 

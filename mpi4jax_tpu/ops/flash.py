@@ -98,7 +98,6 @@ def _kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
     *rest,
     scale,
     causal,
@@ -111,6 +110,16 @@ def _kernel(
     with_lse,
     triangle,
 ):
+    # triangle runs carry a precomputed additive causal-mask bias as a
+    # 4th input (0 on visible entries, _NEG on masked): one VPU add on
+    # the diagonal blocks replaces the iota+compare+select stack, and
+    # _NEG absorbs any finite score exactly, so the result is
+    # bit-identical to the where() form
+    if triangle:
+        mask_ref, o_ref, *rest = rest
+    else:
+        mask_ref = None
+        o_ref, *rest = rest
     if with_lse:
         m_out_ref, l_out_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -133,19 +142,26 @@ def _kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
 
     def _compute(mask_causal):
-        q = q_ref[0].astype(jnp.float32)  # [bq, D]
+        # the softmax scale rides the [bq, D] query block instead of the
+        # [bq, bk] score block — one full-block VPU pass saved per visit
+        # (bk/D× fewer multiplies); f32 so no operand rounding is added
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, D]
         k = k_ref[0].astype(jnp.float32)  # [bk, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        s = s * scale  # [bq, bk]
+        )  # [bq, bk] f32, already scaled
 
-        if mask_causal or not triangle:
+        if mask_causal and triangle:
+            # diagonal block of the squashed grid: add the precomputed
+            # bias (float addition with |s| << |_NEG| makes masked
+            # entries EXACTLY _NEG — the where() convention, one pass)
+            s = s + mask_ref[...]
+        elif not triangle:
             # local (unpadded-array) positions of this block's rows/cols
             krow = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-        if mask_causal:
+        if mask_causal and not triangle:
             qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -164,12 +180,28 @@ def _kernel(
         m_prev = m_ref[:, :1]  # [bq, 1] (lanes replicated)
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
-        w = jnp.exp(s - m_new)  # [bq, bk]
-        l_ref[...] = l_ref[...] * corr + w.sum(axis=1, keepdims=True)
+        if q_ref.dtype == jnp.bfloat16:
+            # bf16 transcendental: the exp argument is rounded to 8
+            # mantissa bits (~0.4% weight error — inside the bf16
+            # operands' own precision budget; the backward recomputes
+            # the SAME bf16 weights, so fwd/bwd stay self-consistent)
+            # and the PV contraction consumes w without a cast pass
+            w = jnp.exp((s - m_new).astype(jnp.bfloat16))
+        else:
+            w = jnp.exp(s - m_new)  # [bq, bk]
+        l_ref[...] = l_ref[...] * corr + w.sum(
+            axis=1, keepdims=True, dtype=jnp.float32
+        )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        # the weights ride the MXU in the INPUT dtype (f32 accumulate):
+        # for bf16 operands that rounds w to 8 mantissa bits — inside
+        # the operands' own precision budget (the flash-standard
+        # mixed-precision contraction) — and keeps the PV matmul on the
+        # fast MXU path; f32 inputs keep exact f32 weights (the tests'
+        # oracle-equality mode)
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            w,
-            v_ref[0].astype(jnp.float32),
+            w.astype(v_ref.dtype),
+            v_ref[0],
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -215,17 +247,17 @@ def flash_attention(
     scale=None,
     q_offset=0,
     k_offset=0,
-    block_q=512,
-    block_k=512,
+    block_q=1024,
+    block_k=1024,
     interpret=False,
 ):
     """Blockwise attention, same contract as ``local_attention``.
 
-    Block sizes default to 512 — measured ~2.6x faster than the
-    original 128x128 on v5e at seq 2048 within one phase (less
-    grid/revisit overhead, fuller MXU; absolute times swing ±30% with
-    co-tenancy — docs/performance.md) — and are clamped down for short
-    sequences.
+    Block sizes default to 1024 — the r5 sweep at seq 2048/b16/h16/d128
+    measured fwd+bwd 10.45 ms at 1024x1024 vs 13.30 ms at the old
+    512x512 default and worse at every other feasible pair (1024x2048
+    and 2048x* exceed VMEM; absolute times swing ±30% with co-tenancy —
+    docs/performance.md) — and are clamped down for short sequences.
 
     ``q``: [B, Tq, H, D]; ``k``/``v``: [B, Tk, H, D].  Sequence lengths
     are padded internally to the block sizes (padded K rows are masked
@@ -271,6 +303,11 @@ def _flash_vjp(
 def _flash_fwd(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
 ):
+    # NB a checkpoint_name tag on these residuals CANNOT spare the
+    # forward replay under jax.checkpoint: linearising the custom_vjp
+    # call re-runs this fwd rule regardless of what a save-names policy
+    # keeps (measured r5 — the tagged variant still traced 4 kernel
+    # classes and paid an extra o-proj recompute).
     out, m_res, l_res = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k,
         interpret, with_lse=True,
@@ -280,13 +317,18 @@ def _flash_fwd(
 
 def _bwd_block(
     q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, *, iq, ik, scale,
-    mask_causal, mask_kv, q_offset, k_offset, kv_len, block_q, block_k,
+    scale_on, mask_causal, mask_kv, q_offset, k_offset, kv_len, block_q,
+    block_k, mask_ref=None,
 ):
     """Shared per-block backward math: recompute masked scores and the
     softmax weights from the saved (m, l) statistics, then form ds —
-    the cotangent of the RAW scores.  ``ds`` is zeroed outside the
-    visible set exactly as the dense oracle's ``where`` vjp does (this
-    is what keeps the fully-masked-row uniform-weights convention
+    the cotangent of the SCALED scores, with the softmax scale folded
+    into one [block, D] operand instead of two [bq, bk] passes
+    (``scale_on``: the dkv kernel scales q — its dk contraction then
+    absorbs the score-cotangent's trailing ·scale through the scaled q
+    — the dq kernel scales k, symmetrically).  ``ds`` is zeroed outside
+    the visible set exactly as the dense oracle's ``where`` vjp does
+    (this is what keeps the fully-masked-row uniform-weights convention
     gradient-exact: those rows produce p == 1/n but ds == 0).
 
     ``mask_causal``/``mask_kv`` select which mask terms this block
@@ -294,53 +336,77 @@ def _bwd_block(
     unpadded, so they skip the iota/where VPU work entirely."""
     q = q_ref[0].astype(jnp.float32)  # [bq, D]
     k = k_ref[0].astype(jnp.float32)  # [bk, D]
-    v = v_ref[0].astype(jnp.float32)  # [bk, D]
-    g = g_ref[0].astype(jnp.float32)  # [bq, D]
+    v = v_ref[0]  # [bk, D]
+    g = g_ref[0]  # [bq, D]
+    if scale_on == "q":
+        q = q * scale
+    else:
+        k = k * scale
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    s = s * scale
+    )  # scaled scores
     visible = None
-    if mask_causal or mask_kv:
-        krow = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-    if mask_kv:
-        visible = krow < kv_len
-    if mask_causal:
-        qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        causal_ok = qpos >= k_offset + krow
-        visible = causal_ok if visible is None else (visible & causal_ok)
-        s = jnp.where(causal_ok, s, _NEG)
-    if mask_kv:
-        s = jnp.where(krow < kv_len, s, -_INF)
+    if mask_causal and mask_ref is not None:
+        # triangle diagonal block: one additive pass; masked entries
+        # become EXACTLY _NEG (|s| << |_NEG|), so p underflows to 0.0
+        # and ds is exactly 0 there with no visible-mask select at all
+        s = s + mask_ref[...]
+    else:
+        if mask_causal or mask_kv:
+            krow = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+        if mask_kv:
+            visible = krow < kv_len
+        if mask_causal:
+            qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            causal_ok = qpos >= k_offset + krow
+            visible = (
+                causal_ok if visible is None else (visible & causal_ok)
+            )
+            s = jnp.where(causal_ok, s, _NEG)
+        if mask_kv:
+            s = jnp.where(krow < kv_len, s, -_INF)
     # p from the saved statistics ((rows, 1) columns broadcast across
     # the block): exp(s - m) / l — NOT exp(s - (m + log l)), whose f32
     # fusion loses log(l) against the huge _NEG on fully-masked rows
     # and would inflate those rows' weights from 1/n to 1.  Padded q
     # rows carry m == +inf (host-side padding) so p is exactly 0 there.
-    p = jnp.exp(s - m_ref[0]) / l_ref[0]  # [bq, bk]
+    # bf16 operands recompute the forward's own bf16-exp weights (the l
+    # statistic summed exactly these), keeping fwd/bwd self-consistent.
+    if q_ref.dtype == jnp.bfloat16:
+        p = jnp.exp((s - m_ref[0]).astype(jnp.bfloat16)).astype(
+            jnp.float32
+        ) / l_ref[0]
+    else:
+        p = jnp.exp(s - m_ref[0]) / l_ref[0]  # [bq, bk]
     dp = jax.lax.dot_general(
         g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    ds = p * (dp - delta_ref[0]) * scale
+    # NO trailing ·scale: the caller's contraction against the scaled
+    # operand (q in dkv, k in dq) supplies it
+    ds = p * (dp - delta_ref[0])
     if visible is not None:
         ds = jnp.where(visible, ds, 0.0)
     return q, k, g, p, ds
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, scale, causal, q_offset, k_offset, kv_len,
-    block_q, block_k, num_q, triangle,
+    q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, *rest, scale,
+    causal, q_offset, k_offset, kv_len, block_q, block_k, num_q, triangle,
 ):
     """dK/dV: one key block per (middle) row, accumulated over the
     sequential query blocks.  On the triangle grid the visible set is
     ``iq >= ik``: the flat index walks key-block rows with iq ascending
     ik..n-1, the diagonal block is the only one needing the mask, and
     the fully-masked iq < ik blocks are never visited at all."""
+    if triangle:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        mask_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     if triangle:
         # reverse the fwd's lower-triangle walk: rows keyed by ik, iq
         # ascending within each row
@@ -364,13 +430,18 @@ def _bwd_dkv_kernel(
     def _accumulate(mask_causal):
         q, _k, g, p, ds = _bwd_block(
             q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, iq=iq,
-            ik=ik, scale=scale, mask_causal=mask_causal,
+            ik=ik, scale=scale, scale_on="q", mask_causal=mask_causal,
             mask_kv=not triangle, q_offset=q_offset, k_offset=k_offset,
             kv_len=kv_len, block_q=block_q, block_k=block_k,
+            mask_ref=mask_ref,
         )
-        # dV += P^T @ dO ; dK += dS^T @ Q   (contract the q-block dim)
+        # dV += P^T @ dO ; dK += dS^T @ Q   (contract the q-block dim).
+        # p rides the MXU in g's storage dtype (f32 accumulate); the dK
+        # contraction stays f32×f32 — q is already the f32 scaled local
+        # (the scale-folding operand), and f32 dots measured the same
+        # as bf16 on this kernel (it is DMA-, not MXU-, bound)
         dv_acc[...] += jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())),
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dk_acc[...] += jax.lax.dot_general(
@@ -396,13 +467,17 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, dq_ref, dq_acc,
-    *, scale, causal, q_offset, k_offset, kv_len, block_q, block_k,
-    num_k, triangle,
+    q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, *rest, scale,
+    causal, q_offset, k_offset, kv_len, block_q, block_k, num_k, triangle,
 ):
     """dQ: one query block per (middle) row, accumulated over the
     sequential key blocks (triangle: ik ascending 0..iq, diagonal
     masked, nothing above it visited)."""
+    if triangle:
+        mask_ref, dq_ref, dq_acc = rest
+    else:
+        mask_ref = None
+        dq_ref, dq_acc = rest
     if triangle:
         iq, ik = _tri_iq_ik(pl.program_id(1))
     else:
@@ -419,9 +494,10 @@ def _bwd_dq_kernel(
     def _accumulate(mask_causal):
         _q, k, _g, _p, ds = _bwd_block(
             q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, delta_ref, iq=iq,
-            ik=ik, scale=scale, mask_causal=mask_causal,
+            ik=ik, scale=scale, scale_on="k", mask_causal=mask_causal,
             mask_kv=not triangle, q_offset=q_offset, k_offset=k_offset,
             kv_len=kv_len, block_q=block_q, block_k=block_k,
+            mask_ref=mask_ref,
         )
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -505,8 +581,12 @@ def _flash_bwd(
 
         dkv_grid = (b * h, nk, nq)
 
+    bwd_operands = [qf, kf, vf, gf, m_pad, l_pad, delta]
+    if triangle:
+        bwd_operands.append(_causal_bias(block_q, block_k, qf, kf, vf, gf))
+
     def specs_for(qmap, kmap):
-        return [
+        specs = [
             pl.BlockSpec((1, block_q, d), qmap),
             pl.BlockSpec((1, block_k, d), kmap),
             pl.BlockSpec((1, block_k, d), kmap),
@@ -515,6 +595,11 @@ def _flash_bwd(
             pl.BlockSpec((1, block_q, 1), qmap),
             pl.BlockSpec((1, block_q, 1), qmap),
         ]
+        if triangle:
+            specs.append(
+                pl.BlockSpec((block_q, block_k), lambda *_: (0, 0))
+            )
+        return specs
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, num_q=nq, **common),
@@ -533,7 +618,7 @@ def _flash_bwd(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, gf, m_pad, l_pad, delta)
+    )(*bwd_operands)
 
     if triangle:
         def dq_qmap(bh, t):
@@ -564,7 +649,7 @@ def _flash_bwd(
         ),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, gf, m_pad, l_pad, delta)
+    )(*bwd_operands)
 
     return (
         _unfold(dq, tq, b, h, d),
@@ -582,6 +667,25 @@ def _blocks(tq, tk, block_q, block_k):
     block_q = min(block_q, max(tq, 8))
     block_k = min(block_k, max(tk, 8))
     return block_q, block_k, (-tq) % block_q, (-tk) % block_k
+
+
+def _causal_bias(block_q, block_k, *arrays):
+    """Additive causal mask for the triangle grid's diagonal blocks:
+    0 on the visible lower triangle, the finite ``_NEG`` elsewhere.
+    Built once per call outside the kernel (XLA folds it to a
+    constant); carries the operands' vma union for shard_map."""
+    vis = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    ) >= jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    bias = jnp.where(vis, 0.0, _NEG).astype(jnp.float32)
+    from mpi4jax_tpu.ops._core import promote_vma, vma_of
+
+    axes = set()
+    for a in arrays:
+        axes.update(vma_of(a) or ())
+    if axes:
+        bias = promote_vma(bias, tuple(sorted(axes)))
+    return bias
 
 
 def _fold(x, pad, b, h, d):
@@ -660,14 +764,21 @@ def _flash_fwd_impl(
                     (b * h, nq * block_q, 1), jnp.float32, qf, kf, vf
                 )
             )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), qmap),
+        pl.BlockSpec((1, block_k, d), kmap),
+        pl.BlockSpec((1, block_k, d), kmap),
+    ]
+    operands = [qf, kf, vf]
+    if triangle:
+        in_specs.append(
+            pl.BlockSpec((block_q, block_k), lambda *_: (0, 0))
+        )
+        operands.append(_causal_bias(block_q, block_k, qf, kf, vf))
     res = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), qmap),
-            pl.BlockSpec((1, block_k, d), kmap),
-            pl.BlockSpec((1, block_k, d), kmap),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs if with_lse else out_specs[0],
         out_shape=tuple(out_shape) if with_lse else out_shape[0],
         scratch_shapes=[
@@ -676,7 +787,7 @@ def _flash_fwd_impl(
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*operands)
 
     if with_lse:
         out, m_res, l_res = res
