@@ -29,7 +29,24 @@ def main():
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--op", default="allreduce",
                     choices=["allreduce", "allgather", "alltoall"])
+    ap.add_argument(
+        "--copy-gauntlet", action="store_true",
+        help="measure the aggregate plain-memcpy rate of N timesharing "
+        "ranks (no collective logic): the scheduler bound the arena's "
+        "ceiling model assumes perfect",
+    )
+    ap.add_argument(
+        "--two-tier", action="store_true",
+        help="composed ICI+DCN path: each launcher process runs an "
+        "8-device virtual mesh, parallel.distributed.two_tier_allreduce "
+        "end to end (VERDICT r4 #6)",
+    )
     args = ap.parse_args()
+
+    if args.two_tier:
+        return _two_tier_main(args)
+    if args.copy_gauntlet:
+        return _copy_gauntlet_main(args)
 
     import jax
 
@@ -92,7 +109,7 @@ def main():
         "payload_mb": nbytes / 1e6,
         "sec_per_call": round(best, 6),
     }
-    if rank == 0 and args.op == "allreduce":
+    if args.op == "allreduce":
         # In-run machine-relative ceiling (the same calibration pattern
         # as bench.py's HBM probe): the shm arena must move
         # (5n+1)*S bytes of memory traffic per S-byte allreduce
@@ -101,14 +118,197 @@ def main():
         # many cores the host gives the job.  With C = measured
         # single-core copy rate (payload bytes/s, i.e. traffic/2) and
         # k = cores available, ceiling busbw = 2C*k*factor/(5n+1).
-        copy_gbps = _copy_rate_gbps()
-        cores = _cores()
-        ceiling = 2 * copy_gbps * min(cores, n) * factor / (5 * n + 1)
-        rec["single_core_copy_gbps"] = round(copy_gbps, 2)
-        rec["cores_available"] = cores
-        rec["ceiling_gbps"] = round(ceiling, 3)
-        rec["pct_of_ceiling"] = round(100 * busbw / 1e9 / ceiling, 1)
+        #
+        # That C is measured SOLO — but the arena's copies run on N
+        # timesharing ranks, and the r5 copy gauntlet measured N-rank
+        # aggregate copy throughput at ~50 % of solo on this box (OS
+        # scheduler + VM bandwidth throttling, --copy-gauntlet mode).
+        # The scheduler-ADJUSTED ceiling below re-runs that mini
+        # gauntlet in-run (every rank copies between barriers) so the
+        # pct-of-ceiling is judged against what N processes can
+        # actually move, not what one process could.
+        copy_gbps = _copy_rate_gbps() if rank == 0 else 0.0
+        agg_gbps = _gauntlet_rate_gbps(comm, tok)
+        if rank == 0:
+            import numpy as _np
+
+            cores = _cores()
+            ceiling = 2 * copy_gbps * min(cores, n) * factor / (5 * n + 1)
+            adj_ceiling = 2 * agg_gbps * factor / (5 * n + 1)
+            rec["single_core_copy_gbps"] = round(copy_gbps, 2)
+            rec["gauntlet_agg_copy_gbps"] = round(agg_gbps, 2)
+            rec["cores_available"] = cores
+            rec["ceiling_gbps"] = round(ceiling, 3)
+            rec["pct_of_ceiling"] = round(100 * busbw / 1e9 / ceiling, 1)
+            rec["ceiling_sched_adjusted_gbps"] = round(adj_ceiling, 3)
+            rec["pct_of_sched_adjusted"] = round(
+                100 * busbw / 1e9 / adj_ceiling, 1
+            )
     if rank == 0:
+        print(json.dumps(rec), flush=True)
+
+
+def _gauntlet_rate_gbps(comm, tok, mb=16, reps=4):
+    """Aggregate N-rank copy payload rate (GB/s), barrier-fenced — the
+    multi-process analog of :func:`_copy_rate_gbps` and the measured
+    input to the scheduler-adjusted arena ceiling.  The single
+    implementation of this protocol: the standalone --copy-gauntlet
+    mode and the allreduce leg's in-run adjusted ceiling both call it."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+
+    src = np.random.default_rng(comm.rank()).random(
+        int(mb * (1 << 20)) // 8
+    )
+    dst = np.empty_like(src)
+    np.copyto(dst, src)
+    best = float("inf")
+    for _ in range(3):
+        tok = m.barrier(comm=comm, token=tok)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            np.copyto(dst, src)
+        dt = time.perf_counter() - t0
+        dt_max, tok = m.allreduce(
+            jnp.float32(dt), op=m.MAX, comm=comm, token=tok
+        )
+        best = min(best, float(dt_max))
+    return comm.size * src.nbytes * reps / best / 1e9
+
+
+def _copy_gauntlet_main(args):
+    """The arena ceiling's falsifiable assumption, measured: N ranks
+    timesharing the core should sustain the single-core copy rate in
+    AGGREGATE (streaming copies have no cache state to lose).  Each
+    rank memcpys a private --mb buffer --reps times between barriers
+    (:func:`_gauntlet_rate_gbps` — the same protocol the allreduce
+    leg's adjusted ceiling replays); rank 0 reports the aggregate
+    payload rate vs a TRULY solo probe (rank 0 measures while the
+    peers wait at a barrier).  If aggregate << solo, the gap is the OS
+    scheduler + DRAM contention — a bound on ANY shared-memory
+    collective on this box, not on the arena's design."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import mpi4jax_tpu as m
+
+    comm = m.get_default_comm()
+    assert comm.backend == "proc", "run under python -m mpi4jax_tpu.launch"
+    n, rank = comm.size, comm.rank()
+
+    # solo baseline: peers idle at the barrier while rank 0 probes
+    tok = m.barrier(comm=comm)
+    single = _copy_rate_gbps() if rank == 0 else 0.0
+    tok = m.barrier(comm=comm, token=tok)
+
+    agg = _gauntlet_rate_gbps(comm, tok, mb=args.mb, reps=args.reps)
+    if rank == 0:
+        print(
+            json.dumps(
+                {
+                    "metric": f"copy_gauntlet_proc{n}",
+                    "value": round(agg, 2),
+                    "unit": "GB/s aggregate payload",
+                    "nprocs": n,
+                    "payload_mb": args.mb,
+                    "single_core_copy_gbps": round(single, 2),
+                    "aggregate_vs_single_pct": round(100 * agg / single, 1),
+                }
+            ),
+            flush=True,
+        )
+
+
+def _two_tier_main(args):
+    """End-to-end timing of the composed ICI+DCN allreduce
+    (parallel/distributed.two_tier_allreduce): per launcher process an
+    8-device virtual mesh reduces over its "slice", one block rides
+    the proc wire across processes, and the result is re-broadcast
+    over the mesh.  Run under the launcher:
+
+        python -m mpi4jax_tpu.launch -np 2 benchmarks/proc_busbw.py \\
+            --two-tier [--mb 32]
+
+    Rank 0 prints algorithmic GB/s (global payload bytes / wall) plus
+    the DCN-hop busbw (the per-process block over the proc tier).
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.parallel.distributed import two_tier_allreduce
+
+    inter = m.get_default_comm()
+    assert inter.backend == "proc", "run under python -m mpi4jax_tpu.launch"
+    n = inter.size
+    mesh = jax.make_mesh(
+        (8,), ("d",), axis_types=(jax.sharding.AxisType.Auto,)
+    )
+    intra = m.MeshComm.from_mesh(mesh)
+
+    per = int(args.mb * 1e6 / 4)
+    per -= per % 8
+    x = jnp.ones((per,), jnp.float32)
+    nbytes = per * 4
+
+    y, _ = two_tier_allreduce(x, m.SUM, intra, inter)  # warm both tiers
+    np.asarray(y)
+
+    best = float("inf")
+    tok = m.create_token()
+    for _ in range(3):
+        tok = m.barrier(comm=inter, token=tok)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            y, _ = two_tier_allreduce(x, m.SUM, intra, inter)
+        np.asarray(y)
+        best = min(best, (time.perf_counter() - t0) / args.reps)
+
+    # the DCN hop measured ALONE: the same reduced block (1/8 of the
+    # payload) over the proc tier, without the virtual-ICI reduction
+    # around it — on this box the end-to-end number is floored by the
+    # ICI tier, and this separates the two
+    block = np.ones((per // 8,), np.float32)
+    block_bytes = block.nbytes
+    y2, tok2 = m.allreduce(block, m.SUM, comm=inter)
+    np.asarray(y2)
+    dcn_best = float("inf")
+    for _ in range(3):
+        tok2 = m.barrier(comm=inter, token=tok2)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            y2, tok2 = m.allreduce(block, m.SUM, comm=inter, token=tok2)
+        np.asarray(y2)
+        dcn_best = min(dcn_best, (time.perf_counter() - t0) / args.reps)
+
+    rec = {
+        "metric": f"two_tier_allreduce_proc{n}x8",
+        "value": round(nbytes / best / 1e9, 3),
+        "unit": "GB/s",
+        "nprocs": n,
+        "devices_per_proc": 8,
+        "payload_mb": nbytes / 1e6,
+        "sec_per_call": round(best, 6),
+        "dcn_block_mb": block_bytes / 1e6,
+        "dcn_busbw_gbps": round(
+            block_bytes * 2 * (n - 1) / n / dcn_best / 1e9, 3
+        ),
+    }
+    if inter.rank() == 0:
         print(json.dumps(rec), flush=True)
 
 
