@@ -127,11 +127,14 @@ def main():
         # gauntlet in-run (every rank copies between barriers) so the
         # pct-of-ceiling is judged against what N processes can
         # actually move, not what one process could.
+        # barrier-fence the SOLO probe: peers sleep at the second
+        # barrier while rank 0 measures (otherwise their gauntlet
+        # buffer setup timeshares the core and deflates the baseline)
+        tok = m.barrier(comm=comm, token=tok)
         copy_gbps = _copy_rate_gbps() if rank == 0 else 0.0
+        tok = m.barrier(comm=comm, token=tok)
         agg_gbps = _gauntlet_rate_gbps(comm, tok)
         if rank == 0:
-            import numpy as _np
-
             cores = _cores()
             ceiling = 2 * copy_gbps * min(cores, n) * factor / (5 * n + 1)
             adj_ceiling = 2 * agg_gbps * factor / (5 * n + 1)

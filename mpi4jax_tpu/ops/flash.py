@@ -111,10 +111,12 @@ def _kernel(
     triangle,
 ):
     # triangle runs carry a precomputed additive causal-mask bias as a
-    # 4th input (0 on visible entries, _NEG on masked): one VPU add on
-    # the diagonal blocks replaces the iota+compare+select stack, and
-    # _NEG absorbs any finite score exactly, so the result is
-    # bit-identical to the where() form
+    # 4th input (0 on visible entries, ~_NEG on masked, bf16): one VPU
+    # add on the diagonal blocks replaces the iota+compare+select
+    # stack; masked scores collapse to ~-2.4e38 whose exp underflows to
+    # exactly 0, so every OBSERVABLE quantity (w, l, m on rows with a
+    # visible entry — every triangle row has one) matches the where()
+    # form
     if triangle:
         mask_ref, o_ref, *rest = rest
     else:
@@ -153,8 +155,9 @@ def _kernel(
 
         if mask_causal and triangle:
             # diagonal block of the squashed grid: add the precomputed
-            # bias (float addition with |s| << |_NEG| makes masked
-            # entries EXACTLY _NEG — the where() convention, one pass)
+            # bias (one pass; masked entries collapse to ~_NEG and
+            # their exp underflows to exactly 0 — see the signature
+            # note)
             s = s + mask_ref[...]
         elif not triangle:
             # local (unpadded-array) positions of this block's rows/cols
@@ -348,8 +351,8 @@ def _bwd_block(
     visible = None
     if mask_causal and mask_ref is not None:
         # triangle diagonal block: one additive pass; masked entries
-        # become EXACTLY _NEG (|s| << |_NEG|), so p underflows to 0.0
-        # and ds is exactly 0 there with no visible-mask select at all
+        # collapse to ~_NEG, so p underflows to exactly 0.0 and ds is
+        # exactly 0 there with no visible-mask select at all
         s = s + mask_ref[...]
     else:
         if mask_causal or mask_kv:
@@ -677,7 +680,12 @@ def _causal_bias(block_q, block_k, *arrays):
     vis = jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     ) >= jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    bias = jnp.where(vis, 0.0, _NEG).astype(jnp.float32)
+    # bf16: same exponent range as f32, so the ~-2.4e38 sentinel
+    # survives the rounding, any finite score it is added to still
+    # collapses to ~_NEG, and exp underflows to exactly 0 — while the
+    # block costs half the VMEM/DMA of an f32 mask (the fused backward
+    # kernel is within ~2 MB of the 16 MB scoped-vmem limit at 1024²)
+    bias = jnp.where(vis, 0.0, _NEG).astype(jnp.bfloat16)
     from mpi4jax_tpu.ops._core import promote_vma, vma_of
 
     axes = set()

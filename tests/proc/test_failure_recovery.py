@@ -60,6 +60,7 @@ JOB = textwrap.dedent(
         start = mgr.latest_step() or 0
         if start:
             state = mgr.restore(start, like={"state": state})["state"]
+        print(f"[job] rank {rank} start={start}", flush=True)
         for i in range(start, total):
             state = step_fn(state, float(i))
             # state is replicated (allreduce-synced): rank 0 persists
@@ -110,16 +111,23 @@ def test_kill_resume_bit_identical(tmp_path):
     res = _launch(job, run_a, 10, 1, 7)
     assert res.returncode != 0, (res.stdout[-1500:], res.stderr[-1500:])
     assert not (run_a / "final.json").exists()
-    assert (run_a / "ck").exists(), "checkpoint must predate the death"
+    # the step-5 checkpoint must be COMMITTED (orbax step dir), not
+    # just the manager's root — otherwise phase B would silently
+    # restart from 0 and the test would pass without testing resume
+    assert (run_a / "ck" / "5").exists(), sorted(
+        p.name for p in (run_a / "ck").iterdir()
+    )
 
-    # B: restart the SAME job directory — resumes from step 5
+    # B: restart the SAME job directory — must RESUME from step 5
     res = _launch(job, run_a, 10, -1, -1)
     assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    assert "start=5" in res.stdout, res.stdout[-1500:]
     resumed = json.loads((run_a / "final.json").read_text())
 
     # C: uninterrupted oracle in a fresh directory
     res = _launch(job, run_c, 10, -1, -1)
     assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    assert "start=0" in res.stdout, res.stdout[-1500:]
     oracle = json.loads((run_c / "final.json").read_text())
 
     # bit-identical continuation (same f32 ops, same order, restored
