@@ -23,6 +23,20 @@ import time
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
+def _fence(comm, tok):
+    """Barrier that actually blocks the PYTHON thread: jax dispatch is
+    asynchronous, so an unforced ``m.barrier`` lets the caller sail on
+    (into buffer setup or a timing window) while the collective is
+    still in flight.  Forcing the token stamp makes the fence real."""
+    import jax
+
+    import mpi4jax_tpu as m
+
+    tok = m.barrier(comm=comm, token=tok)
+    jax.block_until_ready(tok.stamp)
+    return tok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mb", type=float, default=64.0)
@@ -83,7 +97,7 @@ def main():
 
     best = float("inf")
     for _ in range(3):
-        tok = m.barrier(comm=comm, token=tok)
+        tok = _fence(comm, tok)
         t0 = time.perf_counter()
         for _ in range(args.reps):
             y, tok = call(x, tok)
@@ -127,12 +141,13 @@ def main():
         # gauntlet in-run (every rank copies between barriers) so the
         # pct-of-ceiling is judged against what N processes can
         # actually move, not what one process could.
-        # barrier-fence the SOLO probe: peers sleep at the second
-        # barrier while rank 0 measures (otherwise their gauntlet
-        # buffer setup timeshares the core and deflates the baseline)
-        tok = m.barrier(comm=comm, token=tok)
+        # fence the SOLO probe: peers BLOCK at the second fence while
+        # rank 0 measures (otherwise their gauntlet buffer setup
+        # timeshares the core and deflates the baseline; the fences
+        # force the token — async dispatch would let peers sail on)
+        tok = _fence(comm, tok)
         copy_gbps = _copy_rate_gbps() if rank == 0 else 0.0
-        tok = m.barrier(comm=comm, token=tok)
+        tok = _fence(comm, tok)
         agg_gbps = _gauntlet_rate_gbps(comm, tok)
         if rank == 0:
             cores = _cores()
@@ -169,7 +184,7 @@ def _gauntlet_rate_gbps(comm, tok, mb=16, reps=4):
     np.copyto(dst, src)
     best = float("inf")
     for _ in range(3):
-        tok = m.barrier(comm=comm, token=tok)
+        tok = _fence(comm, tok)
         t0 = time.perf_counter()
         for _ in range(reps):
             np.copyto(dst, src)
@@ -202,10 +217,11 @@ def _copy_gauntlet_main(args):
     assert comm.backend == "proc", "run under python -m mpi4jax_tpu.launch"
     n, rank = comm.size, comm.rank()
 
-    # solo baseline: peers idle at the barrier while rank 0 probes
-    tok = m.barrier(comm=comm)
+    # solo baseline: peers BLOCK at the second fence while rank 0
+    # probes (forced — async dispatch would let them sail on)
+    tok = _fence(comm, m.create_token())
     single = _copy_rate_gbps() if rank == 0 else 0.0
-    tok = m.barrier(comm=comm, token=tok)
+    tok = _fence(comm, tok)
 
     agg = _gauntlet_rate_gbps(comm, tok, mb=args.mb, reps=args.reps)
     if rank == 0:
@@ -274,7 +290,7 @@ def _two_tier_main(args):
     best = float("inf")
     tok = m.create_token()
     for _ in range(3):
-        tok = m.barrier(comm=inter, token=tok)
+        tok = _fence(inter, tok)
         t0 = time.perf_counter()
         for _ in range(args.reps):
             y, _ = two_tier_allreduce(x, m.SUM, intra, inter)
@@ -291,7 +307,7 @@ def _two_tier_main(args):
     np.asarray(y2)
     dcn_best = float("inf")
     for _ in range(3):
-        tok2 = m.barrier(comm=inter, token=tok2)
+        tok2 = _fence(inter, tok2)
         t0 = time.perf_counter()
         for _ in range(args.reps):
             y2, tok2 = m.allreduce(block, m.SUM, comm=inter, token=tok2)

@@ -72,7 +72,21 @@ def main(argv=None):
         "--force-cpu", action="store_true",
         help="run on 8 virtual CPU devices regardless of platform",
     )
+    p.add_argument(
+        "--remat", choices=("off", "full", "dots", "names"), default="off",
+        help="dense-mode activation checkpointing: full = per-layer "
+        "jax.checkpoint, dots = save every matmul output, names = the "
+        "q/k/attn-out/mlp-out policy the MFU bench uses "
+        "(docs/performance.md)",
+    )
     args = p.parse_args(argv)
+
+    if args.remat != "off" and args.mode == "pp":
+        p.error(
+            "--remat applies to the dense/moe layer scan; the pipeline "
+            "schedules have their own built-in per-stage remat "
+            "(models/pipeline.py)"
+        )
 
     if args.force_cpu:
         import os
@@ -115,7 +129,10 @@ def main(argv=None):
                 head_dim=8, d_ff=64,
             )
             params = tfm.init_params(jax.random.PRNGKey(0), cfg)
-            step = tfm.make_global_train_step(mesh, dp, tp, sp, cfg, lr=3e-1)
+            remat = {"off": False, "full": True}.get(args.remat, args.remat)
+            step = tfm.make_global_train_step(
+                mesh, dp, tp, sp, cfg, lr=3e-1, remat=remat
+            )
         else:
             from mpi4jax_tpu.models import moe_transformer as moe
 
@@ -126,7 +143,10 @@ def main(argv=None):
                 z_weight=args.z_weight,
             )
             params = moe.init_params(jax.random.PRNGKey(0), cfg)
-            step = moe.make_global_train_step(mesh, dp, tp, sp, cfg, lr=3e-1)
+            remat = {"off": False, "full": True}.get(args.remat, args.remat)
+            step = moe.make_global_train_step(
+                mesh, dp, tp, sp, cfg, lr=3e-1, remat=remat
+            )
         b = 2 * dp.size
         s = 16 * sp.size
         label = f"mesh {shape} (dp x tp x sp)"
