@@ -441,10 +441,13 @@ def main(argv=None):
     p.add_argument("--bf16", action="store_true", help="bf16 params/activations")
     p.add_argument("--remat", action="store_true", help="checkpoint each layer")
     p.add_argument(
-        "--remat-policy", choices=("full", "dots", "names"), default=None,
+        "--remat-policy", default=None,
         help="checkpoint policy (overrides the preset): full = save "
         "nothing per layer, dots = save every matmul output, names = "
-        "save q/k/attn-out/mlp-out only (the measured MFU sweet spot)",
+        "save q/k/attn-out/mlp-out only (the measured MFU sweet spot), "
+        "or save:TAG[,TAG...] for a custom save-list drawn from "
+        "qkv/v_proj/attn_out/mlp_out (e.g. save:attn_out,mlp_out — "
+        "the lighter list that still fits at seq 32k)",
     )
     p.add_argument(
         "--attn-impl", choices=("auto", "flash", "xla", "autotune"),
@@ -470,7 +473,21 @@ def main(argv=None):
     preset = dict(SIZES[args.size]) if args.size else {}
     remat = preset.pop("remat", False) or args.remat
     if args.remat_policy:
-        remat = True if args.remat_policy == "full" else args.remat_policy
+        if args.remat_policy == "full":
+            remat = True
+        elif args.remat_policy.startswith("save:"):
+            remat = tuple(
+                t for t in args.remat_policy[5:].split(",") if t
+            )
+            if not remat:
+                p.error("save: needs at least one tag (e.g. save:attn_out)")
+        elif args.remat_policy in ("dots", "names"):
+            remat = args.remat_policy
+        else:
+            p.error(
+                f"--remat-policy must be full, dots, names or "
+                f"save:TAG[,TAG...], got {args.remat_policy!r}"
+            )
     preset_attn = preset.pop("attn_impl", None)
 
     def pick(name, default):

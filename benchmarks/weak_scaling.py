@@ -180,7 +180,11 @@ def _proc_main(args):
     u = step(u)  # compile + warm transports
     np.asarray(u)
 
+    # force the barrier (async dispatch would let ranks start the timed
+    # loop skewed — same convention as proc_busbw._fence); dt_max below
+    # still absorbs any residual skew
     tok = m.barrier(comm=comm)
+    jax.block_until_ready(tok.stamp)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         u = step(u)

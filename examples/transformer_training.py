@@ -121,6 +121,7 @@ def main(argv=None):
         world = m.MeshComm.from_mesh(mesh)
         dp, tp, sp = world.sub("dp"), world.sub("tp"), world.sub("sp")
 
+        remat = {"off": False, "full": True}.get(args.remat, args.remat)
         if args.mode == "dense":
             from mpi4jax_tpu.models import transformer as tfm
 
@@ -129,7 +130,6 @@ def main(argv=None):
                 head_dim=8, d_ff=64,
             )
             params = tfm.init_params(jax.random.PRNGKey(0), cfg)
-            remat = {"off": False, "full": True}.get(args.remat, args.remat)
             step = tfm.make_global_train_step(
                 mesh, dp, tp, sp, cfg, lr=3e-1, remat=remat
             )
@@ -143,7 +143,6 @@ def main(argv=None):
                 z_weight=args.z_weight,
             )
             params = moe.init_params(jax.random.PRNGKey(0), cfg)
-            remat = {"off": False, "full": True}.get(args.remat, args.remat)
             step = moe.make_global_train_step(
                 mesh, dp, tp, sp, cfg, lr=3e-1, remat=remat
             )

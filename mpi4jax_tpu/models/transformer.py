@@ -45,7 +45,14 @@ __all__ = [
     "make_global_decode",
     "reference_loss",
     "reference_greedy_decode",
+    "CHECKPOINT_NAMES",
 ]
+
+# checkpoint_name tags attached inside each layer (see _forward_sharded);
+# remat may be given as a tuple drawn from these to pick a custom
+# save-list between full remat (save nothing) and "names" (the default
+# q/k/attn-out/mlp-out sweet spot)
+CHECKPOINT_NAMES = ("qkv", "v_proj", "attn_out", "mlp_out")
 
 
 class TransformerConfig(NamedTuple):
@@ -236,6 +243,13 @@ def _forward_sharded(
             aux = aux + res[2]
         return (x + m, aux), None
 
+    if isinstance(remat, (tuple, list)) and not remat:
+        # () is falsy — it would silently skip the remat block below
+        # and benchmark the non-remat path instead of erroring
+        raise ValueError(
+            "empty remat save-list; use remat=True for full remat or a "
+            f"non-empty subset of {CHECKPOINT_NAMES}"
+        )
     if remat:
         # rematerialise each layer in the backward pass: activation
         # memory drops from O(layers) to O(1) layers (plus the scan
@@ -257,6 +271,10 @@ def _forward_sharded(
         # of the activation memory the dots policy would pin (it saves
         # the [tokens, d_ff] w1 outputs; this policy's whole point is
         # NOT saving those).
+        # An explicit tuple/list of tag names selects a CUSTOM save
+        # list — the memory/recompute dial exposed to sweeps (e.g. at
+        # seq 32k, where the standard names list OOMs, a lighter
+        # ("attn_out", "mlp_out") list can still fit).
         if remat == "dots":
             layer = jax.checkpoint(
                 layer,
@@ -269,12 +287,25 @@ def _forward_sharded(
                     "qkv", "attn_out", "mlp_out"
                 ),
             )
+        elif isinstance(remat, (tuple, list)):
+            unknown = set(remat) - set(CHECKPOINT_NAMES)
+            if unknown:
+                raise ValueError(
+                    f"unknown checkpoint tag(s) {sorted(unknown)}; the "
+                    f"layer tags are {CHECKPOINT_NAMES}"
+                )
+            layer = jax.checkpoint(
+                layer,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    *remat
+                ),
+            )
         elif remat is True:
             layer = jax.checkpoint(layer)
         else:
             raise ValueError(
-                f"remat must be False, True, 'dots' or 'names', got "
-                f"{remat!r}"
+                f"remat must be False, True, 'dots', 'names' or a "
+                f"tuple of tag names, got {remat!r}"
             )
     (x, aux), _ = lax.scan(layer, (x, aux0), params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)

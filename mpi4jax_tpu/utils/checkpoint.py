@@ -126,6 +126,15 @@ class Manager:
             step, args=ocp.args.StandardRestore(abstract)
         )
 
+    def wait_until_finished(self):
+        """Durability barrier: block until every pending save is
+        COMMITTED (the orbax step dir renamed out of its ``.tmp``
+        form).  Fault-tolerant loops call this before telling other
+        ranks the step is safe — a crash after ``save()`` but before
+        commit would otherwise leave only a ``.orbax-checkpoint-tmp``
+        dir that ``latest_step()`` ignores on restart."""
+        self._mgr.wait_until_finished()
+
     def close(self):
         self._mgr.wait_until_finished()
         self._mgr.close()

@@ -153,6 +153,44 @@ def test_remat_matches_plain(mesh3d, comms, sequence):
         )
 
 
+@pytest.mark.parametrize(
+    "policy",
+    ["names", ("attn_out", "mlp_out"), ("qkv", "v_proj", "attn_out", "mlp_out")],
+    ids=["names", "save-residuals", "save-all-tags"],
+)
+def test_remat_save_lists_match_plain(mesh3d, comms, policy):
+    # partial-remat policies (the named sweet spot and custom
+    # save-lists) recompute a subset of the layer: gradients must be
+    # identical to the non-remat step up to scheduling.
+    comm_dp, comm_tp, comm_sp = comms
+    params = tfm.init_params(jax.random.PRNGKey(9), CFG)
+    tokens, targets = batch(seed=10)
+    plain = tfm.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=1e-1
+    )
+    rstep = tfm.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=1e-1, remat=policy
+    )
+    p1, l1 = plain(params, (tokens, targets))
+    p2, l2 = rstep(params, (tokens, targets))
+    np.testing.assert_allclose(
+        float(np.asarray(l1)[0]), float(np.asarray(l2)[0]), rtol=1e-6
+    )
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2), strict=True):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_remat_unknown_tag_raises(mesh3d, comms):
+    comm_dp, comm_tp, comm_sp = comms
+    with pytest.raises(ValueError, match="unknown checkpoint tag"):
+        step = tfm.make_global_train_step(
+            mesh3d, comm_dp, comm_tp, comm_sp, CFG, remat=("nope",)
+        )
+        step(tfm.init_params(jax.random.PRNGKey(0), CFG), batch(seed=0))
+
+
 def test_ulysses_gqa_divisibility_error(mesh3d, comms):
     comm_dp, comm_tp, comm_sp = comms
     with pytest.raises(ValueError, match="ulysses"):

@@ -63,12 +63,20 @@ JOB = textwrap.dedent(
         print(f"[job] rank {rank} start={start}", flush=True)
         for i in range(start, total):
             state = step_fn(state, float(i))
+            # force the step (async dispatch would let this rank's
+            # PYTHON thread sail ahead of its own collectives — the
+            # planted death must land after the step it is planted on)
+            state.block_until_ready()
             # state is replicated (allreduce-synced): rank 0 persists
-            # it; the barrier keeps every rank behind the checkpoint so
-            # a death AFTER it can always resume from it
+            # it and WAITS for the commit (orbax saves are async — an
+            # uncommitted .tmp dir is invisible to latest_step); the
+            # FORCED barrier then keeps every rank behind the durable
+            # checkpoint, so a death AFTER it can always resume from it
             if rank == 0:
-                mgr.maybe_save(i + 1, {"state": state}, every=5)
+                if mgr.maybe_save(i + 1, {"state": state}, every=5):
+                    mgr.wait_until_finished()
             tok = m.barrier(comm=comm, token=tok)
+            tok.stamp.block_until_ready()
             if rank == kill_rank and (i + 1) == kill_step:
                 os._exit(17)  # hard mid-run death, no cleanup
 
