@@ -152,7 +152,7 @@ def autotune_attn_impl(batch=8, seq=2048, heads=16, head_dim=64, chain=4,
 def run(
     batch=8, seq=1024, layers=8, d_model=512, heads=8, kv_heads=8,
     d_ff=2048, vocab=32768, bf16=False, batches=8, mode="dense",
-    micro=None, remat=False, attn_impl="auto",
+    micro=None, remat=False, attn_impl="auto", ce_chunk=0,
 ):
     """Measure the train step of the chosen parallelism family
     (``mode``: "dense", "moe", or "pp"); returns the JSON-ready record
@@ -236,7 +236,7 @@ def run(
                 vocab=vocab, d_model=d_model, layers=layers,
                 heads=heads, kv_heads=kv_heads,
                 head_dim=d_model // heads, d_ff=d_ff,
-                attn_impl=attn_impl,
+                attn_impl=attn_impl, ce_chunk=ce_chunk,
             )
             params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
             step = tfm.make_global_train_step(
@@ -318,6 +318,10 @@ def run(
         "step_ms": round(best * 1e3, 2),
         "model_tflops_per_sec": round(model_tflops, 2),
         "model_tflops_incl_attn": round(incl_attn_tflops, 2),
+        # the knobs the sweeps vary — without them, rows differing only
+        # by remat policy / loss chunking emit indistinguishable records
+        "remat": list(remat) if isinstance(remat, (tuple, list)) else remat,
+        **({"ce_chunk": ce_chunk} if ce_chunk else {}),
     }
     # MFU against the chip's dense-bf16 peak, in both conventions: the
     # 6·N·tokens one (attention-score FLOPs excluded — conservative,
@@ -450,6 +454,13 @@ def main(argv=None):
         "the lighter list that still fits at seq 32k)",
     )
     p.add_argument(
+        "--ce-chunk", type=int, default=None,
+        help="compute the loss in token chunks of this size (the head "
+        "matmul + logsumexp per chunk under jax.checkpoint): the full "
+        "[B,S,V] logits tensor is never materialised — frees 2-4 GB at "
+        "the MFU configs, unlocking larger batches / heavier save-lists",
+    )
+    p.add_argument(
         "--attn-impl", choices=("auto", "flash", "xla", "autotune"),
         default="auto",
         help="single-device attention kernel; 'autotune' measures "
@@ -519,8 +530,13 @@ def main(argv=None):
                 batch=kw["batch"], seq=kw["seq"], heads=kw["heads"],
                 head_dim=kw["d_model"] // kw["heads"],
             )
+        ce_chunk = pick("ce_chunk", 0)
+        if ce_chunk and args.mode != "dense":
+            # only the dense TransformerConfig threads ce_chunk; a
+            # silent fallback to streaming CE would mislabel the run
+            p.error(f"--ce-chunk is dense-mode only (got --mode {args.mode})")
         rec = run(mode=args.mode, micro=args.micro, remat=remat,
-                  attn_impl=impl, **kw)
+                  attn_impl=impl, ce_chunk=ce_chunk, **kw)
     print(json.dumps(rec))
 
 

@@ -69,6 +69,15 @@ class TransformerConfig(NamedTuple):
     # and the ulysses full-sequence call — the multi-rank ring path has
     # its own blockwise schedule
     attn_impl: str = "auto"
+    # >0: compute the loss in token chunks of this size (must divide the
+    # local sequence length) — the head matmul and logsumexp run per
+    # chunk under jax.checkpoint, so the full [B, S, V] logits tensor is
+    # NEVER materialised (2.1 GB bf16 at the 940M/seq-2048/b16 MFU
+    # config, 4.3 GB at b32 — the allocation that OOMs the larger-batch
+    # and heavier-save-list configs).  The backward recomputes each
+    # chunk's logits: one extra head matmul of FLOPs in exchange for
+    # the logits' round-trips.  0 = off (single streaming-CE pass).
+    ce_chunk: int = 0
 
 
 class BlockParams(NamedTuple):
@@ -172,7 +181,7 @@ def _dense_mlp(h2, bp, cfg, comm_tp, comm_sp, token):
 
 def _forward_sharded(
     params, tokens, cfg, comm_tp, comm_sp, mesh_axes, mlp=None,
-    sequence="ring", remat=False,
+    sequence="ring", remat=False, return_hidden=False,
 ):
     """Per-device forward; call inside shard_map over (dp, tp, sp).
 
@@ -309,6 +318,9 @@ def _forward_sharded(
             )
     (x, aux), _ = lax.scan(layer, (x, aux0), params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)
+    if return_hidden:
+        # chunked-CE path: the caller applies the head per token chunk
+        return x, aux  # (B, S_local, d) final hidden, aux-loss sum
     return x @ params.head, aux  # (B, S_local, V) logits, aux-loss sum
 
 
@@ -325,6 +337,44 @@ def _ce(logits, targets):
     lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
     picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)
     return (lse - picked[..., 0].astype(jnp.float32)).mean()
+
+
+def _ce_chunked(x, head, targets, chunk, mesh_axes=()):
+    """Chunked cross-entropy: the head matmul + streaming CE run per
+    token chunk inside a ``lax.scan`` whose body is ``jax.checkpoint``ed
+    — the full ``[B, S, V]`` logits tensor is never materialised (only
+    one ``[B, chunk, V]`` block lives at a time), and the backward
+    recomputes each chunk's logits instead of loading stored ones.
+    Same math as :func:`_ce` (per-chunk f32 sums, one final divide), so
+    results agree to f32 reduction-order roundoff.
+
+    ``x``: [B, S_local, d] final hidden; ``head``: [d, V]."""
+    b, s, d = x.shape
+    if s % chunk:
+        raise ValueError(
+            f"ce_chunk={chunk} must divide the local sequence length "
+            f"{s} (global seq / sp size)"
+        )
+    n = s // chunk
+    xs = jnp.moveaxis(x.reshape(b, n, chunk, d), 1, 0)  # [n, B, c, d]
+    ts = jnp.moveaxis(targets.reshape(b, n, chunk), 1, 0)  # [n, B, c]
+
+    def blk(acc, inp):
+        xb, tb = inp
+        logits = xb @ head  # [B, c, V] — freed when the chunk ends
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1
+        )
+        picked = jnp.take_along_axis(logits, tb[..., None], axis=-1)
+        return acc + (lse - picked[..., 0].astype(jnp.float32)).sum(), None
+
+    from mpi4jax_tpu.ops._core import promote_vma
+
+    # the scan carry must match the body output's varying-axes type
+    # under shard_map (same promotion as the layer scan's carry)
+    acc0 = promote_vma(jnp.float32(0.0), mesh_axes)
+    total, _ = lax.scan(jax.checkpoint(blk), acc0, (xs, ts))
+    return total / (b * s)
 
 
 def make_global_train_step(
@@ -394,12 +444,20 @@ def make_global_train_step(
     def local_step(params, batch):
         tokens, targets = batch
 
+        ce_chunk = getattr(cfg, "ce_chunk", 0)
+
         def loss_fn(p):
-            logits, aux = _forward_sharded(
+            out, aux = _forward_sharded(
                 p, tokens, cfg, comm_tp, comm_sp, (dp_ax, tp_ax, sp_ax),
                 mlp=mlp, sequence=sequence, remat=remat,
+                return_hidden=bool(ce_chunk),
             )
-            return _ce(logits, targets) + aux
+            if ce_chunk:
+                return _ce_chunked(
+                    out, p.head, targets, ce_chunk,
+                    mesh_axes=(dp_ax, tp_ax, sp_ax),
+                ) + aux
+            return _ce(out, targets) + aux
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         grads = jax.tree.map(sync_grad, grads, specs)

@@ -182,6 +182,44 @@ def test_remat_save_lists_match_plain(mesh3d, comms, policy):
         )
 
 
+def test_ce_chunked_matches_streaming(mesh3d, comms):
+    # chunked CE (head matmul + logsumexp per token chunk under
+    # jax.checkpoint, full logits never materialised) is the same math
+    # as the streaming form: loss and updated params must agree to f32
+    # reduction-order roundoff.
+    comm_dp, comm_tp, comm_sp = comms
+    params = tfm.init_params(jax.random.PRNGKey(11), CFG)
+    tokens, targets = batch(seed=12)
+    plain = tfm.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, CFG, lr=1e-1
+    )
+    # global S=16 over sp=2 -> local seq 8; chunk 4 gives 2 chunks per
+    # rank, so the scan's cross-chunk accumulation actually runs
+    chunked = tfm.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, CFG._replace(ce_chunk=4),
+        lr=1e-1,
+    )
+    p1, l1 = plain(params, (tokens, targets))
+    p2, l2 = chunked(params, (tokens, targets))
+    np.testing.assert_allclose(
+        float(np.asarray(l1)[0]), float(np.asarray(l2)[0]), rtol=1e-6
+    )
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2), strict=True):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_ce_chunk_indivisible_raises(mesh3d, comms):
+    comm_dp, comm_tp, comm_sp = comms
+    step = tfm.make_global_train_step(
+        mesh3d, comm_dp, comm_tp, comm_sp, CFG._replace(ce_chunk=7)
+    )
+    tokens, targets = batch(seed=13)
+    with pytest.raises(ValueError, match="ce_chunk"):
+        step(tfm.init_params(jax.random.PRNGKey(0), CFG), (tokens, targets))
+
+
 def test_remat_unknown_tag_raises(mesh3d, comms):
     comm_dp, comm_tp, comm_sp = comms
     with pytest.raises(ValueError, match="unknown checkpoint tag"):

@@ -46,6 +46,20 @@ def test_manager_retention_and_latest(tmp_path):
             mgr.restore(1, like={"v": jnp.float32(0)})
 
 
+def test_manager_wait_until_finished_commits(tmp_path):
+    # the durability barrier: after wait_until_finished() the step dir
+    # is COMMITTED on disk (no .orbax-checkpoint-tmp left) — what a
+    # fault-tolerant loop relies on before telling peers the step is
+    # safe (tests/proc/test_failure_recovery.py exercises the
+    # composition; this pins the contract in isolation)
+    with ckpt.Manager(tmp_path / "d", max_to_keep=2) as mgr:
+        mgr.save(5, {"v": jnp.float32(5)})
+        mgr.wait_until_finished()
+        names = [p.name for p in (tmp_path / "d").iterdir()]
+        assert "5" in names, names
+        assert not any("tmp" in n for n in names), names
+
+
 def test_solver_resume_bit_identical(comm2d, tmp_path):
     """Stop/checkpoint/restore mid-run must reproduce the uninterrupted
     trajectory exactly (the resumability guarantee)."""
