@@ -741,6 +741,19 @@ def main():
             600, "decode bench (batch 16)",
         )
         extras["decode_tokens_per_sec_batch16"] = dec16["value"]
+        # bucketed-KV record (late r5): the un-bucketed loop reads the
+        # full 512-position budget every step — the measured ~2x
+        # large-batch gap to the bandwidth bound was that padding tax.
+        # kv_bucket=64 grows the cache view in static buckets instead
+        # (make_global_decode), ~1.7-2x across the batch sweep; the
+        # curve's new peak is batch 32 (docs/performance.md).
+        dec32b = _run_with_watchdog(
+            lambda: run_decode(
+                batch=32, bf16=True, batches=3, kv_bucket=64
+            ),
+            record, 600, "decode bench (batch 32, kv_bucket 64)",
+        )
+        extras["decode_tokens_per_sec_batch32_kv_bucket64"] = dec32b["value"]
     except Exception as exc:  # noqa: BLE001 — bench must still emit its line
         print(f"[bench] decode bench failed: {exc}", file=sys.stderr)
 

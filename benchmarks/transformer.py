@@ -339,6 +339,7 @@ def run(
 def run_decode(
     batch=8, prompt=16, max_len=512, layers=8, d_model=512, heads=8,
     kv_heads=8, d_ff=2048, vocab=32768, bf16=False, batches=5,
+    kv_bucket=None,
 ):
     """Greedy-decode throughput (generated tokens/s) through the
     TP-sharded KV-cache decoder (models/transformer.py
@@ -370,7 +371,9 @@ def run_decode(
         kv_heads=kv_heads, head_dim=d_model // heads, d_ff=d_ff,
     )
     params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
-    decode = tfm.make_global_decode(mesh, dp, tp, cfg, max_len)
+    decode = tfm.make_global_decode(
+        mesh, dp, tp, cfg, max_len, kv_bucket=kv_bucket
+    )
     b = batch * dp.size
     prompts = jax.random.randint(
         jax.random.PRNGKey(1), (b, prompt), 0, cfg.vocab
@@ -424,6 +427,7 @@ def run_decode(
         "tokens_per_sec_per_seq": round((max_len - prompt) / best, 1),
         "hbm_bytes_per_step": int(bytes_per_step),
         "params_bytes": int(params_bytes),
+        **({"kv_bucket": kv_bucket} if kv_bucket else {}),
     }
 
 
@@ -473,6 +477,13 @@ def main(argv=None):
     p.add_argument("--micro", type=int, default=None, help="pp microbatches")
     p.add_argument("--prompt", type=int, default=16, help="decode prompt length")
     p.add_argument("--max-len", type=int, default=512, help="decode budget")
+    p.add_argument(
+        "--kv-bucket", type=int, default=None,
+        help="decode: grow the KV cache view in static buckets of this "
+        "size — each step reads only ceil((pos+1)/N)*N positions "
+        "instead of the full budget (the padded-read tax is the "
+        "measured large-batch gap to the bandwidth bound)",
+    )
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N")
     args = p.parse_args(argv)
 
@@ -517,7 +528,10 @@ def main(argv=None):
     if args.mode == "decode":
         kw.pop("seq")
         kw["batches"] = min(args.batches, 5)
-        rec = run_decode(prompt=args.prompt, max_len=args.max_len, **kw)
+        rec = run_decode(
+            prompt=args.prompt, max_len=args.max_len,
+            kv_bucket=args.kv_bucket, **kw,
+        )
     else:
         impl = args.attn_impl
         if impl in ("auto", "autotune") and preset_attn:

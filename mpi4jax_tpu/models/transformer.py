@@ -601,7 +601,10 @@ def _prefill_sharded(params, prompt, cfg, comm_tp, hq_l, hk_l, max_len):
     return cache, jnp.argmax(logits, axis=-1).astype(prompt.dtype)
 
 
-def make_global_decode(mesh, comm_dp, comm_tp, cfg, max_len, *, prefill="batched"):
+def make_global_decode(
+    mesh, comm_dp, comm_tp, cfg, max_len, *, prefill="batched",
+    kv_bucket=None,
+):
     """Jitted greedy autoregressive decoder over a ``(dp, tp)`` mesh.
 
     ``decode(params, prompt)``: ``prompt`` is global ``[B, P]`` int32
@@ -615,6 +618,17 @@ def make_global_decode(mesh, comm_dp, comm_tp, cfg, max_len, *, prefill="batched
     ``[B, max_len]`` int32 — prompt followed by the generated
     continuation.  Matches :func:`reference_greedy_decode` exactly
     (same math; tp roundoff only).
+
+    ``kv_bucket=N`` runs the generate loop in KV-length buckets: the
+    scan carry is a cache VIEW whose static length grows by N per
+    segment (a python loop of scans inside the same jit), so each step
+    reads/attends only ``ceil((pos+1)/N)·N`` cache positions instead of
+    the full ``max_len`` budget.  Decode is KV-bandwidth-bound at large
+    batch, and with the un-bucketed loop every step pays the PADDED
+    budget read — at the bench's batch-32 point that padding tax is the
+    measured ~2× gap to the bandwidth bound (docs/performance.md).
+    Token-exact vs the un-bucketed loop (garbage positions beyond
+    ``pos`` are causally masked either way).
     """
     dp_ax, tp_ax = comm_dp.axes[0], comm_tp.axes[0]
     tp = comm_tp.size
@@ -624,6 +638,13 @@ def make_global_decode(mesh, comm_dp, comm_tp, cfg, max_len, *, prefill="batched
     if prefill not in ("batched", "stepwise"):
         raise ValueError(
             f"prefill must be 'batched' or 'stepwise', got {prefill!r}"
+        )
+    if kv_bucket is not None and (
+        int(kv_bucket) != kv_bucket or not 0 < int(kv_bucket) <= max_len
+    ):
+        raise ValueError(
+            f"kv_bucket must be an integer in (0, max_len={max_len}], "
+            f"got {kv_bucket!r}"
         )
 
     def local_decode(params, prompt):
@@ -676,9 +697,37 @@ def make_global_decode(mesh, comm_dp, comm_tp, cfg, max_len, *, prefill="batched
             out = lax.dynamic_update_slice(out, write[:, None], (0, pos + 1))
             return (cache, out), None
 
-        (cache, out), _ = lax.scan(
-            step, (cache, out), jnp.arange(start, max_len - 1)
-        )
+        if kv_bucket is None:
+            (cache, out), _ = lax.scan(
+                step, (cache, out), jnp.arange(start, max_len - 1)
+            )
+        else:
+            # bucketed KV growth: segment s scans positions
+            # [prev, min(end_s, max_len-1)) with a cache view of STATIC
+            # length end_s (pos < end_s throughout, so the causal mask
+            # and the pos-slot write both stay in range); between
+            # segments the view is zero-padded to the next bucket
+            # boundary.  The python loop is over static bounds — one
+            # executable, ~max_len/kv_bucket scan instances.
+            bk = int(kv_bucket)
+            ends = list(range((start // bk + 1) * bk, max_len, bk))
+            ends.append(max_len)
+            view = cache[:, :, :, : ends[0]]
+            prev = start
+            for i, end in enumerate(ends):
+                if i:
+                    view = jnp.pad(
+                        view,
+                        (
+                            (0, 0), (0, 0), (0, 0),
+                            (0, end - ends[i - 1]), (0, 0), (0, 0),
+                        ),
+                    )
+                hi = min(end, max_len - 1)
+                (view, out), _ = lax.scan(
+                    step, (view, out), jnp.arange(prev, hi)
+                )
+                prev = hi
         # every tp rank computed the identical sequence, but collective
         # outputs are varying-typed; a masked psum re-establishes the
         # replicated typing the out_specs declare

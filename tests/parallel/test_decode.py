@@ -50,6 +50,37 @@ def test_decode_matches_oracle(mesh2d, comms, prefill):
 
 
 @pytest.mark.parametrize("prefill", ["batched", "stepwise"])
+@pytest.mark.parametrize("bucket", [4, 5, 14])
+def test_decode_kv_bucket_matches_oracle(mesh2d, comms, prefill, bucket):
+    # bucketed KV growth (scan carry = a cache view growing by static
+    # buckets) is token-exact vs the oracle — including a bucket that
+    # does not divide max_len (ragged last segment) and bucket ==
+    # max_len (degenerates to the un-bucketed loop)
+    comm_dp, comm_tp = comms
+    params = tfm.init_params(jax.random.PRNGKey(1), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (B, P), 0, CFG.vocab)
+    decode = tfm.make_global_decode(
+        mesh2d, comm_dp, comm_tp, CFG, MAX, prefill=prefill,
+        kv_bucket=bucket,
+    )
+    got = decode(params, prompt)
+    want = tfm.reference_greedy_decode(params, prompt, CFG, MAX)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_decode_kv_bucket_validation(mesh2d, comms):
+    comm_dp, comm_tp = comms
+    with pytest.raises(ValueError, match="kv_bucket"):
+        tfm.make_global_decode(
+            mesh2d, comm_dp, comm_tp, CFG, MAX, kv_bucket=0
+        )
+    with pytest.raises(ValueError, match="kv_bucket"):
+        tfm.make_global_decode(
+            mesh2d, comm_dp, comm_tp, CFG, MAX, kv_bucket=MAX + 1
+        )
+
+
+@pytest.mark.parametrize("prefill", ["batched", "stepwise"])
 def test_decode_prompt_only_roundtrip(mesh2d, comms, prefill):
     # max_len == prompt length: nothing generated, prompt returned
     comm_dp, comm_tp = comms
