@@ -525,6 +525,10 @@ def main(argv=None):
         d_ff=pick("d_ff", 2048), vocab=args.vocab, bf16=args.bf16,
         batches=args.batches,
     )
+    if args.kv_bucket is not None and args.mode != "decode":
+        # same convention as the --ce-chunk guard: a silently ignored
+        # lever mislabels the benchmark record
+        p.error(f"--kv-bucket is decode-mode only (got --mode {args.mode})")
     if args.mode == "decode":
         kw.pop("seq")
         kw["batches"] = min(args.batches, 5)

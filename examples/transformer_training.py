@@ -69,6 +69,12 @@ def main(argv=None):
         "cache)",
     )
     p.add_argument(
+        "--kv-bucket", type=int, default=None,
+        help="decode with bucketed KV growth: each step reads only the "
+        "cache written so far, rounded up to this bucket — the "
+        "large-batch decode lever (docs/performance.md)",
+    )
+    p.add_argument(
         "--force-cpu", action="store_true",
         help="run on 8 virtual CPU devices regardless of platform",
     )
@@ -236,6 +242,10 @@ def main(argv=None):
             f"z {rep['z_loss']:.3f}  dropped {rep['dropped_fraction']:.3f}"
         )
 
+    if args.kv_bucket is not None and not (
+        args.generate and args.mode == "dense"
+    ):
+        print("--kv-bucket only applies to --generate in dense mode; ignored")
     if args.generate and args.mode != "dense":
         print("--generate is only supported with --mode dense; skipping")
     elif args.generate:
@@ -243,7 +253,9 @@ def main(argv=None):
         # first training sequence -> greedy continuation
         prefix = 4
         max_len = prefix + args.generate
-        decode = tfm.make_global_decode(mesh, dp, tp, cfg, max_len)
+        decode = tfm.make_global_decode(
+            mesh, dp, tp, cfg, max_len, kv_bucket=args.kv_bucket
+        )
         prompt = jnp.broadcast_to(
             tokens[:1, :prefix], (dp.size, prefix)
         )

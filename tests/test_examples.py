@@ -72,6 +72,16 @@ def test_transformer_training_generate():
     )
 
 
+def test_transformer_training_generate_kv_bucket():
+    _run_example(
+        "transformer_training",
+        [
+            "--mode", "dense", "--steps", "6", "--generate", "4",
+            "--kv-bucket", "4",
+        ],
+    )
+
+
 def test_transformer_training_resume_bit_identical(tmp_path):
     # interrupted-and-resumed training must land on the same bits as an
     # uninterrupted run (the solver's resume contract, applied to the
