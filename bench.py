@@ -744,16 +744,17 @@ def main():
         # bucketed-KV record (late r5): the un-bucketed loop reads the
         # full 512-position budget every step — the measured ~2x
         # large-batch gap to the bandwidth bound was that padding tax.
-        # kv_bucket=64 grows the cache view in static buckets instead
-        # (make_global_decode), ~1.7-2x across the batch sweep; the
-        # curve's new peak is batch 32 (docs/performance.md).
-        dec32b = _run_with_watchdog(
+        # kv_bucket grows the cache view in static buckets instead
+        # (make_global_decode); the bucket sweep put the optimum at 16
+        # and the batch sweep's new peak at batch 16: 12158 tokens/s vs
+        # the 6657 un-bucketed peak (docs/performance.md).
+        dec16b = _run_with_watchdog(
             lambda: run_decode(
-                batch=32, bf16=True, batches=3, kv_bucket=64
+                batch=16, bf16=True, batches=3, kv_bucket=16
             ),
-            record, 600, "decode bench (batch 32, kv_bucket 64)",
+            record, 600, "decode bench (batch 16, kv_bucket 16)",
         )
-        extras["decode_tokens_per_sec_batch32_kv_bucket64"] = dec32b["value"]
+        extras["decode_tokens_per_sec_batch16_kv_bucket16"] = dec16b["value"]
     except Exception as exc:  # noqa: BLE001 — bench must still emit its line
         print(f"[bench] decode bench failed: {exc}", file=sys.stderr)
 
