@@ -339,7 +339,7 @@ def run(
 def run_decode(
     batch=8, prompt=16, max_len=512, layers=8, d_model=512, heads=8,
     kv_heads=8, d_ff=2048, vocab=32768, bf16=False, batches=5,
-    kv_bucket=None,
+    kv_bucket=None, prefill_impl="xla",
 ):
     """Greedy-decode throughput (generated tokens/s) through the
     TP-sharded KV-cache decoder (models/transformer.py
@@ -372,7 +372,8 @@ def run_decode(
     )
     params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=dtype)
     decode = tfm.make_global_decode(
-        mesh, dp, tp, cfg, max_len, kv_bucket=kv_bucket
+        mesh, dp, tp, cfg, max_len, kv_bucket=kv_bucket,
+        prefill_impl=prefill_impl,
     )
     b = batch * dp.size
     prompts = jax.random.randint(
@@ -428,6 +429,7 @@ def run_decode(
         "hbm_bytes_per_step": int(bytes_per_step),
         "params_bytes": int(params_bytes),
         **({"kv_bucket": kv_bucket} if kv_bucket else {}),
+        **({"prefill_impl": prefill_impl} if prefill_impl != "xla" else {}),
     }
 
 
@@ -484,6 +486,12 @@ def main(argv=None):
         "instead of the full budget (the padded-read tax is the "
         "measured large-batch gap to the bandwidth bound)",
     )
+    p.add_argument(
+        "--prefill-impl", choices=("xla", "flash"), default=None,
+        help="decode: batched-prefill attention kernel — flash for "
+        "long prompts (the dense [P, P] scores dominate past ~2k); "
+        "default xla",
+    )
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N")
     args = p.parse_args(argv)
 
@@ -525,16 +533,20 @@ def main(argv=None):
         d_ff=pick("d_ff", 2048), vocab=args.vocab, bf16=args.bf16,
         batches=args.batches,
     )
-    if args.kv_bucket is not None and args.mode != "decode":
-        # same convention as the --ce-chunk guard: a silently ignored
-        # lever mislabels the benchmark record
-        p.error(f"--kv-bucket is decode-mode only (got --mode {args.mode})")
+    for flag, val in (("kv-bucket", args.kv_bucket),
+                      ("prefill-impl", args.prefill_impl)):
+        if val is not None and args.mode != "decode":
+            # same convention as the --ce-chunk guard: a silently
+            # ignored lever mislabels the benchmark record
+            p.error(f"--{flag} is decode-mode only (got --mode {args.mode})")
     if args.mode == "decode":
         kw.pop("seq")
         kw["batches"] = min(args.batches, 5)
         rec = run_decode(
             prompt=args.prompt, max_len=args.max_len,
-            kv_bucket=args.kv_bucket, **kw,
+            kv_bucket=args.kv_bucket,
+            prefill_impl=args.prefill_impl or "xla",
+            **kw,
         )
     else:
         impl = args.attn_impl

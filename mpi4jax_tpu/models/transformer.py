@@ -556,7 +556,9 @@ def _decode_step_sharded(params, cache, last_tok, pos, cfg, comm_tp, hq_l, hk_l)
     return cache, jnp.argmax(logits, axis=-1).astype(last_tok.dtype), logits
 
 
-def _prefill_sharded(params, prompt, cfg, comm_tp, hq_l, hk_l, max_len):
+def _prefill_sharded(
+    params, prompt, cfg, comm_tp, hq_l, hk_l, max_len, impl="xla"
+):
     """Batched prefill on the local tp shard: one causal forward pass
     over the whole prompt, writing every prompt position's K/V into the
     (max_len-budget) cache and returning the greedy next token after
@@ -581,7 +583,7 @@ def _prefill_sharded(params, prompt, cfg, comm_tp, hq_l, hk_l, max_len):
         q = (h @ bp.wq).reshape(b, p_len, hq_l, dh)
         k = (h @ bp.wk).reshape(b, p_len, hk_l, dh)
         v = (h @ bp.wv).reshape(b, p_len, hk_l, dh)
-        attn = local_attention(q, k, v, causal=True, impl="xla")
+        attn = local_attention(q, k, v, causal=True, impl=impl)
         a_part = attn.reshape(b, p_len, hq_l * dh) @ bp.wo
         a, token = allreduce(a_part, reductions.SUM, comm=comm_tp, token=token)
         x = x + a
@@ -603,7 +605,7 @@ def _prefill_sharded(params, prompt, cfg, comm_tp, hq_l, hk_l, max_len):
 
 def make_global_decode(
     mesh, comm_dp, comm_tp, cfg, max_len, *, prefill="batched",
-    kv_bucket=None,
+    kv_bucket=None, prefill_impl="xla",
 ):
     """Jitted greedy autoregressive decoder over a ``(dp, tp)`` mesh.
 
@@ -618,6 +620,15 @@ def make_global_decode(
     ``[B, max_len]`` int32 — prompt followed by the generated
     continuation.  Matches :func:`reference_greedy_decode` exactly
     (same math; tp roundoff only).
+
+    ``prefill_impl`` picks the batched prefill's attention kernel:
+    ``"xla"`` (default — dense scores; the right choice for short
+    prompts, where the flash kernel's block pipeline costs more than it
+    saves) or ``"flash"`` (the Pallas blockwise kernel, ops/flash.py)
+    for LONG prompts, where the dense [P, P] score tensor dominates the
+    prefill — the long-context inference analog of the training-side
+    crossover (docs/performance.md "Flash vs dense").  Token-identical
+    either way (same math; the equivalence is pinned on-chip).
 
     ``kv_bucket=N`` runs the generate loop in KV-length buckets: the
     scan carry is a cache VIEW whose static length grows by N per
@@ -638,6 +649,10 @@ def make_global_decode(
     if prefill not in ("batched", "stepwise"):
         raise ValueError(
             f"prefill must be 'batched' or 'stepwise', got {prefill!r}"
+        )
+    if prefill_impl not in ("xla", "flash"):
+        raise ValueError(
+            f"prefill_impl must be 'xla' or 'flash', got {prefill_impl!r}"
         )
     if kv_bucket is not None and (
         int(kv_bucket) != kv_bucket or not 0 < int(kv_bucket) <= max_len
@@ -664,7 +679,8 @@ def make_global_decode(
 
         if prefill == "batched" and p_len > 1:
             cache, nxt = _prefill_sharded(
-                params, prompt, cfg, comm_tp, hq_l, hk_l, max_len
+                params, prompt, cfg, comm_tp, hq_l, hk_l, max_len,
+                impl=prefill_impl,
             )
             if p_len < max_len:
                 out = lax.dynamic_update_slice(
