@@ -45,6 +45,7 @@ __all__ = [
     "make_global_decode",
     "reference_loss",
     "reference_greedy_decode",
+    "reference_sample_decode",
     "CHECKPOINT_NAMES",
 ]
 
@@ -514,12 +515,62 @@ def reference_loss(params, tokens, targets, cfg):
 # --------------------------- inference -----------------------------
 
 
+def _choose_token(logits, pos, key, row_ids, sampler, temperature, top_k):
+    """Next-token choice from ``[B, V]`` logits — THE single copy shared
+    by the sharded decoder and the unsharded oracle (like
+    :func:`_attn_residual` for the layer math).
+
+    Sampling is shard-invariant by construction: each row's draw uses
+    ``fold_in(fold_in(key, pos), global_row_id)``, so the randomness
+    for a given (sequence, position) is identical however the batch is
+    sharded over dp — the sharded decoder matches the unsharded oracle
+    bitwise given the same key."""
+    if sampler == "greedy":
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        # keep the k highest logits per row; ties at the threshold stay
+        # eligible (same rule in the oracle, so they cancel)
+        thresh = jax.lax.top_k(logits, int(top_k))[0][..., -1:]
+        logits = jnp.where(logits >= thresh, logits, -jnp.inf)
+    step_key = jax.random.fold_in(key, pos)
+    row_keys = jax.vmap(lambda r: jax.random.fold_in(step_key, r))(row_ids)
+    return jax.vmap(jax.random.categorical)(row_keys, logits)
+
+
+def _check_sampler(sampler, temperature, top_k, vocab):
+    if sampler not in ("greedy", "categorical"):
+        raise ValueError(
+            f"sampler must be 'greedy' or 'categorical', got {sampler!r}"
+        )
+    if sampler == "greedy":
+        # greedy ignores both knobs — setting one is a forgotten
+        # sampler="categorical", not a request for deterministic output
+        if temperature != 1.0 or top_k is not None:
+            raise ValueError(
+                "temperature/top_k only apply to sampler='categorical' "
+                f"(got sampler='greedy' with temperature={temperature}, "
+                f"top_k={top_k})"
+            )
+        return
+    if not temperature > 0:
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    if top_k is not None and (
+        int(top_k) != top_k or not 0 < int(top_k) <= vocab
+    ):
+        raise ValueError(
+            f"top_k must be an integer in (0, vocab={vocab}], got {top_k!r}"
+        )
+
+
 def _decode_step_sharded(params, cache, last_tok, pos, cfg, comm_tp, hq_l, hk_l):
-    """One greedy decode step on the local tp shard.
+    """One decode step on the local tp shard: embed the last token,
+    run the cached attention + MLP, and return the position's logits —
+    the caller picks the next token (greedy or sampled).
 
     ``cache``: (layers, 2, B, S_max, Hkv_local, dh) — K/V per layer.
     ``last_tok``: (B,) int32; ``pos``: scalar int32 write position.
-    Returns (cache, next_tok, logits).
+    Returns (cache, logits).
     """
     dh = cfg.head_dim
     b = last_tok.shape[0]
@@ -553,7 +604,7 @@ def _decode_step_sharded(params, cache, last_tok, pos, cfg, comm_tp, hq_l, hk_l)
     (x, _token), cache = lax.scan(layer, (x, token), (params.blocks, cache))
     x = _rmsnorm(x, params.ln_f, cfg.eps)
     logits = (x @ params.head)[:, 0, :]  # (B, V)
-    return cache, jnp.argmax(logits, axis=-1).astype(last_tok.dtype), logits
+    return cache, logits
 
 
 def _prefill_sharded(
@@ -568,7 +619,9 @@ def _prefill_sharded(
     position — the attention is causal and the projections are
     per-position — but the matmuls are [B, P, ·] instead of P
     sequential [B, 1, ·] calls, so the prompt costs one MXU-shaped
-    forward instead of P dispatches.
+    forward instead of P dispatches.  Returns ``(cache, logits)`` with
+    the LAST prompt position's ``[B, V]`` logits — the caller picks
+    the next token (greedy or sampled).
     """
     dh = cfg.head_dim
     b, p_len = prompt.shape
@@ -600,12 +653,13 @@ def _prefill_sharded(
     (x, _token), cache = lax.scan(layer, (x, token), params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)
     logits = (x[:, -1, :] @ params.head)  # (B, V): last prompt position
-    return cache, jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+    return cache, logits
 
 
 def make_global_decode(
     mesh, comm_dp, comm_tp, cfg, max_len, *, prefill="batched",
-    kv_bucket=None, prefill_impl="xla",
+    kv_bucket=None, prefill_impl="xla", sampler="greedy",
+    temperature=1.0, top_k=None,
 ):
     """Jitted greedy autoregressive decoder over a ``(dp, tp)`` mesh.
 
@@ -620,6 +674,14 @@ def make_global_decode(
     ``[B, max_len]`` int32 — prompt followed by the generated
     continuation.  Matches :func:`reference_greedy_decode` exactly
     (same math; tp roundoff only).
+
+    ``sampler="categorical"`` draws each continuation token from the
+    (temperature-scaled, optionally top-k-truncated) softmax instead of
+    the argmax; the returned callable then takes a third argument,
+    ``decode(params, prompt, key)`` (a ``jax.random.PRNGKey``).  The
+    draw for a given (row, position) folds the GLOBAL row id and the
+    position into the key, so the sharded sampler matches
+    :func:`reference_sample_decode` bitwise under any dp sharding.
 
     ``prefill_impl`` picks the batched prefill's attention kernel:
     ``"xla"`` (default — dense scores; the right choice for short
@@ -654,6 +716,7 @@ def make_global_decode(
         raise ValueError(
             f"prefill_impl must be 'xla' or 'flash', got {prefill_impl!r}"
         )
+    _check_sampler(sampler, temperature, top_k, cfg.vocab)
     if kv_bucket is not None and (
         int(kv_bucket) != kv_bucket or not 0 < int(kv_bucket) <= max_len
     ):
@@ -662,7 +725,7 @@ def make_global_decode(
             f"got {kv_bucket!r}"
         )
 
-    def local_decode(params, prompt):
+    def local_decode(params, prompt, key):
         from mpi4jax_tpu.ops._core import promote_vma
 
         b, p_len = prompt.shape
@@ -672,17 +735,29 @@ def make_global_decode(
                 f"(the decoder's static sequence budget)"
             )
         prompt = promote_vma(prompt, (dp_ax, tp_ax))
+        key = promote_vma(key, (dp_ax, tp_ax))
+        # global row ids: the sampling key folds these in, so draws are
+        # identical under any dp sharding (see _choose_token)
+        row_ids = lax.axis_index(dp_ax) * b + jnp.arange(b)
         out = promote_vma(
             jnp.zeros((b, max_len), prompt.dtype), (dp_ax, tp_ax)
         )
         out = lax.dynamic_update_slice(out, prompt, (0, 0))
 
+        def choose(logits, pos):
+            return _choose_token(
+                logits, pos, key, row_ids, sampler, temperature, top_k
+            ).astype(prompt.dtype)
+
         if prefill == "batched" and p_len > 1:
-            cache, nxt = _prefill_sharded(
+            cache, pre_logits = _prefill_sharded(
                 params, prompt, cfg, comm_tp, hq_l, hk_l, max_len,
                 impl=prefill_impl,
             )
             if p_len < max_len:
+                # the token at position p_len is chosen from position
+                # p_len - 1's logits
+                nxt = choose(pre_logits, p_len - 1)
                 out = lax.dynamic_update_slice(
                     out, nxt[:, None], (0, p_len)
                 )
@@ -703,11 +778,12 @@ def make_global_decode(
             last = lax.dynamic_index_in_dim(
                 out, pos, axis=1, keepdims=False
             )
-            cache, nxt, _logits = _decode_step_sharded(
+            cache, logits = _decode_step_sharded(
                 params, cache, last, pos, cfg, comm_tp, hq_l, hk_l
             )
             # inside the prompt, keep the given token; past it, append
-            # the greedy choice
+            # the chosen (greedy or sampled) token
+            nxt = choose(logits, pos)
             cur = lax.dynamic_index_in_dim(out, pos + 1, axis=1, keepdims=False)
             write = jnp.where(pos + 1 < p_len, cur, nxt)
             out = lax.dynamic_update_slice(out, write[:, None], (0, pos + 1))
@@ -752,14 +828,28 @@ def make_global_decode(
             jnp.where(tp_rank == 0, out, jnp.zeros((), out.dtype)), tp_ax
         )
 
-    return jax.jit(
+    decode = jax.jit(
         jax.shard_map(
             local_decode,
             mesh=mesh,
-            in_specs=(specs, jax.P(dp_ax, None)),
+            in_specs=(specs, jax.P(dp_ax, None), jax.P(None)),
             out_specs=jax.P(dp_ax, None),
         )
     )
+    if sampler == "greedy":
+        # greedy ignores the key: keep the two-argument call surface
+        _zero_key = jax.random.PRNGKey(0)
+        return lambda params, prompt: decode(params, prompt, _zero_key)
+
+    def _raw_key(key):
+        # accept both key styles: new-style typed keys (jax.random.key,
+        # rank 0 — would trip the rank-1 P(None) spec) unwrap to their
+        # uint32 data; legacy PRNGKey arrays pass through
+        if jnp.issubdtype(jnp.asarray(key).dtype, jax.dtypes.prng_key):
+            return jax.random.key_data(key)
+        return key
+
+    return lambda params, prompt, key: decode(params, prompt, _raw_key(key))
 
 
 def reference_greedy_decode(params, prompt, cfg, max_len):
@@ -785,6 +875,47 @@ def reference_greedy_decode(params, prompt, cfg, max_len):
             logits, pos, axis=1, keepdims=False
         )
         nxt = jnp.argmax(step_logits, axis=-1).astype(out.dtype)
+        cur = lax.dynamic_index_in_dim(out, pos + 1, axis=1, keepdims=False)
+        write = jnp.where(pos + 1 < p_len, cur, nxt)
+        return lax.dynamic_update_slice(out, write[:, None], (0, pos + 1))
+
+    return lax.fori_loop(0, max_len - 1, body, out)
+
+
+def reference_sample_decode(
+    params, prompt, cfg, max_len, key, *, temperature=1.0, top_k=None
+):
+    """Unsharded sampling oracle: full-sequence recompute per position,
+    next tokens drawn through the SAME :func:`_choose_token` (per-row
+    fold_in of position and global row id) as the sharded decoder — so
+    ``make_global_decode(..., sampler="categorical")`` must match it
+    bitwise given the same key, under any dp/tp sharding."""
+    _check_sampler("categorical", temperature, top_k, cfg.vocab)
+    b, p_len = prompt.shape
+    if p_len > max_len:
+        raise ValueError(
+            f"prompt length {p_len} exceeds max_len={max_len}"
+        )
+    row_ids = jnp.arange(b)
+    out = jnp.zeros((b, max_len), prompt.dtype)
+    out = lax.dynamic_update_slice(out, prompt, (0, 0))
+
+    def body(pos, out):
+        x = params.embed[out]
+
+        def layer(x, bp):
+            return dense_layer(x, bp, cfg), None
+
+        x, _ = lax.scan(layer, x, params.blocks)
+        x = _rmsnorm(x, params.ln_f, cfg.eps)
+        logits = x @ params.head  # (B, max_len, V)
+        step_logits = lax.dynamic_index_in_dim(
+            logits, pos, axis=1, keepdims=False
+        )
+        nxt = _choose_token(
+            step_logits, pos, key, row_ids, "categorical", temperature,
+            top_k,
+        ).astype(out.dtype)
         cur = lax.dynamic_index_in_dim(out, pos + 1, axis=1, keepdims=False)
         write = jnp.where(pos + 1 < p_len, cur, nxt)
         return lax.dynamic_update_slice(out, write[:, None], (0, pos + 1))
