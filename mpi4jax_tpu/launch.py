@@ -5,8 +5,16 @@
 Spawns N worker processes, wires the DCN-bridge bootstrap environment
 (T4J_RANK / T4J_SIZE / T4J_COORD), initialises the native runtime in
 each child before handing control to the user program, and propagates
-the first nonzero exit (terminating the rest) — the fail-fast job
-semantics of ``mpirun`` + the reference's MPI_Abort behaviour.
+the first failure (terminating the rest) — the fail-fast job semantics
+of ``mpirun`` + the reference's MPI_Abort behaviour.  The summary
+names WHICH rank failed first and how (nonzero exit vs. signal kill),
+and a dying child broadcasts an abort to its peers first so survivors
+raise a contextual error instead of hanging until the kill
+(docs/failure-semantics.md).
+
+``--timeout SECONDS`` adds a whole-job deadline: past it the job is
+torn down and the launcher exits 124, naming the ranks that were still
+running (the likely hang participants).
 
 Children default to the CPU platform (one XLA CPU per process, the
 reference's process model); override with ``--platform``.
@@ -18,6 +26,7 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port():
@@ -40,7 +49,45 @@ def child_main(argv):
     sys.argv = [prog] + prog_args
     import runpy
 
-    runpy.run_path(prog, run_name="__main__")
+    try:
+        runpy.run_path(prog, run_name="__main__")
+    except BaseException as e:
+        # the MPI_Abort analog: tell peers this rank is going down so
+        # their blocked collectives raise within their deadline instead
+        # of hanging until the launcher's terminate
+        code = e.code if isinstance(e, SystemExit) else None
+        if not (isinstance(e, SystemExit) and code in (0, None)):
+            why = (
+                f"rank {os.environ.get('T4J_RANK', '?')} died: "
+                f"{type(e).__name__}: {e}"
+            )
+            try:
+                runtime.notify_abort(why)
+            except Exception:
+                pass
+        raise
+
+
+def _describe_exit(rc):
+    """Human-readable child status: signal kills are reported
+    distinctly from nonzero exits (satellite: fail-fast summary)."""
+    if rc is not None and rc < 0:
+        try:
+            name = signal.Signals(-rc).name
+        except ValueError:
+            name = f"signal {-rc}"
+        return f"killed by {name} (signal {-rc})"
+    return f"exited with code {rc}"
+
+
+def _job_exit_code(rc):
+    """Normalise a child status into a valid launcher exit code:
+    signal-killed children map to the shell convention 128+signum."""
+    if rc is None:
+        return 1
+    if rc < 0:
+        return 128 - rc  # rc = -signum
+    return rc
 
 
 def main(argv=None):
@@ -59,6 +106,14 @@ def main(argv=None):
         help="prepend the mpi4py/mpi4jax import shims to the workers' "
         "PYTHONPATH (run unmodified reference programs)",
     )
+    parser.add_argument(
+        "--timeout",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="whole-job deadline: past it every worker is torn down and "
+        "the launcher exits 124, naming the ranks still running",
+    )
     parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("prog", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -69,6 +124,8 @@ def main(argv=None):
 
     if not args.nprocs or not args.prog:
         parser.error("usage: python -m mpi4jax_tpu.launch -np N prog.py ...")
+    if args.timeout is not None and args.timeout <= 0:
+        parser.error("--timeout must be > 0 seconds (omit it for no deadline)")
 
     n = args.nprocs
     coord = f"127.0.0.1:{_free_port()}"
@@ -103,6 +160,12 @@ def main(argv=None):
         procs.append(subprocess.Popen(cmd, env=env))
 
     exit_code = 0
+    start = time.monotonic()
+    terminated_at = None  # first terminate time, for SIGKILL escalation
+
+    def _say(msg):
+        print(f"mpi4jax_tpu.launch: {msg}", file=sys.stderr, flush=True)
+
     try:
         remaining = set(range(n))
         while remaining:
@@ -112,13 +175,38 @@ def main(argv=None):
                     continue
                 remaining.discard(i)
                 if rc != 0 and exit_code == 0:
-                    exit_code = rc
-                    # fail fast: take the rest of the job down
+                    exit_code = _job_exit_code(rc)
+                    # fail fast: take the rest of the job down, and say
+                    # WHO failed first and HOW — the post-mortem anchor
+                    _say(
+                        f"rank {i} {_describe_exit(rc)} — first failure; "
+                        f"terminating {len(remaining)} remaining rank(s)"
+                    )
+                    terminated_at = time.monotonic()
                     for j in remaining:
                         procs[j].terminate()
             if remaining:
-                import time
-
+                now = time.monotonic()
+                if (
+                    args.timeout is not None
+                    and exit_code == 0
+                    and now - start > args.timeout
+                ):
+                    exit_code = 124
+                    still = ", ".join(str(i) for i in sorted(remaining))
+                    _say(
+                        f"job deadline of {args.timeout:g}s exceeded; "
+                        f"rank(s) {still} still running — terminating "
+                        "the job"
+                    )
+                    terminated_at = now
+                    for j in remaining:
+                        procs[j].terminate()
+                if terminated_at is not None and now - terminated_at > 10:
+                    # a worker wedged in native code can ignore SIGTERM
+                    # forever; escalate so the launcher itself cannot hang
+                    for j in remaining:
+                        procs[j].kill()
                 time.sleep(0.05)
     except KeyboardInterrupt:
         for p in procs:

@@ -26,22 +26,42 @@ def lib_path():
 _HEADERS = ["dcn.h", "shm.h"]
 
 
+def _sanitize_flags():
+    """Opt-in sanitizer build: T4J_SANITIZE=address compiles the bridge
+    under ASan so the fault-injection suite can double as a memory-
+    safety harness locally (CI tooling satellite).  Other values are
+    passed through to -fsanitize verbatim (e.g. undefined,thread)."""
+    import os
+
+    san = os.environ.get("T4J_SANITIZE", "").strip().lower()
+    if not san:
+        return []
+    if san in ("address", "asan", "1"):
+        san = "address"
+    return [f"-fsanitize={san}", "-fno-omit-frame-pointer", "-g"]
+
+
 def _machine_key():
-    """CPU-feature fingerprint: the cached .so contains -march=native
-    codegen, so a package dir shared across heterogeneous hosts (NFS
-    conda env) must rebuild per machine instead of SIGILL-ing."""
+    """CPU-feature + build-mode fingerprint: the cached .so contains
+    -march=native codegen, so a package dir shared across heterogeneous
+    hosts (NFS conda env) must rebuild per machine instead of
+    SIGILL-ing; toggling T4J_SANITIZE must rebuild too, or a cached
+    plain .so would silently satisfy a sanitizer run."""
     import hashlib
 
+    san = "|".join(_sanitize_flags())
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
                 if line.startswith("flags"):
-                    return hashlib.sha256(line.encode()).hexdigest()[:16]
+                    key = hashlib.sha256(line.encode()).hexdigest()[:16]
+                    return f"{key}|{san}" if san else key
     except OSError:
         pass
     import platform
 
-    return platform.machine()
+    key = platform.machine()
+    return f"{key}|{san}" if san else key
 
 
 def _needs_build():
@@ -76,6 +96,7 @@ def build(verbose=False):
             cxx,
             "-O3",
             *extra,
+            *_sanitize_flags(),
             "-fPIC",
             "-shared",
             "-std=c++17",

@@ -21,8 +21,20 @@ __all__ = [
     "comm_handle",
     "set_logging",
     "finalize",
+    "check_health",
+    "notify_abort",
+    "last_error",
+    "set_timeouts",
+    "BridgeError",
     "HANDLER_NAMES",
 ]
+
+
+class BridgeError(RuntimeError):
+    """A DCN bridge call failed (transport error, deadline expiry, or a
+    peer's abort broadcast).  The message carries rank/peer/op context
+    from the native layer.  The bridge is faulted afterwards: every
+    further proc-tier op raises until the job restarts."""
 
 HANDLER_NAMES = [
     "t4j_allreduce",
@@ -63,7 +75,14 @@ def _load():
     lib.t4j_comm_size.restype = ctypes.c_int
     lib.t4j_comm_size.argtypes = [ctypes.c_int32]
     lib.t4j_set_logging.argtypes = [ctypes.c_int]
-    # data plane for the host-callback tier (TPU staging path)
+    # robustness control surface (docs/failure-semantics.md)
+    lib.t4j_last_error.restype = ctypes.c_char_p
+    lib.t4j_health.restype = ctypes.c_int
+    lib.t4j_fault_msg.restype = ctypes.c_char_p
+    lib.t4j_set_timeouts.argtypes = [ctypes.c_double, ctypes.c_double]
+    lib.t4j_abort_notify.argtypes = [ctypes.c_char_p]
+    # data plane for the host-callback tier (TPU staging path); every
+    # call returns a status: 0 ok, nonzero = failed with t4j_last_error
     i32, u64, vp = ctypes.c_int32, ctypes.c_uint64, ctypes.c_void_p
     i32p = ctypes.POINTER(ctypes.c_int32)
     lib.t4j_c_send.argtypes = [i32, vp, u64, i32, i32]
@@ -79,8 +98,71 @@ def _load():
     lib.t4j_c_gather.argtypes = [i32, vp, vp, u64, i32]
     lib.t4j_c_scatter.argtypes = [i32, vp, vp, u64, i32]
     lib.t4j_c_alltoall.argtypes = [i32, vp, vp, u64]
+    for name in (
+        "t4j_c_send", "t4j_c_recv", "t4j_c_sendrecv", "t4j_c_barrier",
+        "t4j_c_bcast", "t4j_c_allreduce", "t4j_c_reduce", "t4j_c_scan",
+        "t4j_c_allgather", "t4j_c_gather", "t4j_c_scatter",
+        "t4j_c_alltoall",
+    ):
+        getattr(lib, name).restype = ctypes.c_int32
     _state["lib"] = lib
     return lib
+
+
+def last_error():
+    """Contextual message of the last failed native call on this
+    thread (empty string when nothing failed)."""
+    lib = _state["lib"]
+    if lib is None:
+        return ""
+    raw = lib.t4j_last_error()
+    return raw.decode("utf-8", "replace") if raw else ""
+
+
+def _check(status):
+    """Map a native status code to BridgeError with the bridge's own
+    rank/peer/op context."""
+    if status:
+        raise BridgeError(
+            last_error() or "native bridge call failed (no detail)"
+        )
+
+
+def check_health():
+    """Raise BridgeError if the bridge posted a fault (a peer died, an
+    op timed out, or an abort broadcast arrived).  Called from the op
+    tier before dispatch so post-fault calls fail fast instead of
+    feeding a dead transport."""
+    lib = _state["lib"]
+    if lib is None or not lib.t4j_initialized():
+        return
+    if lib.t4j_health():
+        raw = lib.t4j_fault_msg()
+        msg = raw.decode("utf-8", "replace") if raw else "bridge faulted"
+        raise BridgeError(msg)
+
+
+def notify_abort(why):
+    """Best-effort MPI_Abort analog: tell every peer this process is
+    going down so their blocked collectives raise instead of hanging
+    until the launcher's external kill."""
+    lib = _state["lib"]
+    if lib is not None and lib.t4j_initialized():
+        lib.t4j_abort_notify(str(why).encode("utf-8", "replace"))
+
+
+def set_timeouts(op_s=None, connect_s=None):
+    """Runtime override of the bridge deadlines, in seconds.
+
+    ``None`` keeps the current value; ``op_s=0`` disables the per-op
+    deadline.  Useful to arm a tight deadline only after warmup
+    (startup skew and first-call compiles legitimately exceed
+    sub-second deadlines)."""
+    lib = _load()
+    lib.t4j_set_timeouts(
+        -1.0 if op_s is None else float(op_s),
+        -1.0 if connect_s is None else float(connect_s),
+    )
 
 
 # numpy dtype -> native DType enum (dcn.h; the reference's 14-entry
@@ -130,9 +212,9 @@ def host_allreduce(handle, x, opcode):
 
     x = _contig(x)
     out = np.empty_like(x)
-    _state["lib"].t4j_c_allreduce(
+    _check(_state["lib"].t4j_c_allreduce(
         handle, _ptr(x), _ptr(out), x.size, dtype_code(x.dtype), opcode
-    )
+    ))
     return out
 
 
@@ -141,9 +223,9 @@ def host_reduce(handle, x, opcode, root):
 
     x = _contig(x)
     out = np.empty_like(x)
-    _state["lib"].t4j_c_reduce(
+    _check(_state["lib"].t4j_c_reduce(
         handle, _ptr(x), _ptr(out), x.size, dtype_code(x.dtype), opcode, root
-    )
+    ))
     if _state["lib"].t4j_comm_rank(handle) != root:
         return x  # off-root output is the input passthrough (wrapper contract)
     return out
@@ -154,21 +236,21 @@ def host_scan(handle, x, opcode):
 
     x = _contig(x)
     out = np.empty_like(x)
-    _state["lib"].t4j_c_scan(
+    _check(_state["lib"].t4j_c_scan(
         handle, _ptr(x), _ptr(out), x.size, dtype_code(x.dtype), opcode
-    )
+    ))
     return out
 
 
 def host_barrier(handle):
-    _state["lib"].t4j_c_barrier(handle)
+    _check(_state["lib"].t4j_c_barrier(handle))
 
 
 def host_bcast(handle, x, root):
     import numpy as np
 
     x = np.array(x, order="C")  # one writable contiguous copy
-    _state["lib"].t4j_c_bcast(handle, _ptr(x), x.nbytes, root)
+    _check(_state["lib"].t4j_c_bcast(handle, _ptr(x), x.nbytes, root))
     return x
 
 
@@ -178,7 +260,7 @@ def host_allgather(handle, x):
     x = _contig(x)
     n = _state["lib"].t4j_comm_size(handle)
     out = np.empty((n, *x.shape), x.dtype)
-    _state["lib"].t4j_c_allgather(handle, _ptr(x), _ptr(out), x.nbytes)
+    _check(_state["lib"].t4j_c_allgather(handle, _ptr(x), _ptr(out), x.nbytes))
     return out
 
 
@@ -188,7 +270,7 @@ def host_gather(handle, x, root):
     x = _contig(x)
     n = _state["lib"].t4j_comm_size(handle)
     out = np.empty((n, *x.shape), x.dtype)
-    _state["lib"].t4j_c_gather(handle, _ptr(x), _ptr(out), x.nbytes, root)
+    _check(_state["lib"].t4j_c_gather(handle, _ptr(x), _ptr(out), x.nbytes, root))
     return out
 
 
@@ -203,7 +285,7 @@ def host_scatter(handle, x, root):
     else:
         out = np.empty(x.shape, x.dtype)
         nbytes_each = out.nbytes
-    lib.t4j_c_scatter(handle, _ptr(x), _ptr(out), nbytes_each, root)
+    _check(lib.t4j_c_scatter(handle, _ptr(x), _ptr(out), nbytes_each, root))
     return out
 
 
@@ -213,13 +295,13 @@ def host_alltoall(handle, x):
     x = _contig(x)
     n = _state["lib"].t4j_comm_size(handle)
     out = np.empty_like(x)
-    _state["lib"].t4j_c_alltoall(handle, _ptr(x), _ptr(out), x.nbytes // n)
+    _check(_state["lib"].t4j_c_alltoall(handle, _ptr(x), _ptr(out), x.nbytes // n))
     return out
 
 
 def host_send(handle, x, dest, tag):
     x = _contig(x)
-    _state["lib"].t4j_c_send(handle, _ptr(x), x.nbytes, dest, tag)
+    _check(_state["lib"].t4j_c_send(handle, _ptr(x), x.nbytes, dest, tag))
 
 
 def host_recv(handle, shape, dtype, source, tag):
@@ -228,10 +310,10 @@ def host_recv(handle, shape, dtype, source, tag):
     out = np.empty(shape, dtype)
     src = ctypes.c_int32(0)
     tg = ctypes.c_int32(0)
-    _state["lib"].t4j_c_recv(
+    _check(_state["lib"].t4j_c_recv(
         handle, _ptr(out), out.nbytes, source, tag,
         ctypes.byref(src), ctypes.byref(tg),
-    )
+    ))
     return out, np.int32(src.value), np.int32(tg.value)
 
 
@@ -242,10 +324,10 @@ def host_sendrecv(handle, sendbuf, recvbuf, source, dest, sendtag, recvtag):
     out = np.empty(recvbuf.shape, recvbuf.dtype)
     src = ctypes.c_int32(0)
     tg = ctypes.c_int32(0)
-    _state["lib"].t4j_c_sendrecv(
+    _check(_state["lib"].t4j_c_sendrecv(
         handle, _ptr(sendbuf), sendbuf.nbytes, _ptr(out), out.nbytes,
         source, dest, sendtag, recvtag, ctypes.byref(src), ctypes.byref(tg),
-    )
+    ))
     return out, np.int32(src.value), np.int32(tg.value)
 
 
@@ -284,9 +366,22 @@ def ensure_initialized():
         return True
     if not available():
         return False
+    # utils/config.py owns deadline validation: a bad T4J_OP_TIMEOUT /
+    # T4J_CONNECT_TIMEOUT raises ValueError here, before the native
+    # library is even built/loaded
+    from mpi4jax_tpu.utils import config
+
+    op_s, connect_s = config.op_timeout(), config.connect_timeout()
     lib = _load()
-    if lib.t4j_init() != 0:
-        raise RuntimeError("native bridge init failed (check T4J_* env)")
+    lib.t4j_set_timeouts(op_s, connect_s)
+    rc = lib.t4j_init()
+    if rc != 0:
+        detail = last_error()
+        raise BridgeError(
+            detail
+            if detail
+            else "native bridge init failed (check T4J_* env)"
+        )
     _register_ffi_targets(lib)
     atexit.register(finalize)
     return True
@@ -297,15 +392,19 @@ def finalize():
     if lib and lib.t4j_initialized():
         # flush pending XLA work before tearing down sockets — the
         # reference registers the same hygiene (decorators.py:11-24,
-        # flush.py) to avoid the deadlock-on-exit class of bugs
-        try:
-            from mpi4jax_tpu.utils.runtime import drain
-            import jax
-            import jax.numpy as jnp
+        # flush.py) to avoid the deadlock-on-exit class of bugs.
+        # Skipped after a fault: pending work may itself be a wedged
+        # collective, and native finalize already skips the exit
+        # barrier then.
+        if not lib.t4j_health():
+            try:
+                from mpi4jax_tpu.utils.runtime import drain
+                import jax
+                import jax.numpy as jnp
 
-            drain(jnp.zeros(()) + 0)
-        except Exception:
-            pass
+                drain(jnp.zeros(()) + 0)
+            except Exception:
+                pass
         lib.t4j_finalize()
 
 

@@ -4,8 +4,10 @@
 #include "shm.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
 #include <unistd.h>
@@ -38,6 +40,119 @@ int g_rank = -1;
 int g_size = -1;
 bool g_initialized = false;
 std::atomic<bool> g_shutting_down{false};
+// g_stop = "no bridge call can make progress any more": set on clean
+// shutdown AND on the first posted fault.  Blocked pipe/socket/mailbox
+// waiters key off this single flag so one wake path covers both.
+std::atomic<bool> g_stop{false};
+
+// ------------------------------------------------------- fault surface
+
+std::atomic<bool> g_faulted{false};
+std::mutex g_fault_mu;
+std::string g_fault_msg;  // guarded by g_fault_mu; set once
+// Set at finalize entry, BEFORE the exit barrier: peers that finish
+// teardown first close their sockets while we are still leaving, and
+// that expected EOF must not print a scary fault line (it still posts
+// quietly, so a genuinely dead peer cannot hang our exit barrier).
+std::atomic<bool> g_finalizing{false};
+
+// current op name for error context ("MPI_Recv", ...), maintained by
+// the LogScope RAII every public entry point already constructs
+thread_local const char* tls_op = nullptr;
+
+const char* cur_op() { return tls_op ? tls_op : "bridge call"; }
+
+std::string err_prefix() {
+  return "r" + std::to_string(g_rank) + " | t4j: ";
+}
+
+// ------------------------------------------------------------ deadlines
+
+// Python (native/runtime.py) validates via utils/config.py and calls
+// set_timeouts before init; the env parse is the fallback for hand-run
+// processes.  -1 = "not set yet".
+std::atomic<double> g_op_timeout_s{-1.0};
+std::atomic<double> g_connect_timeout_s{-1.0};
+
+double env_seconds(const char* name, double dflt) {
+  const char* s = std::getenv(name);
+  if (!s || !s[0]) return dflt;
+  char* end = nullptr;
+  double v = std::strtod(s, &end);
+  if (end == s || v < 0) return dflt;  // Python layer rejects loudly
+  return v;
+}
+
+double op_timeout() {
+  double v = g_op_timeout_s.load(std::memory_order_relaxed);
+  if (v < 0) {
+    v = env_seconds("T4J_OP_TIMEOUT", 0.0);  // 0 = wait forever (MPI)
+    g_op_timeout_s.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+double connect_timeout() {
+  double v = g_connect_timeout_s.load(std::memory_order_relaxed);
+  if (v < 0) {
+    v = env_seconds("T4J_CONNECT_TIMEOUT", 30.0);
+    if (v <= 0) v = 30.0;
+    g_connect_timeout_s.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+// Init-phase ops (the bootstrap barrier, the shm-pipe agreement rounds)
+// are bounded by the CONNECT deadline, not the per-op one: rank startup
+// skew (python imports, jit warmup) legitimately exceeds a sub-second
+// T4J_OP_TIMEOUT, and tripping there would make tight deadlines unusable.
+std::atomic<bool> g_in_init{false};
+
+double effective_op_timeout() {
+  double v = op_timeout();
+  if (v > 0 && g_in_init.load(std::memory_order_relaxed)) {
+    double c = connect_timeout();
+    if (v < c) v = c;
+  }
+  return v;
+}
+
+// Name the knob that set the enforced deadline, so error messages
+// report the limit that actually fired (during init the op deadline is
+// widened to the connect one).
+const char* deadline_knob() {
+  if (g_in_init.load(std::memory_order_relaxed) &&
+      connect_timeout() > op_timeout())
+    return "T4J_CONNECT_TIMEOUT, init phase";
+  return "T4J_OP_TIMEOUT";
+}
+
+using Clock = std::chrono::steady_clock;
+
+// Absolute deadline; limit_s <= 0 means unbounded.
+struct Deadline {
+  bool bounded = false;
+  Clock::time_point at{};
+
+  static Deadline after(double limit_s) {
+    Deadline d;
+    if (limit_s > 0) {
+      d.bounded = true;
+      d.at = Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                std::chrono::duration<double>(limit_s));
+    }
+    return d;
+  }
+  bool expired() const { return bounded && Clock::now() >= at; }
+  int remaining_ms(int tick_ms) const {
+    if (!bounded) return tick_ms;
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    at - Clock::now())
+                    .count();
+    if (left <= 0) return 0;
+    return left < tick_ms ? static_cast<int>(left) : tick_ms;
+  }
+};
 
 std::string call_id() {
   // 8-char random id, matching the reference's debug-log wire format
@@ -57,6 +172,8 @@ struct LogScope {
   std::string op;
   std::chrono::steady_clock::time_point start;
   bool active;
+  const char* prev_op;  // restored on exit (ops can nest, e.g.
+                        // allreduce -> reduce + bcast)
 
   // Wire format follows the reference's bridge
   // (mpi_xla_bridge.pyx:47-52, 95-450): stdout, "r{rank} | {8-char id} |
@@ -66,6 +183,8 @@ struct LogScope {
   // only carry counts for reductions).
   LogScope(const char* op_, const std::string& detail) : op(op_),
                                                          active(g_logging) {
+    prev_op = tls_op;
+    tls_op = op.c_str();  // error-message context even when not logging
     if (!active) return;
     id = call_id();
     start = std::chrono::steady_clock::now();
@@ -77,6 +196,7 @@ struct LogScope {
     std::fflush(stdout);
   }
   ~LogScope() {
+    tls_op = prev_op;
     if (!active) return;
     double secs = std::chrono::duration<double>(
                       std::chrono::steady_clock::now() - start)
@@ -87,11 +207,60 @@ struct LogScope {
   }
 };
 
-[[noreturn]] void die(const char* what) {
-  std::fprintf(stderr, "r%d | t4j DCN bridge: %s returned error; aborting job\n",
-               g_rank, what);
-  std::fflush(stderr);
-  _exit(13);
+void wake_all_pipes();  // defined after the pipe globals
+
+// Record the first failure, print it once, and wake every blocked
+// waiter (mailbox condvar, shm pipes) so they observe g_stop and bail.
+// Reader threads call this when they detect a dead/garbled peer; op
+// threads call it (via fail_op) just before throwing.
+void post_fault(const std::string& msg) {
+  bool first = false;
+  {
+    std::lock_guard<std::mutex> lk(g_fault_mu);
+    if (!g_faulted.load(std::memory_order_acquire)) {
+      g_fault_msg = msg;
+      g_faulted.store(true, std::memory_order_release);
+      first = true;
+    }
+  }
+  g_stop.store(true, std::memory_order_release);
+  if (first && !g_finalizing.load(std::memory_order_acquire)) {
+    std::fprintf(stderr, "%s\n", msg.c_str());
+    std::fflush(stderr);
+  }
+  wake_all_pipes();
+}
+
+std::string posted_fault_msg() {
+  std::lock_guard<std::mutex> lk(g_fault_mu);
+  return g_fault_msg;
+}
+
+// The bridge stopped under us (fault posted elsewhere, or finalize):
+// throw the recorded context so Python sees WHY, not just "stuck".
+[[noreturn]] void raise_stopped() {
+  std::string m = posted_fault_msg();
+  if (m.empty())
+    m = err_prefix() + std::string(cur_op()) +
+        ": bridge already shut down";
+  throw BridgeError(m);
+}
+
+void broadcast_abort(const std::string& why);  // after transport globals
+
+// Op-context failure on THIS rank: tell the peers (so their blocked
+// collectives raise instead of hanging), record the fault, throw.
+[[noreturn]] void fail_op(const std::string& what) {
+  std::string msg = err_prefix() + std::string(cur_op()) + ": " + what;
+  broadcast_abort(msg);
+  post_fault(msg);
+  throw BridgeError(msg);
+}
+
+// Invariant/argument errors (bad handle, unknown dtype, rank range):
+// no abort broadcast — the job state is fine, only this call is wrong.
+[[noreturn]] void fail_arg(const std::string& what) {
+  throw BridgeError(err_prefix() + std::string(cur_op()) + ": " + what);
 }
 
 // ------------------------------------------------------------- transport
@@ -125,7 +294,25 @@ struct PeerSock {
 };
 
 std::vector<PeerSock> g_peers;  // world_size entries; [g_rank] unused
-std::vector<std::thread> g_readers;
+
+// Reader threads are joined in finalize(); if the process exits
+// WITHOUT finalize (a fault raised through user code that never
+// reaches the atexit hook), destroying a joinable std::thread would
+// std::terminate and mask the real exit code — detach instead.
+struct ThreadList {
+  std::vector<std::thread> v;
+  ~ThreadList() {
+    for (auto& t : v)
+      if (t.joinable()) t.detach();
+  }
+  void join_all() {
+    for (auto& t : v)
+      if (t.joinable()) t.join();
+    v.clear();
+  }
+};
+
+ThreadList g_readers;
 
 // Same-host p2p fast path: frames to same-host peers ride SPSC shm
 // byte pipes in the same wire format as the sockets (shm.h), drained
@@ -134,11 +321,41 @@ std::vector<std::thread> g_readers;
 // frames for a pair use one transport, so ordering can never split.
 shm::PipeSeg* g_my_pipes = nullptr;
 std::vector<shm::Pipe*> g_tx_pipes;   // world-indexed; nullptr = TCP
-std::vector<std::thread> g_pipe_readers;
+ThreadList g_pipe_readers;
 
 std::mutex g_mail_mu;
 std::condition_variable g_mail_cv;
 std::deque<Frame> g_mailbox;
+
+// Guards PUBLICATION and TEARDOWN of g_my_pipes/g_tx_pipes against
+// wake_all_pipes: a reader thread can post a fault (and wake pipes)
+// while setup_pipes is still move-assigning the vectors, or while
+// finalize is nulling them.  The raw_send hot path still reads
+// g_tx_pipes unlocked — publication happens on the only thread that
+// sends during bootstrap, so that read is single-threaded until the
+// vector is stable.
+std::mutex g_pipe_pub_mu;
+
+// Wake every shm-pipe waiter AND the mailbox waiters: called when a
+// fault is posted so waiters re-check g_stop instead of sleeping
+// through the failure.
+void wake_all_pipes() {
+  {
+    std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
+    if (g_my_pipes)
+      for (int i = 0;; ++i) {
+        shm::Pipe* p = shm::pipe_of(g_my_pipes, i);
+        if (!p) break;
+        shm::pipe_wake(p);
+      }
+    for (auto* tx : g_tx_pipes)
+      if (tx) shm::pipe_wake(tx);
+  }
+  // take the mailbox lock so a recv that just scanned and is about to
+  // wait cannot miss the notification (classic lost-wakeup window)
+  { std::lock_guard<std::mutex> lk(g_mail_mu); }
+  g_mail_cv.notify_all();
+}
 
 constexpr uint32_t kMagic = 0x7446a001;
 
@@ -150,48 +367,261 @@ struct WireHeader {
   uint64_t nbytes;
 };
 
-void write_all(int fd, const void* buf, size_t n) {
-  const char* p = static_cast<const char*>(buf);
-  while (n > 0) {
-    ssize_t w = ::write(fd, p, n);
-    if (w < 0 && errno == EINTR) continue;  // signal without SA_RESTART
-    if (w <= 0) die("socket write");
-    p += w;
-    n -= static_cast<size_t>(w);
+// Reserved wire ctx for abort control frames.  Real channels are
+// enc_ctx(ctx30bit) <= 2^31, so this value can never collide.
+constexpr uint32_t kAbortCtx = 0xFFFFFFFFu;
+
+// ------------------------------------------------- deterministic faults
+//
+// Env-driven fault injection compiled into the bridge so the failure
+// paths are testable end-to-end (tests/proc/test_fault_injection.py):
+//   T4J_FAULT_RANK      rank the fault applies to (-1 = nobody)
+//   T4J_FAULT_MODE      refuse      — never join the bootstrap (park,
+//                                     then exit 41): connect-failure
+//                       close_after — abruptly close every transport
+//                                     and exit 42 after N sent frames:
+//                                     dead peer mid-collective
+//                       delay       — sleep T4J_FAULT_DELAY_MS before
+//                                     every frame send after the first
+//                                     N: slow peer / deadline trips
+//   T4J_FAULT_AFTER     N frames before the fault arms (default 0)
+//   T4J_FAULT_DELAY_MS  delay mode's per-frame stall (default 1000)
+
+struct FaultPlan {
+  enum Mode { kNone, kRefuse, kCloseAfter, kDelay };
+  Mode mode = kNone;
+  int rank = -1;
+  long after = 0;
+  long delay_ms = 1000;
+};
+
+FaultPlan g_fault_plan;
+std::atomic<long> g_frames_sent{0};
+
+void parse_fault_plan() {
+  const char* mode = std::getenv("T4J_FAULT_MODE");
+  if (!mode || !mode[0]) return;
+  FaultPlan p;
+  if (!std::strcmp(mode, "refuse")) p.mode = FaultPlan::kRefuse;
+  else if (!std::strcmp(mode, "close_after")) p.mode = FaultPlan::kCloseAfter;
+  else if (!std::strcmp(mode, "delay")) p.mode = FaultPlan::kDelay;
+  else {
+    std::fprintf(stderr,
+                 "r%d | t4j: unknown T4J_FAULT_MODE=%s (want refuse|"
+                 "close_after|delay); fault injection disabled\n",
+                 g_rank, mode);
+    return;
+  }
+  const char* r = std::getenv("T4J_FAULT_RANK");
+  p.rank = r ? std::atoi(r) : -1;
+  const char* a = std::getenv("T4J_FAULT_AFTER");
+  if (a) p.after = std::atol(a);
+  const char* d = std::getenv("T4J_FAULT_DELAY_MS");
+  if (d) p.delay_ms = std::atol(d);
+  g_fault_plan = p;
+}
+
+bool fault_armed(FaultPlan::Mode mode) {
+  return g_fault_plan.mode == mode && g_fault_plan.rank == g_rank;
+}
+
+// Called once per outbound frame (both transports).  close_after and
+// delay key off the frame counter so tests land the fault mid-stream.
+void maybe_inject_send_fault() {
+  if (g_fault_plan.mode == FaultPlan::kNone ||
+      g_fault_plan.rank != g_rank)
+    return;
+  long n = g_frames_sent.fetch_add(1, std::memory_order_relaxed) + 1;
+  if (n <= g_fault_plan.after) return;
+  if (g_fault_plan.mode == FaultPlan::kCloseAfter) {
+    std::fprintf(stderr,
+                 "r%d | t4j fault-injection: closing all transports and "
+                 "dying after %ld frames\n",
+                 g_rank, n - 1);
+    std::fflush(stderr);
+    for (auto& p : g_peers) {
+      if (p.fd >= 0) {
+        ::shutdown(p.fd, SHUT_RDWR);
+        ::close(p.fd);
+      }
+    }
+    _exit(42);
+  }
+  if (g_fault_plan.mode == FaultPlan::kDelay)
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(g_fault_plan.delay_ms));
+}
+
+// --------------------------------------------------------- socket I/O
+//
+// Every managed fd is O_NONBLOCK; progress is driven by poll() with a
+// 100ms tick (so blocked I/O observes g_stop promptly) bounded by the
+// caller's deadline.  This is what turns "peer died / peer stalled"
+// from an indefinite hang into a contextual error within the deadline.
+
+enum class IoStatus { kOk, kEof, kTimeout, kStopped, kError };
+
+void set_nonblock(int fd) {
+  int fl = ::fcntl(fd, F_GETFL, 0);
+  if (fl >= 0) ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+// 1 = ready, 0 = deadline expired, -1 = bridge stopped under us
+int io_wait(int fd, short events, const Deadline& dl) {
+  for (;;) {
+    if (g_stop.load(std::memory_order_acquire)) return -1;
+    int tick = dl.remaining_ms(100);
+    if (dl.bounded && tick == 0) return 0;
+    pollfd pfd{fd, events, 0};
+    int rc = ::poll(&pfd, 1, tick);
+    if (rc < 0 && errno != EINTR && errno != EAGAIN) return -1;
+    if (rc > 0) return 1;
   }
 }
 
-bool read_all(int fd, void* buf, size_t n) {
+IoStatus nb_read_all(int fd, void* buf, size_t n, const Deadline& dl) {
   char* p = static_cast<char*>(buf);
   while (n > 0) {
     ssize_t r = ::read(fd, p, n);
-    if (r == 0) return false;  // peer closed
-    if (r < 0 && errno == EINTR) continue;  // signal without SA_RESTART
-    if (r < 0) {
-      // a local shutdown() wakes blocked readers with an error; that is
-      // the clean teardown path, not a transport failure
-      if (g_shutting_down.load()) return false;
-      die("socket read");
+    if (r > 0) {
+      p += r;
+      n -= static_cast<size_t>(r);
+      continue;
     }
-    p += r;
-    n -= static_cast<size_t>(r);
+    if (r == 0) return IoStatus::kEof;
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      int w = io_wait(fd, POLLIN, dl);
+      if (w == 1) continue;
+      return w == 0 ? IoStatus::kTimeout : IoStatus::kStopped;
+    }
+    return IoStatus::kError;
   }
-  return true;
+  return IoStatus::kOk;
+}
+
+// Gathered write via sendmsg(MSG_NOSIGNAL): a dead peer surfaces as
+// EPIPE (-> contextual error) instead of a process-killing SIGPIPE.
+IoStatus nb_write_all(int fd, iovec* iov, int iovcnt, const Deadline& dl) {
+  msghdr mh{};
+  while (iovcnt > 0) {
+    mh.msg_iov = iov;
+    mh.msg_iovlen = iovcnt;
+    ssize_t w = ::sendmsg(fd, &mh, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        int rc = io_wait(fd, POLLOUT, dl);
+        if (rc == 1) continue;
+        return rc == 0 ? IoStatus::kTimeout : IoStatus::kStopped;
+      }
+      return IoStatus::kError;
+    }
+    size_t done = static_cast<size_t>(w);
+    while (iovcnt > 0 && done >= iov[0].iov_len) {
+      done -= iov[0].iov_len;
+      ++iov;
+      --iovcnt;
+    }
+    if (iovcnt > 0 && done > 0) {
+      iov[0].iov_base = static_cast<char*>(iov[0].iov_base) + done;
+      iov[0].iov_len -= done;
+    }
+  }
+  return IoStatus::kOk;
+}
+
+// Best-effort MPI_Abort propagation: one short-deadline abort frame to
+// every TCP peer.  Runs at most once per process; must never recurse
+// into the failure paths (hence raw sendmsg, try_lock, swallowed
+// errors).  Same-host peers get it over their still-open TCP socket —
+// frames never ride the shm pipes here, so a wedged pipe cannot block
+// the broadcast.
+std::atomic<bool> g_abort_sent{false};
+
+void broadcast_abort(const std::string& why) {
+  if (!g_initialized || g_abort_sent.exchange(true)) return;
+  std::string msg = why.size() > 512 ? why.substr(0, 512) : why;
+  WireHeader h{kMagic, static_cast<uint32_t>(g_rank), kAbortCtx, 1,
+               static_cast<uint64_t>(msg.size())};
+  Deadline dl = Deadline::after(1.0);  // do not let goodbye block us
+  for (int peer = 0; peer < static_cast<int>(g_peers.size()); ++peer) {
+    if (peer == g_rank) continue;
+    PeerSock& p = g_peers[peer];
+    if (p.fd < 0) continue;
+    // a sender wedged on this socket holds send_mu; skip — that peer
+    // will observe our EOF or its own deadline instead
+    std::unique_lock<std::mutex> lk(p.send_mu, std::try_to_lock);
+    if (!lk.owns_lock()) continue;
+    iovec iov[2] = {{&h, sizeof(h)},
+                    {const_cast<char*>(msg.data()), msg.size()}};
+    (void)nb_write_all(p.fd, iov, msg.empty() ? 1 : 2, dl);
+  }
 }
 
 void reader_loop(int peer, int fd) {
-  (void)peer;
+  Deadline forever;  // idle between frames is legal — wait unbounded
   for (;;) {
     WireHeader h;
-    if (!read_all(fd, &h, sizeof(h))) return;  // clean shutdown
-    if (h.magic != kMagic) die("frame magic check");
+    IoStatus st = nb_read_all(fd, &h, sizeof(h), forever);
+    if (st != IoStatus::kOk) {
+      // EOF/error at a frame boundary during teardown is the clean
+      // path; anywhere else the peer died under us
+      if (!g_shutting_down.load() && !g_stop.load() &&
+          st != IoStatus::kStopped)
+        post_fault(err_prefix() + "peer r" + std::to_string(peer) +
+                   " closed the connection unexpectedly (process died "
+                   "or exited without finalize)");
+      return;
+    }
+    if (h.magic != kMagic) {
+      post_fault(err_prefix() + "garbled frame from peer r" +
+                 std::to_string(peer) +
+                 " (magic check failed — torn abort frame or stream "
+                 "corruption)");
+      return;
+    }
+    if (h.ctx == kAbortCtx) {
+      // MPI_Abort analog from a peer: record and wake everyone.
+      // broadcast_abort caps the payload at 512 bytes, so anything
+      // larger is stream corruption, not a real abort reason.
+      if (h.nbytes > 4096) {
+        post_fault(err_prefix() + "garbled abort frame from peer r" +
+                   std::to_string(peer));
+        return;
+      }
+      std::string why(h.nbytes ? h.nbytes : 0, '\0');
+      if (h.nbytes) {
+        Deadline body = Deadline::after(5.0);
+        if (nb_read_all(fd, &why[0], h.nbytes, body) != IoStatus::kOk)
+          why = "(abort reason lost in transit)";
+      }
+      post_fault(err_prefix() + "abort broadcast from rank " +
+                 std::to_string(h.src) + ": " + why);
+      return;
+    }
     Frame f;
     f.src = static_cast<int>(h.src);
     f.ctx = static_cast<int>(h.ctx);
     f.tag = static_cast<int>(h.tag) - 1;
     f.data = Buf(h.nbytes);
-    if (h.nbytes && !read_all(fd, f.data.data(), h.nbytes))
-      die("frame body read");
+    if (h.nbytes) {
+      // mid-frame the peer is actively sending: a stall here is a real
+      // fault, so the per-op deadline applies (when configured)
+      Deadline body = Deadline::after(effective_op_timeout());
+      IoStatus bst = nb_read_all(fd, f.data.data(), h.nbytes, body);
+      if (bst != IoStatus::kOk) {
+        if (!g_shutting_down.load() && bst != IoStatus::kStopped)
+          post_fault(err_prefix() + "lost peer r" + std::to_string(peer) +
+                     " mid-frame (" +
+                     (bst == IoStatus::kTimeout ? "stalled beyond "
+                                                  "T4J_OP_TIMEOUT"
+                                                : "connection dropped") +
+                     " with " + std::to_string(h.nbytes) +
+                     "-byte body pending)");
+        return;
+      }
+    }
     {
       std::lock_guard<std::mutex> lk(g_mail_mu);
       g_mailbox.push_back(std::move(f));
@@ -204,6 +634,7 @@ int enc_ctx(int ctx, bool coll) { return ctx * 2 + (coll ? 1 : 0); }
 
 void raw_send(int world_dest, int ctx, int tag, const void* buf,
               size_t nbytes) {
+  if (g_stop.load(std::memory_order_acquire)) raise_stopped();
   if (world_dest == g_rank) {
     Frame f;
     f.src = g_rank;
@@ -218,6 +649,7 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
     g_mail_cv.notify_all();
     return;
   }
+  maybe_inject_send_fault();
   WireHeader h{kMagic, static_cast<uint32_t>(g_rank),
                static_cast<uint32_t>(ctx), static_cast<uint32_t>(tag + 1),
                static_cast<uint64_t>(nbytes)};
@@ -226,36 +658,57 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
     shm::Pipe* pipe = g_tx_pipes[world_dest];
     PeerSock& pp = g_peers[world_dest];
     std::lock_guard<std::mutex> lk(pp.send_mu);  // one producer per pipe
-    if (!shm::pipe_write(pipe, &h, sizeof(h), g_shutting_down) ||
-        (nbytes && !shm::pipe_write(pipe, buf, nbytes, g_shutting_down)))
-      die("shm pipe write during shutdown");
+    // g_stop (not just the shutdown flag): a fault posted while we are
+    // blocked on a full pipe with a dead consumer must unblock us
+    if (!shm::pipe_write(pipe, &h, sizeof(h), g_stop) ||
+        (nbytes && !shm::pipe_write(pipe, buf, nbytes, g_stop))) {
+      if (g_shutting_down.load())
+        throw BridgeError(err_prefix() + std::string(cur_op()) +
+                          ": shm pipe write during shutdown");
+      raise_stopped();
+    }
     return;
   }
   PeerSock& p = g_peers[world_dest];
-  if (p.fd < 0) die("send to unconnected peer");
-  std::lock_guard<std::mutex> lk(p.send_mu);
-  // header + body in one syscall (one TCP segment for small frames)
-  iovec iov[2] = {{&h, sizeof(h)}, {const_cast<void*>(buf), nbytes}};
-  ssize_t w;
-  do {
-    w = ::writev(p.fd, iov, nbytes ? 2 : 1);
-  } while (w < 0 && errno == EINTR);  // signal without SA_RESTART
-  if (w < 0) die("socket writev");
-  size_t done = static_cast<size_t>(w);
-  if (done < sizeof(h)) {
-    write_all(p.fd, reinterpret_cast<const char*>(&h) + done,
-              sizeof(h) - done);
-    done = sizeof(h);
+  if (p.fd < 0)
+    fail_arg("send to unconnected peer r" + std::to_string(world_dest));
+  IoStatus st;
+  int saved_errno = 0;
+  double limit_s = effective_op_timeout();
+  {
+    // failure handling happens OUTSIDE this scope: fail_op broadcasts
+    // the abort, and broadcast_abort try_locks every peer's send_mu —
+    // including this one, which the same thread must not still hold
+    std::lock_guard<std::mutex> lk(p.send_mu);
+    Deadline dl = Deadline::after(limit_s);
+    // header + body in one syscall (one TCP segment for small frames)
+    iovec iov[2] = {{&h, sizeof(h)}, {const_cast<void*>(buf), nbytes}};
+    st = nb_write_all(p.fd, iov, nbytes ? 2 : 1, dl);
+    saved_errno = errno;
   }
-  size_t body_done = done - sizeof(h);
-  if (nbytes > body_done)
-    write_all(p.fd, static_cast<const char*>(buf) + body_done,
-              nbytes - body_done);
+  switch (st) {
+    case IoStatus::kOk:
+      return;
+    case IoStatus::kTimeout:
+      fail_op("send of " + std::to_string(nbytes) + " bytes to peer r" +
+              std::to_string(world_dest) + " (tag " + std::to_string(tag) +
+              ") made no progress for " + std::to_string(limit_s) + "s (" +
+              deadline_knob() + ") — peer stalled or not draining");
+    case IoStatus::kStopped:
+      raise_stopped();
+    default:
+      fail_op("send to peer r" + std::to_string(world_dest) +
+              " failed: " + std::strerror(saved_errno) +
+              " (peer process likely dead)");
+  }
 }
 
 // Blocking matched receive from the mailbox (MPI matching semantics:
-// FIFO per (source, ctx, tag) with wildcards).
+// FIFO per (source, ctx, tag) with wildcards), bounded by the per-op
+// progress deadline when one is configured.
 Frame raw_recv(int world_source, int ctx, int tag) {
+  double limit_s = effective_op_timeout();
+  Deadline dl = Deadline::after(limit_s);
   std::unique_lock<std::mutex> lk(g_mail_mu);
   for (;;) {
     for (auto it = g_mailbox.begin(); it != g_mailbox.end(); ++it) {
@@ -266,7 +719,30 @@ Frame raw_recv(int world_source, int ctx, int tag) {
       g_mailbox.erase(it);
       return f;
     }
-    g_mail_cv.wait(lk);
+    if (g_stop.load(std::memory_order_acquire)) {
+      lk.unlock();
+      raise_stopped();
+    }
+    if (dl.expired()) {
+      lk.unlock();
+      std::string src = world_source == kAnySource
+                            ? std::string("ANY_SOURCE")
+                            : "r" + std::to_string(world_source);
+      std::string tg = tag == kAnyTag ? std::string("ANY_TAG")
+                                      : std::to_string(tag);
+      fail_op("no matching message from " + src + " (tag " + tg +
+              ") within " + std::to_string(limit_s) + "s (" +
+              deadline_knob() +
+              ") — mismatched send/recv, dead peer, or a peer running "
+              "behind");
+    }
+    if (dl.bounded)
+      g_mail_cv.wait_for(lk,
+                         std::chrono::milliseconds(dl.remaining_ms(100)));
+    else
+      // unbounded (the default): sleep until notified — post_fault and
+      // raw_send both notify under g_mail_mu, so no wakeup can be lost
+      g_mail_cv.wait(lk);
   }
 }
 
@@ -306,44 +782,108 @@ void tune_socket(int fd) {
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
 
+// Bootstrap-context failure: main-thread, nothing to broadcast yet
+// (the mesh may not exist) — just throw with rank context.
+[[noreturn]] void fail_boot(const std::string& what) {
+  throw BridgeError(err_prefix() + "bootstrap: " + what);
+}
+
 int tcp_listen(uint16_t* port_out) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) die("socket");
+  if (fd < 0) fail_boot(std::string("socket: ") + std::strerror(errno));
   int one = 1;
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   presize_buffers(fd);  // accepted sockets inherit
+  set_nonblock(fd);     // accept goes through the poll/deadline path
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
   addr.sin_port = htons(*port_out);
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
-    die("bind");
+    fail_boot("bind to port " + std::to_string(*port_out) + ": " +
+              std::strerror(errno));
   socklen_t len = sizeof(addr);
   ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
   *port_out = ntohs(addr.sin_port);
-  if (::listen(fd, 128) < 0) die("listen");
+  if (::listen(fd, 128) < 0)
+    fail_boot(std::string("listen: ") + std::strerror(errno));
   return fd;
 }
 
+// Deadline-bounded accept with attributable context: `who` names what
+// we are waiting for ("rank check-ins at the coordinator", ...).
+int tcp_accept(int listen_fd, const Deadline& dl, const std::string& who) {
+  for (;;) {
+    sockaddr_in peer{};
+    socklen_t len = sizeof(peer);
+    int fd = ::accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &len);
+    if (fd >= 0) return fd;
+    if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR &&
+        errno != ECONNABORTED)
+      fail_boot("accept (" + who + "): " + std::strerror(errno));
+    int w = io_wait(listen_fd, POLLIN, dl);
+    if (w == 0)
+      fail_boot("timed out after " + std::to_string(connect_timeout()) +
+                "s (T4J_CONNECT_TIMEOUT) waiting for " + who +
+                " — a rank failed to start, died during startup, or is "
+                "unreachable");
+    if (w < 0) raise_stopped();
+  }
+}
 
-int tcp_connect(const std::string& host, uint16_t port) {
-  for (int attempt = 0; attempt < 600; ++attempt) {
+// Bounded retrying connect.  `who` names the target for the failure
+// message (satellite: the old code died with a bare "connect
+// (timeout)" after a hardcoded 600 x 50ms loop).
+int tcp_connect(const std::string& host, uint16_t port,
+                const std::string& who) {
+  Deadline dl = Deadline::after(connect_timeout());
+  int last_err = 0;
+  for (;;) {
     int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) die("socket");
+    if (fd < 0) fail_boot(std::string("socket: ") + std::strerror(errno));
     presize_buffers(fd);  // before connect: window scale negotiation
+    set_nonblock(fd);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(port);
-    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
-      die("inet_pton (coordinator must be an IPv4 literal)");
-    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      ::close(fd);
+      fail_boot("bad address " + host +
+                " (coordinator must be an IPv4 literal)");
+    }
+    int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                       sizeof(addr));
+    if (rc == 0) {
       tune_socket(fd);
       return fd;
     }
+    if (errno == EINPROGRESS) {
+      int w = io_wait(fd, POLLOUT, dl);
+      if (w == 1) {
+        int soerr = 0;
+        socklen_t slen = sizeof(soerr);
+        ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+        if (soerr == 0) {
+          tune_socket(fd);
+          return fd;
+        }
+        last_err = soerr;
+      } else if (w < 0) {
+        ::close(fd);
+        raise_stopped();
+      }
+    } else {
+      last_err = errno;
+    }
     ::close(fd);
+    if (dl.expired())
+      fail_boot("connect to " + who + " at " + host + ":" +
+                std::to_string(port) + " failed after " +
+                std::to_string(connect_timeout()) +
+                "s (T4J_CONNECT_TIMEOUT): " +
+                (last_err ? std::strerror(last_err) : "timed out"));
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
-  die("connect (timeout)");
 }
 
 struct PeerAddr {
@@ -396,19 +936,23 @@ uint64_t host_fingerprint() {
 }
 
 void pipe_reader_loop(int peer, shm::Pipe* pipe) {
-  (void)peer;
   for (;;) {
     WireHeader h;
-    if (!shm::pipe_read(pipe, &h, sizeof(h), g_shutting_down))
-      return;  // shutdown
-    if (h.magic != kMagic) die("pipe frame magic check");
+    // g_stop: a posted fault must unblock the pipe reader too
+    if (!shm::pipe_read(pipe, &h, sizeof(h), g_stop))
+      return;  // shutdown or fault
+    if (h.magic != kMagic) {
+      post_fault(err_prefix() + "garbled shm-pipe frame from peer r" +
+                 std::to_string(peer) + " (magic check failed)");
+      return;
+    }
     Frame f;
     f.src = static_cast<int>(h.src);
     f.ctx = static_cast<int>(h.ctx);
     f.tag = static_cast<int>(h.tag) - 1;
     f.data = Buf(h.nbytes);
     if (h.nbytes &&
-        !shm::pipe_read(pipe, f.data.data(), h.nbytes, g_shutting_down))
+        !shm::pipe_read(pipe, f.data.data(), h.nbytes, g_stop))
       return;
     {
       std::lock_guard<std::mutex> lk(g_mail_mu);
@@ -436,7 +980,10 @@ constexpr int kPipeTagCreated = (1 << 24) + 12;
 constexpr int kPipeTagFinal = (1 << 24) + 13;
 
 void setup_pipes() {
-  g_tx_pipes.assign(g_size, nullptr);
+  {
+    std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
+    g_tx_pipes.assign(g_size, nullptr);
+  }
   if (g_size < 2 || static_cast<int>(g_host_fps.size()) != g_size) return;
   std::vector<int> local;  // same-host world ranks, ascending (incl. me)
   for (int r = 0; r < g_size; ++r)
@@ -478,8 +1025,12 @@ void setup_pipes() {
   };
   int n_sources = static_cast<int>(local.size()) - 1;
 
-  g_my_pipes = shm::pipes_create(g_job.c_str(), g_rank, n_sources);
+  {
+    std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
+    g_my_pipes = shm::pipes_create(g_job.c_str(), g_rank, n_sources);
+  }
   if (!agree(g_my_pipes != nullptr, kPipeTagCreated)) {
+    std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
     if (g_my_pipes) {
       shm::pipes_destroy(g_my_pipes);
       g_my_pipes = nullptr;
@@ -504,6 +1055,7 @@ void setup_pipes() {
         shm::pipe_close(t);
         t = nullptr;
       }
+    std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
     shm::pipes_destroy(g_my_pipes);
     g_my_pipes = nullptr;
     return;
@@ -512,13 +1064,47 @@ void setup_pipes() {
   // proves it): drop the segment NAME immediately, shrinking the crash
   // window that could leak /dev/shm to the few ms of setup itself
   shm::pipes_unlink(g_my_pipes);
-  g_tx_pipes = std::move(tx);  // publish: raw_send may now route pipes
+  {
+    std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
+    g_tx_pipes = std::move(tx);  // publish: raw_send may now route pipes
+  }
   for (int r : local) {
     if (r == g_rank) continue;
-    g_pipe_readers.emplace_back(
+    g_pipe_readers.v.emplace_back(
         pipe_reader_loop, r,
         shm::pipe_of(g_my_pipes, slot_of(g_rank, r)));
   }
+}
+
+// Deadline-bounded bootstrap read/write with attributable failures.
+void boot_read(int fd, void* buf, size_t n, const std::string& what) {
+  Deadline dl = Deadline::after(connect_timeout());
+  switch (nb_read_all(fd, buf, n, dl)) {
+    case IoStatus::kOk:
+      return;
+    case IoStatus::kEof:
+      fail_boot(what + ": peer closed the connection mid-handshake "
+                       "(rank died during startup)");
+    case IoStatus::kTimeout:
+      fail_boot(what + ": no data within " +
+                std::to_string(connect_timeout()) +
+                "s (T4J_CONNECT_TIMEOUT)");
+    case IoStatus::kStopped:
+      raise_stopped();
+    default:
+      fail_boot(what + ": " + std::strerror(errno));
+  }
+}
+
+void boot_write(int fd, const void* buf, size_t n, const std::string& what) {
+  Deadline dl = Deadline::after(connect_timeout());
+  iovec iov[1] = {{const_cast<void*>(buf), n}};
+  IoStatus st = nb_write_all(fd, iov, 1, dl);
+  if (st == IoStatus::kOk) return;
+  if (st == IoStatus::kStopped) raise_stopped();
+  fail_boot(what + ": " +
+            (st == IoStatus::kTimeout ? "stalled (T4J_CONNECT_TIMEOUT)"
+                                      : std::strerror(errno)));
 }
 
 void bootstrap(const std::string& coord_host, uint16_t coord_port) {
@@ -538,34 +1124,44 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
     table[0] = PeerAddr{htonl(INADDR_LOOPBACK), my_port, 0, my_fp};
     std::vector<int> fds(g_size, -1);
     for (int i = 1; i < g_size; ++i) {
+      Deadline dl = Deadline::after(connect_timeout());
+      int fd = tcp_accept(coord_fd, dl,
+                          std::to_string(g_size - i) +
+                              " more rank check-in(s) at the coordinator");
+      set_nonblock(fd);
       sockaddr_in peer{};
       socklen_t len = sizeof(peer);
-      int fd = ::accept(coord_fd, reinterpret_cast<sockaddr*>(&peer), &len);
-      if (fd < 0) die("accept (coordinator)");
+      ::getpeername(fd, reinterpret_cast<sockaddr*>(&peer), &len);
       uint32_t rank_and_port[2];
-      if (!read_all(fd, rank_and_port, sizeof(rank_and_port)))
-        die("coordinator handshake");
+      boot_read(fd, rank_and_port, sizeof(rank_and_port),
+                "coordinator handshake");
       uint64_t fp = 0;
-      if (!read_all(fd, &fp, sizeof(fp))) die("coordinator fp handshake");
+      boot_read(fd, &fp, sizeof(fp), "coordinator fp handshake");
       int r = static_cast<int>(rank_and_port[0]);
-      if (r < 1 || r >= g_size) die("coordinator rank check");
+      if (r < 1 || r >= g_size)
+        fail_boot("coordinator check-in claimed invalid rank " +
+                  std::to_string(r) + " (world size " +
+                  std::to_string(g_size) + ")");
       table[r] = PeerAddr{peer.sin_addr.s_addr,
                           static_cast<uint16_t>(rank_and_port[1]), 0, fp};
       fds[r] = fd;
     }
     // phase 2: broadcast the table
     for (int i = 1; i < g_size; ++i) {
-      write_all(fds[i], table.data(), sizeof(PeerAddr) * g_size);
+      boot_write(fds[i], table.data(), sizeof(PeerAddr) * g_size,
+                 "coordinator table broadcast to rank " + std::to_string(i));
       ::close(fds[i]);
     }
     ::close(coord_fd);
   } else {
-    int fd = tcp_connect(coord_host, coord_port);
+    int fd = tcp_connect(coord_host, coord_port, "coordinator (rank 0)");
     uint32_t rank_and_port[2] = {static_cast<uint32_t>(g_rank), my_port};
-    write_all(fd, rank_and_port, sizeof(rank_and_port));
-    write_all(fd, &my_fp, sizeof(my_fp));
-    if (!read_all(fd, table.data(), sizeof(PeerAddr) * g_size))
-      die("coordinator table read");
+    boot_write(fd, rank_and_port, sizeof(rank_and_port),
+               "coordinator check-in");
+    boot_write(fd, &my_fp, sizeof(my_fp), "coordinator fp check-in");
+    boot_read(fd, table.data(), sizeof(PeerAddr) * g_size,
+              "coordinator table read (waiting for every rank to check "
+              "in)");
     ::close(fd);
   }
 
@@ -579,28 +1175,34 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
     in_addr a{table[lower].ip};
     ::inet_ntop(AF_INET, &a, ip, sizeof(ip));
     std::string host = (lower == 0) ? coord_host : std::string(ip);
-    int fd = tcp_connect(host, table[lower].port);
+    int fd = tcp_connect(host, table[lower].port,
+                         "rank " + std::to_string(lower) +
+                             " mesh listener");
     uint32_t me = static_cast<uint32_t>(g_rank);
-    write_all(fd, &me, sizeof(me));
+    boot_write(fd, &me, sizeof(me),
+               "mesh handshake with rank " + std::to_string(lower));
     g_peers[lower].fd = fd;
   }
   for (int higher = g_rank + 1; higher < g_size; ++higher) {
-    sockaddr_in peer{};
-    socklen_t len = sizeof(peer);
-    int fd = ::accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &len);
-    if (fd < 0) die("accept (mesh)");
+    Deadline dl = Deadline::after(connect_timeout());
+    int fd = tcp_accept(listen_fd, dl,
+                        "mesh connections from " +
+                            std::to_string(g_size - higher) +
+                            " higher rank(s)");
     tune_socket(fd);
+    set_nonblock(fd);
     uint32_t who = 0;
-    if (!read_all(fd, &who, sizeof(who))) die("mesh handshake");
+    boot_read(fd, &who, sizeof(who), "mesh handshake");
     if (static_cast<int>(who) <= g_rank || static_cast<int>(who) >= g_size)
-      die("mesh handshake rank check");
+      fail_boot("mesh handshake claimed invalid rank " +
+                std::to_string(who));
     g_peers[who].fd = fd;
   }
   ::close(listen_fd);
 
   for (int p = 0; p < g_size; ++p) {
     if (p == g_rank || g_peers[p].fd < 0) continue;
-    g_readers.emplace_back(reader_loop, p, g_peers[p].fd);
+    g_readers.v.emplace_back(reader_loop, p, g_peers[p].fd);
   }
   setup_pipes();
 }
@@ -628,7 +1230,7 @@ constexpr int kCollTagBase = 1 << 24;
 Comm& get_comm(int handle) {
   std::lock_guard<std::mutex> lk(g_comm_mu);
   if (handle < 0 || handle >= static_cast<int>(g_comms.size()))
-    die("invalid communicator handle");
+    fail_arg("invalid communicator handle " + std::to_string(handle));
   return g_comms[handle];
 }
 
@@ -744,14 +1346,14 @@ void combine_typed(ReduceOp op, const T* a, T* acc, size_t n) {
         for (size_t i = 0; i < n; ++i) acc[i] = a[i] < acc[i] ? a[i] : acc[i];
         return;
       }
-      die("MIN on complex dtype");
+      fail_arg("MIN on complex dtype");
     case ReduceOp::kMax:
       if constexpr (!std::is_same_v<T, std::complex<float>> &&
                     !std::is_same_v<T, std::complex<double>>) {
         for (size_t i = 0; i < n; ++i) acc[i] = acc[i] < a[i] ? a[i] : acc[i];
         return;
       }
-      die("MAX on complex dtype");
+      fail_arg("MAX on complex dtype");
     default:
       break;
   }
@@ -780,7 +1382,7 @@ void combine_typed(ReduceOp op, const T* a, T* acc, size_t n) {
         break;
     }
   }
-  die("unsupported reduce op for dtype");
+  fail_arg("unsupported reduce op for dtype");
 }
 
 // half-precision types travel as uint16 and reduce via float
@@ -859,7 +1461,7 @@ void combine_half(ReduceOp op, const uint16_t* a, uint16_t* acc, size_t n,
         r = y < x ? x : y;
         break;
       default:
-        die("unsupported reduce op for half dtype");
+        fail_arg("unsupported reduce op for half dtype");
     }
     acc[i] = float_to_half(r, bf16);
   }
@@ -916,7 +1518,7 @@ void combine(ReduceOp op, DType dt, const void* contrib, void* acc,
       return combine_half(op, static_cast<const uint16_t*>(contrib),
                           static_cast<uint16_t*>(acc), count, true);
   }
-  die("unknown dtype");
+  fail_arg("unknown dtype");
 }
 }  // namespace detail
 
@@ -935,6 +1537,16 @@ void csend(Comm& c, int dest_idx, int tag, const void* buf, size_t n,
 Frame crecv(Comm& c, int src_idx, int tag, bool coll = true) {
   int world_src = src_idx == kAnySource ? kAnySource : c.ranks[src_idx];
   return raw_recv(world_src, enc_ctx(c.ctx, coll), tag);
+}
+
+// A matched frame of the wrong size means the ranks disagree on
+// shapes/dtypes for this op — attributable, abort-broadcast-worthy.
+[[noreturn]] void fail_size(const Frame& f, size_t expected) {
+  fail_op("size mismatch: expected " + std::to_string(expected) +
+          " bytes but matched a " + std::to_string(f.data.size()) +
+          "-byte message from world rank r" + std::to_string(f.src) +
+          " (tag " + std::to_string(f.tag) +
+          ") — ranks disagree on shapes or dtypes");
 }
 
 }  // namespace
@@ -964,7 +1576,7 @@ size_t dtype_size(DType dt) {
     case DType::kC128:
       return 16;
   }
-  die("unknown dtype");
+  fail_arg("unknown dtype");
 }
 
 bool initialized() { return g_initialized; }
@@ -972,11 +1584,43 @@ int world_rank() { return g_rank; }
 int world_size() { return g_size; }
 void set_logging(bool enabled) { g_logging = enabled; }
 
+void set_timeouts(double op_s, double connect_s) {
+  // op_s: < 0 keeps the current value, 0 disables, > 0 sets.
+  // connect_s: <= 0 keeps (a connect deadline cannot be disabled).
+  if (op_s >= 0) g_op_timeout_s.store(op_s, std::memory_order_relaxed);
+  if (connect_s > 0)
+    g_connect_timeout_s.store(connect_s, std::memory_order_relaxed);
+}
+
+bool faulted() { return g_faulted.load(std::memory_order_acquire); }
+
+std::string fault_message() { return posted_fault_msg(); }
+
+void abort_notify(const char* why) {
+  if (!g_initialized) return;
+  broadcast_abort(err_prefix() + (why ? why : "job aborted"));
+}
+
 void abort_job(int code, const char* why) {
   std::fprintf(stderr, "r%d | t4j abort: %s\n", g_rank, why);
   std::fflush(stderr);
+  broadcast_abort(err_prefix() + "MPI_Abort: " + (why ? why : ""));
   _exit(code);
 }
+
+namespace detail {
+
+bool stopped() { return g_stop.load(std::memory_order_acquire); }
+
+[[noreturn]] void raise_stop() { raise_stopped(); }
+
+double op_timeout_seconds() { return op_timeout(); }
+
+[[noreturn]] void fail_op(const std::string& what) {
+  t4j::fail_op(what);  // anon-namespace impl: broadcast + post + throw
+}
+
+}  // namespace detail
 
 int init_from_env() {
   if (g_initialized) return 0;
@@ -986,7 +1630,23 @@ int init_from_env() {
   if (!rank_s || !size_s) return 1;  // not a multi-process job
   g_rank = std::atoi(rank_s);
   g_size = std::atoi(size_s);
-  if (g_size < 1 || g_rank < 0 || g_rank >= g_size) die("T4J_RANK/T4J_SIZE");
+  if (g_size < 1 || g_rank < 0 || g_rank >= g_size)
+    throw BridgeError(err_prefix() + "invalid T4J_RANK=" +
+                      std::string(rank_s) + " / T4J_SIZE=" +
+                      std::string(size_s));
+  parse_fault_plan();
+  if (fault_armed(FaultPlan::kRefuse)) {
+    // connect-failure injection: never join the bootstrap, so every
+    // peer exercises its connect/accept deadline.  Park (bounded) so
+    // the test harness can reap us, then exit distinctly.
+    std::fprintf(stderr,
+                 "r%d | t4j fault-injection: refusing to join the "
+                 "bootstrap\n",
+                 g_rank);
+    std::fflush(stderr);
+    std::this_thread::sleep_for(std::chrono::seconds(600));
+    _exit(41);
+  }
   // The native LogScope has its own switch, separate from the Python
   // layer's MPI4JAX_TPU_DEBUG: with both keyed to one var every MPI
   // call would log two begin/done pairs with different call ids.
@@ -1009,9 +1669,12 @@ int init_from_env() {
   if (g_size > 1) {
     std::string coord = coord_s ? coord_s : "127.0.0.1:45677";
     auto colon = coord.rfind(':');
-    if (colon == std::string::npos) die("T4J_COORD format (host:port)");
+    if (colon == std::string::npos)
+      throw BridgeError(err_prefix() + "bad T4J_COORD=" + coord +
+                        " (want host:port)");
     std::string host = coord.substr(0, colon);
     uint16_t port = static_cast<uint16_t>(std::atoi(coord.c_str() + colon + 1));
+    g_in_init.store(true, std::memory_order_relaxed);
     bootstrap(host, port);
   }
 
@@ -1024,13 +1687,31 @@ int init_from_env() {
     g_comms.push_back(world);
   }
   g_initialized = true;
+  // the join barrier absorbs rank startup skew, so it runs under the
+  // connect deadline (g_in_init), not the per-op one
   barrier(0);
+  g_in_init.store(false, std::memory_order_relaxed);
   return 0;
 }
 
 void finalize() {
   if (!g_initialized) return;
-  barrier(0);
+  g_finalizing.store(true, std::memory_order_release);
+  // After a fault there is nobody reliable to synchronise with: skip
+  // the exit barrier (it would throw or hang) and go straight to
+  // teardown.  A fault arriving DURING the barrier must not escape a
+  // teardown path either.
+  if (!g_faulted.load(std::memory_order_acquire)) {
+    // like the join barrier, the exit barrier absorbs end-of-job rank
+    // skew: bound it by the connect deadline, not a tight per-op one
+    g_in_init.store(true, std::memory_order_relaxed);
+    try {
+      barrier(0);
+    } catch (const BridgeError&) {
+      // peer died while we were leaving: proceed with teardown
+    }
+    g_in_init.store(false, std::memory_order_relaxed);
+  }
   {
     std::lock_guard<std::mutex> lk(g_comm_mu);
     for (auto& c : g_comms) {
@@ -1040,25 +1721,31 @@ void finalize() {
     }
   }
   g_shutting_down.store(true);
+  g_stop.store(true);
   // wake every pipe waiter (readers blocked on empty, writers on full):
-  // they observe g_shutting_down and exit
-  if (g_my_pipes)
-    for (int i = 0;; ++i) {
-      shm::Pipe* p = shm::pipe_of(g_my_pipes, i);
-      if (!p) break;
-      shm::pipe_wake(p);
-    }
-  for (auto* tx : g_tx_pipes)
-    if (tx) shm::pipe_wake(tx);
-  for (auto& t : g_pipe_readers) t.join();
-  g_pipe_readers.clear();
-  for (auto*& tx : g_tx_pipes) {
-    if (tx) shm::pipe_close(tx);
-    tx = nullptr;
+  // they observe the stop flag and exit
+  {
+    std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
+    if (g_my_pipes)
+      for (int i = 0;; ++i) {
+        shm::Pipe* p = shm::pipe_of(g_my_pipes, i);
+        if (!p) break;
+        shm::pipe_wake(p);
+      }
+    for (auto* tx : g_tx_pipes)
+      if (tx) shm::pipe_wake(tx);
   }
-  if (g_my_pipes) {
-    shm::pipes_destroy(g_my_pipes);
-    g_my_pipes = nullptr;
+  g_pipe_readers.join_all();
+  {
+    std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
+    for (auto*& tx : g_tx_pipes) {
+      if (tx) shm::pipe_close(tx);
+      tx = nullptr;
+    }
+    if (g_my_pipes) {
+      shm::pipes_destroy(g_my_pipes);
+      g_my_pipes = nullptr;
+    }
   }
   // shutdown first (wakes blocked readers with EOF/error), close only
   // after every reader has exited — closing a fd a thread is blocked on
@@ -1066,8 +1753,7 @@ void finalize() {
   for (auto& p : g_peers) {
     if (p.fd >= 0) ::shutdown(p.fd, SHUT_RDWR);
   }
-  for (auto& t : g_readers) t.join();
-  g_readers.clear();
+  g_readers.join_all();
   for (auto& p : g_peers) {
     if (p.fd >= 0) {
       ::close(p.fd);
@@ -1083,7 +1769,7 @@ int comm_create(const int* world_ranks, int n, int ctx) {
   c.my_index = -1;
   for (int i = 0; i < n; ++i) {
     int r = world_ranks[i];
-    if (r < 0 || r >= g_size) die("comm_create rank range");
+    if (r < 0 || r >= g_size) fail_arg("comm_create: world rank " + std::to_string(r) + " out of range [0, " + std::to_string(g_size) + ")");
     if (r == g_rank) c.my_index = i;
     c.ranks.push_back(r);
   }
@@ -1107,7 +1793,7 @@ void send(int comm, const void* buf, size_t nbytes, int dest, int tag) {
                              std::to_string(tag) + " and " +
                              std::to_string(nbytes) + " bytes");
   if (dest < 0 || dest >= static_cast<int>(c.ranks.size()))
-    die("send dest rank (MPI_Send)");
+    fail_arg("destination rank " + std::to_string(dest) + " out of range for a " + std::to_string(c.ranks.size()) + "-member communicator");
   csend(c, dest, tag, buf, nbytes, /*coll=*/false);
 }
 
@@ -1119,9 +1805,9 @@ void recv(int comm, void* buf, size_t nbytes, int source, int tag,
                              std::to_string(nbytes) + " bytes");
   if (source != kAnySource &&
       (source < 0 || source >= static_cast<int>(c.ranks.size())))
-    die("recv source rank (MPI_Recv)");
+    fail_arg("source rank " + std::to_string(source) + " out of range for a " + std::to_string(c.ranks.size()) + "-member communicator");
   Frame f = crecv(c, source, tag, /*coll=*/false);
-  if (f.data.size() != nbytes) die("recv size mismatch");
+  if (f.data.size() != nbytes) fail_size(f, nbytes);
   std::memcpy(buf, f.data.data(), nbytes);
   if (src_out) {
     *src_out = 0;
@@ -1144,7 +1830,7 @@ void sendrecv(int comm, const void* sendbuf, size_t send_nbytes,
   // Send and recv sizes are independent (MPI_Sendrecv semantics).
   csend(c, dest, sendtag, sendbuf, send_nbytes, /*coll=*/false);
   Frame f = crecv(c, source, recvtag, /*coll=*/false);
-  if (f.data.size() != recv_nbytes) die("sendrecv size mismatch");
+  if (f.data.size() != recv_nbytes) fail_size(f, recv_nbytes);
   std::memcpy(recvbuf, f.data.data(), recv_nbytes);
   if (src_out) {
     *src_out = 0;
@@ -1185,7 +1871,7 @@ void bcast(int comm, void* buf, size_t nbytes, int root) {
         csend(c, (partner + root) % n, kCollTagBase + 2, buf, nbytes);
     } else if (me < 2 * k) {
       Frame f = crecv(c, ((me - k) + root) % n, kCollTagBase + 2);
-      if (f.data.size() != nbytes) die("bcast size mismatch");
+      if (f.data.size() != nbytes) fail_size(f, nbytes);
       std::memcpy(buf, f.data.data(), nbytes);
     }
   }
@@ -1211,7 +1897,7 @@ void reduce(int comm, const void* in, void* out, size_t count, DType dt,
       int partner = me + k;
       if (partner < n) {
         Frame f = crecv(c, (partner + root) % n, kCollTagBase + 3);
-        if (f.data.size() != nbytes) die("reduce size mismatch");
+        if (f.data.size() != nbytes) fail_size(f, nbytes);
         combine(op, dt, f.data.data(), acc.data(), count);
       }
     } else if (me < 2 * k) {
@@ -1246,7 +1932,7 @@ void scan(int comm, const void* in, void* out, size_t count, DType dt,
   // linear inclusive prefix chain (MPI_Scan semantics)
   if (c.my_index > 0) {
     Frame f = crecv(c, c.my_index - 1, kCollTagBase + 4);
-    if (f.data.size() != nbytes) die("scan size mismatch");
+    if (f.data.size() != nbytes) fail_size(f, nbytes);
     combine(op, dt, in, f.data.data(), count);
     std::memcpy(out, f.data.data(), nbytes);
   }
@@ -1277,7 +1963,7 @@ void gather(int comm, const void* in, void* out, size_t nbytes_each,
     for (int i = 0; i < n; ++i) {
       if (i == root) continue;
       Frame f = crecv(c, i, kCollTagBase + 5);
-      if (f.data.size() != nbytes_each) die("gather size mismatch");
+      if (f.data.size() != nbytes_each) fail_size(f, nbytes_each);
       std::memcpy(o + nbytes_each * i, f.data.data(), nbytes_each);
     }
   } else {
@@ -1302,7 +1988,7 @@ void scatter(int comm, const void* in, void* out, size_t nbytes_each,
     std::memcpy(out, i8 + nbytes_each * root, nbytes_each);
   } else {
     Frame f = crecv(c, root, kCollTagBase + 6);
-    if (f.data.size() != nbytes_each) die("scatter size mismatch");
+    if (f.data.size() != nbytes_each) fail_size(f, nbytes_each);
     std::memcpy(out, f.data.data(), nbytes_each);
   }
 }
@@ -1324,7 +2010,7 @@ void alltoall(int comm, const void* in, void* out, size_t nbytes_each) {
     int from = ((me - off) % n + n) % n;
     csend(c, to, kCollTagBase + 7, i8 + nbytes_each * to, nbytes_each);
     Frame f = crecv(c, from, kCollTagBase + 7);
-    if (f.data.size() != nbytes_each) die("alltoall size mismatch");
+    if (f.data.size() != nbytes_each) fail_size(f, nbytes_each);
     std::memcpy(o8 + nbytes_each * from, f.data.data(), nbytes_each);
   }
 }

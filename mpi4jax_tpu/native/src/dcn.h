@@ -11,16 +11,39 @@
 // Process model: one OS process per rank (the reference's model,
 // SURVEY §7 "one JAX process per TPU host").  Bootstrap via environment:
 //   T4J_RANK, T4J_SIZE, T4J_COORD=host:port (rank 0 listens there).
+//
+// Failure semantics (docs/failure-semantics.md): transport errors no
+// longer abort the process.  They raise BridgeError with rank/peer/op
+// context, post a process-wide fault (every subsequent bridge call then
+// fails fast), and broadcast an abort control frame so peers blocked in
+// a matching collective raise too instead of hanging.  Deadlines:
+//   T4J_OP_TIMEOUT      per-call progress deadline, seconds (0 = wait
+//                       forever, the default — matching MPI)
+//   T4J_CONNECT_TIMEOUT bootstrap connect/accept deadline (default 30s)
+// Deterministic fault injection for tests (T4J_FAULT_MODE=refuse|
+// close_after|delay gated on T4J_FAULT_RANK) is compiled in; see
+// init_from_env.
 
 #pragma once
 
 #include <cstddef>
 #include <cstdint>
+#include <stdexcept>
+#include <string>
 
 namespace t4j {
 
 constexpr int kAnySource = -1;
 constexpr int kAnyTag = -1;
+
+// Raised (not abort) on transport failures, deadline expiry, matching
+// errors and invalid arguments.  The message carries rank, peer and op
+// context ("r2 | t4j: MPI_Recv ...") so pod post-mortems are
+// attributable.  Crosses the FFI boundary as ffi::Error (ffi.cc) and
+// the ctypes boundary as a nonzero status + t4j_last_error().
+struct BridgeError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
 
 enum class ReduceOp : int32_t {
   kSum = 0,
@@ -58,16 +81,37 @@ enum class DType : int32_t {
 size_t dtype_size(DType dt);
 
 // -- runtime lifecycle ----------------------------------------------------
-// All functions abort the process (after printing an MPI_Abort-style
-// message, mpi_xla_bridge.pyx:67-91) on unrecoverable transport errors.
+// All communication functions throw BridgeError (with an MPI_Abort-style
+// contextual message, mpi_xla_bridge.pyx:67-91) on transport errors and
+// deadline expiry; after the first failure the bridge is faulted and
+// every further call fails fast.
 
 bool initialized();
-int init_from_env();  // returns 0 on success
+int init_from_env();  // 0 ok; 1 not a multi-process job; 2 bootstrap failed
 void finalize();
 int world_rank();
 int world_size();
 void set_logging(bool enabled);
 void abort_job(int code, const char* why);
+
+// Override the env-derived deadlines (seconds).  op_s: < 0 keeps the
+// current value, 0 disables the per-op deadline, > 0 sets it.
+// connect_s: <= 0 keeps the current value (a connect deadline cannot
+// be disabled).  Called from Python (native/runtime.py) before init so
+// utils/config.py owns validation.
+void set_timeouts(double op_s, double connect_s);
+
+// Fault surface: after any bridge call fails, faulted() is true and
+// fault_message() describes the first failure.
+bool faulted();
+std::string fault_message();
+
+// Best-effort MPI_Abort analog: broadcast an abort control frame to
+// every connected peer (their blocked ops raise `why` within their
+// deadline) without touching this process's own control flow.  Used by
+// the launcher's child wrapper when user code dies so survivors don't
+// hang until the external kill.
+void abort_notify(const char* why);
 
 // -- communicators --------------------------------------------------------
 // A communicator is a subset of world ranks plus a context id that
@@ -104,5 +148,20 @@ void gather(int comm, const void* in, void* out, size_t nbytes_each,
 void scatter(int comm, const void* in, void* out, size_t nbytes_each,
              int root);
 void alltoall(int comm, const void* in, void* out, size_t nbytes_each);
+
+// -- internal hooks shared with the shm tier (shm.cc) ---------------------
+namespace detail {
+// True once a fault was posted or shutdown began: blocked shm waiters
+// must bail out instead of waiting for a peer that will never come.
+bool stopped();
+// Throw the posted fault (or a generic stop message) as BridgeError.
+[[noreturn]] void raise_stop();
+// Effective per-op progress deadline in seconds (0 = unbounded).
+double op_timeout_seconds();
+// Op-context failure: posts the fault, broadcasts the abort control
+// frame to peers, throws BridgeError.  `what` is appended to the
+// "r<rank> | t4j: <current op>: " prefix.
+[[noreturn]] void fail_op(const std::string& what);
+}  // namespace detail
 
 }  // namespace t4j

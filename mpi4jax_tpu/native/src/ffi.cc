@@ -8,11 +8,21 @@
 // data buffer and a f32[] ordering stamp that threads the token chain
 // through the compiled program.
 //
+// Failure propagation: bridge calls throw t4j::BridgeError with
+// rank/peer/op context.  FFI handlers translate that into a non-OK
+// ffi::Error (surfacing in Python as XlaRuntimeError with the message
+// intact); the plain-C control API returns a nonzero status and parks
+// the message in a thread-local retrieved via t4j_last_error().  The
+// process is never aborted from here — the reference's MPI_Abort
+// fail-fast is replaced by the abort broadcast (dcn.cc) plus the
+// launcher's job-level fail-fast.
+//
 // Also exports the plain-C control API consumed through ctypes
 // (mpi4jax_tpu/native/runtime.py).
 
 #include <cstdint>
 #include <cstring>
+#include <string>
 
 #include "dcn.h"
 #include "xla/ffi/api/ffi.h"
@@ -20,6 +30,36 @@
 namespace ffi = xla::ffi;
 
 namespace {
+
+// last failure message for the ctypes tier (per thread: the Python
+// caller reads it right after the failing call on the same thread)
+thread_local std::string g_tls_err;
+
+template <typename F>
+ffi::Error guarded(F&& f) {
+  try {
+    f();
+    return ffi::Error::Success();
+  } catch (const t4j::BridgeError& e) {
+    return ffi::Error(ffi::ErrorCode::kAborted, e.what());
+  } catch (const std::exception& e) {
+    return ffi::Error(ffi::ErrorCode::kInternal, e.what());
+  }
+}
+
+template <typename F>
+int32_t c_guard(F&& f) {
+  try {
+    f();
+    return 0;
+  } catch (const t4j::BridgeError& e) {
+    g_tls_err = e.what();
+    return 1;
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return 2;
+  }
+}
 
 t4j::DType to_dtype(ffi::DataType dt) {
   switch (dt) {
@@ -54,7 +94,7 @@ t4j::DType to_dtype(ffi::DataType dt) {
     case ffi::BF16:
       return t4j::DType::kBF16;
     default:
-      t4j::abort_job(13, "unsupported dtype in FFI call");
+      throw t4j::BridgeError("unsupported dtype in FFI call");
   }
 }
 
@@ -64,43 +104,44 @@ void touch_stamp(ffi::AnyBuffer& stamp, ffi::Result<ffi::AnyBuffer>& out) {
                 out->size_bytes());
 }
 
-ffi::Error ok() { return ffi::Error::Success(); }
-
 // ---- allreduce / reduce / scan -----------------------------------------
 
 ffi::Error AllreduceImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                          ffi::Result<ffi::AnyBuffer> y,
                          ffi::Result<ffi::AnyBuffer> stamp_out,
                          int32_t comm, int32_t op) {
-  t4j::allreduce(comm, x.untyped_data(), y->untyped_data(),
-                 x.element_count(), to_dtype(x.element_type()),
-                 static_cast<t4j::ReduceOp>(op));
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    t4j::allreduce(comm, x.untyped_data(), y->untyped_data(),
+                   x.element_count(), to_dtype(x.element_type()),
+                   static_cast<t4j::ReduceOp>(op));
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 ffi::Error ReduceImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                       ffi::Result<ffi::AnyBuffer> y,
                       ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
                       int32_t op, int32_t root) {
-  // non-root outputs mirror the input (the Python wrapper returns the
-  // input unchanged off-root, reference reduce.py:66-71)
-  std::memcpy(y->untyped_data(), x.untyped_data(), x.size_bytes());
-  t4j::reduce(comm, x.untyped_data(), y->untyped_data(), x.element_count(),
-              to_dtype(x.element_type()), static_cast<t4j::ReduceOp>(op),
-              root);
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    // non-root outputs mirror the input (the Python wrapper returns the
+    // input unchanged off-root, reference reduce.py:66-71)
+    std::memcpy(y->untyped_data(), x.untyped_data(), x.size_bytes());
+    t4j::reduce(comm, x.untyped_data(), y->untyped_data(),
+                x.element_count(), to_dtype(x.element_type()),
+                static_cast<t4j::ReduceOp>(op), root);
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 ffi::Error ScanImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                     ffi::Result<ffi::AnyBuffer> y,
                     ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
                     int32_t op) {
-  t4j::scan(comm, x.untyped_data(), y->untyped_data(), x.element_count(),
-            to_dtype(x.element_type()), static_cast<t4j::ReduceOp>(op));
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    t4j::scan(comm, x.untyped_data(), y->untyped_data(), x.element_count(),
+              to_dtype(x.element_type()), static_cast<t4j::ReduceOp>(op));
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 // ---- p2p ----------------------------------------------------------------
@@ -108,23 +149,25 @@ ffi::Error ScanImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
 ffi::Error SendImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                     ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
                     int32_t dest, int32_t tag) {
-  t4j::send(comm, x.untyped_data(), x.size_bytes(), dest, tag);
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    t4j::send(comm, x.untyped_data(), x.size_bytes(), dest, tag);
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 ffi::Error RecvImpl(ffi::AnyBuffer stamp, ffi::Result<ffi::AnyBuffer> y,
                     ffi::Result<ffi::AnyBuffer> stamp_out,
                     ffi::Result<ffi::AnyBuffer> status, int32_t comm,
                     int32_t source, int32_t tag) {
-  int src = 0, got_tag = 0;
-  t4j::recv(comm, y->untyped_data(), y->size_bytes(), source, tag, &src,
-            &got_tag);
-  auto* st = static_cast<int32_t*>(status->untyped_data());
-  st[0] = src;
-  st[1] = got_tag;
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    int src = 0, got_tag = 0;
+    t4j::recv(comm, y->untyped_data(), y->size_bytes(), source, tag, &src,
+              &got_tag);
+    auto* st = static_cast<int32_t*>(status->untyped_data());
+    st[0] = src;
+    st[1] = got_tag;
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 ffi::Error SendrecvImpl(ffi::AnyBuffer sendbuf, ffi::AnyBuffer recvbuf,
@@ -133,74 +176,82 @@ ffi::Error SendrecvImpl(ffi::AnyBuffer sendbuf, ffi::AnyBuffer recvbuf,
                         ffi::Result<ffi::AnyBuffer> status, int32_t comm,
                         int32_t source, int32_t dest, int32_t sendtag,
                         int32_t recvtag) {
-  (void)recvbuf;
-  int src = 0, got_tag = 0;
-  t4j::sendrecv(comm, sendbuf.untyped_data(), sendbuf.size_bytes(),
-                y->untyped_data(), y->size_bytes(), source, dest, sendtag,
-                recvtag, &src, &got_tag);
-  auto* st = static_cast<int32_t*>(status->untyped_data());
-  st[0] = src;
-  st[1] = got_tag;
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    (void)recvbuf;
+    int src = 0, got_tag = 0;
+    t4j::sendrecv(comm, sendbuf.untyped_data(), sendbuf.size_bytes(),
+                  y->untyped_data(), y->size_bytes(), source, dest, sendtag,
+                  recvtag, &src, &got_tag);
+    auto* st = static_cast<int32_t*>(status->untyped_data());
+    st[0] = src;
+    st[1] = got_tag;
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 // ---- rooted / gather family --------------------------------------------
 
 ffi::Error BarrierImpl(ffi::AnyBuffer stamp,
                        ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm) {
-  t4j::barrier(comm);
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    t4j::barrier(comm);
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 ffi::Error BcastImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                      ffi::Result<ffi::AnyBuffer> y,
                      ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
                      int32_t root) {
-  std::memcpy(y->untyped_data(), x.untyped_data(), x.size_bytes());
-  t4j::bcast(comm, y->untyped_data(), y->size_bytes(), root);
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    std::memcpy(y->untyped_data(), x.untyped_data(), x.size_bytes());
+    t4j::bcast(comm, y->untyped_data(), y->size_bytes(), root);
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 ffi::Error AllgatherImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                          ffi::Result<ffi::AnyBuffer> y,
                          ffi::Result<ffi::AnyBuffer> stamp_out,
                          int32_t comm) {
-  t4j::allgather(comm, x.untyped_data(), y->untyped_data(), x.size_bytes());
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    t4j::allgather(comm, x.untyped_data(), y->untyped_data(),
+                   x.size_bytes());
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 ffi::Error GatherImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                       ffi::Result<ffi::AnyBuffer> y,
                       ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
                       int32_t root) {
-  t4j::gather(comm, x.untyped_data(), y->untyped_data(), x.size_bytes(),
-              root);
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    t4j::gather(comm, x.untyped_data(), y->untyped_data(), x.size_bytes(),
+                root);
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 ffi::Error ScatterImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                        ffi::Result<ffi::AnyBuffer> y,
                        ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
                        int32_t root) {
-  t4j::scatter(comm, x.untyped_data(), y->untyped_data(), y->size_bytes(),
-               root);
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    t4j::scatter(comm, x.untyped_data(), y->untyped_data(),
+                 y->size_bytes(), root);
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 ffi::Error AlltoallImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                         ffi::Result<ffi::AnyBuffer> y,
                         ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm) {
-  int n = t4j::comm_size(comm);
-  t4j::alltoall(comm, x.untyped_data(), y->untyped_data(),
-                x.size_bytes() / static_cast<size_t>(n));
-  touch_stamp(stamp, stamp_out);
-  return ok();
+  return guarded([&] {
+    int n = t4j::comm_size(comm);
+    t4j::alltoall(comm, x.untyped_data(), y->untyped_data(),
+                  x.size_bytes() / static_cast<size_t>(n));
+    touch_stamp(stamp, stamp_out);
+  });
 }
 
 }  // namespace
@@ -296,21 +347,66 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_alltoall, AlltoallImpl,
                                   .Attr<int32_t>("comm"));
 
 // ---- plain-C control API (ctypes) --------------------------------------
+//
+// Data-plane entry points return 0 on success; nonzero means the call
+// failed and t4j_last_error() (same thread) holds the contextual
+// message.  Python raises it as BridgeError (native/runtime.py).
 
 extern "C" {
 
-int t4j_init() { return t4j::init_from_env(); }
+int t4j_init() {
+  try {
+    return t4j::init_from_env();  // 0 ok, 1 not a multi-process job
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return 2;  // bootstrap failed; message via t4j_last_error()
+  }
+}
 void t4j_finalize() { t4j::finalize(); }
 int t4j_initialized() { return t4j::initialized() ? 1 : 0; }
 int t4j_world_rank() { return t4j::world_rank(); }
 int t4j_world_size() { return t4j::world_size(); }
 void t4j_set_logging(int enabled) { t4j::set_logging(enabled != 0); }
-int t4j_comm_create(const int32_t* ranks, int32_t n, int32_t ctx) {
-  return t4j::comm_create(reinterpret_cast<const int*>(ranks),
-                          static_cast<int>(n), static_cast<int>(ctx));
+const char* t4j_last_error() { return g_tls_err.c_str(); }
+
+// fault surface: 0 = healthy, 1 = a bridge failure was posted (every
+// further call on any thread fails fast with t4j_fault_msg())
+int t4j_health() { return t4j::faulted() ? 1 : 0; }
+const char* t4j_fault_msg() {
+  thread_local std::string msg;
+  msg = t4j::fault_message();
+  return msg.c_str();
 }
-int t4j_comm_rank(int32_t comm) { return t4j::comm_rank(comm); }
-int t4j_comm_size(int32_t comm) { return t4j::comm_size(comm); }
+void t4j_set_timeouts(double op_s, double connect_s) {
+  t4j::set_timeouts(op_s, connect_s);
+}
+void t4j_abort_notify(const char* why) { t4j::abort_notify(why); }
+
+int t4j_comm_create(const int32_t* ranks, int32_t n, int32_t ctx) {
+  try {
+    return t4j::comm_create(reinterpret_cast<const int*>(ranks),
+                            static_cast<int>(n), static_cast<int>(ctx));
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return -1;
+  }
+}
+int t4j_comm_rank(int32_t comm) {
+  try {
+    return t4j::comm_rank(comm);
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return -1;
+  }
+}
+int t4j_comm_size(int32_t comm) {
+  try {
+    return t4j::comm_size(comm);
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return -1;
+  }
+}
 void t4j_abort(int32_t code) { t4j::abort_job(code, "user abort"); }
 
 // ctypes data plane: used by the host-callback tier (TPU jits stage
@@ -318,62 +414,74 @@ void t4j_abort(int32_t code) { t4j::abort_job(code, "user abort"); }
 // analog of the reference's GPU COPY_TO_HOST staging path,
 // mpi_xla_bridge_gpu.pyx:211-251).
 
-void t4j_c_send(int32_t comm, const void* buf, uint64_t nbytes, int32_t dest,
-                int32_t tag) {
-  t4j::send(comm, buf, nbytes, dest, tag);
+int32_t t4j_c_send(int32_t comm, const void* buf, uint64_t nbytes,
+                   int32_t dest, int32_t tag) {
+  return c_guard([&] { t4j::send(comm, buf, nbytes, dest, tag); });
 }
-void t4j_c_recv(int32_t comm, void* buf, uint64_t nbytes, int32_t source,
-                int32_t tag, int32_t* src_out, int32_t* tag_out) {
-  int s = 0, t = 0;
-  t4j::recv(comm, buf, nbytes, source, tag, &s, &t);
-  if (src_out) *src_out = s;
-  if (tag_out) *tag_out = t;
+int32_t t4j_c_recv(int32_t comm, void* buf, uint64_t nbytes, int32_t source,
+                   int32_t tag, int32_t* src_out, int32_t* tag_out) {
+  return c_guard([&] {
+    int s = 0, t = 0;
+    t4j::recv(comm, buf, nbytes, source, tag, &s, &t);
+    if (src_out) *src_out = s;
+    if (tag_out) *tag_out = t;
+  });
 }
-void t4j_c_sendrecv(int32_t comm, const void* sendbuf,
-                    uint64_t send_nbytes, void* recvbuf,
-                    uint64_t recv_nbytes, int32_t source, int32_t dest,
-                    int32_t sendtag, int32_t recvtag, int32_t* src_out,
-                    int32_t* tag_out) {
-  int s = 0, t = 0;
-  t4j::sendrecv(comm, sendbuf, send_nbytes, recvbuf, recv_nbytes, source,
-                dest, sendtag, recvtag, &s, &t);
-  if (src_out) *src_out = s;
-  if (tag_out) *tag_out = t;
+int32_t t4j_c_sendrecv(int32_t comm, const void* sendbuf,
+                       uint64_t send_nbytes, void* recvbuf,
+                       uint64_t recv_nbytes, int32_t source, int32_t dest,
+                       int32_t sendtag, int32_t recvtag, int32_t* src_out,
+                       int32_t* tag_out) {
+  return c_guard([&] {
+    int s = 0, t = 0;
+    t4j::sendrecv(comm, sendbuf, send_nbytes, recvbuf, recv_nbytes, source,
+                  dest, sendtag, recvtag, &s, &t);
+    if (src_out) *src_out = s;
+    if (tag_out) *tag_out = t;
+  });
 }
-void t4j_c_barrier(int32_t comm) { t4j::barrier(comm); }
-void t4j_c_bcast(int32_t comm, void* buf, uint64_t nbytes, int32_t root) {
-  t4j::bcast(comm, buf, nbytes, root);
+int32_t t4j_c_barrier(int32_t comm) {
+  return c_guard([&] { t4j::barrier(comm); });
 }
-void t4j_c_allreduce(int32_t comm, const void* in, void* out, uint64_t count,
-                     int32_t dt, int32_t op) {
-  t4j::allreduce(comm, in, out, count, static_cast<t4j::DType>(dt),
-                 static_cast<t4j::ReduceOp>(op));
+int32_t t4j_c_bcast(int32_t comm, void* buf, uint64_t nbytes, int32_t root) {
+  return c_guard([&] { t4j::bcast(comm, buf, nbytes, root); });
 }
-void t4j_c_reduce(int32_t comm, const void* in, void* out, uint64_t count,
-                  int32_t dt, int32_t op, int32_t root) {
-  t4j::reduce(comm, in, out, count, static_cast<t4j::DType>(dt),
-              static_cast<t4j::ReduceOp>(op), root);
+int32_t t4j_c_allreduce(int32_t comm, const void* in, void* out,
+                        uint64_t count, int32_t dt, int32_t op) {
+  return c_guard([&] {
+    t4j::allreduce(comm, in, out, count, static_cast<t4j::DType>(dt),
+                   static_cast<t4j::ReduceOp>(op));
+  });
 }
-void t4j_c_scan(int32_t comm, const void* in, void* out, uint64_t count,
-                int32_t dt, int32_t op) {
-  t4j::scan(comm, in, out, count, static_cast<t4j::DType>(dt),
-            static_cast<t4j::ReduceOp>(op));
+int32_t t4j_c_reduce(int32_t comm, const void* in, void* out, uint64_t count,
+                     int32_t dt, int32_t op, int32_t root) {
+  return c_guard([&] {
+    t4j::reduce(comm, in, out, count, static_cast<t4j::DType>(dt),
+                static_cast<t4j::ReduceOp>(op), root);
+  });
 }
-void t4j_c_allgather(int32_t comm, const void* in, void* out,
-                     uint64_t nbytes_each) {
-  t4j::allgather(comm, in, out, nbytes_each);
+int32_t t4j_c_scan(int32_t comm, const void* in, void* out, uint64_t count,
+                   int32_t dt, int32_t op) {
+  return c_guard([&] {
+    t4j::scan(comm, in, out, count, static_cast<t4j::DType>(dt),
+              static_cast<t4j::ReduceOp>(op));
+  });
 }
-void t4j_c_gather(int32_t comm, const void* in, void* out,
-                  uint64_t nbytes_each, int32_t root) {
-  t4j::gather(comm, in, out, nbytes_each, root);
+int32_t t4j_c_allgather(int32_t comm, const void* in, void* out,
+                        uint64_t nbytes_each) {
+  return c_guard([&] { t4j::allgather(comm, in, out, nbytes_each); });
 }
-void t4j_c_scatter(int32_t comm, const void* in, void* out,
-                   uint64_t nbytes_each, int32_t root) {
-  t4j::scatter(comm, in, out, nbytes_each, root);
+int32_t t4j_c_gather(int32_t comm, const void* in, void* out,
+                     uint64_t nbytes_each, int32_t root) {
+  return c_guard([&] { t4j::gather(comm, in, out, nbytes_each, root); });
 }
-void t4j_c_alltoall(int32_t comm, const void* in, void* out,
-                    uint64_t nbytes_each) {
-  t4j::alltoall(comm, in, out, nbytes_each);
+int32_t t4j_c_scatter(int32_t comm, const void* in, void* out,
+                      uint64_t nbytes_each, int32_t root) {
+  return c_guard([&] { t4j::scatter(comm, in, out, nbytes_each, root); });
+}
+int32_t t4j_c_alltoall(int32_t comm, const void* in, void* out,
+                       uint64_t nbytes_each) {
+  return c_guard([&] { t4j::alltoall(comm, in, out, nbytes_each); });
 }
 
 }  // extern "C"

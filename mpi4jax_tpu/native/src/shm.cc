@@ -57,8 +57,15 @@ struct Hdr {
   std::atomic<uint64_t> acked[kMaxRanks];     // pieces fully consumed
 };
 
-void futex_wait(std::atomic<uint32_t>* w, uint32_t val) {
-  timespec ts{2, 0};  // bounded: re-check the predicate at least every 2s
+void futex_wait(std::atomic<uint32_t>* w, uint32_t val,
+                double max_wait_s = 2.0) {
+  // bounded: re-check the predicate (and the stop flag / deadline) at
+  // least every max_wait_s
+  if (max_wait_s <= 0 || max_wait_s > 2.0) max_wait_s = 2.0;
+  if (max_wait_s < 0.01) max_wait_s = 0.01;
+  timespec ts{static_cast<time_t>(max_wait_s),
+              static_cast<long>((max_wait_s -
+                                 static_cast<time_t>(max_wait_s)) * 1e9)};
   syscall(SYS_futex, reinterpret_cast<uint32_t*>(w), FUTEX_WAIT, val, &ts,
           nullptr, 0);
 }
@@ -74,10 +81,13 @@ double now_s() {
   return ts.tv_sec + 1e-9 * ts.tv_nsec;
 }
 
-// T4J_SHM_TIMEOUT (seconds) opts into fail-fast aborts on a stalled
-// collective; unset, a stall WARNS once and keeps waiting — matching
-// the TCP transport, which blocks indefinitely (a slow peer compiling
-// a big program must not convert into a killed job).
+// T4J_SHM_TIMEOUT (seconds) opts into fail-fast errors on a stalled
+// collective; unset, the stall deadline falls back to the transport-
+// wide T4J_OP_TIMEOUT so one knob bounds both tiers, and with neither
+// set a stall WARNS once and keeps waiting — matching MPI, where a
+// slow peer compiling a big program must not convert into a killed
+// job.  A tripped deadline now raises BridgeError through the dcn
+// fault path (abort broadcast + fault flag) instead of _exit(13).
 // T4J_SHM_WARN (seconds, default 300) tunes when that one-time warning
 // fires, for hosts where a legitimately slow first collective (large
 // compile on a busy box) outlives the default (ADVICE r4).
@@ -91,11 +101,12 @@ double wait_warn_s() {
 }
 
 double wait_abort_s() {
-  static double lim = [] {
+  static double env_lim = [] {
     const char* s = std::getenv("T4J_SHM_TIMEOUT");
-    return s ? std::atof(s) : 0.0;  // 0 = never abort
+    return s ? std::atof(s) : 0.0;  // 0 = defer to T4J_OP_TIMEOUT
   }();
-  return lim;
+  if (env_lim > 0) return env_lim;
+  return detail::op_timeout_seconds();  // 0 = never abort
 }
 
 }  // namespace
@@ -154,10 +165,15 @@ void wait_for(Hdr* h, Pred ok) {
   double t0 = now_s();
   bool warned = false;
   for (;;) {
+    if (detail::stopped()) detail::raise_stop();
     uint32_t seen = h->progress.load(std::memory_order_acquire);
     if (ok()) return;
+    double abort_s = wait_abort_s();
     h->waiters.fetch_add(1, std::memory_order_acq_rel);
-    if (!ok()) futex_wait(&h->progress, seen);
+    if (!ok() && !detail::stopped())
+      // tick fast enough that a sub-second deadline actually fires
+      // sub-second (the waker may be dead and never bump the futex)
+      futex_wait(&h->progress, seen, abort_s > 0 ? abort_s / 4 : 2.0);
     h->waiters.fetch_sub(1, std::memory_order_acq_rel);
     if (ok()) return;
     double waited = now_s() - t0;
@@ -167,18 +183,18 @@ void wait_for(Hdr* h, Pred ok) {
                    "t4j shm arena: collective waiting > %.0fs for a peer "
                    "(slow rank or deadlock); still waiting — tune this "
                    "warning with T4J_SHM_WARN=<s>, or set "
-                   "T4J_SHM_TIMEOUT=<s> for fail-fast abort\n",
+                   "T4J_SHM_TIMEOUT=<s> for a fail-fast error\n",
                    wait_warn_s());
       std::fflush(stderr);
     }
-    double abort_s = wait_abort_s();
     if (abort_s > 0 && waited > abort_s) {
-      std::fprintf(stderr,
-                   "t4j shm arena: collective stalled > %.0fs "
-                   "(T4J_SHM_TIMEOUT); aborting job\n",
-                   abort_s);
-      std::fflush(stderr);
-      _exit(13);
+      char buf[160];
+      std::snprintf(buf, sizeof(buf),
+                    "shm arena collective made no progress for %.2fs "
+                    "(T4J_SHM_TIMEOUT/T4J_OP_TIMEOUT) — peer stalled "
+                    "or dead",
+                    waited);
+      detail::fail_op(buf);  // abort broadcast + fault + BridgeError
     }
   }
 }

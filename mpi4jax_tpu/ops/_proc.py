@@ -69,6 +69,11 @@ def _handle(comm):
     from mpi4jax_tpu.native import runtime
 
     runtime.ensure_initialized()
+    # fail fast once the bridge is faulted (a peer died, an op timed
+    # out, or an abort broadcast arrived): dispatching another op onto
+    # the dead transport would hang or abort, and the recorded fault
+    # message is strictly more useful than either
+    runtime.check_health()
     return np.int32(runtime.comm_handle(comm))
 
 

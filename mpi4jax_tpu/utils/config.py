@@ -12,11 +12,33 @@ xla_bridge/__init__.py:22) with the ``MPI4JAX_TPU_`` prefix:
 * ``MPI4JAX_TPU_NO_FENCE``     — drop optimization-barrier token fences
                                  (perf experiments only; ordering
                                  becomes UB)
+
+Robustness deadlines for the multi-process DCN bridge
+(docs/failure-semantics.md):
+
+* ``T4J_OP_TIMEOUT``      — per-call progress deadline in seconds for
+                            bridge sends/recvs/collectives; 0 (the
+                            default) waits forever, matching MPI.
+* ``T4J_CONNECT_TIMEOUT`` — bootstrap connect/accept deadline in
+                            seconds (default 30).
+
+Values are validated here and handed to the native bridge before init
+(native/runtime.py), so a typo'd deadline fails loudly at launch
+instead of silently running unbounded.
 """
 
+import math
 import os
 
-__all__ = ["truthy", "debug_enabled", "fences_enabled", "set_debug"]
+__all__ = [
+    "truthy",
+    "debug_enabled",
+    "fences_enabled",
+    "set_debug",
+    "seconds",
+    "op_timeout",
+    "connect_timeout",
+]
 
 _TRUE = {"1", "true", "on", "yes"}
 _FALSE = {"0", "false", "off", "no", ""}
@@ -55,3 +77,51 @@ def set_debug(enabled):
 
 def fences_enabled():
     return not truthy(os.environ.get("MPI4JAX_TPU_NO_FENCE"), default=False)
+
+
+def seconds(value, default, name="value", minimum=0.0):
+    """Parse an env-var duration in seconds.
+
+    ``None``/empty returns ``default``; anything that is not a finite
+    number >= ``minimum`` raises ``ValueError`` naming the variable —
+    a mistyped deadline must fail at launch, not silently disable the
+    deadline."""
+    if value is None or str(value).strip() == "":
+        return float(default)
+    try:
+        v = float(str(value).strip())
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"cannot interpret {name}={value!r} as seconds (want a number)"
+        )
+    if not math.isfinite(v):
+        raise ValueError(f"{name}={value!r} must be finite")
+    if v < minimum:
+        raise ValueError(f"{name}={value!r} must be >= {minimum}")
+    return v
+
+
+def op_timeout():
+    """Per-call progress deadline for DCN bridge ops, in seconds.
+
+    0 disables the deadline (wait forever — MPI's behaviour, and the
+    default: a slow peer compiling a large program is legal)."""
+    return seconds(
+        os.environ.get("T4J_OP_TIMEOUT"), 0.0, name="T4J_OP_TIMEOUT"
+    )
+
+
+def connect_timeout():
+    """Bootstrap connect/accept deadline in seconds (strictly positive;
+    default 30 — the old hardcoded 600 x 50ms retry loop)."""
+    v = seconds(
+        os.environ.get("T4J_CONNECT_TIMEOUT"),
+        30.0,
+        name="T4J_CONNECT_TIMEOUT",
+    )
+    if v <= 0:
+        raise ValueError(
+            "T4J_CONNECT_TIMEOUT must be > 0 (the bootstrap cannot wait "
+            "forever for a rank that never starts)"
+        )
+    return v

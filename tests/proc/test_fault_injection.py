@@ -1,0 +1,406 @@
+"""Fault-injection suite for the DCN bridge robustness layer.
+
+The acceptance contract (docs/failure-semantics.md): killing or
+stalling one rank mid-collective makes every SURVIVING rank raise a
+contextual error within the configured deadline — no hang, no silent
+process abort.  The failing rank is planted deterministically with the
+bridge's compiled-in fault hooks (T4J_FAULT_MODE=refuse|close_after|
+delay gated on T4J_FAULT_RANK), so the failure paths are exercised
+end-to-end: native detection -> fault posting -> abort broadcast ->
+Python exception.
+
+Ranks are mostly spawned DIRECTLY (hand-set T4J_* env, the contract
+documented in native/src/dcn.h) rather than through the launcher, so
+each survivor's own exit code and stderr can be asserted without the
+launcher's fail-fast terminate racing the observation.  The launcher's
+reporting gets its own tests at the bottom.
+"""
+
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import uuid
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401 -- probe only
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+pytestmark = pytest.mark.fault
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+# Exit codes the workers use to make assertions unambiguous.
+RAISED = 23  # the op raised as expected (marker line has the details)
+NO_RAISE = 3  # the op that must fail completed instead
+
+PREAMBLE = """
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.native import runtime
+
+runtime.ensure_initialized()
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+rank, size = comm.rank(), comm.size
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_world(tmp_path, body, nprocs, env_common=None, timeout=150,
+                 expect_hang=()):
+    """Spawn ``body`` across ``nprocs`` hand-wired ranks.
+
+    Returns a list of (returncode, stdout, stderr) per rank.  Ranks in
+    ``expect_hang`` are expected NOT to exit (e.g. the refuse-mode
+    rank): they are SIGKILLed after every other rank finished and get
+    returncode None.
+    """
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(body))
+    coord = f"127.0.0.1:{_free_port()}"
+    job = uuid.uuid4().hex[:12]
+    procs = []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(
+            T4J_RANK=str(rank), T4J_SIZE=str(nprocs), T4J_COORD=coord,
+            T4J_JOB=job,
+        )
+        env.update(env_common or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=env,
+                cwd=str(REPO),
+            )
+        )
+    results = [None] * nprocs
+    deadline = time.monotonic() + timeout
+    try:
+        for rank, p in enumerate(procs):
+            if rank in expect_hang:
+                continue
+            left = max(1.0, deadline - time.monotonic())
+            try:
+                out, err = p.communicate(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+                raise AssertionError(
+                    f"rank {rank} hung past {timeout}s (the robustness "
+                    f"layer exists to prevent exactly this)\n"
+                    f"--- stdout ---\n{out}\n--- stderr ---\n{err}"
+                )
+            results[rank] = (p.returncode, out, err)
+        for rank in expect_hang:
+            p = procs[rank]
+            p.kill()
+            out, err = p.communicate()
+            results[rank] = (None, out, err)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return results
+
+
+# --------------------------------------------------------------- dead peer
+
+
+def test_dead_peer_mid_collective(tmp_path):
+    """close_after: rank 1 abruptly closes every socket and dies after
+    12 frames.  Both survivors must raise a contextual BridgeError
+    (naming peer r1) instead of hanging in the collective."""
+    body = PREAMBLE + f"""
+x = jnp.ones((8,), jnp.float32)
+t0 = time.monotonic()
+try:
+    for i in range(200):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+    print("NO-RAISE", flush=True)
+    sys.exit({NO_RAISE})
+except Exception as e:
+    dt = time.monotonic() - t0
+    print(f"OP-RAISED after {{dt:.2f}}s: {{type(e).__name__}}: {{e}}",
+          flush=True)
+    sys.exit({RAISED})
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=3,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_FAULT_MODE": "close_after",
+            "T4J_FAULT_RANK": "1",
+            "T4J_FAULT_AFTER": "12",
+        },
+    )
+    rc1, _, err1 = res[1]
+    assert rc1 == 42, (rc1, err1[-2000:])  # the planted death
+    for rank in (0, 2):
+        rc, out, err = res[rank]
+        assert rc == RAISED, (rank, rc, out[-2000:], err[-2000:])
+        blob = out + err
+        assert "peer r1" in blob or "rank 1" in blob, (rank, blob[-2000:])
+
+
+# --------------------------------------------------------------- slow peer
+
+
+def test_slow_peer_trips_deadline(tmp_path):
+    """delay: rank 1 stalls 5s before every frame send once warmed up.
+    With a 0.5s op deadline (armed after warmup so first-call compile
+    skew cannot trip it), rank 0 must raise within the deadline order
+    of magnitude — not after the 5s stall, and never hang."""
+    body = PREAMBLE + f"""
+x = jnp.ones((8,), jnp.float32)
+for i in range(15):  # warmup: compiles + lockstep before the deadline
+    y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+    np.asarray(y)
+runtime.set_timeouts(op_s=0.5)
+t0 = time.monotonic()
+try:
+    for i in range(100):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+    print("NO-RAISE", flush=True)
+    sys.exit({NO_RAISE})
+except Exception as e:
+    dt = time.monotonic() - t0
+    print(f"OP-RAISED after {{dt:.2f}}s: {{type(e).__name__}}: {{e}}",
+          flush=True)
+    sys.exit({RAISED})
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=2,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_FAULT_MODE": "delay",
+            "T4J_FAULT_RANK": "1",
+            "T4J_FAULT_AFTER": "25",
+            "T4J_FAULT_DELAY_MS": "5000",
+        },
+    )
+    rc0, out0, err0 = res[0]
+    assert rc0 == RAISED, (rc0, out0[-2000:], err0[-2000:])
+    assert "T4J_OP_TIMEOUT" in out0 + err0, (out0 + err0)[-2000:]
+    # rank 0 raised on its own 0.5s deadline, not rank 1's 5s stall
+    dt = float(out0.split("OP-RAISED after ")[1].split("s:")[0])
+    assert dt < 4.0, f"survivor took {dt}s to notice a stalled peer"
+    # the stalled rank observes the abort broadcast once it wakes
+    rc1, out1, err1 = res[1]
+    assert rc1 == RAISED, (rc1, out1[-2000:], err1[-2000:])
+
+
+# ---------------------------------------------------------- connect failure
+
+
+def test_connect_failure_bounded(tmp_path):
+    """refuse: rank 1 never joins the bootstrap.  Rank 0's coordinator
+    accept must give up after T4J_CONNECT_TIMEOUT with an attributable
+    message instead of waiting forever."""
+    body = PREAMBLE + """
+print("SHOULD-NOT-INITIALIZE", flush=True)
+"""
+    t0 = time.monotonic()
+    res = _spawn_world(
+        tmp_path, body, nprocs=2,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_CONNECT_TIMEOUT": "2",
+            "T4J_FAULT_MODE": "refuse",
+            "T4J_FAULT_RANK": "1",
+        },
+        expect_hang=(1,),
+    )
+    elapsed = time.monotonic() - t0
+    rc0, out0, err0 = res[0]
+    assert rc0 not in (0, None), (rc0, out0[-1000:], err0[-2000:])
+    assert "SHOULD-NOT-INITIALIZE" not in out0
+    assert "T4J_CONNECT_TIMEOUT" in err0, err0[-2000:]
+    # 2s deadline + python/jax startup; nowhere near the old 30s loop
+    assert elapsed < 60, elapsed
+    _, _, err1 = res[1]
+    assert "refusing to join" in err1, err1[-2000:]
+
+
+# ------------------------------------------- mismatched send/recv (deadline)
+
+
+def test_mismatched_recv_times_out(tmp_path):
+    """A recv whose tag nobody sends must error within the deadline
+    (satellite: mismatched send/recv errors instead of hanging)."""
+    body = PREAMBLE + f"""
+x = jnp.ones((4,), jnp.float32)
+for i in range(5):  # warmup compiles both ranks' programs
+    y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+    np.asarray(y)
+if rank == 0:
+    tok = m.send(x, dest=1, tag=0, comm=comm)
+    time.sleep(8)  # stay alive: the timeout, not our EOF, must fire
+    sys.exit(0)
+runtime.set_timeouts(op_s=0.5)
+t0 = time.monotonic()
+try:
+    y, _ = m.recv(x, source=0, tag=7, comm=comm)
+    np.asarray(y)
+    print("NO-RAISE", flush=True)
+    sys.exit({NO_RAISE})
+except Exception as e:
+    dt = time.monotonic() - t0
+    print(f"OP-RAISED after {{dt:.2f}}s: {{type(e).__name__}}: {{e}}",
+          flush=True)
+    assert dt < 5.0, dt
+    sys.exit({RAISED})
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=2, env_common={"T4J_NO_SHM": "1"}
+    )
+    rc1, out1, err1 = res[1]
+    assert rc1 == RAISED, (rc1, out1[-2000:], err1[-2000:])
+    blob = out1 + err1
+    assert "T4J_OP_TIMEOUT" in blob, blob[-2000:]
+    assert "tag 7" in blob, blob[-2000:]
+
+
+def test_mismatched_recv_size_raises(tmp_path):
+    """A matched message of the wrong size raises immediately with
+    peer/tag/byte context (ranks disagreeing on shapes), instead of
+    aborting the process."""
+    body = PREAMBLE + f"""
+for i in range(5):
+    y, _ = m.allreduce(jnp.ones((4,), jnp.float32), op=m.SUM, comm=comm)
+    np.asarray(y)
+if rank == 0:
+    tok = m.send(jnp.ones((4,), jnp.float32), dest=1, tag=0, comm=comm)
+    time.sleep(3)
+    sys.exit(0)
+try:
+    y, _ = m.recv(jnp.ones((8,), jnp.float32), source=0, tag=0, comm=comm)
+    np.asarray(y)
+    print("NO-RAISE", flush=True)
+    sys.exit({NO_RAISE})
+except Exception as e:
+    print(f"OP-RAISED: {{type(e).__name__}}: {{e}}", flush=True)
+    sys.exit({RAISED})
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=2, env_common={"T4J_NO_SHM": "1"}
+    )
+    rc1, out1, err1 = res[1]
+    assert rc1 == RAISED, (rc1, out1[-2000:], err1[-2000:])
+    blob = out1 + err1
+    assert "size mismatch" in blob, blob[-2000:]
+    assert "32" in blob and "16" in blob, blob[-2000:]  # expected/got bytes
+
+
+# ------------------------------------------------------- launcher reporting
+
+
+def _launch(tmp_path, body, nprocs=2, launch_args=(), timeout=150):
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent(body))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    popen = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi4jax_tpu.launch",
+            "-np", str(nprocs), *launch_args, str(script),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=str(REPO),
+        start_new_session=True,
+    )
+    try:
+        out, err = popen.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(popen.pid), signal.SIGKILL)
+        out, err = popen.communicate()
+        raise AssertionError(f"launcher hung\n{out}\n{err}")
+    return popen.returncode, out, err
+
+
+FAIL_JOB = PREAMBLE + """
+x = jnp.ones((4,), jnp.float32)
+for i in range(5):
+    y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+    np.asarray(y)
+if rank == 1:
+    {death}
+try:
+    for i in range(200):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+except Exception:
+    time.sleep(2)  # let the launcher observe rank 1's exit first
+    sys.exit(5)
+"""
+
+
+def test_launcher_reports_first_failure_exit_code(tmp_path):
+    rc, out, err = _launch(
+        tmp_path, FAIL_JOB.format(death="os._exit(17)")
+    )
+    assert rc == 17, (rc, out[-1000:], err[-2000:])
+    assert "rank 1" in err and "first failure" in err, err[-2000:]
+    assert "exited with code 17" in err, err[-2000:]
+
+
+def test_launcher_reports_signal_kill_distinctly(tmp_path):
+    rc, out, err = _launch(
+        tmp_path,
+        FAIL_JOB.format(death="os.kill(os.getpid(), 9)"),
+    )
+    # shell convention: signal-killed jobs exit 128 + signum
+    assert rc == 137, (rc, out[-1000:], err[-2000:])
+    assert "killed by SIGKILL" in err and "signal 9" in err, err[-2000:]
+    assert "first failure" in err, err[-2000:]
+
+
+def test_launcher_job_deadline(tmp_path):
+    body = """
+import time
+time.sleep(300)
+"""
+    t0 = time.monotonic()
+    rc, out, err = _launch(
+        tmp_path, body, launch_args=("--timeout", "5")
+    )
+    assert rc == 124, (rc, out[-1000:], err[-2000:])
+    assert "job deadline" in err, err[-2000:]
+    assert time.monotonic() - t0 < 120

@@ -1,0 +1,100 @@
+"""Timeout config plumbing (utils/config.py).
+
+The robustness layer's deadlines (docs/failure-semantics.md) are
+validated in Python before the native bridge ever sees them: a typo'd
+T4J_OP_TIMEOUT must fail at launch, not silently run unbounded.
+"""
+
+import pytest
+
+try:
+    from mpi4jax_tpu.utils import config
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+
+class TestSecondsParser:
+    def test_none_returns_default(self):
+        assert config.seconds(None, 12.5) == 12.5
+
+    def test_empty_returns_default(self):
+        assert config.seconds("", 3.0) == 3.0
+        assert config.seconds("   ", 3.0) == 3.0
+
+    def test_parses_numbers(self):
+        assert config.seconds("0.25", 1.0) == 0.25
+        assert config.seconds(" 30 ", 1.0) == 30.0
+        assert config.seconds("0", 1.0) == 0.0
+        assert config.seconds(5, 1.0) == 5.0
+
+    @pytest.mark.parametrize("bad", ["soon", "1s", "0x10", "1,5"])
+    def test_rejects_garbage(self, bad):
+        with pytest.raises(ValueError, match="T4J_TEST"):
+            config.seconds(bad, 1.0, name="T4J_TEST")
+
+    @pytest.mark.parametrize("bad", ["nan", "inf", "-inf"])
+    def test_rejects_non_finite(self, bad):
+        with pytest.raises(ValueError):
+            config.seconds(bad, 1.0, name="T4J_TEST")
+
+    def test_rejects_below_minimum(self):
+        with pytest.raises(ValueError, match=">= 0"):
+            config.seconds("-1", 1.0, name="T4J_TEST")
+
+
+class TestOpTimeout:
+    def test_default_is_disabled(self, monkeypatch):
+        monkeypatch.delenv("T4J_OP_TIMEOUT", raising=False)
+        assert config.op_timeout() == 0.0
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_OP_TIMEOUT", "0.5")
+        assert config.op_timeout() == 0.5
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_OP_TIMEOUT", "fast")
+        with pytest.raises(ValueError, match="T4J_OP_TIMEOUT"):
+            config.op_timeout()
+
+    def test_negative_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_OP_TIMEOUT", "-3")
+        with pytest.raises(ValueError, match="T4J_OP_TIMEOUT"):
+            config.op_timeout()
+
+
+class TestConnectTimeout:
+    def test_default(self, monkeypatch):
+        monkeypatch.delenv("T4J_CONNECT_TIMEOUT", raising=False)
+        assert config.connect_timeout() == 30.0
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_CONNECT_TIMEOUT", "1.5")
+        assert config.connect_timeout() == 1.5
+
+    def test_zero_rejected(self, monkeypatch):
+        # the bootstrap cannot wait forever for a rank that never starts
+        monkeypatch.setenv("T4J_CONNECT_TIMEOUT", "0")
+        with pytest.raises(ValueError, match="T4J_CONNECT_TIMEOUT"):
+            config.connect_timeout()
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_CONNECT_TIMEOUT", "never")
+        with pytest.raises(ValueError, match="T4J_CONNECT_TIMEOUT"):
+            config.connect_timeout()
+
+
+def test_ensure_initialized_rejects_bad_deadline(monkeypatch):
+    """The validation is threaded through native/runtime.py: a bad env
+    value aborts initialisation before any socket is opened."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_OP_TIMEOUT", "not-a-number")
+    with pytest.raises(ValueError, match="T4J_OP_TIMEOUT"):
+        runtime.ensure_initialized()
