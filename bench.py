@@ -276,18 +276,23 @@ def transformer_large_mfu(fallback_record, timeout=1200):
     )
 
 
-def _metric_subprocess(argv, metric, timeout, label):
+def _metric_subprocess(argv, metric, timeout, label, env=None):
     """Run a benchmark subprocess and return its JSON record whose
     ``metric`` key matches — the shared scaffold for every out-of-
     process bench leg (guarded: any failure returns None and the main
-    record still emits)."""
+    record still emits).  ``env`` overlays os.environ for the child."""
+    import os
     import pathlib
     import subprocess
 
     try:
+        full_env = None
+        if env:
+            full_env = dict(os.environ)
+            full_env.update(env)
         out = subprocess.run(
             argv, capture_output=True, text=True, timeout=timeout,
-            cwd=str(pathlib.Path(__file__).parent),
+            cwd=str(pathlib.Path(__file__).parent), env=full_env,
         )
         for line in out.stdout.splitlines():
             try:
@@ -337,6 +342,34 @@ def proc_busbw(timeout=600):
         ],
         "allreduce_busbw_proc8", timeout, "proc busbw",
     )
+
+
+def proc_tcp_busbw(timeout=900):
+    """TCP-tier allreduce busbw, ring vs tree (PR 2's tentpole,
+    docs/performance.md "TCP-tier algorithm selection"): 8 launcher
+    processes with the shm arena disabled so the payload rides the
+    wire algorithms, 64 MB — well above T4J_RING_MIN_BYTES.  Returns
+    (ring_record, tree_record); either may be None."""
+    import pathlib
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
+    argv = [
+        sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+        str(script), "--mb", "64", "--reps", "5",
+    ]
+    # pin the switchover in BOTH legs: an ambient T4J_RING_MIN_BYTES in
+    # the caller's shell would otherwise make the "ring" record a
+    # silent tree measurement (0 = always ring; 64 MB is far above the
+    # default threshold anyway, so the number equals the default path)
+    ring = _metric_subprocess(
+        argv, "allreduce_busbw_proc8", timeout, "proc TCP ring busbw",
+        env={"T4J_NO_SHM": "1", "T4J_RING_MIN_BYTES": "0"},
+    )
+    tree = _metric_subprocess(
+        argv, "allreduce_busbw_proc8", timeout, "proc TCP tree busbw",
+        env={"T4J_NO_SHM": "1", "T4J_RING_MIN_BYTES": "1099511627776"},
+    )
+    return ring, tree
 
 
 def main():
@@ -606,6 +639,18 @@ def main():
         ):
             if src_key in procrec:
                 extras[dst_key] = procrec[src_key]
+    ring_rec, tree_rec = proc_tcp_busbw()  # subprocess jobs: own timeouts
+    if ring_rec is not None:
+        # the TCP tier proper (T4J_NO_SHM=1): segmented ring allreduce
+        # vs the pre-PR2 tree path on the same 64 MB payload — the
+        # first entries of the tree->ring BENCH trajectory
+        extras["allreduce_busbw_proc8_tcp_ring_gbps"] = ring_rec["value"]
+    if tree_rec is not None:
+        extras["allreduce_busbw_proc8_tcp_tree_gbps"] = tree_rec["value"]
+    if ring_rec and tree_rec and tree_rec["value"]:
+        extras["proc8_tcp_ring_vs_tree_ratio"] = round(
+            ring_rec["value"] / tree_rec["value"], 2
+        )
 
     try:
         extras["transformer_train_tokens_per_sec_bf16"] = (

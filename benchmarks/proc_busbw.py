@@ -8,10 +8,16 @@ where libmpi's shm BTL moves intra-host traffic through shared memory
 calls MPI_Allreduce).  Run under the launcher:
 
     python -m mpi4jax_tpu.launch -np 8 benchmarks/proc_busbw.py \
-        [--mb 64] [--reps 10] [--op allreduce]
+        [--mb 64] [--reps 10] [--op allreduce] [--sweep]
 
 Rank 0 prints one JSON line: NCCL-convention bus bandwidth
-(``bytes * 2*(n-1)/n / t`` for allreduce).
+(``bytes * 2*(n-1)/n / t`` for allreduce).  ``--sweep`` prints one
+JSON line per payload size from 1 KB up to ``--mb``, covering both
+sides of the tree->ring switchover (``T4J_RING_MIN_BYTES``, see
+docs/performance.md "TCP-tier algorithm selection").  To measure the
+TCP tier on one host, disable the same-host shm arena with
+``T4J_NO_SHM=1`` — otherwise collectives ride shared memory and never
+touch the wire algorithms.
 """
 
 import argparse
@@ -42,7 +48,13 @@ def main():
     ap.add_argument("--mb", type=float, default=64.0)
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--op", default="allreduce",
-                    choices=["allreduce", "allgather", "alltoall"])
+                    choices=["allreduce", "allgather", "alltoall",
+                             "reduce_scatter"])
+    ap.add_argument(
+        "--sweep", action="store_true",
+        help="one JSON line per payload size, 1 KB -> --mb in x4 steps: "
+        "the tree->ring switchover trajectory for BENCH records",
+    )
     ap.add_argument(
         "--copy-gauntlet", action="store_true",
         help="measure the aggregate plain-memcpy rate of N timesharing "
@@ -65,8 +77,6 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-    import numpy as np
 
     import mpi4jax_tpu as m
 
@@ -75,54 +85,22 @@ def main():
     n = comm.size
     rank = comm.rank()
 
-    per = int(args.mb * 1e6 / 4)
-    per -= per % max(n, 1)
-    x = jnp.ones((per,), jnp.float32)
-    nbytes = per * 4
+    if args.sweep:
+        # 1 KB -> --mb in x4 steps, straddling T4J_RING_MIN_BYTES so
+        # the records show both the tree and ring sides per op
+        sizes_mb, s = [], 1024.0 / 1e6
+        while s < args.mb:
+            sizes_mb.append(s)
+            s *= 4
+        sizes_mb.append(float(args.mb))
+        for mb in sizes_mb:
+            rec, _bw, _tok = _measure(args, comm, mb)
+            if rank == 0:
+                print(json.dumps(rec), flush=True)
+        return
 
-    def call(v, tok):
-        if args.op == "allreduce":
-            return m.allreduce(v, m.SUM, comm=comm, token=tok)
-        if args.op == "allgather":
-            y, tok = m.allgather(v, comm=comm, token=tok)
-            return y[0], tok
-        blk = v.reshape(n, -1)
-        y, tok = m.alltoall(blk, comm=comm, token=tok)
-        return y.reshape(v.shape), tok
-
-    # warm (compile + first-touch of transport buffers)
-    tok = m.create_token()
-    y, tok = call(x, tok)
-    np.asarray(y)
-
-    best = float("inf")
-    for _ in range(3):
-        tok = _fence(comm, tok)
-        t0 = time.perf_counter()
-        for _ in range(args.reps):
-            y, tok = call(x, tok)
-        np.asarray(y)  # materialise: all reps done
-        dt = (time.perf_counter() - t0) / args.reps
-        best = min(best, dt)
-
-    # NCCL-tests algorithmic factors relative to the PER-RANK payload:
-    # allgather receives n-1 peer blocks per rank, so its busbw is
-    # send_bytes*(n-1)/t; alltoall ships (n-1)/n of the send buffer
-    factor = {
-        "allreduce": 2 * (n - 1) / n,
-        "allgather": float(n - 1),
-        "alltoall": (n - 1) / n,
-    }[args.op]
-    busbw = nbytes * factor / best
-
-    rec = {
-        "metric": f"{args.op}_busbw_proc{n}",
-        "value": round(busbw / 1e9, 3),
-        "unit": "GB/s",
-        "nprocs": n,
-        "payload_mb": nbytes / 1e6,
-        "sec_per_call": round(best, 6),
-    }
+    rec, busbw, tok = _measure(args, comm, args.mb)
+    factor = _busbw_factor(args.op, n)
     if args.op == "allreduce":
         # In-run machine-relative ceiling (the same calibration pattern
         # as bench.py's HBM probe): the shm arena must move
@@ -164,6 +142,97 @@ def main():
             )
     if rank == 0:
         print(json.dumps(rec), flush=True)
+
+
+def _busbw_factor(op, n):
+    """NCCL-tests algorithmic factors relative to the PER-RANK payload
+    buffer: allgather receives n-1 peer blocks per rank, so its busbw
+    is send_bytes*(n-1)/t; alltoall and reduce_scatter ship (n-1)/n of
+    the local buffer."""
+    return {
+        "allreduce": 2 * (n - 1) / n,
+        "allgather": float(n - 1),
+        "alltoall": (n - 1) / n,
+        "reduce_scatter": (n - 1) / n,
+    }[op]
+
+
+def _measure(args, comm, mb):
+    """Time ``args.op`` at one payload size.
+
+    Returns ``(record, busbw, token)`` — ``busbw`` is the unrounded
+    bytes/s figure (the record's ``value`` is rounded for display; the
+    ceiling percentages must divide the exact measurement)."""
+    import os
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.utils import config
+
+    n = comm.size
+    per = max(int(mb * 1e6 / 4), n)
+    per -= per % max(n, 1)
+    x = jnp.ones((per,), jnp.float32)
+    nbytes = per * 4
+
+    def call(v, tok):
+        if args.op == "allreduce":
+            return m.allreduce(v, m.SUM, comm=comm, token=tok)
+        if args.op == "allgather":
+            y, tok = m.allgather(v, comm=comm, token=tok)
+            return y[0], tok
+        if args.op == "reduce_scatter":
+            return m.reduce_scatter(v.reshape(n, -1), m.SUM, comm=comm,
+                                    token=tok)
+        blk = v.reshape(n, -1)
+        y, tok = m.alltoall(blk, comm=comm, token=tok)
+        return y.reshape(v.shape), tok
+
+    # warm (compile + first-touch of transport buffers)
+    tok = m.create_token()
+    y, tok = call(x, tok)
+    np.asarray(y)
+
+    best = float("inf")
+    for _ in range(3):
+        tok = _fence(comm, tok)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            y, tok = call(x, tok)
+        np.asarray(y)  # materialise: all reps done
+        dt = (time.perf_counter() - t0) / args.reps
+        best = min(best, dt)
+
+    busbw = nbytes * _busbw_factor(args.op, n) / best
+
+    # Which data plane served this size — without it, rows from the shm
+    # arena, the TCP ring and the TCP trees are indistinguishable in
+    # the trajectory.  Total message size per op mirrors the native
+    # switchover predicate (dcn.cc use_ring).
+    if os.environ.get("T4J_NO_SHM", "").strip() not in ("", "0"):
+        if args.op == "alltoall":
+            algo = "pairwise"
+        else:
+            total = nbytes * n if args.op == "allgather" else nbytes
+            algo = "ring" if total >= config.ring_min_bytes() else "tree"
+    else:
+        algo = "shm"
+
+    rec = {
+        "metric": f"{args.op}_busbw_proc{n}",
+        "value": round(busbw / 1e9, 3),
+        "unit": "GB/s",
+        "nprocs": n,
+        "payload_mb": nbytes / 1e6,
+        "payload_bytes": nbytes,
+        "sec_per_call": round(best, 6),
+        "data_plane": algo,
+        "ring_min_bytes": config.ring_min_bytes(),
+        "seg_bytes": config.seg_bytes(),
+    }
+    return rec, busbw, tok
 
 
 def _gauntlet_rate_gbps(comm, tok, mb=16, reps=4):

@@ -158,6 +158,15 @@ def run(
     (``mode``: "dense", "moe", or "pp"); returns the JSON-ready record
     dict.  Importable so ``bench.py`` can run it in-process (a second
     process cannot share the TPU chip)."""
+    if ce_chunk and mode != "dense":
+        # same contract as main()'s CLI guard, enforced for in-process
+        # callers (bench.py sweeps): only the dense TransformerConfig
+        # threads ce_chunk — a silent fallback to streaming CE would
+        # mislabel the benchmark record
+        raise ValueError(
+            f"ce_chunk is dense-mode only (got mode={mode!r})"
+        )
+
     import jax
     import jax.numpy as jnp
 
@@ -319,8 +328,18 @@ def run(
         "model_tflops_per_sec": round(model_tflops, 2),
         "model_tflops_incl_attn": round(incl_attn_tflops, 2),
         # the knobs the sweeps vary — without them, rows differing only
-        # by remat policy / loss chunking emit indistinguishable records
-        "remat": list(remat) if isinstance(remat, (tuple, list)) else remat,
+        # by remat policy / loss chunking emit indistinguishable records.
+        # dense-mode only, mirroring the ce_chunk guard: moe/pp ignore
+        # the remat lever, and an always-present key mislabels their rows
+        **(
+            {
+                "remat": list(remat)
+                if isinstance(remat, (tuple, list))
+                else remat
+            }
+            if mode == "dense"
+            else {}
+        ),
         **({"ce_chunk": ce_chunk} if ce_chunk else {}),
     }
     # MFU against the chip's dense-bf16 peak, in both conventions: the

@@ -25,6 +25,7 @@ __all__ = [
     "notify_abort",
     "last_error",
     "set_timeouts",
+    "set_tuning",
     "BridgeError",
     "HANDLER_NAMES",
 ]
@@ -39,6 +40,7 @@ class BridgeError(RuntimeError):
 HANDLER_NAMES = [
     "t4j_allreduce",
     "t4j_reduce",
+    "t4j_reduce_scatter",
     "t4j_scan",
     "t4j_send",
     "t4j_recv",
@@ -80,6 +82,7 @@ def _load():
     lib.t4j_health.restype = ctypes.c_int
     lib.t4j_fault_msg.restype = ctypes.c_char_p
     lib.t4j_set_timeouts.argtypes = [ctypes.c_double, ctypes.c_double]
+    lib.t4j_set_tuning.argtypes = [ctypes.c_int64, ctypes.c_int64]
     lib.t4j_abort_notify.argtypes = [ctypes.c_char_p]
     # data plane for the host-callback tier (TPU staging path); every
     # call returns a status: 0 ok, nonzero = failed with t4j_last_error
@@ -94,6 +97,7 @@ def _load():
     lib.t4j_c_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
     lib.t4j_c_reduce.argtypes = [i32, vp, vp, u64, i32, i32, i32]
     lib.t4j_c_scan.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_c_reduce_scatter.argtypes = [i32, vp, vp, u64, i32, i32]
     lib.t4j_c_allgather.argtypes = [i32, vp, vp, u64]
     lib.t4j_c_gather.argtypes = [i32, vp, vp, u64, i32]
     lib.t4j_c_scatter.argtypes = [i32, vp, vp, u64, i32]
@@ -101,8 +105,8 @@ def _load():
     for name in (
         "t4j_c_send", "t4j_c_recv", "t4j_c_sendrecv", "t4j_c_barrier",
         "t4j_c_bcast", "t4j_c_allreduce", "t4j_c_reduce", "t4j_c_scan",
-        "t4j_c_allgather", "t4j_c_gather", "t4j_c_scatter",
-        "t4j_c_alltoall",
+        "t4j_c_reduce_scatter", "t4j_c_allgather", "t4j_c_gather",
+        "t4j_c_scatter", "t4j_c_alltoall",
     ):
         getattr(lib, name).restype = ctypes.c_int32
     _state["lib"] = lib
@@ -149,6 +153,21 @@ def notify_abort(why):
     lib = _state["lib"]
     if lib is not None and lib.t4j_initialized():
         lib.t4j_abort_notify(str(why).encode("utf-8", "replace"))
+
+
+def set_tuning(ring_min_bytes=None, seg_bytes=None):
+    """Runtime override of the TCP-tier collective tuning, in bytes.
+
+    ``None`` keeps the current value; ``ring_min_bytes=0`` forces the
+    segmented ring path for every message size.  Must be set uniformly
+    across ranks (the launcher propagates ``T4J_RING_MIN_BYTES`` /
+    ``T4J_SEG_BYTES``): ranks disagreeing on the switchover would run
+    mismatched algorithms and deadlock."""
+    lib = _load()
+    lib.t4j_set_tuning(
+        -1 if ring_min_bytes is None else int(ring_min_bytes),
+        0 if seg_bytes is None else int(seg_bytes),
+    )
 
 
 def set_timeouts(op_s=None, connect_s=None):
@@ -228,6 +247,19 @@ def host_reduce(handle, x, opcode, root):
     ))
     if _state["lib"].t4j_comm_rank(handle) != root:
         return x  # off-root output is the input passthrough (wrapper contract)
+    return out
+
+
+def host_reduce_scatter(handle, x, opcode):
+    """``x`` has shape ``(comm_size, *rest)``; returns the reduction of
+    row ``rank`` (MPI_Reduce_scatter_block over the segmented ring)."""
+    import numpy as np
+
+    x = _contig(x)
+    out = np.empty(x.shape[1:], x.dtype)
+    _check(_state["lib"].t4j_c_reduce_scatter(
+        handle, _ptr(x), _ptr(out), out.size, dtype_code(x.dtype), opcode
+    ))
     return out
 
 
@@ -372,8 +404,10 @@ def ensure_initialized():
     from mpi4jax_tpu.utils import config
 
     op_s, connect_s = config.op_timeout(), config.connect_timeout()
+    ring_min, seg = config.ring_min_bytes(), config.seg_bytes()
     lib = _load()
     lib.t4j_set_timeouts(op_s, connect_s)
+    lib.t4j_set_tuning(ring_min, seg)
     rc = lib.t4j_init()
     if rc != 0:
         detail = last_error()

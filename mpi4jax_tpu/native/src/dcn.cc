@@ -14,6 +14,7 @@
 
 #include <cerrno>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <complex>
@@ -98,6 +99,60 @@ double connect_timeout() {
     v = env_seconds("T4J_CONNECT_TIMEOUT", 30.0);
     if (v <= 0) v = 30.0;
     g_connect_timeout_s.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+// ------------------------------------------------- data-plane tuning
+//
+// Ring-vs-tree switchover and segment size for the TCP-tier
+// collectives (docs/performance.md "TCP-tier algorithm selection").
+// Python (native/runtime.py) validates via utils/config.py and calls
+// set_tuning before init; the env parse is the fallback for hand-run
+// processes.  -1 = "not set yet".
+
+std::atomic<long long> g_ring_min_bytes{-1};
+std::atomic<long long> g_seg_bytes{-1};
+
+// Measured crossover on the 8-proc loopback sweep (docs/performance.md
+// "TCP-tier algorithm selection"): trees win below ~256 KB (the ring
+// pays 2(n-1) serialized step latencies), ring wins 2-3x from 1 MB up.
+constexpr long long kDefaultRingMinBytes = 256 << 10;  // 256 KiB
+constexpr long long kDefaultSegBytes = 1 << 20;       // 1 MiB
+
+long long env_bytes(const char* name, long long dflt) {
+  const char* s = std::getenv(name);
+  if (!s || !s[0]) return dflt;
+  char* end = nullptr;
+  long long v = std::strtoll(s, &end, 10);
+  if (end == s || v < 0) return dflt;  // Python layer rejects loudly
+  // optional K/M/G suffix; anything else trailing ("0x40", "256KB")
+  // falls back to the default rather than misparsing — the Python
+  // layer (utils/config.py byte_count) is the loud validator
+  while (*end == ' ') ++end;
+  if (*end == 'k' || *end == 'K') { v <<= 10; ++end; }
+  else if (*end == 'm' || *end == 'M') { v <<= 20; ++end; }
+  else if (*end == 'g' || *end == 'G') { v <<= 30; ++end; }
+  while (*end == ' ') ++end;
+  if (*end != '\0') return dflt;
+  return v;
+}
+
+long long ring_min_bytes() {
+  long long v = g_ring_min_bytes.load(std::memory_order_relaxed);
+  if (v < 0) {
+    v = env_bytes("T4J_RING_MIN_BYTES", kDefaultRingMinBytes);
+    g_ring_min_bytes.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+long long seg_bytes() {
+  long long v = g_seg_bytes.load(std::memory_order_relaxed);
+  if (v < 1) {
+    v = env_bytes("T4J_SEG_BYTES", kDefaultSegBytes);
+    if (v < 1) v = kDefaultSegBytes;
+    g_seg_bytes.store(v, std::memory_order_relaxed);
   }
   return v;
 }
@@ -1216,6 +1271,12 @@ struct Comm {
   // same-host shm collective arena (lazy; nullptr = use TCP algorithms)
   shm::Arena* arena = nullptr;
   bool arena_checked = false;
+  // gather-instance counter: every member advances it in lockstep (one
+  // per gather call), tagging each instance uniquely so the root can
+  // receive ANY_SOURCE without a run-ahead rank's next-gather frame
+  // being mistaken for this one.  Only the collective-calling thread
+  // touches it (MPI serialises collectives per comm).
+  uint32_t gather_seq = 0;
 };
 
 std::mutex g_comm_mu;
@@ -1549,6 +1610,357 @@ Frame crecv(Comm& c, int src_idx, int tag, bool coll = true) {
           ") — ranks disagree on shapes or dtypes");
 }
 
+// ------------------------------------------------------------ ring engine
+//
+// Bandwidth-optimal segmented ring collectives for the TCP tier.  The
+// trees (binomial reduce+bcast, root-funnel gather+bcast) move the
+// FULL payload across a link once per level — ~2*ceil(log2 n)*S wire
+// bytes per allreduce of S bytes.  The ring schedule (NCCL/Horovod)
+// moves 2*S*(n-1)/n: reduce-scatter walks each block once around the
+// ring accumulating, allgather walks the reduced blocks once more.
+// Messages below T4J_RING_MIN_BYTES keep the trees (fewer rounds wins
+// when latency, not bandwidth, dominates).
+//
+// Transfers are segmented at T4J_SEG_BYTES: the combine of segment k
+// runs while the reader thread is already pulling segment k+1 off the
+// socket, instead of buffering the whole block as one Frame before any
+// arithmetic starts.  Every segment send/recv goes through the normal
+// csend/crecv path, so the per-op deadline, fault fail-fast and abort
+// broadcast of docs/failure-semantics.md apply per segment — a peer
+// dying mid-ring surfaces as the usual contextual BridgeError.
+
+constexpr int kTagRingRS = kCollTagBase + 14;
+constexpr int kTagRingAG = kCollTagBase + 15;
+
+// Gather-instance tag window (see Comm::gather_seq): 64Ki consecutive
+// gather calls get distinct tags.  After a wrap, FIFO matching per
+// (src, ctx, tag) still pairs the oldest outstanding frame with the
+// oldest outstanding recv, so correctness never depends on the window.
+constexpr int kTagGatherSeqBase = kCollTagBase + (1 << 16);
+
+int ring_mod(int a, int n) {
+  int r = a % n;
+  return r < 0 ? r + n : r;
+}
+
+// Partition of `count` elements over n ranks (allreduce blocks): the
+// first count%n blocks carry one extra element, so any count — not
+// divisible by n included — rides the ring without padding.
+struct BlockPart {
+  size_t base, extra;
+  BlockPart(size_t count, int n)
+      : base(count / static_cast<size_t>(n)),
+        extra(count % static_cast<size_t>(n)) {}
+  size_t off(int b) const {
+    size_t ub = static_cast<size_t>(b);
+    return ub * base + (ub < extra ? ub : extra);
+  }
+  size_t len(int b) const {
+    return base + (static_cast<size_t>(b) < extra ? 1 : 0);
+  }
+};
+
+// Effective segment size in bytes for elements of size dsize: at least
+// one element, rounded down to a whole number of elements so every
+// segment can be combined independently.
+size_t seg_for(size_t dsize) {
+  size_t seg = static_cast<size_t>(seg_bytes());
+  size_t elems = seg / dsize;
+  return (elems < 1 ? 1 : elems) * dsize;
+}
+
+void send_segmented(Comm& c, int dest_idx, int tag, const uint8_t* p,
+                    size_t nbytes, size_t seg) {
+  for (size_t o = 0; o < nbytes; o += seg) {
+    size_t k = nbytes - o < seg ? nbytes - o : seg;
+    csend(c, dest_idx, tag, p + o, k);
+  }
+}
+
+template <typename T>
+void add_into(const void* a, const void* b, void* out, size_t n) {
+  const T* pa = static_cast<const T*>(a);
+  const T* pb = static_cast<const T*>(b);
+  T* po = static_cast<T*>(out);
+  for (size_t i = 0; i < n; ++i) po[i] = pa[i] + pb[i];
+}
+
+// Fused out = local + received for the hot SUM dtypes: the generic
+// path (memcpy local into acc, then combine received into acc) pays an
+// extra read+write pass over every byte, and the reduce-scatter inner
+// loop is memory-bound on loopback.  Operand order matches
+// combine_typed (acc=local, contrib=received), so results stay
+// bit-identical to the unfused path.  Returns false when the caller
+// must fall back.
+bool combine_fused(ReduceOp op, DType dt, const void* local,
+                   const void* received, void* out, size_t count) {
+  if (op != ReduceOp::kSum) return false;
+  switch (dt) {
+    case DType::kF32:
+      add_into<float>(local, received, out, count);
+      return true;
+    case DType::kF64:
+      add_into<double>(local, received, out, count);
+      return true;
+    case DType::kI32:
+      add_into<int32_t>(local, received, out, count);
+      return true;
+    case DType::kI64:
+      add_into<int64_t>(local, received, out, count);
+      return true;
+    case DType::kU32:
+      add_into<uint32_t>(local, received, out, count);
+      return true;
+    case DType::kU64:
+      add_into<uint64_t>(local, received, out, count);
+      return true;
+    default:
+      return false;
+  }
+}
+
+// Receive a block as segments, folding each with the local
+// contribution (`local`, same length) into `acc` as it lands: the fold
+// of segment k overlaps the wire transfer of segment k+1, and the
+// just-touched segment stays cache-hot between init and combine.
+void recv_combine_segmented(Comm& c, int src_idx, int tag,
+                            const uint8_t* local, uint8_t* acc,
+                            size_t nbytes, size_t seg, DType dt,
+                            ReduceOp op) {
+  size_t dsize = dtype_size(dt);
+  for (size_t o = 0; o < nbytes; o += seg) {
+    size_t k = nbytes - o < seg ? nbytes - o : seg;
+    Frame f = crecv(c, src_idx, tag);
+    if (f.data.size() != k) fail_size(f, k);
+    if (!combine_fused(op, dt, local + o, f.data.data(), acc + o,
+                       k / dsize)) {
+      std::memcpy(acc + o, local + o, k);
+      combine(op, dt, f.data.data(), acc + o, k / dsize);
+    }
+  }
+}
+
+void recv_copy_segmented(Comm& c, int src_idx, int tag, uint8_t* dst,
+                         size_t nbytes, size_t seg) {
+  for (size_t o = 0; o < nbytes; o += seg) {
+    size_t k = nbytes - o < seg ? nbytes - o : seg;
+    Frame f = crecv(c, src_idx, tag);
+    if (f.data.size() != k) fail_size(f, k);
+    std::memcpy(dst + o, f.data.data(), k);
+  }
+}
+
+// Ring reduce-scatter: block b starts accumulating at rank b+1 and
+// travels the ring once, so rank r ends holding block r fully reduced
+// in `out_block`.  Step s (0..n-2): send the partial of block r-1-s to
+// the right, receive block r-2-s from the left and combine it with the
+// local contribution.  `in` is the caller's untouched input; scratch
+// is two blocks (the partial being sent and the one being built), not
+// a full-message copy.  off/len are byte offsets/lengths per block;
+// zero-length blocks (count < n) simply move no frames.
+void ring_reduce_scatter(Comm& c, const uint8_t* in, uint8_t* out_block,
+                         const std::vector<size_t>& off,
+                         const std::vector<size_t>& len, DType dt,
+                         ReduceOp op) {
+  int n = static_cast<int>(c.ranks.size());
+  int me = c.my_index;
+  int right = ring_mod(me + 1, n), left = ring_mod(me - 1, n);
+  size_t seg = seg_for(dtype_size(dt));
+  size_t maxlen = 0;
+  for (size_t l : len) maxlen = maxlen < l ? l : maxlen;
+  Buf scratch_a(maxlen), scratch_b(maxlen);
+  uint8_t* building = scratch_a.data();
+  uint8_t* sending = scratch_b.data();  // partial built the step before
+  for (int s = 0; s < n - 1; ++s) {
+    int sb = ring_mod(me - 1 - s, n);
+    int rb = ring_mod(me - 2 - s, n);
+    send_segmented(c, right, kTagRingRS,
+                   s == 0 ? in + off[sb] : sending, len[sb], seg);
+    uint8_t* acc = s == n - 2 ? out_block : building;
+    recv_combine_segmented(c, left, kTagRingRS, in + off[rb], acc,
+                           len[rb], seg, dt, op);
+    std::swap(building, sending);
+  }
+}
+
+// Ring allgather: on entry block `me` of `buf` is valid; each block
+// then travels the ring once.  Step s: send block r-s right, receive
+// block r-1-s from the left.
+void ring_allgather(Comm& c, uint8_t* buf, const std::vector<size_t>& off,
+                    const std::vector<size_t>& len) {
+  int n = static_cast<int>(c.ranks.size());
+  int me = c.my_index;
+  int right = ring_mod(me + 1, n), left = ring_mod(me - 1, n);
+  size_t seg = seg_for(1);
+  for (int s = 0; s < n - 1; ++s) {
+    int sb = ring_mod(me - s, n);
+    int rb = ring_mod(me - 1 - s, n);
+    send_segmented(c, right, kTagRingAG, buf + off[sb], len[sb], seg);
+    recv_copy_segmented(c, left, kTagRingAG, buf + off[rb], len[rb], seg);
+  }
+}
+
+// Switchover: ring for messages at or above T4J_RING_MIN_BYTES (total
+// message size), trees below.
+bool use_ring(const Comm& c, size_t total_bytes) {
+  return c.ranks.size() > 1 &&
+         static_cast<long long>(total_bytes) >= ring_min_bytes();
+}
+
+// ------------------------------------------------- interleaved root send
+//
+// One frame per destination, progressed round-robin over every pending
+// TCP socket, so the root's fan-out is bounded by ITS uplink — one
+// slow or stalled peer no longer serialises delivery to the others
+// (the old scatter loop wrote whole payloads one peer at a time).
+// Self and same-host pipe destinations are delivered up front: those
+// writes are bounded local memcpys, not throttleable sockets.
+
+struct RootSend {
+  int dest_idx;  // comm-relative index
+  const uint8_t* p;
+  size_t nbytes;
+};
+
+void multi_send(Comm& c, int tag, std::vector<RootSend>& msgs) {
+  if (g_stop.load(std::memory_order_acquire)) raise_stopped();
+  std::vector<RootSend> tcp;
+  for (const RootSend& m : msgs) {
+    int wd = c.ranks[m.dest_idx];
+    bool piped = wd < static_cast<int>(g_tx_pipes.size()) &&
+                 g_tx_pipes[wd] != nullptr;
+    if (wd == g_rank || piped)
+      csend(c, m.dest_idx, tag, m.p, m.nbytes);
+    else
+      tcp.push_back(m);
+  }
+  if (tcp.empty()) return;
+  if (tcp.size() == 1) {
+    csend(c, tcp[0].dest_idx, tag, tcp[0].p, tcp[0].nbytes);
+    return;
+  }
+  // ascending world-rank lock order: concurrent multi_sends (different
+  // comms on different threads) then acquire send_mu in one global
+  // order, and single raw_sends hold one lock only — no cycle
+  std::sort(tcp.begin(), tcp.end(), [&](const RootSend& a,
+                                        const RootSend& b) {
+    return c.ranks[a.dest_idx] < c.ranks[b.dest_idx];
+  });
+
+  struct Tx {
+    int wdest;
+    int fd;
+    WireHeader h;
+    iovec iov[2];
+    int iovcnt;
+    std::unique_lock<std::mutex> lk;
+    bool done = false;
+  };
+  std::vector<Tx> txs(tcp.size());
+  for (size_t i = 0; i < tcp.size(); ++i) {
+    int wd = c.ranks[tcp[i].dest_idx];
+    PeerSock& p = g_peers[wd];
+    if (p.fd < 0)
+      fail_arg("send to unconnected peer r" + std::to_string(wd));
+    maybe_inject_send_fault();
+    Tx& t = txs[i];
+    t.wdest = wd;
+    t.fd = p.fd;
+    t.h = WireHeader{kMagic, static_cast<uint32_t>(g_rank),
+                     static_cast<uint32_t>(enc_ctx(c.ctx, true)),
+                     static_cast<uint32_t>(tag + 1),
+                     static_cast<uint64_t>(tcp[i].nbytes)};
+    t.iov[0] = {&t.h, sizeof(t.h)};
+    t.iov[1] = {const_cast<uint8_t*>(tcp[i].p), tcp[i].nbytes};
+    t.iovcnt = tcp[i].nbytes ? 2 : 1;
+    t.lk = std::unique_lock<std::mutex>(p.send_mu);
+  }
+
+  double limit_s = effective_op_timeout();
+  Deadline dl = Deadline::after(limit_s);
+  size_t remaining = txs.size();
+  std::string failure;  // set -> release all locks, then fail_op
+  bool stopped = false;
+  while (remaining > 0 && failure.empty() && !stopped) {
+    bool progressed = false;
+    for (Tx& t : txs) {
+      if (t.done) continue;
+      msghdr mh{};
+      mh.msg_iov = t.iov;
+      mh.msg_iovlen = t.iovcnt;
+      ssize_t w = ::sendmsg(t.fd, &mh, MSG_NOSIGNAL);
+      if (w < 0) {
+        if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
+          continue;
+        failure = "send to peer r" + std::to_string(t.wdest) +
+                  " failed: " + std::strerror(errno) +
+                  " (peer process likely dead)";
+        break;
+      }
+      progressed = true;
+      size_t done = static_cast<size_t>(w);
+      while (t.iovcnt > 0 && done >= t.iov[0].iov_len) {
+        done -= t.iov[0].iov_len;
+        t.iov[0] = t.iov[1];  // shift down (2-entry array; once iovcnt
+        --t.iovcnt;           // hits 0 the slot is never read again)
+      }
+      if (t.iovcnt > 0 && done > 0) {
+        t.iov[0].iov_base = static_cast<char*>(t.iov[0].iov_base) + done;
+        t.iov[0].iov_len -= done;
+      }
+      if (t.iovcnt == 0) {
+        t.done = true;
+        t.lk.unlock();
+        --remaining;
+      }
+    }
+    if (remaining == 0 || !failure.empty()) break;
+    if (g_stop.load(std::memory_order_acquire)) {
+      stopped = true;
+      break;
+    }
+    if (progressed) {
+      // true PROGRESS deadline, matching the knob's documented
+      // semantics: it fires only after limit_s with no bytes moving to
+      // ANY peer — a large fan-out that is steadily draining never
+      // trips it (the sequential loop gave each peer a fresh window;
+      // one shared non-resetting window would be stricter than both)
+      dl = Deadline::after(limit_s);
+    } else {
+      if (dl.expired()) {
+        std::string who;
+        for (const Tx& t : txs)
+          if (!t.done) who += (who.empty() ? "r" : ", r") +
+                              std::to_string(t.wdest);
+        failure = "root send made no progress to peer(s) " + who +
+                  " for " + std::to_string(limit_s) + "s (" +
+                  deadline_knob() + ") — peer stalled or not draining";
+        break;
+      }
+      std::vector<pollfd> pfds;
+      for (const Tx& t : txs)
+        if (!t.done) pfds.push_back({t.fd, POLLOUT, 0});
+      ::poll(pfds.data(), pfds.size(), dl.remaining_ms(100));
+    }
+  }
+  if (stopped || !failure.empty()) {
+    // Abandoning the fan-out can leave a TORN frame on any unfinished
+    // socket, and fail_op's in-band abort broadcast would then be
+    // parsed as that frame's remaining payload — the peer either hangs
+    // waiting for body bytes that never come or silently accepts
+    // corrupted data.  Shut those sockets down (while still holding
+    // their send_mu, so the abort writer cannot interleave): the
+    // peer's reader sees EOF mid-frame immediately and raises the
+    // usual attributable lost-peer error instead.
+    for (Tx& t : txs)
+      if (!t.done) ::shutdown(t.fd, SHUT_RDWR);
+  }
+  for (Tx& t : txs)
+    if (t.lk.owns_lock()) t.lk.unlock();
+  if (stopped) raise_stopped();
+  if (!failure.empty()) fail_op(failure);
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------- public
@@ -1590,6 +2002,17 @@ void set_timeouts(double op_s, double connect_s) {
   if (op_s >= 0) g_op_timeout_s.store(op_s, std::memory_order_relaxed);
   if (connect_s > 0)
     g_connect_timeout_s.store(connect_s, std::memory_order_relaxed);
+}
+
+void set_tuning(long long ring_min, long long seg) {
+  // ring_min: < 0 keeps the current value, 0 = always ring, > 0 sets
+  // the switchover.  seg: < 1 keeps (a segment cannot be empty).
+  // Must be uniform across ranks (the launcher propagates the env):
+  // ranks disagreeing on the switchover would run mismatched
+  // algorithms and deadlock, exactly like divergent T4J_NO_SHM.
+  if (ring_min >= 0)
+    g_ring_min_bytes.store(ring_min, std::memory_order_relaxed);
+  if (seg >= 1) g_seg_bytes.store(seg, std::memory_order_relaxed);
 }
 
 bool faulted() { return g_faulted.load(std::memory_order_acquire); }
@@ -1914,10 +2337,63 @@ void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
   LogScope log("MPI_Allreduce", "with " + std::to_string(count) + " items");
   if (shm::Arena* a = comm_arena(c))
     return shm::allreduce(a, in, out, count, dt, op);
-  size_t nbytes = count * dtype_size(dt);
+  size_t dsize = dtype_size(dt);
+  size_t nbytes = count * dsize;
+  if (use_ring(c, nbytes)) {
+    // segmented ring reduce-scatter + ring allgather: each link
+    // carries 2*(n-1)/n of the payload instead of the tree's full
+    // payload per level.  The reduce-scatter writes this rank's block
+    // of `out`; the allgather circulates the reduced blocks to fill
+    // the rest — no whole-message staging copy.
+    int n = static_cast<int>(c.ranks.size());
+    BlockPart part(count, n);
+    std::vector<size_t> off(n), len(n);
+    for (int b = 0; b < n; ++b) {
+      off[b] = part.off(b) * dsize;
+      len[b] = part.len(b) * dsize;
+    }
+    const uint8_t* i8 = static_cast<const uint8_t*>(in);
+    uint8_t* o8 = static_cast<uint8_t*>(out);
+    ring_reduce_scatter(c, i8, o8 + off[c.my_index], off, len, dt, op);
+    ring_allgather(c, o8, off, len);
+    return;
+  }
   reduce(comm, in, out, count, dt, op, 0);
   if (c.my_index != 0) std::memcpy(out, in, nbytes);  // placate valgrind
   bcast(comm, out, nbytes, 0);
+}
+
+void reduce_scatter(int comm, const void* in, void* out, size_t count_each,
+                    DType dt, ReduceOp op) {
+  Comm& c = get_comm(comm);
+  LogScope log("MPI_Reduce_scatter",
+               "with " + std::to_string(count_each) + " items per rank");
+  int n = static_cast<int>(c.ranks.size());
+  size_t dsize = dtype_size(dt);
+  size_t block = count_each * dsize;
+  if (n == 1) {
+    if (block) std::memmove(out, in, block);
+    return;
+  }
+  if (shm::Arena* a = comm_arena(c)) {
+    // intra-host the arena moves memory, not wire bytes: one shm
+    // allreduce then take this rank's block
+    Buf tmp(block * n);
+    shm::allreduce(a, in, tmp.data(), count_each * n, dt, op);
+    std::memcpy(out, tmp.data() + block * c.my_index, block);
+    return;
+  }
+  if (use_ring(c, block * n)) {
+    std::vector<size_t> off(n), len(n, block);
+    for (int b = 0; b < n; ++b) off[b] = block * b;
+    ring_reduce_scatter(c, static_cast<const uint8_t*>(in),
+                        static_cast<uint8_t*>(out), off, len, dt, op);
+    return;
+  }
+  // small messages: binomial reduce to member 0, scatter the blocks
+  Buf tmp(block * n);
+  reduce(comm, in, tmp.data(), count_each * n, dt, op, 0);
+  scatter(comm, tmp.data(), out, block, 0);
 }
 
 void scan(int comm, const void* in, void* out, size_t count, DType dt,
@@ -1945,6 +2421,17 @@ void allgather(int comm, const void* in, void* out, size_t nbytes_each) {
                                   " bytes each");
   if (shm::Arena* a = comm_arena(c))
     return shm::allgather(a, in, out, nbytes_each);
+  int n = static_cast<int>(c.ranks.size());
+  if (use_ring(c, nbytes_each * n)) {
+    // ring allgather: every block travels once, (n-1)/n of the output
+    // per link — vs the root-funnel gather+bcast's ~2*log2(n) copies
+    uint8_t* o8 = static_cast<uint8_t*>(out);
+    std::memcpy(o8 + nbytes_each * c.my_index, in, nbytes_each);
+    std::vector<size_t> off(n), len(n, nbytes_each);
+    for (int b = 0; b < n; ++b) off[b] = nbytes_each * b;
+    ring_allgather(c, o8, off, len);
+    return;
+  }
   gather(comm, in, out, nbytes_each, 0);
   bcast(comm, out, nbytes_each * c.ranks.size(), 0);
 }
@@ -1957,17 +2444,29 @@ void gather(int comm, const void* in, void* out, size_t nbytes_each,
   if (shm::Arena* a = comm_arena(c))
     return shm::gather(a, in, out, nbytes_each, root);
   int n = static_cast<int>(c.ranks.size());
+  // Per-instance tag (every member advances the counter in lockstep):
+  // lets the root receive in ARRIVAL order below without a run-ahead
+  // rank's next-gather frame matching this instance.
+  int tag = kTagGatherSeqBase +
+            static_cast<int>(c.gather_seq++ & 0xFFFFu);
   if (c.my_index == root) {
     uint8_t* o = static_cast<uint8_t*>(out);
     std::memcpy(o + nbytes_each * root, in, nbytes_each);
-    for (int i = 0; i < n; ++i) {
-      if (i == root) continue;
-      Frame f = crecv(c, i, kCollTagBase + 5);
+    // arrival order, not rank order: a slow peer no longer serialises
+    // the root behind the untouched mailbox frames of the fast ones
+    for (int k = 1; k < n; ++k) {
+      Frame f = crecv(c, kAnySource, tag);
       if (f.data.size() != nbytes_each) fail_size(f, nbytes_each);
-      std::memcpy(o + nbytes_each * i, f.data.data(), nbytes_each);
+      int idx = -1;
+      for (size_t i = 0; i < c.ranks.size(); ++i)
+        if (c.ranks[i] == f.src) idx = static_cast<int>(i);
+      if (idx < 0)
+        fail_op("gather frame from non-member world rank r" +
+                std::to_string(f.src));
+      std::memcpy(o + nbytes_each * idx, f.data.data(), nbytes_each);
     }
   } else {
-    csend(c, root, kCollTagBase + 5, in, nbytes_each);
+    csend(c, root, tag, in, nbytes_each);
   }
 }
 
@@ -1981,10 +2480,15 @@ void scatter(int comm, const void* in, void* out, size_t nbytes_each,
   int n = static_cast<int>(c.ranks.size());
   if (c.my_index == root) {
     const uint8_t* i8 = static_cast<const uint8_t*>(in);
+    // interleaved non-blocking fan-out: all peers' frames progress
+    // round-robin, so one slow peer cannot serialise the rest
+    std::vector<RootSend> msgs;
+    msgs.reserve(n - 1);
     for (int i = 0; i < n; ++i) {
       if (i == root) continue;
-      csend(c, i, kCollTagBase + 6, i8 + nbytes_each * i, nbytes_each);
+      msgs.push_back(RootSend{i, i8 + nbytes_each * i, nbytes_each});
     }
+    multi_send(c, kCollTagBase + 6, msgs);
     std::memcpy(out, i8 + nbytes_each * root, nbytes_each);
   } else {
     Frame f = crecv(c, root, kCollTagBase + 6);

@@ -23,6 +23,17 @@
 // Deterministic fault injection for tests (T4J_FAULT_MODE=refuse|
 // close_after|delay gated on T4J_FAULT_RANK) is compiled in; see
 // init_from_env.
+//
+// Data-plane algorithm selection (docs/performance.md "TCP-tier
+// algorithm selection"): large-message allreduce/allgather/
+// reduce_scatter run as segmented ring collectives (each link carries
+// ~2*(n-1)/n of the payload instead of the trees' full payload per
+// level), pipelined at T4J_SEG_BYTES granularity; small messages keep
+// the latency-optimal trees.  Knobs (validated in utils/config.py):
+//   T4J_RING_MIN_BYTES  total message size at or above which the ring
+//                       path is used (default 256 KiB, the measured
+//                       crossover; 0 = always ring)
+//   T4J_SEG_BYTES       ring segment size (default 1 MiB)
 
 #pragma once
 
@@ -101,6 +112,14 @@ void abort_job(int code, const char* why);
 // utils/config.py owns validation.
 void set_timeouts(double op_s, double connect_s);
 
+// Override the env-derived data-plane tuning.  ring_min: < 0 keeps the
+// current value, 0 = always use the ring path, > 0 sets the tree->ring
+// switchover in bytes.  seg: < 1 keeps, >= 1 sets the ring segment
+// size in bytes.  Must be uniform across ranks (divergent values would
+// run mismatched algorithms and deadlock); utils/config.py owns
+// validation, native/runtime.py threads the values through before init.
+void set_tuning(long long ring_min, long long seg);
+
 // Fault surface: after any bridge call fails, faulted() is true and
 // fault_message() describes the first failure.
 bool faulted();
@@ -138,6 +157,14 @@ void barrier(int comm);
 void bcast(int comm, void* buf, size_t nbytes, int root);
 void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
                ReduceOp op);
+// MPI_Reduce_scatter_block: `in` holds comm_size blocks of count_each
+// elements; member r receives the reduction of block r in `out`.
+// Large messages ride the segmented ring reduce-scatter directly —
+// O((n-1)/n * payload) per link, the collective ZeRO-style scattered
+// gradients want — instead of paying full allreduce (or alltoall)
+// cost.
+void reduce_scatter(int comm, const void* in, void* out, size_t count_each,
+                    DType dt, ReduceOp op);
 void reduce(int comm, const void* in, void* out, size_t count, DType dt,
             ReduceOp op, int root);
 void scan(int comm, const void* in, void* out, size_t count, DType dt,

@@ -133,6 +133,19 @@ ffi::Error ReduceImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
   });
 }
 
+ffi::Error ReduceScatterImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                             ffi::Result<ffi::AnyBuffer> y,
+                             ffi::Result<ffi::AnyBuffer> stamp_out,
+                             int32_t comm, int32_t op) {
+  return guarded([&] {
+    // y's element count is the per-rank block (x = comm_size blocks)
+    t4j::reduce_scatter(comm, x.untyped_data(), y->untyped_data(),
+                        y->element_count(), to_dtype(x.element_type()),
+                        static_cast<t4j::ReduceOp>(op));
+    touch_stamp(stamp, stamp_out);
+  });
+}
+
 ffi::Error ScanImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                     ffi::Result<ffi::AnyBuffer> y,
                     ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
@@ -275,6 +288,13 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_reduce, ReduceImpl,
                                   .Attr<int32_t>("op")
                                   .Attr<int32_t>("root"));
 
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_reduce_scatter, ReduceScatterImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("op"));
+
 XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_scan, ScanImpl,
                               T4J_BUF.Arg<ffi::AnyBuffer>()
                                   .Ret<ffi::AnyBuffer>()
@@ -380,6 +400,9 @@ const char* t4j_fault_msg() {
 void t4j_set_timeouts(double op_s, double connect_s) {
   t4j::set_timeouts(op_s, connect_s);
 }
+void t4j_set_tuning(int64_t ring_min_bytes, int64_t seg_bytes) {
+  t4j::set_tuning(ring_min_bytes, seg_bytes);
+}
 void t4j_abort_notify(const char* why) { t4j::abort_notify(why); }
 
 int t4j_comm_create(const int32_t* ranks, int32_t n, int32_t ctx) {
@@ -465,6 +488,14 @@ int32_t t4j_c_scan(int32_t comm, const void* in, void* out, uint64_t count,
   return c_guard([&] {
     t4j::scan(comm, in, out, count, static_cast<t4j::DType>(dt),
               static_cast<t4j::ReduceOp>(op));
+  });
+}
+int32_t t4j_c_reduce_scatter(int32_t comm, const void* in, void* out,
+                             uint64_t count_each, int32_t dt, int32_t op) {
+  return c_guard([&] {
+    t4j::reduce_scatter(comm, in, out, count_each,
+                        static_cast<t4j::DType>(dt),
+                        static_cast<t4j::ReduceOp>(op));
   });
 }
 int32_t t4j_c_allgather(int32_t comm, const void* in, void* out,

@@ -241,6 +241,32 @@ def proc_reduce(x, stamp, op, comm, root):
     )
 
 
+def proc_reduce_scatter(x, stamp, op, comm):
+    """MPI_Reduce_scatter_block on the native bridge: ``x`` has shape
+    ``(comm.size, *rest)``, the result is the reduction of row ``rank``
+    with shape ``rest``.  Large payloads ride the segmented ring
+    reduce-scatter directly — O((n-1)/n * payload) per link — instead
+    of the alltoall + on-device fold detour.  Builtin ops only: callers
+    route user-defined ops through the alltoall + rank-ordered-fold
+    path (ops/collectives.py), which is the jax-traceable contract
+    user combines require."""
+    code = _op_code(op)
+    out = jax.ShapeDtypeStruct(jnp.shape(x)[1:], jnp.result_type(x))
+    if _staged():
+        return _staged_data(
+            comm, out,
+            lambda rt, h, a: rt.host_reduce_scatter(h, a, code), x, stamp,
+        )
+    return _call(
+        "t4j_reduce_scatter",
+        (out, _STAMP),
+        x,
+        stamp,
+        comm=_handle(comm),
+        op=np.int32(code),
+    )
+
+
 def proc_scan(x, stamp, op, comm):
     if getattr(op, "is_user", False):
         g, stamp = proc_allgather(x, stamp, comm)

@@ -301,9 +301,14 @@ def reduce_scatter(x, op=reductions.SUM, *, comm=None, token=None):
     ``x`` must have shape ``(comm.size, *rest)`` on every rank; the
     result has shape ``rest``.  Identity: ``reduce_scatter(x)`` on rank
     ``r`` equals ``allreduce(x)[r]``.  Differentiable for ``op=SUM``
-    (the composition transposes to an ``all_gather``).  Non-SUM and
-    user-defined ops ride an ``all_to_all`` + rank-ordered local fold
-    (correct for ``commute=False`` operators).
+    (the composition transposes to an ``all_gather``).  On the mesh
+    backend, non-SUM and user-defined ops ride an ``all_to_all`` +
+    rank-ordered local fold (correct for ``commute=False`` operators);
+    on the proc backend every builtin op is a single native
+    ``reduce_scatter`` over the DCN bridge — the segmented ring at
+    large payloads, ``O((n-1)/n * payload)`` per link
+    (docs/performance.md "TCP-tier algorithm selection") — and only
+    user-defined ops take the ``all_to_all`` + fold detour.
     """
     x, comm, token = _prologue(x, comm, token)
     op = check_op(op)
@@ -345,8 +350,16 @@ def reduce_scatter(x, op=reductions.SUM, *, comm=None, token=None):
         from mpi4jax_tpu.ops import _proc
 
         xv = x.astype(jnp.int8) if as_int else x
-        rows, stamp = _proc.proc_alltoall(xv, token.stamp, comm)
-        y = fold_rows(rows)
+        if not op.is_user:
+            # native segmented ring reduce-scatter (dcn.cc): the
+            # scattered-gradient collective ZeRO wants, at
+            # O((n-1)/n * payload) per link — the alltoall + fold
+            # detour ships the same bytes but pays the fold on every
+            # rank and a full staging pass
+            y, stamp = _proc.proc_reduce_scatter(xv, token.stamp, op, comm)
+        else:
+            rows, stamp = _proc.proc_alltoall(xv, token.stamp, comm)
+            y = fold_rows(rows)
         if as_int:
             y = y.astype(jnp.bool_)
         return y, token.with_stamp(stamp)

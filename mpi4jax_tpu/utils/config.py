@@ -22,6 +22,21 @@ Robustness deadlines for the multi-process DCN bridge
 * ``T4J_CONNECT_TIMEOUT`` — bootstrap connect/accept deadline in
                             seconds (default 30).
 
+Data-plane tuning for the TCP-tier collectives (docs/performance.md
+"TCP-tier algorithm selection"):
+
+* ``T4J_RING_MIN_BYTES`` — total message size at or above which
+                           allreduce/allgather/reduce_scatter use the
+                           segmented ring algorithms instead of the
+                           latency-optimal trees (default 256 KiB, the
+                           measured crossover; 0 = always ring).
+* ``T4J_SEG_BYTES``      — ring segment/pipelining granularity
+                           (default 1 MiB; must be >= 1).
+
+Both accept an optional K/M/G suffix (``T4J_SEG_BYTES=256K``) and must
+be uniform across ranks — the launcher propagates the env, and ranks
+disagreeing on the switchover would run mismatched algorithms.
+
 Values are validated here and handed to the native bridge before init
 (native/runtime.py), so a typo'd deadline fails loudly at launch
 instead of silently running unbounded.
@@ -38,6 +53,9 @@ __all__ = [
     "seconds",
     "op_timeout",
     "connect_timeout",
+    "byte_count",
+    "ring_min_bytes",
+    "seg_bytes",
 ]
 
 _TRUE = {"1", "true", "on", "yes"}
@@ -98,6 +116,70 @@ def seconds(value, default, name="value", minimum=0.0):
         raise ValueError(f"{name}={value!r} must be finite")
     if v < minimum:
         raise ValueError(f"{name}={value!r} must be >= {minimum}")
+    return v
+
+
+_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
+
+
+def byte_count(value, default, name="value", minimum=0):
+    """Parse an env-var byte count with an optional K/M/G suffix.
+
+    ``None``/empty returns ``default``; anything that is not a whole
+    number of bytes >= ``minimum`` raises ``ValueError`` naming the
+    variable — a mistyped tuning knob must fail at launch, not silently
+    fall back and mislabel every benchmark after it."""
+    if value is None or str(value).strip() == "":
+        return int(default)
+    s = str(value).strip()
+    mult = 1
+    if s and s[-1].lower() in _SUFFIX:
+        mult = _SUFFIX[s[-1].lower()]
+        s = s[:-1].strip()
+    try:
+        v = int(s, 10)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"cannot interpret {name}={value!r} as a byte count "
+            "(want an integer, optionally suffixed K/M/G)"
+        )
+    v *= mult
+    if v < minimum:
+        raise ValueError(f"{name}={value!r} must be >= {minimum}")
+    if v >= 1 << 62:
+        # the native side takes an int64; a value this large is a typo,
+        # and letting it through would crash in ctypes with an error
+        # that does not name the variable
+        raise ValueError(f"{name}={value!r} is implausibly large")
+    return v
+
+
+def ring_min_bytes():
+    """Tree->ring switchover for the TCP-tier collectives, in bytes.
+
+    Messages at or above this total size use the segmented ring
+    algorithms (bandwidth-optimal); smaller ones keep the trees
+    (latency-optimal).  0 forces the ring path for every size.  The
+    default is the measured 8-proc crossover (docs/performance.md
+    "TCP-tier algorithm selection")."""
+    return byte_count(
+        os.environ.get("T4J_RING_MIN_BYTES"),
+        256 << 10,
+        name="T4J_RING_MIN_BYTES",
+    )
+
+
+def seg_bytes():
+    """Ring segment size in bytes (strictly positive): the granularity
+    at which ring transfers are pipelined — the combine of segment k
+    overlaps the receive of segment k+1."""
+    v = byte_count(
+        os.environ.get("T4J_SEG_BYTES"), 1 << 20, name="T4J_SEG_BYTES"
+    )
+    if v < 1:
+        raise ValueError(
+            "T4J_SEG_BYTES must be >= 1 (a ring segment cannot be empty)"
+        )
     return v
 
 

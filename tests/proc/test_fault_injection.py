@@ -174,6 +174,57 @@ except Exception as e:
         assert "peer r1" in blob or "rank 1" in blob, (rank, blob[-2000:])
 
 
+def test_dead_peer_mid_ring(tmp_path):
+    """close_after with a ring-sized payload: rank 1 dies partway
+    through the segmented ring allreduce (T4J_RING_MIN_BYTES=0 forces
+    the ring path, small T4J_SEG_BYTES makes each step many frames, and
+    T4J_FAULT_AFTER lands the death mid-stream).  Survivors must raise
+    a contextual BridgeError naming peer r1 — the per-segment sends and
+    recvs run under the same deadline/abort contract as whole-message
+    collectives (docs/failure-semantics.md)."""
+    body = PREAMBLE + f"""
+x = jnp.ones((64 * 1024,), jnp.float32)  # 256 KB through the ring
+t0 = time.monotonic()
+try:
+    for i in range(200):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+    print("NO-RAISE", flush=True)
+    sys.exit({NO_RAISE})
+except Exception as e:
+    dt = time.monotonic() - t0
+    print(f"OP-RAISED after {{dt:.2f}}s: {{type(e).__name__}}: {{e}}",
+          flush=True)
+    sys.exit({RAISED})
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=3,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_RING_MIN_BYTES": "0",
+            "T4J_SEG_BYTES": "4096",
+            "T4J_FAULT_MODE": "close_after",
+            "T4J_FAULT_RANK": "1",
+            # ~21 x 4 KB segments per ring step: 40 frames is mid-ring,
+            # past the bootstrap/barrier traffic but inside an allreduce
+            "T4J_FAULT_AFTER": "40",
+        },
+    )
+    rc1, _, err1 = res[1]
+    assert rc1 == 42, (rc1, err1[-2000:])  # the planted death
+    named_dead = False
+    for rank in (0, 2):
+        rc, out, err = res[rank]
+        assert rc == RAISED, (rank, rc, out[-2000:], err[-2000:])
+        blob = out + err
+        # every survivor raises with peer context; the first survivor
+        # to raise then exits, so the second may attribute its failure
+        # to either dead transport — but SOMEONE must name rank 1
+        assert "peer r" in blob or "rank " in blob, (rank, blob[-2000:])
+        named_dead = named_dead or "peer r1" in blob or "rank 1" in blob
+    assert named_dead, [r[1][-500:] + r[2][-500:] for r in res if r]
+
+
 # --------------------------------------------------------------- slow peer
 
 

@@ -1,0 +1,186 @@
+"""Segment-boundary matrix for the TCP-tier ring collectives.
+
+The segmented ring data plane (native/src/dcn.cc, docs/performance.md
+"TCP-tier algorithm selection") is forced on for every payload size
+(T4J_RING_MIN_BYTES=0) with a tiny segment (T4J_SEG_BYTES=64) and the
+shm arena disabled (T4J_NO_SHM=1), so every boundary of the
+segmentation and block-partition logic is exercised over the real wire
+path:
+
+* payloads of 1 byte, seg-1 / seg / seg+1 bytes, and multi-segment;
+* element counts not divisible by the world size (uneven ring blocks,
+  including zero-length blocks when count < n);
+* non-power-of-two world sizes (n=3) alongside even ones (n=4).
+
+Results are checked BIT-exact against a local rank-ordered fold of
+deterministically regenerated per-rank arrays, and the ring path is
+checked bit-identical to the tree path (runtime.set_tuning flips the
+switchover in-process) for SUM/MAX/MIN.  The float matrices use small
+integers so every reduction order yields the same bits — the property
+that makes "ring vs tree bit-identical" a well-defined contract for
+floating point.
+"""
+
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401 -- probe only
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+WORKER = """
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.native import runtime
+
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+n, rank = comm.size, comm.rank()
+SEG = 64  # bytes; matches T4J_SEG_BYTES in the test env
+
+
+def rank_data(count, dtype, r):
+    # small integers: SUM over any association is exact in f32 too, so
+    # bit-identity across algorithms/orders is well-defined
+    rng = np.random.default_rng(1234 + 17 * r)
+    return rng.integers(0, 8, size=count).astype(dtype)
+
+
+OPS = {
+    "sum": (m.SUM, lambda a, b: a + b),
+    "max": (m.MAX, np.maximum),
+    "min": (m.MIN, np.minimum),
+}
+
+
+def fold(arrays, np_op):
+    acc = arrays[0].copy()
+    for a in arrays[1:]:
+        acc = np_op(acc, a)
+    return acc
+
+
+def check(label, got, want):
+    got = np.asarray(got)
+    assert got.dtype == want.dtype, (label, got.dtype, want.dtype)
+    assert got.shape == want.shape, (label, got.shape, want.shape)
+    assert got.tobytes() == want.tobytes(), (
+        label,
+        got.ravel()[:8],
+        want.ravel()[:8],
+    )
+
+
+# element counts per dtype: 1-byte payload, the seg-1/seg/seg+1 byte
+# boundaries (int8: elements == bytes; f32: element-level boundaries of
+# the 16-element segment), multi-segment, and counts not divisible by n
+CASES = {
+    np.int8: [1, SEG - 1, SEG, SEG + 1, 3 * SEG + 5],
+    np.float32: [SEG // 4 - 1, SEG // 4, SEG // 4 + 1, 3 * (SEG // 4) + 7,
+                 7 * n + 3],
+    np.int32: [SEG // 4 + 1, 5 * n + 1],
+}
+
+for dtype, counts in CASES.items():
+    for count in counts:
+        per_rank = [rank_data(count, dtype, r) for r in range(n)]
+        mine = per_rank[rank]
+        for opname, (op, np_op) in OPS.items():
+            want = fold(per_rank, np_op)
+            label = f"{np.dtype(dtype).name}/{opname}/count={count}"
+
+            # ring allreduce (T4J_RING_MIN_BYTES=0 forces it) ...
+            runtime.set_tuning(ring_min_bytes=0)
+            y_ring, _ = m.allreduce(jnp.asarray(mine), op=op, comm=comm)
+            check("ring allreduce " + label, y_ring, want)
+
+            # ... bit-identical to the tree path on the same payload
+            runtime.set_tuning(ring_min_bytes=1 << 40)
+            y_tree, _ = m.allreduce(jnp.asarray(mine), op=op, comm=comm)
+            check("tree allreduce " + label, y_tree, want)
+            assert np.asarray(y_ring).tobytes() == np.asarray(
+                y_tree
+            ).tobytes(), ("ring-vs-tree " + label)
+            runtime.set_tuning(ring_min_bytes=0)
+
+        # reduce_scatter: (n, count) rows, rank r gets the SUM of row r
+        rows = [
+            rank_data(n * count, dtype, 100 + r).reshape(n, count)
+            for r in range(n)
+        ]
+        want_rs = fold([rws[rank] for rws in rows], lambda a, b: a + b)
+        y_rs, _ = m.reduce_scatter(
+            jnp.asarray(rows[rank]), op=m.SUM, comm=comm
+        )
+        check(f"ring reduce_scatter {np.dtype(dtype).name}/{count}",
+              y_rs, want_rs)
+
+        # allgather of the per-rank array
+        y_ag, _ = m.allgather(jnp.asarray(mine), comm=comm)
+        check(f"ring allgather {np.dtype(dtype).name}/{count}",
+              y_ag, np.stack(per_rank))
+
+print(f"MATRIX-OK {rank}", flush=True)
+"""
+
+
+def _run_matrix(nprocs, timeout=240):
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(textwrap.dedent(WORKER))
+        path = f.name
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(
+        T4J_NO_SHM="1",       # force the TCP tier: shm would bypass the ring
+        T4J_RING_MIN_BYTES="0",
+        T4J_SEG_BYTES="64",   # tiny segments: boundary cases stay cheap
+    )
+    popen = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi4jax_tpu.launch",
+            "-np", str(nprocs), path,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=str(REPO),
+        start_new_session=True,
+    )
+    try:
+        out, err = popen.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(popen.pid), signal.SIGKILL)
+        out, err = popen.communicate()
+        raise AssertionError(f"ring matrix hung\n{out}\n{err}")
+    assert popen.returncode == 0, (popen.returncode, out[-3000:],
+                                   err[-3000:])
+    for r in range(nprocs):
+        assert f"MATRIX-OK {r}" in out, (r, out[-3000:], err[-3000:])
+
+
+def test_ring_matrix_non_power_of_two_world():
+    """n=3: uneven ring blocks everywhere, incl. zero-length blocks for
+    the 1-byte payload."""
+    _run_matrix(3)
+
+
+def test_ring_matrix_even_world():
+    _run_matrix(4)

@@ -1,0 +1,137 @@
+"""Data-plane tuning config plumbing (utils/config.py).
+
+The TCP-tier ring collectives' knobs (docs/performance.md "TCP-tier
+algorithm selection") are validated in Python before the native bridge
+ever sees them, same contract as the timeout knobs
+(tests/test_config_timeouts.py): a typo'd T4J_RING_MIN_BYTES must fail
+at launch, not silently fall back to a default and mislabel every
+benchmark record after it.
+"""
+
+import pytest
+
+try:
+    from mpi4jax_tpu.utils import config
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+
+class TestByteCountParser:
+    def test_none_returns_default(self):
+        assert config.byte_count(None, 4096) == 4096
+
+    def test_empty_returns_default(self):
+        assert config.byte_count("", 64) == 64
+        assert config.byte_count("   ", 64) == 64
+
+    def test_parses_plain_integers(self):
+        assert config.byte_count("0", 1) == 0
+        assert config.byte_count("65536", 1) == 65536
+        assert config.byte_count(" 123 ", 1) == 123
+        assert config.byte_count(4096, 1) == 4096
+
+    @pytest.mark.parametrize(
+        "value,expected",
+        [
+            ("1K", 1024),
+            ("1k", 1024),
+            ("64K", 64 << 10),
+            ("2M", 2 << 20),
+            ("1G", 1 << 30),
+            ("256 K", 256 << 10),
+        ],
+    )
+    def test_suffixes(self, value, expected):
+        assert config.byte_count(value, 1) == expected
+
+    @pytest.mark.parametrize("bad", ["big", "1.5", "1.5M", "0x40", "K", "1KB"])
+    def test_rejects_garbage(self, bad):
+        with pytest.raises(ValueError, match="T4J_TEST"):
+            config.byte_count(bad, 1, name="T4J_TEST")
+
+    def test_rejects_below_minimum(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            config.byte_count("0", 1, name="T4J_TEST", minimum=1)
+        with pytest.raises(ValueError, match=">= 0"):
+            config.byte_count("-1", 1, name="T4J_TEST")
+        with pytest.raises(ValueError, match=">= 0"):
+            config.byte_count("-1K", 1, name="T4J_TEST")
+
+    @pytest.mark.parametrize("huge", ["99999999999999999999", "16000000000G"])
+    def test_rejects_int64_overflow(self, huge):
+        # the native side takes an int64: fail loudly at launch naming
+        # the variable, not later in ctypes with an anonymous error
+        with pytest.raises(ValueError, match="T4J_TEST"):
+            config.byte_count(huge, 1, name="T4J_TEST")
+
+
+class TestRingMinBytes:
+    def test_default_is_256k(self, monkeypatch):
+        # the measured 8-proc tree/ring crossover (docs/performance.md)
+        monkeypatch.delenv("T4J_RING_MIN_BYTES", raising=False)
+        assert config.ring_min_bytes() == 256 << 10
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_RING_MIN_BYTES", "4096")
+        assert config.ring_min_bytes() == 4096
+
+    def test_zero_means_always_ring(self, monkeypatch):
+        monkeypatch.setenv("T4J_RING_MIN_BYTES", "0")
+        assert config.ring_min_bytes() == 0
+
+    def test_suffix(self, monkeypatch):
+        monkeypatch.setenv("T4J_RING_MIN_BYTES", "1M")
+        assert config.ring_min_bytes() == 1 << 20
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_RING_MIN_BYTES", "huge")
+        with pytest.raises(ValueError, match="T4J_RING_MIN_BYTES"):
+            config.ring_min_bytes()
+
+    def test_negative_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_RING_MIN_BYTES", "-1")
+        with pytest.raises(ValueError, match="T4J_RING_MIN_BYTES"):
+            config.ring_min_bytes()
+
+
+class TestSegBytes:
+    def test_default_is_1m(self, monkeypatch):
+        monkeypatch.delenv("T4J_SEG_BYTES", raising=False)
+        assert config.seg_bytes() == 1 << 20
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_SEG_BYTES", "64")
+        assert config.seg_bytes() == 64
+
+    def test_suffix(self, monkeypatch):
+        monkeypatch.setenv("T4J_SEG_BYTES", "256K")
+        assert config.seg_bytes() == 256 << 10
+
+    def test_zero_rejected(self, monkeypatch):
+        # a ring segment cannot be empty: transfers would never progress
+        monkeypatch.setenv("T4J_SEG_BYTES", "0")
+        with pytest.raises(ValueError, match="T4J_SEG_BYTES"):
+            config.seg_bytes()
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_SEG_BYTES", "tiny")
+        with pytest.raises(ValueError, match="T4J_SEG_BYTES"):
+            config.seg_bytes()
+
+
+def test_ensure_initialized_rejects_bad_tuning(monkeypatch):
+    """The validation is threaded through native/runtime.py, same as
+    the deadlines: a bad env value aborts initialisation before any
+    socket is opened."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_RING_MIN_BYTES", "not-a-size")
+    with pytest.raises(ValueError, match="T4J_RING_MIN_BYTES"):
+        runtime.ensure_initialized()
