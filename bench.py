@@ -328,6 +328,23 @@ def virtual_mesh_busbw(timeout=600):
     return rec["value"] if rec else None
 
 
+def native_bridge_status():
+    """Probe whether the native DCN bridge builds and loads.
+
+    Every proc-tier benchmark leg spawns launcher jobs that need the
+    compiled bridge; when the toolchain or FFI headers are missing each
+    leg used to die with its own timeout + traceback noise.  One probe
+    up front turns that into a single clear skip line.  Returns
+    ``(ok, reason)``."""
+    try:
+        from mpi4jax_tpu.native.build import ensure_built
+
+        ensure_built()
+        return True, ""
+    except Exception as exc:  # noqa: BLE001 — reason feeds the skip line
+        return False, f"{type(exc).__name__}: {str(exc)[:300]}"
+
+
 def proc_busbw(timeout=600):
     """8-process DCN-bridge allreduce bus bandwidth (the proc tier over
     the same-host shm arena), via a launcher subprocess job.  Returns
@@ -370,6 +387,52 @@ def proc_tcp_busbw(timeout=900):
         env={"T4J_NO_SHM": "1", "T4J_RING_MIN_BYTES": "1099511627776"},
     )
     return ring, tree
+
+
+def proc_hier_busbw(timeout=900):
+    """Hierarchical vs flat allreduce on an emulated 2-node x 4-local
+    topology (T4J_EMU_LOCAL=4): one launcher job, 64 MB, interleaved
+    same-conditions pairs (proc_busbw.py --pairs).  Returns the ratio
+    record plus the per-side records (any may be None)."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
+    argv = [
+        sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+        str(script), "--mb", "64", "--reps", "5", "--pairs",
+    ]
+    import os as _os
+
+    env = dict(_os.environ)
+    env["T4J_EMU_LOCAL"] = "4"
+    hier = flat = ratio = None
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent), env=env,
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") == "allreduce_busbw_proc8":
+                if rec.get("data_plane") == "hier":
+                    hier = rec
+                else:
+                    flat = rec
+            elif rec.get("metric") == "allreduce_hier_vs_flat_proc8":
+                ratio = rec
+        if ratio is None:
+            print(
+                f"[bench] hier busbw produced no ratio record "
+                f"(rc={out.returncode}): {out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] hier busbw failed: {exc}", file=sys.stderr)
+    return hier, flat, ratio
 
 
 def main():
@@ -607,7 +670,16 @@ def main():
         # mesh-tier collective on host shared memory) — kept for
         # round-over-round continuity under its historical key
         extras["allreduce_busbw_cpu8_hostmem_gbps"] = vmesh_gbps
-    procrec = proc_busbw()  # subprocess launcher job: own timeout
+    # every leg below spawns launcher jobs over the compiled DCN
+    # bridge: when it cannot build/load, skip them all with ONE clear
+    # line instead of a per-leg timeout + traceback
+    native_ok, native_reason = native_bridge_status()
+    if not native_ok:
+        print(
+            f"[bench] skipping native-bridge benchmarks: {native_reason}",
+            file=sys.stderr,
+        )
+    procrec = proc_busbw() if native_ok else None
     if procrec is not None:
         # the DCN bridge proper: 8 OS processes over the same-host shm
         # arena (native/src/shm.cc) — the analog of the reference's
@@ -639,7 +711,7 @@ def main():
         ):
             if src_key in procrec:
                 extras[dst_key] = procrec[src_key]
-    ring_rec, tree_rec = proc_tcp_busbw()  # subprocess jobs: own timeouts
+    ring_rec, tree_rec = proc_tcp_busbw() if native_ok else (None, None)
     if ring_rec is not None:
         # the TCP tier proper (T4J_NO_SHM=1): segmented ring allreduce
         # vs the pre-PR2 tree path on the same 64 MB payload — the
@@ -651,6 +723,18 @@ def main():
         extras["proc8_tcp_ring_vs_tree_ratio"] = round(
             ring_rec["value"] / tree_rec["value"], 2
         )
+    # the hierarchical plane (PR 3 tentpole): 8 procs emulating 2 nodes
+    # x 4 local ranks, shm-leaf reduce + leader ring vs the flat path
+    # on the same 64 MB payload, interleaved same-conditions pairs
+    hier_rec, hflat_rec, hratio_rec = (
+        proc_hier_busbw() if native_ok else (None, None, None)
+    )
+    if hier_rec is not None:
+        extras["allreduce_busbw_proc8_hier_gbps"] = hier_rec["value"]
+    if hflat_rec is not None:
+        extras["allreduce_busbw_proc8_hier_flat_gbps"] = hflat_rec["value"]
+    if hratio_rec is not None:
+        extras["proc8_hier_vs_ring_ratio"] = hratio_rec["value"]
 
     try:
         extras["transformer_train_tokens_per_sec_bf16"] = (
@@ -706,7 +790,7 @@ def main():
         import pathlib as _pl
 
         tt_script = _pl.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
-        tt = _metric_subprocess(
+        tt = None if not native_ok else _metric_subprocess(
             [
                 sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "2",
                 str(tt_script), "--two-tier", "--mb", "32",
@@ -739,7 +823,7 @@ def main():
             )
             return rec["aggregate_cell_updates_per_sec"] if rec else None
 
-        ws1, ws8 = _ws(1), _ws(8)
+        ws1, ws8 = (_ws(1), _ws(8)) if native_ok else (None, None)
         if ws1 and ws8:
             extras["weak_scaling_proc8_core_normalized_eff"] = round(
                 ws8 / ws1, 3

@@ -8,16 +8,21 @@ where libmpi's shm BTL moves intra-host traffic through shared memory
 calls MPI_Allreduce).  Run under the launcher:
 
     python -m mpi4jax_tpu.launch -np 8 benchmarks/proc_busbw.py \
-        [--mb 64] [--reps 10] [--op allreduce] [--sweep]
+        [--mb 64] [--reps 10] [--op allreduce] [--sweep] [--pairs]
 
 Rank 0 prints one JSON line: NCCL-convention bus bandwidth
 (``bytes * 2*(n-1)/n / t`` for allreduce).  ``--sweep`` prints one
 JSON line per payload size from 1 KB up to ``--mb``, covering both
 sides of the tree->ring switchover (``T4J_RING_MIN_BYTES``, see
-docs/performance.md "TCP-tier algorithm selection").  To measure the
-TCP tier on one host, disable the same-host shm arena with
-``T4J_NO_SHM=1`` — otherwise collectives ride shared memory and never
-touch the wire algorithms.
+docs/performance.md "TCP-tier algorithm selection"); every record
+carries the chosen data plane (``tree|ring|hier|shm``) plus the
+local/leader world sizes and active knob values so BENCH trajectories
+can attribute wins.  ``--pairs`` (with ``T4J_EMU_LOCAL=k`` to emulate
+multiple nodes on one host) measures hier-vs-flat interleaved
+same-conditions pairs (docs/performance.md "hierarchical
+collectives").  To measure the TCP tier on one host, disable the
+same-host shm arena with ``T4J_NO_SHM=1`` — otherwise collectives
+ride shared memory and never touch the wire algorithms.
 """
 
 import argparse
@@ -56,6 +61,14 @@ def main():
         "the tree->ring switchover trajectory for BENCH records",
     )
     ap.add_argument(
+        "--pairs", action="store_true",
+        help="interleaved same-conditions hier-vs-flat allreduce pairs "
+        "at --mb: each timed batch alternates the hierarchical plane "
+        "off/on so phase noise hits both sides equally; one JSON "
+        "record per side plus the ratio (run with T4J_EMU_LOCAL=k to "
+        "emulate multiple nodes on one host)",
+    )
+    ap.add_argument(
         "--copy-gauntlet", action="store_true",
         help="measure the aggregate plain-memcpy rate of N timesharing "
         "ranks (no collective logic): the scheduler bound the arena's "
@@ -84,6 +97,9 @@ def main():
     assert comm.backend == "proc", "run under python -m mpi4jax_tpu.launch"
     n = comm.size
     rank = comm.rank()
+
+    if args.pairs:
+        return _pairs_main(args, comm)
 
     if args.sweep:
         # 1 KB -> --mb in x4 steps, straddling T4J_RING_MIN_BYTES so
@@ -163,8 +179,6 @@ def _measure(args, comm, mb):
     Returns ``(record, busbw, token)`` — ``busbw`` is the unrounded
     bytes/s figure (the record's ``value`` is rounded for display; the
     ceiling percentages must divide the exact measurement)."""
-    import os
-
     import jax.numpy as jnp
     import numpy as np
 
@@ -207,19 +221,7 @@ def _measure(args, comm, mb):
 
     busbw = nbytes * _busbw_factor(args.op, n) / best
 
-    # Which data plane served this size — without it, rows from the shm
-    # arena, the TCP ring and the TCP trees are indistinguishable in
-    # the trajectory.  Total message size per op mirrors the native
-    # switchover predicate (dcn.cc use_ring).
-    if os.environ.get("T4J_NO_SHM", "").strip() not in ("", "0"):
-        if args.op == "alltoall":
-            algo = "pairwise"
-        else:
-            total = nbytes * n if args.op == "allgather" else nbytes
-            algo = "ring" if total >= config.ring_min_bytes() else "tree"
-    else:
-        algo = "shm"
-
+    algo, topo = _data_plane(args.op, comm, nbytes)
     rec = {
         "metric": f"{args.op}_busbw_proc{n}",
         "value": round(busbw / 1e9, 3),
@@ -229,10 +231,116 @@ def _measure(args, comm, mb):
         "payload_bytes": nbytes,
         "sec_per_call": round(best, 6),
         "data_plane": algo,
+        "local_world": topo["local_size"],
+        "leader_world": topo["n_hosts"],
         "ring_min_bytes": config.ring_min_bytes(),
         "seg_bytes": config.seg_bytes(),
+        "leader_ring_min_bytes": config.leader_ring_min_bytes(),
     }
     return rec, busbw, tok
+
+
+def _data_plane(op, comm, nbytes):
+    """(chosen algorithm, topology) for one op at one size — mirrors
+    the native selection predicates (dcn.cc: the same-host arena gate,
+    use_hier, use_ring), so sweep records can attribute wins to the
+    plane that actually served them.  The hier answer comes from the
+    native bridge itself (``runtime.hier_would_select``), not a
+    re-derivation, so the label cannot drift from the selection."""
+    import os
+
+    from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.ops._proc import proc_topology
+    from mpi4jax_tpu.utils import config
+
+    n = comm.size
+    topo = proc_topology(comm)
+    shm_on = os.environ.get("T4J_NO_SHM", "").strip() in ("", "0")
+    if shm_on and topo["n_hosts"] == 1 and n > 1:
+        return "shm", topo
+    total = nbytes * n if op == "allgather" else nbytes
+    if op != "alltoall" and runtime.hier_would_select(
+        runtime.comm_handle(comm), total
+    ):
+        return "hier", topo
+    if op == "alltoall":
+        return "pairwise", topo
+    return ("ring" if total >= config.ring_min_bytes() else "tree"), topo
+
+
+def _pairs_main(args, comm):
+    """Interleaved same-conditions hier-vs-flat allreduce pairs.
+
+    Each timed batch runs the flat plane (``set_hier("off")``) and the
+    hierarchical plane (``set_hier("on")``) back to back, alternating
+    across batches, so co-tenant phase noise hits both sides equally —
+    the measurement convention of the PR-2 tree/ring comparison.  Rank
+    0 prints one record per side plus a ratio record."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.ops._proc import proc_topology
+    from mpi4jax_tpu.utils import config
+
+    n = comm.size
+    per = max(int(args.mb * 1e6 / 4), n)
+    per -= per % max(n, 1)
+    x = jnp.ones((per,), jnp.float32)
+    nbytes = per * 4
+    factor = _busbw_factor("allreduce", n)
+
+    tok = m.create_token()
+    best = {"off": float("inf"), "on": float("inf")}
+    for mode in ("off", "on"):  # warm both planes (compile + negotiate)
+        runtime.set_hier(mode=mode)
+        y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        np.asarray(y)
+    for _ in range(3):
+        for mode in ("off", "on"):
+            runtime.set_hier(mode=mode)
+            tok = _fence(comm, tok)
+            t0 = time.perf_counter()
+            for _ in range(args.reps):
+                y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+            np.asarray(y)
+            best[mode] = min(
+                best[mode], (time.perf_counter() - t0) / args.reps
+            )
+    runtime.set_hier(mode="auto")
+    if comm.rank() != 0:
+        return
+    topo = proc_topology(comm)
+    flat = "ring" if nbytes >= config.ring_min_bytes() else "tree"
+    vals = {}
+    for mode, plane in (("off", flat), ("on", "hier")):
+        busbw = nbytes * factor / best[mode]
+        vals[plane] = busbw
+        print(json.dumps({
+            "metric": f"allreduce_busbw_proc{n}",
+            "value": round(busbw / 1e9, 3),
+            "unit": "GB/s",
+            "nprocs": n,
+            "payload_mb": nbytes / 1e6,
+            "payload_bytes": nbytes,
+            "sec_per_call": round(best[mode], 6),
+            "data_plane": plane,
+            "local_world": topo["local_size"],
+            "leader_world": topo["n_hosts"],
+            "seg_bytes": config.seg_bytes(),
+            "interleaved_pairs": True,
+        }), flush=True)
+    print(json.dumps({
+        "metric": f"allreduce_hier_vs_flat_proc{n}",
+        "value": round(vals["hier"] / vals[flat], 2),
+        "unit": "x",
+        "nprocs": n,
+        "payload_mb": nbytes / 1e6,
+        "flat_plane": flat,
+        "local_world": topo["local_size"],
+        "leader_world": topo["n_hosts"],
+    }), flush=True)
 
 
 def _gauntlet_rate_gbps(comm, tok, mb=16, reps=4):

@@ -26,6 +26,10 @@ __all__ = [
     "last_error",
     "set_timeouts",
     "set_tuning",
+    "set_hier",
+    "topology",
+    "hier_would_select",
+    "hier_active",
     "BridgeError",
     "HANDLER_NAMES",
 ]
@@ -39,6 +43,7 @@ class BridgeError(RuntimeError):
 
 HANDLER_NAMES = [
     "t4j_allreduce",
+    "t4j_hier_allreduce",
     "t4j_reduce",
     "t4j_reduce_scatter",
     "t4j_scan",
@@ -83,11 +88,19 @@ def _load():
     lib.t4j_fault_msg.restype = ctypes.c_char_p
     lib.t4j_set_timeouts.argtypes = [ctypes.c_double, ctypes.c_double]
     lib.t4j_set_tuning.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.t4j_set_hier.argtypes = [ctypes.c_int32, ctypes.c_int64]
+    lib.t4j_topo.argtypes = [ctypes.POINTER(ctypes.c_int32)] * 5
+    lib.t4j_topo.restype = ctypes.c_int32
+    lib.t4j_hier_would_select.argtypes = [ctypes.c_int32, ctypes.c_uint64]
+    lib.t4j_hier_would_select.restype = ctypes.c_int32
+    lib.t4j_hier_active.argtypes = [ctypes.c_int32]
+    lib.t4j_hier_active.restype = ctypes.c_int32
     lib.t4j_abort_notify.argtypes = [ctypes.c_char_p]
     # data plane for the host-callback tier (TPU staging path); every
     # call returns a status: 0 ok, nonzero = failed with t4j_last_error
     i32, u64, vp = ctypes.c_int32, ctypes.c_uint64, ctypes.c_void_p
     i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.t4j_c_hier_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
     lib.t4j_c_send.argtypes = [i32, vp, u64, i32, i32]
     lib.t4j_c_recv.argtypes = [i32, vp, u64, i32, i32, i32p, i32p]
     lib.t4j_c_sendrecv.argtypes = [i32, vp, u64, vp, u64, i32, i32, i32,
@@ -104,7 +117,8 @@ def _load():
     lib.t4j_c_alltoall.argtypes = [i32, vp, vp, u64]
     for name in (
         "t4j_c_send", "t4j_c_recv", "t4j_c_sendrecv", "t4j_c_barrier",
-        "t4j_c_bcast", "t4j_c_allreduce", "t4j_c_reduce", "t4j_c_scan",
+        "t4j_c_bcast", "t4j_c_allreduce", "t4j_c_hier_allreduce",
+        "t4j_c_reduce", "t4j_c_scan",
         "t4j_c_reduce_scatter", "t4j_c_allgather", "t4j_c_gather",
         "t4j_c_scatter", "t4j_c_alltoall",
     ):
@@ -168,6 +182,76 @@ def set_tuning(ring_min_bytes=None, seg_bytes=None):
         -1 if ring_min_bytes is None else int(ring_min_bytes),
         0 if seg_bytes is None else int(seg_bytes),
     )
+
+
+_HIER_MODES = {"auto": 0, "on": 1, "off": 2}
+
+
+def set_hier(mode=None, leader_ring_min_bytes=None):
+    """Runtime override of the hierarchical-collective selection.
+
+    ``mode`` is ``"auto"`` (size threshold), ``"on"`` (force wherever
+    the topology allows) or ``"off"``; ``None`` keeps the current
+    setting.  ``leader_ring_min_bytes`` is auto mode's switchover.
+    Must be set uniformly across ranks (the launcher propagates
+    ``T4J_HIER`` / ``T4J_LEADER_RING_MIN_BYTES``): ranks disagreeing
+    on the selection would run mismatched algorithms and deadlock."""
+    lib = _load()
+    code = -1 if mode is None else _HIER_MODES[str(mode)]
+    lib.t4j_set_hier(
+        code,
+        -1 if leader_ring_min_bytes is None else int(leader_ring_min_bytes),
+    )
+
+
+def topology():
+    """Bootstrap topology of this rank, or ``None`` before init.
+
+    Returns ``{"host_id", "local_rank", "local_size", "leader_rank",
+    "n_hosts"}`` — host ordinals in first-occurrence order over world
+    ranks, the leader being the lowest world rank on the host.  This
+    is the map the hierarchical collectives are built on
+    (docs/performance.md "hierarchical collectives")."""
+    lib = _state["lib"]
+    if lib is None or not lib.t4j_initialized():
+        return None
+    vals = [ctypes.c_int32(0) for _ in range(5)]
+    if not lib.t4j_topo(*[ctypes.byref(v) for v in vals]):
+        return None
+    keys = ("host_id", "local_rank", "local_size", "leader_rank", "n_hosts")
+    return dict(zip(keys, (v.value for v in vals)))
+
+
+def hier_would_select(handle, total_bytes):
+    """Would a collective of ``total_bytes`` on this comm handle take
+    the hierarchical path right now?  Pure query — never communicates
+    (benchmarks use it to label records)."""
+    lib = _state["lib"]
+    if lib is None or not lib.t4j_initialized():
+        return False
+    return lib.t4j_hier_would_select(int(handle), int(total_bytes)) == 1
+
+
+def hier_active(handle):
+    """True once the comm's hierarchical layer has been negotiated and
+    is live (passive read)."""
+    lib = _state["lib"]
+    if lib is None or not lib.t4j_initialized():
+        return False
+    return lib.t4j_hier_active(int(handle)) == 1
+
+
+def host_hier_allreduce(handle, x, opcode):
+    """Explicitly hierarchical allreduce (raises when the topology is
+    ineligible) — the auto-selected path is :func:`host_allreduce`."""
+    import numpy as np
+
+    x = _contig(x)
+    out = np.empty_like(x)
+    _check(_state["lib"].t4j_c_hier_allreduce(
+        handle, _ptr(x), _ptr(out), x.size, dtype_code(x.dtype), opcode
+    ))
+    return out
 
 
 def set_timeouts(op_s=None, connect_s=None):
@@ -405,9 +489,11 @@ def ensure_initialized():
 
     op_s, connect_s = config.op_timeout(), config.connect_timeout()
     ring_min, seg = config.ring_min_bytes(), config.seg_bytes()
+    hier, hier_min = config.hier_mode(), config.leader_ring_min_bytes()
     lib = _load()
     lib.t4j_set_timeouts(op_s, connect_s)
     lib.t4j_set_tuning(ring_min, seg)
+    lib.t4j_set_hier(_HIER_MODES[hier], hier_min)
     rc = lib.t4j_init()
     if rc != 0:
         detail = last_error()

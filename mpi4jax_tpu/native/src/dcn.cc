@@ -157,6 +157,44 @@ long long seg_bytes() {
   return v;
 }
 
+// ---------------------------------------------- hierarchical tuning
+//
+// Selection knobs for the two-tier (shm leaf + leader ring) path
+// (docs/performance.md "hierarchical collectives").  -1 = "not set
+// yet"; Python validates via utils/config.py and calls set_hier
+// before init, the env parse is the fallback for hand-run processes.
+
+constexpr int kHierAuto = 0, kHierOn = 1, kHierOff = 2;
+
+std::atomic<int> g_hier_mode{-1};
+std::atomic<long long> g_leader_ring_min_bytes{-1};
+
+constexpr long long kDefaultLeaderRingMinBytes = 256 << 10;  // 256 KiB
+
+int hier_mode() {
+  int v = g_hier_mode.load(std::memory_order_relaxed);
+  if (v < 0) {
+    const char* s = std::getenv("T4J_HIER");
+    v = kHierAuto;
+    if (s && s[0]) {
+      if (!std::strcmp(s, "on")) v = kHierOn;
+      else if (!std::strcmp(s, "off")) v = kHierOff;
+      // anything else keeps auto; utils/config.py rejects loudly
+    }
+    g_hier_mode.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+long long leader_ring_min_bytes() {
+  long long v = g_leader_ring_min_bytes.load(std::memory_order_relaxed);
+  if (v < 0) {
+    v = env_bytes("T4J_LEADER_RING_MIN_BYTES", kDefaultLeaderRingMinBytes);
+    g_leader_ring_min_bytes.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
 // Init-phase ops (the bootstrap barrier, the shm-pipe agreement rounds)
 // are bounded by the CONNECT deadline, not the per-op one: rank startup
 // skew (python imports, jit warmup) legitimately exceeds a sub-second
@@ -439,11 +477,19 @@ constexpr uint32_t kAbortCtx = 0xFFFFFFFFu;
 //                       delay       — sleep T4J_FAULT_DELAY_MS before
 //                                     every frame send after the first
 //                                     N: slow peer / deadline trips
+//                       die_after   — _exit(42) T4J_FAULT_DELAY_MS
+//                                     after init completes: a rank
+//                                     whose data plane is frameless
+//                                     (shm arena — e.g. a non-leader
+//                                     local in a hierarchical
+//                                     collective) still dies
+//                                     deterministically mid-op
 //   T4J_FAULT_AFTER     N frames before the fault arms (default 0)
-//   T4J_FAULT_DELAY_MS  delay mode's per-frame stall (default 1000)
+//   T4J_FAULT_DELAY_MS  delay mode's per-frame stall / die_after's
+//                       countdown (default 1000)
 
 struct FaultPlan {
-  enum Mode { kNone, kRefuse, kCloseAfter, kDelay };
+  enum Mode { kNone, kRefuse, kCloseAfter, kDelay, kDieAfter };
   Mode mode = kNone;
   int rank = -1;
   long after = 0;
@@ -460,10 +506,11 @@ void parse_fault_plan() {
   if (!std::strcmp(mode, "refuse")) p.mode = FaultPlan::kRefuse;
   else if (!std::strcmp(mode, "close_after")) p.mode = FaultPlan::kCloseAfter;
   else if (!std::strcmp(mode, "delay")) p.mode = FaultPlan::kDelay;
+  else if (!std::strcmp(mode, "die_after")) p.mode = FaultPlan::kDieAfter;
   else {
     std::fprintf(stderr,
                  "r%d | t4j: unknown T4J_FAULT_MODE=%s (want refuse|"
-                 "close_after|delay); fault injection disabled\n",
+                 "close_after|delay|die_after); fault injection disabled\n",
                  g_rank, mode);
     return;
   }
@@ -987,6 +1034,22 @@ uint64_t host_fingerprint() {
   // Mixed-in (not zeroed) so an all-disabled job still agrees among
   // itself and falls back together through the ok=0 round.
   if (shm::disabled()) mix("t4j-no-shm", 10);
+  // T4J_EMU_LOCAL=k folds rank/k into the fingerprint so one box
+  // emulates ceil(size/k) nodes of k local ranks: same-emulated-node
+  // ranks keep the shm transports, cross-node pairs ride real TCP —
+  // which is what lets the hierarchical path (and its tests/benches)
+  // run on a single host.  The launcher propagates the env, so the
+  // partition is uniform by construction.
+  const char* emu = std::getenv("T4J_EMU_LOCAL");
+  if (emu && emu[0]) {
+    long k = std::atol(emu);
+    if (k >= 1) {
+      char tag[48];
+      int m = std::snprintf(tag, sizeof(tag), "t4j-emu-node-%ld",
+                            static_cast<long>(g_rank) / k);
+      mix(tag, static_cast<size_t>(m));
+    }
+  }
   return h ? h : 1;
 }
 
@@ -1277,6 +1340,21 @@ struct Comm {
   // being mistaken for this one.  Only the collective-calling thread
   // touches it (MPI serialises collectives per comm).
   uint32_t gather_seq = 0;
+  // Hierarchical (shm leaf + leader ring) layer, negotiated lazily on
+  // the first large multi-host collective (see hier_setup).  The
+  // topology vectors are pure functions of the bootstrap fingerprint
+  // table, so every member derives identical values.
+  bool hier_checked = false;
+  bool hier_ok = false;
+  int local_comm = -1;        // handle: members sharing my host
+  int leader_comm = -1;       // handle: one leader per host (host order)
+  std::vector<int> host_of;   // comm index -> host ordinal
+  std::vector<int> local_of;  // comm index -> index within its host
+  std::vector<int> host_size; // host ordinal -> member count
+  std::vector<int> leader_idx;  // host ordinal -> comm index of leader
+  int my_host = -1;
+  bool is_leader = false;
+  bool host_contiguous = false;  // comm order == host-grouped order
 };
 
 std::mutex g_comm_mu;
@@ -1723,6 +1801,9 @@ bool combine_fused(ReduceOp op, DType dt, const void* local,
 // contribution (`local`, same length) into `acc` as it lands: the fold
 // of segment k overlaps the wire transfer of segment k+1, and the
 // just-touched segment stays cache-hot between init and combine.
+// acc == local (the in-place ring the hier leader tier runs on its
+// output buffer) is legal: the accumulator already holds the local
+// contribution, so the init pass is skipped.
 void recv_combine_segmented(Comm& c, int src_idx, int tag,
                             const uint8_t* local, uint8_t* acc,
                             size_t nbytes, size_t seg, DType dt,
@@ -1734,7 +1815,7 @@ void recv_combine_segmented(Comm& c, int src_idx, int tag,
     if (f.data.size() != k) fail_size(f, k);
     if (!combine_fused(op, dt, local + o, f.data.data(), acc + o,
                        k / dsize)) {
-      std::memcpy(acc + o, local + o, k);
+      if (acc != local) std::memcpy(acc + o, local + o, k);
       combine(op, dt, f.data.data(), acc + o, k / dsize);
     }
   }
@@ -1961,6 +2042,471 @@ void multi_send(Comm& c, int tag, std::vector<RootSend>& msgs) {
   if (!failure.empty()) fail_op(failure);
 }
 
+// -------------------------------------------------- hierarchical engine
+//
+// NCCL-style two-tier collectives for communicators that span several
+// hosts with more than one rank on at least one of them: same-host
+// members reduce (or gather) into their host leader through the shm
+// arena, the leaders — one per host — run the segmented ring over the
+// TCP tier among themselves, and results fan back out through the
+// arena.  Cross-host wire traffic shrinks by the local world size
+// (the flat ring crosses the inter-host link once per LOCAL rank).
+//
+// Topology is a pure function of the bootstrap fingerprint table, so
+// every member derives identical host groups and leaders; the leaf
+// arenas and the agreement that the whole comm switches together are
+// negotiated lazily on first use (hier_setup), reusing the arena
+// agreement protocol via internal sub-communicators.  The intra- and
+// inter-node phases pipeline at T4J_SEG_BYTES granularity: the leader
+// rings chunk k while its locals are already staging/combining chunk
+// k+1 into the arena.  Every phase runs through the normal
+// csend/crecv/arena paths, so the per-op deadline, fault fail-fast and
+// abort broadcast apply — a dead non-leader local rank surfaces on
+// every survivor as a contextual BridgeError (its sockets close; shm
+// waiters observe the posted fault via detail::stopped()).
+
+constexpr int kHierTagOk = kCollTagBase + 16;
+constexpr int kHierTagVerdict = kCollTagBase + 17;
+constexpr int kHierTagRoot = kCollTagBase + 18;
+
+// Deterministic 30-bit wire context for the internal sub-comms: a pure
+// function of the parent ctx + host identity, so every member derives
+// the same channel regardless of local creation order (the same
+// requirement _stable_ctx satisfies on the Python side).
+int derive_hier_ctx(int parent_ctx, uint32_t salt, uint64_t key) {
+  uint32_t h = 0x811C9DC5u;
+  auto mix32 = [&h](uint32_t v) {
+    h ^= v;
+    h *= 0x01000193u;
+  };
+  mix32(static_cast<uint32_t>(parent_ctx));
+  mix32(salt);
+  mix32(static_cast<uint32_t>(key));
+  mix32(static_cast<uint32_t>(key >> 32));
+  int ctx = static_cast<int>(h & 0x3FFFFFFF);
+  return ctx ? ctx : 1;
+}
+
+// Fill c's topology vectors from the bootstrap fingerprints; returns
+// eligibility (>= 2 hosts, at least one with >= 2 members).  Pure and
+// deterministic: host ordinals are first-occurrence order over comm
+// indices, the leader of a host is its lowest comm index.
+bool compute_hier_topology(Comm& c) {
+  int n = static_cast<int>(c.ranks.size());
+  if (n < 2 || c.my_index < 0 || shm::disabled()) return false;
+  if (static_cast<int>(g_host_fps.size()) != g_size) return false;
+  c.host_of.assign(n, -1);
+  c.local_of.assign(n, 0);
+  c.host_size.clear();
+  c.leader_idx.clear();
+  std::vector<uint64_t> fps;
+  for (int j = 0; j < n; ++j) {
+    uint64_t fp = g_host_fps[c.ranks[j]];
+    int h = -1;
+    for (size_t k = 0; k < fps.size(); ++k)
+      if (fps[k] == fp) {
+        h = static_cast<int>(k);
+        break;
+      }
+    if (h < 0) {
+      h = static_cast<int>(fps.size());
+      fps.push_back(fp);
+      c.host_size.push_back(0);
+      c.leader_idx.push_back(j);
+    }
+    c.host_of[j] = h;
+    c.local_of[j] = c.host_size[h]++;
+  }
+  int max_local = 0;
+  for (int s : c.host_size) max_local = max_local < s ? s : max_local;
+  c.my_host = c.host_of[c.my_index];
+  c.is_leader = c.leader_idx[c.my_host] == c.my_index;
+  // comm order == host-grouped order iff host ordinals never decrease
+  // along comm indices (lets reduce_scatter skip a reorder pass)
+  c.host_contiguous = true;
+  for (int j = 1; j < n; ++j)
+    if (c.host_of[j] < c.host_of[j - 1]) c.host_contiguous = false;
+  return static_cast<int>(fps.size()) >= 2 && max_local >= 2;
+}
+
+// Comm-wide agreement that every host's leaf arena came up: leaders
+// AND their local verdicts through comm member 0 (a leader by
+// construction), then fan the result to their locals over the parent
+// channel — the arena cannot carry the "no" verdict because on "no" it
+// may not exist.  Mirrors negotiate_arena's shape one level up.
+uint8_t hier_agree(Comm& c, uint8_t mine) {
+  int nh = static_cast<int>(c.host_size.size());
+  int coord = c.leader_idx[0];
+  uint8_t verdict = mine;
+  if (c.is_leader) {
+    if (c.my_index == coord) {
+      for (int h = 1; h < nh; ++h) {
+        Frame f = crecv(c, c.leader_idx[h], kHierTagOk);
+        verdict &= f.data.size() == 1 ? f.data.data()[0] : 0;
+      }
+      for (int h = 1; h < nh; ++h)
+        csend(c, c.leader_idx[h], kHierTagVerdict, &verdict, 1);
+    } else {
+      csend(c, coord, kHierTagOk, &mine, 1);
+      Frame f = crecv(c, coord, kHierTagVerdict);
+      verdict = f.data.size() == 1 ? f.data.data()[0] : 0;
+    }
+    for (int j = 0; j < static_cast<int>(c.ranks.size()); ++j)
+      if (c.host_of[j] == c.my_host && j != c.my_index)
+        csend(c, j, kHierTagVerdict, &verdict, 1);
+  } else {
+    Frame f = crecv(c, c.leader_idx[c.my_host], kHierTagVerdict);
+    verdict = f.data.size() == 1 ? f.data.data()[0] : 0;
+  }
+  return verdict;
+}
+
+// Lazy, collective: first caller (same call site on every member — MPI
+// serialises collectives per comm) derives the topology, creates the
+// internal local/leader sub-comms, negotiates the leaf arena through
+// the existing agreement protocol, and agrees comm-wide.  On any
+// failure the whole comm drops to the flat algorithms together.
+bool hier_setup(Comm& c) {
+  {
+    std::lock_guard<std::mutex> lk(g_comm_mu);
+    if (c.hier_checked) return c.hier_ok;
+  }
+  bool ok = false;
+  int local_h = -1, leader_h = -1;
+  if (compute_hier_topology(c)) {
+    int n = static_cast<int>(c.ranks.size());
+    int nh = static_cast<int>(c.host_size.size());
+    std::vector<int> local_world, leader_world;
+    for (int j = 0; j < n; ++j)
+      if (c.host_of[j] == c.my_host) local_world.push_back(c.ranks[j]);
+    for (int h = 0; h < nh; ++h)
+      leader_world.push_back(c.ranks[c.leader_idx[h]]);
+    int leader_wr = c.ranks[c.leader_idx[c.my_host]];
+    local_h = comm_create(local_world.data(),
+                          static_cast<int>(local_world.size()),
+                          derive_hier_ctx(c.ctx, 'L', leader_wr));
+    leader_h = comm_create(leader_world.data(),
+                           static_cast<int>(leader_world.size()),
+                           derive_hier_ctx(c.ctx, 'H', 0));
+    // a single-member host needs no arena: its leader IS the member
+    // and the leaf phases degenerate to copies (the impls branch on
+    // host_size).  Only multi-member hosts negotiate a leaf arena.
+    shm::Arena* a = nullptr;
+    uint8_t mine = 1;
+    if (local_world.size() > 1) {
+      a = comm_arena(get_comm(local_h));
+      mine = a != nullptr;
+    }
+    if (std::getenv("T4J_HIER_DEBUG"))
+      std::fprintf(stderr, "r%d | hier_setup: host=%d leader=%d mine=%d\n",
+                   g_rank, c.my_host, c.leader_idx[c.my_host], mine);
+    ok = hier_agree(c, mine) != 0;
+    if (!ok && a) {
+      // every member of this host reached the same "no": drop the
+      // now-unused arena together (finalize would also reap it)
+      Comm& lcomm = get_comm(local_h);
+      std::lock_guard<std::mutex> lk(g_comm_mu);
+      if (lcomm.arena) {
+        shm::destroy(lcomm.arena);
+        lcomm.arena = nullptr;
+      }
+    }
+  }
+  std::lock_guard<std::mutex> lk(g_comm_mu);
+  c.local_comm = local_h;
+  c.leader_comm = leader_h;
+  c.hier_ok = ok;
+  c.hier_checked = true;
+  return ok;
+}
+
+// Mode/size gate shared by the live selection (use_hier) and the
+// benchmark-labeling query (hier_would_select) — one predicate, so
+// record labels can never drift from what actually ran: T4J_HIER off
+// kills the path, on forces it wherever the topology allows, auto
+// (default) takes it at or above T4J_LEADER_RING_MIN_BYTES.
+bool hier_mode_allows(size_t total_bytes) {
+  int mode = hier_mode();
+  if (mode == kHierOff || total_bytes == 0) return false;
+  if (mode == kHierAuto &&
+      static_cast<long long>(total_bytes) < leader_ring_min_bytes())
+    return false;
+  return true;
+}
+
+// Selection.  Knobs and the message size are uniform across ranks, so
+// negotiation triggers at the same call everywhere.
+bool use_hier(Comm& c, size_t total_bytes) {
+  return hier_mode_allows(total_bytes) && hier_setup(c);
+}
+
+struct HierView {
+  Comm* lc;       // my host's local sub-comm
+  Comm* hc;       // leader sub-comm (my_index >= 0 only on leaders)
+  shm::Arena* a;  // leaf arena (null only on a single-member host)
+  bool solo;      // I am my host's only member: leaf phases are copies
+};
+
+HierView hier_view(Comm& c) {
+  HierView v;
+  v.lc = &get_comm(c.local_comm);
+  v.hc = &get_comm(c.leader_comm);
+  v.solo = c.host_size[c.my_host] == 1;
+  std::lock_guard<std::mutex> lk(g_comm_mu);
+  v.a = v.lc->arena;
+  return v;
+}
+
+// Pipeline chunk for the hier phases, in bytes: total/8 keeps the
+// leader ring and the leaf folds overlapped across ~8 stages, the
+// T4J_SEG_BYTES floor keeps small messages in one or two chunks, and
+// the slot cap ceiling lets each chunk ride ONE arena piece (every
+// piece costs a 3-futex-gate rotation of all local ranks through the
+// scheduler — chunking at raw seg granularity measured 30% slower at
+// 64 MB on the 2-core box purely from gate overhead).  The slot cap
+// applies UNCONDITIONALLY — slot_bytes() is a uniform env read even
+// on an arena-less single-member host, and a solo leader computing a
+// different chunk count than its peers would desynchronise the
+// leader ring (mismatched frame sizes/iteration counts).
+size_t hier_chunk_bytes(size_t total, size_t esz) {
+  size_t chunk = seg_for(esz);
+  size_t target = total / 8;
+  if (target > chunk) chunk = target;
+  size_t cap = shm::slot_bytes();
+  if (chunk > cap) chunk = cap;
+  size_t elems = chunk / esz;
+  return (elems < 1 ? 1 : elems) * esz;
+}
+
+// Pipelined hier allreduce: per chunk, locals reduce into the leader
+// through the arena (split-phase: stage, then fold), leaders
+// allreduce the chunk over their ring, the arena fans it back out.
+// Software pipeline: everyone STAGES chunk k+1 before the leader
+// rings chunk k, so the locals' leaf fold of k+1 (shm::reduce_finish)
+// runs while the leader is still on the wire with k.
+void hier_allreduce_impl(Comm& c, const void* in, void* out, size_t count,
+                         DType dt, ReduceOp op) {
+  if (count == 0) return;  // nothing to move; stay out of the arena
+  HierView v = hier_view(c);
+  size_t esz = dtype_size(dt);
+  size_t chunk = hier_chunk_bytes(count * esz, esz) / esz;
+  size_t nchunks = (count + chunk - 1) / chunk;
+  const uint8_t* i8 = static_cast<const uint8_t*>(in);
+  uint8_t* o8 = static_cast<uint8_t*>(out);
+  auto clen = [&](size_t k) {
+    size_t left = count - k * chunk;
+    return left < chunk ? left : chunk;
+  };
+  std::vector<uint64_t> piece(nchunks, 0);
+  auto stage = [&](size_t k) {
+    if (!v.solo)
+      piece[k] = shm::reduce_stage(v.a, i8 + k * chunk * esz,
+                                   clen(k) * esz);
+  };
+  auto finish = [&](size_t k) {
+    if (v.solo)
+      std::memcpy(o8 + k * chunk * esz, i8 + k * chunk * esz,
+                  clen(k) * esz);
+    else
+      shm::reduce_finish(v.a, piece[k], o8 + k * chunk * esz, clen(k),
+                         dt, op, 0);
+  };
+  stage(0);
+  finish(0);
+  int nl = static_cast<int>(c.host_size.size());
+  for (size_t k = 0; k < nchunks; ++k) {
+    size_t o = k * chunk * esz, len = clen(k);
+    if (k + 1 < nchunks) stage(k + 1);
+    if (c.is_leader) {
+      // in-place segmented ring directly on the output chunk (leader
+      // ordinals equal leader-comm indices): no scratch allocation, no
+      // copy-back pass — recv_combine_segmented folds into the block
+      // it already holds
+      BlockPart bp(len, nl);
+      std::vector<size_t> boff(nl), blen(nl);
+      for (int b = 0; b < nl; ++b) {
+        boff[b] = bp.off(b) * esz;
+        blen[b] = bp.len(b) * esz;
+      }
+      ring_reduce_scatter(*v.hc, o8 + o, o8 + o + boff[c.my_host], boff,
+                          blen, dt, op);
+      ring_allgather(*v.hc, o8 + o, boff, blen);
+    }
+    // locals reach this fold while the leader is ringing chunk k (its
+    // chunk-k+1 contribution is already staged, so the fold needs
+    // nothing more from it until wait_folded)
+    if (k + 1 < nchunks) finish(k + 1);
+    if (!v.solo) shm::bcast(v.a, o8 + o, len * esz, 0);
+  }
+}
+
+void hier_reduce_impl(Comm& c, const void* in, void* out, size_t count,
+                      DType dt, ReduceOp op, int root) {
+  if (count == 0) return;  // nothing to move; stay out of the arena
+  HierView v = hier_view(c);
+  size_t esz = dtype_size(dt);
+  int rhost = c.host_of[root];
+  int rleader = c.leader_idx[rhost];
+  const uint8_t* i8 = static_cast<const uint8_t*>(in);
+  uint8_t* o8 = static_cast<uint8_t*>(out);
+  // non-root members must leave `out` untouched (the off-root output
+  // mirrors the input by contract), so non-root leaders accumulate
+  // into a scratch buffer
+  Buf tmp(c.is_leader && c.my_index != root ? count * esz : 0);
+  uint8_t* acc = c.my_index == root ? o8 : (c.is_leader ? tmp.data() : o8);
+  size_t chunk = hier_chunk_bytes(count * esz, esz) / esz;
+  size_t nchunks = (count + chunk - 1) / chunk;
+  auto clen = [&](size_t k) {
+    size_t left = count - k * chunk;
+    return left < chunk ? left : chunk;
+  };
+  std::vector<uint64_t> piece(nchunks, 0);
+  auto stage = [&](size_t k) {
+    if (!v.solo)
+      piece[k] = shm::reduce_stage(v.a, i8 + k * chunk * esz,
+                                   clen(k) * esz);
+  };
+  auto finish = [&](size_t k) {
+    if (v.solo)
+      std::memcpy(acc + k * chunk * esz, i8 + k * chunk * esz,
+                  clen(k) * esz);
+    else
+      shm::reduce_finish(v.a, piece[k], acc + k * chunk * esz, clen(k),
+                         dt, op, 0);
+  };
+  stage(0);
+  finish(0);
+  for (size_t k = 0; k < nchunks; ++k) {
+    if (k + 1 < nchunks) stage(k + 1);
+    if (c.is_leader)
+      reduce(c.leader_comm, acc + k * chunk * esz, acc + k * chunk * esz,
+             clen(k), dt, op, rhost);
+    if (k + 1 < nchunks) finish(k + 1);
+  }
+  // a non-leader root gets the result over the same-host pipes,
+  // segmented — a whole-message Frame would transiently buffer the
+  // full payload on both sides (the allocation class PR 2 removed)
+  if (root != rleader) {
+    if (c.my_index == rleader)
+      send_segmented(c, root, kHierTagRoot, acc, count * esz,
+                     seg_for(esz));
+    else if (c.my_index == root)
+      recv_copy_segmented(c, rleader, kHierTagRoot, o8, count * esz,
+                          seg_for(esz));
+  }
+}
+
+void hier_bcast_impl(Comm& c, void* buf, size_t nbytes, int root) {
+  HierView v = hier_view(c);
+  int rhost = c.host_of[root];
+  int rleader = c.leader_idx[rhost];
+  uint8_t* b = static_cast<uint8_t*>(buf);
+  // hop 1: a non-leader root hands the payload to its host leader
+  // (same-host: the frames ride the shm pipes), segmented to keep the
+  // transient buffering bounded
+  if (root != rleader) {
+    if (c.my_index == root)
+      send_segmented(c, rleader, kHierTagRoot, b, nbytes, seg_for(1));
+    else if (c.my_index == rleader)
+      recv_copy_segmented(c, root, kHierTagRoot, b, nbytes, seg_for(1));
+  }
+  // hops 2+3, chunked: leaders bcast chunk k among themselves (the
+  // leader of the root's host is leader-comm member rhost — leader
+  // ordinals equal host ordinals by construction), each arena fans it
+  // out while the leader tier moves chunk k+1
+  size_t chunk = hier_chunk_bytes(nbytes, 1);
+  for (size_t o = 0; o < nbytes; o += chunk) {
+    size_t len = nbytes - o < chunk ? nbytes - o : chunk;
+    if (c.is_leader) bcast(c.leader_comm, b + o, len, rhost);
+    if (!v.solo) shm::bcast(v.a, b + o, len, 0);
+  }
+}
+
+void hier_allgather_impl(Comm& c, const void* in, void* out,
+                         size_t nbytes_each) {
+  HierView v = hier_view(c);
+  int n = static_cast<int>(c.ranks.size());
+  int nh = static_cast<int>(c.host_size.size());
+  uint8_t* o8 = static_cast<uint8_t*>(out);
+  // host-block partition of the gathered payload, host-ordinal order
+  std::vector<size_t> off(nh), len(nh);
+  size_t total = 0;
+  for (int h = 0; h < nh; ++h) {
+    off[h] = total;
+    len[h] = static_cast<size_t>(c.host_size[h]) * nbytes_each;
+    total += len[h];
+  }
+  if (c.is_leader) {
+    Buf hostbuf(total);
+    // the local gather lands my host's members (local order) exactly
+    // at this host's ring block
+    if (v.solo)
+      std::memcpy(hostbuf.data() + off[c.my_host], in, nbytes_each);
+    else
+      shm::gather(v.a, in, hostbuf.data() + off[c.my_host], nbytes_each,
+                  0);
+    ring_allgather(*v.hc, hostbuf.data(), off, len);
+    // host-grouped -> comm order
+    for (int j = 0; j < n; ++j)
+      std::memcpy(o8 + static_cast<size_t>(j) * nbytes_each,
+                  hostbuf.data() + off[c.host_of[j]] +
+                      static_cast<size_t>(c.local_of[j]) * nbytes_each,
+                  nbytes_each);
+  } else {
+    shm::gather(v.a, in, nullptr, nbytes_each, 0);
+  }
+  if (!v.solo) shm::bcast(v.a, o8, total, 0);
+}
+
+void hier_reduce_scatter_impl(Comm& c, const void* in, void* out,
+                              size_t count_each, DType dt, ReduceOp op) {
+  HierView v = hier_view(c);
+  int n = static_cast<int>(c.ranks.size());
+  int nh = static_cast<int>(c.host_size.size());
+  size_t esz = dtype_size(dt);
+  size_t block = count_each * esz;
+  if (c.is_leader) {
+    // host-partial reduction of the whole payload lands on the leader,
+    // then the leader ring reduce-scatters host-sized partitions: each
+    // leader ends with its own members' blocks fully reduced
+    Buf full(block * static_cast<size_t>(n));
+    if (v.solo)
+      std::memcpy(full.data(), in, block * static_cast<size_t>(n));
+    else
+      shm::reduce(v.a, in, full.data(),
+                  count_each * static_cast<size_t>(n), dt, op, 0);
+    std::vector<size_t> off(nh), len(nh);
+    size_t total = 0;
+    for (int h = 0; h < nh; ++h) {
+      off[h] = total;
+      len[h] = static_cast<size_t>(c.host_size[h]) * block;
+      total += len[h];
+    }
+    const uint8_t* ringin = full.data();
+    Buf grouped;
+    if (!c.host_contiguous) {
+      grouped = Buf(block * static_cast<size_t>(n));
+      for (int j = 0; j < n; ++j)
+        std::memcpy(grouped.data() + off[c.host_of[j]] +
+                        static_cast<size_t>(c.local_of[j]) * block,
+                    full.data() + static_cast<size_t>(j) * block, block);
+      ringin = grouped.data();
+    }
+    Buf myblk(len[c.my_host]);
+    ring_reduce_scatter(*v.hc, ringin, myblk.data(), off, len, dt, op);
+    // one block per local member in local order: exactly the arena
+    // scatter's root layout
+    if (v.solo)
+      std::memcpy(out, myblk.data(), block);
+    else
+      shm::scatter(v.a, myblk.data(), out, block, 0);
+  } else {
+    shm::reduce(v.a, in, nullptr, count_each * static_cast<size_t>(n), dt,
+                op, 0);
+    shm::scatter(v.a, nullptr, out, block, 0);
+  }
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------- public
@@ -2013,6 +2559,82 @@ void set_tuning(long long ring_min, long long seg) {
   if (ring_min >= 0)
     g_ring_min_bytes.store(ring_min, std::memory_order_relaxed);
   if (seg >= 1) g_seg_bytes.store(seg, std::memory_order_relaxed);
+}
+
+void set_hier(int mode, long long min_bytes) {
+  // mode: 0 auto, 1 on, 2 off (anything else keeps); min_bytes < 0
+  // keeps.  Must be uniform across ranks, like set_tuning.
+  if (mode >= kHierAuto && mode <= kHierOff)
+    g_hier_mode.store(mode, std::memory_order_relaxed);
+  if (min_bytes >= 0)
+    g_leader_ring_min_bytes.store(min_bytes, std::memory_order_relaxed);
+}
+
+bool topology(TopoInfo* out) {
+  if (!g_initialized || !out) return false;
+  if (static_cast<int>(g_host_fps.size()) != g_size) {
+    if (g_size != 1) return false;
+    *out = TopoInfo{0, 0, 1, 0, 1};  // single-process job: trivial map
+    return true;
+  }
+  std::vector<uint64_t> fps;
+  TopoInfo t{-1, 0, 0, -1, 0};
+  uint64_t mine = g_host_fps[g_rank];
+  for (int r = 0; r < g_size; ++r) {
+    uint64_t fp = g_host_fps[r];
+    bool seen = false;
+    for (uint64_t k : fps)
+      if (k == fp) {
+        seen = true;
+        break;
+      }
+    if (!seen) {
+      if (fp == mine) t.host_id = static_cast<int>(fps.size());
+      fps.push_back(fp);
+    }
+    if (fp == mine) {
+      if (t.leader_rank < 0) t.leader_rank = r;
+      if (r < g_rank) ++t.local_rank;
+      ++t.local_size;
+    }
+  }
+  t.n_hosts = static_cast<int>(fps.size());
+  *out = t;
+  return true;
+}
+
+bool hier_would_select(int comm, size_t total_bytes) {
+  Comm& c = get_comm(comm);
+  if (!hier_mode_allows(total_bytes)) return false;
+  {
+    std::lock_guard<std::mutex> lk(g_comm_mu);
+    if (c.hier_checked) return c.hier_ok;
+  }
+  // not yet negotiated: answer from the pure topology predicate on a
+  // scratch copy (this query must never communicate or mutate)
+  Comm probe;
+  probe.ranks = c.ranks;
+  probe.my_index = c.my_index;
+  return compute_hier_topology(probe);
+}
+
+bool hier_active(int comm) {
+  Comm& c = get_comm(comm);
+  std::lock_guard<std::mutex> lk(g_comm_mu);
+  return c.hier_checked && c.hier_ok;
+}
+
+void hier_allreduce(int comm, const void* in, void* out, size_t count,
+                    DType dt, ReduceOp op) {
+  Comm& c = get_comm(comm);
+  LogScope log("MPI_Allreduce_hier",
+               "with " + std::to_string(count) + " items");
+  if (!hier_setup(c))
+    fail_arg(
+        "hierarchical path unavailable (single-host communicator, no "
+        "multi-rank host, T4J_NO_SHM, or the leaf arena negotiation "
+        "failed)");
+  hier_allreduce_impl(c, in, out, count, dt, op);
 }
 
 bool faulted() { return g_faulted.load(std::memory_order_acquire); }
@@ -2114,6 +2736,21 @@ int init_from_env() {
   // connect deadline (g_in_init), not the per-op one
   barrier(0);
   g_in_init.store(false, std::memory_order_relaxed);
+  if (fault_armed(FaultPlan::kDieAfter)) {
+    // time-based death, armed only after init: kills the rank even
+    // when its data plane is frameless (shm arena), so tests can land
+    // a deterministic mid-collective death on e.g. a non-leader local
+    // rank of a hierarchical collective
+    long ms = g_fault_plan.delay_ms;
+    std::thread([ms] {
+      std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+      std::fprintf(stderr,
+                   "r%d | t4j fault-injection: dying %ld ms after init\n",
+                   g_rank, ms);
+      std::fflush(stderr);
+      _exit(42);
+    }).detach();
+  }
   return 0;
 }
 
@@ -2285,6 +2922,7 @@ void bcast(int comm, void* buf, size_t nbytes, int root) {
   int n = static_cast<int>(c.ranks.size());
   if (n == 1) return;
   if (shm::Arena* a = comm_arena(c)) return shm::bcast(a, buf, nbytes, root);
+  if (use_hier(c, nbytes)) return hier_bcast_impl(c, buf, nbytes, root);
   // binomial tree rooted at `root` (rotate indices so root -> 0)
   int me = (c.my_index - root % n + n) % n;
   for (int k = 1; k < n; k <<= 1) {
@@ -2308,6 +2946,8 @@ void reduce(int comm, const void* in, void* out, size_t count, DType dt,
   int n = static_cast<int>(c.ranks.size());
   if (shm::Arena* a = comm_arena(c))
     return shm::reduce(a, in, out, count, dt, op, root);
+  if (use_hier(c, count * dtype_size(dt)))
+    return hier_reduce_impl(c, in, out, count, dt, op, root);
   size_t nbytes = count * dtype_size(dt);
   std::vector<uint8_t> acc(static_cast<const uint8_t*>(in),
                            static_cast<const uint8_t*>(in) + nbytes);
@@ -2339,6 +2979,8 @@ void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
     return shm::allreduce(a, in, out, count, dt, op);
   size_t dsize = dtype_size(dt);
   size_t nbytes = count * dsize;
+  if (use_hier(c, nbytes))
+    return hier_allreduce_impl(c, in, out, count, dt, op);
   if (use_ring(c, nbytes)) {
     // segmented ring reduce-scatter + ring allgather: each link
     // carries 2*(n-1)/n of the payload instead of the tree's full
@@ -2383,6 +3025,8 @@ void reduce_scatter(int comm, const void* in, void* out, size_t count_each,
     std::memcpy(out, tmp.data() + block * c.my_index, block);
     return;
   }
+  if (use_hier(c, block * n))
+    return hier_reduce_scatter_impl(c, in, out, count_each, dt, op);
   if (use_ring(c, block * n)) {
     std::vector<size_t> off(n), len(n, block);
     for (int b = 0; b < n; ++b) off[b] = block * b;
@@ -2422,6 +3066,8 @@ void allgather(int comm, const void* in, void* out, size_t nbytes_each) {
   if (shm::Arena* a = comm_arena(c))
     return shm::allgather(a, in, out, nbytes_each);
   int n = static_cast<int>(c.ranks.size());
+  if (use_hier(c, nbytes_each * n))
+    return hier_allgather_impl(c, in, out, nbytes_each);
   if (use_ring(c, nbytes_each * n)) {
     // ring allgather: every block travels once, (n-1)/n of the output
     // per link — vs the root-funnel gather+bcast's ~2*log2(n) copies

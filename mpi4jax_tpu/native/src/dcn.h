@@ -34,6 +34,31 @@
 //                       path is used (default 256 KiB, the measured
 //                       crossover; 0 = always ring)
 //   T4J_SEG_BYTES       ring segment size (default 1 MiB)
+//
+// Hierarchical collectives (docs/performance.md "hierarchical
+// collectives"): when a communicator spans several hosts and at least
+// one host holds more than one member, large allreduce/reduce/bcast/
+// allgather/reduce_scatter compose the two native tiers NCCL-style —
+// same-host members reduce (or gather) into their host leader through
+// the shm arena, leaders run the segmented ring over the DCN TCP tier
+// among themselves, and results fan back out through the arena.
+// Cross-host traffic shrinks by the local world size; the intra- and
+// inter-node phases pipeline at T4J_SEG_BYTES granularity (the leader
+// rings chunk k while its locals are still combining chunk k+1).
+// Knobs (validated in utils/config.py):
+//   T4J_HIER                  auto (default) | on (force, any size) |
+//                             off (never)
+//   T4J_LEADER_RING_MIN_BYTES total message size at or above which
+//                             auto mode picks the hierarchical path
+//                             (default 256 KiB)
+//   T4J_EMU_LOCAL=k           testing: fold rank/k into the host
+//                             fingerprint so one box emulates
+//                             ceil(size/k) nodes of k local ranks each
+//                             (same-host shm stays within an emulated
+//                             node; cross-node traffic rides real TCP)
+// Every phase keeps the deadline/abort contract above — a dead or
+// stalled local rank (leader or not) surfaces on every survivor as a
+// contextual BridgeError within the op deadline.
 
 #pragma once
 
@@ -119,6 +144,43 @@ void set_timeouts(double op_s, double connect_s);
 // run mismatched algorithms and deadlock); utils/config.py owns
 // validation, native/runtime.py threads the values through before init.
 void set_tuning(long long ring_min, long long seg);
+
+// Override the env-derived hierarchical-collective selection.  mode:
+// 0 = auto (size threshold), 1 = on (force wherever the topology
+// allows), 2 = off, any other value keeps the current setting.
+// min_bytes: < 0 keeps, >= 0 sets the auto-mode switchover.  Must be
+// uniform across ranks (divergent values would run mismatched
+// algorithms and deadlock); utils/config.py owns validation.
+void set_hier(int mode, long long min_bytes);
+
+// World-level topology discovered at bootstrap (host fingerprints).
+// host_id is the ordinal of this rank's host in first-occurrence
+// order over world ranks; leader_rank the lowest world rank sharing
+// the host.  Returns false before init (fields untouched).
+struct TopoInfo {
+  int host_id;
+  int local_rank;
+  int local_size;
+  int leader_rank;
+  int n_hosts;
+};
+bool topology(TopoInfo* out);
+
+// Pure selection query (no communication): would a collective of
+// total_bytes on this communicator take the hierarchical path, given
+// the current T4J_HIER mode, threshold and bootstrap topology?
+// Assumes the local arenas negotiate successfully (they are queried
+// lazily on first real use).
+bool hier_would_select(int comm, size_t total_bytes);
+// True once the communicator's hierarchical layer has actually been
+// negotiated and is live (passive read; never communicates).
+bool hier_active(int comm);
+
+// Explicitly hierarchical allreduce: throws BridgeError when the
+// topology is ineligible or the negotiation failed, instead of
+// falling back.  The auto-selected path is the plain allreduce().
+void hier_allreduce(int comm, const void* in, void* out, size_t count,
+                    DType dt, ReduceOp op);
 
 // Fault surface: after any bridge call fails, faulted() is true and
 // fault_message() describes the first failure.

@@ -146,6 +146,21 @@ ffi::Error ReduceScatterImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
   });
 }
 
+// Explicitly hierarchical allreduce (shm leaf reduce -> leader ring ->
+// shm bcast): errors instead of falling back when the topology is
+// ineligible.  The auto-selected path lives inside t4j_allreduce.
+ffi::Error HierAllreduceImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                             ffi::Result<ffi::AnyBuffer> y,
+                             ffi::Result<ffi::AnyBuffer> stamp_out,
+                             int32_t comm, int32_t op) {
+  return guarded([&] {
+    t4j::hier_allreduce(comm, x.untyped_data(), y->untyped_data(),
+                        x.element_count(), to_dtype(x.element_type()),
+                        static_cast<t4j::ReduceOp>(op));
+    touch_stamp(stamp, stamp_out);
+  });
+}
+
 ffi::Error ScanImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
                     ffi::Result<ffi::AnyBuffer> y,
                     ffi::Result<ffi::AnyBuffer> stamp_out, int32_t comm,
@@ -295,6 +310,13 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_reduce_scatter, ReduceScatterImpl,
                                   .Attr<int32_t>("comm")
                                   .Attr<int32_t>("op"));
 
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_hier_allreduce, HierAllreduceImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("op"));
+
 XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_scan, ScanImpl,
                               T4J_BUF.Arg<ffi::AnyBuffer>()
                                   .Ret<ffi::AnyBuffer>()
@@ -403,6 +425,41 @@ void t4j_set_timeouts(double op_s, double connect_s) {
 void t4j_set_tuning(int64_t ring_min_bytes, int64_t seg_bytes) {
   t4j::set_tuning(ring_min_bytes, seg_bytes);
 }
+void t4j_set_hier(int32_t mode, int64_t min_bytes) {
+  t4j::set_hier(mode, min_bytes);
+}
+// Bootstrap topology (host_id, local_rank, local_size, leader_rank,
+// n_hosts); returns 0 and leaves the outputs untouched before init.
+int32_t t4j_topo(int32_t* host_id, int32_t* local_rank,
+                 int32_t* local_size, int32_t* leader_rank,
+                 int32_t* n_hosts) {
+  t4j::TopoInfo t;
+  if (!t4j::topology(&t)) return 0;
+  if (host_id) *host_id = t.host_id;
+  if (local_rank) *local_rank = t.local_rank;
+  if (local_size) *local_size = t.local_size;
+  if (leader_rank) *leader_rank = t.leader_rank;
+  if (n_hosts) *n_hosts = t.n_hosts;
+  return 1;
+}
+// Pure selection query (never communicates): would a collective of
+// total_bytes on this comm take the hierarchical path right now?
+int32_t t4j_hier_would_select(int32_t comm, uint64_t total_bytes) {
+  try {
+    return t4j::hier_would_select(comm, total_bytes) ? 1 : 0;
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return -1;
+  }
+}
+int32_t t4j_hier_active(int32_t comm) {
+  try {
+    return t4j::hier_active(comm) ? 1 : 0;
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return -1;
+  }
+}
 void t4j_abort_notify(const char* why) { t4j::abort_notify(why); }
 
 int t4j_comm_create(const int32_t* ranks, int32_t n, int32_t ctx) {
@@ -488,6 +545,13 @@ int32_t t4j_c_scan(int32_t comm, const void* in, void* out, uint64_t count,
   return c_guard([&] {
     t4j::scan(comm, in, out, count, static_cast<t4j::DType>(dt),
               static_cast<t4j::ReduceOp>(op));
+  });
+}
+int32_t t4j_c_hier_allreduce(int32_t comm, const void* in, void* out,
+                             uint64_t count, int32_t dt, int32_t op) {
+  return c_guard([&] {
+    t4j::hier_allreduce(comm, in, out, count, static_cast<t4j::DType>(dt),
+                        static_cast<t4j::ReduceOp>(op));
   });
 }
 int32_t t4j_c_reduce_scatter(int32_t comm, const void* in, void* out,

@@ -34,6 +34,17 @@ constexpr size_t kFoldChunkBytes = 256 << 10;
 
 size_t slot_cap() {
   static size_t cap = [] {
+    // T4J_SHM_SLOT_BYTES: byte-granular override (floor 4 KiB) so the
+    // piece-boundary test matrix (tests/proc/test_shm_collectives.py)
+    // can exercise the streaming gates without megabyte payloads.
+    // T4J_SHM_SLOT_MB stays the production knob.
+    const char* b = std::getenv("T4J_SHM_SLOT_BYTES");
+    if (b && b[0]) {
+      long v = std::atol(b);
+      if (v < 4096) v = 4096;
+      if (v > (256L << 20)) v = 256L << 20;
+      return static_cast<size_t>(v);
+    }
     const char* s = std::getenv("T4J_SHM_SLOT_MB");
     long mb = s ? std::atol(s) : 8;
     if (mb < 1) mb = 1;
@@ -476,6 +487,38 @@ void reduce(Arena* a, const void* in, void* out, size_t count, DType dt,
     bump(h);
   });
 }
+
+uint64_t reduce_stage(Arena* a, const void* in, size_t nbytes) {
+  Hdr* h = a->h;
+  uint64_t p = ++a->pieces;
+  wait_consumed(h, p);
+  std::memcpy(a->slot(a->me), in, nbytes);
+  h->staged[a->me].store(p, std::memory_order_release);
+  bump(h);
+  return p;
+}
+
+void reduce_finish(Arena* a, uint64_t p, void* out, size_t count,
+                   DType dt, ReduceOp op, int root) {
+  Hdr* h = a->h;
+  wait_staged(h, p);
+  size_t seg_start, seg_len;
+  segment(count, a->n, a->me, &seg_start, &seg_len);
+  size_t esz = dtype_size(dt);
+  if (seg_len)
+    fold_segment(a, seg_start, seg_len, dt, op,
+                 a->result() + seg_start * esz);
+  h->seg_done[a->me].store(p, std::memory_order_release);
+  bump(h);
+  if (a->me == root) {
+    wait_folded(h, p);
+    std::memcpy(out, a->result(), count * esz);
+  }
+  h->acked[a->me].store(p, std::memory_order_release);
+  bump(h);
+}
+
+size_t slot_bytes() { return slot_cap(); }
 
 void scan(Arena* a, const void* in, void* out, size_t count, DType dt,
           ReduceOp op) {

@@ -55,6 +55,21 @@ void allreduce(Arena* a, const void* in, void* out, size_t count, DType dt,
                ReduceOp op);
 void reduce(Arena* a, const void* in, void* out, size_t count, DType dt,
             ReduceOp op, int root);
+
+// Split-phase reduce for the hierarchical pipeline (dcn.cc): stage
+// copies this member's contribution into its slot and returns without
+// waiting for the fold; finish completes it (fold my segment; root
+// additionally collects the result).  This is what lets the leaf fold
+// of pipeline chunk k+1 run on the local ranks while their leader is
+// still ringing chunk k over the wire.  Constraints: the payload must
+// fit ONE arena piece (nbytes <= slot_bytes()), every member pairs
+// the calls with the same arguments, and the staged/finish pairs
+// interleave with other arena ops in the same order on every member.
+uint64_t reduce_stage(Arena* a, const void* in, size_t nbytes);
+void reduce_finish(Arena* a, uint64_t piece, void* out, size_t count,
+                   DType dt, ReduceOp op, int root);
+
+size_t slot_bytes();  // per-rank slot capacity (one piece's max size)
 void scan(Arena* a, const void* in, void* out, size_t count, DType dt,
           ReduceOp op);
 void bcast(Arena* a, void* buf, size_t nbytes, int root);

@@ -77,6 +77,40 @@ def _handle(comm):
     return np.int32(runtime.comm_handle(comm))
 
 
+def proc_topology(comm):
+    """(host_id, local_rank, local_size, leader_rank, n_hosts) map for
+    a communicator, backend-agnostic.
+
+    Proc comms read the native bridge's bootstrap topology (host
+    fingerprints — the map the hierarchical collectives are built on);
+    other backends read the rendezvous registry
+    (ops/_rendezvous.py), which defaults to the trivial single-host
+    map.  Benchmarks use this to label records with the local/leader
+    world sizes."""
+    if getattr(comm, "backend", None) == "proc":
+        from mpi4jax_tpu.native import runtime
+
+        runtime.ensure_initialized()
+        topo = runtime.topology()
+        if topo is not None:
+            return topo
+    from mpi4jax_tpu.ops import _rendezvous
+
+    size = int(getattr(comm, "size", 1))
+    rank = int(comm.rank()) if hasattr(comm, "rank") else 0
+    tmap = _rendezvous.topology_map(
+        getattr(comm, "context", 0), size=size
+    )
+    host, local, leader = tmap.get(rank, (0, rank, 0))
+    return {
+        "host_id": host,
+        "local_rank": local,
+        "local_size": sum(1 for h, _l, _r in tmap.values() if h == host),
+        "leader_rank": leader,
+        "n_hosts": len({h for h, _l, _r in tmap.values()}),
+    }
+
+
 def _staged():
     """True when arrays live on an accelerator: route ops through
     ``io_callback`` (device->host staging handled by JAX) instead of the

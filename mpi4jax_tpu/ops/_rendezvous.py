@@ -221,3 +221,44 @@ _engine = Engine()
 
 def engine():
     return _engine
+
+
+# ---------------------------------------------------------- topology map
+#
+# (host_id, local_rank, leader_rank) registry for the in-process tiers,
+# the single-host counterpart of the native bridge's bootstrap topology
+# (native/runtime.py topology()).  Mesh/self "ranks" are devices of one
+# process on one host, so the published default is the trivial map —
+# but MPMD-style harnesses that emulate several hosts in one process
+# (tests, the rendezvous engine's own consumers) can publish a custom
+# partition and the hierarchical-selection heuristics read one view
+# regardless of backend (ops/_proc.py proc_topology).
+
+_topo_lock = threading.Lock()
+_topo = {}  # comm_key -> {rank: (host_id, local_rank, leader_rank)}
+
+
+def publish_topology(key, rank, host_id, local_rank, leader_rank):
+    """Publish one rank's (host_id, local_rank, leader_rank) entry for
+    communicator ``key``; overwrites a prior entry for the rank."""
+    with _topo_lock:
+        _topo.setdefault(key, {})[rank] = (
+            int(host_id), int(local_rank), int(leader_rank)
+        )
+
+
+def topology_map(key, size=None):
+    """The published map for ``key``: {rank: (host_id, local_rank,
+    leader_rank)}.  When nothing was published and ``size`` is given,
+    returns the trivial single-host map (every rank local to host 0,
+    rank 0 the leader) — the truth for the mesh/self tiers."""
+    with _topo_lock:
+        got = dict(_topo.get(key, {}))
+    if got or size is None:
+        return got
+    return {r: (0, r, 0) for r in range(int(size))}
+
+
+def reset_topology():
+    with _topo_lock:
+        _topo.clear()

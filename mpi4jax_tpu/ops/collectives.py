@@ -9,6 +9,14 @@ enclosing ``shard_map`` — data never leaves HBM.  Autodiff falls out of
 the underlying collectives' JAX rules, a superset of the reference (which
 defines AD only for allreduce and sendrecv).
 
+On the multi-process backend the native bridge picks the data plane
+per call: same-host comms ride the shm arena, cross-host ones the
+tree/segmented-ring TCP algorithms, and multi-host topologies with
+several ranks per host the hierarchical shm-leaf + leader-ring plane
+(selection knobs ``T4J_HIER`` / ``T4J_LEADER_RING_MIN_BYTES``;
+docs/performance.md).  ``ops._proc.proc_topology`` exposes the
+(host_id, local_rank, leader_rank) map the selection is built on.
+
 SPMD note (the MPMD↔SPMD gap, SURVEY §7): the reference's rooted ops have
 *rank-dependent output shapes* — e.g. gather returns ``(nproc, *shape)``
 on root and the input unchanged elsewhere
@@ -306,9 +314,12 @@ def reduce_scatter(x, op=reductions.SUM, *, comm=None, token=None):
     rank-ordered local fold (correct for ``commute=False`` operators);
     on the proc backend every builtin op is a single native
     ``reduce_scatter`` over the DCN bridge — the segmented ring at
-    large payloads, ``O((n-1)/n * payload)`` per link
-    (docs/performance.md "TCP-tier algorithm selection") — and only
-    user-defined ops take the ``all_to_all`` + fold detour.
+    large payloads, ``O((n-1)/n * payload)`` per link, and on
+    multi-host topologies with several ranks per host the hierarchical
+    shm-leaf + leader-ring plane, which cuts cross-host traffic by the
+    local world size (docs/performance.md "TCP-tier algorithm
+    selection" / "hierarchical collectives") — and only user-defined
+    ops take the ``all_to_all`` + fold detour.
     """
     x, comm, token = _prologue(x, comm, token)
     op = check_op(op)

@@ -33,9 +33,22 @@ Data-plane tuning for the TCP-tier collectives (docs/performance.md
 * ``T4J_SEG_BYTES``      — ring segment/pipelining granularity
                            (default 1 MiB; must be >= 1).
 
-Both accept an optional K/M/G suffix (``T4J_SEG_BYTES=256K``) and must
-be uniform across ranks — the launcher propagates the env, and ranks
-disagreeing on the switchover would run mismatched algorithms.
+Hierarchical (shm leaf + leader ring) selection for multi-host
+communicators with multiple ranks per host (docs/performance.md
+"hierarchical collectives"):
+
+* ``T4J_HIER``                  — ``auto`` (default: size threshold),
+                                  ``on`` (force wherever the topology
+                                  allows), ``off`` (never).
+* ``T4J_LEADER_RING_MIN_BYTES`` — auto mode's switchover: total
+                                  message size at or above which the
+                                  hierarchical path is taken (default
+                                  256 KiB, the measured crossover).
+
+The byte knobs accept an optional K/M/G suffix
+(``T4J_SEG_BYTES=256K``) and all of them must be uniform across ranks
+— the launcher propagates the env, and ranks disagreeing on a
+switchover would run mismatched algorithms.
 
 Values are validated here and handed to the native bridge before init
 (native/runtime.py), so a typo'd deadline fails loudly at launch
@@ -56,6 +69,8 @@ __all__ = [
     "byte_count",
     "ring_min_bytes",
     "seg_bytes",
+    "hier_mode",
+    "leader_ring_min_bytes",
 ]
 
 _TRUE = {"1", "true", "on", "yes"}
@@ -181,6 +196,34 @@ def seg_bytes():
             "T4J_SEG_BYTES must be >= 1 (a ring segment cannot be empty)"
         )
     return v
+
+
+def hier_mode():
+    """Hierarchical-collective selection mode: ``auto`` (size
+    threshold), ``on`` (force wherever the topology allows) or
+    ``off``.  Anything else raises — a typo'd mode must fail at
+    launch, not silently fall back to auto."""
+    v = os.environ.get("T4J_HIER")
+    if v is None or not str(v).strip():
+        return "auto"
+    v = str(v).strip().lower()
+    if v not in ("auto", "on", "off"):
+        raise ValueError(
+            f"cannot interpret T4J_HIER={v!r} (want auto|on|off)"
+        )
+    return v
+
+
+def leader_ring_min_bytes():
+    """Auto-mode switchover for the hierarchical path, in bytes: total
+    message size at or above which multi-host collectives run
+    shm-leaf-reduce + leader-ring instead of the flat algorithms
+    (default 256 KiB; 0 = whenever the topology allows)."""
+    return byte_count(
+        os.environ.get("T4J_LEADER_RING_MIN_BYTES"),
+        256 << 10,
+        name="T4J_LEADER_RING_MIN_BYTES",
+    )
 
 
 def op_timeout():

@@ -1,0 +1,15 @@
+"""Auto-mark every test in this directory ``proc``.
+
+The tests here spawn real multi-process launcher jobs over the native
+DCN bridge — a distinct CI lane (tools/ci_smoke.sh runs it explicitly
+with ``-m proc``, alongside the tier-1 sweep and the ``fault`` lane).
+Marking at collection time keeps the per-file boilerplate out and
+guarantees a new test file cannot silently fall outside the lane.
+"""
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        item.add_marker(pytest.mark.proc)

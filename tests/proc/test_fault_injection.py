@@ -273,6 +273,75 @@ except Exception as e:
     assert rc1 == RAISED, (rc1, out1[-2000:], err1[-2000:])
 
 
+# ----------------------------------------------- dead local rank (hier)
+
+
+def test_dead_nonleader_local_rank_mid_hier(tmp_path):
+    """die_after: a NON-LEADER local rank of a hierarchical collective
+    (4 ranks as 2 emulated nodes x 2 locals via T4J_EMU_LOCAL; rank 1
+    is node 0's non-leader) dies mid-collective.  Its data plane is the
+    frameless shm arena, so the frame-count fault modes cannot land
+    there — die_after kills on a timer instead.  Every survivor — the
+    dead rank's leader blocked in the arena, AND the other node's
+    ranks blocked in the leader ring / their own arena — must raise an
+    attributable BridgeError within the op deadline: the dead rank's
+    sockets close, the reader threads post the fault, and the arena
+    waiters observe the stop flag (docs/failure-semantics.md)."""
+    body = PREAMBLE + f"""
+from mpi4jax_tpu.ops._proc import proc_topology
+
+topo = proc_topology(comm)
+assert topo["n_hosts"] == 2 and topo["local_size"] == 2, topo
+x = jnp.ones((256 * 1024,), jnp.float32)  # 1 MB through the hier plane
+t0 = time.monotonic()
+try:
+    # warmup (compiles + hier negotiation) runs inside the try: the
+    # timer-based death may land during it on a slow box, and the
+    # contract — every survivor raises attributably, no hang — is the
+    # same either way
+    for i in range(3):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+    runtime.set_timeouts(op_s=3.0)
+    for i in range(500):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+    print("NO-RAISE", flush=True)
+    sys.exit({NO_RAISE})
+except Exception as e:
+    dt = time.monotonic() - t0
+    print(f"OP-RAISED after {{dt:.2f}}s: {{type(e).__name__}}: {{e}}",
+          flush=True)
+    assert dt < 30.0, dt  # bounded: deadline order, never a hang
+    sys.exit({RAISED})
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=4,
+        env_common={
+            "T4J_EMU_LOCAL": "2",
+            "T4J_HIER": "on",
+            "T4J_SEG_BYTES": "65536",
+            "T4J_FAULT_MODE": "die_after",
+            "T4J_FAULT_RANK": "1",
+            # long enough to be mid-loop, short enough to be mid-job
+            "T4J_FAULT_DELAY_MS": "4000",
+        },
+    )
+    rc1, _, err1 = res[1]
+    assert rc1 == 42, (rc1, err1[-2000:])  # the planted death
+    named_dead = False
+    for rank in (0, 2, 3):
+        rc, out, err = res[rank]
+        assert rc == RAISED, (rank, rc, out[-2000:], err[-2000:])
+        blob = out + err
+        # attributable = the native contextual message (every bridge
+        # error carries the "t4j" rank/peer/op prefix), not just any
+        # exception
+        assert "peer r" in blob or "t4j" in blob, (rank, blob[-2000:])
+        named_dead = named_dead or "peer r1" in blob or "rank 1" in blob
+    assert named_dead, [r[1][-500:] + r[2][-500:] for r in res if r]
+
+
 # ---------------------------------------------------------- connect failure
 
 

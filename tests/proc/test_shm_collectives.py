@@ -1,0 +1,183 @@
+"""Piece-boundary matrix for the shm arena reduction kernels.
+
+The same-host collective arena (native/src/shm.cc) streams payloads in
+slot-capacity pieces through futex-gated stage/fold/copy-out phases;
+this matrix pins the reduction kernels bit-exactly against a local
+fold, mirroring tests/proc/test_ring_collectives.py for the TCP ring.
+The slot capacity is shrunk to 4 KiB (T4J_SHM_SLOT_BYTES — the
+test-only byte-granular override) so every boundary of the piece
+streaming is exercised cheaply:
+
+* element counts of 1, piece-1 / piece / piece+1, multi-piece, and odd
+  counts not divisible by the world size (uneven fold segments);
+* dtype x op matrix f32/f64/i32/i64 x SUM/MAX/MIN — the builtin ops
+  the arena's ``fold_segment``/``combine`` dispatch serves;
+* allreduce, rooted reduce (off-root passthrough), reduce_scatter
+  (the arena allreduce + block-take path) and scan (the prefix fold).
+
+Results are checked BIT-exact against a local rank-ordered fold of
+deterministically regenerated per-rank arrays.  The float matrices use
+small integers so every reduction order yields the same bits — the
+property that makes bit-exactness a well-defined contract for
+floating point.
+"""
+
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401 -- probe only
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+WORKER = """
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+n, rank = comm.size, comm.rank()
+SLOT = 4096  # bytes; matches T4J_SHM_SLOT_BYTES in the test env
+
+from mpi4jax_tpu.ops._proc import proc_topology
+
+topo = proc_topology(comm)
+assert topo["n_hosts"] == 1 and topo["local_size"] == n, topo
+
+
+def rank_data(count, dtype, r):
+    # small integers: SUM over any association is exact in f32 too, so
+    # bit-exactness across fold orders is well-defined
+    rng = np.random.default_rng(4321 + 13 * r)
+    return rng.integers(0, 8, size=count).astype(dtype)
+
+
+OPS = {
+    "sum": (m.SUM, lambda a, b: a + b),
+    "max": (m.MAX, np.maximum),
+    "min": (m.MIN, np.minimum),
+}
+
+
+def fold(arrays, np_op):
+    acc = arrays[0].copy()
+    for a in arrays[1:]:
+        acc = np_op(acc, a)
+    return acc
+
+
+def check(label, got, want):
+    got = np.asarray(got)
+    assert got.dtype == want.dtype, (label, got.dtype, want.dtype)
+    assert got.shape == want.shape, (label, got.shape, want.shape)
+    assert got.tobytes() == want.tobytes(), (
+        label,
+        got.ravel()[:8],
+        want.ravel()[:8],
+    )
+
+
+# per-dtype element counts: single element, the piece-1/piece/piece+1
+# boundaries of the 4 KiB slot, multi-piece, odd counts not divisible
+# by n (uneven fold segments, incl. segments of length 0 for count < n)
+CASES = {}
+for dtype in (np.float32, np.float64, np.int32, np.int64):
+    per = SLOT // np.dtype(dtype).itemsize
+    CASES[dtype] = [1, n - 1 if n > 1 else 2, per - 1, per, per + 1,
+                    3 * per + 7, 5 * n + 3]
+
+for dtype, counts in CASES.items():
+    for count in counts:
+        per_rank = [rank_data(count, dtype, r) for r in range(n)]
+        mine = per_rank[rank]
+        for opname, (op, np_op) in OPS.items():
+            want = fold(per_rank, np_op)
+            label = f"{np.dtype(dtype).name}/{opname}/count={count}"
+
+            y, _ = m.allreduce(jnp.asarray(mine), op=op, comm=comm)
+            check("shm allreduce " + label, y, want)
+
+            root = count % n  # rotate roots across cases
+            yr, _ = m.reduce(jnp.asarray(mine), op, root, comm=comm)
+            if rank == root:
+                check("shm reduce " + label, yr, want)
+            else:
+                check("shm reduce passthrough " + label, yr, mine)
+
+        # scan: inclusive prefix fold in rank order
+        want_scan = fold(per_rank[: rank + 1], lambda a, b: a + b)
+        ys, _ = m.scan(jnp.asarray(mine), m.SUM, comm=comm)
+        check(f"shm scan {np.dtype(dtype).name}/{count}", ys, want_scan)
+
+        # reduce_scatter rides the arena allreduce + block take
+        rows = [
+            rank_data(n * count, dtype, 900 + r).reshape(n, count)
+            for r in range(n)
+        ]
+        want_rs = fold([rws[rank] for rws in rows], lambda a, b: a + b)
+        y_rs, _ = m.reduce_scatter(
+            jnp.asarray(rows[rank]), op=m.SUM, comm=comm
+        )
+        check(f"shm reduce_scatter {np.dtype(dtype).name}/{count}",
+              y_rs, want_rs)
+
+print(f"MATRIX-OK {rank}", flush=True)
+"""
+
+
+def _run_matrix(nprocs, timeout=240):
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(textwrap.dedent(WORKER))
+        path = f.name
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("T4J_NO_SHM", None)  # the arena IS the system under test
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["T4J_SHM_SLOT_BYTES"] = "4096"  # tiny pieces: boundaries stay cheap
+    popen = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi4jax_tpu.launch",
+            "-np", str(nprocs), path,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=str(REPO),
+        start_new_session=True,
+    )
+    try:
+        out, err = popen.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(popen.pid), signal.SIGKILL)
+        out, err = popen.communicate()
+        raise AssertionError(f"shm matrix hung\n{out}\n{err}")
+    assert popen.returncode == 0, (popen.returncode, out[-3000:],
+                                   err[-3000:])
+    for r in range(nprocs):
+        assert f"MATRIX-OK {r}" in out, (r, out[-3000:], err[-3000:])
+
+
+def test_shm_matrix_non_power_of_two_world():
+    """n=3: uneven fold segments everywhere, incl. zero-length segments
+    for the single-element payloads."""
+    _run_matrix(3)
+
+
+def test_shm_matrix_even_world():
+    _run_matrix(4)

@@ -119,6 +119,46 @@ class TestSegBytes:
             config.seg_bytes()
 
 
+class TestHierMode:
+    def test_default_is_auto(self, monkeypatch):
+        monkeypatch.delenv("T4J_HIER", raising=False)
+        assert config.hier_mode() == "auto"
+
+    @pytest.mark.parametrize("v,want", [
+        ("auto", "auto"), ("on", "on"), ("off", "off"),
+        ("ON", "on"), (" off ", "off"),
+    ])
+    def test_values(self, monkeypatch, v, want):
+        monkeypatch.setenv("T4J_HIER", v)
+        assert config.hier_mode() == want
+
+    @pytest.mark.parametrize("bad", ["yes", "1", "hier", "always"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        # a typo'd mode must fail at launch, not silently run auto
+        monkeypatch.setenv("T4J_HIER", bad)
+        with pytest.raises(ValueError, match="T4J_HIER"):
+            config.hier_mode()
+
+
+class TestLeaderRingMinBytes:
+    def test_default_is_256k(self, monkeypatch):
+        monkeypatch.delenv("T4J_LEADER_RING_MIN_BYTES", raising=False)
+        assert config.leader_ring_min_bytes() == 256 << 10
+
+    def test_env_value_with_suffix(self, monkeypatch):
+        monkeypatch.setenv("T4J_LEADER_RING_MIN_BYTES", "4M")
+        assert config.leader_ring_min_bytes() == 4 << 20
+
+    def test_zero_means_whenever_eligible(self, monkeypatch):
+        monkeypatch.setenv("T4J_LEADER_RING_MIN_BYTES", "0")
+        assert config.leader_ring_min_bytes() == 0
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_LEADER_RING_MIN_BYTES", "lots")
+        with pytest.raises(ValueError, match="T4J_LEADER_RING_MIN_BYTES"):
+            config.leader_ring_min_bytes()
+
+
 def test_ensure_initialized_rejects_bad_tuning(monkeypatch):
     """The validation is threaded through native/runtime.py, same as
     the deadlines: a bad env value aborts initialisation before any
