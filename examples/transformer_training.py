@@ -267,3 +267,33 @@ def main(argv=None):
 
 if __name__ == "__main__":
     main()
+
+
+# -- t4j-lint entries (trace-time contract verification; no execution) --
+
+
+def _lint_dense_train_step():
+    import jax
+    import jax.numpy as jnp
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.models import transformer as tfm
+
+    mesh = jax.make_mesh(
+        (2, 2, 2), ("dp", "tp", "sp"),
+        axis_types=(jax.sharding.AxisType.Auto,) * 3,
+    )
+    world = m.MeshComm.from_mesh(mesh)
+    cfg = tfm.TransformerConfig(
+        vocab=32, d_model=16, layers=2, heads=4, kv_heads=2, head_dim=8,
+        d_ff=32,
+    )
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    step = tfm.make_global_train_step(
+        mesh, world.sub("dp"), world.sub("tp"), world.sub("sp"), cfg,
+        lr=1e-1,
+    )
+    return step(params, (tokens, jnp.roll(tokens, -1, axis=1)))
+
+
+T4J_LINT_ENTRIES = [("dense_train_step_2x2x2", _lint_dense_train_step)]

@@ -1,0 +1,37 @@
+"""mpi4jax_tpu.analysis — trace-time communication contract verifier.
+
+Because every mpi4jax_tpu program is *traced*, its complete
+communication schedule is known before the first byte moves.  This
+subsystem exploits that to reject broken programs up front, the
+capability classic MPI tooling (MUST's deadlock detection, MPI-Checker's
+send/recv matching) can only approximate over C sources:
+
+* :func:`verify_comm` / ``t4j-lint`` — the static single-trace pass:
+  token-chain misuse, unmatched/mismatched send-recv envelopes,
+  self-deadlocking wait-for orders, collectives under rank-dependent
+  branches, op/comm contract violations.  Stable rule IDs T4J001...
+  (docs/static-analysis.md).
+* :func:`guard` + ``T4J_VERIFY=off|fingerprint|full`` — the cross-rank
+  schedule-fingerprint pass: each rank hashes its extracted schedule
+  and exchanges digests before executing, so MPMD schedule divergence
+  raises :class:`CommContractError` immediately on every rank instead
+  of hanging until ``T4J_OP_TIMEOUT``.
+"""
+
+from mpi4jax_tpu.analysis.contracts import (
+    CommContractError,
+    CommEvent,
+    Finding,
+    RULES,
+)
+from mpi4jax_tpu.analysis.verify import Report, guard, verify_comm
+
+__all__ = [
+    "CommContractError",
+    "CommEvent",
+    "Finding",
+    "RULES",
+    "Report",
+    "guard",
+    "verify_comm",
+]

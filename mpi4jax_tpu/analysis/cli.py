@@ -1,0 +1,145 @@
+"""``t4j-lint`` — command-line front end of the contract verifier.
+
+Lints the communication schedules of Python programs before any byte
+moves::
+
+    t4j-lint examples/shallow_water.py mpi4jax_tpu/models/transformer.py
+    python -m mpi4jax_tpu.analysis.cli --list examples/shallow_water.py
+
+A target file declares what to lint via a module-level
+
+    T4J_LINT_ENTRIES = [("name", zero_arg_thunk), ...]
+
+list: each thunk builds a representative (small) input set and runs the
+program's communication path; the CLI traces it with
+:func:`~mpi4jax_tpu.analysis.verify_comm` — nothing executes, so
+entries are cheap even for programs whose real inputs are huge.  Files
+without ``T4J_LINT_ENTRIES`` are reported as skipped (exit code is
+unaffected): lint coverage is opt-in per program, exactly like a test.
+
+Exit codes: 0 clean, 1 findings, 2 usage/target errors — the usual
+linter contract so CI lanes (tools/ci_smoke.sh lint lane) can gate on
+it.
+"""
+
+import argparse
+import importlib.util
+import os
+import pathlib
+import sys
+
+__all__ = ["main"]
+
+
+def _ensure_devices():
+    """Give mesh-backed entries a virtual 8-device CPU slice, mirroring
+    tests/conftest.py — must happen before jax initialises."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_module(path):
+    path = pathlib.Path(path).resolve()
+    name = f"_t4j_lint_{path.stem}_{abs(hash(str(path))) % 10**8}"
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load {path}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _entries(mod):
+    raw = getattr(mod, "T4J_LINT_ENTRIES", None)
+    if raw is None:
+        return None
+    out = []
+    for item in raw:
+        if callable(item):
+            out.append((getattr(item, "__name__", "entry"), item))
+        else:
+            name, thunk = item
+            out.append((str(name), thunk))
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="t4j-lint",
+        description="trace-time communication contract verifier "
+        "(rule catalog: docs/static-analysis.md)",
+    )
+    parser.add_argument("files", nargs="+", help="Python files to lint")
+    parser.add_argument(
+        "--mode", default="full", choices=["fingerprint", "full"],
+        help="verification depth (default: full)",
+    )
+    parser.add_argument(
+        "--list", action="store_true",
+        help="list each file's lint entries without verifying",
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="only print findings and the final summary",
+    )
+    args = parser.parse_args(argv)
+
+    _ensure_devices()
+    from mpi4jax_tpu.analysis.verify import verify_comm
+
+    n_findings = 0
+    n_entries = 0
+    broken = 0
+    for path in args.files:
+        try:
+            mod = _load_module(path)
+        except Exception as exc:
+            print(f"{path}: cannot import target: {exc}", file=sys.stderr)
+            broken += 1
+            continue
+        entries = _entries(mod)
+        if entries is None:
+            if not args.quiet:
+                print(f"{path}: no T4J_LINT_ENTRIES, skipped")
+            continue
+        for name, thunk in entries:
+            if args.list:
+                print(f"{path}::{name}")
+                continue
+            n_entries += 1
+            try:
+                report = verify_comm(thunk, mode=args.mode)()
+            except Exception as exc:
+                print(
+                    f"{path}::{name}: verification crashed: {exc}",
+                    file=sys.stderr,
+                )
+                broken += 1
+                continue
+            for note in report.notes:
+                print(f"{path}::{name}: note: {note}")
+            if report.ok:
+                if not args.quiet:
+                    print(f"{path}::{name}: {report}")
+            else:
+                n_findings += len(report.findings)
+                for f in report.findings:
+                    print(f"{path}::{name}: {f}")
+
+    if not args.list and not args.quiet:
+        print(
+            f"t4j-lint: {n_entries} entr{'y' if n_entries == 1 else 'ies'}"
+            f" checked, {n_findings} finding(s)"
+        )
+    if broken:
+        return 2
+    return 1 if n_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

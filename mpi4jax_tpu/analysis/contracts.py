@@ -1,0 +1,489 @@
+"""Communication-contract rule core for ``t4j-lint`` / ``verify_comm``.
+
+This module is the *pure* half of the analyzer: the rule catalog, the
+symbolic-schedule data model, the schedule checks, and the fingerprint
+hashing.  It deliberately imports **nothing** from jax or the rest of
+the package at module scope, so the rule logic is unit-testable on any
+container (including old-jax ones where the package itself cannot
+import) by loading this file directly — see tests/analysis/conftest.py.
+
+Background: classic MPI verifiers split the same way — MUST's runtime
+deadlock detector and MPI-Checker's static send/recv matching both
+operate on an extracted per-process *communication schedule*, not on
+the host language.  Because mpi4jax_tpu programs are traced, the
+schedule here is exact (every op the program will ever issue appears
+once, in program order), which makes the classic checks decidable at
+trace time: token misuse, unmatched or mismatched envelopes,
+self-deadlocking wait-for orders, and rank-divergent branches are all
+reported before the first byte moves (docs/static-analysis.md).
+
+The impure halves live next door: :mod:`.record` captures events from
+the op layer while a trace runs, :mod:`.jaxpr_walk` recurses into
+pjit/scan/while/cond sub-jaxprs, :mod:`.fingerprint` exchanges schedule
+digests across ranks.
+"""
+
+import hashlib
+import re
+from dataclasses import dataclass
+
+__all__ = [
+    "RULES",
+    "CommEvent",
+    "Finding",
+    "CommContractError",
+    "check_schedule",
+    "classify_trace_error",
+    "step_signature",
+    "schedule_lines",
+    "schedule_digest",
+    "first_divergence",
+    "NATIVE_DTYPES",
+]
+
+
+# ----------------------------------------------------------- rule catalog
+#
+# Stable IDs: tooling (CI greps, issue trackers, suppressions) keys on
+# these, so an ID is never renumbered or reused once released.  The
+# catalog with examples lives in docs/static-analysis.md.
+
+RULES = {
+    "T4J001": "forked token chain: one token consumed by more than one "
+              "communication op",
+    "T4J002": "dropped pending send: a staged send is never matched by a "
+              "recv before its token chain ends",
+    "T4J003": "send/recv envelope mismatch: no staged send can satisfy "
+              "this recv under the comm's world (peer/tag/shape/dtype)",
+    "T4J004": "point-to-point wait-for cycle (self-deadlock): a blocking "
+              "recv is ordered before the only send that could satisfy it",
+    "T4J005": "collective under rank-dependent branch: cond branches "
+              "selected by a rank-derived predicate disagree on their "
+              "communication schedule",
+    "T4J006": "op/comm contract mismatch: dtype, shape, reduce-op, root "
+              "or partner rank disagrees with the communicator",
+    "T4J007": "cross-rank schedule divergence: ranks extracted different "
+              "communication schedules for one program (fingerprint pass)",
+}
+
+
+class CommContractError(RuntimeError):
+    """A communication-contract violation detected before execution.
+
+    Raised by the cross-rank fingerprint pass on schedule divergence
+    (rule T4J007) and by :func:`mpi4jax_tpu.analysis.guard` when the
+    static pass reports findings.  Carries ``findings`` (list of
+    :class:`Finding`) when produced by the static pass.
+    """
+
+    def __init__(self, message, findings=()):
+        super().__init__(message)
+        self.findings = list(findings)
+
+
+@dataclass(frozen=True)
+class CommEvent:
+    """One communication op in a rank's extracted schedule.
+
+    ``token_in`` / ``token_out`` are opaque identities (``id()`` of the
+    Token objects at trace time) used for chain analysis; ``pending_out``
+    summarises the token's staged-send queue after the op (mirroring
+    ``Token.pending`` / ``Token.pending_meta`` bookkeeping in
+    ops/_core.py).  ``rank`` is the calling rank when it is static
+    (self/proc backends) and ``None`` on the mesh backend, where the
+    rank is a traced value.
+    """
+
+    seq: int
+    kind: str                 # public op name: "allreduce", "send", ...
+    comm_key: tuple           # ops/_core.comm_key(comm)
+    backend: str              # "mesh" | "self" | "proc"
+    comm_size: int
+    dtype: str = ""
+    shape: tuple = ()
+    reduce_op: str = ""       # "" for non-reductions
+    tag: int | None = None
+    source: object = None     # int | tuple(pairs) | "ANY" | "traced" | None
+    dest: object = None
+    root: int | None = None
+    rank: int | None = None
+    comm_ranks: tuple = ()    # world ranks of the comm's members, if known
+    token_in: int | None = None
+    token_out: int | None = None
+    pending_out: tuple = ()   # tuple of short strings, one per staged send
+    src_info: str = ""        # "file.py:123" best-effort user frame
+    scope: tuple = ()         # trace-nesting path, outermost first
+
+    def describe(self):
+        bits = [self.kind, f"comm={_fmt_comm(self.comm_key)}"]
+        if self.shape or self.dtype:
+            bits.append(f"{self.dtype}[{'x'.join(map(str, self.shape))}]")
+        if self.reduce_op:
+            bits.append(f"op={self.reduce_op}")
+        if self.root is not None:
+            bits.append(f"root={self.root}")
+        if self.dest is not None:
+            bits.append(f"dest={self.dest}")
+        if self.source is not None:
+            bits.append(f"source={self.source}")
+        if self.tag is not None:
+            bits.append(f"tag={self.tag}")
+        return " ".join(bits)
+
+
+def _fmt_comm(comm_key):
+    try:
+        return "/".join(str(p) for p in comm_key)
+    except TypeError:
+        return str(comm_key)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, with stable ID and source anchoring."""
+
+    rule: str                 # "T4J001" ...
+    message: str
+    src_info: str = ""
+    event_seq: int | None = None
+
+    def __str__(self):
+        loc = f" [{self.src_info}]" if self.src_info else ""
+        return f"{self.rule}: {self.message}{loc}"
+
+
+def _finding(rule, message, event=None):
+    return Finding(
+        rule=rule,
+        message=message,
+        src_info=event.src_info if event is not None else "",
+        event_seq=event.seq if event is not None else None,
+    )
+
+
+# ------------------------------------------------------- schedule checks
+
+
+def check_schedule(events):
+    """Run every pure-schedule rule over an ordered event list.
+
+    Returns a list of :class:`Finding` (empty when the schedule is
+    clean).  Rules needing the jaxpr (T4J005) or other ranks (T4J007)
+    live in :mod:`.jaxpr_walk` / :mod:`.fingerprint`.
+    """
+    findings = []
+    findings += _check_token_forks(events)
+    findings += _check_dropped_sends(events)
+    findings += _check_self_deadlock(events)
+    findings += _check_native_dtypes(events)
+    return findings
+
+
+def _check_token_forks(events):
+    """T4J001 — each token identity may be consumed by at most one op.
+
+    Consuming a token twice forks the ordering chain: the two branches
+    carry no mutual ordering, so the relative execution order of their
+    collectives is undefined across devices — the exact failure mode
+    the reference declares UB (docs/sharp-bits.rst there) and that
+    surfaces as a cross-device deadlock at runtime.
+    """
+    findings = []
+    first_use = {}
+    for ev in events:
+        if ev.token_in is None:
+            continue
+        prev = first_use.get(ev.token_in)
+        if prev is not None:
+            findings.append(_finding(
+                "T4J001",
+                f"token consumed by {prev.kind} (step {prev.seq}"
+                f"{', ' + prev.src_info if prev.src_info else ''}) is "
+                f"consumed again by {ev.kind}: the ordering chain forks "
+                "and the two branches may execute in different orders "
+                "on different devices. Thread the token returned by "
+                f"{prev.kind} instead.",
+                ev,
+            ))
+        else:
+            first_use[ev.token_in] = ev
+    return findings
+
+
+def _check_dropped_sends(events):
+    """T4J002 — staged sends must be drained before their chain ends.
+
+    Mirrors ``Token.assert_drained`` (ops/_core.py), but at lint time
+    over the whole trace: a token that still carries pending sends and
+    is never consumed by a later op means those payloads can never be
+    delivered (the matching recv would have had to pop them from this
+    very token).
+    """
+    consumed = {ev.token_in for ev in events if ev.token_in is not None}
+    findings = []
+    for ev in events:
+        if not ev.pending_out:
+            continue
+        if ev.token_out is not None and ev.token_out in consumed:
+            continue  # chain continues; a later op may drain it
+        descs = "; ".join(ev.pending_out)
+        findings.append(_finding(
+            "T4J002",
+            f"token returned by {ev.kind} still carries unmatched "
+            f"send(s) [{descs}] and no later op consumes it. Every "
+            "send must be paired with a recv on the same token chain "
+            "within the trace.",
+            ev,
+        ))
+    return findings
+
+
+def _check_self_deadlock(events):
+    """T4J004 — per-rank wait-for order on blocking p2p (proc backend).
+
+    The proc tier executes ops in program order and its ``recv``
+    blocks.  A ``recv(source=me)`` can therefore only be satisfied by a
+    ``send(dest=me)`` issued *earlier* in this same rank's schedule; if
+    the only matching send comes later (or never), the recv blocks
+    forever — the minimal wait-for cycle, detectable from one rank's
+    schedule alone (cross-rank cycles are the fingerprint pass's and
+    the runtime deadline's job).
+    """
+    findings = []
+    by_comm = {}
+    for ev in events:
+        if ev.backend != "proc":
+            continue
+        by_comm.setdefault(ev.comm_key, []).append(ev)
+    for seq_events in by_comm.values():
+        # multiset of sends-to-self already issued: (tag,) -> count
+        posted = {}
+        for ev in seq_events:
+            me = ev.rank
+            if me is None:
+                continue
+            if ev.kind == "send" and ev.dest == me:
+                posted[ev.tag] = posted.get(ev.tag, 0) + 1
+            elif ev.kind == "recv" and ev.source == me:
+                want = ev.tag
+                match = None
+                for tag in posted:
+                    if posted[tag] <= 0:
+                        continue
+                    if want is None or want == -1 or tag == want:
+                        match = tag
+                        break
+                if match is not None:
+                    posted[match] -= 1
+                    continue
+                later = [
+                    o for o in seq_events
+                    if o.seq > ev.seq and o.kind == "send" and o.dest == me
+                    and (want is None or want == -1 or o.tag == want)
+                ]
+                if later:
+                    where = later[0]
+                    findings.append(_finding(
+                        "T4J004",
+                        f"recv(source={me}, tag={_fmt_tag(want)}) on rank "
+                        f"{me} blocks before the matching send at step "
+                        f"{where.seq}"
+                        f"{' (' + where.src_info + ')' if where.src_info else ''}"
+                        " executes: a rank cannot receive from itself "
+                        "before it has sent (wait-for cycle of length 1). "
+                        "Issue the send first.",
+                        ev,
+                    ))
+                else:
+                    findings.append(_finding(
+                        "T4J004",
+                        f"recv(source={me}, tag={_fmt_tag(want)}) on rank "
+                        f"{me} waits for a send-to-self that this rank's "
+                        "schedule never issues: it can never complete.",
+                        ev,
+                    ))
+    return findings
+
+
+def _fmt_tag(tag):
+    return "ANY" if tag in (None, -1) else tag
+
+
+# dtype names the native bridge can move (native/runtime.py
+# _DTYPE_CODES; kept as a name list here so this module stays
+# import-free — drift is pinned by tests/analysis/test_rules.py)
+NATIVE_DTYPES = frozenset({
+    "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool",
+    "complex64", "complex128", "float16", "bfloat16",
+})
+
+
+def _check_native_dtypes(events):
+    """T4J006 — proc-tier ops must use dtypes the native bridge can
+    move; anything else dies at execution time inside a callback with a
+    much less useful traceback."""
+    findings = []
+    for ev in events:
+        if ev.backend == "proc" and ev.dtype and ev.dtype not in NATIVE_DTYPES:
+            findings.append(_finding(
+                "T4J006",
+                f"{ev.kind} on a proc communicator uses dtype "
+                f"{ev.dtype}, which the native bridge cannot move "
+                "(supported: the 15-entry table in native/runtime.py). "
+                "Cast before the op.",
+                ev,
+            ))
+    return findings
+
+
+# ------------------------------------- trace-error classification (T4J00x)
+#
+# The op layer already rejects many contract violations eagerly at
+# trace time (ops/p2p.py, ops/collectives.py, utils/validation.py).
+# Under verify_comm those exceptions become *findings* with stable rule
+# IDs instead of a crash mid-trace, so one lint run reports them
+# uniformly alongside the schedule rules.  Matchers key on stable
+# phrases from the ops' own error messages (their tests assert on the
+# same phrases, so they are load-bearing strings already).
+
+_ERROR_RULES = (
+    # p2p trace-time matching failures -> envelope mismatch
+    (r"recv found no matching in-trace send", "T4J003"),
+    (r"recv template shape/dtype .* does not match staged send", "T4J003"),
+    (r"pattern is not a permutation", "T4J003"),
+    (r"still carries unmatched send", "T4J002"),
+    (r"was never matched by a recv", "T4J002"),
+    # op/comm contract violations the validation layer rejects
+    (r"out of range for communicator", "T4J006"),
+    (r"alltoall input must have shape", "T4J006"),
+    (r"reduce_scatter input must have shape", "T4J006"),
+    (r"[Ss]catter input must have shape", "T4J006"),
+    (r"unsupported dtype for the native bridge", "T4J006"),
+    (r"must describe one global permutation", "T4J003"),
+    (r"requires uniform send/recv\s+shapes", "T4J006"),
+    (r"bare integer rank is ambiguous under SPMD", "T4J006"),
+)
+
+
+def classify_trace_error(exc):
+    """Map a trace-time exception from the op layer to a rule ID.
+
+    Returns ``None`` when the exception is not a recognised
+    communication-contract violation (it should then propagate — an
+    unrelated bug in the traced program is not a lint finding).
+    """
+    text = str(exc)
+    for pattern, rule in _ERROR_RULES:
+        if re.search(pattern, text):
+            return rule
+    return None
+
+
+# ----------------------------------------------------------- fingerprints
+
+
+def step_signature(ev):
+    """Canonical one-line signature of a schedule step.
+
+    This is the unit of cross-rank agreement: two ranks executing "the
+    same program" must produce identical signature sequences.  Fields
+    that legitimately differ per rank (the rank itself, source info,
+    token identities) are excluded; fields that must agree (op kind,
+    comm identity and size, dtype/shape, reduce op, root, tag, and the
+    p2p pattern) are included.
+    """
+    parts = [
+        ev.kind,
+        _fmt_comm(ev.comm_key),
+        f"n={ev.comm_size}",
+        ev.dtype or "-",
+        "x".join(map(str, ev.shape)) if ev.shape else "-",
+        ev.reduce_op or "-",
+        f"root={ev.root}" if ev.root is not None else "-",
+        f"tag={ev.tag}" if ev.tag is not None else "-",
+    ]
+    # p2p patterns: a static global pattern must agree verbatim; a
+    # per-rank int partner legitimately differs across MPMD ranks, so
+    # it is reduced to its *kind* (the matched pair is the other rank's
+    # business — MPI's envelope matching, checked at runtime)
+    for name, spec in (("dst", ev.dest), ("src", ev.source)):
+        if spec is None:
+            parts.append("-")
+        elif isinstance(spec, tuple):
+            parts.append(f"{name}={spec}")
+        else:
+            parts.append(f"{name}:{_spec_kind(spec)}")
+    return "|".join(parts)
+
+
+def _spec_kind(spec):
+    if spec == "ANY":
+        return "any"
+    if spec == "traced":
+        return "traced"
+    if isinstance(spec, int):
+        return "rank"
+    return "static"
+
+
+def schedule_lines(events):
+    """The schedule as an ordered list of signature lines."""
+    return [step_signature(ev) for ev in events]
+
+
+def schedule_digest(events):
+    """(n_steps, 32-byte sha256) over the canonical schedule text."""
+    text = "\n".join(schedule_lines(events))
+    return len(events), hashlib.sha256(text.encode()).digest()
+
+
+def first_divergence(lines_by_rank):
+    """Locate the first differing step across ranks' schedule lines.
+
+    ``lines_by_rank`` is a list (indexed by rank) of line lists.
+    Returns ``(step_index, details)`` where ``details`` maps rank ->
+    its line at that step (or ``"<schedule ends>"``), or ``None`` when
+    all schedules agree.
+    """
+    if not lines_by_rank:
+        return None
+    longest = max(len(lines) for lines in lines_by_rank)
+    for i in range(longest):
+        seen = {}
+        for rank, lines in enumerate(lines_by_rank):
+            line = lines[i] if i < len(lines) else "<schedule ends>"
+            seen.setdefault(line, rank)
+        if len(seen) > 1:
+            details = {}
+            for rank, lines in enumerate(lines_by_rank):
+                details[rank] = (
+                    lines[i] if i < len(lines) else "<schedule ends>"
+                )
+            return i, details
+        if not seen:
+            break
+    return None
+
+
+def divergence_message(step, details, deadline_hint=None):
+    """Human-readable CommContractError text naming the first differing
+    step — raised identically on every rank so each job log carries the
+    full diagnosis regardless of which rank the user inspects."""
+    by_line = {}
+    for rank, line in sorted(details.items()):
+        by_line.setdefault(line, []).append(rank)
+    sides = "; ".join(
+        f"rank{'s' if len(ranks) > 1 else ''} "
+        f"{','.join(map(str, ranks))}: {line}"
+        for line, ranks in by_line.items()
+    )
+    msg = (
+        f"T4J007: communication schedules diverge at step {step}: "
+        f"{sides}. Every rank of a communicator must issue the same "
+        "collective sequence; a rank-dependent branch or a mismatched "
+        "tag/shape/reduce-op is the usual cause (docs/static-analysis.md)."
+    )
+    if deadline_hint:
+        msg += f" ({deadline_hint})"
+    return msg

@@ -1,0 +1,242 @@
+"""Cross-rank schedule-fingerprint pass (rule T4J007).
+
+The static single-trace pass sees one rank's program; on the
+multi-process (MPMD) backend the classic failure mode is *divergence* —
+per-rank Python control flow makes rank A trace an ``allreduce`` where
+rank B traced a ``bcast``, and the job hangs until PR 1's
+``T4J_OP_TIMEOUT`` deadline converts the hang into a ``BridgeError``
+after a full timeout.  This pass turns that into an immediate,
+attributed error: before executing, every rank serialises its extracted
+schedule (op kind, comm key, dtype, shape, reduce op, root, tag,
+per-comm order — contracts.step_signature), exchanges the serialisation
+with every other rank, and every rank independently diffs the per-comm
+sections it is a member of.  Divergence raises
+:class:`~.contracts.CommContractError` on *every* member naming the
+first differing step, so each job log carries the full diagnosis.
+
+Two transports, matching the repo's two multi-rank tiers:
+
+* **proc** — the native bridge's world allgather
+  (native/runtime.py host_allgather), available whenever the process is
+  part of a launched job.  One fixed-size buffer per rank; wall cost is
+  one small collective, orders of magnitude below any op deadline.
+* **in-process** — the rendezvous registry's barrier-style value
+  exchange (ops/_rendezvous.py ``exchange``), for thread-per-rank MPMD
+  harnesses and the analyzer's own tests.  Mesh/self programs are
+  single-trace by construction (SPMD: one program, every device), so
+  there is nothing to exchange — divergence is impossible, and the
+  pass is a no-op without an explicit ``world``.
+"""
+
+import hashlib
+
+import numpy as np
+
+from mpi4jax_tpu.analysis.contracts import (
+    CommContractError,
+    divergence_message,
+    step_signature,
+)
+
+__all__ = ["exchange_and_check", "serialize_schedule", "FP_BYTES"]
+
+FP_BYTES = 16384          # fixed exchange-buffer size per rank
+_MAX_SECTION_STEPS = 200  # above this a section ships digest-only
+
+
+def serialize_schedule(events):
+    """Canonical per-comm serialisation of one rank's schedule.
+
+    Sections are ordered by first appearance; each carries the comm's
+    member ranks (so ranks outside a communicator skip its section),
+    a digest of the full step sequence, and — for reasonably sized
+    schedules — the per-step signature lines used to name the first
+    differing step.
+    """
+    sections = []  # (comm_header, [step lines])
+    index = {}
+    for ev in events:
+        key = _comm_header(ev)
+        if key not in index:
+            index[key] = len(sections)
+            sections.append((key, []))
+        sections[index[key]][1].append(step_signature(ev))
+    def render(with_steps):
+        out = []
+        for header, lines in sections:
+            digest = hashlib.sha256(
+                "\n".join(lines).encode()
+            ).hexdigest()[:16]
+            out.append(f"@comm {header} n={len(lines)} sha={digest}")
+            if with_steps and len(lines) <= _MAX_SECTION_STEPS:
+                out.extend(lines)
+        return "\n".join(out).encode()
+
+    # never truncate: a cut-off tail section would silently compare
+    # equal across ranks that diverge only there.  Degrade in whole
+    # steps instead — full text, then digest-only headers, then one
+    # global digest (coarser error, same detection power).
+    text = render(with_steps=True)
+    if len(text) >= FP_BYTES:
+        text = render(with_steps=False)
+    if len(text) >= FP_BYTES:
+        text = (
+            "@comm <all> members=* n=%d sha=%s"
+            % (len(events), hashlib.sha256(text).hexdigest()[:16])
+        ).encode()
+    return text
+
+
+def _comm_header(ev):
+    members = ",".join(map(str, ev.comm_ranks)) if ev.comm_ranks else "*"
+    return f"{'/'.join(map(str, ev.comm_key))} members={members}"
+
+
+def exchange_and_check(events, world=None, timeout=None,
+                       local_findings=()):
+    """Exchange this rank's schedule and raise on divergence.
+
+    ``world`` is ``None`` (auto: use the proc tier when the native
+    bridge is initialised, else no-op) or an explicit ``(rank, size)``
+    pair routing through the in-process rendezvous exchange.  Returns
+    the number of peer schedules compared (0 = pass skipped).
+
+    ``local_findings`` (rule IDs) marks this rank's schedule as locally
+    broken: the rank still participates — the exchange is a collective
+    and sitting out would wedge every clean peer — but posts a sentinel,
+    and the *peers* raise immediately naming it.
+    """
+    if local_findings:
+        payload = ("!findings " + ",".join(local_findings)).encode()
+    else:
+        payload = serialize_schedule(events)
+    if world is not None:
+        rank, size = int(world[0]), int(world[1])
+        if size <= 1:
+            return 0
+        from mpi4jax_tpu.ops import _rendezvous
+
+        blobs = _rendezvous.exchange(
+            "t4j-fingerprint", rank, size, payload,
+            timeout=timeout if timeout is not None else 60.0,
+        )
+    else:
+        blobs = _proc_exchange(payload)
+        if blobs is None:
+            return 0
+        from mpi4jax_tpu.native import runtime
+
+        rank = runtime.world_rank()
+    _compare(blobs, my_rank=rank)
+    return len(blobs)
+
+
+def _proc_exchange(payload):
+    """World allgather of the fixed-size fingerprint buffer over the
+    native bridge; returns None when not in a multi-process job."""
+    from mpi4jax_tpu.native import runtime
+
+    if not runtime.available():
+        return None
+    runtime.ensure_initialized()
+    if runtime.world_size() <= 1:
+        return None
+    buf = np.zeros(FP_BYTES, np.uint8)
+    raw = np.frombuffer(payload, np.uint8)
+    buf[: raw.size] = raw
+    gathered = runtime.host_allgather(0, buf)  # handle 0 = world comm
+    return [bytes(row.tobytes()).rstrip(b"\x00") for row in gathered]
+
+
+def _compare(blobs, my_rank=None):
+    """Diff every per-comm section this process is a member of; raise
+    CommContractError naming the first differing step on mismatch."""
+    broken = {
+        r: blob.decode(errors="replace")[len("!findings "):]
+        for r, blob in enumerate(blobs)
+        if blob.startswith(b"!findings ")
+    }
+    if broken:
+        if my_rank in broken:
+            # this rank's own Report carries the detail; don't bury it
+            # under a CommContractError about itself
+            return
+        sides = "; ".join(
+            f"rank {r}: {rules}" for r, rules in sorted(broken.items())
+        )
+        raise CommContractError(
+            "T4J007: peer rank(s) failed local contract verification "
+            f"({sides}) — executing would desynchronise the schedule. "
+            "See the failing rank's own report for the findings."
+        )
+    parsed = [_parse(blob) for blob in blobs]
+    all_comms = []
+    for sections in parsed:
+        for comm_id in sections:
+            if comm_id not in all_comms:
+                all_comms.append(comm_id)
+    for comm_id in all_comms:
+        members = _members(comm_id, len(blobs))
+        if my_rank is not None and my_rank not in members:
+            continue
+        rows = []
+        for r in members:
+            if r < len(parsed) and comm_id in parsed[r]:
+                rows.append((r, parsed[r][comm_id]))
+            else:
+                rows.append((r, {"sha": "<missing>", "lines": []}))
+        shas = {sec["sha"] for _, sec in rows}
+        if len(shas) <= 1:
+            continue
+        # locate the first differing step when step lines are present
+        from mpi4jax_tpu.analysis.contracts import first_divergence
+
+        lines_by_rank = []
+        rank_of_row = {}
+        for i, (r, sec) in enumerate(rows):
+            rank_of_row[i] = r
+            lines_by_rank.append(sec["lines"])
+        div = (
+            first_divergence(lines_by_rank)
+            if any(lines_by_rank) else None
+        )
+        if div is not None:
+            step, details = div
+            details = {rank_of_row[i]: v for i, v in details.items()}
+            raise CommContractError(divergence_message(
+                step, details,
+                deadline_hint=f"comm {comm_id.split(' ')[0]}",
+            ))
+        sides = "; ".join(
+            f"rank {r}: sha={sec['sha']}" for r, sec in rows
+        )
+        raise CommContractError(
+            f"T4J007: communication schedules diverge on comm "
+            f"{comm_id.split(' ')[0]}: {sides} (schedules too large to "
+            "inline; re-run with a smaller program to see the step)."
+        )
+
+
+def _parse(blob):
+    sections = {}
+    current = None
+    for line in blob.decode(errors="replace").splitlines():
+        if line.startswith("@comm "):
+            head = line[len("@comm "):]
+            comm_id, _, rest = head.partition(" n=")
+            sha = rest.partition("sha=")[2]
+            current = {"sha": sha, "lines": []}
+            sections[comm_id] = current
+        elif current is not None and line:
+            current["lines"].append(line)
+    return sections
+
+
+def _members(comm_id, world_size):
+    part = comm_id.partition("members=")[2]
+    if not part or part == "*":
+        return list(range(world_size))
+    try:
+        return [int(tok) for tok in part.split(",") if tok != ""]
+    except ValueError:
+        return list(range(world_size))

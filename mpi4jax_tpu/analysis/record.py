@@ -1,0 +1,293 @@
+"""Trace-time event recording for the communication-contract analyzer.
+
+While a :func:`mpi4jax_tpu.analysis.verify_comm` extraction is running,
+every public communication op reports itself here from the shared
+``publishes_token`` wrapper (ops/_core.py) — the one choke point every
+op already passes through for profiling scopes, debug logging and
+ambient-token publication.  Recording captures exactly the metadata the
+op itself validated (comm, tag, pattern, dtype/shape, reduce op, root)
+plus the identities of the incoming and outgoing Token objects, which
+is what the chain rules (T4J001/T4J002) key on.
+
+Zero overhead when no scope is active: the wrapper checks one
+module-level flag before doing anything else (same contract as the
+debug logging's ``config.debug_enabled()`` fast path).
+
+Reentrancy: some public ops are implemented via other public ops
+(``gather`` -> ``allgather`` and ``reduce`` -> ``allreduce`` on the
+mesh backend, collectives.py).  Only the *outermost* call is recorded —
+the schedule is the sequence of ops the user program issued, and the
+inner call is an implementation detail that would otherwise make one
+user step count twice.
+"""
+
+import inspect
+import threading
+import traceback
+
+__all__ = ["active", "recording", "record_op", "take_events"]
+
+_state = threading.local()
+
+
+def _stack():
+    st = getattr(_state, "scopes", None)
+    if st is None:
+        st = _state.scopes = []
+    return st
+
+
+def active():
+    """Fast check used by the op-layer hook (ops/_core.py)."""
+    return bool(getattr(_state, "scopes", None))
+
+
+class _Scope:
+    def __init__(self):
+        self.events = []
+        self.seq = 0
+        self.depth = 0  # >0 while inside a recorded op (reentrancy guard)
+        # strong refs to every Token seen: events key chains on id(),
+        # and a freed Token's address could otherwise be recycled for a
+        # later one, aliasing distinct chain links across events
+        self.tokens = []
+        # id of the previous event's outgoing token, for linking ops
+        # that chain through the ambient auto_tokenize context
+        # (token=None resolves inside the op, invisible to the hook)
+        self.last_out = None
+
+
+class recording:
+    """Context manager collecting CommEvents from the op layer."""
+
+    def __enter__(self):
+        self.scope = _Scope()
+        _stack().append(self.scope)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+    @property
+    def events(self):
+        return list(self.scope.events)
+
+
+def take_events():
+    """Events of the innermost active scope (ordered)."""
+    st = _stack()
+    return list(st[-1].events) if st else []
+
+
+# ------------------------------------------------------------- capture
+
+# Parameter names the ops use, normalised to CommEvent fields.  The op
+# signatures are bound with inspect so new ops with the same vocabulary
+# are picked up without touching this module.
+_DATA_PARAMS = ("x", "sendbuf")
+_TAG_PARAMS = ("tag", "sendtag")
+
+
+def record_op(name, fn, args, kwargs, out):
+    """Called by ``publishes_token`` after a successful op call.
+
+    ``out`` is the op's return value (used for the outgoing token
+    identity and the staged-send bookkeeping).  Never raises: an
+    analyzer bug must not take down the traced program — it degrades to
+    an event with fewer fields.
+    """
+    st = _stack()
+    if not st:
+        return
+    scope = st[-1]
+    if scope.depth > 1:
+        return  # nested public op: the outer event covers it
+    try:
+        ev = _build_event(scope, name, fn, args, kwargs, out)
+    except Exception:
+        ev = None
+    if ev is not None:
+        scope.events.append(ev)
+
+
+class op_frame:
+    """Marks 'inside a public op' for the reentrancy guard; used by
+    ``publishes_token`` around the op body so nested public-op calls
+    are attributed to the outermost one."""
+
+    def __enter__(self):
+        st = _stack()
+        if st:
+            st[-1].depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        st = _stack()
+        if st:
+            st[-1].depth -= 1
+        return False
+
+
+def _build_event(scope, name, fn, args, kwargs, out):
+    from mpi4jax_tpu.analysis.contracts import CommEvent
+    from mpi4jax_tpu.ops._core import Token, _ambient_stack, comm_key
+    from mpi4jax_tpu.utils.validation import check_comm
+
+    try:
+        bound = inspect.signature(fn).bind(*args, **kwargs)
+        bound.apply_defaults()
+        params = dict(bound.arguments)
+    except TypeError:
+        params = dict(kwargs)
+
+    comm = check_comm(params.get("comm"))
+    token_in = params.get("token")
+    token_out = _find_token(out)
+    token_in_id = id(token_in) if isinstance(token_in, Token) else None
+    if token_in_id is None and _ambient_stack():
+        # token=None under auto_tokenize resolves to the ambient chain
+        # inside the op; model the chain the ambient context maintains
+        # by linking to the previous op's outgoing token, or the chain
+        # rules would see every ambient op as an orphan
+        token_in_id = scope.last_out
+    if isinstance(token_in, Token):
+        scope.tokens.append(token_in)
+    if token_out is not None:
+        scope.tokens.append(token_out)
+
+    data = None
+    for p in _DATA_PARAMS:
+        if params.get(p) is not None:
+            data = params[p]
+            break
+
+    rank = None
+    if comm.backend != "mesh":
+        try:
+            rank = int(comm.rank())
+        except Exception:
+            rank = None
+
+    tag = None
+    for p in _TAG_PARAMS:
+        if p in params:
+            tag = _static_int(params[p])
+            break
+
+    ev = CommEvent(
+        seq=scope.seq,
+        kind=name,
+        comm_key=comm_key(comm),
+        backend=comm.backend,
+        comm_size=int(comm.size),
+        dtype=str(getattr(data, "dtype", "")) if data is not None else "",
+        shape=tuple(getattr(data, "shape", ())) if data is not None else (),
+        reduce_op=_op_name(params.get("op")),
+        tag=tag,
+        source=_spec(params.get("source")),
+        dest=_spec(params.get("dest")),
+        root=_static_int(params.get("root")),
+        rank=rank,
+        comm_ranks=_comm_ranks(comm),
+        token_in=token_in_id,
+        token_out=id(token_out) if token_out is not None else None,
+        pending_out=_pending_summary(token_out),
+        src_info=_user_frame(),
+    )
+    scope.seq += 1
+    if token_out is not None:
+        scope.last_out = id(token_out)
+    return ev
+
+
+def _find_token(out):
+    from mpi4jax_tpu.ops._core import Token
+
+    if isinstance(out, Token):
+        return out
+    if isinstance(out, tuple):
+        for item in out:
+            if isinstance(item, Token):
+                return item
+    return None
+
+
+def _pending_summary(token):
+    if token is None or not getattr(token, "pending_meta", ()):
+        return ()
+    return tuple(
+        f"tag={m.tag} perm={m.perm} {m.dtype}[{'x'.join(map(str, m.shape))}]"
+        for m in token.pending_meta
+    )
+
+
+def _comm_ranks(comm):
+    """World ranks of the comm's members when the backend knows them
+    (ProcComm carries .ranks); empty means 'all ranks' to the
+    fingerprint pass."""
+    ranks = getattr(comm, "ranks", None)
+    if ranks is None:
+        return ()
+    try:
+        return tuple(int(r) for r in ranks)
+    except (TypeError, ValueError):
+        return ()
+
+
+def _op_name(op):
+    if op is None:
+        return ""
+    name = getattr(op, "name", None)
+    if name is None:
+        return str(op)
+    return f"user:{name}" if getattr(op, "is_user", False) else str(name)
+
+
+def _static_int(value):
+    import numpy as np
+
+    if isinstance(value, (int, np.integer)) and not isinstance(
+        value, (bool, np.bool_)
+    ):
+        return int(value)
+    return None
+
+
+def _spec(spec):
+    """Normalise a p2p partner spec for the event record."""
+    import numpy as np
+
+    if spec is None:
+        return None
+    if isinstance(spec, (int, np.integer)) and not isinstance(
+        spec, (bool, np.bool_)
+    ):
+        return "ANY" if int(spec) == -1 else int(spec)
+    if callable(spec):
+        return "callable"
+    if isinstance(spec, (list, tuple)):
+        try:
+            return tuple(sorted((int(s), int(d)) for s, d in spec))
+        except (TypeError, ValueError):
+            return "static"
+    import jax
+
+    if isinstance(spec, jax.core.Tracer):
+        return "traced"
+    return "static"
+
+
+_LIB_MARKERS = ("mpi4jax_tpu/ops", "mpi4jax_tpu/analysis", "jax/")
+
+
+def _user_frame():
+    """Innermost stack frame outside the library — the finding anchor."""
+    for fr in reversed(traceback.extract_stack(limit=40)):
+        fname = fr.filename.replace("\\", "/")
+        if any(m in fname for m in _LIB_MARKERS):
+            continue
+        if "/site-packages/" in fname or fname.startswith("<"):
+            continue
+        return f"{fr.filename}:{fr.lineno}"
+    return ""

@@ -1,0 +1,106 @@
+"""Schedule extraction: trace a program and capture its communication
+schedule without executing it.
+
+``extract_schedule`` traces the callable with ``jax.make_jaxpr`` under
+an active recording scope (analysis/record.py), so every public op the
+program issues reports one :class:`~.contracts.CommEvent` in program
+order, and the closed jaxpr is retained for the control-flow pass
+(analysis/jaxpr_walk.py).  No backend I/O happens: tracing stops at
+abstract values, exactly like ``jax.eval_shape``.
+
+Contract violations the op layer rejects eagerly (unmatched recv,
+shape/dtype mismatch against a staged send, out-of-range roots/peers,
+non-permutation patterns...) raise *during* tracing; they are caught
+here and converted to findings with stable rule IDs
+(contracts.classify_trace_error) so one lint run reports them uniformly
+alongside the schedule rules instead of dying on the first one.
+Unrecognised exceptions propagate — a bug in the traced program is not
+a lint finding.
+"""
+
+import traceback
+
+from mpi4jax_tpu.analysis import record
+from mpi4jax_tpu.analysis.contracts import Finding, classify_trace_error
+
+__all__ = ["extract_schedule", "Extraction"]
+
+
+class Extraction:
+    """Result of tracing a program for analysis."""
+
+    def __init__(self, events, closed_jaxpr, error_findings, notes=()):
+        self.events = events
+        self.closed_jaxpr = closed_jaxpr
+        self.error_findings = error_findings
+        self.notes = list(notes)
+
+
+def extract_schedule(fn, args=(), kwargs=None):
+    """Trace ``fn(*args, **kwargs)`` and extract its comm schedule.
+
+    Returns an :class:`Extraction`.  The traced callable's return value
+    is reduced to its jax-typeable leaves, so programs returning
+    auxiliary Python objects (e.g. a :class:`~mpi4jax_tpu.Status`)
+    still trace.
+    """
+    import jax
+
+    kwargs = dict(kwargs or {})
+
+    def thunk():
+        out = fn(*args, **kwargs)
+        leaves = jax.tree_util.tree_leaves(out)
+        return [
+            leaf for leaf in leaves
+            if hasattr(leaf, "dtype") or isinstance(leaf, (int, float))
+        ]
+
+    error_findings = []
+    closed = None
+    notes = []
+    with record.recording() as rec:
+        try:
+            closed = jax.make_jaxpr(thunk)()
+        except Exception as exc:
+            rule = classify_trace_error(exc)
+            if rule is None:
+                raise
+            error_findings.append(Finding(
+                rule=rule,
+                message=str(exc),
+                src_info=_exc_user_frame(exc),
+            ))
+        events = rec.events
+
+    if closed is not None and not events:
+        # a cached jax.jit inside fn can satisfy the trace without
+        # re-running the Python body, hiding ops from the recorder;
+        # surface that instead of silently reporting a clean schedule
+        from mpi4jax_tpu.analysis.jaxpr_walk import walk_comm_jaxpr
+
+        occurrences, _ = walk_comm_jaxpr(closed)
+        if occurrences:
+            notes.append(
+                f"recorded 0 op events but the jaxpr contains "
+                f"{len(occurrences)} communication op occurrence(s): a "
+                "pre-traced jax.jit cache entry was reused. Wrap the "
+                "underlying (un-jitted) function, or verify before its "
+                "first execution."
+            )
+    return Extraction(events, closed, error_findings, notes)
+
+
+_LIB_MARKERS = ("mpi4jax_tpu/ops", "mpi4jax_tpu/analysis", "jax/",
+                "/site-packages/")
+
+
+def _exc_user_frame(exc):
+    tb = getattr(exc, "__traceback__", None)
+    best = ""
+    for fr in traceback.extract_tb(tb):
+        fname = fr.filename.replace("\\", "/")
+        if any(m in fname for m in _LIB_MARKERS) or fname.startswith("<"):
+            continue
+        best = f"{fr.filename}:{fr.lineno}"
+    return best

@@ -1,0 +1,168 @@
+"""Public entry points of the communication-contract verifier.
+
+* :func:`verify_comm` — the lint API: ``verify_comm(fn)(*args)`` traces
+  ``fn`` (no execution, no network I/O), runs the static single-trace
+  pass and — when a multi-rank world is reachable — the cross-rank
+  fingerprint pass, and returns a :class:`Report` of findings with
+  stable rule IDs (docs/static-analysis.md).
+* :func:`guard` — the deploy hook: wraps a step function so its first
+  call per input signature verifies before executing, governed by
+  ``T4J_VERIFY=off|fingerprint|full`` (utils/config.py).  ``off`` is a
+  zero-overhead passthrough; ``fingerprint`` exchanges schedule digests
+  across ranks (turning a would-be deadlock-until-T4J_OP_TIMEOUT into
+  an immediate :class:`~.contracts.CommContractError`); ``full`` adds
+  the whole static rule catalog and raises on any finding.
+"""
+
+import functools
+
+from mpi4jax_tpu.analysis import fingerprint as _fp
+from mpi4jax_tpu.analysis.contracts import CommContractError
+from mpi4jax_tpu.analysis.trace import extract_schedule
+
+__all__ = ["Report", "verify_comm", "guard", "CommContractError"]
+
+
+class Report:
+    """Outcome of one static verification run."""
+
+    def __init__(self, findings, events, notes=(), peers_checked=0):
+        self.findings = list(findings)
+        self.events = list(events)
+        self.notes = list(notes)
+        self.peers_checked = peers_checked
+
+    @property
+    def ok(self):
+        return not self.findings
+
+    def raise_if_findings(self):
+        if self.findings:
+            lines = "\n".join(f"  {f}" for f in self.findings)
+            raise CommContractError(
+                f"communication contract verification failed with "
+                f"{len(self.findings)} finding(s):\n{lines}",
+                findings=self.findings,
+            )
+        return self
+
+    def __str__(self):
+        if self.ok:
+            extra = f", {self.peers_checked} peer schedules" if (
+                self.peers_checked
+            ) else ""
+            return (
+                f"clean: {len(self.events)} communication op(s) "
+                f"verified{extra}"
+            )
+        return "\n".join(str(f) for f in self.findings)
+
+    def __repr__(self):
+        return (
+            f"Report(findings={len(self.findings)}, "
+            f"events={len(self.events)}, ok={self.ok})"
+        )
+
+
+def verify_comm(fn, *, mode=None, world=None):
+    """Wrap ``fn`` so calling the wrapper *verifies* instead of runs.
+
+    ``verify_comm(fn)(*args, **kwargs)`` returns a :class:`Report`.
+    ``mode`` overrides ``T4J_VERIFY`` (explicit verification defaults
+    to ``full``); ``world=(rank, size)`` routes the fingerprint
+    exchange through the in-process rendezvous registry for MPMD-style
+    harnesses — by default the proc tier is used when this process is
+    part of a launched job, and the pass is skipped otherwise (an SPMD
+    trace cannot diverge from itself).
+    """
+
+    @functools.wraps(fn)
+    def run(*args, **kwargs):
+        # an explicit verify_comm call means "lint this": default to
+        # the full catalog regardless of the ambient T4J_VERIFY (which
+        # governs the implicit guard hook and defaults to off)
+        return _verify_once(
+            fn, args, kwargs, mode="full" if mode is None else mode,
+            world=world,
+        )
+
+    return run
+
+
+def _verify_once(fn, args, kwargs, mode, world):
+    from mpi4jax_tpu.analysis.contracts import check_schedule
+    from mpi4jax_tpu.analysis.jaxpr_walk import walk_comm_jaxpr
+    from mpi4jax_tpu.utils import config
+
+    mode = config.verify_mode() if mode is None else str(mode)
+    if mode == "off":
+        return Report((), ())
+    if mode not in ("fingerprint", "full"):
+        raise ValueError(
+            f"verify mode must be off|fingerprint|full, got {mode!r}"
+        )
+
+    extraction = extract_schedule(fn, args, kwargs)
+    findings = list(extraction.error_findings)
+    if mode == "full":
+        findings += check_schedule(extraction.events)
+        if extraction.closed_jaxpr is not None:
+            _, jaxpr_findings = walk_comm_jaxpr(extraction.closed_jaxpr)
+            findings += jaxpr_findings
+
+    # ALWAYS participate in the exchange, findings or not: the exchange
+    # is a collective, and a rank that silently sat out because of a
+    # local finding would wedge every clean peer in it — the exact
+    # hang-until-deadline this pass exists to eliminate.  A rank with
+    # local findings posts a sentinel instead of a schedule; its peers
+    # raise immediately naming that rank, while the rank itself gets
+    # its Report.
+    peers = _fp.exchange_and_check(
+        extraction.events, world=world,
+        local_findings=[f.rule for f in findings],
+    )
+    return Report(
+        findings, extraction.events, extraction.notes, peers_checked=peers
+    )
+
+
+def guard(fn=None, *, mode=None, world=None):
+    """Verify-before-execute wrapper for a step function.
+
+    Usable as ``guard(step)`` or ``@guard``.  Verification runs once
+    per input signature (shapes/dtypes of the flattened args) and is
+    then cached, so steady-state calls pay one dict lookup.  With
+    ``T4J_VERIFY=off`` (the default) the wrapper is a passthrough.
+    """
+    if fn is None:
+        return functools.partial(guard, mode=mode, world=world)
+
+    cache = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from mpi4jax_tpu.utils import config
+
+        eff_mode = config.verify_mode() if mode is None else str(mode)
+        if eff_mode != "off":
+            key = (eff_mode, _signature_key(args, kwargs))
+            if key not in cache:
+                report = _verify_once(
+                    fn, args, kwargs, mode=eff_mode, world=world
+                )
+                report.raise_if_findings()
+                cache[key] = True
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def _signature_key(args, kwargs):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = tuple(
+        (getattr(x, "shape", None), str(getattr(x, "dtype", type(x))))
+        for x in leaves
+    )
+    return (str(treedef), sig)

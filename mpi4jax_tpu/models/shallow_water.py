@@ -875,3 +875,32 @@ def gather_global(local_field, comm, *, ghost=1):
     ny_l, nx_l = local_field.shape[0] - 2 * G, local_field.shape[1] - 2 * G
     grid = blocks.reshape(py, px, ny_l, nx_l)
     return grid.transpose(0, 2, 1, 3).reshape(py * ny_l, px * nx_l)
+
+
+# -- t4j-lint entries: the model's own communication schedule, one per
+# ghost-width schedule variant (1 = reference layout, 2 = wide-halo,
+# 4 = single-exchange) — the three schedules differ in exchange
+# structure and each must stay contract-clean.
+
+
+def _lint_step(ghost):
+    def thunk():
+        import jax
+
+        from mpi4jax_tpu.parallel.comm import MeshComm
+
+        mesh = jax.make_mesh(
+            (2, 4), ("y", "x"),
+            axis_types=(jax.sharding.AxisType.Auto,) * 2,
+        )
+        comm = MeshComm.from_mesh(mesh)
+        cfg = SWConfig(ny=8, nx=16, ghost=ghost)
+        return make_multistep(cfg, comm, num_steps=1)(
+            make_init(cfg, comm)()
+        )
+
+    thunk.__name__ = f"step_ghost{ghost}"
+    return thunk
+
+
+T4J_LINT_ENTRIES = [_lint_step(g) for g in (1, 2, 4)]

@@ -29,8 +29,10 @@ _HEADERS = ["dcn.h", "shm.h"]
 def _sanitize_flags():
     """Opt-in sanitizer build: T4J_SANITIZE=address compiles the bridge
     under ASan so the fault-injection suite can double as a memory-
-    safety harness locally (CI tooling satellite).  Other values are
-    passed through to -fsanitize verbatim (e.g. undefined,thread)."""
+    safety harness locally, and T4J_SANITIZE=thread under TSan so the
+    same suite exercises the bridge's progress/abort threads for data
+    races (tools/ci_smoke.sh has a build leg for each).  Other values
+    are passed through to -fsanitize verbatim (e.g. undefined)."""
     import os
 
     san = os.environ.get("T4J_SANITIZE", "").strip().lower()
@@ -38,7 +40,23 @@ def _sanitize_flags():
         return []
     if san in ("address", "asan", "1"):
         san = "address"
+    elif san in ("thread", "tsan"):
+        san = "thread"
     return [f"-fsanitize={san}", "-fno-omit-frame-pointer", "-g"]
+
+
+def _strict():
+    """T4J_NATIVE_STRICT=1 promotes the bridge build to
+    -Wall -Wextra -Werror and runs clang-tidy (bugprone-*,
+    concurrency-*; .clang-tidy at the repo root) when the tool is
+    installed.  Our sources must stay warning-clean; the jaxlib FFI
+    headers are third-party and enter via -isystem so their warnings
+    never gate our build."""
+    import os
+
+    from mpi4jax_tpu.utils.config import truthy
+
+    return truthy(os.environ.get("T4J_NATIVE_STRICT"), default=False)
 
 
 def _machine_key():
@@ -50,6 +68,8 @@ def _machine_key():
     import hashlib
 
     san = "|".join(_sanitize_flags())
+    if _strict():
+        san = f"{san}|strict" if san else "strict"
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
@@ -80,17 +100,34 @@ def _needs_build():
     return False
 
 
+def _ffi_include_dir():
+    """The XLA FFI headers inside the installed jaxlib.  jax>=0.7
+    exposes them as jax.ffi; older lines (which cannot import the
+    package but can still build/lint the bridge standalone) as
+    jax.extend.ffi."""
+    try:
+        import jax.ffi as ffi
+    except ImportError:
+        from jax.extend import ffi
+    return ffi.include_dir()
+
+
 def build(verbose=False):
     import os
-    import jax.ffi
 
-    include = jax.ffi.include_dir()
+    include = _ffi_include_dir()
     tmp = _OUT.with_suffix(f".tmp{os.getpid()}.so")
     # compiler override mirrors the reference's MPI4JAX_BUILD_MPICC
     # (setup.py:78); CXX is the conventional spelling here
     cxx = os.environ.get("MPI4JAX_TPU_BUILD_CXX") or os.environ.get(
         "CXX", "g++"
     )
+    strict = _strict()
+    # the jaxlib FFI headers are third-party: -isystem keeps their
+    # (numerous) -Wextra findings out of our warning surface, so the
+    # strict gate measures only this repo's sources
+    warn = ["-Wall", "-Wextra", "-Werror"] if strict else ["-Wall"]
+
     def cmd_for(extra):
         return [
             cxx,
@@ -100,14 +137,17 @@ def build(verbose=False):
             "-fPIC",
             "-shared",
             "-std=c++17",
-            "-Wall",
-            f"-I{include}",
+            *warn,
+            f"-isystem{include}",
             *[str(_SRC_DIR / s) for s in _SOURCES],
             "-o",
             str(tmp),
             "-lpthread",
             "-lrt",
         ]
+
+    if strict:
+        _run_clang_tidy(include)
 
     # -march=native vectorises the reduction combines (the shm arena's
     # fold is memory-bound only when SIMD keeps up); the library is
@@ -129,6 +169,38 @@ def build(verbose=False):
     os.replace(tmp, _OUT)  # atomic: concurrent loaders never see a torn .so
     _OUT.with_suffix(".buildinfo").write_text(_machine_key() + "\n")
     return _OUT
+
+
+def _run_clang_tidy(include):
+    """clang-tidy leg of the strict build (checks from the repo-root
+    .clang-tidy: bugprone-*, concurrency-*, warnings-as-errors).  Skips
+    with a note when clang-tidy is not installed — the strict *compile*
+    still gates; containers with the full toolchain get both."""
+    import os
+    import shutil
+
+    tidy = shutil.which(os.environ.get("T4J_CLANG_TIDY", "clang-tidy"))
+    if tidy is None:
+        print(
+            "t4j strict build: clang-tidy not found, running the "
+            "-Werror compile gate only",
+            file=sys.stderr,
+        )
+        return
+    cmd = [
+        tidy,
+        *[str(_SRC_DIR / s) for s in _SOURCES],
+        "--warnings-as-errors=*",
+        "--",
+        "-std=c++17",
+        f"-isystem{include}",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"clang-tidy failed (T4J_NATIVE_STRICT=1):\n"
+            f"{(proc.stdout + proc.stderr)[-4000:]}"
+        )
 
 
 def ensure_built():

@@ -458,16 +458,26 @@ def is_initialized():
     return bool(lib and lib.t4j_initialized())
 
 
+def _ffi_module():
+    """jax.ffi (jax>=0.7), or jax.extend.ffi on older lines — the
+    latter keeps the ctypes control plane and the staged data plane
+    usable from standalone harnesses on old-jax containers (same
+    fallback as native/build.py)."""
+    try:
+        import jax.ffi as ffi
+    except ImportError:
+        from jax.extend import ffi
+    return ffi
+
+
 def _register_ffi_targets(lib):
     if _state["registered"]:
         return
-    import jax.ffi
+    ffi = _ffi_module()
 
     for name in HANDLER_NAMES:
         fn = getattr(lib, name)
-        jax.ffi.register_ffi_target(
-            name, jax.ffi.pycapsule(fn), platform="cpu"
-        )
+        ffi.register_ffi_target(name, ffi.pycapsule(fn), platform="cpu")
     _state["registered"] = True
 
 

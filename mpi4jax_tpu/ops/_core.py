@@ -444,8 +444,17 @@ def _debug_end(state, out):
 
 def publishes_token(fn):
     """Instrumentation wrapper for every public op: profiler scope,
-    opt-in per-call debug logging, and publication of the returned Token
-    (if any) to the ambient auto_tokenize chain."""
+    opt-in per-call debug logging, publication of the returned Token
+    (if any) to the ambient auto_tokenize chain, and — while a
+    ``verify_comm`` extraction is active — reporting the call to the
+    contract analyzer (analysis/record.py).
+
+    The ``jax.named_scope`` below is load-bearing for the analyzer too:
+    it stamps every lowered eqn's name stack with ``mpi4jax_tpu.<op>``,
+    which is how the jaxpr walker (analysis/jaxpr_walk.py) identifies
+    communication eqns inside control-flow sub-jaxprs regardless of
+    backend.
+    """
     import functools
 
     name = fn.__name__
@@ -461,8 +470,16 @@ def publishes_token(fn):
             log_state = _debug_begin(
                 name, args, kwargs, check_comm(kwargs.get("comm"))
             )
-        with jax.named_scope(f"mpi4jax_tpu.{name}"):
-            out = fn(*args, **kwargs)
+        from mpi4jax_tpu.analysis import record as _arecord
+
+        if _arecord.active():
+            with _arecord.op_frame():
+                with jax.named_scope(f"mpi4jax_tpu.{name}"):
+                    out = fn(*args, **kwargs)
+                _arecord.record_op(name, fn, args, kwargs, out)
+        else:
+            with jax.named_scope(f"mpi4jax_tpu.{name}"):
+                out = fn(*args, **kwargs)
         token = None
         if isinstance(out, Token):
             token = out

@@ -133,9 +133,9 @@ def _staged():
 
 
 def _call(name, results, *operands, **attrs):
-    import jax.ffi
+    from mpi4jax_tpu.native.runtime import _ffi_module
 
-    fn = jax.ffi.ffi_call(name, results, has_side_effect=True)
+    fn = _ffi_module().ffi_call(name, results, has_side_effect=True)
     return fn(*operands, **attrs)
 
 

@@ -262,3 +262,61 @@ def topology_map(key, size=None):
 def reset_topology():
     with _topo_lock:
         _topo.clear()
+
+
+# ------------------------------------------------------ value exchange
+#
+# Barrier-style in-process allgather of opaque byte payloads, the
+# single-host counterpart of the native bridge's allgather wire: every
+# participant posts its value for a generation of ``key`` and blocks
+# until all ``size`` values arrived, then reads the full table.  The
+# schedule-fingerprint pass (analysis/fingerprint.py) exchanges digests
+# through here for in-process MPMD harnesses (thread-per-rank tests,
+# the rendezvous engine's own consumers); proc-tier jobs use the native
+# allgather instead.  The registry is reusable: a new round for the
+# same key opens once the previous cohort has drained.
+
+_xchg_cv = threading.Condition()
+_xchg = {}  # key -> {"vals": {rank: bytes}, "readers": int}
+
+
+def exchange(key, rank, size, payload, timeout=60.0):
+    """Post ``payload`` (bytes) as ``rank``'s value for ``key`` and
+    return the list of all ``size`` payloads, rank-ordered.  Raises
+    RuntimeError on timeout (some participant never posted)."""
+    rank, size = int(rank), int(size)
+    with _xchg_cv:
+        slot = _xchg.setdefault(key, {"vals": {}, "readers": 0})
+        # a rank re-entering for the next round while the previous
+        # cohort is still reading waits for the round to drain first
+        if not _xchg_cv.wait_for(
+            lambda: rank not in slot["vals"], timeout=timeout
+        ):
+            raise RuntimeError(
+                f"exchange: rank {rank} re-posted for key {key!r} but "
+                "the previous round never drained"
+            )
+        slot["vals"][rank] = bytes(payload)
+        _xchg_cv.notify_all()
+        if not _xchg_cv.wait_for(
+            lambda: len(slot["vals"]) >= size, timeout=timeout
+        ):
+            missing = sorted(set(range(size)) - set(slot["vals"]))
+            slot["vals"].pop(rank, None)
+            _xchg_cv.notify_all()
+            raise RuntimeError(
+                f"exchange on key {key!r}: timed out after {timeout:.0f}s "
+                f"waiting for rank(s) {missing} to post"
+            )
+        out = [slot["vals"][r] for r in range(size)]
+        slot["readers"] += 1
+        if slot["readers"] >= size:  # cohort drained: open a new round
+            slot["vals"].clear()
+            slot["readers"] = 0
+            _xchg_cv.notify_all()
+    return out
+
+
+def reset_exchange():
+    with _xchg_cv:
+        _xchg.clear()

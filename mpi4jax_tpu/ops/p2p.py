@@ -490,8 +490,21 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
         # time through the host engine (reference recv.py:39-47
         # semantics; Status reports the true runtime source)
         return _rendezvous_recv(x, source, tag, comm, token, status)
+    staged = "; ".join(
+        f"tag={meta.tag} perm={meta.perm} "
+        f"{meta.dtype}[{'x'.join(map(str, meta.shape))}]"
+        + ("" if meta.comm_key == key else " (different comm)")
+        for meta in token.pending_meta
+    )
+    wanted = (
+        f"tag={'ANY' if tag == ANY_TAG else tag}, source="
+        f"{'ANY' if want_pairs is None else sorted(want_pairs)}"
+    )
     raise RuntimeError(
-        "recv found no matching in-trace send on this token. Under SPMD, "
+        "recv found no matching in-trace send on this token. This recv "
+        f"wants {wanted}; the token carries "
+        + (f"staged send(s) [{staged}]" if staged else "no staged sends")
+        + ". Under SPMD, "
         "send and recv must be paired within the same trace (the send "
         "stages its payload on the token; pass that token to recv). For "
         "true cross-process MPMD p2p use the multi-process backend."

@@ -71,6 +71,7 @@ __all__ = [
     "seg_bytes",
     "hier_mode",
     "leader_ring_min_bytes",
+    "verify_mode",
 ]
 
 _TRUE = {"1", "true", "on", "yes"}
@@ -224,6 +225,31 @@ def leader_ring_min_bytes():
         256 << 10,
         name="T4J_LEADER_RING_MIN_BYTES",
     )
+
+
+def verify_mode():
+    """Communication-contract verification mode for analysis.guard
+    (docs/static-analysis.md):
+
+    * ``off`` (default) — zero-overhead passthrough.
+    * ``fingerprint`` — exchange schedule digests across ranks before
+      executing; divergence raises CommContractError immediately
+      instead of hanging until T4J_OP_TIMEOUT.
+    * ``full`` — fingerprint plus the whole static rule catalog
+      (T4J001...) on every new input signature.
+
+    Anything else raises — a typo'd mode must fail at launch, not
+    silently skip verification."""
+    v = os.environ.get("T4J_VERIFY")
+    if v is None or not str(v).strip():
+        return "off"
+    v = str(v).strip().lower()
+    if v not in ("off", "fingerprint", "full"):
+        raise ValueError(
+            f"cannot interpret T4J_VERIFY={v!r} "
+            "(want off|fingerprint|full)"
+        )
+    return v
 
 
 def op_timeout():

@@ -1,0 +1,177 @@
+"""Proc-tier fingerprint pass: divergence must raise *fast*.
+
+The acceptance contract (ISSUE 4): with ``T4J_VERIFY=fingerprint``, two
+ranks whose programs trace different communication schedules raise
+:class:`CommContractError` naming the first differing step in well
+under ``T4J_OP_TIMEOUT`` — the digest exchange happens before any
+collective executes, so the would-be deadlock (one rank in allreduce,
+the other in bcast) never forms and the per-op deadline never starts
+ticking.
+
+Ranks are spawned directly (hand-set T4J_* env, the contract from
+tests/proc/test_fault_injection.py) so each rank's exit code, stderr
+and wall time can be asserted independently.
+"""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import uuid
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401 -- probe only
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+pytestmark = pytest.mark.fault
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+RAISED = 23    # CommContractError observed, marker line has details
+NO_RAISE = 3   # verification passed where a divergence was planted
+
+OP_TIMEOUT = 25.0  # generous op deadline the verifier must beat by 5x
+
+WORKER = """
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.analysis import CommContractError, verify_comm
+from mpi4jax_tpu.native import runtime
+
+runtime.ensure_initialized()
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+rank = comm.rank()
+
+
+def step(x):
+    tok = m.create_token()
+    y, tok = m.allreduce(x, comm=comm, token=tok)
+    if os.environ.get("DIVERGE") == "1" and rank == 1:
+        y, tok = m.bcast(y, 0, comm=comm, token=tok)
+    else:
+        y, tok = m.allreduce(y, m.MAX, comm=comm, token=tok)
+    return y
+
+
+t0 = time.monotonic()
+try:
+    report = verify_comm(step)(jnp.ones(8))
+    elapsed = time.monotonic() - t0
+    print(f"T4JMARK ok peers={report.peers_checked} "
+          f"elapsed={elapsed:.3f}", flush=True)
+    sys.exit(3)
+except CommContractError as e:
+    elapsed = time.monotonic() - t0
+    print(f"T4JMARK raised elapsed={elapsed:.3f} msg={e}", flush=True)
+    sys.exit(23)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(tmp_path, body, nprocs, env_common):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(body))
+    coord = f"127.0.0.1:{_free_port()}"
+    job = uuid.uuid4().hex[:12]
+    procs = []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(
+            T4J_RANK=str(rank), T4J_SIZE=str(nprocs), T4J_COORD=coord,
+            T4J_JOB=job,
+        )
+        env.update(env_common)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=str(REPO),
+        ))
+    results = []
+    deadline = time.monotonic() + 120
+    for rank, p in enumerate(procs):
+        left = max(1.0, deadline - time.monotonic())
+        try:
+            out, err = p.communicate(timeout=left)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            raise AssertionError(
+                f"rank {rank} hung (fingerprint pass must not "
+                f"block)\nstdout:\n{out}\nstderr:\n{err}"
+            )
+        results.append((p.returncode, out, err))
+    return results
+
+
+def _marker(out):
+    for line in out.splitlines():
+        if line.startswith("T4JMARK "):
+            return line
+    raise AssertionError(f"no T4JMARK line in output:\n{out}")
+
+
+def _elapsed(marker):
+    for tok in marker.split():
+        if tok.startswith("elapsed="):
+            return float(tok.split("=", 1)[1])
+    raise AssertionError(f"no elapsed in marker: {marker}")
+
+
+def test_divergent_schedule_raises_under_deadline(tmp_path):
+    results = _spawn(
+        tmp_path, WORKER, 2,
+        {
+            "DIVERGE": "1",
+            "T4J_OP_TIMEOUT": str(OP_TIMEOUT),
+            "T4J_VERIFY": "fingerprint",
+        },
+    )
+    for rank, (rc, out, err) in enumerate(results):
+        marker = _marker(out)
+        assert rc == RAISED, (rank, rc, out, err)
+        # every rank raises, naming the rule and the differing step
+        assert "T4J007" in marker, marker
+        assert "bcast" in marker and "allreduce" in marker, marker
+        # the whole point: far inside the op deadline (acceptance bar
+        # is T4J_OP_TIMEOUT/5)
+        assert _elapsed(marker) < OP_TIMEOUT / 5, marker
+
+
+def test_agreeing_schedule_passes(tmp_path):
+    results = _spawn(
+        tmp_path, WORKER, 2,
+        {
+            "DIVERGE": "0",
+            "T4J_OP_TIMEOUT": str(OP_TIMEOUT),
+            "T4J_VERIFY": "fingerprint",
+        },
+    )
+    for rank, (rc, out, err) in enumerate(results):
+        marker = _marker(out)
+        assert rc == NO_RAISE, (rank, rc, out, err)
+        assert "peers=2" in marker, marker

@@ -1,0 +1,273 @@
+"""Unit matrix for the pure rule core (analysis/contracts.py).
+
+Runs on every container: the module under test imports no jax and is
+loaded standalone when the package cannot import (conftest.py).  The
+full-stack matrix (real ops, real traces) is test_verify_comm.py.
+"""
+
+import re
+
+import pytest
+
+
+def ev(contracts, seq, kind, **kw):
+    defaults = dict(
+        comm_key=("proc", 0),
+        backend="proc",
+        comm_size=2,
+        dtype="float32",
+        shape=(4,),
+    )
+    defaults.update(kw)
+    return contracts.CommEvent(seq=seq, kind=kind, **defaults)
+
+
+class TestTokenRules:
+    def test_fork_detected(self, contracts):
+        events = [
+            ev(contracts, 0, "allreduce", token_in=101, token_out=102),
+            ev(contracts, 1, "allreduce", token_in=101, token_out=103),
+        ]
+        rules = [f.rule for f in contracts.check_schedule(events)]
+        assert rules == ["T4J001"]
+
+    def test_linear_chain_clean(self, contracts):
+        events = [
+            ev(contracts, 0, "allreduce", token_in=101, token_out=102),
+            ev(contracts, 1, "bcast", token_in=102, token_out=103, root=0),
+        ]
+        assert contracts.check_schedule(events) == []
+
+    def test_triple_fork_two_findings(self, contracts):
+        events = [
+            ev(contracts, 0, "allreduce", token_in=7, token_out=10),
+            ev(contracts, 1, "allreduce", token_in=7, token_out=11),
+            ev(contracts, 2, "allreduce", token_in=7, token_out=12),
+        ]
+        rules = [f.rule for f in contracts.check_schedule(events)]
+        assert rules == ["T4J001", "T4J001"]
+
+    def test_dropped_pending_send(self, contracts):
+        events = [
+            ev(contracts, 0, "send", backend="mesh", token_in=1,
+               token_out=2, pending_out=("tag=3 perm=((0, 1),) f32[4]",),
+               dest=((0, 1),), tag=3),
+        ]
+        findings = contracts.check_schedule(events)
+        assert [f.rule for f in findings] == ["T4J002"]
+        assert "tag=3" in findings[0].message
+
+    def test_pending_carried_then_drained_clean(self, contracts):
+        events = [
+            ev(contracts, 0, "send", backend="mesh", token_in=1,
+               token_out=2, pending_out=("tag=3 ...",), tag=3),
+            ev(contracts, 1, "allreduce", backend="mesh", token_in=2,
+               token_out=3, pending_out=("tag=3 ...",)),
+            ev(contracts, 2, "recv", backend="mesh", token_in=3,
+               token_out=4, tag=3),
+        ]
+        assert contracts.check_schedule(events) == []
+
+
+class TestSelfDeadlock:
+    def test_recv_before_send_to_self(self, contracts):
+        events = [
+            ev(contracts, 0, "recv", rank=0, source=0, tag=5),
+            ev(contracts, 1, "send", rank=0, dest=0, tag=5),
+        ]
+        findings = contracts.check_schedule(events)
+        assert [f.rule for f in findings] == ["T4J004"]
+        assert "wait-for cycle" in findings[0].message
+
+    def test_recv_from_self_never_sent(self, contracts):
+        events = [ev(contracts, 0, "recv", rank=1, source=1, tag=5)]
+        findings = contracts.check_schedule(events)
+        assert [f.rule for f in findings] == ["T4J004"]
+        assert "never issues" in findings[0].message
+
+    def test_send_then_recv_self_clean(self, contracts):
+        events = [
+            ev(contracts, 0, "send", rank=0, dest=0, tag=5),
+            ev(contracts, 1, "recv", rank=0, source=0, tag=5),
+        ]
+        assert contracts.check_schedule(events) == []
+
+    def test_wildcard_tag_matches_earlier_self_send(self, contracts):
+        events = [
+            ev(contracts, 0, "send", rank=0, dest=0, tag=9),
+            ev(contracts, 1, "recv", rank=0, source=0, tag=-1),
+        ]
+        assert contracts.check_schedule(events) == []
+
+    def test_cross_rank_recv_not_flagged(self, contracts):
+        # recv from a *different* rank is satisfied remotely: the
+        # single-rank pass must stay silent (fingerprint territory)
+        events = [ev(contracts, 0, "recv", rank=0, source=1, tag=5)]
+        assert contracts.check_schedule(events) == []
+
+
+class TestNativeDtypes:
+    def test_unsupported_dtype_on_proc(self, contracts):
+        events = [ev(contracts, 0, "allreduce", dtype="float8_e4m3fn")]
+        assert [f.rule for f in contracts.check_schedule(events)] == [
+            "T4J006"
+        ]
+
+    def test_supported_dtype_clean(self, contracts):
+        events = [ev(contracts, 0, "allreduce", dtype="bfloat16")]
+        assert contracts.check_schedule(events) == []
+
+    def test_mesh_backend_not_gated(self, contracts):
+        # mesh ops never cross the native bridge: exotic dtypes are
+        # XLA's business there
+        events = [
+            ev(contracts, 0, "allreduce", backend="mesh",
+               dtype="float8_e4m3fn")
+        ]
+        assert contracts.check_schedule(events) == []
+
+    def test_table_matches_native_runtime(self, contracts):
+        # drift pin: the rule's dtype list must equal the native
+        # bridge's _DTYPE_CODES table (parsed from source so this test
+        # runs even where the package cannot import)
+        import pathlib
+
+        src = (
+            pathlib.Path(__file__).resolve().parent.parent.parent
+            / "mpi4jax_tpu" / "native" / "runtime.py"
+        ).read_text()
+        table = re.search(r"_DTYPE_CODES = \{(.*?)\}", src, re.S).group(1)
+        names = set(re.findall(r'"(\w+)":', table))
+        assert names == set(contracts.NATIVE_DTYPES)
+
+
+class TestErrorClassification:
+    @pytest.mark.parametrize(
+        "text,rule",
+        [
+            ("recv found no matching in-trace send on this token. ...",
+             "T4J003"),
+            ("recv template shape/dtype (3,)/float32 does not match "
+             "staged send (2, 2)/float32", "T4J003"),
+            ("send dest pattern is not a permutation: [(0, 1), (1, 1)]",
+             "T4J003"),
+            ("root=9 out of range for communicator of size 8", "T4J006"),
+            ("alltoall input must have shape (nproc, ...) with nproc == "
+             "comm.size=8, got shape (2,)", "T4J006"),
+            ("unsupported dtype for the native bridge: float8_e4m3fn",
+             "T4J006"),
+            ("token still carries unmatched send(s): tag=1 perm=((0, 1),)",
+             "T4J002"),
+            ("sendrecv source and dest views disagree: ... They must "
+             "describe one global permutation.", "T4J003"),
+            ("dest=3: a bare integer rank is ambiguous under SPMD ...",
+             "T4J006"),
+        ],
+    )
+    def test_known_errors_classified(self, contracts, text, rule):
+        assert contracts.classify_trace_error(RuntimeError(text)) == rule
+
+    def test_unrelated_error_propagates(self, contracts):
+        assert contracts.classify_trace_error(ValueError("shapes differ")) \
+            is None
+
+
+class TestFingerprintCore:
+    def test_signature_stable_across_ranks(self, contracts):
+        # per-rank fields (rank, src_info, token ids) must not leak
+        # into the cross-rank signature
+        a = ev(contracts, 0, "allreduce", rank=0, token_in=1, token_out=2,
+               src_info="a.py:1", reduce_op="sum")
+        b = ev(contracts, 0, "allreduce", rank=1, token_in=9, token_out=8,
+               src_info="b.py:99", reduce_op="sum")
+        assert contracts.step_signature(a) == contracts.step_signature(b)
+
+    def test_signature_differs_on_contract_fields(self, contracts):
+        base = ev(contracts, 0, "allreduce", reduce_op="sum")
+        for change in (
+            dict(kind="bcast"),
+            dict(reduce_op="max"),
+            dict(dtype="float64"),
+            dict(shape=(8,)),
+            dict(comm_key=("proc", 1)),
+            dict(root=0),
+            dict(tag=4),
+        ):
+            kw = dict(reduce_op="sum")
+            kw.update(change)
+            other = ev(contracts, 0, kw.pop("kind", "allreduce"), **kw)
+            assert contracts.step_signature(base) != \
+                contracts.step_signature(other)
+
+    def test_int_partner_reduces_to_kind(self, contracts):
+        # MPMD ranks legitimately send to different int partners; the
+        # signature keeps the *kind* so schedules still align
+        a = ev(contracts, 0, "send", dest=1, tag=0)
+        b = ev(contracts, 0, "send", dest=0, tag=0)
+        assert contracts.step_signature(a) == contracts.step_signature(b)
+
+    def test_pattern_partner_is_verbatim(self, contracts):
+        a = ev(contracts, 0, "send", dest=((0, 1), (1, 0)), tag=0)
+        b = ev(contracts, 0, "send", dest=((0, 1),), tag=0)
+        assert contracts.step_signature(a) != contracts.step_signature(b)
+
+    def test_first_divergence(self, contracts):
+        lines = [["a", "b", "c"], ["a", "x", "c"]]
+        step, details = contracts.first_divergence(lines)
+        assert step == 1
+        assert details == {0: "b", 1: "x"}
+
+    def test_divergence_on_length(self, contracts):
+        lines = [["a", "b"], ["a"]]
+        step, details = contracts.first_divergence(lines)
+        assert step == 1
+        assert details[1] == "<schedule ends>"
+
+    def test_agreement(self, contracts):
+        assert contracts.first_divergence([["a", "b"], ["a", "b"]]) is None
+
+    def test_digest_changes_with_schedule(self, contracts):
+        e1 = [ev(contracts, 0, "allreduce", reduce_op="sum")]
+        e2 = [ev(contracts, 0, "allreduce", reduce_op="max")]
+        assert contracts.schedule_digest(e1) != contracts.schedule_digest(e2)
+
+    def test_divergence_message_names_ranks_and_step(self, contracts):
+        msg = contracts.divergence_message(3, {0: "allreduce", 1: "bcast"})
+        assert "T4J007" in msg and "step 3" in msg
+        assert "allreduce" in msg and "bcast" in msg
+
+
+class TestRuleCatalog:
+    def test_ids_stable(self, contracts):
+        # released IDs are frozen: renumbering breaks suppressions and
+        # CI greps downstream
+        assert set(contracts.RULES) == {
+            f"T4J00{i}" for i in range(1, 8)
+        }
+
+    def test_finding_str_carries_rule_and_src(self, contracts):
+        f = contracts.Finding(rule="T4J001", message="boom",
+                              src_info="x.py:3")
+        assert str(f) == "T4J001: boom [x.py:3]"
+
+
+class TestVerifyModeConfig:
+    def test_default_off(self, t4j_config, monkeypatch):
+        monkeypatch.delenv("T4J_VERIFY", raising=False)
+        assert t4j_config.verify_mode() == "off"
+
+    @pytest.mark.parametrize("v,want", [
+        ("off", "off"), ("fingerprint", "fingerprint"), ("full", "full"),
+        ("FULL", "full"), (" fingerprint ", "fingerprint"),
+    ])
+    def test_values(self, t4j_config, monkeypatch, v, want):
+        monkeypatch.setenv("T4J_VERIFY", v)
+        assert t4j_config.verify_mode() == want
+
+    @pytest.mark.parametrize("bad", ["on", "1", "lint", "static"])
+    def test_bad_value_raises(self, t4j_config, monkeypatch, bad):
+        # a typo'd mode must fail at launch, not silently skip
+        # verification (same contract as T4J_HIER)
+        monkeypatch.setenv("T4J_VERIFY", bad)
+        with pytest.raises(ValueError, match="T4J_VERIFY"):
+            t4j_config.verify_mode()

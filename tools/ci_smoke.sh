@@ -13,15 +13,23 @@
 #                the sanitize flag is part of the build fingerprint).
 #                Running the suites under ASan needs LD_PRELOAD plumbing
 #                (.claude/skills/verify/SKILL.md) and stays manual.
+#   5. tsan    — same BUILD check under ThreadSanitizer
+#                (T4J_SANITIZE=thread): the bridge's progress/abort/shm
+#                threads compile under the race instrumentation.
+#   6. lint    — tools/lint.sh: ruff + mypy (pyproject.toml config) and
+#                t4j-lint over examples/ + models/, so the contract
+#                analyzer dogfoods the repo's own programs on every run
+#                (docs/static-analysis.md).  Tools missing from the
+#                container are skipped inside lint.sh.
 #
-# Usage: tools/ci_smoke.sh [lane...]   (default: all four)
+# Usage: tools/ci_smoke.sh [lane...]   (default: all six)
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
-  lanes=(tier1 fault proc asan)
+  lanes=(tier1 fault proc asan tsan lint)
 fi
 
 run_lane() {
@@ -61,8 +69,15 @@ for lane in "${lanes[@]}"; do
       run_lane asan env T4J_SANITIZE=address \
         python -m mpi4jax_tpu.native.build
       ;;
+    tsan)
+      run_lane tsan env T4J_SANITIZE=thread \
+        python -m mpi4jax_tpu.native.build
+      ;;
+    lint)
+      run_lane lint tools/lint.sh
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint)" >&2
       exit 2
       ;;
   esac

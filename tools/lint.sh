@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Lint runner: ruff + mypy (targeted config in pyproject.toml) plus the
+# repo's own trace-time contract verifier (t4j-lint) over the example
+# and model programs that declare T4J_LINT_ENTRIES.
+#
+# Tools that are not installed in the current container are skipped
+# with a note instead of failing the run — the image bakes in the
+# jax_graft toolchain and nothing may be pip-installed on top
+# (ROADMAP constraints); containers with the full toolchain run all
+# three legs.
+#
+# Usage: tools/lint.sh [ruff|mypy|t4j] ...   (default: all)
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+legs=("$@")
+if [ ${#legs[@]} -eq 0 ]; then
+  legs=(ruff mypy t4j)
+fi
+
+fail=0
+
+# resolve each tool once: prefer the binary, fall back to python -m,
+# empty when neither exists (the leg then skips with a note)
+tool_cmd() {
+  if command -v "$1" >/dev/null 2>&1; then
+    echo "$1"
+  elif python -c "import $1" >/dev/null 2>&1; then
+    echo "python -m $1"
+  fi
+}
+
+for leg in "${legs[@]}"; do
+  case "$leg" in
+    ruff)
+      echo "=== lint leg: ruff ==="
+      cmd=$(tool_cmd ruff)
+      if [ -n "$cmd" ]; then
+        $cmd check . || fail=1
+      else
+        echo "ruff not installed in this container, skipped"
+      fi
+      ;;
+    mypy)
+      echo "=== lint leg: mypy ==="
+      cmd=$(tool_cmd mypy)
+      if [ -n "$cmd" ]; then
+        $cmd || fail=1
+      else
+        echo "mypy not installed in this container, skipped"
+      fi
+      ;;
+    t4j)
+      echo "=== lint leg: t4j-lint (examples + models) ==="
+      # the verifier needs the package importable (jax >= floor);
+      # old-jax containers skip, same contract as the test suite
+      if python -c "import mpi4jax_tpu" >/dev/null 2>&1; then
+        env JAX_PLATFORMS=cpu python -m mpi4jax_tpu.analysis.cli \
+          examples/*.py mpi4jax_tpu/models/*.py || fail=1
+      else
+        echo "mpi4jax_tpu not importable (old jax), t4j-lint skipped"
+      fi
+      ;;
+    *)
+      echo "unknown lint leg: $leg (want ruff|mypy|t4j)" >&2
+      exit 2
+      ;;
+  esac
+done
+
+if [ $fail -ne 0 ]; then
+  echo "=== lint FAILED ==="
+  exit 1
+fi
+echo "=== lint passed ==="
