@@ -16,6 +16,14 @@ raise a contextual error instead of hanging until the kill
 torn down and the launcher exits 124, naming the ranks that were still
 running (the likely hang participants).
 
+``--restarts N`` adds bounded auto-relaunch: a job that exits nonzero
+(other than Ctrl-C) is relaunched up to N more times with a fresh
+coordinator port and job id, the attempt count and final status
+reported per attempt.  This is the coarse-grained rung under the
+transport's fine-grained self-healing (docs/failure-semantics.md):
+pair it with ``utils/checkpoint.py`` so the relaunched job resumes at
+the last saved step instead of from scratch.
+
 Children default to the CPU platform (one XLA CPU per process, the
 reference's process model); override with ``--platform``.
 """
@@ -33,6 +41,10 @@ def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _say(msg):
+    print(f"mpi4jax_tpu.launch: {msg}", file=sys.stderr, flush=True)
 
 
 def child_main(argv):
@@ -63,6 +75,23 @@ def child_main(argv):
             )
             try:
                 runtime.notify_abort(why)
+            except Exception:
+                pass
+            # first-failure report: when the self-healing transport saw
+            # action before the death, say so — a rank dying AFTER
+            # surviving reconnects usually points at a flaky fabric
+            try:
+                stats = runtime.link_stats()
+                if stats and stats["reconnects"]:
+                    print(
+                        f"r{os.environ.get('T4J_RANK', '?')} | t4j link "
+                        f"stats at failure: {stats['reconnects']} "
+                        f"reconnect(s), {stats['replayed_frames']} "
+                        f"frame(s) / {stats['replayed_bytes']} bytes "
+                        "replayed (docs/failure-semantics.md)",
+                        file=sys.stderr,
+                        flush=True,
+                    )
             except Exception:
                 pass
         raise
@@ -114,6 +143,16 @@ def main(argv=None):
         help="whole-job deadline: past it every worker is torn down and "
         "the launcher exits 124, naming the ranks still running",
     )
+    parser.add_argument(
+        "--restarts",
+        type=int,
+        default=0,
+        metavar="N",
+        help="bounded auto-relaunch: a job exiting nonzero (other than "
+        "Ctrl-C) is relaunched up to N more times with a fresh "
+        "coordinator/job id — pair with utils/checkpoint.py so the "
+        "relaunch resumes at the last saved step",
+    )
     parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("prog", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -126,7 +165,35 @@ def main(argv=None):
         parser.error("usage: python -m mpi4jax_tpu.launch -np N prog.py ...")
     if args.timeout is not None and args.timeout <= 0:
         parser.error("--timeout must be > 0 seconds (omit it for no deadline)")
+    if args.restarts < 0:
+        parser.error("--restarts must be >= 0")
 
+    attempts = args.restarts + 1
+    for attempt in range(1, attempts + 1):
+        exit_code = _run_job(args)
+        if exit_code == 0 or exit_code == 130:
+            break
+        if attempt < attempts:
+            _say(
+                f"attempt {attempt}/{attempts} exited with code "
+                f"{exit_code}; restarting the job "
+                f"({attempts - attempt} restart(s) left)"
+            )
+        elif args.restarts:
+            # without --restarts the launcher's failure output must
+            # stay exactly the pre-restart-feature report
+            _say(
+                f"attempt {attempt}/{attempts} exited with code "
+                f"{exit_code}; restart budget exhausted (--restarts "
+                f"{args.restarts})"
+            )
+    if args.restarts and exit_code == 0 and attempt > 1:
+        _say(f"job succeeded on attempt {attempt}/{attempts}")
+    return exit_code
+
+
+def _run_job(args):
+    """One launch attempt: spawn the workers, wait, fail fast."""
     n = args.nprocs
     coord = f"127.0.0.1:{_free_port()}"
     # unique job id: namespaces the bridge's same-host shm segments so
@@ -162,9 +229,6 @@ def main(argv=None):
     exit_code = 0
     start = time.monotonic()
     terminated_at = None  # first terminate time, for SIGKILL escalation
-
-    def _say(msg):
-        print(f"mpi4jax_tpu.launch: {msg}", file=sys.stderr, flush=True)
 
     try:
         remaining = set(range(n))

@@ -27,6 +27,8 @@ __all__ = [
     "set_timeouts",
     "set_tuning",
     "set_hier",
+    "set_resilience",
+    "link_stats",
     "topology",
     "hier_would_select",
     "hier_active",
@@ -89,6 +91,17 @@ def _load():
     lib.t4j_set_timeouts.argtypes = [ctypes.c_double, ctypes.c_double]
     lib.t4j_set_tuning.argtypes = [ctypes.c_int64, ctypes.c_int64]
     lib.t4j_set_hier.argtypes = [ctypes.c_int32, ctypes.c_int64]
+    lib.t4j_set_resilience.argtypes = [
+        ctypes.c_int32, ctypes.c_double, ctypes.c_double, ctypes.c_int64,
+    ]
+    lib.t4j_link_stats.argtypes = [
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.t4j_link_stats.restype = ctypes.c_int32
     lib.t4j_topo.argtypes = [ctypes.POINTER(ctypes.c_int32)] * 5
     lib.t4j_topo.restype = ctypes.c_int32
     lib.t4j_hier_would_select.argtypes = [ctypes.c_int32, ctypes.c_uint64]
@@ -150,14 +163,78 @@ def check_health():
     """Raise BridgeError if the bridge posted a fault (a peer died, an
     op timed out, or an abort broadcast arrived).  Called from the op
     tier before dispatch so post-fault calls fail fast instead of
-    feeding a dead transport."""
+    feeding a dead transport.  When the self-healing layer saw action
+    before the fault, the message carries the reconnect/replay
+    counters — a job that died AFTER surviving drops usually points at
+    a flaky fabric, and the counters make that visible in the
+    post-mortem."""
     lib = _state["lib"]
     if lib is None or not lib.t4j_initialized():
         return
     if lib.t4j_health():
         raw = lib.t4j_fault_msg()
         msg = raw.decode("utf-8", "replace") if raw else "bridge faulted"
+        stats = link_stats()
+        if stats and stats["reconnects"]:
+            msg += (
+                " [self-healing before the fault: "
+                f"{stats['reconnects']} reconnect(s), "
+                f"{stats['replayed_frames']} frame(s) / "
+                f"{stats['replayed_bytes']} bytes replayed — "
+                "docs/failure-semantics.md]"
+            )
         raise BridgeError(msg)
+
+
+def link_stats(peer=None):
+    """Self-healing transport counters (docs/failure-semantics.md
+    "self-healing transport"), or ``None`` before init.
+
+    ``peer=None`` aggregates every link: ``{"reconnects",
+    "replayed_frames", "replayed_bytes", "state"}`` with ``state`` the
+    worst link state (0 up, 1 broken/repairing, 2 dead).  An integer
+    ``peer`` selects that world rank's link (``None`` for self or
+    out-of-range)."""
+    lib = _state["lib"]
+    if lib is None or not lib.t4j_initialized():
+        return None
+    rec = ctypes.c_uint64(0)
+    frames = ctypes.c_uint64(0)
+    nbytes = ctypes.c_uint64(0)
+    state = ctypes.c_int32(0)
+    ok = lib.t4j_link_stats(
+        -1 if peer is None else int(peer),
+        ctypes.byref(rec), ctypes.byref(frames), ctypes.byref(nbytes),
+        ctypes.byref(state),
+    )
+    if not ok:
+        return None
+    return {
+        "reconnects": rec.value,
+        "replayed_frames": frames.value,
+        "replayed_bytes": nbytes.value,
+        "state": state.value,
+    }
+
+
+def set_resilience(retry_max=None, backoff_base_s=None, backoff_max_s=None,
+                   replay_bytes=None):
+    """Runtime override of the self-healing transport knobs.
+
+    ``None`` keeps the current value; ``retry_max=0`` disables
+    self-healing (the first transport error fails the job).  Must be
+    set before init and uniformly across ranks (the launcher
+    propagates ``T4J_RETRY_MAX`` / ``T4J_BACKOFF_BASE`` /
+    ``T4J_BACKOFF_MAX`` / ``T4J_REPLAY_BYTES``): the reconnect
+    listener is wired at bootstrap, and one side healing while the
+    other fail-stops would turn every transient drop into an abort."""
+    lib = _load()
+    lib.t4j_set_resilience(
+        -1 if retry_max is None else int(retry_max),
+        -1.0 if backoff_base_s is None else float(backoff_base_s),
+        -1.0 if backoff_max_s is None else float(backoff_max_s),
+        -1 if replay_bytes is None else int(replay_bytes),
+    )
 
 
 def notify_abort(why):
@@ -500,10 +577,14 @@ def ensure_initialized():
     op_s, connect_s = config.op_timeout(), config.connect_timeout()
     ring_min, seg = config.ring_min_bytes(), config.seg_bytes()
     hier, hier_min = config.hier_mode(), config.leader_ring_min_bytes()
+    retry = config.retry_max()
+    boff_base, boff_max = config.backoff_base(), config.backoff_max()
+    replay = config.replay_bytes()
     lib = _load()
     lib.t4j_set_timeouts(op_s, connect_s)
     lib.t4j_set_tuning(ring_min, seg)
     lib.t4j_set_hier(_HIER_MODES[hier], hier_min)
+    lib.t4j_set_resilience(retry, boff_base, boff_max, replay)
     rc = lib.t4j_init()
     if rc != 0:
         detail = last_error()

@@ -21,8 +21,28 @@
 //                       forever, the default — matching MPI)
 //   T4J_CONNECT_TIMEOUT bootstrap connect/accept deadline (default 30s)
 // Deterministic fault injection for tests (T4J_FAULT_MODE=refuse|
-// close_after|delay gated on T4J_FAULT_RANK) is compiled in; see
-// init_from_env.
+// close_after|delay|die_after|flaky|drop_conn gated on T4J_FAULT_RANK)
+// is compiled in; see init_from_env.
+//
+// Self-healing transport (docs/failure-semantics.md "self-healing
+// transport"): each TCP peer link carries a connection epoch and
+// sequence-numbered frames backed by a bounded replay ring, so a
+// transient connection drop no longer kills the job.  The escalation
+// ladder is retry -> reconnect+replay -> abort: the surviving sides
+// re-dial with exponential backoff + jitter, handshake (incarnation
+// token, epoch, last-acked seq) and replay only the unacked tail —
+// in-flight segmented/hierarchical collectives resume from the last
+// completed segment instead of restarting.  Exhausted retries, an
+// evicted replay tail, or a re-dial from a RESTARTED process (stale
+// bootstrap fingerprint) escalate to the abort broadcast above, so
+// fail-stop remains the backstop.  Knobs (validated in
+// utils/config.py):
+//   T4J_RETRY_MAX     reconnect attempts per break (default 3;
+//                     0 disables self-healing entirely)
+//   T4J_BACKOFF_BASE  first re-dial delay, seconds (default 0.05)
+//   T4J_BACKOFF_MAX   backoff cap, seconds (default 2)
+//   T4J_REPLAY_BYTES  per-peer replay-ring cap (default 32 MiB; see
+//                     docs/performance.md for the memory cost)
 //
 // Data-plane algorithm selection (docs/performance.md "TCP-tier
 // algorithm selection"): large-message allreduce/allgather/
@@ -152,6 +172,29 @@ void set_tuning(long long ring_min, long long seg);
 // uniform across ranks (divergent values would run mismatched
 // algorithms and deadlock); utils/config.py owns validation.
 void set_hier(int mode, long long min_bytes);
+
+// Override the env-derived self-healing knobs.  retry: < 0 keeps,
+// 0 disables (fail-stop on the first transport error, the pre-PR-5
+// behaviour), > 0 caps reconnect attempts per break.  base_s / max_s:
+// <= 0 keeps.  replay: < 0 keeps, >= 0 sets the per-peer replay-ring
+// byte cap.  Must be called before init and uniformly across ranks;
+// utils/config.py owns validation.
+void set_resilience(int retry, double base_s, double max_s,
+                    long long replay);
+
+// Per-peer self-healing counters (t4j_link_stats / runtime.link_stats):
+// how many times the link reconnected and how much it replayed.
+// state: 0 = up, 1 = broken (repair in progress), 2 = dead.
+struct LinkStats {
+  uint64_t reconnects;
+  uint64_t replayed_frames;
+  uint64_t replayed_bytes;
+  int state;
+};
+// peer >= 0: that link's counters (false for self/out-of-range).
+// peer < 0: aggregate over every link, state = worst.  False before
+// init.
+bool link_stats(int peer, LinkStats* out);
 
 // World-level topology discovered at bootstrap (host fingerprints).
 // host_id is the ordinal of this rank's host in first-occurrence

@@ -428,6 +428,30 @@ void t4j_set_tuning(int64_t ring_min_bytes, int64_t seg_bytes) {
 void t4j_set_hier(int32_t mode, int64_t min_bytes) {
   t4j::set_hier(mode, min_bytes);
 }
+// Self-healing transport knobs (docs/failure-semantics.md
+// "self-healing transport"); must be set before t4j_init and
+// uniformly across ranks.  retry_max < 0 keeps, 0 disables; backoffs
+// <= 0 keep; replay_bytes < 0 keeps.
+void t4j_set_resilience(int32_t retry_max, double backoff_base_s,
+                        double backoff_max_s, int64_t replay_bytes) {
+  t4j::set_resilience(retry_max, backoff_base_s, backoff_max_s,
+                      replay_bytes);
+}
+// Per-peer reconnect/replay counters.  peer >= 0 selects one link;
+// peer < 0 aggregates every link (state = worst: 0 up, 1 broken,
+// 2 dead).  Returns 1 when the outputs were filled, 0 before init or
+// for an invalid peer.
+int32_t t4j_link_stats(int32_t peer, uint64_t* reconnects,
+                       uint64_t* replayed_frames,
+                       uint64_t* replayed_bytes, int32_t* state) {
+  t4j::LinkStats s;
+  if (!t4j::link_stats(peer, &s)) return 0;
+  if (reconnects) *reconnects = s.reconnects;
+  if (replayed_frames) *replayed_frames = s.replayed_frames;
+  if (replayed_bytes) *replayed_bytes = s.replayed_bytes;
+  if (state) *state = s.state;
+  return 1;
+}
 // Bootstrap topology (host_id, local_rank, local_size, leader_rank,
 // n_hosts); returns 0 and leaves the outputs untouched before init.
 int32_t t4j_topo(int32_t* host_id, int32_t* local_rank,

@@ -22,6 +22,23 @@ Robustness deadlines for the multi-process DCN bridge
 * ``T4J_CONNECT_TIMEOUT`` — bootstrap connect/accept deadline in
                             seconds (default 30).
 
+Self-healing transport knobs (docs/failure-semantics.md "self-healing
+transport" — the retry -> reconnect+replay -> abort escalation ladder):
+
+* ``T4J_RETRY_MAX``     — reconnect attempts per broken link (default
+                          3); 0 disables self-healing entirely (the
+                          first transport error fails the job, the
+                          pre-PR-5 behaviour).
+* ``T4J_BACKOFF_BASE``  — first re-dial delay in seconds (default
+                          0.05); subsequent attempts double it, with
+                          ±25 % jitter.
+* ``T4J_BACKOFF_MAX``   — re-dial delay cap in seconds (default 2).
+* ``T4J_REPLAY_BYTES``  — per-peer replay-ring capacity (default 32M;
+                          docs/performance.md covers the memory and
+                          copy cost).  Must exceed the bytes a drop
+                          can lose in flight (the two kernel socket
+                          buffers) or recovery escalates to abort.
+
 Data-plane tuning for the TCP-tier collectives (docs/performance.md
 "TCP-tier algorithm selection"):
 
@@ -67,10 +84,15 @@ __all__ = [
     "op_timeout",
     "connect_timeout",
     "byte_count",
+    "int_count",
     "ring_min_bytes",
     "seg_bytes",
     "hier_mode",
     "leader_ring_min_bytes",
+    "retry_max",
+    "backoff_base",
+    "backoff_max",
+    "replay_bytes",
     "verify_mode",
 ]
 
@@ -168,6 +190,75 @@ def byte_count(value, default, name="value", minimum=0):
         # that does not name the variable
         raise ValueError(f"{name}={value!r} is implausibly large")
     return v
+
+
+def int_count(value, default, name="value", minimum=0):
+    """Parse an env-var plain integer count (no size suffix).
+
+    ``None``/empty returns ``default``; anything that is not a whole
+    number >= ``minimum`` raises ``ValueError`` naming the variable."""
+    if value is None or str(value).strip() == "":
+        return int(default)
+    try:
+        v = int(str(value).strip(), 10)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"cannot interpret {name}={value!r} as an integer count"
+        )
+    if v < minimum:
+        raise ValueError(f"{name}={value!r} must be >= {minimum}")
+    return v
+
+
+def retry_max():
+    """Reconnect attempts per broken DCN link before escalating to the
+    abort broadcast (docs/failure-semantics.md "self-healing
+    transport").  0 disables self-healing: the first transport error
+    fails the job, the pre-self-healing behaviour."""
+    return int_count(
+        os.environ.get("T4J_RETRY_MAX"), 3, name="T4J_RETRY_MAX"
+    )
+
+
+def backoff_base():
+    """First re-dial delay in seconds (strictly positive); each
+    subsequent attempt doubles it, with ±25 % jitter so the two ends of
+    a broken link never re-dial in lockstep."""
+    v = seconds(
+        os.environ.get("T4J_BACKOFF_BASE"), 0.05, name="T4J_BACKOFF_BASE"
+    )
+    if v <= 0:
+        raise ValueError("T4J_BACKOFF_BASE must be > 0 seconds")
+    return v
+
+
+def backoff_max():
+    """Re-dial delay cap in seconds; must be >= T4J_BACKOFF_BASE (a cap
+    below the base would silently shrink the first delay)."""
+    v = seconds(
+        os.environ.get("T4J_BACKOFF_MAX"), 2.0, name="T4J_BACKOFF_MAX"
+    )
+    if v <= 0:
+        raise ValueError("T4J_BACKOFF_MAX must be > 0 seconds")
+    if v < backoff_base():
+        raise ValueError(
+            "T4J_BACKOFF_MAX must be >= T4J_BACKOFF_BASE "
+            f"(got {v} < {backoff_base()})"
+        )
+    return v
+
+
+def replay_bytes():
+    """Per-peer replay-ring capacity in bytes for the self-healing
+    transport (default 32M).  Sized to exceed the bytes a connection
+    drop can lose in flight (the two kernel socket buffers, ~8 MB each
+    when pinned); a reconnect that needs frames already evicted
+    escalates to abort.  docs/performance.md covers the memory cost."""
+    return byte_count(
+        os.environ.get("T4J_REPLAY_BYTES"),
+        32 << 20,
+        name="T4J_REPLAY_BYTES",
+    )
 
 
 def ring_min_bytes():

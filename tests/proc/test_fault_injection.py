@@ -444,6 +444,179 @@ except Exception as e:
     assert "32" in blob and "16" in blob, blob[-2000:]  # expected/got bytes
 
 
+# ------------------------------------------------- self-healing transport
+
+
+def test_flaky_connection_self_heals(tmp_path):
+    """flaky: rank 1 drops every TCP connection twice mid-allreduce
+    (≥2 drops per link), then behaves.  The self-healing transport
+    (docs/failure-semantics.md "self-healing transport") must
+    reconnect and replay so every rank finishes ALL iterations with
+    results bit-identical to the fault-free reduction — zero abort
+    broadcasts, zero raised ops."""
+    body = PREAMBLE + """
+iters, count = 12, 64 * 1024
+for it in range(iters):
+    per_rank = [
+        np.random.default_rng(1000 * it + r)
+        .integers(0, 64, size=count).astype(np.float32)
+        for r in range(size)
+    ]
+    want = per_rank[0].copy()
+    for a in per_rank[1:]:
+        want += a
+    y, _ = m.allreduce(jnp.asarray(per_rank[rank]), op=m.SUM, comm=comm)
+    got = np.asarray(y)
+    assert got.tobytes() == want.tobytes(), (
+        f"iteration {it}: result differs from the fault-free reduction"
+    )
+print("SELF-HEAL-OK", flush=True)
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=8, timeout=240,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_RING_MIN_BYTES": "0",
+            "T4J_SEG_BYTES": "8192",
+            "T4J_FAULT_MODE": "flaky",
+            "T4J_FAULT_RANK": "1",
+            "T4J_FAULT_AFTER": "40",
+            "T4J_FAULT_COUNT": "2",
+        },
+    )
+    blob = ""
+    for rank, (rc, out, err) in enumerate(res):
+        assert rc == 0, (rank, rc, out[-2000:], err[-2000:])
+        assert "SELF-HEAL-OK" in out, (rank, out[-2000:])
+        blob += out + err
+    # the drops really happened, the links really healed, nobody aborted
+    assert "dropping every TCP connection" in blob, blob[-3000:]
+    assert "reconnected" in blob, blob[-3000:]
+    assert "abort" not in blob, blob[-3000:]
+
+
+def test_drop_conn_with_retries_disabled_aborts(tmp_path):
+    """drop_conn with T4J_RETRY_MAX=0: self-healing disabled, so the
+    one-shot connection drop must escalate exactly like the pre-self-
+    healing bridge — every rank raises a contextual BridgeError in
+    bounded time, with the broken peer named."""
+    body = PREAMBLE + f"""
+x = jnp.ones((16 * 1024,), jnp.float32)
+t0 = time.monotonic()
+try:
+    for i in range(200):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+    print("NO-RAISE", flush=True)
+    sys.exit({NO_RAISE})
+except Exception as e:
+    dt = time.monotonic() - t0
+    print(f"OP-RAISED after {{dt:.2f}}s: {{type(e).__name__}}: {{e}}",
+          flush=True)
+    assert dt < 30.0, dt
+    sys.exit({RAISED})
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=3,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_RING_MIN_BYTES": "0",
+            "T4J_SEG_BYTES": "8192",
+            "T4J_RETRY_MAX": "0",
+            "T4J_OP_TIMEOUT": "15",
+            "T4J_FAULT_MODE": "drop_conn",
+            "T4J_FAULT_RANK": "1",
+            "T4J_FAULT_AFTER": "40",
+        },
+    )
+    named_dead = False
+    for rank, (rc, out, err) in enumerate(res):
+        assert rc == RAISED, (rank, rc, out[-2000:], err[-2000:])
+        blob = out + err
+        assert "t4j" in blob, (rank, blob[-2000:])
+        named_dead = named_dead or "peer r1" in blob or "rank 1" in blob
+    assert named_dead, [r[1][-500:] + r[2][-500:] for r in res if r]
+
+
+# ------------------------------------------- checkpoint abort -> resume
+
+
+CKPT_JOB = PREAMBLE + """
+from mpi4jax_tpu.utils import checkpoint as ckpt
+
+TOTAL = 6
+x = jnp.ones((4,), jnp.float32)
+ckpt_dir = os.environ["T4J_TEST_CKPT_DIR"] + f"/rank{rank}"
+with ckpt.Manager(ckpt_dir, max_to_keep=3) as mgr:
+    latest = mgr.latest_step() or 0
+    # ranks may have died with different last-saved steps: agree on the
+    # minimum so the resumed schedules stay uniform
+    lat, _ = m.allreduce(jnp.array([float(latest)]), op=m.MIN, comm=comm)
+    start = int(np.asarray(lat)[0])
+    if start:
+        state = mgr.restore(
+            start, like={"acc": jnp.zeros((4,), jnp.float32)}
+        )["acc"]
+    else:
+        state = jnp.zeros((4,), jnp.float32)
+    print(f"RESUMED-AT {start}", flush=True)
+    for step in range(start, TOTAL):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        state = state + y
+        mgr.save(step + 1, {"acc": state})
+        mgr.wait_until_finished()
+        if step + 1 == 3 and os.environ.get("T4J_FAULT_MODE"):
+            # park on live collectives: the planted timer death lands
+            # here with steps 1..3 durably saved on every rank
+            runtime.set_timeouts(op_s=5.0)
+            while True:
+                time.sleep(0.2)
+                y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+                np.asarray(y)
+    final = np.asarray(state)
+    np.testing.assert_allclose(final, float(TOTAL * size))
+    print("CKPT-DONE", flush=True)
+"""
+
+
+def test_checkpoint_abort_resume(tmp_path):
+    """The coarse-grained rung of the recovery ladder: a rank dies
+    (die_after) mid-training, the job aborts, the relaunch restores
+    the last durably saved step via utils/checkpoint.py and finishes
+    with the exact fault-free result."""
+    pytest.importorskip("orbax.checkpoint")
+    ckpt_dir = str(tmp_path / "ckpt")
+    # incarnation 1: rank 1 dies on a timer while every rank is parked
+    # past the step-3 save
+    res = _spawn_world(
+        tmp_path, CKPT_JOB, nprocs=2,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_TEST_CKPT_DIR": ckpt_dir,
+            "T4J_FAULT_MODE": "die_after",
+            "T4J_FAULT_RANK": "1",
+            "T4J_FAULT_DELAY_MS": "10000",
+            # bound the survivor's reconnect wait for the dead dialer
+            "T4J_CONNECT_TIMEOUT": "3",
+        },
+    )
+    rc1, _, err1 = res[1]
+    assert rc1 == 42, (rc1, err1[-2000:])  # the planted death
+    rc0, out0, err0 = res[0]
+    assert rc0 not in (0, None), (rc0, out0[-2000:], err0[-2000:])
+    assert "RESUMED-AT 0" in out0, out0[-2000:]
+    # incarnation 2: no fault; must resume at the saved step, not step 0
+    res = _spawn_world(
+        tmp_path, CKPT_JOB, nprocs=2,
+        env_common={"T4J_NO_SHM": "1", "T4J_TEST_CKPT_DIR": ckpt_dir},
+    )
+    for rank, (rc, out, err) in enumerate(res):
+        assert rc == 0, (rank, rc, out[-2000:], err[-2000:])
+        assert "CKPT-DONE" in out, (rank, out[-2000:])
+        resumed = int(out.split("RESUMED-AT ")[1].split()[0])
+        assert resumed >= 1, (rank, out[-2000:])
+
+
 # ------------------------------------------------------- launcher reporting
 
 
@@ -524,3 +697,54 @@ time.sleep(300)
     assert rc == 124, (rc, out[-1000:], err[-2000:])
     assert "job deadline" in err, err[-2000:]
     assert time.monotonic() - t0 < 120
+
+
+def test_launcher_restarts_until_success(tmp_path):
+    """--restarts: a job whose first incarnation dies is relaunched
+    (fresh coordinator + job id) and the launcher reports the attempt
+    count; a succeeding relaunch yields exit code 0."""
+    marker = tmp_path / "first-attempt-done"
+    body = PREAMBLE + f"""
+marker = r"{str(marker)}"
+first = not os.path.exists(marker)
+if first:
+    open(marker, "w").close()
+x = jnp.ones((4,), jnp.float32)
+for i in range(5):
+    y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+    np.asarray(y)
+if first and rank == 1:
+    os._exit(17)
+try:
+    for i in range(5):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+except Exception:
+    sys.exit(5)
+print("JOB-OK", flush=True)
+"""
+    rc, out, err = _launch(
+        tmp_path, body, launch_args=("--restarts", "2"),
+        timeout=240,
+    )
+    assert rc == 0, (rc, out[-1000:], err[-2000:])
+    assert "restarting the job" in err, err[-2000:]
+    assert "attempt 1/3" in err, err[-2000:]
+    assert "succeeded on attempt 2/3" in err, err[-2000:]
+
+
+def test_launcher_restarts_budget_exhausted(tmp_path):
+    """--restarts: a job that keeps failing exhausts the budget and the
+    launcher reports it, propagating the last failure's exit code."""
+    body = PREAMBLE + """
+if rank == 0:
+    os._exit(9)
+import time
+time.sleep(60)
+"""
+    rc, out, err = _launch(
+        tmp_path, body, launch_args=("--restarts", "1"), timeout=240,
+    )
+    assert rc == 9, (rc, out[-1000:], err[-2000:])
+    assert "restart budget exhausted" in err, err[-2000:]
+    assert "attempt 2/2" in err, err[-2000:]

@@ -159,6 +159,99 @@ class TestLeaderRingMinBytes:
             config.leader_ring_min_bytes()
 
 
+class TestRetryMax:
+    def test_default_is_3(self, monkeypatch):
+        monkeypatch.delenv("T4J_RETRY_MAX", raising=False)
+        assert config.retry_max() == 3
+
+    def test_zero_disables_self_healing(self, monkeypatch):
+        monkeypatch.setenv("T4J_RETRY_MAX", "0")
+        assert config.retry_max() == 0
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_RETRY_MAX", "7")
+        assert config.retry_max() == 7
+
+    @pytest.mark.parametrize("bad", ["-1", "many", "1.5", "3K"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        # a typo'd retry budget must fail at launch, not silently run
+        # the default and mask a mis-tuned fleet
+        monkeypatch.setenv("T4J_RETRY_MAX", bad)
+        with pytest.raises(ValueError, match="T4J_RETRY_MAX"):
+            config.retry_max()
+
+
+class TestBackoff:
+    def test_defaults(self, monkeypatch):
+        monkeypatch.delenv("T4J_BACKOFF_BASE", raising=False)
+        monkeypatch.delenv("T4J_BACKOFF_MAX", raising=False)
+        assert config.backoff_base() == pytest.approx(0.05)
+        assert config.backoff_max() == pytest.approx(2.0)
+
+    def test_env_values(self, monkeypatch):
+        monkeypatch.setenv("T4J_BACKOFF_BASE", "0.2")
+        monkeypatch.setenv("T4J_BACKOFF_MAX", "5")
+        assert config.backoff_base() == pytest.approx(0.2)
+        assert config.backoff_max() == pytest.approx(5.0)
+
+    @pytest.mark.parametrize("var", ["T4J_BACKOFF_BASE", "T4J_BACKOFF_MAX"])
+    def test_zero_rejected(self, monkeypatch, var):
+        monkeypatch.setenv(var, "0")
+        with pytest.raises(ValueError, match=var):
+            getattr(config,
+                    "backoff_base" if "BASE" in var else "backoff_max")()
+
+    def test_max_below_base_rejected(self, monkeypatch):
+        # a cap below the base would silently shrink the first delay
+        monkeypatch.setenv("T4J_BACKOFF_BASE", "1")
+        monkeypatch.setenv("T4J_BACKOFF_MAX", "0.5")
+        with pytest.raises(ValueError, match="T4J_BACKOFF_MAX"):
+            config.backoff_max()
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_BACKOFF_BASE", "soon")
+        with pytest.raises(ValueError, match="T4J_BACKOFF_BASE"):
+            config.backoff_base()
+
+
+class TestReplayBytes:
+    def test_default_is_32m(self, monkeypatch):
+        monkeypatch.delenv("T4J_REPLAY_BYTES", raising=False)
+        assert config.replay_bytes() == 32 << 20
+
+    def test_suffix(self, monkeypatch):
+        monkeypatch.setenv("T4J_REPLAY_BYTES", "8M")
+        assert config.replay_bytes() == 8 << 20
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_REPLAY_BYTES", "plenty")
+        with pytest.raises(ValueError, match="T4J_REPLAY_BYTES"):
+            config.replay_bytes()
+
+    def test_negative_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_REPLAY_BYTES", "-1")
+        with pytest.raises(ValueError, match="T4J_REPLAY_BYTES"):
+            config.replay_bytes()
+
+
+def test_ensure_initialized_rejects_bad_resilience(monkeypatch):
+    """The self-healing knobs thread through native/runtime.py like the
+    deadlines: a bad env value aborts initialisation before any socket
+    is opened."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_RETRY_MAX", "lots")
+    with pytest.raises(ValueError, match="T4J_RETRY_MAX"):
+        runtime.ensure_initialized()
+
+
 def test_ensure_initialized_rejects_bad_tuning(monkeypatch):
     """The validation is threaded through native/runtime.py, same as
     the deadlines: a bad env value aborts initialisation before any

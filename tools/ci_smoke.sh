@@ -21,15 +21,24 @@
 #                analyzer dogfoods the repo's own programs on every run
 #                (docs/static-analysis.md).  Tools missing from the
 #                container are skipped inside lint.sh.
+#   7. resilience — tools/resilience_smoke.py under the ASan build: an
+#                8-rank flaky-fault job (rank 1 drops every connection
+#                twice mid-allreduce) must self-heal to bit-identical
+#                results with zero aborts, and the same drop with
+#                T4J_RETRY_MAX=0 must fail stop (docs/
+#                failure-semantics.md "self-healing transport").  Runs
+#                the ctypes data plane directly, so it works on
+#                old-jax containers and computes its own sanitizer
+#                LD_PRELOAD.
 #
-# Usage: tools/ci_smoke.sh [lane...]   (default: all six)
+# Usage: tools/ci_smoke.sh [lane...]   (default: all seven)
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
-  lanes=(tier1 fault proc asan tsan lint)
+  lanes=(tier1 fault proc asan tsan lint resilience)
 fi
 
 run_lane() {
@@ -76,8 +85,12 @@ for lane in "${lanes[@]}"; do
     lint)
       run_lane lint tools/lint.sh
       ;;
+    resilience)
+      run_lane resilience env T4J_SANITIZE=address timeout -k 10 900 \
+        python tools/resilience_smoke.py 8
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience)" >&2
       exit 2
       ;;
   esac

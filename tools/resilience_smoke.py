@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""Resilience smoke lane: the self-healing DCN transport end-to-end.
+
+Two phases over an N-rank (default 8) proc world driven through the
+native bridge's ctypes C API (no jax import in the workers, so the lane
+runs on old-jax containers and under sanitizer preloads alike):
+
+  1. self-heal   — rank 1 runs ``T4J_FAULT_MODE=flaky``: it drops every
+                   TCP connection twice mid-allreduce, then behaves.
+                   Every rank must finish ALL iterations with
+                   bit-identical results and ZERO abort broadcasts; the
+                   drops must show up as nonzero reconnect counters
+                   (t4j_link_stats).
+  2. fail-stop   — same drop with ``T4J_RETRY_MAX=0`` (self-healing
+                   disabled): every rank must raise a contextual
+                   BridgeError within the op deadline — the PR-1
+                   escalation path is still the backstop.
+
+Run under AddressSanitizer by exporting ``T4J_SANITIZE=address`` before
+invoking (tools/ci_smoke.sh does): the driver rebuilds the .so
+instrumented and computes the LD_PRELOAD the workers need.
+
+Usage: python tools/resilience_smoke.py [nprocs] [--phase self-heal|fail-stop]
+"""
+
+import importlib.util
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import types
+import uuid
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+RAISED = 23
+NO_RAISE = 3
+
+ITERS = 30
+COUNT = 64 * 1024  # f32 elements per rank per allreduce (256 KB)
+
+
+def _load_build_module():
+    """mpi4jax_tpu.native.build, importable even where the package
+    __init__ refuses (old-jax containers): the build module and
+    utils/config.py are jax-version-agnostic, so register lightweight
+    package stubs and load both by file path."""
+    try:
+        from mpi4jax_tpu.native import build  # noqa: PLC0415
+
+        return build
+    except Exception:
+        pass
+    for name in ("mpi4jax_tpu", "mpi4jax_tpu.utils", "mpi4jax_tpu.native"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [str(REPO / name.replace(".", "/"))]
+            sys.modules[name] = mod
+    for name, rel in (
+        ("mpi4jax_tpu.utils.config", "mpi4jax_tpu/utils/config.py"),
+        ("mpi4jax_tpu.native.build", "mpi4jax_tpu/native/build.py"),
+    ):
+        if name in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(name, REPO / rel)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["mpi4jax_tpu.native.build"]
+
+
+def _sanitizer_env():
+    """LD_PRELOAD plumbing for running a sanitized .so inside python
+    (see .claude/skills/verify/SKILL.md): both libasan and libstdc++
+    must be preloaded or the __cxa_throw interceptor CHECK-fails."""
+    san = os.environ.get("T4J_SANITIZE", "").strip().lower()
+    if not san:
+        return {}
+    lib = {"address": "libasan.so", "asan": "libasan.so",
+           "1": "libasan.so", "thread": "libtsan.so",
+           "tsan": "libtsan.so"}.get(san)
+    if lib is None:
+        return {}
+    paths = []
+    for name in (lib, "libstdc++.so.6"):
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={name}"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+        if out and out != name:
+            paths.append(out)
+    if not paths:
+        return {}
+    return {
+        "LD_PRELOAD": " ".join(paths),
+        "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
+        "TSAN_OPTIONS": "report_bugs=1",
+    }
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ worker
+
+
+def _load_lib(so):
+    import ctypes
+
+    lib = ctypes.CDLL(so)
+    i32, u64, vp = ctypes.c_int32, ctypes.c_uint64, ctypes.c_void_p
+    u64p = ctypes.POINTER(u64)
+    lib.t4j_init.restype = ctypes.c_int
+    lib.t4j_last_error.restype = ctypes.c_char_p
+    lib.t4j_set_timeouts.argtypes = [ctypes.c_double, ctypes.c_double]
+    lib.t4j_c_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_c_allreduce.restype = i32
+    lib.t4j_c_allgather.argtypes = [i32, vp, vp, u64]
+    lib.t4j_c_allgather.restype = i32
+    lib.t4j_c_barrier.argtypes = [i32]
+    lib.t4j_c_barrier.restype = i32
+    lib.t4j_link_stats.argtypes = [i32, u64p, u64p, u64p,
+                                   ctypes.POINTER(i32)]
+    lib.t4j_link_stats.restype = i32
+    return lib
+
+
+def worker(so):
+    import ctypes
+    import time
+
+    import numpy as np
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    lib = _load_lib(so)
+    rc = lib.t4j_init()
+    if rc != 0:
+        raise RuntimeError(
+            f"init rc={rc}: {lib.t4j_last_error().decode()}"
+        )
+    rank = lib.t4j_world_rank()
+    n = lib.t4j_world_size()
+    t0 = time.monotonic()
+    try:
+        for it in range(ITERS):
+            per_rank = [
+                np.random.default_rng(1000 * it + r)
+                .integers(0, 64, size=COUNT)
+                .astype(np.float32)
+                for r in range(n)
+            ]
+            want = per_rank[0].copy()
+            for a in per_rank[1:]:
+                want += a
+            out = np.empty_like(want)
+            st = lib.t4j_c_allreduce(0, ptr(per_rank[rank]), ptr(out),
+                                     COUNT, 0, 0)
+            if st:
+                raise RuntimeError(
+                    f"allreduce[{it}]: {lib.t4j_last_error().decode()}"
+                )
+            assert out.tobytes() == want.tobytes(), (
+                f"iteration {it}: result differs from the fault-free "
+                f"reduction (first bad index "
+                f"{int(np.argmax(out != want))})"
+            )
+        # one allgather so a second collective shape crosses the healed
+        # links too
+        mine = np.full(1024, float(rank), np.float32)
+        g = np.empty((n, 1024), np.float32)
+        st = lib.t4j_c_allgather(0, ptr(mine), ptr(g), mine.nbytes)
+        if st:
+            raise RuntimeError(
+                f"allgather: {lib.t4j_last_error().decode()}"
+            )
+        assert np.array_equal(
+            g, np.broadcast_to(
+                np.arange(n, dtype=np.float32)[:, None], (n, 1024))
+        )
+        import ctypes as ct
+
+        rec, fr, by = ct.c_uint64(), ct.c_uint64(), ct.c_uint64()
+        state = ct.c_int32()
+        lib.t4j_link_stats(-1, ct.byref(rec), ct.byref(fr),
+                           ct.byref(by), ct.byref(state))
+        print(
+            f"SMOKE-OK {rank} reconnects={rec.value} "
+            f"replayed_frames={fr.value} replayed_bytes={by.value} "
+            f"elapsed={time.monotonic() - t0:.2f}s",
+            flush=True,
+        )
+        lib.t4j_finalize()
+        sys.exit(0)
+    except (RuntimeError, AssertionError) as e:
+        print(f"OP-RAISED after {time.monotonic() - t0:.2f}s: {e}",
+              flush=True)
+        sys.exit(RAISED)
+
+
+# ------------------------------------------------------------------ driver
+
+
+def run_phase(phase, n, so, extra_env):
+    coord = f"127.0.0.1:{_free_port()}"
+    job = uuid.uuid4().hex[:8]
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.update(
+            T4J_RANK=str(r), T4J_SIZE=str(n), T4J_COORD=coord,
+            T4J_JOB=job, T4J_NO_SHM="1",
+            # ring path with small segments: drops land mid-op and the
+            # replay tail spans several segments
+            T4J_RING_MIN_BYTES="0", T4J_SEG_BYTES="8192",
+            T4J_FAULT_RANK="1",
+        )
+        env.update(extra_env)
+        env.update(_sanitizer_env())
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__, "worker", so],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    outs, ok = [], True
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        print(f"--- [{phase}] rank {r} (rc={p.returncode}) ---")
+        print(out[-3000:])
+        want = 0 if phase == "self-heal" else RAISED
+        if p.returncode != want:
+            ok = False
+            print(f"EXPECTED rc={want}")
+    blob = "\n".join(outs)
+    if phase == "self-heal":
+        if "abort" in blob:
+            ok = False
+            print("FAIL: an abort fired during the self-heal phase")
+        if "dropping every TCP connection" not in blob:
+            ok = False
+            print("FAIL: the flaky fault never armed")
+        if "reconnected" not in blob:
+            ok = False
+            print("FAIL: no link ever reconnected")
+        # every drop must be visible in the counters rank 0 reports
+        r0 = outs[0].split("reconnects=")
+        if len(r0) > 1 and int(r0[1].split()[0]) < 1:
+            ok = False
+            print("FAIL: rank 0 reports zero reconnects")
+    else:
+        if "t4j" not in blob:
+            ok = False
+            print("FAIL: no contextual bridge error in the fail-stop "
+                  "phase")
+    return ok
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if args else 8
+    phases = ["self-heal", "fail-stop"]
+    if "--phase" in sys.argv:
+        phases = [sys.argv[sys.argv.index("--phase") + 1]]
+    build = _load_build_module()
+    so = str(build.ensure_built())
+    ok = True
+    for phase in phases:
+        if phase == "self-heal":
+            env = {
+                "T4J_FAULT_MODE": "flaky",
+                "T4J_FAULT_AFTER": "40",
+                "T4J_FAULT_COUNT": "2",
+            }
+        else:
+            env = {
+                "T4J_FAULT_MODE": "drop_conn",
+                "T4J_FAULT_AFTER": "40",
+                "T4J_RETRY_MAX": "0",
+                "T4J_OP_TIMEOUT": "20",
+            }
+        ok = run_phase(phase, n, so, env) and ok
+    print("RESILIENCE-SMOKE-OK" if ok else "RESILIENCE-SMOKE-FAIL")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker(sys.argv[2])
+    else:
+        main()
