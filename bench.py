@@ -352,12 +352,16 @@ def proc_busbw(timeout=600):
     import pathlib
 
     script = pathlib.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
+    # counters-mode telemetry (docs/observability.md): the record then
+    # carries measured p50/p99 op latency and per-plane byte counters
+    # from the native histograms — BENCH tracks latency, not just busbw
     return _metric_subprocess(
         [
             sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
             str(script), "--mb", "16", "--reps", "10",
         ],
         "allreduce_busbw_proc8", timeout, "proc busbw",
+        env={"T4J_TELEMETRY": "counters"},
     )
 
 
@@ -711,6 +715,16 @@ def main():
         ):
             if src_key in procrec:
                 extras[dst_key] = procrec[src_key]
+        # telemetry-sourced latency keys (counters mode): measured
+        # per-op percentiles from the native histograms, the numbers
+        # ROADMAP items 4 (autotuning) and 5 (serving SLOs) consume
+        if procrec.get("p99_ms") is not None:
+            extras["allreduce_p99_ms_proc8"] = procrec["p99_ms"]
+        if procrec.get("p50_ms") is not None:
+            extras["allreduce_p50_ms_proc8"] = procrec["p50_ms"]
+        for key, val in procrec.items():
+            if key.startswith("bytes_") and isinstance(val, int):
+                extras[f"proc8_{key}"] = val
     ring_rec, tree_rec = proc_tcp_busbw() if native_ok else (None, None)
     if ring_rec is not None:
         # the TCP tier proper (T4J_NO_SHM=1): segmented ring allreduce

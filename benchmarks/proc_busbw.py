@@ -173,6 +173,46 @@ def _busbw_factor(op, n):
     }[op]
 
 
+def _telemetry_registry():
+    """Cumulative metrics registry from the native snapshot, or ``None``
+    when T4J_TELEMETRY is off (docs/observability.md)."""
+    from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.telemetry.registry import MetricsRegistry
+    from mpi4jax_tpu.utils import config
+
+    if config.telemetry_mode() == "off":
+        return None
+    words = runtime.metrics_snapshot()
+    return MetricsRegistry.from_snapshot(words) if words else None
+
+
+def _telemetry_keys(op, before):
+    """Latency + per-plane byte keys for one timed window, sourced from
+    the telemetry snapshot delta (``before`` = the registry captured
+    when the window opened).  These are MEASURED per-op latencies from
+    the native histograms — the numbers trace-guided autotuning
+    (ROADMAP item 4) and serving SLOs (item 5) consume — not wall-clock
+    reps/total arithmetic."""
+    after = _telemetry_registry()
+    if after is None:
+        return {}
+    window = after.diff(before) if before is not None else after
+    stats = window.aggregate(op=op)
+    if stats is None or stats.count == 0:
+        return {}
+    s = stats.stats()
+    keys = {
+        "lat_source": "telemetry",
+        "op_count": s["count"],
+        "p50_ms": round(s["p50_ms"], 4) if s["p50_ms"] else None,
+        "p99_ms": round(s["p99_ms"], 4) if s["p99_ms"] else None,
+        "mean_ms": round(s["mean_ms"], 4) if s["mean_ms"] else None,
+    }
+    for plane, nbytes in sorted(window.bytes_by_plane().items()):
+        keys[f"bytes_{plane}"] = nbytes
+    return keys
+
+
 def _measure(args, comm, mb):
     """Time ``args.op`` at one payload size.
 
@@ -209,6 +249,9 @@ def _measure(args, comm, mb):
     y, tok = call(x, tok)
     np.asarray(y)
 
+    # telemetry window opens AFTER warmup: the snapshot delta then
+    # attributes latencies to the timed reps only
+    tel_before = _telemetry_registry()
     best = float("inf")
     for _ in range(3):
         tok = _fence(comm, tok)
@@ -220,6 +263,7 @@ def _measure(args, comm, mb):
         best = min(best, dt)
 
     busbw = nbytes * _busbw_factor(args.op, n) / best
+    tel_keys = _telemetry_keys(args.op, tel_before)
 
     algo, topo = _data_plane(args.op, comm, nbytes)
     rec = {
@@ -237,6 +281,7 @@ def _measure(args, comm, mb):
         "seg_bytes": config.seg_bytes(),
         "leader_ring_min_bytes": config.leader_ring_min_bytes(),
     }
+    rec.update(tel_keys)
     return rec, busbw, tok
 
 

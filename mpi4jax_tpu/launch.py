@@ -24,6 +24,16 @@ transport's fine-grained self-healing (docs/failure-semantics.md):
 pair it with ``utils/checkpoint.py`` so the relaunched job resumes at
 the last saved step instead of from scratch.
 
+``--telemetry DIR`` turns on comm telemetry for every rank
+(``T4J_TELEMETRY=trace`` unless the environment already chose a mode,
+docs/observability.md): each rank drains its native event ring +
+metrics snapshot into ``DIR/rank<k>.t4j.json`` at exit — on the abort
+path too, so a dying rank's last events reach the first-failure
+report — and after the job the launcher merges the per-rank files into
+one Perfetto-loadable ``DIR/job.trace.json`` with all ranks on one
+aligned timeline.  Inspect with ``t4j-top DIR`` or load the merged
+trace at https://ui.perfetto.dev.
+
 Children default to the CPU platform (one XLA CPU per process, the
 reference's process model); override with ``--platform``.
 """
@@ -77,6 +87,18 @@ def child_main(argv):
                 runtime.notify_abort(why)
             except Exception:
                 pass
+            # drain telemetry NOW (not only at atexit): a rank about to
+            # be signal-killed by the launcher's teardown would lose
+            # its ring, and the dying rank's last events are the most
+            # valuable part of the first-failure report
+            tel_dir = os.environ.get("T4J_TELEMETRY_DIR")
+            if tel_dir:
+                try:
+                    from mpi4jax_tpu.telemetry import dump
+
+                    dump.write_rank_file(tel_dir)
+                except Exception:
+                    pass
             # first-failure report: when the self-healing transport saw
             # action before the death, say so — a rank dying AFTER
             # surviving reconnects usually points at a flaky fabric
@@ -153,6 +175,16 @@ def main(argv=None):
         "coordinator/job id — pair with utils/checkpoint.py so the "
         "relaunch resumes at the last saved step",
     )
+    parser.add_argument(
+        "--telemetry",
+        default=None,
+        metavar="DIR",
+        help="comm telemetry (docs/observability.md): every rank "
+        "drains its event ring into DIR/rank<k>.t4j.json at exit "
+        "(T4J_TELEMETRY=trace unless the environment already set a "
+        "mode), and the launcher merges them into a Perfetto-loadable "
+        "DIR/job.trace.json; inspect with t4j-top DIR",
+    )
     parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("prog", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -192,6 +224,42 @@ def main(argv=None):
     return exit_code
 
 
+def _telemetry_failure_report(tel_dir, rank):
+    """Print the dying rank's last telemetry events (drained by the
+    child's abort path) under the first-failure line — the post-mortem
+    shows WHAT the rank was doing, not just that it died."""
+    try:
+        from mpi4jax_tpu.native.runtime import _format_recent_events
+        from mpi4jax_tpu.telemetry import dump, schema
+
+        path = os.path.join(tel_dir, dump.rank_file_name(rank))
+        if not os.path.exists(path):
+            return
+        obj = schema.load_rank_file(path)
+        events = [schema.event_from_list(r) for r in obj["events"][-8:]]
+        tail = _format_recent_events(events)
+        if tail:
+            _say(f"rank {rank} last telemetry events: {tail}")
+    except Exception:
+        pass  # the report must never mask the real failure
+
+
+def _merge_telemetry(tel_dir, job):
+    try:
+        from mpi4jax_tpu.telemetry import trace
+
+        out = trace.merge_dir(tel_dir, job=job)
+        _say(
+            f"telemetry merged into {out} (load in "
+            "https://ui.perfetto.dev, or run: t4j-top "
+            f"{tel_dir})"
+        )
+    except FileNotFoundError:
+        _say(f"telemetry: no rank files appeared in {tel_dir}")
+    except Exception as e:
+        _say(f"telemetry merge failed: {type(e).__name__}: {e}")
+
+
 def _run_job(args):
     """One launch attempt: spawn the workers, wait, fail fast."""
     n = args.nprocs
@@ -201,6 +269,10 @@ def _run_job(args):
     import uuid
 
     job = uuid.uuid4().hex[:12]
+    tel_dir = None
+    if args.telemetry:
+        tel_dir = os.path.abspath(args.telemetry)
+        os.makedirs(tel_dir, exist_ok=True)
     procs = []
     for rank in range(n):
         env = dict(os.environ)
@@ -211,6 +283,11 @@ def _run_job(args):
             T4J_PLATFORM=args.platform,
             T4J_JOB=job,
         )
+        if tel_dir:
+            env["T4J_TELEMETRY_DIR"] = tel_dir
+            # trace unless the caller already chose a mode (counters
+            # keeps the overhead at metrics-only for perf runs)
+            env.setdefault("T4J_TELEMETRY", "trace")
         if args.shims:
             from mpi4jax_tpu import shims
 
@@ -246,6 +323,8 @@ def _run_job(args):
                         f"rank {i} {_describe_exit(rc)} — first failure; "
                         f"terminating {len(remaining)} remaining rank(s)"
                     )
+                    if tel_dir:
+                        _telemetry_failure_report(tel_dir, i)
                     terminated_at = time.monotonic()
                     for j in remaining:
                         procs[j].terminate()
@@ -276,6 +355,8 @@ def _run_job(args):
         for p in procs:
             p.send_signal(signal.SIGINT)
         exit_code = 130
+    if tel_dir and exit_code != 130:
+        _merge_telemetry(tel_dir, job)
     return exit_code
 
 

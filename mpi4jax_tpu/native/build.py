@@ -23,7 +23,7 @@ def lib_path():
     return _OUT
 
 
-_HEADERS = ["dcn.h", "shm.h"]
+_HEADERS = ["dcn.h", "shm.h", "telemetry.h"]
 
 
 def _sanitize_flags():

@@ -28,6 +28,13 @@ __all__ = [
     "set_tuning",
     "set_hier",
     "set_resilience",
+    "set_telemetry",
+    "telemetry_mode_name",
+    "telemetry_drain",
+    "telemetry_last",
+    "telemetry_anchor",
+    "telemetry_dropped",
+    "metrics_snapshot",
     "link_stats",
     "topology",
     "hier_would_select",
@@ -109,6 +116,24 @@ def _load():
     lib.t4j_hier_active.argtypes = [ctypes.c_int32]
     lib.t4j_hier_active.restype = ctypes.c_int32
     lib.t4j_abort_notify.argtypes = [ctypes.c_char_p]
+    # telemetry surface (docs/observability.md)
+    lib.t4j_set_telemetry.argtypes = [ctypes.c_int32, ctypes.c_int64]
+    lib.t4j_telemetry_mode.restype = ctypes.c_int32
+    lib.t4j_telemetry_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.t4j_telemetry_drain.restype = ctypes.c_int64
+    lib.t4j_telemetry_peek_last.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.t4j_telemetry_peek_last.restype = ctypes.c_int64
+    lib.t4j_telemetry_dropped.restype = ctypes.c_uint64
+    lib.t4j_telemetry_anchor.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.t4j_telemetry_anchor.restype = ctypes.c_int32
+    lib.t4j_metrics_snapshot.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+    ]
+    lib.t4j_metrics_snapshot.restype = ctypes.c_int64
     # data plane for the host-callback tier (TPU staging path); every
     # call returns a status: 0 ok, nonzero = failed with t4j_last_error
     i32, u64, vp = ctypes.c_int32, ctypes.c_uint64, ctypes.c_void_p
@@ -183,27 +208,25 @@ def check_health():
                 f"{stats['replayed_bytes']} bytes replayed — "
                 "docs/failure-semantics.md]"
             )
+        # the ring tail shows WHAT the rank was doing when it died
+        # (T4J_TELEMETRY=counters records the control-plane events,
+        # trace adds the op/frame context — docs/observability.md)
+        try:
+            tail = _format_recent_events(telemetry_last(8))
+        except Exception:
+            tail = ""
+        if tail:
+            msg += f" [last telemetry events: {tail}]"
         raise BridgeError(msg)
 
 
-def link_stats(peer=None):
-    """Self-healing transport counters (docs/failure-semantics.md
-    "self-healing transport"), or ``None`` before init.
-
-    ``peer=None`` aggregates every link: ``{"reconnects",
-    "replayed_frames", "replayed_bytes", "state"}`` with ``state`` the
-    worst link state (0 up, 1 broken/repairing, 2 dead).  An integer
-    ``peer`` selects that world rank's link (``None`` for self or
-    out-of-range)."""
-    lib = _state["lib"]
-    if lib is None or not lib.t4j_initialized():
-        return None
+def _link_stats_one(lib, peer):
     rec = ctypes.c_uint64(0)
     frames = ctypes.c_uint64(0)
     nbytes = ctypes.c_uint64(0)
     state = ctypes.c_int32(0)
     ok = lib.t4j_link_stats(
-        -1 if peer is None else int(peer),
+        int(peer),
         ctypes.byref(rec), ctypes.byref(frames), ctypes.byref(nbytes),
         ctypes.byref(state),
     )
@@ -215,6 +238,54 @@ def link_stats(peer=None):
         "replayed_bytes": nbytes.value,
         "state": state.value,
     }
+
+
+def link_stats(peer=None):
+    """Self-healing transport counters (docs/failure-semantics.md
+    "self-healing transport"), or ``None`` before init.
+
+    ``peer=None`` aggregates every link: ``{"reconnects",
+    "replayed_frames", "replayed_bytes", "state"}`` with ``state`` the
+    worst link state (0 up, 1 broken/repairing, 2 dead) — plus the
+    per-peer MAXIMA (``"worst_peer"``, ``"max_reconnects"``,
+    ``"max_replayed_frames"``, ``"max_replayed_bytes"``), because sums
+    hide a single flaky link behind healthy ones and serving admission
+    control sheds load by the WORST link, not the average
+    (ROADMAP item 5).  ``worst_peer`` is the rank with the most
+    reconnects (ties broken by replayed bytes, then by worse state);
+    ``None`` when no link has any counter.  An integer ``peer``
+    selects that world rank's link (``None`` for self or
+    out-of-range)."""
+    lib = _state["lib"]
+    if lib is None or not lib.t4j_initialized():
+        return None
+    if peer is not None:
+        return _link_stats_one(lib, peer)
+    agg = _link_stats_one(lib, -1)
+    if agg is None:
+        return None
+    agg.update(
+        worst_peer=None,
+        max_reconnects=0,
+        max_replayed_frames=0,
+        max_replayed_bytes=0,
+    )
+    worst_key = (0, 0, 0)
+    for r in range(int(lib.t4j_world_size())):
+        s = _link_stats_one(lib, r)
+        if s is None:
+            continue
+        agg["max_reconnects"] = max(agg["max_reconnects"],
+                                    s["reconnects"])
+        agg["max_replayed_frames"] = max(agg["max_replayed_frames"],
+                                         s["replayed_frames"])
+        agg["max_replayed_bytes"] = max(agg["max_replayed_bytes"],
+                                        s["replayed_bytes"])
+        key = (s["reconnects"], s["replayed_bytes"], s["state"])
+        if key > worst_key and any(key):
+            worst_key = key
+            agg["worst_peer"] = r
+    return agg
 
 
 def set_resilience(retry_max=None, backoff_base_s=None, backoff_max_s=None,
@@ -235,6 +306,143 @@ def set_resilience(retry_max=None, backoff_base_s=None, backoff_max_s=None,
         -1.0 if backoff_max_s is None else float(backoff_max_s),
         -1 if replay_bytes is None else int(replay_bytes),
     )
+
+
+_TEL_MODES = {"off": 0, "counters": 1, "trace": 2}
+_TEL_MODE_NAMES = {v: k for k, v in _TEL_MODES.items()}
+
+
+def set_telemetry(mode=None, ring_bytes=None):
+    """Runtime override of the telemetry knobs (docs/observability.md).
+
+    ``mode`` is ``"off"`` (zero-cost no-op, the default), ``"counters"``
+    (metrics table + control-plane events) or ``"trace"`` (plus
+    per-event records — the Perfetto feed); ``None`` keeps the current
+    setting.  ``ring_bytes`` bounds the per-rank event ring.  Must be
+    set before the first instrumented call: the ring is sized on first
+    use and never re-sized."""
+    lib = _load()
+    code = -1 if mode is None else _TEL_MODES[str(mode)]
+    lib.t4j_set_telemetry(
+        code, -1 if ring_bytes is None else int(ring_bytes)
+    )
+    if mode is not None:
+        # keep the Python-lane recorder in lockstep: it caches the env
+        # mode on first use, and a runtime override that only reached
+        # the native ring would silently drop the python timeline lane
+        from mpi4jax_tpu.telemetry import recorder
+
+        recorder.set_mode(str(mode))
+
+
+def telemetry_mode_name():
+    """The active telemetry mode as a string (``off`` before load)."""
+    lib = _state["lib"]
+    if lib is None:
+        return "off"
+    return _TEL_MODE_NAMES.get(int(lib.t4j_telemetry_mode()), "off")
+
+
+def _decode_event_buffer(buf, nbytes):
+    from mpi4jax_tpu.telemetry import schema as _schema
+
+    return _schema.decode_events(bytes(buf[: int(nbytes)]))
+
+
+def telemetry_drain(max_events=1 << 20):
+    """Consume the native event ring (oldest first) into a list of
+    :class:`telemetry.schema.Event`.  Empty list when telemetry is off
+    or the library was never loaded.  The ring outlives finalize, so
+    exit-path drains also carry teardown events."""
+    lib = _state["lib"]
+    if lib is None:
+        return []
+    out = []
+    chunk = ctypes.create_string_buffer(32 * 4096)
+    remaining = int(max_events)
+    while remaining > 0:
+        got = lib.t4j_telemetry_drain(
+            chunk, min(remaining, 4096) * 32
+        )
+        if got <= 0:
+            break
+        events = _decode_event_buffer(chunk.raw, got)
+        out.extend(events)
+        remaining -= len(events)
+    return out
+
+
+def telemetry_last(n=16):
+    """The newest ``n`` native events WITHOUT consuming them (the
+    check_health post-mortem peek)."""
+    lib = _state["lib"]
+    if lib is None or n <= 0:
+        return []
+    buf = ctypes.create_string_buffer(32 * int(n))
+    got = lib.t4j_telemetry_peek_last(buf, len(buf))
+    return _decode_event_buffer(buf.raw, got)
+
+
+def telemetry_dropped():
+    lib = _state["lib"]
+    return int(lib.t4j_telemetry_dropped()) if lib is not None else 0
+
+
+def telemetry_anchor():
+    """(mono_ns, unix_ns) clock anchor captured right after the
+    bootstrap join barrier (docs/observability.md "clock alignment");
+    captured lazily for single-process runs."""
+    lib = _load()
+    mono = ctypes.c_uint64(0)
+    unix = ctypes.c_uint64(0)
+    lib.t4j_telemetry_anchor(ctypes.byref(mono), ctypes.byref(unix))
+    return mono.value, unix.value
+
+
+def metrics_snapshot():
+    """The native metrics table as a list of u64 words (parse with
+    ``telemetry.schema.parse_snapshot`` / feed to
+    ``telemetry.registry.MetricsRegistry.from_snapshot``).  Empty list
+    when the library was never loaded or nothing was counted."""
+    lib = _state["lib"]
+    if lib is None:
+        return []
+    need = lib.t4j_metrics_snapshot(None, 0)
+    if need <= 0:
+        return []
+    # sizing/fill race: a concurrent op can add a table row between
+    # the two calls, making the fill call return the NEW required size
+    # without writing (the native side never overruns the buffer).
+    # Retry with the fresh size; the table has finitely many rows, so
+    # this converges — the bound is just a backstop.
+    for _ in range(4):
+        buf = (ctypes.c_uint64 * int(need))()
+        got = lib.t4j_metrics_snapshot(buf, need)
+        if got <= need:
+            return list(buf[: int(got)])
+        need = got
+    return []
+
+
+def _format_recent_events(events):
+    """Compact post-mortem rendering of the ring tail: op, peer, age
+    relative to the newest event."""
+    from mpi4jax_tpu.telemetry import schema as _schema
+
+    if not events:
+        return ""
+    newest = max(e.t_ns for e in events)
+    parts = []
+    for e in events:
+        desc = _schema.kind_name(e.kind)
+        phase = _schema.PHASE_NAMES.get(e.phase, "?")
+        if phase != "instant":
+            desc += f" {phase}"
+        if e.peer >= 0:
+            desc += f" peer=r{e.peer}"
+        age_ms = (newest - e.t_ns) / 1e6
+        parts.append(f"{desc} ({age_ms:.1f}ms ago)")
+    return "; ".join(parts)
 
 
 def notify_abort(why):
@@ -580,11 +788,14 @@ def ensure_initialized():
     retry = config.retry_max()
     boff_base, boff_max = config.backoff_base(), config.backoff_max()
     replay = config.replay_bytes()
+    tel_mode, tel_bytes = config.telemetry_mode(), config.telemetry_bytes()
+    tel_dir = config.telemetry_dir()
     lib = _load()
     lib.t4j_set_timeouts(op_s, connect_s)
     lib.t4j_set_tuning(ring_min, seg)
     lib.t4j_set_hier(_HIER_MODES[hier], hier_min)
     lib.t4j_set_resilience(retry, boff_base, boff_max, replay)
+    lib.t4j_set_telemetry(_TEL_MODES[tel_mode], tel_bytes)
     rc = lib.t4j_init()
     if rc != 0:
         detail = last_error()
@@ -594,6 +805,12 @@ def ensure_initialized():
             else "native bridge init failed (check T4J_* env)"
         )
     _register_ffi_targets(lib)
+    if tel_dir is not None:
+        # registered BEFORE finalize: atexit runs LIFO, so the drain
+        # happens after teardown and carries the exit-phase events too
+        from mpi4jax_tpu.telemetry import dump
+
+        dump.install_atexit(tel_dir)
     atexit.register(finalize)
     return True
 
@@ -601,6 +818,19 @@ def ensure_initialized():
 def finalize():
     lib = _state["lib"]
     if lib and lib.t4j_initialized():
+        # snapshot the teardown-sensitive telemetry state (per-link
+        # counters, topology) while still initialized: the exit-time
+        # rank-file drain deliberately runs AFTER this (atexit LIFO)
+        # and would otherwise write link_stats {}
+        try:
+            from mpi4jax_tpu.utils import config
+
+            if config.telemetry_dir() is not None:
+                from mpi4jax_tpu.telemetry import dump
+
+                dump.capture_runtime_state()
+        except Exception:
+            pass
         # flush pending XLA work before tearing down sockets — the
         # reference registers the same hygiene (decorators.py:11-24,
         # flush.py) to avoid the deadlock-on-exit class of bugs.

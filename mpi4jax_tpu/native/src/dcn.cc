@@ -2,6 +2,7 @@
 
 #include "dcn.h"
 #include "shm.h"
+#include "telemetry.h"
 
 #include <arpa/inet.h>
 #include <fcntl.h>
@@ -452,6 +453,7 @@ void post_fault(const std::string& msg) {
       first = true;
     }
   }
+  if (first) tel::control_event(tel::kFault, -1, 0);
   g_stop.store(true, std::memory_order_release);
   if (first && !g_finalizing.load(std::memory_order_acquire)) {
     std::fprintf(stderr, "%s\n", msg.c_str());
@@ -1068,6 +1070,8 @@ void reader_loop(int peer, int fd) {
       g_mailbox.push_back(std::move(f));
     }
     g_mail_cv.notify_all();
+    tel::trace_event(tel::kFrameRx, tel::kInstant, tel::kPlaneNone, -1,
+                     peer, h.nbytes);
   }
 }
 
@@ -1203,6 +1207,8 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
       g_mailbox.push_back(std::move(f));
     }
     g_mail_cv.notify_all();
+    tel::trace_event(tel::kFrameTx, tel::kInstant, tel::kPlaneNone, -1,
+                     world_dest, nbytes);
     return;
   }
   maybe_inject_send_fault();
@@ -1223,6 +1229,8 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
                           ": shm pipe write during shutdown");
       raise_stopped();
     }
+    tel::trace_event(tel::kFrameTx, tel::kInstant, tel::kPlaneShm, -1,
+                     world_dest, nbytes);
     return;
   }
   PeerLink& p = g_peers[world_dest];
@@ -1255,6 +1263,8 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
   }
   switch (st) {
     case IoStatus::kOk:
+      tel::trace_event(tel::kFrameTx, tel::kInstant, tel::kPlaneNone, -1,
+                       world_dest, nbytes);
       return;
     case IoStatus::kTimeout:
       fail_op("send of " + std::to_string(nbytes) + " bytes to peer r" +
@@ -1517,6 +1527,7 @@ int tcp_connect(const std::string& host, uint16_t port,
 // on the link cv must find the repair diagnostic in the fault slot
 // when it wakes, not an empty "bridge already shut down".
 void escalate_link(int peer, const std::string& why) {
+  tel::control_event(tel::kLinkDead, peer, 0);
   PeerLink& p = g_peers[peer];
   if (!g_shutting_down.load() &&
       !g_stop.load(std::memory_order_acquire) &&
@@ -1594,6 +1605,8 @@ bool finish_repair(int peer, int fd, uint64_t peer_has, std::string* why) {
   }
   p.replayed_frames.fetch_add(frames, std::memory_order_relaxed);
   p.replayed_bytes.fetch_add(bytes, std::memory_order_relaxed);
+  tel::control_event(tel::kReconnect, peer, bytes);
+  if (frames) tel::control_event(tel::kReplay, peer, bytes);
   std::fprintf(stderr,
                "r%d | t4j: link to peer r%d reconnected (epoch %u, "
                "replayed %llu frame(s) / %llu bytes)\n",
@@ -1702,6 +1715,7 @@ void mark_broken(int peer, const std::string& why) {
   {
     std::lock_guard<std::mutex> lk(p.mu);
     if (p.state != PeerLink::kUp) return;  // a cycle is already running
+    tel::control_event(tel::kLinkBreak, peer, 0);
     p.state = PeerLink::kBroken;
     if (!p.repairing) {
       p.repairing = true;
@@ -1923,6 +1937,8 @@ void pipe_reader_loop(int peer, shm::Pipe* pipe) {
       g_mailbox.push_back(std::move(f));
     }
     g_mail_cv.notify_all();
+    tel::trace_event(tel::kFrameRx, tel::kInstant, tel::kPlaneShm, -1,
+                     peer, h.nbytes);
   }
 }
 
@@ -2899,6 +2915,8 @@ void multi_send(Comm& c, int tag, std::vector<RootSend>& msgs) {
         t.done = true;
         t.lk.unlock();
         --remaining;
+        tel::trace_event(tel::kFrameTx, tel::kInstant, tel::kPlaneNone,
+                         -1, t.wdest, t.h.nbytes);
       }
     }
     if (remaining == 0 || !failure.empty()) break;
@@ -3583,6 +3601,8 @@ void hier_allreduce(int comm, const void* in, void* out, size_t count,
         "hierarchical path unavailable (single-host communicator, no "
         "multi-rank host, T4J_NO_SHM, or the leaf arena negotiation "
         "failed)");
+  tel::OpScope ts(tel::kHierAllreduce, comm, count * dtype_size(dt));
+  ts.plane = tel::kPlaneHier;
   hier_allreduce_impl(c, in, out, count, dt, op);
 }
 
@@ -3684,6 +3704,12 @@ int init_from_env() {
   // the join barrier absorbs rank startup skew, so it runs under the
   // connect deadline (g_in_init), not the per-op one
   barrier(0);
+  // telemetry clock anchor, captured immediately after the join
+  // barrier on every rank: the cross-rank trace merger treats the
+  // anchors as (near-)simultaneous — barrier-exit skew is the
+  // alignment error, not wall-clock skew (docs/observability.md
+  // "clock alignment")
+  tel::capture_anchor();
   g_in_init.store(false, std::memory_order_relaxed);
   if (fault_armed(FaultPlan::kDieAfter)) {
     // time-based death, armed only after init: kills the rank even
@@ -3821,6 +3847,7 @@ void send(int comm, const void* buf, size_t nbytes, int dest, int tag) {
                              std::to_string(nbytes) + " bytes");
   if (dest < 0 || dest >= static_cast<int>(c.ranks.size()))
     fail_arg("destination rank " + std::to_string(dest) + " out of range for a " + std::to_string(c.ranks.size()) + "-member communicator");
+  tel::OpScope ts(tel::kSend, comm, nbytes, c.ranks[dest]);
   csend(c, dest, tag, buf, nbytes, /*coll=*/false);
 }
 
@@ -3833,6 +3860,8 @@ void recv(int comm, void* buf, size_t nbytes, int source, int tag,
   if (source != kAnySource &&
       (source < 0 || source >= static_cast<int>(c.ranks.size())))
     fail_arg("source rank " + std::to_string(source) + " out of range for a " + std::to_string(c.ranks.size()) + "-member communicator");
+  tel::OpScope ts(tel::kRecv, comm, nbytes,
+                  source == kAnySource ? -1 : c.ranks[source]);
   Frame f = crecv(c, source, tag, /*coll=*/false);
   if (f.data.size() != nbytes) fail_size(f, nbytes);
   std::memcpy(buf, f.data.data(), nbytes);
@@ -3852,6 +3881,11 @@ void sendrecv(int comm, const void* sendbuf, size_t send_nbytes,
                                  " (tag " + std::to_string(recvtag) +
                                  ") / -> " + std::to_string(dest) +
                                  " (tag " + std::to_string(sendtag) + ")");
+  tel::OpScope ts(
+      tel::kSendrecv, comm, send_nbytes + recv_nbytes,
+      dest >= 0 && dest < static_cast<int>(c.ranks.size())
+          ? c.ranks[dest]
+          : -1);
   // eager sends cannot block: send first, then receive (the pattern the
   // reference's deadlock test guards, test_send_and_recv.py:104-117).
   // Send and recv sizes are independent (MPI_Sendrecv semantics).
@@ -3872,7 +3906,12 @@ void barrier(int comm) {
   LogScope log("MPI_Barrier", "");
   int n = static_cast<int>(c.ranks.size());
   if (n == 1) return;
-  if (shm::Arena* a = comm_arena(c)) return shm::barrier(a);
+  tel::OpScope ts(tel::kBarrier, comm, 0);
+  if (shm::Arena* a = comm_arena(c)) {
+    ts.plane = tel::kPlaneShm;
+    return shm::barrier(a);
+  }
+  ts.plane = tel::kPlaneTree;
   int me = c.my_index;
   // dissemination barrier
   for (int k = 1; k < n; k <<= 1) {
@@ -3888,8 +3927,17 @@ void bcast(int comm, void* buf, size_t nbytes, int root) {
                               std::to_string(nbytes) + " bytes");
   int n = static_cast<int>(c.ranks.size());
   if (n == 1) return;
-  if (shm::Arena* a = comm_arena(c)) return shm::bcast(a, buf, nbytes, root);
-  if (use_hier(c, nbytes)) return hier_bcast_impl(c, buf, nbytes, root);
+  tel::OpScope ts(tel::kBcast, comm, nbytes,
+                  root >= 0 && root < n ? c.ranks[root] : -1);
+  if (shm::Arena* a = comm_arena(c)) {
+    ts.plane = tel::kPlaneShm;
+    return shm::bcast(a, buf, nbytes, root);
+  }
+  if (use_hier(c, nbytes)) {
+    ts.plane = tel::kPlaneHier;
+    return hier_bcast_impl(c, buf, nbytes, root);
+  }
+  ts.plane = tel::kPlaneTree;
   // binomial tree rooted at `root` (rotate indices so root -> 0)
   int me = (c.my_index - root % n + n) % n;
   for (int k = 1; k < n; k <<= 1) {
@@ -3911,10 +3959,17 @@ void reduce(int comm, const void* in, void* out, size_t count, DType dt,
   LogScope log("MPI_Reduce", "-> " + std::to_string(root) + " with " +
                                std::to_string(count) + " items");
   int n = static_cast<int>(c.ranks.size());
-  if (shm::Arena* a = comm_arena(c))
+  tel::OpScope ts(tel::kReduce, comm, count * dtype_size(dt),
+                  root >= 0 && root < n ? c.ranks[root] : -1);
+  if (shm::Arena* a = comm_arena(c)) {
+    ts.plane = tel::kPlaneShm;
     return shm::reduce(a, in, out, count, dt, op, root);
-  if (use_hier(c, count * dtype_size(dt)))
+  }
+  if (use_hier(c, count * dtype_size(dt))) {
+    ts.plane = tel::kPlaneHier;
     return hier_reduce_impl(c, in, out, count, dt, op, root);
+  }
+  ts.plane = tel::kPlaneTree;
   size_t nbytes = count * dtype_size(dt);
   std::vector<uint8_t> acc(static_cast<const uint8_t*>(in),
                            static_cast<const uint8_t*>(in) + nbytes);
@@ -3942,13 +3997,19 @@ void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
                ReduceOp op) {
   Comm& c = get_comm(comm);
   LogScope log("MPI_Allreduce", "with " + std::to_string(count) + " items");
-  if (shm::Arena* a = comm_arena(c))
+  tel::OpScope ts(tel::kAllreduce, comm, count * dtype_size(dt));
+  if (shm::Arena* a = comm_arena(c)) {
+    ts.plane = tel::kPlaneShm;
     return shm::allreduce(a, in, out, count, dt, op);
+  }
   size_t dsize = dtype_size(dt);
   size_t nbytes = count * dsize;
-  if (use_hier(c, nbytes))
+  if (use_hier(c, nbytes)) {
+    ts.plane = tel::kPlaneHier;
     return hier_allreduce_impl(c, in, out, count, dt, op);
+  }
   if (use_ring(c, nbytes)) {
+    ts.plane = tel::kPlaneRing;
     // segmented ring reduce-scatter + ring allgather: each link
     // carries 2*(n-1)/n of the payload instead of the tree's full
     // payload per level.  The reduce-scatter writes this rank's block
@@ -3967,6 +4028,7 @@ void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
     ring_allgather(c, o8, off, len);
     return;
   }
+  ts.plane = tel::kPlaneTree;
   reduce(comm, in, out, count, dt, op, 0);
   if (c.my_index != 0) std::memcpy(out, in, nbytes);  // placate valgrind
   bcast(comm, out, nbytes, 0);
@@ -3984,17 +4046,22 @@ void reduce_scatter(int comm, const void* in, void* out, size_t count_each,
     if (block) std::memmove(out, in, block);
     return;
   }
+  tel::OpScope ts(tel::kReduceScatter, comm, block * n);
   if (shm::Arena* a = comm_arena(c)) {
     // intra-host the arena moves memory, not wire bytes: one shm
     // allreduce then take this rank's block
+    ts.plane = tel::kPlaneShm;
     Buf tmp(block * n);
     shm::allreduce(a, in, tmp.data(), count_each * n, dt, op);
     std::memcpy(out, tmp.data() + block * c.my_index, block);
     return;
   }
-  if (use_hier(c, block * n))
+  if (use_hier(c, block * n)) {
+    ts.plane = tel::kPlaneHier;
     return hier_reduce_scatter_impl(c, in, out, count_each, dt, op);
+  }
   if (use_ring(c, block * n)) {
+    ts.plane = tel::kPlaneRing;
     std::vector<size_t> off(n), len(n, block);
     for (int b = 0; b < n; ++b) off[b] = block * b;
     ring_reduce_scatter(c, static_cast<const uint8_t*>(in),
@@ -4002,6 +4069,7 @@ void reduce_scatter(int comm, const void* in, void* out, size_t count_each,
     return;
   }
   // small messages: binomial reduce to member 0, scatter the blocks
+  ts.plane = tel::kPlaneTree;
   Buf tmp(block * n);
   reduce(comm, in, tmp.data(), count_each * n, dt, op, 0);
   scatter(comm, tmp.data(), out, block, 0);
@@ -4011,8 +4079,12 @@ void scan(int comm, const void* in, void* out, size_t count, DType dt,
           ReduceOp op) {
   Comm& c = get_comm(comm);
   LogScope log("MPI_Scan", "with " + std::to_string(count) + " items");
-  if (shm::Arena* a = comm_arena(c))
+  tel::OpScope ts(tel::kScan, comm, count * dtype_size(dt));
+  if (shm::Arena* a = comm_arena(c)) {
+    ts.plane = tel::kPlaneShm;
     return shm::scan(a, in, out, count, dt, op);
+  }
+  ts.plane = tel::kPlaneTree;
   int n = static_cast<int>(c.ranks.size());
   size_t nbytes = count * dtype_size(dt);
   std::memcpy(out, in, nbytes);
@@ -4030,12 +4102,19 @@ void allgather(int comm, const void* in, void* out, size_t nbytes_each) {
   Comm& c = get_comm(comm);
   LogScope log("MPI_Allgather", "sending " + std::to_string(nbytes_each) +
                                   " bytes each");
-  if (shm::Arena* a = comm_arena(c))
+  tel::OpScope ts(tel::kAllgather, comm,
+                  nbytes_each * c.ranks.size());
+  if (shm::Arena* a = comm_arena(c)) {
+    ts.plane = tel::kPlaneShm;
     return shm::allgather(a, in, out, nbytes_each);
+  }
   int n = static_cast<int>(c.ranks.size());
-  if (use_hier(c, nbytes_each * n))
+  if (use_hier(c, nbytes_each * n)) {
+    ts.plane = tel::kPlaneHier;
     return hier_allgather_impl(c, in, out, nbytes_each);
+  }
   if (use_ring(c, nbytes_each * n)) {
+    ts.plane = tel::kPlaneRing;
     // ring allgather: every block travels once, (n-1)/n of the output
     // per link — vs the root-funnel gather+bcast's ~2*log2(n) copies
     uint8_t* o8 = static_cast<uint8_t*>(out);
@@ -4045,6 +4124,7 @@ void allgather(int comm, const void* in, void* out, size_t nbytes_each) {
     ring_allgather(c, o8, off, len);
     return;
   }
+  ts.plane = tel::kPlaneTree;
   gather(comm, in, out, nbytes_each, 0);
   bcast(comm, out, nbytes_each * c.ranks.size(), 0);
 }
@@ -4054,8 +4134,16 @@ void gather(int comm, const void* in, void* out, size_t nbytes_each,
   Comm& c = get_comm(comm);
   LogScope log("MPI_Gather", "-> " + std::to_string(root) + " sending " +
                                std::to_string(nbytes_each) + " bytes each");
-  if (shm::Arena* a = comm_arena(c))
+  tel::OpScope ts(
+      tel::kGather, comm, nbytes_each * c.ranks.size(),
+      root >= 0 && root < static_cast<int>(c.ranks.size())
+          ? c.ranks[root]
+          : -1);
+  if (shm::Arena* a = comm_arena(c)) {
+    ts.plane = tel::kPlaneShm;
     return shm::gather(a, in, out, nbytes_each, root);
+  }
+  ts.plane = tel::kPlaneTree;
   int n = static_cast<int>(c.ranks.size());
   // Per-instance tag (every member advances the counter in lockstep):
   // lets the root receive in ARRIVAL order below without a run-ahead
@@ -4088,8 +4176,16 @@ void scatter(int comm, const void* in, void* out, size_t nbytes_each,
   Comm& c = get_comm(comm);
   LogScope log("MPI_Scatter", "-> " + std::to_string(root) + " sending " +
                                 std::to_string(nbytes_each) + " bytes each");
-  if (shm::Arena* a = comm_arena(c))
+  tel::OpScope ts(
+      tel::kScatter, comm, nbytes_each * c.ranks.size(),
+      root >= 0 && root < static_cast<int>(c.ranks.size())
+          ? c.ranks[root]
+          : -1);
+  if (shm::Arena* a = comm_arena(c)) {
+    ts.plane = tel::kPlaneShm;
     return shm::scatter(a, in, out, nbytes_each, root);
+  }
+  ts.plane = tel::kPlaneTree;
   int n = static_cast<int>(c.ranks.size());
   if (c.my_index == root) {
     const uint8_t* i8 = static_cast<const uint8_t*>(in);
@@ -4114,8 +4210,13 @@ void alltoall(int comm, const void* in, void* out, size_t nbytes_each) {
   Comm& c = get_comm(comm);
   LogScope log("MPI_Alltoall", "sending " + std::to_string(nbytes_each) +
                                  " bytes each");
-  if (shm::Arena* a = comm_arena(c))
+  tel::OpScope ts(tel::kAlltoall, comm,
+                  nbytes_each * c.ranks.size());
+  if (shm::Arena* a = comm_arena(c)) {
+    ts.plane = tel::kPlaneShm;
     return shm::alltoall(a, in, out, nbytes_each);
+  }
+  ts.plane = tel::kPlaneTree;
   int n = static_cast<int>(c.ranks.size());
   int me = c.my_index;
   const uint8_t* i8 = static_cast<const uint8_t*>(in);

@@ -25,6 +25,7 @@
 #include <string>
 
 #include "dcn.h"
+#include "telemetry.h"
 #include "xla/ffi/api/ffi.h"
 
 namespace ffi = xla::ffi;
@@ -485,6 +486,49 @@ int32_t t4j_hier_active(int32_t comm) {
   }
 }
 void t4j_abort_notify(const char* why) { t4j::abort_notify(why); }
+
+// ---- telemetry control surface (docs/observability.md) ------------------
+//
+// mode: 0 off, 1 counters, 2 trace (< 0 keeps); ring_bytes sizes the
+// per-rank event ring (< 0 keeps; clamped to a small floor).  Must be
+// set before the first instrumented call — the ring is sized on first
+// use and never re-sized.  utils/config.py owns validation
+// (T4J_TELEMETRY / T4J_TELEMETRY_BYTES); the env parse in telemetry.h
+// is the fallback for hand-run processes.
+void t4j_set_telemetry(int32_t mode, int64_t ring_bytes) {
+  t4j::tel::set(mode, ring_bytes);
+}
+int32_t t4j_telemetry_mode() { return t4j::tel::mode(); }
+// Consume up to max_bytes/32 ring events (oldest first) into `out` as
+// packed 32-byte records (telemetry/schema.py mirrors the layout);
+// returns bytes written.  Call repeatedly until 0.
+int64_t t4j_telemetry_drain(void* out, int64_t max_bytes) {
+  if (!out || max_bytes < 0) return 0;
+  return static_cast<int64_t>(
+      t4j::tel::drain(out, static_cast<size_t>(max_bytes)));
+}
+// Copy the NEWEST events without consuming (the check_health
+// post-mortem peek); same record format, returns bytes written.
+int64_t t4j_telemetry_peek_last(void* out, int64_t max_bytes) {
+  if (!out || max_bytes < 0) return 0;
+  return static_cast<int64_t>(
+      t4j::tel::peek_last(out, static_cast<size_t>(max_bytes)));
+}
+uint64_t t4j_telemetry_dropped() { return t4j::tel::dropped(); }
+// Clock anchor: one (monotonic, realtime) pair captured right after
+// the bootstrap join barrier (or lazily now for single-process jobs).
+// Returns 1 when a bootstrap anchor existed, 0 when it was captured
+// lazily by this call.
+int32_t t4j_telemetry_anchor(uint64_t* mono_ns, uint64_t* unix_ns) {
+  return t4j::tel::anchor(mono_ns, unix_ns) ? 1 : 0;
+}
+// Metrics-table snapshot as u64 words (header + nonzero rows; layout
+// in telemetry.h / telemetry/schema.py).  out == null returns the
+// word count required.
+int64_t t4j_metrics_snapshot(uint64_t* out, int64_t max_words) {
+  return static_cast<int64_t>(t4j::tel::metrics_snapshot(
+      out, max_words < 0 ? 0 : static_cast<size_t>(max_words)));
+}
 
 int t4j_comm_create(const int32_t* ranks, int32_t n, int32_t ctx) {
   try {

@@ -1,6 +1,7 @@
 // Same-host shared-memory collective arena: see shm.h.
 
 #include "shm.h"
+#include "telemetry.h"
 
 #include <fcntl.h>
 #include <linux/futex.h>
@@ -495,6 +496,8 @@ uint64_t reduce_stage(Arena* a, const void* in, size_t nbytes) {
   std::memcpy(a->slot(a->me), in, nbytes);
   h->staged[a->me].store(p, std::memory_order_release);
   bump(h);
+  tel::trace_event(tel::kShmStage, tel::kInstant, tel::kPlaneShm, -1,
+                   -1, nbytes);
   return p;
 }
 
@@ -516,6 +519,8 @@ void reduce_finish(Arena* a, uint64_t p, void* out, size_t count,
   }
   h->acked[a->me].store(p, std::memory_order_release);
   bump(h);
+  tel::trace_event(tel::kShmFold, tel::kInstant, tel::kPlaneShm, -1,
+                   -1, count * esz);
 }
 
 size_t slot_bytes() { return slot_cap(); }

@@ -1,0 +1,567 @@
+// Native telemetry core: per-rank lock-free event ring + metrics table
+// (internal; docs/observability.md).
+//
+// The reference's observability story stops at per-call debug log lines
+// (mpi_xla_bridge.pyx:35-60) — string formatting on the hot path, off
+// by default, unparseable at scale.  This header is the measurement
+// substrate under docs/observability.md: every instrumented site in
+// dcn.cc / shm.cc appends a fixed 32-byte binary record to a per-rank
+// lock-free ring buffer and/or bumps a fixed-shape atomic counter
+// table, drained from Python (ffi.cc exports, native/runtime.py,
+// mpi4jax_tpu/telemetry/) into per-rank snapshot files and merged
+// cross-rank Perfetto timelines.
+//
+// Modes (T4J_TELEMETRY, validated loudly in utils/config.py; the env
+// parse here is the fallback for hand-run processes):
+//   off       — zero-cost: every instrumented site is one relaxed
+//               atomic load + compare (measured within noise of the
+//               un-instrumented build, docs/observability.md
+//               "overhead").
+//   counters  — the metrics table only (per comm x op x plane count /
+//               bytes / latency + size histograms), plus the rare
+//               control-plane events (link break / reconnect / replay
+//               escalation / fault) in the ring — those are the
+//               post-mortem payload runtime.check_health() reports.
+//   trace     — counters plus per-event records for ops, wire frames
+//               (= ring/hier segments) and shm arena stages: the
+//               Perfetto timeline feed.
+//
+// T4J_TELEMETRY_BYTES bounds the ring (default 1 MiB = 32Ki events);
+// when writers lap the drain cursor the oldest events are dropped and
+// counted (t4j_telemetry_dropped), never blocking a data-plane thread.
+//
+// Concurrency: writers reserve a slot with one fetch_add and publish
+// with a per-slot ticket (release store of index+1); the drain side
+// (Python, serialised by a mutex) copies a slot and re-checks its
+// ticket, discarding records a lapping writer tore mid-copy — a
+// per-slot seqlock.  No instrumented path ever takes a lock.
+//
+// The event layout is mirrored byte-for-byte by
+// mpi4jax_tpu/telemetry/schema.py (struct format "<QHBBiiIQ"); bump
+// kSchemaVersion when changing either.
+
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <memory>
+#include <mutex>
+#include <thread>
+
+namespace t4j {
+namespace tel {
+
+constexpr uint32_t kSchemaVersion = 1;
+
+enum Mode : int { kOff = 0, kCounters = 1, kTrace = 2 };
+
+// Stable wire ids, mirrored by telemetry/schema.py KIND_NAMES.
+enum Kind : uint16_t {
+  kKindNone = 0,
+  // op-level (metrics rows + trace begin/end pairs)
+  kSend = 1,
+  kRecv = 2,
+  kSendrecv = 3,
+  kBarrier = 4,
+  kBcast = 5,
+  kReduce = 6,
+  kAllreduce = 7,
+  kReduceScatter = 8,
+  kScan = 9,
+  kAllgather = 10,
+  kGather = 11,
+  kScatter = 12,
+  kAlltoall = 13,
+  kHierAllreduce = 14,
+  // data plane (trace instants): one frame = one wire segment
+  kFrameTx = 20,
+  kFrameRx = 21,
+  // control plane (recorded from counters mode up: rare and vital)
+  kLinkBreak = 30,
+  kReconnect = 31,
+  kReplay = 32,
+  kLinkDead = 33,
+  kFault = 34,
+  // shm arena stages (trace instants)
+  kShmStage = 40,
+  kShmFold = 41,
+};
+
+enum Phase : uint8_t { kInstant = 0, kBegin = 1, kEnd = 2 };
+
+// Data-plane attribution, mirrored by telemetry/schema.py PLANE_NAMES.
+enum Plane : uint8_t {
+  kPlaneNone = 0,
+  kPlaneTree = 1,
+  kPlaneRing = 2,
+  kPlaneHier = 3,
+  kPlaneShm = 4,
+  kPlaneCtrl = 5,
+};
+
+// 32-byte packed record; `seq` carries a 32-bit hash of the emitting
+// thread id so the exporter can lane events per native thread (begin/
+// end pairs nest correctly per lane).
+struct Event {
+  uint64_t t_ns;  // monotonic (CLOCK_MONOTONIC via steady_clock)
+  uint16_t kind;
+  uint8_t phase;
+  uint8_t plane;
+  int32_t comm;  // comm handle, -1 when unknown (shm arena stages)
+  int32_t peer;  // world rank of the peer/root, -1 when n/a
+  uint32_t seq;  // emitting-thread lane id
+  uint64_t bytes;
+};
+static_assert(sizeof(Event) == 32, "telemetry event layout");
+
+inline uint64_t now_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+inline uint32_t thread_lane() {
+  static thread_local uint32_t lane = [] {
+    size_t h = std::hash<std::thread::id>{}(std::this_thread::get_id());
+    uint32_t v = static_cast<uint32_t>(h ^ (h >> 32));
+    return v ? v : 1u;
+  }();
+  return lane;
+}
+
+// ---- knobs --------------------------------------------------------------
+
+inline std::atomic<int>& mode_cell() {
+  static std::atomic<int> v{-1};
+  return v;
+}
+
+inline std::atomic<long long>& ring_bytes_cell() {
+  static std::atomic<long long> v{-1};
+  return v;
+}
+
+constexpr long long kDefaultRingBytes = 1 << 20;  // 32Ki events
+constexpr long long kMinRingBytes = 4 << 10;      // 128 events
+
+inline int mode() {
+  int v = mode_cell().load(std::memory_order_relaxed);
+  if (v < 0) {
+    const char* s = std::getenv("T4J_TELEMETRY");
+    v = kOff;
+    if (s && s[0]) {
+      if (!std::strcmp(s, "counters")) v = kCounters;
+      else if (!std::strcmp(s, "trace")) v = kTrace;
+      // anything else keeps off; utils/config.py rejects loudly
+    }
+    mode_cell().store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+inline long long ring_bytes() {
+  long long v = ring_bytes_cell().load(std::memory_order_relaxed);
+  if (v < 0) {
+    v = kDefaultRingBytes;
+    const char* s = std::getenv("T4J_TELEMETRY_BYTES");
+    if (s && s[0]) {
+      char* end = nullptr;
+      long long got = std::strtoll(s, &end, 10);
+      if (end != s && got >= 0) {
+        if (*end == 'k' || *end == 'K') { got <<= 10; ++end; }
+        else if (*end == 'm' || *end == 'M') { got <<= 20; ++end; }
+        else if (*end == 'g' || *end == 'G') { got <<= 30; ++end; }
+        if (*end == '\0') v = got;  // Python is the loud validator
+      }
+    }
+    if (v < kMinRingBytes) v = kMinRingBytes;
+    ring_bytes_cell().store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+// set_telemetry(mode, ring_bytes): mode < 0 or ring < 0 keeps the
+// current value.  Must be called before the first event is recorded
+// (native/runtime.py threads it through before t4j_init; the ring is
+// sized on first use and never re-sized).
+inline void set(int m, long long ring) {
+  if (m >= kOff && m <= kTrace)
+    mode_cell().store(m, std::memory_order_relaxed);
+  if (ring >= 0) {
+    if (ring < kMinRingBytes) ring = kMinRingBytes;
+    ring_bytes_cell().store(ring, std::memory_order_relaxed);
+  }
+}
+
+// ---- clock anchor -------------------------------------------------------
+//
+// Event timestamps are monotonic (immune to NTP steps mid-run); the
+// cross-rank merge needs each rank's monotonic clock pinned to a
+// shared timeline.  The anchor is one (monotonic, realtime) pair
+// captured at bridge bootstrap: per-rank files carry it, and the
+// merger maps t_unix = t_mono - anchor_mono + anchor_unix.  Same-host
+// ranks then align exactly; across hosts the residual is the hosts'
+// wall-clock skew (NTP-bounded), which the merger additionally
+// tightens by pinning every rank's bootstrap-barrier instant to the
+// same tick (docs/observability.md "clock alignment").
+
+struct Anchor {
+  std::atomic<uint64_t> mono_ns{0};
+  std::atomic<uint64_t> unix_ns{0};
+};
+
+inline Anchor& anchor_cell() {
+  static Anchor a;
+  return a;
+}
+
+inline void capture_anchor() {
+  timespec ts{};
+  clock_gettime(CLOCK_REALTIME, &ts);
+  uint64_t real = static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+                  static_cast<uint64_t>(ts.tv_nsec);
+  anchor_cell().mono_ns.store(now_ns(), std::memory_order_relaxed);
+  anchor_cell().unix_ns.store(real, std::memory_order_relaxed);
+}
+
+// Returns false (and captures now) when no bootstrap anchor was taken
+// yet — a single-process job is its own timeline.
+inline bool anchor(uint64_t* mono, uint64_t* unix_out) {
+  bool had = anchor_cell().mono_ns.load(std::memory_order_relaxed) != 0;
+  if (!had) capture_anchor();
+  if (mono) *mono = anchor_cell().mono_ns.load(std::memory_order_relaxed);
+  if (unix_out)
+    *unix_out = anchor_cell().unix_ns.load(std::memory_order_relaxed);
+  return had;
+}
+
+// ---- event ring ---------------------------------------------------------
+
+struct Slot {
+  std::atomic<uint64_t> ticket{0};  // index+1 once the payload is valid
+  Event ev;
+};
+
+struct Ring {
+  std::unique_ptr<Slot[]> slots;
+  size_t nslots = 0;  // power of two
+  size_t mask = 0;
+  std::atomic<uint64_t> widx{0};
+  uint64_t ridx = 0;  // guarded by drain_mu
+  std::atomic<uint64_t> dropped{0};
+  std::mutex drain_mu;
+};
+
+// Leaked on purpose, like every global detached threads touch (see the
+// g_fault_mu comment in dcn.cc): reader/repair threads emit events
+// until the instant the process exits.
+inline Ring& ring() {
+  static Ring& r = *[] {
+    Ring* rr = new Ring;
+    size_t want = static_cast<size_t>(ring_bytes()) / sizeof(Event);
+    size_t n = 1;
+    while (n * 2 <= want) n *= 2;
+    rr->slots.reset(new Slot[n]);
+    rr->nslots = n;
+    rr->mask = n - 1;
+    return rr;
+  }();
+  return r;
+}
+
+inline void emit(Kind kind, Phase phase, Plane plane, int comm, int peer,
+                 uint64_t bytes) {
+  Ring& r = ring();
+  uint64_t idx = r.widx.fetch_add(1, std::memory_order_relaxed);
+  Slot& s = r.slots[idx & r.mask];
+  // invalidate first so a concurrent drain of a lapped slot never
+  // reads a half-written payload with a stale valid ticket; the full
+  // fence keeps the payload stores below from becoming visible BEFORE
+  // the invalidation on weakly-ordered CPUs (classical seqlock writer
+  // — the paired reader fence is in drain/peek_last)
+  s.ticket.store(0, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  s.ev.t_ns = now_ns();
+  s.ev.kind = static_cast<uint16_t>(kind);
+  s.ev.phase = phase;
+  s.ev.plane = plane;
+  s.ev.comm = comm;
+  s.ev.peer = peer;
+  s.ev.seq = thread_lane();
+  s.ev.bytes = bytes;
+  s.ticket.store(idx + 1, std::memory_order_release);
+}
+
+// Data-plane record: trace mode only.
+inline void trace_event(Kind kind, Phase phase, Plane plane, int comm,
+                        int peer, uint64_t bytes) {
+  if (mode() < kTrace) return;
+  emit(kind, phase, plane, comm, peer, bytes);
+}
+
+// Control-plane record (link break/reconnect/replay/fault): rare and
+// vital, recorded from counters mode up so post-mortems always carry
+// them (runtime.check_health reports the tail of the ring).
+inline void control_event(Kind kind, int peer, uint64_t bytes) {
+  if (mode() < kCounters) return;
+  emit(kind, kInstant, kPlaneCtrl, -1, peer, bytes);
+}
+
+// Drain up to max_bytes/32 events in ring order (oldest first),
+// consuming them; returns bytes written.  Lapped (overflowed) events
+// are counted in `dropped`; an *in-flight* slot — reserved by a
+// writer that has not published yet, which is the only way a ticket
+// can mismatch inside the [w - nslots, w) window — stops the drain
+// there, leaving the cursor on it: the writer finishes within a few
+// instructions and the next drain picks it up, so no published event
+// is ever lost.  Serialised: one consumer at a time.
+inline size_t drain(void* out, size_t max_bytes) {
+  Ring& r = ring();
+  std::lock_guard<std::mutex> lk(r.drain_mu);
+  uint64_t w = r.widx.load(std::memory_order_acquire);
+  uint64_t start = r.ridx;
+  if (w > r.nslots && start < w - r.nslots) {
+    r.dropped.fetch_add((w - r.nslots) - start,
+                        std::memory_order_relaxed);
+    start = w - r.nslots;
+  }
+  Event* dst = static_cast<Event*>(out);
+  size_t cap = max_bytes / sizeof(Event);
+  size_t n = 0;
+  uint64_t i = start;
+  for (; i < w && n < cap; ++i) {
+    Slot& s = r.slots[i & r.mask];
+    if (s.ticket.load(std::memory_order_acquire) != i + 1)
+      break;  // in-flight writer: resume here next drain
+    Event copy = s.ev;
+    // seqlock read validation: the fence orders the payload loads
+    // above before the ticket re-check (paired with emit's fence)
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (s.ticket.load(std::memory_order_relaxed) != i + 1)
+      break;  // a writer claimed this slot mid-copy
+    dst[n++] = copy;
+  }
+  r.ridx = i;
+  return n * sizeof(Event);
+}
+
+// Copy the NEWEST events (up to max_bytes/32, oldest-of-the-tail
+// first) WITHOUT consuming: the post-mortem peek check_health uses.
+inline size_t peek_last(void* out, size_t max_bytes) {
+  Ring& r = ring();
+  std::lock_guard<std::mutex> lk(r.drain_mu);
+  uint64_t w = r.widx.load(std::memory_order_acquire);
+  size_t cap = max_bytes / sizeof(Event);
+  uint64_t lo = 0;
+  if (w > cap) lo = w - cap;
+  if (w > r.nslots && lo < w - r.nslots) lo = w - r.nslots;
+  Event* dst = static_cast<Event*>(out);
+  size_t n = 0;
+  for (uint64_t i = lo; i < w && n < cap; ++i) {
+    Slot& s = r.slots[i & r.mask];
+    if (s.ticket.load(std::memory_order_acquire) != i + 1) continue;
+    Event copy = s.ev;
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (s.ticket.load(std::memory_order_relaxed) != i + 1) continue;
+    dst[n++] = copy;
+  }
+  return n * sizeof(Event);
+}
+
+inline uint64_t dropped() {
+  return ring().dropped.load(std::memory_order_relaxed);
+}
+
+// ---- metrics table ------------------------------------------------------
+//
+// Fixed-shape atomic counters per (comm, op kind, plane): count, bytes,
+// sum/min/max latency, a log2 latency histogram (1 us .. ~8.6 s) and a
+// log2 size histogram (64 B .. >=32 MB).  Fixed shape keeps the update
+// path allocation- and lock-free; Python (telemetry/registry.py)
+// derives p50/p99 from the buckets.  Comm handles >= kMaxComm-1 fold
+// into the last row (real programs use a handful of comms; the fold
+// loses per-comm attribution, never counts).
+
+constexpr int kMaxComm = 8;
+constexpr int kMaxKind = 16;  // op kinds 0..15 (kSend..kHierAllreduce)
+constexpr int kMaxPlane = 6;
+constexpr int kLatBuckets = 24;     // bucket i: [2^(10+i), 2^(11+i)) ns
+constexpr int kLatBaseLog2 = 10;    // 1.024 us
+constexpr int kSizeBuckets = 20;    // bucket i: [2^(6+i), 2^(7+i)) bytes
+constexpr int kSizeBaseLog2 = 6;    // 64 B
+
+struct Row {
+  std::atomic<uint64_t> count{0};
+  std::atomic<uint64_t> bytes{0};
+  std::atomic<uint64_t> sum_ns{0};
+  std::atomic<uint64_t> min_ns{0};  // 0 = unset
+  std::atomic<uint64_t> max_ns{0};
+  std::atomic<uint64_t> lat[kLatBuckets];
+  std::atomic<uint64_t> size[kSizeBuckets];
+};
+
+struct Table {
+  Row rows[kMaxComm][kMaxKind][kMaxPlane];
+};
+
+inline Table& table() {
+  static Table& t = *new Table;  // leaked: see ring()
+  return t;
+}
+
+inline int log2_bucket(uint64_t v, int base, int nbuckets) {
+  if (v >> base == 0) return 0;
+  int b = 0;
+  uint64_t x = v >> base;
+  while (x > 1 && b < nbuckets - 1) {
+    x >>= 1;
+    ++b;
+  }
+  return b;
+}
+
+inline void count_op(int comm, Kind kind, Plane plane, uint64_t bytes,
+                     uint64_t dur_ns) {
+  if (comm < 0) comm = 0;
+  if (comm >= kMaxComm) comm = kMaxComm - 1;
+  int k = static_cast<int>(kind);
+  if (k < 0 || k >= kMaxKind) return;
+  int p = static_cast<int>(plane);
+  if (p < 0 || p >= kMaxPlane) p = 0;
+  Row& r = table().rows[comm][k][p];
+  r.count.fetch_add(1, std::memory_order_relaxed);
+  r.bytes.fetch_add(bytes, std::memory_order_relaxed);
+  r.sum_ns.fetch_add(dur_ns, std::memory_order_relaxed);
+  uint64_t cur = r.min_ns.load(std::memory_order_relaxed);
+  while ((cur == 0 || dur_ns < cur) &&
+         !r.min_ns.compare_exchange_weak(cur, dur_ns,
+                                         std::memory_order_relaxed)) {
+  }
+  cur = r.max_ns.load(std::memory_order_relaxed);
+  while (dur_ns > cur &&
+         !r.max_ns.compare_exchange_weak(cur, dur_ns,
+                                         std::memory_order_relaxed)) {
+  }
+  r.lat[log2_bucket(dur_ns, kLatBaseLog2, kLatBuckets)].fetch_add(
+      1, std::memory_order_relaxed);
+  r.size[log2_bucket(bytes, kSizeBaseLog2, kSizeBuckets)].fetch_add(
+      1, std::memory_order_relaxed);
+}
+
+// Snapshot layout (u64 words), mirrored by telemetry/schema.py
+// parse_snapshot:
+//   header: [version, n_rows, row_words, lat_buckets, lat_base_log2,
+//            size_buckets, size_base_log2, mode]
+//   row:    [comm, kind, plane, count, bytes, sum_ns, min_ns, max_ns,
+//            lat..., size...]
+// Only rows with count > 0 are emitted.  Returns words written; when
+// out is null (or too small) returns the words REQUIRED — callers size
+// a buffer with a null call first.
+constexpr int kSnapHeader = 8;
+constexpr int kRowWords = 8 + kLatBuckets + kSizeBuckets;
+
+inline size_t metrics_snapshot(uint64_t* out, size_t max_words) {
+  Table& t = table();
+  size_t nrows = 0;
+  for (int c = 0; c < kMaxComm; ++c)
+    for (int k = 0; k < kMaxKind; ++k)
+      for (int p = 0; p < kMaxPlane; ++p)
+        if (t.rows[c][k][p].count.load(std::memory_order_relaxed))
+          ++nrows;
+  size_t need = kSnapHeader + nrows * kRowWords;
+  if (!out || max_words < need) return need;
+  uint64_t* w = out;
+  uint64_t emitted = 0;
+  *w++ = kSchemaVersion;
+  *w++ = nrows;
+  *w++ = kRowWords;
+  *w++ = kLatBuckets;
+  *w++ = kLatBaseLog2;
+  *w++ = kSizeBuckets;
+  *w++ = kSizeBaseLog2;
+  *w++ = static_cast<uint64_t>(mode());
+  for (int c = 0; c < kMaxComm; ++c)
+    for (int k = 0; k < kMaxKind; ++k)
+      for (int p = 0; p < kMaxPlane; ++p) {
+        Row& r = t.rows[c][k][p];
+        uint64_t cnt = r.count.load(std::memory_order_relaxed);
+        if (!cnt) continue;
+        // a row can flip nonzero between the sizing pass and this
+        // one (concurrent OpScope): never write past the caller's
+        // buffer — the skipped row shows up in the next snapshot
+        if (static_cast<size_t>(w - out) + kRowWords > max_words)
+          goto done;
+        ++emitted;
+        *w++ = static_cast<uint64_t>(c);
+        *w++ = static_cast<uint64_t>(k);
+        *w++ = static_cast<uint64_t>(p);
+        *w++ = cnt;
+        *w++ = r.bytes.load(std::memory_order_relaxed);
+        *w++ = r.sum_ns.load(std::memory_order_relaxed);
+        *w++ = r.min_ns.load(std::memory_order_relaxed);
+        *w++ = r.max_ns.load(std::memory_order_relaxed);
+        for (int i = 0; i < kLatBuckets; ++i)
+          *w++ = r.lat[i].load(std::memory_order_relaxed);
+        for (int i = 0; i < kSizeBuckets; ++i)
+          *w++ = r.size[i].load(std::memory_order_relaxed);
+      }
+done:
+  out[1] = emitted;  // the rows actually written, not the sizing count
+  return static_cast<size_t>(w - out);
+}
+
+// ---- op scope -----------------------------------------------------------
+//
+// RAII bracket for the public op entry points (dcn.cc): one metrics
+// update per op (counters mode up) and a begin/end event pair (trace
+// mode).  The op body sets `plane` once path selection has happened —
+// the destructor records the plane that actually served the call.
+//
+// Composed ops nest (tree allreduce = reduce + bcast through the
+// public entry points; hier phases call reduce on the leader comm):
+// the nested scopes still emit trace begin/end pairs — nested
+// timeline slices are exactly what Perfetto should show — but only
+// the OUTERMOST scope updates the metrics table, so per-op counts
+// and per-plane byte totals count each user-visible call once (the
+// same count-once convention the analyzer's publishes_token
+// reentrancy guard enforces on the Python side).
+
+inline int& op_depth() {
+  static thread_local int depth = 0;
+  return depth;
+}
+
+struct OpScope {
+  Kind kind;
+  int comm;
+  int peer;
+  uint64_t bytes;
+  Plane plane = kPlaneNone;
+  uint64_t t0 = 0;
+  bool counting = false;
+  bool outermost = false;
+
+  OpScope(Kind kind_, int comm_, uint64_t bytes_, int peer_ = -1)
+      : kind(kind_), comm(comm_), peer(peer_), bytes(bytes_) {
+    if (mode() < kCounters) return;
+    counting = true;
+    outermost = op_depth()++ == 0;
+    t0 = now_ns();
+    if (mode() >= kTrace)
+      emit(kind, kBegin, plane, comm, peer, bytes);
+  }
+  ~OpScope() {
+    if (!counting) return;
+    --op_depth();
+    if (outermost) count_op(comm, kind, plane, bytes, now_ns() - t0);
+    if (mode() >= kTrace) emit(kind, kEnd, plane, comm, peer, bytes);
+  }
+};
+
+}  // namespace tel
+}  // namespace t4j

@@ -442,12 +442,25 @@ def _debug_end(state, out):
     )
 
 
+def _tel_nbytes(args, kwargs):
+    arr = _first_array((args, kwargs))
+    if arr is None:
+        return 0
+    try:
+        return int(arr.size) * np.dtype(arr.dtype).itemsize
+    except Exception:
+        return 0
+
+
 def publishes_token(fn):
     """Instrumentation wrapper for every public op: profiler scope,
-    opt-in per-call debug logging, publication of the returned Token
-    (if any) to the ambient auto_tokenize chain, and — while a
-    ``verify_comm`` extraction is active — reporting the call to the
-    contract analyzer (analysis/record.py).
+    opt-in per-call debug logging, opt-in telemetry bracketing
+    (T4J_TELEMETRY=trace — the Python-level begin/end events that
+    enclose the native segment events on the merged timeline,
+    docs/observability.md), publication of the returned Token (if any)
+    to the ambient auto_tokenize chain, and — while a ``verify_comm``
+    extraction is active — reporting the call to the contract analyzer
+    (analysis/record.py).
 
     The ``jax.named_scope`` below is load-bearing for the analyzer too:
     it stamps every lowered eqn's name stack with ``mpi4jax_tpu.<op>``,
@@ -455,6 +468,7 @@ def publishes_token(fn):
     communication eqns inside control-flow sub-jaxprs regardless of
     backend.
     """
+    import contextlib
     import functools
 
     name = fn.__name__
@@ -470,16 +484,29 @@ def publishes_token(fn):
             log_state = _debug_begin(
                 name, args, kwargs, check_comm(kwargs.get("comm"))
             )
+        # Python-level op bracket: at execution time for eager/proc
+        # calls (the MPMD idiom), at trace time under jit — the staged
+        # tier additionally brackets its runtime callbacks
+        # (ops/_proc.py), which is where in-jit wall time is spent.
+        # EVERY wrapped op is bracketed (reduce_scatter, the halo and
+        # attention composites included) — _LOGGED_OPS is the debug
+        # log's MPI_<Op> wire-name set, a different concern.
+        tel_scope = contextlib.nullcontext()
+        from mpi4jax_tpu.telemetry import recorder as _telrec
+
+        if _telrec.tracing():
+            tel_scope = _telrec.py_op(name, _tel_nbytes(args, kwargs))
         from mpi4jax_tpu.analysis import record as _arecord
 
-        if _arecord.active():
-            with _arecord.op_frame():
+        with tel_scope:
+            if _arecord.active():
+                with _arecord.op_frame():
+                    with jax.named_scope(f"mpi4jax_tpu.{name}"):
+                        out = fn(*args, **kwargs)
+                    _arecord.record_op(name, fn, args, kwargs, out)
+            else:
                 with jax.named_scope(f"mpi4jax_tpu.{name}"):
                     out = fn(*args, **kwargs)
-                _arecord.record_op(name, fn, args, kwargs, out)
-        else:
-            with jax.named_scope(f"mpi4jax_tpu.{name}"):
-                out = fn(*args, **kwargs)
         token = None
         if isinstance(out, Token):
             token = out

@@ -206,16 +206,23 @@ def _eager_host_hop(py_fn, results, operands):
     return jax.device_put(np.asarray(out))
 
 
-def _staged_data(comm, out_sds, host_fn, x, stamp):
+def _staged_data(comm, out_sds, host_fn, x, stamp, name="op"):
     """Shared staged-tier shape for data-in/data-out ops: stages ``x``
     to host, runs ``host_fn(runtime, handle, np_x) -> np_out``, threads
-    the stamp through for ordering."""
+    the stamp through for ordering.  The callback is bracketed with
+    Python-level telemetry begin/end events (T4J_TELEMETRY=trace,
+    docs/observability.md): under jit this is the execution-time span
+    that encloses the native segment events — the trace-time bracket in
+    ops/_core.py cannot see runtime from inside a compiled program."""
     from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.telemetry import recorder as _telrec
 
     h = int(_handle(comm))
 
     def cb(x_, stamp_):
-        return host_fn(runtime, h, np.asarray(x_)), stamp_
+        a = np.asarray(x_)
+        with _telrec.py_op(f"staged_{name}", a.nbytes):
+            return host_fn(runtime, h, a), stamp_
 
     return _io(cb, (out_sds, _STAMP), x, stamp)
 
@@ -240,6 +247,7 @@ def proc_allreduce(x, stamp, op, comm):
         return _staged_data(
             comm, _sds(x),
             lambda rt, h, a: rt.host_allreduce(h, a, code), x, stamp,
+            name="allreduce",
         )
     return _call(
         "t4j_allreduce",
@@ -263,6 +271,7 @@ def proc_reduce(x, stamp, op, comm, root):
         return _staged_data(
             comm, _sds(x),
             lambda rt, h, a: rt.host_reduce(h, a, code, root), x, stamp,
+            name="reduce",
         )
     return _call(
         "t4j_reduce",
@@ -290,6 +299,7 @@ def proc_reduce_scatter(x, stamp, op, comm):
         return _staged_data(
             comm, out,
             lambda rt, h, a: rt.host_reduce_scatter(h, a, code), x, stamp,
+            name="reduce_scatter",
         )
     return _call(
         "t4j_reduce_scatter",
@@ -310,6 +320,7 @@ def proc_scan(x, stamp, op, comm):
         return _staged_data(
             comm, _sds(x),
             lambda rt, h, a: rt.host_scan(h, a, code), x, stamp,
+            name="scan",
         )
     return _call(
         "t4j_scan",
@@ -341,6 +352,7 @@ def proc_bcast(x, stamp, comm, root):
         return _staged_data(
             comm, _sds(x),
             lambda rt, h, a: rt.host_bcast(h, a, root), x, stamp,
+            name="bcast",
         )
     return _call(
         "t4j_bcast",
@@ -356,7 +368,8 @@ def proc_allgather(x, stamp, comm):
     out = jax.ShapeDtypeStruct((comm.size, *jnp.shape(x)), jnp.result_type(x))
     if _staged():
         return _staged_data(
-            comm, out, lambda rt, h, a: rt.host_allgather(h, a), x, stamp
+            comm, out, lambda rt, h, a: rt.host_allgather(h, a), x, stamp,
+            name="allgather",
         )
     return _call(
         "t4j_allgather", (out, _STAMP), x, stamp, comm=_handle(comm)
@@ -369,6 +382,7 @@ def proc_gather(x, stamp, comm, root):
         return _staged_data(
             comm, out,
             lambda rt, h, a: rt.host_gather(h, a, root), x, stamp,
+            name="gather",
         )
     return _call(
         "t4j_gather",
@@ -389,6 +403,7 @@ def proc_scatter(x, stamp, comm, root):
         return _staged_data(
             comm, out,
             lambda rt, h, a: rt.host_scatter(h, a, root), x, stamp,
+            name="scatter",
         )
     return _call(
         "t4j_scatter",
@@ -403,7 +418,8 @@ def proc_scatter(x, stamp, comm, root):
 def proc_alltoall(x, stamp, comm):
     if _staged():
         return _staged_data(
-            comm, _sds(x), lambda rt, h, a: rt.host_alltoall(h, a), x, stamp
+            comm, _sds(x), lambda rt, h, a: rt.host_alltoall(h, a), x, stamp,
+            name="alltoall",
         )
     return _call("t4j_alltoall", (_sds(x), _STAMP), x, stamp, comm=_handle(comm))
 

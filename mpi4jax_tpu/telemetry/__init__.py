@@ -1,0 +1,63 @@
+"""Comm telemetry: native event ring + metrics -> snapshots, Perfetto
+timelines, and ``t4j-top`` (docs/observability.md).
+
+The measurement layer over the native bridge's instrumentation
+(native/src/telemetry.h): each rank's lock-free event ring and metrics
+table drain through ``native.runtime`` into per-rank JSON files
+(:mod:`.dump`), which merge into one cross-rank Chrome/Perfetto trace
+(:mod:`.trace`) and render as console tables (:mod:`.top`, the
+``t4j-top`` script).  :mod:`.schema` is the wire-format mirror,
+:mod:`.registry` the counters/histograms/percentile core, and
+:mod:`.recorder` the Python-level op bracket.
+
+Enable with ``T4J_TELEMETRY=counters|trace`` (validated in
+utils/config.py; ``off`` is a zero-cost no-op) or run jobs under
+``python -m mpi4jax_tpu.launch --telemetry DIR``.
+
+Every module here is import-free of jax (stdlib only), like
+``analysis.contracts``: the cores load standalone on containers where
+the package itself cannot import.
+"""
+
+from .recorder import py_op
+from .registry import Histogram, MetricsRegistry
+from .schema import (
+    EVENT_STRUCT,
+    KIND_NAMES,
+    PLANE_NAMES,
+    RANK_FILE_SCHEMA,
+    SCHEMA_VERSION,
+    Event,
+    SchemaError,
+    check_begin_end_balance,
+    decode_events,
+    load_rank_file,
+    load_trace,
+    parse_snapshot,
+    validate_rank_file,
+    validate_trace,
+)
+from .trace import merge_dir, merge_rank_objs, rank_to_chrome_events
+
+__all__ = [
+    "EVENT_STRUCT",
+    "Event",
+    "Histogram",
+    "KIND_NAMES",
+    "MetricsRegistry",
+    "PLANE_NAMES",
+    "RANK_FILE_SCHEMA",
+    "SCHEMA_VERSION",
+    "SchemaError",
+    "check_begin_end_balance",
+    "decode_events",
+    "load_rank_file",
+    "load_trace",
+    "merge_dir",
+    "merge_rank_objs",
+    "parse_snapshot",
+    "py_op",
+    "rank_to_chrome_events",
+    "validate_rank_file",
+    "validate_trace",
+]

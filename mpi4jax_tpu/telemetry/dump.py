@@ -1,0 +1,163 @@
+"""Per-rank telemetry drain: ring + metrics -> ``rank<k>.t4j.json``.
+
+Two entry points:
+
+* :func:`build_rank_obj` — the pure builder (stdlib only): standalone
+  harnesses (tools/telemetry_smoke.py, old-jax containers) feed it raw
+  ctypes drains directly.
+* :func:`collect` / :func:`write_rank_file` / :func:`install_atexit` —
+  the in-package path: pull everything from ``native.runtime`` and
+  write the file.  ``runtime.ensure_initialized`` installs the atexit
+  hook when ``T4J_TELEMETRY_DIR`` is set (the launcher's
+  ``--telemetry DIR``), and the launcher's child wrapper calls
+  :func:`write_rank_file` on the abort path too, so a dying rank's
+  last events make it into the first-failure report.
+
+The drain is ordered AFTER the bridge's atexit finalize on purpose
+(atexit runs LIFO; ensure_initialized registers this hook first): the
+ring and metrics table are process-global and outlive finalize, so the
+file also carries teardown-phase events.
+"""
+
+import json
+import os
+import pathlib
+
+from . import recorder, schema
+
+_hook_state = {"installed": False}
+
+# Drains accumulate across calls: the abort path drains first (so a
+# rank about to be signal-killed loses nothing) and the atexit hook
+# drains AGAIN at interpreter exit — without accumulation the second,
+# nearly-empty drain would overwrite the file that held the dying
+# rank's last events, which is the post-mortem case the feature
+# exists for.  link_stats/topology are cached the same way: the
+# atexit drain runs AFTER bridge finalize (LIFO by design), where the
+# live queries return None — runtime.finalize() calls
+# :func:`capture_runtime_state` just before teardown so the exit file
+# still carries the per-link counters.
+_accum = {"events": [], "py_events": [], "link_stats": None,
+          "topology": None}
+
+
+def capture_runtime_state():
+    """Snapshot the teardown-sensitive state (link stats, topology)
+    while the bridge is still initialized.  Called from
+    runtime.finalize() when a telemetry dir is configured; idempotent
+    and never raises."""
+    try:
+        from mpi4jax_tpu.native import runtime
+
+        agg = runtime.link_stats()
+        if agg is not None:
+            per_peer = {}
+            for peer in range(runtime.world_size()):
+                s = runtime.link_stats(peer)
+                if s is not None:
+                    per_peer[str(peer)] = s
+            _accum["link_stats"] = {"aggregate": agg,
+                                    "per_peer": per_peer}
+        topo = runtime.topology()
+        if topo is not None:
+            _accum["topology"] = topo
+    except Exception:
+        pass
+
+
+def rank_file_name(rank):
+    return f"rank{int(rank)}.t4j.json"
+
+
+def build_rank_obj(rank, world, anchor_mono_ns, anchor_unix_ns, mode,
+                   events=(), py_events=(), metrics_words=(),
+                   dropped=0, link_stats=None, topology=None, job=None):
+    """Assemble a schema-valid per-rank telemetry object from raw
+    drains (``events``: iterable of :class:`schema.Event` or 8-field
+    rows; ``metrics_words``: the u64 snapshot)."""
+    rows = []
+    for e in events:
+        rows.append(schema.event_to_list(e) if isinstance(e, schema.Event)
+                    else list(e))
+    metrics = (schema.parse_snapshot(metrics_words) if metrics_words
+               else {"version": schema.SCHEMA_VERSION, "mode": 0,
+                     "lat_base_log2": 10, "size_base_log2": 6,
+                     "rows": []})
+    obj = {
+        "schema": schema.RANK_FILE_SCHEMA,
+        "rank": int(rank),
+        "world": int(world),
+        "mode": str(mode),
+        "job": str(job or ""),
+        "anchor": {"mono_ns": int(anchor_mono_ns),
+                   "unix_ns": int(anchor_unix_ns)},
+        "dropped": int(dropped),
+        "events": rows,
+        "py_events": [list(r) for r in py_events],
+        "metrics": metrics,
+        "link_stats": link_stats or {},
+        "topology": topology or {},
+    }
+    return schema.validate_rank_file(obj)
+
+
+def collect():
+    """Drain everything this rank has (native ring, python recorder,
+    metrics, link stats, topology) into a rank object, or ``None``
+    when the native bridge was never loaded.  Cumulative: repeated
+    calls (abort path, then atexit; or periodic mid-run dumps) return
+    everything drained so far."""
+    from mpi4jax_tpu.native import runtime
+
+    if runtime._state["lib"] is None:
+        return None
+    _accum["events"].extend(runtime.telemetry_drain())
+    _accum["py_events"].extend(recorder.drain())
+    events = _accum["events"]
+    mono, unix = runtime.telemetry_anchor()
+    capture_runtime_state()  # refresh while live; no-op post-finalize
+    link = _accum["link_stats"] or {}
+    return build_rank_obj(
+        rank=int(os.environ.get("T4J_RANK", 0)),
+        world=int(os.environ.get("T4J_SIZE", 1)),
+        anchor_mono_ns=mono,
+        anchor_unix_ns=unix,
+        mode=runtime.telemetry_mode_name(),
+        events=events,
+        py_events=_accum["py_events"],
+        metrics_words=runtime.metrics_snapshot(),
+        dropped=runtime.telemetry_dropped() + recorder.dropped(),
+        link_stats=link,
+        topology=_accum["topology"] or {},
+        job=os.environ.get("T4J_JOB", ""),
+    )
+
+
+def write_rank_file(directory):
+    """Drain into ``directory/rank<k>.t4j.json``; returns the path or
+    ``None`` when there was nothing to drain.  Never raises (the exit
+    path must not mask the real failure)."""
+    try:
+        obj = collect()
+        if obj is None:
+            return None
+        d = pathlib.Path(directory)
+        d.mkdir(parents=True, exist_ok=True)
+        path = d / rank_file_name(obj["rank"])
+        tmp = path.with_suffix(f".tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)  # atomic: the merger never sees a torn file
+        return path
+    except Exception:
+        return None
+
+
+def install_atexit(directory):
+    """Register the exit-time drain once (idempotent)."""
+    if _hook_state["installed"]:
+        return
+    _hook_state["installed"] = True
+    import atexit
+
+    atexit.register(write_rank_file, directory)

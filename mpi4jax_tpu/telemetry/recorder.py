@@ -1,0 +1,115 @@
+"""Python-level op recorder: begin/end events bracketing the native tier.
+
+The native event ring sees wire frames and native op scopes; what it
+cannot see is the Python-side span around them — io_callback staging,
+numpy marshalling, ctypes dispatch.  The op layer (ops/_core.py trace
+hook, ops/_proc.py staged-callback hook) brackets each op with
+:func:`py_op`, and the drain (telemetry/dump.py) writes these rows next
+to the native events so the merged timeline shows a ``python`` lane
+above the native lanes per rank.
+
+Events are (t_ns, op_name, phase, nbytes) with ``time.monotonic_ns``
+timestamps — the same CLOCK_MONOTONIC the native steady_clock reads on
+Linux, so the two lanes share a timebase and the native anchor aligns
+both.  The buffer is bounded (oldest dropped first, counted) and
+thread-safe; recording is a no-op unless T4J_TELEMETRY=trace.
+
+Import-free of jax (stdlib only).
+"""
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+PHASE_INSTANT, PHASE_BEGIN, PHASE_END = 0, 1, 2
+
+_MAX_EVENTS = 65536
+
+_state = {
+    "events": deque(maxlen=_MAX_EVENTS),
+    "dropped": 0,
+    "lock": threading.Lock(),
+    "mode": None,  # resolved lazily; tests reset via _reset()
+}
+
+
+def _resolve_mode():
+    """T4J_TELEMETRY via utils.config when importable (loud validation
+    already happened at bridge init), raw env otherwise (standalone
+    loads on old-jax containers must not import the package)."""
+    try:
+        from mpi4jax_tpu.utils import config
+
+        return config.telemetry_mode()
+    except Exception:
+        v = os.environ.get("T4J_TELEMETRY", "").strip().lower()
+        return v if v in ("counters", "trace") else "off"
+
+
+def mode():
+    m = _state["mode"]
+    if m is None:
+        m = _state["mode"] = _resolve_mode()
+    return m
+
+
+def tracing():
+    """True when Python-level events should be recorded."""
+    return mode() == "trace"
+
+
+def _reset(mode=None):
+    """Test hook: clear the buffer and pin (or re-resolve) the mode."""
+    with _state["lock"]:
+        _state["events"].clear()
+        _state["dropped"] = 0
+        _state["mode"] = mode
+
+
+def set_mode(mode):
+    """Pin the recorder's mode without touching recorded events —
+    runtime.set_telemetry() calls this so a runtime override keeps
+    the Python lane in lockstep with the native ring."""
+    _state["mode"] = str(mode)
+
+
+def record(op, phase, nbytes=0, t_ns=None):
+    if not tracing():
+        return
+    if t_ns is None:
+        t_ns = time.monotonic_ns()
+    with _state["lock"]:
+        q = _state["events"]
+        if len(q) == q.maxlen:
+            _state["dropped"] += 1
+        q.append((int(t_ns), str(op), int(phase), int(nbytes)))
+
+
+@contextmanager
+def py_op(op, nbytes=0):
+    """Bracket one op invocation with begin/end events (no-op unless
+    trace mode is on)."""
+    if not tracing():
+        yield
+        return
+    record(op, PHASE_BEGIN, nbytes)
+    try:
+        yield
+    finally:
+        record(op, PHASE_END, nbytes)
+
+
+def drain():
+    """Consume and return every recorded row ([t_ns, op, phase,
+    nbytes], oldest first)."""
+    with _state["lock"]:
+        rows = [list(r) for r in _state["events"]]
+        _state["events"].clear()
+        return rows
+
+
+def dropped():
+    with _state["lock"]:
+        return _state["dropped"]

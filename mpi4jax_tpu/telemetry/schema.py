@@ -1,0 +1,330 @@
+"""Telemetry wire schema: the single Python mirror of native/src/telemetry.h.
+
+Three layouts live here (docs/observability.md "event schema"):
+
+* the 32-byte packed native event record (``EVENT_STRUCT``, drained via
+  ``t4j_telemetry_drain`` / ``t4j_telemetry_peek_last``),
+* the u64-word metrics snapshot (``parse_snapshot``, from
+  ``t4j_metrics_snapshot``),
+* the per-rank JSON file every rank drains at exit
+  (``rank<k>.t4j.json``, ``validate_rank_file``) and the merged Chrome/
+  Perfetto trace (``job.trace.json``, ``validate_trace``).
+
+This module is deliberately import-free of jax (stdlib only), like
+analysis/contracts.py: its tests and the CI telemetry lane run on every
+container, including old-jax ones where the package itself cannot
+import.  Bump ``SCHEMA_VERSION`` in lockstep with
+``tel::kSchemaVersion``.
+"""
+
+import json
+import struct
+from collections import namedtuple
+
+SCHEMA_VERSION = 1
+RANK_FILE_SCHEMA = f"t4j-telemetry-v{SCHEMA_VERSION}"
+
+# t_ns, kind, phase, plane, comm, peer, lane, bytes  (telemetry.h Event)
+EVENT_STRUCT = struct.Struct("<QHBBiiIQ")
+assert EVENT_STRUCT.size == 32, "event layout drifted from telemetry.h"
+
+Event = namedtuple(
+    "Event", ["t_ns", "kind", "phase", "plane", "comm", "peer", "lane",
+              "bytes"]
+)
+
+# Stable wire ids (telemetry.h Kind).
+KIND_NAMES = {
+    1: "send",
+    2: "recv",
+    3: "sendrecv",
+    4: "barrier",
+    5: "bcast",
+    6: "reduce",
+    7: "allreduce",
+    8: "reduce_scatter",
+    9: "scan",
+    10: "allgather",
+    11: "gather",
+    12: "scatter",
+    13: "alltoall",
+    14: "hier_allreduce",
+    20: "frame_tx",
+    21: "frame_rx",
+    30: "link_break",
+    31: "reconnect",
+    32: "replay",
+    33: "link_dead",
+    34: "fault",
+    40: "shm_stage",
+    41: "shm_fold",
+}
+KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
+
+# Op-level kinds: the ones that appear as begin/end pairs and as
+# metrics-table rows.
+OP_KINDS = frozenset(range(1, 15))
+CONTROL_KINDS = frozenset((30, 31, 32, 33, 34))
+
+PHASE_INSTANT, PHASE_BEGIN, PHASE_END = 0, 1, 2
+PHASE_NAMES = {0: "instant", 1: "begin", 2: "end"}
+
+PLANE_NAMES = {
+    0: "none",
+    1: "tree",
+    2: "ring",
+    3: "hier",
+    4: "shm",
+    5: "ctrl",
+}
+
+SNAP_HEADER_WORDS = 8
+
+
+class SchemaError(ValueError):
+    """A telemetry artifact does not match the documented schema."""
+
+
+def kind_name(kind):
+    return KIND_NAMES.get(int(kind), f"kind{int(kind)}")
+
+
+def plane_name(plane):
+    return PLANE_NAMES.get(int(plane), f"plane{int(plane)}")
+
+
+def decode_events(buf):
+    """Packed native drain buffer -> list of :class:`Event` (ring
+    order, oldest first)."""
+    if len(buf) % EVENT_STRUCT.size:
+        raise SchemaError(
+            f"drain buffer of {len(buf)} bytes is not a whole number of "
+            f"{EVENT_STRUCT.size}-byte events"
+        )
+    return [Event(*f) for f in EVENT_STRUCT.iter_unpack(bytes(buf))]
+
+
+def encode_events(events):
+    """Inverse of :func:`decode_events` (tests, synthetic fixtures)."""
+    return b"".join(EVENT_STRUCT.pack(*e) for e in events)
+
+
+def event_to_list(e):
+    """JSON-friendly row for the per-rank file (schema: 8-element list
+    in EVENT_STRUCT field order)."""
+    return [e.t_ns, e.kind, e.phase, e.plane, e.comm, e.peer, e.lane,
+            e.bytes]
+
+
+def event_from_list(row):
+    if len(row) != 8:
+        raise SchemaError(f"event row has {len(row)} fields, want 8")
+    return Event(*row)
+
+
+def parse_snapshot(words):
+    """u64-word metrics snapshot (t4j_metrics_snapshot) -> dict.
+
+    Returns ``{"version", "mode", "lat_base_log2", "size_base_log2",
+    "rows": [{comm, kind, plane, count, bytes, sum_ns, min_ns, max_ns,
+    lat: [...], size: [...]}, ...]}``.
+    """
+    words = list(words)
+    if len(words) < SNAP_HEADER_WORDS:
+        raise SchemaError("metrics snapshot shorter than its header")
+    (version, n_rows, row_words, lat_buckets, lat_base, size_buckets,
+     size_base, mode) = words[:SNAP_HEADER_WORDS]
+    if version != SCHEMA_VERSION:
+        raise SchemaError(
+            f"metrics snapshot version {version} != {SCHEMA_VERSION}"
+        )
+    want = SNAP_HEADER_WORDS + n_rows * row_words
+    if len(words) < want:
+        raise SchemaError(
+            f"metrics snapshot truncated: {len(words)} words < {want}"
+        )
+    if row_words != 8 + lat_buckets + size_buckets:
+        raise SchemaError("metrics snapshot row shape is inconsistent")
+    rows = []
+    off = SNAP_HEADER_WORDS
+    for _ in range(n_rows):
+        r = words[off:off + row_words]
+        rows.append({
+            "comm": int(r[0]),
+            "kind": int(r[1]),
+            "plane": int(r[2]),
+            "count": int(r[3]),
+            "bytes": int(r[4]),
+            "sum_ns": int(r[5]),
+            "min_ns": int(r[6]),
+            "max_ns": int(r[7]),
+            "lat": [int(v) for v in r[8:8 + lat_buckets]],
+            "size": [int(v) for v in r[8 + lat_buckets:row_words]],
+        })
+        off += row_words
+    return {
+        "version": int(version),
+        "mode": int(mode),
+        "lat_base_log2": int(lat_base),
+        "size_base_log2": int(size_base),
+        "rows": rows,
+    }
+
+
+# ---- per-rank file -------------------------------------------------------
+
+_RANK_REQUIRED = ("schema", "rank", "world", "mode", "anchor", "dropped",
+                  "events", "py_events", "metrics")
+
+
+def validate_rank_file(obj):
+    """Raise :class:`SchemaError` unless ``obj`` is a well-formed
+    per-rank telemetry file; returns ``obj``."""
+    if not isinstance(obj, dict):
+        raise SchemaError("rank file is not a JSON object")
+    for key in _RANK_REQUIRED:
+        if key not in obj:
+            raise SchemaError(f"rank file is missing {key!r}")
+    if obj["schema"] != RANK_FILE_SCHEMA:
+        raise SchemaError(
+            f"rank file schema {obj['schema']!r} != {RANK_FILE_SCHEMA!r}"
+        )
+    anchor = obj["anchor"]
+    if (not isinstance(anchor, dict) or "mono_ns" not in anchor
+            or "unix_ns" not in anchor):
+        raise SchemaError("rank file anchor must carry mono_ns + unix_ns")
+    if not 0 <= int(obj["rank"]) < int(obj["world"]):
+        raise SchemaError(
+            f"rank {obj['rank']} out of range for world {obj['world']}"
+        )
+    for row in obj["events"]:
+        event_from_list(row)
+    for row in obj["py_events"]:
+        if len(row) != 4:
+            raise SchemaError(
+                f"py_event row has {len(row)} fields, want "
+                "[t_ns, op, phase, bytes]"
+            )
+    return obj
+
+
+def load_rank_file(path):
+    with open(path) as f:
+        return validate_rank_file(json.load(f))
+
+
+# ---- merged Chrome/Perfetto trace ---------------------------------------
+
+_TRACE_PHASES = frozenset("BEiM")
+
+
+def validate_trace(obj):
+    """Raise :class:`SchemaError` unless ``obj`` is a schema-valid
+    merged trace (chrome://tracing / Perfetto "JSON object format"):
+
+    * ``traceEvents`` list where every event carries name/ph/pid/tid
+      (+ a numeric ``ts`` for non-metadata phases), ``ph`` one of
+      B/E/i/M;
+    * begin/end events balance per (pid, tid) with LIFO name matching
+      (Perfetto rejects crossed or dangling duration events);
+    * every pid carries a ``process_name`` metadata event.
+
+    Returns ``obj``.
+    """
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        raise SchemaError("trace is not an object with traceEvents")
+    events = obj["traceEvents"]
+    if not isinstance(events, list):
+        raise SchemaError("traceEvents is not a list")
+    named_pids = set()
+    pids = set()
+    stacks = {}
+    for i, e in enumerate(events):
+        if not isinstance(e, dict):
+            raise SchemaError(f"traceEvents[{i}] is not an object")
+        for key in ("name", "ph", "pid", "tid"):
+            if key not in e:
+                raise SchemaError(f"traceEvents[{i}] is missing {key!r}")
+        ph = e["ph"]
+        if ph not in _TRACE_PHASES:
+            raise SchemaError(
+                f"traceEvents[{i}] has unsupported phase {ph!r}"
+            )
+        if ph == "M":
+            if e["name"] == "process_name":
+                named_pids.add(e["pid"])
+            continue
+        pids.add(e["pid"])
+        if not isinstance(e.get("ts"), (int, float)):
+            raise SchemaError(f"traceEvents[{i}] has no numeric ts")
+    # LIFO begin/end balance per (pid, tid), in list order: the merger
+    # emits each lane in ring order (time order), and sorting by the
+    # microsecond-rounded ts would mis-order zero-length spans
+    for e in events:
+        if e["ph"] not in "BE":
+            continue
+        stack = stacks.setdefault((e["pid"], e["tid"]), [])
+        if e["ph"] == "B":
+            stack.append(e["name"])
+        else:
+            if not stack or stack[-1] != e["name"]:
+                raise SchemaError(
+                    f"unbalanced duration events on pid={e['pid']} "
+                    f"tid={e['tid']}: E {e['name']!r} does not close "
+                    f"{stack[-1] if stack else 'anything'!r}"
+                )
+            stack.pop()
+    for key, stack in stacks.items():
+        if stack:
+            raise SchemaError(
+                f"dangling begin event(s) {stack!r} on pid/tid {key}"
+            )
+    missing = pids - named_pids
+    if missing:
+        raise SchemaError(
+            f"pid(s) {sorted(missing)} carry events but no process_name "
+            "metadata"
+        )
+    return obj
+
+
+def load_trace(path):
+    with open(path) as f:
+        return validate_trace(json.load(f))
+
+
+def check_begin_end_balance(events):
+    """Problems list for a drained native event sequence: every op
+    begin must be closed by a matching end on the same thread lane
+    (LIFO per lane), and timestamps must be monotone in ring order per
+    lane.  Empty list = clean.  (The tests/proc 2-rank job asserts
+    this on real drains.)"""
+    problems = []
+    stacks = {}
+    last_t = {}
+    for e in events:
+        if e.t_ns < last_t.get(e.lane, 0):
+            problems.append(
+                f"lane {e.lane}: timestamp went backwards at "
+                f"{kind_name(e.kind)} ({e.t_ns} < {last_t[e.lane]})"
+            )
+        last_t[e.lane] = e.t_ns
+        if e.kind not in OP_KINDS:
+            continue
+        stack = stacks.setdefault(e.lane, [])
+        if e.phase == PHASE_BEGIN:
+            stack.append(e.kind)
+        elif e.phase == PHASE_END:
+            if not stack or stack[-1] != e.kind:
+                problems.append(
+                    f"lane {e.lane}: end {kind_name(e.kind)} closes "
+                    + (kind_name(stack[-1]) if stack else "nothing")
+                )
+            else:
+                stack.pop()
+    for lane, stack in stacks.items():
+        for kind in stack:
+            problems.append(
+                f"lane {lane}: begin {kind_name(kind)} never ended"
+            )
+    return problems

@@ -1,0 +1,226 @@
+"""``t4j-top``: render a job's comm telemetry as console tables.
+
+    t4j-top DIR            # a --telemetry directory of rank<k>.t4j.json
+    t4j-top rank0.t4j.json # one rank
+    t4j-top DIR --follow 2 # live: re-render every 2s while a job runs
+    t4j-top DIR --json     # machine-readable summary
+
+Retrospective or live (``--follow`` re-reads the directory each tick —
+ranks rewrite their files at exit, and long-running jobs can call
+``mpi4jax_tpu.telemetry.dump.write_rank_file`` periodically), showing:
+
+* per-op latency percentiles (p50/p99 from the metrics histograms) and
+  byte totals per data plane — the measured per-comm x size numbers
+  trace-guided autotuning consumes;
+* per-link throughput (from trace-mode frame events) plus the
+  self-healing reconnect/replay counters per link — the worst-link
+  signal serving admission control keys on;
+* per-rank totals (events, drops, faults).
+
+Console-script twin of ``t4j-lint`` (pyproject.toml); import-free of
+jax so it runs anywhere the files do.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+from . import schema
+from .registry import MetricsRegistry
+from .trace import RANK_FILE_GLOB
+
+
+def load_rank_objs(path):
+    """Path (dir of rank files, or one rank file) -> list of validated
+    rank objects."""
+    p = pathlib.Path(path)
+    if p.is_dir():
+        files = sorted(p.glob(RANK_FILE_GLOB))
+        if not files:
+            raise FileNotFoundError(f"no {RANK_FILE_GLOB} files in {p}")
+        return [schema.load_rank_file(f) for f in files]
+    return [schema.load_rank_file(p)]
+
+
+def _fmt_ms(v):
+    return "-" if v is None else f"{v:9.3f}"
+
+
+def _fmt_bytes(v):
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if v < 1024 or unit == "TB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024.0
+    return f"{v:.1f}TB"
+
+
+def summarize(rank_objs):
+    """The data model behind both renderings (table and --json)."""
+    reg = MetricsRegistry()
+    per_rank = []
+    links = {}
+    for obj in rank_objs:
+        reg.merge(MetricsRegistry.from_snapshot(obj["metrics"]))
+        rank = int(obj["rank"])
+        events = [schema.event_from_list(r) for r in obj["events"]]
+        faults = sum(1 for e in events
+                     if e.kind == schema.KIND_IDS["fault"])
+        t_lo = min((e.t_ns for e in events), default=0)
+        t_hi = max((e.t_ns for e in events), default=0)
+        span_s = (t_hi - t_lo) / 1e9 if t_hi > t_lo else 0.0
+        for e in events:
+            if e.kind == schema.KIND_IDS["frame_tx"] and e.peer >= 0:
+                link = links.setdefault(
+                    (rank, e.peer),
+                    {"bytes": 0, "frames": 0, "t_lo": e.t_ns,
+                     "t_hi": e.t_ns},
+                )
+                link["bytes"] += e.bytes
+                link["frames"] += 1
+                link["t_lo"] = min(link["t_lo"], e.t_ns)
+                link["t_hi"] = max(link["t_hi"], e.t_ns)
+        per_peer = (obj.get("link_stats") or {}).get("per_peer", {})
+        for peer, s in per_peer.items():
+            link = links.setdefault(
+                (rank, int(peer)),
+                {"bytes": 0, "frames": 0, "t_lo": 0, "t_hi": 0},
+            )
+            link.update(
+                reconnects=s.get("reconnects", 0),
+                replayed_frames=s.get("replayed_frames", 0),
+                replayed_bytes=s.get("replayed_bytes", 0),
+                state=s.get("state", 0),
+            )
+        per_rank.append({
+            "rank": rank,
+            "mode": obj["mode"],
+            "events": len(events),
+            "py_events": len(obj["py_events"]),
+            "dropped": int(obj.get("dropped", 0)),
+            "faults": faults,
+            "span_s": span_s,
+            "reconnects": ((obj.get("link_stats") or {})
+                           .get("aggregate") or {}).get("reconnects", 0),
+        })
+    ops = []
+    for op in reg.ops():
+        for plane in sorted({p for (_c, o, p) in reg.rows if o == op}):
+            row = reg.aggregate(op=op, plane=plane)
+            stats = row.stats()
+            stats.update(op=op, plane=plane)
+            ops.append(stats)
+    link_rows = []
+    for (rank, peer), link in sorted(links.items()):
+        span = (link["t_hi"] - link["t_lo"]) / 1e9
+        link_rows.append({
+            "rank": rank,
+            "peer": peer,
+            "bytes": link["bytes"],
+            "frames": link["frames"],
+            "gbps": link["bytes"] / span / 1e9 if span > 0 else None,
+            "reconnects": link.get("reconnects", 0),
+            "replayed_frames": link.get("replayed_frames", 0),
+            "state": link.get("state", 0),
+        })
+    return {
+        "ranks": per_rank,
+        "ops": ops,
+        "links": link_rows,
+        "bytes_by_plane": reg.bytes_by_plane(),
+    }
+
+
+_STATE_NAMES = {0: "up", 1: "broken", 2: "dead"}
+
+
+def render(summary):
+    out = []
+    ranks = summary["ranks"]
+    out.append(
+        f"t4j-top — {len(ranks)} rank(s), "
+        f"{sum(r['events'] for r in ranks)} native event(s), "
+        f"{sum(r['dropped'] for r in ranks)} dropped"
+    )
+    plane = summary["bytes_by_plane"]
+    if plane:
+        out.append("  plane bytes: " + "  ".join(
+            f"{k}={_fmt_bytes(v)}" for k, v in sorted(plane.items())
+        ))
+    if summary["ops"]:
+        out.append("")
+        out.append(f"  {'op':<16}{'plane':<7}{'count':>8}{'bytes':>10}"
+                   f"{'p50 ms':>10}{'p99 ms':>10}{'max ms':>10}")
+        for s in summary["ops"]:
+            out.append(
+                f"  {s['op']:<16}{s['plane']:<7}{s['count']:>8}"
+                f"{_fmt_bytes(s['bytes']):>10}"
+                f" {_fmt_ms(s['p50_ms'])}{_fmt_ms(s['p99_ms'])}"
+                f"{_fmt_ms(s['max_ms'])}"
+            )
+    if summary["links"]:
+        out.append("")
+        out.append(f"  {'link':<12}{'bytes':>10}{'frames':>8}"
+                   f"{'GB/s':>8}{'reconn':>8}{'replay':>8}{'state':>8}")
+        for link in summary["links"]:
+            gbps = ("-" if link["gbps"] is None
+                    else f"{link['gbps']:.3f}")
+            out.append(
+                f"  r{link['rank']}->r{link['peer']:<8}"
+                f"{_fmt_bytes(link['bytes']):>10}{link['frames']:>8}"
+                f"{gbps:>8}{link['reconnects']:>8}"
+                f"{link['replayed_frames']:>8}"
+                f"{_STATE_NAMES.get(link['state'], '?'):>8}"
+            )
+    if summary["ranks"]:
+        out.append("")
+        out.append(f"  {'rank':<6}{'mode':<10}{'events':>8}{'py':>6}"
+                   f"{'dropped':>9}{'reconn':>8}{'faults':>8}"
+                   f"{'span s':>9}")
+        for r in summary["ranks"]:
+            out.append(
+                f"  r{r['rank']:<5}{r['mode']:<10}{r['events']:>8}"
+                f"{r['py_events']:>6}{r['dropped']:>9}"
+                f"{r['reconnects']:>8}{r['faults']:>8}"
+                f"{r['span_s']:>9.2f}"
+            )
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="t4j-top",
+        description="render mpi4jax_tpu comm telemetry "
+                    "(docs/observability.md)",
+    )
+    ap.add_argument("path", help="--telemetry directory or one "
+                                 "rank<k>.t4j.json")
+    ap.add_argument("--follow", type=float, default=None, metavar="SECS",
+                    help="live mode: re-read and re-render every SECS")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable summary instead of "
+                         "tables")
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            summary = summarize(load_rank_objs(args.path))
+        except FileNotFoundError as e:
+            if args.follow is None:
+                print(f"t4j-top: {e}", file=sys.stderr)
+                return 2
+            summary = None
+        if summary is not None:
+            if args.json:
+                print(json.dumps(summary))
+            else:
+                if args.follow is not None:
+                    print("\x1b[2J\x1b[H", end="")
+                print(render(summary), flush=True)
+        if args.follow is None:
+            return 0
+        time.sleep(args.follow)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,188 @@
+"""Chrome-trace / Perfetto exporter and cross-rank merger.
+
+Turns per-rank telemetry files (``rank<k>.t4j.json``, written by
+telemetry/dump.py or the standalone smoke workers) into one Chrome
+"JSON object format" trace — loadable in Perfetto / chrome://tracing —
+with every rank on one aligned timeline:
+
+* one *process* (pid) per rank, named ``rank <k>``;
+* per rank, thread 0 is the ``python`` lane (the op-layer begin/end
+  recorder) and threads 1..n are the native lanes (one per native
+  thread that emitted events: the op thread, reader threads, repair
+  dialers);
+* op begin/end pairs become nested B/E duration slices, everything
+  else (wire frames, arena stages, link break/reconnect/replay/fault)
+  becomes thread-scoped instants with the payload in ``args``.
+
+Clock alignment (docs/observability.md "clock alignment"): every
+rank's anchor is a (monotonic, realtime) pair captured immediately
+after the SAME bootstrap join barrier, so the merger places each event
+at ``(t_ns - anchor_mono_r) / 1000`` µs on a job-relative timeline —
+ranks align up to barrier-exit skew, immune to wall-clock
+disagreement.  The earliest anchor's realtime is recorded in
+``otherData.job_epoch_unix_ns`` so absolute times are recoverable.
+
+Import-free of jax (stdlib only).
+"""
+
+import json
+import pathlib
+
+from . import schema
+
+RANK_FILE_GLOB = "rank*.t4j.json"
+MERGED_NAME = "job.trace.json"
+
+
+def _lane_tids(rank_obj):
+    """Stable tid assignment: 0 = python lane, then native lanes by
+    first appearance in ring order."""
+    tids = {}
+    for row in rank_obj["events"]:
+        lane = schema.event_from_list(row).lane
+        if lane not in tids:
+            tids[lane] = len(tids) + 1
+    return tids
+
+
+def rank_to_chrome_events(rank_obj):
+    """One validated rank file -> list of Chrome trace events (pid =
+    rank).  Dangling op begins (a rank that died mid-op, or a drain
+    that raced an in-flight op) are closed at the rank's last seen
+    timestamp so the merged trace stays schema-valid — the post-mortem
+    case is exactly when those spans matter most."""
+    rank = int(rank_obj["rank"])
+    anchor_mono = int(rank_obj["anchor"]["mono_ns"])
+    out = [
+        {"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+         "args": {"name": f"rank {rank}"}},
+        {"name": "process_sort_index", "ph": "M", "pid": rank, "tid": 0,
+         "args": {"sort_index": rank}},
+        {"name": "thread_name", "ph": "M", "pid": rank, "tid": 0,
+         "args": {"name": "python"}},
+    ]
+    tids = _lane_tids(rank_obj)
+    for lane, tid in tids.items():
+        out.append({
+            "name": "thread_name", "ph": "M", "pid": rank, "tid": tid,
+            "args": {"name": f"native-{tid}" if tid > 1 else "native"},
+        })
+
+    def ts_us(t_ns):
+        return (int(t_ns) - anchor_mono) / 1000.0
+
+    last_ts = 0.0
+    open_spans = {}  # tid -> [name, ...]
+    for row in rank_obj["events"]:
+        e = schema.event_from_list(row)
+        tid = tids[e.lane]
+        name = schema.kind_name(e.kind)
+        ts = ts_us(e.t_ns)
+        last_ts = max(last_ts, ts)
+        args = {
+            "plane": schema.plane_name(e.plane),
+            "comm": e.comm,
+            "peer": e.peer,
+            "bytes": e.bytes,
+        }
+        if e.kind in schema.OP_KINDS and e.phase == schema.PHASE_BEGIN:
+            open_spans.setdefault(tid, []).append(name)
+            out.append({"name": name, "ph": "B", "ts": ts, "pid": rank,
+                        "tid": tid, "args": args})
+        elif e.kind in schema.OP_KINDS and e.phase == schema.PHASE_END:
+            stack = open_spans.get(tid, [])
+            if stack and stack[-1] == name:
+                stack.pop()
+                out.append({"name": name, "ph": "E", "ts": ts,
+                            "pid": rank, "tid": tid, "args": args})
+            # an end with no open begin: the begin was lapped out of
+            # the bounded ring — drop it rather than emit an
+            # unbalanced E
+        else:
+            out.append({"name": name, "ph": "i", "ts": ts, "s": "t",
+                        "pid": rank, "tid": tid, "args": args})
+    # py events extend the rank's last-seen instant too: a rank that
+    # died inside Python-side staging (no native event for the op yet)
+    # must not get its truncated end placed BEFORE its begin
+    for t_ns, _op, _phase, _nbytes in rank_obj["py_events"]:
+        last_ts = max(last_ts, ts_us(t_ns))
+    # close spans cut off by death/drain at the last seen instant
+    for tid, stack in open_spans.items():
+        while stack:
+            name = stack.pop()
+            out.append({"name": name, "ph": "E", "ts": last_ts,
+                        "pid": rank, "tid": tid,
+                        "args": {"truncated": True}})
+    # python lane: same discipline as the native lanes — an end whose
+    # begin is missing (dropped from the bounded recorder deque, or
+    # crossed by another thread's bracket interleaving on this shared
+    # lane) is SKIPPED rather than emitted unbalanced, and begins cut
+    # off by death are closed at the rank's last seen instant; one
+    # dangling slice must not make validate_trace reject the whole
+    # merged job.trace.json.
+    py_stack = []
+    for t_ns, op, phase, nbytes in rank_obj["py_events"]:
+        ts = ts_us(t_ns)
+        name = f"py:{op}"
+        if phase == schema.PHASE_BEGIN:
+            py_stack.append(name)
+            out.append({"name": name, "ph": "B", "ts": ts,
+                        "pid": rank, "tid": 0,
+                        "args": {"bytes": nbytes}})
+        elif phase == schema.PHASE_END:
+            if py_stack and py_stack[-1] == name:
+                py_stack.pop()
+                out.append({"name": name, "ph": "E", "ts": ts,
+                            "pid": rank, "tid": 0,
+                            "args": {"bytes": nbytes}})
+            # else: begin lost to the bounded deque — drop the end
+        else:
+            out.append({"name": name, "ph": "i", "ts": ts,
+                        "s": "t", "pid": rank, "tid": 0,
+                        "args": {"bytes": nbytes}})
+    for name in reversed(py_stack):
+        out.append({"name": name, "ph": "E", "ts": last_ts, "pid": rank,
+                    "tid": 0, "args": {"truncated": True}})
+    return out
+
+
+def merge_rank_objs(rank_objs, job=None):
+    """Validated rank files -> one schema-valid merged trace dict."""
+    rank_objs = sorted(rank_objs, key=lambda o: int(o["rank"]))
+    events = []
+    for obj in rank_objs:
+        schema.validate_rank_file(obj)
+        events.extend(rank_to_chrome_events(obj))
+    epoch = min(
+        (int(o["anchor"]["unix_ns"]) for o in rank_objs), default=0
+    )
+    trace = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "schema": schema.RANK_FILE_SCHEMA,
+            "job": job or "",
+            "ranks": len(rank_objs),
+            "job_epoch_unix_ns": epoch,
+            "dropped_events": sum(
+                int(o.get("dropped", 0)) for o in rank_objs
+            ),
+        },
+    }
+    return schema.validate_trace(trace)
+
+
+def merge_dir(dir_path, out_name=MERGED_NAME, job=None):
+    """Merge every per-rank file in ``dir_path`` into
+    ``dir_path/out_name``; returns the output path.  Raises
+    FileNotFoundError when no rank files exist."""
+    d = pathlib.Path(dir_path)
+    paths = sorted(d.glob(RANK_FILE_GLOB))
+    if not paths:
+        raise FileNotFoundError(f"no {RANK_FILE_GLOB} files in {d}")
+    objs = [schema.load_rank_file(p) for p in paths]
+    trace = merge_rank_objs(objs, job=job)
+    out = d / out_name
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    return out

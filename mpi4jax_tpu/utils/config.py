@@ -62,6 +62,23 @@ communicators with multiple ranks per host (docs/performance.md
                                   hierarchical path is taken (default
                                   256 KiB, the measured crossover).
 
+Telemetry (docs/observability.md):
+
+* ``T4J_TELEMETRY``       — ``off`` (default: zero-cost no-op),
+                            ``counters`` (metrics table + control-plane
+                            events), ``trace`` (plus per-event records
+                            for ops / wire segments / arena stages —
+                            the Perfetto timeline feed).
+* ``T4J_TELEMETRY_BYTES`` — per-rank event-ring capacity (default 1M =
+                            32Ki events; writers lapping the drain
+                            cursor drop the oldest, never block).
+* ``T4J_TELEMETRY_DIR``   — when set, every rank drains its ring and
+                            metrics snapshot into
+                            ``<dir>/rank<k>.t4j.json`` at exit (the
+                            launcher's ``--telemetry DIR`` sets it and
+                            merges the files into one Perfetto
+                            ``job.trace.json``).
+
 The byte knobs accept an optional K/M/G suffix
 (``T4J_SEG_BYTES=256K``) and all of them must be uniform across ranks
 — the launcher propagates the env, and ranks disagreeing on a
@@ -94,6 +111,9 @@ __all__ = [
     "backoff_max",
     "replay_bytes",
     "verify_mode",
+    "telemetry_mode",
+    "telemetry_bytes",
+    "telemetry_dir",
 ]
 
 _TRUE = {"1", "true", "on", "yes"}
@@ -341,6 +361,59 @@ def verify_mode():
             "(want off|fingerprint|full)"
         )
     return v
+
+
+_TELEMETRY_MODES = ("off", "counters", "trace")
+
+
+def telemetry_mode():
+    """Comm-telemetry mode (docs/observability.md):
+
+    * ``off`` (default) — zero-cost no-op: every instrumented native
+      site is one relaxed atomic load + compare.
+    * ``counters`` — the per comm x op x plane metrics table (counts,
+      bytes, latency/size histograms -> p50/p99) plus the rare
+      control-plane events (link break / reconnect / replay / fault).
+    * ``trace`` — counters plus per-event records for ops, wire frames
+      and shm arena stages: the Perfetto timeline feed.
+
+    Anything else raises — a typo'd mode must fail at launch, not
+    silently record nothing."""
+    v = os.environ.get("T4J_TELEMETRY")
+    if v is None or not str(v).strip():
+        return "off"
+    v = str(v).strip().lower()
+    if v not in _TELEMETRY_MODES:
+        raise ValueError(
+            f"cannot interpret T4J_TELEMETRY={v!r} "
+            "(want off|counters|trace)"
+        )
+    return v
+
+
+def telemetry_bytes():
+    """Per-rank telemetry event-ring capacity in bytes (default 1M =
+    32Ki 32-byte events; floor 4K).  Writers lapping the drain cursor
+    drop the oldest events (counted, never blocking); grow this for
+    long jobs drained only at exit."""
+    v = byte_count(
+        os.environ.get("T4J_TELEMETRY_BYTES"),
+        1 << 20,
+        name="T4J_TELEMETRY_BYTES",
+        minimum=4 << 10,
+    )
+    return v
+
+
+def telemetry_dir():
+    """Directory every rank drains its telemetry into at exit
+    (``<dir>/rank<k>.t4j.json``), or ``None`` when unset.  The
+    launcher's ``--telemetry DIR`` sets it for every rank and merges
+    the per-rank files into one Perfetto ``job.trace.json``."""
+    v = os.environ.get("T4J_TELEMETRY_DIR")
+    if v is None or not str(v).strip():
+        return None
+    return str(v).strip()
 
 
 def op_timeout():

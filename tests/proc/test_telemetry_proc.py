@@ -1,0 +1,164 @@
+"""Telemetry end-to-end over real launcher jobs (docs/observability.md).
+
+A 2-rank ``--telemetry DIR`` job must leave schema-valid per-rank
+files whose drained native events are monotone per lane and complete
+(every op begin closed by a matching end), plus a merged
+``job.trace.json`` that validates and carries both ranks on one
+aligned timeline; ``T4J_TELEMETRY=off`` must leave ZERO events and
+zero metrics rows (the zero-cost contract).  The 8-rank version of
+this flow (plus the off/trace overhead gate) runs in the ci_smoke
+``telemetry`` lane, tools/telemetry_smoke.py.
+"""
+
+import pathlib
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401 -- probe only
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+from mpi4jax_tpu.telemetry import dump, schema, top, trace
+
+from tests.proc.test_proc_backend import run_workers
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+WORKER = """
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+n, rank = comm.size, comm.rank()
+
+tok = m.create_token()
+x = jnp.arange(4096.0, dtype=jnp.float32) + rank
+y = x
+for _ in range(5):
+    y, tok = m.allreduce(y, m.SUM, comm=comm, token=tok)
+y, tok = m.sendrecv(
+    y, y, source=(rank - 1) % n, dest=(rank + 1) % n, comm=comm,
+    token=tok,
+)
+tok = m.barrier(comm=comm, token=tok)
+np.asarray(y)
+print("WORKER-OK", rank, flush=True)
+"""
+
+# frames must cross the wire (not the shm arena) so segment-level
+# events appear; tiny segments make every collective multi-segment
+TRACE_ENV = {
+    "T4J_NO_SHM": "1",
+    "T4J_RING_MIN_BYTES": "0",
+    "T4J_SEG_BYTES": "4096",
+}
+
+
+def _rank_objs(tel_dir, nprocs):
+    paths = sorted(pathlib.Path(tel_dir).glob("rank*.t4j.json"))
+    assert len(paths) == nprocs, (
+        f"expected {nprocs} rank files, found "
+        f"{[p.name for p in paths]}"
+    )
+    return [schema.load_rank_file(p) for p in paths]
+
+
+def test_trace_job_drains_complete_monotone_events(tmp_path):
+    tel_dir = tmp_path / "tel"
+    proc = run_workers(
+        WORKER, nprocs=2, env=TRACE_ENV,
+        launch_args=("--telemetry", str(tel_dir)),
+    )
+    assert proc.stdout.count("WORKER-OK") == 2, proc.stdout
+
+    objs = _rank_objs(tel_dir, 2)
+    for obj in objs:
+        assert obj["mode"] == "trace"
+        events = [schema.event_from_list(r) for r in obj["events"]]
+        assert events, f"rank {obj['rank']} drained zero events"
+        # monotone per lane + every begin has an end — the drain
+        # happened at exit, with no op in flight
+        problems = schema.check_begin_end_balance(events)
+        assert not problems, problems[:5]
+        op_events = [e for e in events if e.kind in schema.OP_KINDS]
+        allreduces = [e for e in op_events
+                      if schema.kind_name(e.kind) == "allreduce"
+                      and e.phase == schema.PHASE_BEGIN]
+        assert len(allreduces) >= 5, (
+            f"rank {obj['rank']}: {len(allreduces)} allreduce begins"
+        )
+        frames = [e for e in events
+                  if schema.kind_name(e.kind).startswith("frame")]
+        assert frames, "no wire-frame events on the TCP path"
+        # the metrics table counted the same ops the ring recorded
+        reg_rows = obj["metrics"]["rows"]
+        counted = {schema.kind_name(r["kind"]) for r in reg_rows}
+        assert "allreduce" in counted and "barrier" in counted
+        # python-level brackets enclose the native tier
+        py_ops = {r[1] for r in obj["py_events"]}
+        assert "allreduce" in py_ops, obj["py_events"][:4]
+
+    # the launcher merged a schema-valid trace with both ranks aligned
+    merged = pathlib.Path(tel_dir) / "job.trace.json"
+    assert merged.exists(), "launcher did not merge job.trace.json"
+    tr = schema.load_trace(merged)
+    pids = {e["pid"] for e in tr["traceEvents"] if e["ph"] != "M"}
+    assert pids == {0, 1}
+    assert tr["otherData"]["ranks"] == 2
+    # aligned timeline: the lockstep collectives overlap in job time
+    lo = {p: min(e["ts"] for e in tr["traceEvents"]
+                 if e["ph"] != "M" and e["pid"] == p) for p in pids}
+    hi = {p: max(e["ts"] for e in tr["traceEvents"]
+                 if e["ph"] != "M" and e["pid"] == p) for p in pids}
+    assert max(lo.values()) < min(hi.values()), (lo, hi)
+
+    # t4j-top renders latency percentiles from the same files
+    summary = top.summarize(objs)
+    assert any(s["op"] == "allreduce" and s["p99_ms"] is not None
+               for s in summary["ops"]), summary["ops"]
+    assert summary["links"], "no per-link rows"
+    assert "allreduce" in top.render(summary)
+
+
+def test_off_mode_leaves_zero_events(tmp_path):
+    tel_dir = tmp_path / "tel"
+    env = dict(TRACE_ENV)
+    # --telemetry defaults the mode to trace; an explicit off must win
+    # (the zero-cost contract is what the overhead gate measures)
+    env["T4J_TELEMETRY"] = "off"
+    run_workers(
+        WORKER, nprocs=2, env=env,
+        launch_args=("--telemetry", str(tel_dir)),
+    )
+    for obj in _rank_objs(tel_dir, 2):
+        assert obj["mode"] == "off"
+        assert obj["events"] == [], (
+            f"rank {obj['rank']} recorded {len(obj['events'])} "
+            "event(s) with telemetry off"
+        )
+        assert obj["py_events"] == []
+        assert obj["metrics"]["rows"] == []
+
+
+def test_merge_ignores_partial_tmp_files(tmp_path):
+    # the abort path writes rank files atomically (tmp + rename): a
+    # leftover .tmp from a killed rank must not break the merge
+    tel_dir = tmp_path / "tel"
+    run_workers(
+        WORKER, nprocs=2, env=TRACE_ENV,
+        launch_args=("--telemetry", str(tel_dir)),
+    )
+    (pathlib.Path(tel_dir) / "rank9.t4j.tmp12345").write_text("{garbage")
+    out = trace.merge_dir(tel_dir)
+    schema.load_trace(out)
+
+
+def test_rank_file_name_shape():
+    assert dump.rank_file_name(3) == "rank3.t4j.json"

@@ -234,6 +234,81 @@ class TestReplayBytes:
             config.replay_bytes()
 
 
+class TestTelemetryMode:
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("T4J_TELEMETRY", raising=False)
+        assert config.telemetry_mode() == "off"
+
+    @pytest.mark.parametrize("v,want", [
+        ("off", "off"), ("counters", "counters"), ("trace", "trace"),
+        ("TRACE", "trace"), (" counters ", "counters"),
+    ])
+    def test_values(self, monkeypatch, v, want):
+        monkeypatch.setenv("T4J_TELEMETRY", v)
+        assert config.telemetry_mode() == want
+
+    @pytest.mark.parametrize("bad", ["on", "1", "full", "events"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        # a typo'd mode must fail at launch, not silently record nothing
+        monkeypatch.setenv("T4J_TELEMETRY", bad)
+        with pytest.raises(ValueError, match="T4J_TELEMETRY"):
+            config.telemetry_mode()
+
+
+class TestTelemetryBytes:
+    def test_default_is_1m(self, monkeypatch):
+        monkeypatch.delenv("T4J_TELEMETRY_BYTES", raising=False)
+        assert config.telemetry_bytes() == 1 << 20
+
+    def test_suffix(self, monkeypatch):
+        monkeypatch.setenv("T4J_TELEMETRY_BYTES", "8M")
+        assert config.telemetry_bytes() == 8 << 20
+
+    def test_below_floor_rejected(self, monkeypatch):
+        # the ring must hold at least a few events or every drain is
+        # all drops; the native side clamps, Python rejects loudly
+        monkeypatch.setenv("T4J_TELEMETRY_BYTES", "1024")
+        with pytest.raises(ValueError, match="T4J_TELEMETRY_BYTES"):
+            config.telemetry_bytes()
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_TELEMETRY_BYTES", "plenty")
+        with pytest.raises(ValueError, match="T4J_TELEMETRY_BYTES"):
+            config.telemetry_bytes()
+
+
+class TestTelemetryDir:
+    def test_default_is_none(self, monkeypatch):
+        monkeypatch.delenv("T4J_TELEMETRY_DIR", raising=False)
+        assert config.telemetry_dir() is None
+
+    def test_empty_is_none(self, monkeypatch):
+        monkeypatch.setenv("T4J_TELEMETRY_DIR", "   ")
+        assert config.telemetry_dir() is None
+
+    def test_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_TELEMETRY_DIR", "/tmp/tel")
+        assert config.telemetry_dir() == "/tmp/tel"
+
+
+def test_ensure_initialized_rejects_bad_telemetry(monkeypatch):
+    """The telemetry knobs thread through native/runtime.py like the
+    deadlines: a bad env value aborts initialisation before any socket
+    is opened."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_TELEMETRY", "verbose")
+    with pytest.raises(ValueError, match="T4J_TELEMETRY"):
+        runtime.ensure_initialized()
+
+
 def test_ensure_initialized_rejects_bad_resilience(monkeypatch):
     """The self-healing knobs thread through native/runtime.py like the
     deadlines: a bad env value aborts initialisation before any socket

@@ -5,6 +5,14 @@ regexes against captured stdout in tests/collective_ops/test_common.py:
 Two surfaces here: XLA-profiler name scopes baked into the lowered
 module (always on), and opt-in per-call debug lines in the reference's
 ``r{rank} | {callid} | <Op> ...`` wire format.
+
+These are the reference-parity surfaces only.  The first-class
+telemetry layer that superseded them — the native event ring, metrics
+registry with p50/p99, cross-rank Perfetto timelines and ``t4j-top``
+(``T4J_TELEMETRY``, ``launch.py --telemetry``) — is documented in
+docs/observability.md and covered by tests/test_telemetry.py (pure
+core), tests/proc/test_telemetry_proc.py (2-rank end-to-end) and the
+ci_smoke ``telemetry`` lane (tools/telemetry_smoke.py).
 """
 
 import re

@@ -1,0 +1,492 @@
+"""Telemetry pure core (mpi4jax_tpu/telemetry/): schema, registry
+percentile math, recorder, exporter, merge, t4j-top summary.
+
+The package is deliberately import-free of jax (like analysis/
+contracts.py), so these tests run on every container — including
+old-jax ones where ``import mpi4jax_tpu`` raises at the version gate:
+the loader below registers a lightweight package stub and imports the
+real subpackage under it (the tools/telemetry_smoke.py pattern).
+
+The native half (the event ring, drains, metrics snapshot) is covered
+end-to-end by tests/proc/test_telemetry_proc.py and the ci_smoke
+``telemetry`` lane (tools/telemetry_smoke.py).
+"""
+
+import importlib
+import pathlib
+import sys
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_telemetry():
+    try:
+        import mpi4jax_tpu.telemetry as tele
+
+        return tele
+    except Exception:
+        # stub the parent just long enough to import the jax-free
+        # subpackage, then REMOVE it: a lingering attribute-less stub
+        # would satisfy `import mpi4jax_tpu` in later-collected test
+        # modules and turn their clean version-gate collection error
+        # into per-test AttributeErrors (changing the tier-1 failure
+        # set).  The telemetry submodules stay in sys.modules, so the
+        # module-level imports below still resolve.
+        stubbed = "mpi4jax_tpu" not in sys.modules
+        if stubbed:
+            stub = types.ModuleType("mpi4jax_tpu")
+            stub.__path__ = [str(REPO / "mpi4jax_tpu")]
+            sys.modules["mpi4jax_tpu"] = stub
+        try:
+            return importlib.import_module("mpi4jax_tpu.telemetry")
+        finally:
+            if stubbed:
+                sys.modules.pop("mpi4jax_tpu", None)
+
+
+tele = _load_telemetry()
+schema = tele.schema
+registry = importlib.import_module(tele.__name__ + ".registry")
+recorder = importlib.import_module(tele.__name__ + ".recorder")
+trace = importlib.import_module(tele.__name__ + ".trace")
+dump = importlib.import_module(tele.__name__ + ".dump")
+top = importlib.import_module(tele.__name__ + ".top")
+
+
+# ---- schema --------------------------------------------------------------
+
+
+class TestEventCodec:
+    def test_struct_is_32_bytes(self):
+        assert schema.EVENT_STRUCT.size == 32
+
+    def test_roundtrip(self):
+        events = [
+            schema.Event(1000, 7, 1, 2, 0, -1, 42, 4096),
+            schema.Event(2000, 7, 2, 2, 0, -1, 42, 4096),
+            schema.Event(1500, 20, 0, 0, -1, 3, 7, 8192),
+        ]
+        buf = schema.encode_events(events)
+        assert len(buf) == 96
+        assert schema.decode_events(buf) == events
+
+    def test_rejects_torn_buffer(self):
+        with pytest.raises(schema.SchemaError, match="whole number"):
+            schema.decode_events(b"\x00" * 33)
+
+    def test_kind_names_are_stable(self):
+        # wire ids are frozen (telemetry.h Kind): renumbering breaks
+        # every stored trace
+        assert schema.KIND_NAMES[7] == "allreduce"
+        assert schema.KIND_NAMES[20] == "frame_tx"
+        assert schema.KIND_NAMES[31] == "reconnect"
+        assert schema.KIND_IDS["shm_stage"] == 40
+        assert 7 in schema.OP_KINDS and 20 not in schema.OP_KINDS
+
+
+class TestBeginEndBalance:
+    def _ev(self, t, kind, phase, lane=1):
+        return schema.Event(t, kind, phase, 0, 0, -1, lane, 0)
+
+    def test_clean_stream(self):
+        events = [
+            self._ev(1, 7, schema.PHASE_BEGIN),
+            self._ev(2, 6, schema.PHASE_BEGIN),  # nested (tree path)
+            self._ev(3, 6, schema.PHASE_END),
+            self._ev(4, 7, schema.PHASE_END),
+            self._ev(5, 20, schema.PHASE_INSTANT),
+        ]
+        assert schema.check_begin_end_balance(events) == []
+
+    def test_unclosed_begin(self):
+        events = [self._ev(1, 7, schema.PHASE_BEGIN)]
+        problems = schema.check_begin_end_balance(events)
+        assert problems and "never ended" in problems[0]
+
+    def test_crossed_pairs(self):
+        events = [
+            self._ev(1, 7, schema.PHASE_BEGIN),
+            self._ev(2, 6, schema.PHASE_BEGIN),
+            self._ev(3, 7, schema.PHASE_END),  # closes the wrong op
+        ]
+        assert schema.check_begin_end_balance(events)
+
+    def test_nonmonotone_lane(self):
+        events = [
+            self._ev(10, 20, schema.PHASE_INSTANT),
+            self._ev(5, 20, schema.PHASE_INSTANT),
+        ]
+        problems = schema.check_begin_end_balance(events)
+        assert problems and "backwards" in problems[0]
+
+    def test_lanes_are_independent(self):
+        events = [
+            self._ev(10, 20, schema.PHASE_INSTANT, lane=1),
+            self._ev(5, 20, schema.PHASE_INSTANT, lane=2),  # other lane
+        ]
+        assert schema.check_begin_end_balance(events) == []
+
+
+def make_snapshot_words(rows, lat_n=24, lat_base=10, size_n=20,
+                        size_base=6, mode=1):
+    """Synthetic native snapshot: rows = [(comm, kind, plane, count,
+    nbytes, sum_ns, min_ns, max_ns, lat_list, size_list)]."""
+    words = [schema.SCHEMA_VERSION, len(rows), 8 + lat_n + size_n,
+             lat_n, lat_base, size_n, size_base, mode]
+    for r in rows:
+        words.extend(r[:8])
+        lat = list(r[8]) + [0] * (lat_n - len(r[8]))
+        size = list(r[9]) + [0] * (size_n - len(r[9]))
+        words.extend(lat)
+        words.extend(size)
+    return words
+
+
+class TestSnapshotParse:
+    def test_roundtrip(self):
+        words = make_snapshot_words([
+            (0, 7, 2, 5, 4096 * 5, 50_000_000, 8_000_000, 15_000_000,
+             [0, 0, 0, 5], [0, 0, 5]),
+        ])
+        snap = schema.parse_snapshot(words)
+        assert snap["version"] == schema.SCHEMA_VERSION
+        (row,) = snap["rows"]
+        assert row["kind"] == 7 and row["plane"] == 2
+        assert row["count"] == 5 and sum(row["lat"]) == 5
+
+    def test_truncated_raises(self):
+        words = make_snapshot_words([
+            (0, 7, 2, 1, 1, 1, 1, 1, [1], [1]),
+        ])
+        with pytest.raises(schema.SchemaError, match="truncated"):
+            schema.parse_snapshot(words[:-3])
+
+    def test_wrong_version_raises(self):
+        words = make_snapshot_words([])
+        words[0] = 99
+        with pytest.raises(schema.SchemaError, match="version"):
+            schema.parse_snapshot(words)
+
+
+# ---- registry ------------------------------------------------------------
+
+
+class TestBucketMath:
+    def test_matches_native_formula(self):
+        # tel::log2_bucket, bit for bit: below base -> 0, each octave
+        # one bucket up, saturating at the top
+        f = registry.log2_bucket
+        assert f(0, 10, 24) == 0
+        assert f(1023, 10, 24) == 0
+        assert f(1024, 10, 24) == 0
+        assert f(2048, 10, 24) == 1
+        assert f(4095, 10, 24) == 1
+        assert f(1 << 40, 10, 24) == 23  # saturates
+
+    def test_histogram_quantile_within_bucket_bounds(self):
+        h = registry.Histogram(10, 24)
+        for _ in range(90):
+            h.add(2_000_000)  # ~2ms
+        for _ in range(10):
+            h.add(100_000_000)  # ~100ms
+        p50 = h.quantile(0.50)
+        lo, hi = h.bucket_bounds(registry.log2_bucket(2_000_000, 10, 24))
+        assert lo <= p50 <= hi
+        p99 = h.quantile(0.99)
+        lo, hi = h.bucket_bounds(
+            registry.log2_bucket(100_000_000, 10, 24)
+        )
+        assert lo <= p99 <= hi
+
+    def test_empty_quantile_is_none(self):
+        assert registry.Histogram(10, 24).quantile(0.5) is None
+
+
+class TestRegistry:
+    def test_observe_and_stats(self):
+        reg = registry.MetricsRegistry()
+        for _ in range(95):
+            reg.observe(0, "allreduce", "ring", 4096, 2_000_000)
+        for _ in range(5):
+            reg.observe(0, "allreduce", "ring", 4096, 200_000_000)
+        s = reg.op_latency("allreduce")
+        assert s["count"] == 100
+        assert s["min_ms"] == pytest.approx(2.0)
+        assert s["max_ms"] == pytest.approx(200.0)
+        # p50 lands in the 2ms octave; p99 crosses into the slow tail
+        assert 1.0 <= s["p50_ms"] <= 4.2
+        assert s["p99_ms"] >= 100.0
+
+    def test_percentiles_clamped_to_observed_extremes(self):
+        reg = registry.MetricsRegistry()
+        reg.observe(0, "bcast", "tree", 64, 3_000_000)
+        s = reg.op_latency("bcast")
+        # one sample: every percentile equals it exactly (the clamp)
+        assert s["p50_ms"] == pytest.approx(3.0)
+        assert s["p99_ms"] == pytest.approx(3.0)
+
+    def test_from_snapshot(self):
+        words = make_snapshot_words([
+            (0, 7, 2, 5, 5 * 4096, 50_000_000, 8_000_000, 15_000_000,
+             [0, 0, 0, 5], [0, 0, 5]),
+            (0, 4, 4, 2, 0, 2_000_000, 900_000, 1_100_000,
+             [2], [2]),
+        ])
+        reg = registry.MetricsRegistry.from_snapshot(words)
+        assert set(reg.ops()) == {"allreduce", "barrier"}
+        s = reg.op_latency("allreduce", plane="ring")
+        assert s["count"] == 5
+        assert s["min_ms"] == pytest.approx(8.0)
+        assert reg.bytes_by_plane() == {"ring": 5 * 4096, "shm": 0}
+
+    def test_merge_across_ranks(self):
+        a = registry.MetricsRegistry()
+        b = registry.MetricsRegistry()
+        a.observe(0, "allreduce", "ring", 100, 1_000_000)
+        b.observe(0, "allreduce", "ring", 100, 9_000_000)
+        a.merge(b)
+        s = a.op_latency("allreduce")
+        assert s["count"] == 2
+        assert s["min_ms"] == pytest.approx(1.0)
+        assert s["max_ms"] == pytest.approx(9.0)
+
+    def test_diff_window(self):
+        cum = registry.MetricsRegistry()
+        for _ in range(3):
+            cum.observe(0, "allreduce", "ring", 100, 1_000_000)
+        before = registry.MetricsRegistry()
+        before.merge(cum)  # snapshot copy
+        for _ in range(7):
+            cum.observe(0, "allreduce", "ring", 100, 1_000_000)
+        window = cum.diff(before)
+        assert window.op_latency("allreduce")["count"] == 7
+        # an all-zero delta row disappears entirely
+        assert cum.diff(cum).aggregate(op="allreduce") is None
+
+
+# ---- recorder ------------------------------------------------------------
+
+
+class TestRecorder:
+    def teardown_method(self):
+        recorder._reset(None)
+
+    def test_off_records_nothing(self):
+        recorder._reset("off")
+        recorder.record("allreduce", recorder.PHASE_BEGIN, 64)
+        with recorder.py_op("bcast", 64):
+            pass
+        assert recorder.drain() == []
+
+    def test_trace_brackets(self):
+        recorder._reset("trace")
+        with recorder.py_op("allreduce", 4096):
+            pass
+        rows = recorder.drain()
+        assert len(rows) == 2
+        (t0, op0, ph0, b0), (t1, op1, ph1, b1) = rows
+        assert (op0, ph0, b0) == ("allreduce", recorder.PHASE_BEGIN, 4096)
+        assert (op1, ph1, b1) == ("allreduce", recorder.PHASE_END, 4096)
+        assert t1 >= t0
+        assert recorder.drain() == []  # consumed
+
+    def test_end_recorded_on_exception(self):
+        recorder._reset("trace")
+        with pytest.raises(RuntimeError):
+            with recorder.py_op("scan", 1):
+                raise RuntimeError("boom")
+        phases = [r[2] for r in recorder.drain()]
+        assert phases == [recorder.PHASE_BEGIN, recorder.PHASE_END]
+
+
+# ---- rank files, merge, trace validation --------------------------------
+
+
+def make_rank_obj(rank, world=2, anchor_mono=10_000, events=None,
+                  py_events=None):
+    if events is None:
+        # one op pair, one frame instant — all after the anchor
+        events = [
+            schema.Event(anchor_mono + 1_000, 7, 1, 2, 0, -1, 5, 256),
+            schema.Event(anchor_mono + 1_500, 20, 0, 0, -1,
+                         (rank + 1) % world, 5, 256),
+            schema.Event(anchor_mono + 2_000, 7, 2, 2, 0, -1, 5, 256),
+        ]
+    words = make_snapshot_words([
+        (0, 7, 2, 1, 256, 1_000, 1_000, 1_000, [1], [1]),
+    ])
+    return dump.build_rank_obj(
+        rank=rank, world=world,
+        anchor_mono_ns=anchor_mono, anchor_unix_ns=1_700_000_000_000,
+        mode="trace", events=events, py_events=py_events or [],
+        metrics_words=words,
+        link_stats={"aggregate": {"reconnects": 0}, "per_peer": {}},
+        job="testjob",
+    )
+
+
+class TestRankFile:
+    def test_builder_validates(self):
+        obj = make_rank_obj(0)
+        assert obj["schema"] == schema.RANK_FILE_SCHEMA
+        schema.validate_rank_file(obj)
+
+    def test_missing_key_rejected(self):
+        obj = make_rank_obj(0)
+        del obj["anchor"]
+        with pytest.raises(schema.SchemaError, match="anchor"):
+            schema.validate_rank_file(obj)
+
+    def test_rank_out_of_world_rejected(self):
+        with pytest.raises(schema.SchemaError, match="out of range"):
+            make_rank_obj(5, world=2)
+
+
+class TestMergeAndValidate:
+    def test_merge_two_ranks(self):
+        trace_obj = trace.merge_rank_objs(
+            [make_rank_obj(1), make_rank_obj(0)], job="testjob"
+        )
+        schema.validate_trace(trace_obj)  # idempotent re-check
+        pids = {e["pid"] for e in trace_obj["traceEvents"]
+                if e["ph"] != "M"}
+        assert pids == {0, 1}
+        assert trace_obj["otherData"]["ranks"] == 2
+        # the op pair became one balanced B/E slice per rank
+        bs = [e for e in trace_obj["traceEvents"] if e["ph"] == "B"]
+        es = [e for e in trace_obj["traceEvents"] if e["ph"] == "E"]
+        assert len(bs) == 2 and len(es) == 2
+        assert all(e["name"] == "allreduce" for e in bs)
+        # timestamps are anchor-relative: both ranks land at the same
+        # job-relative microsecond despite arbitrary absolute clocks
+        assert {round(e["ts"], 3) for e in bs} == {1.0}
+
+    def test_dangling_begin_gets_truncated_end(self):
+        # a rank that died mid-op: begin with no end must still merge
+        # into a schema-valid trace (closed at the last seen instant)
+        anchor = 10_000
+        events = [
+            schema.Event(anchor + 1_000, 7, 1, 2, 0, -1, 5, 256),
+            schema.Event(anchor + 3_000, 34, 0, 5, -1, -1, 5, 0),
+        ]
+        obj = make_rank_obj(0, world=1, events=events)
+        merged = trace.merge_rank_objs([obj])
+        ends = [e for e in merged["traceEvents"] if e["ph"] == "E"]
+        assert len(ends) == 1
+        assert ends[0]["args"].get("truncated") is True
+
+    def test_orphan_py_end_is_dropped_not_unbalanced(self):
+        # a py begin lost to the bounded recorder deque leaves its end
+        # orphaned: the exporter must drop it (like native lanes do),
+        # not emit an unbalanced E that makes validate_trace reject
+        # the whole merged trace
+        anchor = 10_000
+        obj = make_rank_obj(
+            0, world=1, events=[],
+            py_events=[[anchor + 500, "bcast", 2, 64],  # orphan end
+                       [anchor + 600, "scan", 1, 8],
+                       [anchor + 700, "scan", 2, 8]],
+        )
+        merged = trace.merge_rank_objs([obj])  # must not raise
+        names = [(e["ph"], e["name"]) for e in merged["traceEvents"]
+                 if e["ph"] in "BE"]
+        assert ("E", "py:bcast") not in names
+        assert ("B", "py:scan") in names and ("E", "py:scan") in names
+
+    def test_dangling_py_begin_closes_after_its_begin(self):
+        # a rank that died inside Python-side staging: the py begin is
+        # NEWER than every native event, and the synthesized truncated
+        # end must not land before it (negative-duration slice)
+        anchor = 10_000
+        events = [
+            schema.Event(anchor + 1_000, 34, 0, 5, -1, -1, 5, 0),
+        ]
+        obj = make_rank_obj(
+            0, world=1, events=events,
+            py_events=[[anchor + 5_000, "allreduce", 1, 64]],
+        )
+        merged = trace.merge_rank_objs([obj])
+        begins = {e["name"]: e["ts"] for e in merged["traceEvents"]
+                  if e["ph"] == "B"}
+        ends = {e["name"]: e["ts"] for e in merged["traceEvents"]
+                if e["ph"] == "E"}
+        assert ends["py:allreduce"] >= begins["py:allreduce"]
+
+    def test_validate_rejects_unbalanced(self):
+        bad = {
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+                 "args": {"name": "rank 0"}},
+                {"name": "allreduce", "ph": "E", "ts": 1.0, "pid": 0,
+                 "tid": 1},
+            ]
+        }
+        with pytest.raises(schema.SchemaError, match="unbalanced"):
+            schema.validate_trace(bad)
+
+    def test_validate_rejects_unnamed_pid(self):
+        bad = {
+            "traceEvents": [
+                {"name": "x", "ph": "i", "ts": 1.0, "pid": 3, "tid": 0,
+                 "s": "t"},
+            ]
+        }
+        with pytest.raises(schema.SchemaError, match="process_name"):
+            schema.validate_trace(bad)
+
+    def test_merge_dir_roundtrip(self, tmp_path):
+        import json
+
+        for rank in (0, 1):
+            obj = make_rank_obj(rank)
+            with open(tmp_path / dump.rank_file_name(rank), "w") as f:
+                json.dump(obj, f)
+        out = trace.merge_dir(tmp_path, job="testjob")
+        assert out.name == "job.trace.json"
+        schema.load_trace(out)
+
+    def test_merge_dir_empty_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            trace.merge_dir(tmp_path)
+
+
+# ---- t4j-top -------------------------------------------------------------
+
+
+class TestTop:
+    def test_summarize_and_render(self):
+        objs = [make_rank_obj(0), make_rank_obj(1)]
+        summary = top.summarize(objs)
+        assert len(summary["ranks"]) == 2
+        assert any(s["op"] == "allreduce" for s in summary["ops"])
+        # the frame_tx instants became per-link rows
+        assert {(r["rank"], r["peer"]) for r in summary["links"]} == {
+            (0, 1), (1, 0)
+        }
+        text = top.render(summary)
+        assert "allreduce" in text and "r0->r1" in text
+
+    def test_cli_renders_a_directory(self, tmp_path, capsys):
+        import json
+
+        for rank in (0, 1):
+            with open(tmp_path / dump.rank_file_name(rank), "w") as f:
+                json.dump(make_rank_obj(rank), f)
+        assert top.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "t4j-top" in out and "allreduce" in out
+
+    def test_cli_json_mode(self, tmp_path, capsys):
+        import json
+
+        with open(tmp_path / dump.rank_file_name(0), "w") as f:
+            json.dump(make_rank_obj(0, world=1, events=[]), f)
+        assert top.main([str(tmp_path), "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["ranks"][0]["rank"] == 0
+
+    def test_cli_missing_dir_errors(self, tmp_path, capsys):
+        assert top.main([str(tmp_path / "nope")]) == 2

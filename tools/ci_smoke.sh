@@ -29,16 +29,27 @@
 #                failure-semantics.md "self-healing transport").  Runs
 #                the ctypes data plane directly, so it works on
 #                old-jax containers and computes its own sanitizer
-#                LD_PRELOAD.
+#                LD_PRELOAD.  The self-heal phase runs with telemetry
+#                tracing on and asserts the reconnects appear as ring
+#                events (docs/observability.md).
+#   8. telemetry — tools/telemetry_smoke.py under the ASan build: an
+#                8-rank trace-mode job whose ranks drain their event
+#                rings (drained events monotone + begin/end complete),
+#                merged into one job.trace.json that must validate
+#                against the trace schema with all ranks on one
+#                aligned timeline and render through t4j-top; plus an
+#                off-mode phase that must drain ZERO events
+#                (docs/observability.md).  ctypes only — runs on
+#                old-jax containers.
 #
-# Usage: tools/ci_smoke.sh [lane...]   (default: all seven)
+# Usage: tools/ci_smoke.sh [lane...]   (default: all eight)
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
-  lanes=(tier1 fault proc asan tsan lint resilience)
+  lanes=(tier1 fault proc asan tsan lint resilience telemetry)
 fi
 
 run_lane() {
@@ -89,8 +100,12 @@ for lane in "${lanes[@]}"; do
       run_lane resilience env T4J_SANITIZE=address timeout -k 10 900 \
         python tools/resilience_smoke.py 8
       ;;
+    telemetry)
+      run_lane telemetry env T4J_SANITIZE=address timeout -k 10 900 \
+        python tools/telemetry_smoke.py 8
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry)" >&2
       exit 2
       ;;
   esac

@@ -126,7 +126,32 @@ def _load_lib(so):
     lib.t4j_link_stats.argtypes = [i32, u64p, u64p, u64p,
                                    ctypes.POINTER(i32)]
     lib.t4j_link_stats.restype = i32
+    lib.t4j_telemetry_drain.argtypes = [vp, ctypes.c_int64]
+    lib.t4j_telemetry_drain.restype = ctypes.c_int64
     return lib
+
+
+# telemetry.h wire ids (mirrored by mpi4jax_tpu/telemetry/schema.py):
+# a drained 32-byte record's kind field at offset 8
+_KIND_RECONNECT = 31
+
+
+def _count_reconnect_events(lib):
+    """Drain this rank's telemetry ring and count the reconnect
+    control-plane events — the flaky phase must leave its repairs
+    visible in the trace, not just in the counters
+    (docs/observability.md)."""
+    import ctypes
+    import struct
+
+    buf = ctypes.create_string_buffer(32 * 65536)
+    got = lib.t4j_telemetry_drain(buf, len(buf))
+    count = 0
+    for off in range(0, int(got), 32):
+        (kind,) = struct.unpack_from("<H", buf.raw, off + 8)
+        if kind == _KIND_RECONNECT:
+            count += 1
+    return count
 
 
 def worker(so):
@@ -192,6 +217,7 @@ def worker(so):
         print(
             f"SMOKE-OK {rank} reconnects={rec.value} "
             f"replayed_frames={fr.value} replayed_bytes={by.value} "
+            f"reconnect_events={_count_reconnect_events(lib)} "
             f"elapsed={time.monotonic() - t0:.2f}s",
             flush=True,
         )
@@ -258,6 +284,18 @@ def run_phase(phase, n, so, extra_env):
         if len(r0) > 1 and int(r0[1].split()[0]) < 1:
             ok = False
             print("FAIL: rank 0 reports zero reconnects")
+        # ... and in the telemetry ring: the repairs must appear as
+        # reconnect events in the trace (docs/observability.md), on
+        # both ends of a repaired link — the flaky rank (dial side of
+        # its lower peers) and rank 0 (accept side)
+        for r in (0, 1):
+            part = outs[r].split("reconnect_events=")
+            if len(part) > 1 and int(part[1].split()[0]) < 1:
+                ok = False
+                print(
+                    f"FAIL: rank {r} telemetry ring has no reconnect "
+                    "events during the flaky phase"
+                )
     else:
         if "t4j" not in blob:
             ok = False
@@ -267,11 +305,14 @@ def run_phase(phase, n, so, extra_env):
 
 
 def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    n = int(args[0]) if args else 8
+    argv = list(sys.argv[1:])
     phases = ["self-heal", "fail-stop"]
-    if "--phase" in sys.argv:
-        phases = [sys.argv[sys.argv.index("--phase") + 1]]
+    if "--phase" in argv:
+        i = argv.index("--phase")
+        phases = [argv[i + 1]]
+        del argv[i:i + 2]  # the value must not be parsed as nprocs
+    args = [a for a in argv if not a.startswith("--")]
+    n = int(args[0]) if args else 8
     build = _load_build_module()
     so = str(build.ensure_built())
     ok = True
@@ -281,6 +322,10 @@ def main():
                 "T4J_FAULT_MODE": "flaky",
                 "T4J_FAULT_AFTER": "40",
                 "T4J_FAULT_COUNT": "2",
+                # counters mode records the control-plane events (link
+                # break/reconnect/replay) the driver asserts on, at
+                # metrics-only overhead (docs/observability.md)
+                "T4J_TELEMETRY": "counters",
             }
         else:
             env = {

@@ -1,0 +1,416 @@
+#!/usr/bin/env python
+"""Telemetry smoke lane: the comm-telemetry subsystem end-to-end.
+
+Two phases over an N-rank (default 8) proc world driven through the
+native bridge's ctypes C API (no jax import anywhere, so the lane runs
+on old-jax containers and under sanitizer preloads alike — the same
+harness shape as tools/resilience_smoke.py):
+
+  1. trace — every rank runs allreduces/allgathers/sendrecvs with
+             ``T4J_TELEMETRY=trace`` on the ring path, drains its event
+             ring + metrics snapshot through the C API, asserts the
+             drained events are monotone per lane and complete (every
+             op begin has a matching end), and writes a schema-valid
+             ``rank<k>.t4j.json``.  The driver then merges the per-rank
+             files into one ``job.trace.json``, validates it against
+             the trace schema (begin/end balance per lane, process
+             metadata, aligned timestamps), and renders the ``t4j-top``
+             summary from the same files.
+  2. off   — same workload with ``T4J_TELEMETRY=off``: the drain must
+             return ZERO events and the metrics snapshot zero rows
+             (the zero-cost contract of docs/observability.md).
+
+Run under AddressSanitizer by exporting ``T4J_SANITIZE=address`` before
+invoking (tools/ci_smoke.sh does): the driver rebuilds the .so
+instrumented and computes the LD_PRELOAD the workers need.
+
+Usage: python tools/telemetry_smoke.py [nprocs] [--phase trace|off]
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import tempfile
+import types
+import uuid
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+FAILED = 21
+
+ITERS = 12
+COUNT = 16 * 1024  # f32 elements per rank per allreduce (64 KB)
+
+
+def _stub_packages():
+    """Register lightweight package stubs so the jax-free submodules
+    (telemetry/, utils/config.py, native/build.py) import by their real
+    dotted names on containers where the package __init__ refuses
+    (old jax) — the tools/resilience_smoke.py pattern."""
+    for name in ("mpi4jax_tpu", "mpi4jax_tpu.utils", "mpi4jax_tpu.native"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [str(REPO / name.replace(".", "/"))]
+            sys.modules[name] = mod
+
+
+def _load_telemetry():
+    """The telemetry package (jax-free), importable everywhere."""
+    try:
+        import mpi4jax_tpu.telemetry as tele  # noqa: PLC0415
+
+        return tele
+    except Exception:
+        pass
+    _stub_packages()
+    import importlib
+
+    return importlib.import_module("mpi4jax_tpu.telemetry")
+
+
+def _load_build_module():
+    try:
+        from mpi4jax_tpu.native import build  # noqa: PLC0415
+
+        return build
+    except Exception:
+        pass
+    _stub_packages()
+    for name, rel in (
+        ("mpi4jax_tpu.utils.config", "mpi4jax_tpu/utils/config.py"),
+        ("mpi4jax_tpu.native.build", "mpi4jax_tpu/native/build.py"),
+    ):
+        if name in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(name, REPO / rel)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["mpi4jax_tpu.native.build"]
+
+
+def _sanitizer_env():
+    san = os.environ.get("T4J_SANITIZE", "").strip().lower()
+    if not san:
+        return {}
+    lib = {"address": "libasan.so", "asan": "libasan.so",
+           "1": "libasan.so", "thread": "libtsan.so",
+           "tsan": "libtsan.so"}.get(san)
+    if lib is None:
+        return {}
+    paths = []
+    for name in (lib, "libstdc++.so.6"):
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={name}"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+        if out and out != name:
+            paths.append(out)
+    if not paths:
+        return {}
+    return {
+        "LD_PRELOAD": " ".join(paths),
+        "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
+        "TSAN_OPTIONS": "report_bugs=1",
+    }
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ worker
+
+
+def _load_lib(so):
+    import ctypes
+
+    lib = ctypes.CDLL(so)
+    i32, i64, u64, vp = (ctypes.c_int32, ctypes.c_int64, ctypes.c_uint64,
+                         ctypes.c_void_p)
+    lib.t4j_init.restype = ctypes.c_int
+    lib.t4j_last_error.restype = ctypes.c_char_p
+    lib.t4j_c_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_c_allreduce.restype = i32
+    lib.t4j_c_allgather.argtypes = [i32, vp, vp, u64]
+    lib.t4j_c_allgather.restype = i32
+    lib.t4j_c_sendrecv.argtypes = [i32, vp, u64, vp, u64, i32, i32, i32,
+                                   i32, ctypes.POINTER(i32),
+                                   ctypes.POINTER(i32)]
+    lib.t4j_c_sendrecv.restype = i32
+    lib.t4j_c_barrier.argtypes = [i32]
+    lib.t4j_c_barrier.restype = i32
+    lib.t4j_telemetry_mode.restype = i32
+    lib.t4j_telemetry_drain.argtypes = [vp, i64]
+    lib.t4j_telemetry_drain.restype = i64
+    lib.t4j_telemetry_dropped.restype = u64
+    lib.t4j_telemetry_anchor.argtypes = [ctypes.POINTER(u64),
+                                         ctypes.POINTER(u64)]
+    lib.t4j_telemetry_anchor.restype = i32
+    lib.t4j_metrics_snapshot.argtypes = [ctypes.POINTER(u64), i64]
+    lib.t4j_metrics_snapshot.restype = i64
+    lib.t4j_link_stats.argtypes = [i32, ctypes.POINTER(u64),
+                                   ctypes.POINTER(u64),
+                                   ctypes.POINTER(u64),
+                                   ctypes.POINTER(i32)]
+    lib.t4j_link_stats.restype = i32
+    return lib
+
+
+def worker(so):
+    import ctypes
+
+    import numpy as np
+
+    tele = _load_telemetry()
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    lib = _load_lib(so)
+    rc = lib.t4j_init()
+    if rc != 0:
+        raise RuntimeError(f"init rc={rc}: {lib.t4j_last_error().decode()}")
+    rank = lib.t4j_world_rank()
+    n = lib.t4j_world_size()
+    phase = os.environ["SMOKE_PHASE"]
+    try:
+        for it in range(ITERS):
+            x = np.full(COUNT, float(rank + it), np.float32)
+            out = np.empty_like(x)
+            st = lib.t4j_c_allreduce(0, ptr(x), ptr(out), COUNT, 0, 0)
+            if st:
+                raise RuntimeError(
+                    f"allreduce[{it}]: {lib.t4j_last_error().decode()}"
+                )
+        mine = np.full(256, float(rank), np.float32)
+        g = np.empty((n, 256), np.float32)
+        if lib.t4j_c_allgather(0, ptr(mine), ptr(g), mine.nbytes):
+            raise RuntimeError(
+                f"allgather: {lib.t4j_last_error().decode()}"
+            )
+        right, left = (rank + 1) % n, (rank - 1) % n
+        rbuf = np.empty_like(mine)
+        src = ctypes.c_int32(0)
+        tag = ctypes.c_int32(0)
+        if lib.t4j_c_sendrecv(0, ptr(mine), mine.nbytes, ptr(rbuf),
+                              rbuf.nbytes, left, right, 7, 7,
+                              ctypes.byref(src), ctypes.byref(tag)):
+            raise RuntimeError(
+                f"sendrecv: {lib.t4j_last_error().decode()}"
+            )
+        if lib.t4j_c_barrier(0):
+            raise RuntimeError(f"barrier: {lib.t4j_last_error().decode()}")
+
+        # ---- drain the telemetry surface through the C API ----------
+        mode = lib.t4j_telemetry_mode()
+        buf = ctypes.create_string_buffer(32 * 65536)
+        got = lib.t4j_telemetry_drain(buf, len(buf))
+        events = tele.decode_events(buf.raw[:got])
+        need = lib.t4j_metrics_snapshot(None, 0)
+        words = []
+        if need > 0:
+            arr = (ctypes.c_uint64 * need)()
+            lib.t4j_metrics_snapshot(arr, need)
+            words = list(arr)
+        mono = ctypes.c_uint64(0)
+        unix = ctypes.c_uint64(0)
+        lib.t4j_telemetry_anchor(ctypes.byref(mono), ctypes.byref(unix))
+
+        if phase == "off":
+            assert mode == 0, f"mode {mode}, want off"
+            assert not events, f"off mode drained {len(events)} event(s)"
+            snap = tele.parse_snapshot(words) if words else None
+            assert snap is None or not snap["rows"], (
+                "off mode counted metrics rows"
+            )
+            print(f"SMOKE-OFF-OK {rank}", flush=True)
+            lib.t4j_finalize()
+            sys.exit(0)
+
+        assert mode == 2, f"mode {mode}, want trace"
+        assert events, "trace mode drained zero events"
+        ops = [e for e in events if e.kind in tele.schema.OP_KINDS]
+        assert ops, "no op-level events in the drain"
+        begins = sum(1 for e in ops if e.phase == 1)
+        # monotone per lane + every begin closed by a matching end
+        problems = tele.check_begin_end_balance(events)
+        assert not problems, f"event stream problems: {problems[:5]}"
+        frames = [e for e in events if tele.KIND_NAMES[e.kind].startswith(
+            "frame")] if n > 1 else []
+        assert n == 1 or frames, "multi-rank trace carries no frame events"
+        snap = tele.parse_snapshot(words)
+        assert snap["rows"], "trace mode counted zero metrics rows"
+        ar = [r for r in snap["rows"]
+              if tele.KIND_NAMES.get(r["kind"]) == "allreduce"]
+        assert ar and sum(r["count"] for r in ar) >= ITERS, (
+            "allreduce metrics row missing or undercounted"
+        )
+
+        # per-peer link stats for the rank file
+        per_peer = {}
+        for peer in range(n):
+            rec_, fr_, by_ = (ctypes.c_uint64(), ctypes.c_uint64(),
+                              ctypes.c_uint64())
+            state_ = ctypes.c_int32()
+            if lib.t4j_link_stats(peer, ctypes.byref(rec_),
+                                  ctypes.byref(fr_), ctypes.byref(by_),
+                                  ctypes.byref(state_)):
+                per_peer[str(peer)] = {
+                    "reconnects": rec_.value,
+                    "replayed_frames": fr_.value,
+                    "replayed_bytes": by_.value,
+                    "state": state_.value,
+                }
+
+        from mpi4jax_tpu.telemetry import dump
+
+        obj = dump.build_rank_obj(
+            rank=rank, world=n,
+            anchor_mono_ns=mono.value, anchor_unix_ns=unix.value,
+            mode="trace", events=events, metrics_words=words,
+            dropped=lib.t4j_telemetry_dropped(),
+            link_stats={"per_peer": per_peer},
+            job=os.environ.get("T4J_JOB", ""),
+        )
+        out_dir = pathlib.Path(os.environ["SMOKE_DIR"])
+        path = out_dir / dump.rank_file_name(rank)
+        tmp = path.with_suffix(f".tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+        print(
+            f"SMOKE-TRACE-OK {rank} events={len(events)} "
+            f"begins={begins} frames={len(frames)} "
+            f"metrics_rows={len(snap['rows'])}",
+            flush=True,
+        )
+        lib.t4j_finalize()
+        sys.exit(0)
+    except (RuntimeError, AssertionError) as e:
+        print(f"SMOKE-FAILED: {e}", flush=True)
+        sys.exit(FAILED)
+
+
+# ------------------------------------------------------------------ driver
+
+
+def run_phase(phase, n, so, out_dir):
+    coord = f"127.0.0.1:{_free_port()}"
+    job = uuid.uuid4().hex[:8]
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.update(
+            T4J_RANK=str(r), T4J_SIZE=str(n), T4J_COORD=coord,
+            T4J_JOB=job, T4J_NO_SHM="1",
+            # ring path with small segments so segment-level frame
+            # events appear in every collective
+            T4J_RING_MIN_BYTES="0", T4J_SEG_BYTES="8192",
+            T4J_TELEMETRY="trace" if phase == "trace" else "off",
+            SMOKE_PHASE=phase, SMOKE_DIR=str(out_dir),
+        )
+        env.update(_sanitizer_env())
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__, "worker", so],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    ok = True
+    outs = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        if p.returncode != 0:
+            ok = False
+        print(f"--- [{phase}] rank {r} (rc={p.returncode}) ---")
+        print(out[-2000:])
+    if not ok:
+        return False
+
+    if phase == "off":
+        return all("SMOKE-OFF-OK" in o for o in outs)
+
+    # ---- merge + validate + render: the driver half of the lane -----
+    tele = _load_telemetry()
+    try:
+        merged = tele.merge_dir(out_dir, job=job)
+    except Exception as e:
+        print(f"FAIL: merge_dir raised {type(e).__name__}: {e}")
+        return False
+    try:
+        trace = tele.load_trace(merged)  # re-validates from disk
+    except Exception as e:
+        print(f"FAIL: merged trace is schema-invalid: {e}")
+        return False
+    pids = {e["pid"] for e in trace["traceEvents"] if e["ph"] != "M"}
+    if pids != set(range(n)):
+        print(f"FAIL: merged trace covers pids {sorted(pids)}, want 0..{n-1}")
+        return False
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "B"]
+    if not spans:
+        print("FAIL: merged trace has no duration slices")
+        return False
+    # all ranks on one aligned timeline: every rank's job-relative
+    # timestamps must land in one overlapping window (the workers run
+    # the same lockstep collectives), not offset by wall-clock skew
+    lo = {p: min(e["ts"] for e in trace["traceEvents"]
+                 if e["ph"] != "M" and e["pid"] == p) for p in pids}
+    hi = {p: max(e["ts"] for e in trace["traceEvents"]
+                 if e["ph"] != "M" and e["pid"] == p) for p in pids}
+    if max(lo.values()) >= min(hi.values()):
+        print(f"FAIL: rank timelines do not overlap (lo={lo} hi={hi})")
+        return False
+
+    from mpi4jax_tpu.telemetry import top
+
+    summary = top.summarize(top.load_rank_objs(out_dir))
+    table = top.render(summary)
+    print(table)
+    if not summary["ops"] or not summary["links"]:
+        print("FAIL: t4j-top summary is missing ops or links")
+        return False
+    if not any(s["op"] == "allreduce" and s["p99_ms"] is not None
+               for s in summary["ops"]):
+        print("FAIL: t4j-top has no allreduce p99")
+        return False
+    print(f"merged trace OK: {merged} "
+          f"({len(trace['traceEvents'])} trace events)")
+    return True
+
+
+def main():
+    argv = list(sys.argv[1:])
+    phases = ["trace", "off"]
+    if "--phase" in argv:
+        i = argv.index("--phase")
+        phases = [argv[i + 1]]
+        del argv[i:i + 2]  # the value must not be parsed as nprocs
+    args = [a for a in argv if not a.startswith("--")]
+    n = int(args[0]) if args else 8
+    build = _load_build_module()
+    so = str(build.ensure_built())
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="t4j_telemetry_") as d:
+        for phase in phases:
+            ok = run_phase(phase, n, so, pathlib.Path(d)) and ok
+    print("TELEMETRY-SMOKE-OK" if ok else "TELEMETRY-SMOKE-FAIL")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker(sys.argv[2])
+    else:
+        main()
