@@ -439,6 +439,50 @@ def proc_hier_busbw(timeout=900):
     return hier, flat, ratio
 
 
+def proc_overlap_step(timeout=900):
+    """DP train step with bucketed compute/comm overlap on vs off
+    (docs/async.md "gradient bucketing"): one 8-rank launcher job
+    running ``benchmarks/transformer.py --overlap pairs`` — each timed
+    batch runs the overlap-on and overlap-off steps back to back, so
+    phase noise hits both arms equally.  Returns
+    ``(on_record, off_record, speedup_record)``; any may be None."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "transformer.py"
+    argv = [
+        sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+        str(script), "--overlap", "pairs",
+    ]
+    on = off = speedup = None
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent),
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            metric = rec.get("metric", "")
+            if metric == "train_step_ms_proc8_overlap_on":
+                on = rec
+            elif metric == "train_step_ms_proc8_overlap_off":
+                off = rec
+            elif metric == "overlap_speedup_proc8":
+                speedup = rec
+        if speedup is None:
+            print(
+                f"[bench] overlap step produced no speedup record "
+                f"(rc={out.returncode}): {out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] overlap step failed: {exc}", file=sys.stderr)
+    return on, off, speedup
+
+
 def main():
     import jax
 
@@ -749,6 +793,18 @@ def main():
         extras["allreduce_busbw_proc8_hier_flat_gbps"] = hflat_rec["value"]
     if hratio_rec is not None:
         extras["proc8_hier_vs_ring_ratio"] = hratio_rec["value"]
+    # the async progress engine (PR 7 tentpole): DDP train step with
+    # bucketed compute/comm overlap on vs off, interleaved pairs — the
+    # end-to-end step-time number, not just busbw (docs/async.md)
+    ov_on, ov_off, ov_ratio = (
+        proc_overlap_step() if native_ok else (None, None, None)
+    )
+    if ov_on is not None:
+        extras["train_step_ms_proc8_overlap_on"] = ov_on["value"]
+    if ov_off is not None:
+        extras["train_step_ms_proc8_overlap_off"] = ov_off["value"]
+    if ov_ratio is not None:
+        extras["overlap_speedup_proc8"] = ov_ratio["value"]
 
     try:
         extras["transformer_train_tokens_per_sec_bf16"] = (

@@ -69,6 +69,14 @@ def main():
         "emulate multiple nodes on one host)",
     )
     ap.add_argument(
+        "--inflight", type=int, default=0, metavar="N",
+        help="issue-depth scaling (docs/async.md): split --mb into N "
+        "chunks submitted as N overlapping iallreduce requests "
+        "(waitall at the end) vs the same chunks through blocking "
+        "allreduces, interleaved same-conditions batches; one JSON "
+        "record per arm plus the depth-speedup ratio",
+    )
+    ap.add_argument(
         "--copy-gauntlet", action="store_true",
         help="measure the aggregate plain-memcpy rate of N timesharing "
         "ranks (no collective logic): the scheduler bound the arena's "
@@ -100,6 +108,9 @@ def main():
 
     if args.pairs:
         return _pairs_main(args, comm)
+
+    if args.inflight:
+        return _inflight_main(args, comm)
 
     if args.sweep:
         # 1 KB -> --mb in x4 steps, straddling T4J_RING_MIN_BYTES so
@@ -385,6 +396,92 @@ def _pairs_main(args, comm):
         "flat_plane": flat,
         "local_world": topo["local_size"],
         "leader_world": topo["n_hosts"],
+    }), flush=True)
+
+
+def _inflight_main(args, comm):
+    """Issue-depth scaling of the async progress engine
+    (docs/async.md): the --mb payload split into ``--inflight`` chunks,
+    either submitted as overlapping ``iallreduce`` requests reaped by
+    one ``waitall`` (depth N on the engine) or pushed through blocking
+    allreduces one at a time (depth 1).  Interleaved same-conditions
+    batches, one record per arm plus the ratio — the microbenchmark
+    behind the bucket-size guidance in docs/async.md ("smaller buckets
+    start overlapping earlier but pay more per-op latency")."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.ops._proc import proc_topology
+    from mpi4jax_tpu.utils import config
+
+    n = comm.size
+    depth = max(1, args.inflight)
+    per = max(int(args.mb * 1e6 / 4) // depth, n)
+    per -= per % max(n, 1)
+    xs = [jnp.full((per,), float(k + 1), jnp.float32)
+          for k in range(depth)]
+    nbytes = per * 4 * depth  # total payload per rep, both arms
+    factor = _busbw_factor("allreduce", n)
+
+    def rep_deep(tok):
+        reqs = []
+        for x in xs:
+            r, tok = m.iallreduce(x, m.SUM, comm=comm, token=tok)
+            reqs.append(r)
+        outs, tok = m.waitall(reqs, token=tok)
+        return outs[-1], tok
+
+    def rep_serial(tok):
+        y = None
+        for x in xs:
+            y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        return y, tok
+
+    tok = m.create_token()
+    for fn in (rep_serial, rep_deep):  # warm (compile + transport)
+        y, tok = fn(tok)
+        np.asarray(y)
+
+    best = {"serial": float("inf"), "deep": float("inf")}
+    for _ in range(3):
+        for mode, fn in (("serial", rep_serial), ("deep", rep_deep)):
+            tok = _fence(comm, tok)
+            t0 = time.perf_counter()
+            for _ in range(args.reps):
+                y, tok = fn(tok)
+            np.asarray(y)
+            best[mode] = min(
+                best[mode], (time.perf_counter() - t0) / args.reps
+            )
+    if comm.rank() != 0:
+        return
+    topo = proc_topology(comm)
+    algo, _ = _data_plane("allreduce", comm, per * 4)
+    for mode, d in (("serial", 1), ("deep", depth)):
+        print(json.dumps({
+            "metric": f"allreduce_busbw_proc{n}_inflight{d}",
+            "value": round(nbytes * factor / best[mode] / 1e9, 3),
+            "unit": "GB/s",
+            "nprocs": n,
+            "inflight": d,
+            "chunk_mb": per * 4 / 1e6,
+            "payload_mb": nbytes / 1e6,
+            "sec_per_rep": round(best[mode], 6),
+            "data_plane": algo,
+            "local_world": topo["local_size"],
+            "leader_world": topo["n_hosts"],
+            "seg_bytes": config.seg_bytes(),
+            "interleaved_pairs": True,
+        }), flush=True)
+    print(json.dumps({
+        "metric": f"inflight_speedup_proc{n}",
+        "value": round(best["serial"] / best["deep"], 3),
+        "unit": "x",
+        "nprocs": n,
+        "inflight": depth,
+        "chunk_mb": per * 4 / 1e6,
+        "data_plane": algo,
     }), flush=True)
 
 

@@ -452,6 +452,135 @@ def run_decode(
     }
 
 
+def run_overlap(mode="pairs", layers=6, d_model=1024, batch=16, reps=3,
+                batches=3, bucket_bytes=None, lr=1e-3):
+    """Proc-tier DP train step: bucketed-overlap gradient sync vs the
+    identical bucket layout through blocking allreduces
+    (docs/async.md "gradient bucketing").
+
+    Run under the launcher (the proc tier is multi-process)::
+
+        python -m mpi4jax_tpu.launch -np 8 benchmarks/transformer.py \\
+            --overlap pairs
+
+    ``mode`` is ``on``/``off`` (one side) or ``pairs``: each timed
+    batch runs the overlap-on and overlap-off steps back to back,
+    alternating, so co-tenant phase noise hits both sides equally —
+    the same interleaved-pairs convention as the hier-vs-flat busbw
+    comparison (PRs 2/3/5).  Rank 0 prints one record per side plus
+    the speedup ratio; the records carry the bucket/knob context so
+    BENCH trajectories can attribute wins.
+    """
+    import os
+
+    # One compute thread per rank — the standard methodology for
+    # multiple ranks per host (MPI jobs pin OMP_NUM_THREADS=1): an
+    # oversubscribed per-rank eigen pool spends the very idle cycles
+    # the overlap engine is supposed to harvest, turning the
+    # measurement into a threadpool contention test.  Must land before
+    # jax initialises its CPU client; opt out by presetting XLA_FLAGS.
+    if "--xla_cpu_multi_thread_eigen" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_cpu_multi_thread_eigen=false"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.models import train
+    from mpi4jax_tpu.utils import config
+
+    comm = m.get_default_comm()
+    assert comm.backend == "proc", (
+        "--overlap measures the proc tier: run under "
+        "python -m mpi4jax_tpu.launch -np N"
+    )
+    n, rank = comm.size, comm.rank()
+    if bucket_bytes is None:
+        bucket_bytes = config.bucket_bytes()
+
+    params = train.init_stack_params(
+        jax.random.PRNGKey(0), layers, d_model
+    )
+    x = jax.random.normal(jax.random.PRNGKey(rank + 1), (batch, d_model))
+    targets = jnp.zeros((batch, d_model))
+    data = (x, targets)
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+    steps = {}
+    sides = ("on", "off") if mode == "pairs" else (mode,)
+    for side in sides:
+        steps[side] = jax.jit(train.make_dp_train_step(
+            comm, lr=lr, overlap=(side == "on"),
+            bucket_bytes=bucket_bytes,
+        ))
+
+    def fence(tok):
+        tok = m.barrier(comm=comm, token=tok)
+        jax.block_until_ready(tok.stamp)
+        return tok
+
+    # warm both sides (compile + transport buffers) from one params copy
+    tok = m.create_token()
+    losses = {}
+    for side in sides:
+        p2, loss = steps[side](params, data)
+        jax.block_until_ready(loss)
+        losses[side] = float(loss)
+    if len(sides) == 2:
+        assert losses["on"] == losses["off"], (
+            "overlap on/off steps disagree", losses
+        )
+
+    best = {side: float("inf") for side in sides}
+    for _ in range(batches):
+        for side in sides:
+            p2 = params
+            tok = fence(tok)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                p2, loss = steps[side](p2, data)
+            jax.block_until_ready(loss)
+            best[side] = min(
+                best[side], (time.perf_counter() - t0) / reps
+            )
+    if rank != 0:
+        return None
+    recs = []
+    for side in sides:
+        recs.append({
+            "metric": f"train_step_ms_proc{n}_overlap_{side}",
+            "value": round(best[side] * 1e3, 3),
+            "unit": "ms",
+            "nprocs": n,
+            "layers": layers,
+            "d_model": d_model,
+            "batch": batch,
+            "params_m": round(n_params / 1e6, 3),
+            "bucket_bytes": int(bucket_bytes),
+            "grad_mb": round(n_params * 4 / 1e6, 2),
+            "interleaved_pairs": mode == "pairs",
+        })
+        print(json.dumps(recs[-1]), flush=True)
+    if len(sides) == 2:
+        recs.append({
+            "metric": f"overlap_speedup_proc{n}",
+            "value": round(best["off"] / best["on"], 3),
+            "unit": "x",
+            "nprocs": n,
+            "layers": layers,
+            "d_model": d_model,
+            "bucket_bytes": int(bucket_bytes),
+        })
+        print(json.dumps(recs[-1]), flush=True)
+    return recs
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument(
@@ -512,7 +641,33 @@ def main(argv=None):
         "default xla",
     )
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N")
+    p.add_argument(
+        "--overlap", choices=("on", "off", "pairs"), default=None,
+        help="proc-tier DP train step with bucketed compute/comm "
+        "overlap (docs/async.md): run under python -m mpi4jax_tpu"
+        ".launch -np N; 'pairs' interleaves overlap-on and overlap-off "
+        "per timed batch and reports both plus the speedup",
+    )
+    p.add_argument(
+        "--bucket-bytes", type=int, default=None,
+        help="gradient-bucket size for --overlap (default "
+        "T4J_BUCKET_BYTES)",
+    )
+    p.add_argument("--reps", type=int, default=3,
+                   help="steps per timed batch in --overlap mode")
     args = p.parse_args(argv)
+
+    if args.overlap:
+        run_overlap(
+            mode=args.overlap,
+            layers=args.layers or 6,
+            d_model=args.d_model or 1024,
+            batch=args.batch or 16,
+            reps=args.reps,
+            batches=min(args.batches, 5),
+            bucket_bytes=args.bucket_bytes,
+        )
+        return
 
     if args.cpu_mesh:
         from benchmarks.collectives import force_cpu_mesh

@@ -39,7 +39,9 @@ from mpi4jax_tpu.ops import (
     MIN,
     PROD,
     SUM,
+    BucketedGradSync,
     Op,
+    Request,
     Status,
     Token,
     allgather,
@@ -47,9 +49,14 @@ from mpi4jax_tpu.ops import (
     alltoall,
     as_token,
     barrier,
+    assert_requests_drained,
     bcast,
     create_token,
     gather,
+    iallreduce,
+    ireduce_scatter,
+    irecv,
+    isend,
     recv,
     reduce,
     reduce_scatter,
@@ -57,7 +64,10 @@ from mpi4jax_tpu.ops import (
     scatter,
     send,
     sendrecv,
+    test,
     token_array,
+    wait,
+    waitall,
 )
 from mpi4jax_tpu.parallel import (
     Comm,
@@ -123,6 +133,7 @@ __all__ = [
     "BAND",
     "BOR",
     "BXOR",
+    "BucketedGradSync",
     "Comm",
     "LAND",
     "LOR",
@@ -133,6 +144,7 @@ __all__ = [
     "Op",
     "PROD",
     "ProcComm",
+    "Request",
     "SUM",
     "SelfComm",
     "Status",
@@ -140,6 +152,7 @@ __all__ = [
     "allgather",
     "allreduce",
     "alltoall",
+    "assert_requests_drained",
     "as_token",
     "barrier",
     "bcast",
@@ -149,6 +162,10 @@ __all__ = [
     "get_default_comm",
     "has_cuda_support",
     "has_tpu_support",
+    "iallreduce",
+    "ireduce_scatter",
+    "irecv",
+    "isend",
     "recv",
     "reduce",
     "reduce_scatter",
@@ -157,5 +174,8 @@ __all__ = [
     "send",
     "sendrecv",
     "set_default_comm",
+    "test",
     "token_array",
+    "wait",
+    "waitall",
 ]

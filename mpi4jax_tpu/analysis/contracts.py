@@ -64,6 +64,9 @@ RULES = {
               "or partner rank disagrees with the communicator",
     "T4J007": "cross-rank schedule divergence: ranks extracted different "
               "communication schedules for one program (fingerprint pass)",
+    "T4J008": "request never waited: a nonblocking op's request is not "
+              "consumed by wait/waitall before the trace ends, or a "
+              "request is waited more than once",
 }
 
 
@@ -113,6 +116,11 @@ class CommEvent:
     pending_out: tuple = ()   # tuple of short strings, one per staged send
     src_info: str = ""        # "file.py:123" best-effort user frame
     scope: tuple = ()         # trace-nesting path, outermost first
+    # async request chain (docs/async.md): identity of the Request a
+    # nonblocking op returned, and of the Request(s) a wait/waitall/
+    # test consumed — T4J008 keys on these
+    request_out: int | None = None
+    requests_in: tuple = ()
 
     def describe(self):
         bits = [self.kind, f"comm={_fmt_comm(self.comm_key)}"]
@@ -176,6 +184,7 @@ def check_schedule(events):
     findings += _check_dropped_sends(events)
     findings += _check_self_deadlock(events)
     findings += _check_native_dtypes(events)
+    findings += _check_requests(events)
     return findings
 
 
@@ -307,6 +316,57 @@ def _check_self_deadlock(events):
 
 def _fmt_tag(tag):
     return "ANY" if tag in (None, -1) else tag
+
+
+def _check_requests(events):
+    """T4J008 — async request discipline (docs/async.md).
+
+    Every request a nonblocking op returns must be consumed by a
+    wait/waitall exactly once within the trace: a never-waited request
+    leaks its buffers and silently drops the op's completion ordering
+    (on the proc tier the runtime reports the leak only at finalize —
+    long after the bug); a doubly-waited request raises at runtime on
+    the second wait, mid-job.  Both are decidable from one rank's
+    schedule.  ``test`` probes do not consume (MPI_Test-and-then-wait
+    is the documented idiom), so they are not counted as waits.
+    """
+    findings = []
+    produced = {}   # request identity -> producing event
+    consumed = {}   # request identity -> first consuming event
+    for ev in events:
+        if ev.request_out is not None:
+            produced[ev.request_out] = ev
+        if ev.kind == "test":
+            continue  # probe: does not consume
+        for rid in ev.requests_in:
+            prev = consumed.get(rid)
+            if prev is not None:
+                origin = produced.get(rid)
+                findings.append(_finding(
+                    "T4J008",
+                    f"request returned by "
+                    f"{origin.kind if origin else 'a nonblocking op'}"
+                    f"{' (step ' + str(origin.seq) + ')' if origin else ''}"
+                    f" is waited again by {ev.kind} after "
+                    f"{prev.kind} (step {prev.seq}) already consumed it: "
+                    "a request may be waited exactly once.",
+                    ev,
+                ))
+            else:
+                consumed[rid] = ev
+    for rid, origin in produced.items():
+        if rid not in consumed:
+            findings.append(_finding(
+                "T4J008",
+                f"request returned by {origin.kind} is never consumed by "
+                "wait/waitall before the trace ends: the operation's "
+                "completion is unobservable and its buffers stay pinned "
+                "(request leak — the runtime reports it only at "
+                "finalize). Wait every nonblocking request exactly "
+                "once.",
+                origin,
+            ))
+    return findings
 
 
 # dtype names the native bridge can move (native/runtime.py

@@ -175,6 +175,37 @@ def _build_event(scope, name, fn, args, kwargs, out):
             tag = _static_int(params[p])
             break
 
+    # async request chain (T4J008, docs/async.md): identity of the
+    # Request a nonblocking op returned and of the Request(s) a
+    # wait/waitall/test consumed.  Strong refs join scope.tokens for
+    # the same id-recycling reason.
+    request_out = None
+    requests_in = ()
+    try:
+        from mpi4jax_tpu.ops.async_ import Request
+
+        rin = []
+        for v in params.values():
+            if isinstance(v, Request):
+                rin.append(v)
+            elif isinstance(v, (list, tuple)):
+                rin.extend(i for i in v if isinstance(i, Request))
+        out_req = None
+        if isinstance(out, Request):
+            out_req = out
+        elif isinstance(out, tuple):
+            for item in out:
+                if isinstance(item, Request):
+                    out_req = item
+                    break
+        scope.tokens.extend(rin)
+        requests_in = tuple(id(r) for r in rin)
+        if out_req is not None:
+            scope.tokens.append(out_req)
+            request_out = id(out_req)
+    except Exception:
+        pass
+
     ev = CommEvent(
         seq=scope.seq,
         kind=name,
@@ -194,6 +225,8 @@ def _build_event(scope, name, fn, args, kwargs, out):
         token_out=id(token_out) if token_out is not None else None,
         pending_out=_pending_summary(token_out),
         src_info=_user_frame(),
+        request_out=request_out,
+        requests_in=requests_in,
     )
     scope.seq += 1
     if token_out is not None:

@@ -30,10 +30,13 @@ from mpi4jax_tpu.ops._core import create_token
 
 __all__ = [
     "MLPParams",
+    "StackParams",
     "init_params",
+    "init_stack_params",
     "make_train_step",
     "make_global_train_step",
     "make_global_zero_train_step",
+    "make_dp_train_step",
 ]
 
 
@@ -172,6 +175,214 @@ def make_global_train_step(mesh, comm_dp, comm_tp, lr=1e-2):
             out_specs=(param_specs, jax.P((dp_ax, tp_ax))),
         )
     )
+
+
+class StackParams(NamedTuple):
+    """Deep MLP stack for the data-parallel (MPMD) train step.
+
+    Each layer is its own ``(w, b)`` pair of leaves — deliberately NOT
+    stacked into one ``(layers, d, d)`` array: per-layer leaves are
+    what lets :class:`~mpi4jax_tpu.BucketedGradSync` bucket gradients
+    in backprop order, so layer k's bucket can hit the wire while the
+    backward pass is still producing layer k-1's gradients.  The
+    flattened leaf order is ``(w0, b0, w1, b1, ..., w_out)``; reversed
+    it is exactly the order backprop produces gradients in.
+    """
+
+    layers: tuple  # L entries of (w: (d, d), b: (d,))
+    w_out: jax.Array  # (d, d_out)
+
+
+def init_stack_params(key, layers, d, d_out=None, dtype=jnp.float32):
+    d_out = d_out or d
+    keys = jax.random.split(key, layers + 1)
+    scale = (2.0 / d) ** 0.5
+    return StackParams(
+        layers=tuple(
+            (jax.random.normal(keys[i], (d, d), dtype) * scale,
+             jnp.zeros((d,), dtype))
+            for i in range(layers)
+        ),
+        w_out=jax.random.normal(keys[-1], (d, d_out), dtype) * scale,
+    )
+
+
+def make_dp_train_step(comm, lr=1e-2, overlap=True, bucket_bytes=None,
+                       loss_sync=True):
+    """Pure data-parallel train step for MPMD backends (the proc tier),
+    with DDP-style bucketed compute/comm overlap (docs/async.md
+    "gradient bucketing").
+
+    Each rank holds the FULL parameters and its own micro-batch.  For
+    :class:`StackParams` the backward pass is written out per layer, and
+    as soon as a gradient bucket (~``bucket_bytes``, default
+    ``T4J_BUCKET_BYTES``) fills, its ``iallreduce`` is submitted and the
+    remaining backprop is FENCED to depend on the submit's stamp
+    (``lax.optimization_barrier``): the data dependency forces XLA to
+    issue bucket k's request before computing layer k-1's gradients, so
+    the native progress engine runs the wire phase while the backward
+    pass continues — relying on the scheduler to hoist an independent
+    callback does NOT work (XLA's CPU schedule serialises it; measured
+    in docs/async.md).  Every request is waited at the optimizer step.
+
+    ``overlap=False`` runs the identical bucket layout and fence points
+    through blocking allreduces — classic non-overlapped DDP, the
+    control arm of ``benchmarks/transformer.py --overlap`` interleaved
+    pairs.  Both arms are bit-identical in results (same reduction
+    sizes, same order).
+
+    Other parameter pytrees (:class:`MLPParams` included) fall back to
+    ``jax.value_and_grad`` + :class:`~mpi4jax_tpu.BucketedGradSync`,
+    where overlap is at the scheduler's discretion.
+
+    Returns ``step(params, (x, targets)) -> (params, loss)`` — jit it
+    yourself (``jax.jit(step)``) or call it eagerly.
+    """
+    from jax import lax
+
+    from mpi4jax_tpu.ops._core import create_token
+    from mpi4jax_tpu.ops.allreduce import BucketedGradSync
+    from mpi4jax_tpu.ops.async_ import iallreduce, wait
+
+    if bucket_bytes is None:
+        from mpi4jax_tpu.utils import config
+
+        bucket_bytes = config.bucket_bytes()
+    bucket_bytes = max(1, int(bucket_bytes))
+    n = float(comm.size)
+    use_async = overlap and getattr(comm, "backend", None) != "mesh"
+
+    def generic_step(params, batch):
+        x, targets = batch
+        sync = BucketedGradSync(
+            comm, bucket_bytes=bucket_bytes, average=True,
+            overlap=use_async,
+        )
+
+        def loss_fn(p):
+            h = jax.nn.relu(x @ p.w1 + p.b1)
+            y = h @ p.w2 + p.b2
+            return jnp.mean((y - targets) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads, tok = sync(grads)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        if loss_sync:
+            loss_sum, tok = allreduce(
+                loss, reductions.SUM, comm=comm, token=tok
+            )
+            loss = loss_sum / n
+        return params, loss
+
+    def stack_step(params, batch):
+        x, targets = batch
+        layers = list(params.layers)
+
+        # forward, saving each layer's input and pre-activation (the
+        # exact residuals the hand-written backward below needs)
+        h = x
+        saves = []
+        for w, b in layers:
+            pre = h @ w + b
+            saves.append((h, pre))
+            h = jax.nn.relu(pre)
+        y = h @ params.w_out
+        diff = y - targets
+        loss = jnp.mean(diff ** 2)
+        dy = (2.0 / diff.size) * diff
+
+        tok = create_token()
+        itemsize = jnp.dtype(y.dtype).itemsize
+        pending = []   # (entries, request-or-reduced) in submit order
+        bucket = []    # [(key, grad)] accumulating toward bucket_bytes
+        bucket_nbytes = 0
+
+        def flush(tok):
+            nonlocal bucket, bucket_nbytes
+            if not bucket:
+                return tok, None
+            flat = jnp.concatenate([g.reshape(-1) for _k, g in bucket])
+            entries = [(k, g.shape, g.size) for k, g in bucket]
+            if use_async:
+                handle, tok = iallreduce(
+                    flat, reductions.SUM, comm=comm, token=tok
+                )
+            else:
+                handle, tok = allreduce(
+                    flat, reductions.SUM, comm=comm, token=tok
+                )
+            pending.append((entries, handle))
+            bucket = []
+            bucket_nbytes = 0
+            return tok, tok.stamp
+
+        def push(tok, key, g):
+            nonlocal bucket_nbytes
+            bucket.append((key, g))
+            bucket_nbytes += g.size * itemsize
+            if bucket_nbytes >= bucket_bytes:
+                return flush(tok)
+            return tok, None
+
+        # backward, last layer first — each flush point fences the rest
+        # of the backward pass on the submit's stamp, forcing the DDP
+        # schedule: bucket k on the wire while layer k-1 backprops
+        tok, stamp = push(tok, ("w_out",), h.T @ dy)
+        dh = dy @ params.w_out.T
+        if stamp is not None:
+            dh, _ = lax.optimization_barrier((dh, stamp))
+        for i in reversed(range(len(layers))):
+            h_in, pre = saves[i]
+            w, _b = layers[i]
+            dpre = jnp.where(pre > 0, dh, jnp.zeros((), dh.dtype))
+            tok, stamp = push(tok, ("w", i), h_in.T @ dpre)
+            tok, stamp2 = push(tok, ("b", i), dpre.sum(axis=0))
+            if i > 0:
+                dh = dpre @ w.T
+                gate = stamp2 if stamp2 is not None else stamp
+                if gate is not None:
+                    dh, _ = lax.optimization_barrier((dh, gate))
+        tok, _ = flush(tok)
+
+        # wait every request at the optimizer step and apply updates
+        scale = jnp.asarray(1.0 / n, y.dtype)
+        synced = {}
+        for entries, handle in pending:
+            if use_async:
+                red, tok = wait(handle, token=tok)
+            else:
+                red = handle
+            red = red * scale
+            off = 0
+            for key, shape, size in entries:
+                synced[key] = red[off:off + size].reshape(shape)
+                off += size
+        new_layers = tuple(
+            (w - lr * synced[("w", i)], b - lr * synced[("b", i)])
+            for i, (w, b) in enumerate(layers)
+        )
+        new_params = StackParams(
+            layers=new_layers,
+            w_out=params.w_out - lr * synced[("w_out",)],
+        )
+        if loss_sync:
+            loss_sum, tok = allreduce(
+                loss, reductions.SUM, comm=comm, token=tok
+            )
+            loss = loss_sum / n
+        return new_params, loss
+
+    def step(params, batch):
+        if isinstance(params, StackParams):
+            return stack_step(params, batch)
+        if isinstance(params, MLPParams):
+            return generic_step(params, batch)
+        raise TypeError(
+            f"make_dp_train_step knows StackParams/MLPParams, got "
+            f"{type(params)}"
+        )
+
+    return step
 
 
 def make_global_zero_train_step(mesh, comm_dp, comm_tp, lr=1e-2, momentum=0.9):

@@ -39,6 +39,15 @@ __all__ = [
     "topology",
     "hier_would_select",
     "hier_active",
+    "host_iallreduce",
+    "host_ireduce_scatter",
+    "host_isend",
+    "host_irecv",
+    "host_wait",
+    "host_test",
+    "async_inflight",
+    "async_pending",
+    "async_assert_drained",
     "BridgeError",
     "HANDLER_NAMES",
 ]
@@ -65,6 +74,15 @@ HANDLER_NAMES = [
     "t4j_gather",
     "t4j_scatter",
     "t4j_alltoall",
+    # async progress engine (docs/async.md): in-jit submit/wait fast
+    # path — submits hand the operand to the engine's owned-buffer API
+    # and return a u64 request id; wait/test consume it as data
+    "t4j_iallreduce_submit",
+    "t4j_ireduce_scatter_submit",
+    "t4j_isend_submit",
+    "t4j_irecv_submit",
+    "t4j_async_wait",
+    "t4j_async_test",
 ]
 
 _state = {"lib": None, "registered": False, "comm_cache": {}}
@@ -153,6 +171,29 @@ def _load():
     lib.t4j_c_gather.argtypes = [i32, vp, vp, u64, i32]
     lib.t4j_c_scatter.argtypes = [i32, vp, vp, u64, i32]
     lib.t4j_c_alltoall.argtypes = [i32, vp, vp, u64]
+    # async progress engine (docs/async.md): nonblocking submits return
+    # a request id (0 = failure, message via t4j_last_error)
+    lib.t4j_iallreduce.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_iallreduce.restype = u64
+    lib.t4j_ireduce_scatter.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_ireduce_scatter.restype = u64
+    lib.t4j_isend.argtypes = [i32, vp, u64, i32, i32]
+    lib.t4j_isend.restype = u64
+    lib.t4j_irecv.argtypes = [i32, vp, u64, i32, i32]
+    lib.t4j_irecv.restype = u64
+    lib.t4j_wait.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(i32), ctypes.POINTER(i32),
+    ]
+    lib.t4j_wait.restype = i32
+    lib.t4j_test.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(i32), ctypes.POINTER(i32),
+        ctypes.POINTER(i32),
+    ]
+    lib.t4j_test.restype = i32
+    lib.t4j_waitall.argtypes = [ctypes.POINTER(ctypes.c_uint64), i32]
+    lib.t4j_waitall.restype = i32
+    lib.t4j_async_inflight.restype = i32
+    lib.t4j_async_pending.restype = i32
     for name in (
         "t4j_c_send", "t4j_c_recv", "t4j_c_sendrecv", "t4j_c_barrier",
         "t4j_c_bcast", "t4j_c_allreduce", "t4j_c_hier_allreduce",
@@ -732,6 +773,146 @@ def host_sendrecv(handle, sendbuf, recvbuf, source, dest, sendtag, recvtag):
     return out, np.int32(src.value), np.int32(tg.value)
 
 
+# -- async request layer (docs/async.md) ----------------------------------
+#
+# Nonblocking submits hand the native progress engine RAW buffer
+# pointers, so the numpy arrays MUST outlive the request: the registry
+# below pins (input, output) per request id until the matching
+# host_wait/host_test-done consumes it.  Never-waited entries are the
+# request leaks reported at finalize (and statically by t4j-lint rule
+# T4J008, docs/static-analysis.md).
+
+_async_reqs = {}  # rid -> {"kind", "out", "keep"}
+
+
+def _async_submit(kind, rid, out, keep):
+    if not rid:
+        raise BridgeError(
+            last_error() or f"native {kind} submit failed (no detail)"
+        )
+    _async_reqs[int(rid)] = {"kind": kind, "out": out, "keep": keep}
+    return int(rid)
+
+
+def host_iallreduce(handle, x, opcode):
+    """Submit a nonblocking allreduce; returns the request id.  The
+    result array is produced by :func:`host_wait` on that id."""
+    import numpy as np
+
+    x = _contig(x)
+    out = np.empty_like(x)
+    rid = _state["lib"].t4j_iallreduce(
+        handle, _ptr(x), _ptr(out), x.size, dtype_code(x.dtype), opcode
+    )
+    return _async_submit("iallreduce", rid, out, (x,))
+
+
+def host_ireduce_scatter(handle, x, opcode):
+    """Nonblocking MPI_Reduce_scatter_block submit: ``x`` has shape
+    ``(comm_size, *rest)``; wait returns the reduction of row rank."""
+    import numpy as np
+
+    x = _contig(x)
+    out = np.empty(x.shape[1:], x.dtype)
+    rid = _state["lib"].t4j_ireduce_scatter(
+        handle, _ptr(x), _ptr(out), out.size, dtype_code(x.dtype), opcode
+    )
+    return _async_submit("ireduce_scatter", rid, out, (x,))
+
+
+def host_isend(handle, x, dest, tag):
+    x = _contig(x)
+    rid = _state["lib"].t4j_isend(
+        handle, _ptr(x), x.nbytes, int(dest), int(tag)
+    )
+    return _async_submit("isend", rid, None, (x,))
+
+
+def host_irecv(handle, shape, dtype, source, tag):
+    import numpy as np
+
+    out = np.empty(shape, dtype)
+    rid = _state["lib"].t4j_irecv(
+        handle, _ptr(out), out.nbytes, int(source), int(tag)
+    )
+    return _async_submit("irecv", rid, out, ())
+
+
+def host_wait(rid):
+    """Block until request ``rid`` completes; consumes it.
+
+    Returns ``(out, src, tag)`` — ``out`` is the result array (``None``
+    for isend), ``src``/``tag`` the matched envelope for irecv (-1
+    otherwise).  Raises BridgeError with the engine-side context when
+    the op failed, and on a second wait of the same request."""
+    rec = _async_reqs.pop(int(rid), None)
+    src = ctypes.c_int32(-1)
+    tag = ctypes.c_int32(-1)
+    status = _state["lib"].t4j_wait(
+        ctypes.c_uint64(int(rid)), ctypes.byref(src), ctypes.byref(tag)
+    )
+    if status:
+        raise BridgeError(
+            last_error() or "native wait failed (no detail)"
+        )
+    if rec is None:
+        # the native layer accepted the wait (double-bookkeeping drift:
+        # should be unreachable — native is the source of truth)
+        return None, src.value, tag.value
+    return rec["out"], src.value, tag.value
+
+
+def host_test(rid):
+    """Nonblocking completion probe: True when request ``rid`` is
+    complete (it is NOT consumed — call :func:`host_wait` to fetch the
+    result and release it).  A failed op raises here, consuming it."""
+    done = ctypes.c_int32(0)
+    status = _state["lib"].t4j_test(
+        ctypes.c_uint64(int(rid)), ctypes.byref(done), None, None
+    )
+    if status:
+        _async_reqs.pop(int(rid), None)
+        raise BridgeError(last_error() or "native test failed (no detail)")
+    return bool(done.value)
+
+
+def async_inflight():
+    """Progress-engine gauge: requests submitted but not yet complete
+    (queued + running + parked).  0 when idle or before load."""
+    lib = _state["lib"]
+    return int(lib.t4j_async_inflight()) if lib is not None else 0
+
+
+def async_pending():
+    """Requests this process never consumed with wait (leak gauge).
+
+    The native engine is authoritative: requests submitted through the
+    in-jit FFI fast path never enter the Python-side registry, but
+    every request (FFI or callback path) lives in the engine's inflight
+    table until waited."""
+    lib = _state["lib"]
+    if lib is not None and lib.t4j_initialized():
+        return int(lib.t4j_async_pending())
+    return len(_async_reqs)
+
+
+def async_assert_drained():
+    """Raise if any async request was submitted but never waited — the
+    runtime counterpart of ``Token.assert_drained`` (t4j-lint reports
+    the same statically as rule T4J008)."""
+    n = async_pending()
+    if n:
+        kinds = ", ".join(
+            f"{rec['kind']} (req {rid})"
+            for rid, rec in list(_async_reqs.items())[:8]
+        ) or "submitted via the in-jit fast path"
+        raise BridgeError(
+            f"{n} async request(s) never waited: {kinds}"
+            " — every iallreduce/isend/irecv must be completed by "
+            "wait/waitall exactly once (docs/async.md)"
+        )
+
+
 def available():
     """True when this process is part of a multi-process job (launched
     via mpi4jax_tpu.launch or with T4J_RANK/T4J_SIZE set)."""
@@ -818,6 +999,25 @@ def ensure_initialized():
 def finalize():
     lib = _state["lib"]
     if lib and lib.t4j_initialized():
+        # request-leak detection (docs/async.md): loud on stderr — the
+        # native stop reports its own count too, but only this layer
+        # knows the Python-level op kinds.  Not raised: finalize runs
+        # from atexit, where an exception would mask the job's real
+        # outcome; tests assert on the message instead.
+        if _async_reqs:
+            import sys as _sys
+
+            kinds = ", ".join(
+                rec["kind"] for rec in list(_async_reqs.values())[:8]
+            )
+            print(
+                f"t4j: {len(_async_reqs)} async request(s) never waited "
+                f"at finalize ({kinds}) — request leak; every "
+                "iallreduce/isend/irecv must be completed by "
+                "wait/waitall (docs/async.md)",
+                file=_sys.stderr,
+                flush=True,
+            )
         # snapshot the teardown-sensitive telemetry state (per-link
         # counters, topology) while still initialized: the exit-time
         # rank-file drain deliberately runs AFTER this (atexit LIFO)
@@ -847,6 +1047,7 @@ def finalize():
             except Exception:
                 pass
         lib.t4j_finalize()
+        _async_reqs.clear()  # native reaped everything; release pins
 
 
 def world_rank():

@@ -79,6 +79,23 @@
 // Every phase keeps the deadline/abort contract above — a dead or
 // stalled local rank (leader or not) surfaces on every survivor as a
 // contextual BridgeError within the op deadline.
+//
+// Async progress engine (docs/async.md): nonblocking
+// iallreduce/isend/irecv/ireduce_scatter return a request handle
+// immediately; a dedicated progress thread (grown out of the PR-5
+// accept-thread model) drains a submission queue and drives each
+// operation's segments off the caller's thread, composing with the
+// replay-ring self-healing and the per-segment deadlines unchanged
+// (the op bodies are the SAME code, just executed on the engine
+// thread).  Blocking allreduce/reduce_scatter/send/recv are routed
+// through the engine too (blocking = submit + wait), so there is
+// exactly one wire path and the deadline/abort contract lives in one
+// place.  MPI semantics apply: buffers passed to a nonblocking op must
+// stay valid and unmodified (send side) until the request completes,
+// and every rank must submit collectives on one communicator in the
+// same order.  Requests that are never waited are reported at
+// finalize (request-leak detection; t4j-lint rule T4J008 catches the
+// same statically).
 
 #pragma once
 
@@ -280,6 +297,64 @@ void gather(int comm, const void* in, void* out, size_t nbytes_each,
 void scatter(int comm, const void* in, void* out, size_t nbytes_each,
              int root);
 void alltoall(int comm, const void* in, void* out, size_t nbytes_each);
+
+// -- async progress engine (docs/async.md) --------------------------------
+// Nonblocking ops: submit returns a request id (> 0) immediately; the
+// progress thread executes the wire phase.  Contract (MPI_I* model):
+//   * `in` / `buf` must stay valid and unmodified, and `out` valid,
+//     until the request completes (wait/test-done);
+//   * collectives must be submitted in the same order on every member
+//     rank (the engine executes them in submission order);
+//   * every request must be completed by wait/waitall (or test
+//     returning done) exactly once — a second wait throws, and
+//     requests still pending at finalize are reported as leaks.
+// Argument errors (bad comm/rank/dtype) throw at submit time on the
+// caller's thread; transport failures during execution surface from
+// wait/test as BridgeError with the engine-side context, after the
+// usual fault posting + abort broadcast.
+uint64_t iallreduce(int comm, const void* in, void* out, size_t count,
+                    DType dt, ReduceOp op);
+uint64_t ireduce_scatter(int comm, const void* in, void* out,
+                         size_t count_each, DType dt, ReduceOp op);
+uint64_t isend(int comm, const void* buf, size_t nbytes, int dest, int tag);
+// irecv parks in the engine until a matching frame arrives (it never
+// blocks the progress thread); source/tag may be ANY.  The matched
+// envelope is returned by wait/test via src_out/tag_out.
+uint64_t irecv(int comm, void* buf, size_t nbytes, int source, int tag);
+// Block until the request completes; fills *src_out/*tag_out for
+// irecv (untouched otherwise; null ok).  Consumes the request.
+void wait(uint64_t req, int* src_out, int* tag_out);
+// Nonblocking completion probe: returns true when the request is
+// complete (outputs filled like wait) WITHOUT consuming it — a later
+// wait reaps it.  Throws if the op failed (consuming the request).
+bool test(uint64_t req, int* src_out, int* tag_out);
+void waitall(const uint64_t* reqs, int n);
+// Owned-buffer variants for callers whose buffers do NOT outlive the
+// submit call (the XLA FFI handlers: custom-call operands are reused
+// the moment the handler returns).  The engine copies the input into a
+// request-owned buffer at submit and allocates the result buffer
+// itself; wait_into copies the completed result out.  One extra
+// memcpy per direction versus the zero-copy API above — still far
+// below the host-callback path these exist to replace.
+uint64_t iallreduce_owned(int comm, const void* in, size_t count,
+                          DType dt, ReduceOp op);
+uint64_t ireduce_scatter_owned(int comm, const void* in,
+                               size_t count_each, DType dt, ReduceOp op);
+uint64_t isend_owned(int comm, const void* buf, size_t nbytes, int dest,
+                     int tag);
+uint64_t irecv_owned(int comm, size_t nbytes, int source, int tag);
+// Wait for an owned-buffer request and copy its result into dst
+// (exactly nbytes; dst/nbytes ignored for isend).  Fills
+// *src_out/*tag_out for irecv.  Consumes the request.
+void wait_into(uint64_t req, void* dst, size_t nbytes, int* src_out,
+               int* tag_out);
+
+// Gauge: requests submitted but not yet complete (queued + running +
+// parked).  0 before init / when idle.
+int async_inflight();
+// Requests never consumed by wait/test-done (includes completed ones
+// nobody reaped) — the finalize leak check reads this.
+int async_pending();
 
 // -- internal hooks shared with the shm tier (shm.cc) ---------------------
 namespace detail {

@@ -283,6 +283,101 @@ ffi::Error AlltoallImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
   });
 }
 
+// ---- async submit / wait (docs/async.md) --------------------------------
+//
+// The in-jit fast path for ops/async_.py: a submit handler hands the
+// operand to the progress engine's owned-buffer API (custom-call
+// operands are reused the moment the handler returns) and writes the
+// request id into a u64 scalar output that ``wait``/``test`` consume
+// as an ordinary data dependency — the host-callback detour and its
+// per-call staging cost never enter the compiled program.
+
+void put_req(ffi::Result<ffi::AnyBuffer>& req, uint64_t rid) {
+  *static_cast<uint64_t*>(req->untyped_data()) = rid;
+}
+
+uint64_t get_req(const ffi::AnyBuffer& req) {
+  return *static_cast<const uint64_t*>(req.untyped_data());
+}
+
+ffi::Error IallreduceSubmitImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                                ffi::Result<ffi::AnyBuffer> req,
+                                ffi::Result<ffi::AnyBuffer> stamp_out,
+                                int32_t comm, int32_t op) {
+  return guarded([&] {
+    put_req(req, t4j::iallreduce_owned(comm, x.untyped_data(),
+                                       x.element_count(),
+                                       to_dtype(x.element_type()),
+                                       static_cast<t4j::ReduceOp>(op)));
+    touch_stamp(stamp, stamp_out);
+  });
+}
+
+ffi::Error IreduceScatterSubmitImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                                    ffi::Result<ffi::AnyBuffer> req,
+                                    ffi::Result<ffi::AnyBuffer> stamp_out,
+                                    int32_t comm, int32_t op) {
+  return guarded([&] {
+    int n = t4j::comm_size(comm);
+    put_req(req, t4j::ireduce_scatter_owned(
+                     comm, x.untyped_data(),
+                     x.element_count() / static_cast<size_t>(n),
+                     to_dtype(x.element_type()),
+                     static_cast<t4j::ReduceOp>(op)));
+    touch_stamp(stamp, stamp_out);
+  });
+}
+
+ffi::Error IsendSubmitImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
+                           ffi::Result<ffi::AnyBuffer> req,
+                           ffi::Result<ffi::AnyBuffer> stamp_out,
+                           int32_t comm, int32_t dest, int32_t tag) {
+  return guarded([&] {
+    put_req(req, t4j::isend_owned(comm, x.untyped_data(), x.size_bytes(),
+                                  dest, tag));
+    touch_stamp(stamp, stamp_out);
+  });
+}
+
+ffi::Error IrecvSubmitImpl(ffi::AnyBuffer stamp,
+                           ffi::Result<ffi::AnyBuffer> req,
+                           ffi::Result<ffi::AnyBuffer> stamp_out,
+                           int32_t comm, int32_t source, int32_t tag,
+                           int64_t nbytes) {
+  return guarded([&] {
+    put_req(req, t4j::irecv_owned(comm, static_cast<size_t>(nbytes),
+                                  source, tag));
+    touch_stamp(stamp, stamp_out);
+  });
+}
+
+// y is the result payload (0-sized for isend); status carries the
+// matched (source, tag) envelope for irecv, (-1, -1) otherwise.
+ffi::Error AsyncWaitImpl(ffi::AnyBuffer req, ffi::AnyBuffer stamp,
+                         ffi::Result<ffi::AnyBuffer> y,
+                         ffi::Result<ffi::AnyBuffer> stamp_out,
+                         ffi::Result<ffi::AnyBuffer> status) {
+  return guarded([&] {
+    int src = -1, tag = -1;
+    t4j::wait_into(get_req(req), y->untyped_data(), y->size_bytes(),
+                   &src, &tag);
+    auto* st = static_cast<int32_t*>(status->untyped_data());
+    st[0] = src;
+    st[1] = tag;
+    touch_stamp(stamp, stamp_out);
+  });
+}
+
+ffi::Error AsyncTestImpl(ffi::AnyBuffer req, ffi::AnyBuffer stamp,
+                         ffi::Result<ffi::AnyBuffer> done,
+                         ffi::Result<ffi::AnyBuffer> stamp_out) {
+  return guarded([&] {
+    bool d = t4j::test(get_req(req), nullptr, nullptr);
+    *static_cast<int8_t*>(done->untyped_data()) = d ? 1 : 0;
+    touch_stamp(stamp, stamp_out);
+  });
+}
+
 }  // namespace
 
 // ---- handler symbol definitions ----------------------------------------
@@ -388,6 +483,48 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_alltoall, AlltoallImpl,
                                   .Ret<ffi::AnyBuffer>()
                                   .Ret<ffi::AnyBuffer>()
                                   .Attr<int32_t>("comm"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_iallreduce_submit, IallreduceSubmitImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("op"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_ireduce_scatter_submit,
+                              IreduceScatterSubmitImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("op"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_isend_submit, IsendSubmitImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("dest")
+                                  .Attr<int32_t>("tag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_irecv_submit, IrecvSubmitImpl,
+                              T4J_BUF.Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("source")
+                                  .Attr<int32_t>("tag")
+                                  .Attr<int64_t>("nbytes"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_async_wait, AsyncWaitImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_async_test, AsyncTestImpl,
+                              T4J_BUF.Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>());
 
 // ---- plain-C control API (ctypes) --------------------------------------
 //
@@ -529,6 +666,86 @@ int64_t t4j_metrics_snapshot(uint64_t* out, int64_t max_words) {
   return static_cast<int64_t>(t4j::tel::metrics_snapshot(
       out, max_words < 0 ? 0 : static_cast<size_t>(max_words)));
 }
+
+// ---- async progress engine (docs/async.md) ------------------------------
+//
+// Nonblocking submits return a request id (> 0) or 0 on failure (the
+// message is in t4j_last_error on this thread).  Buffers must stay
+// valid until the request completes; every request must be consumed
+// by wait/waitall (or test returning done) exactly once — leaks are
+// reported at finalize.
+
+uint64_t t4j_iallreduce(int32_t comm, const void* in, void* out,
+                        uint64_t count, int32_t dt, int32_t op) {
+  try {
+    return t4j::iallreduce(comm, in, out, count,
+                           static_cast<t4j::DType>(dt),
+                           static_cast<t4j::ReduceOp>(op));
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return 0;
+  }
+}
+uint64_t t4j_ireduce_scatter(int32_t comm, const void* in, void* out,
+                             uint64_t count_each, int32_t dt, int32_t op) {
+  try {
+    return t4j::ireduce_scatter(comm, in, out, count_each,
+                                static_cast<t4j::DType>(dt),
+                                static_cast<t4j::ReduceOp>(op));
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return 0;
+  }
+}
+uint64_t t4j_isend(int32_t comm, const void* buf, uint64_t nbytes,
+                   int32_t dest, int32_t tag) {
+  try {
+    return t4j::isend(comm, buf, nbytes, dest, tag);
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return 0;
+  }
+}
+uint64_t t4j_irecv(int32_t comm, void* buf, uint64_t nbytes,
+                   int32_t source, int32_t tag) {
+  try {
+    return t4j::irecv(comm, buf, nbytes, source, tag);
+  } catch (const std::exception& e) {
+    g_tls_err = e.what();
+    return 0;
+  }
+}
+// Blocks until the request completes and consumes it; src_out/tag_out
+// carry the matched envelope for irecv (null ok).
+int32_t t4j_wait(uint64_t req, int32_t* src_out, int32_t* tag_out) {
+  return c_guard([&] {
+    int s = -1, t = -1;
+    t4j::wait(req, &s, &t);
+    if (src_out) *src_out = s;
+    if (tag_out) *tag_out = t;
+  });
+}
+// Nonblocking probe: *done = 1 when complete (request NOT consumed —
+// a later wait reaps it); a failed op returns nonzero and consumes.
+int32_t t4j_test(uint64_t req, int32_t* done, int32_t* src_out,
+                 int32_t* tag_out) {
+  return c_guard([&] {
+    int s = -1, t = -1;
+    bool d = t4j::test(req, &s, &t);
+    if (done) *done = d ? 1 : 0;
+    if (d) {
+      if (src_out) *src_out = s;
+      if (tag_out) *tag_out = t;
+    }
+  });
+}
+int32_t t4j_waitall(const uint64_t* reqs, int32_t n) {
+  return c_guard([&] { t4j::waitall(reqs, n); });
+}
+// In-flight-depth gauge (submitted, not yet complete) and the
+// never-consumed request count (the finalize leak check's input).
+int32_t t4j_async_inflight() { return t4j::async_inflight(); }
+int32_t t4j_async_pending() { return t4j::async_pending(); }
 
 int t4j_comm_create(const int32_t* ranks, int32_t n, int32_t ctx) {
   try {

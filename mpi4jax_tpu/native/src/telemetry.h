@@ -89,6 +89,16 @@ enum Kind : uint16_t {
   // shm arena stages (trace instants)
   kShmStage = 40,
   kShmFold = 41,
+  // async progress engine (trace instants; docs/async.md).  The
+  // 32-byte record has no spare field, so these three overload two:
+  // `peer` carries the in-flight-depth gauge (the engine has no wire
+  // peer), and while kOpQueued/kOpProgress put the payload size in
+  // `bytes`, kOpComplete's `bytes` is the op's EXECUTION duration in
+  // ns — t4j-top derives queue depth and the engine overlap ratio
+  // from these without needing per-event request ids.
+  kOpQueued = 50,
+  kOpProgress = 51,
+  kOpComplete = 52,
 };
 
 enum Phase : uint8_t { kInstant = 0, kBegin = 1, kEnd = 2 };

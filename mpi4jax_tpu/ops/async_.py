@@ -1,0 +1,501 @@
+"""Nonblocking collectives and p2p: request futures over the native
+async progress engine (docs/async.md).
+
+The reference substrate's real-world speed came from MPI's nonblocking
+progress (``MPI_Isend``/``MPI_Irecv``/``MPI_Iallreduce``): submit
+returns immediately, a progress engine drives the wire phase, and the
+caller overlaps its own compute until ``MPI_Wait``.  This module is
+that contract as JAX ops:
+
+* :func:`iallreduce` / :func:`ireduce_scatter` / :func:`isend` /
+  :func:`irecv` submit to the native progress engine
+  (native/src/dcn.cc) and return a :class:`Request` immediately;
+* :func:`wait` / :func:`waitall` / :func:`test` complete a request.
+
+A :class:`Request` is a pytree whose leaves are the request id and an
+ordering stamp, so it threads through ``jit`` as data: ``wait`` is a
+**data dependency** on the submit that produced it — XLA cannot reorder
+a wait before its submit, and compute placed between the two overlaps
+the engine's wire phase.  Ordering between submits rides the same Token
+machinery as every blocking op (ops/_core.py): each submit consumes and
+returns a token, so the engine receives collectives in one well-defined
+program order on every rank (the MPI requirement for nonblocking
+collectives).
+
+Request discipline (MPI semantics):
+
+* every request must be consumed by ``wait``/``waitall`` (or ``test``
+  returning done followed by ``wait``) **exactly once** — a second wait
+  raises, and requests never waited are reported at finalize
+  (``native/runtime.py``) and statically by ``t4j-lint`` rule T4J008
+  (docs/static-analysis.md);
+* the submitted operand is pinned host-side until completion (the
+  runtime registry holds it), so donation/reuse of the JAX value is
+  safe.
+
+Backends: ``proc`` submits to the native engine (the point of the
+subsystem).  ``self`` completes trivially at submit (the request
+carries the value), so single-process programs and tests exercise the
+full API surface.  The mesh backend raises ``NotImplementedError`` —
+inside one XLA program the compiler already schedules collectives
+asynchronously, and a host-side engine has nothing to add.
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node
+
+from mpi4jax_tpu.ops import reductions
+from mpi4jax_tpu.ops._core import (
+    ANY_SOURCE,
+    ANY_TAG,
+    as_token,
+    publishes_token,
+)
+from mpi4jax_tpu.utils.validation import (
+    check_comm,
+    check_op,
+    check_rank_range,
+)
+
+__all__ = [
+    "Request",
+    "iallreduce",
+    "ireduce_scatter",
+    "isend",
+    "irecv",
+    "wait",
+    "waitall",
+    "test",
+    "assert_requests_drained",
+]
+
+_RID = jax.ShapeDtypeStruct((), np.uint64)
+_STAMP = jax.ShapeDtypeStruct((), np.float32)
+_STATUS = jax.ShapeDtypeStruct((2,), np.int32)
+
+
+def _use_ffi():
+    """In-jit fast path: submit/wait lower to native XLA custom calls
+    (ffi.cc t4j_*_submit / t4j_async_wait) whenever arrays are already
+    host-side — the host-callback detour and its per-call staging cost
+    are what ate the overlap win (docs/async.md "measured overhead").
+    Accelerator backends keep the staged io_callback path."""
+    from mpi4jax_tpu.ops import _proc
+
+    return not _proc._staged()
+
+
+@dataclass(frozen=True)
+class _RequestMeta:
+    """Static half of a Request (pytree aux data)."""
+
+    kind: str          # "iallreduce" | "ireduce_scatter" | "isend" | "irecv"
+    backend: str       # "proc" | "self"
+    shape: tuple       # result shape ("" for isend)
+    dtype: str         # result dtype
+    comm_key: tuple
+
+
+class Request:
+    """Handle for an in-flight nonblocking op.
+
+    A pytree: the request id (or, on the ``self`` backend, the already-
+    complete value) and the submit-time stamp are leaves, so a Request
+    flows through ``jit``/``scan`` carries and ``wait`` inside the same
+    trace is a data dependency on the submit.  Consume with
+    :func:`wait`/:func:`waitall` exactly once.
+    """
+
+    def __init__(self, payload, stamp, meta):
+        self.payload = payload  # rid array (proc) / result value (self)
+        self.stamp = stamp
+        self.meta = meta
+        self._consumed = False
+
+    def __repr__(self):
+        return f"Request({self.meta.kind}, backend={self.meta.backend})"
+
+
+def _request_flatten(req):
+    return (req.payload, req.stamp), (req.meta, req._consumed)
+
+
+def _request_unflatten(aux, children):
+    req = Request(children[0], children[1], aux[0])
+    req._consumed = aux[1]
+    return req
+
+
+register_pytree_node(Request, _request_flatten, _request_unflatten)
+
+
+def _result_sds(meta):
+    return jax.ShapeDtypeStruct(meta.shape, np.dtype(meta.dtype))
+
+
+def _mark_consumed(req, what):
+    if req._consumed:
+        raise RuntimeError(
+            f"{what} on an already-consumed request ({req.meta.kind}): a "
+            "request may be waited exactly once (docs/async.md; t4j-lint "
+            "rule T4J008)"
+        )
+    object.__setattr__(req, "_consumed", True)
+
+
+def _io(cb, results, *operands):
+    from mpi4jax_tpu.ops._proc import _io as proc_io
+
+    return proc_io(cb, results, *operands)
+
+
+def _check_async_backend(comm, opname):
+    if comm.backend == "mesh":
+        raise NotImplementedError(
+            f"{opname} is not defined on the mesh backend: inside one "
+            "XLA program the compiler already overlaps collectives; "
+            "nonblocking requests are a proc-tier (multi-process) "
+            "concept (docs/async.md)"
+        )
+
+
+# ---------------------------------------------------------------- submits
+
+
+@publishes_token
+def iallreduce(x, op=reductions.SUM, *, comm=None, token=None):
+    """Nonblocking all-reduce: returns ``(request, token)`` immediately;
+    the wire phase runs on the native progress engine while the caller
+    keeps computing.  Complete with :func:`wait`, which returns the
+    reduced array.  Builtin ops only (user-defined ops need the
+    traceable fold of the blocking path)."""
+    op = check_op(op)
+    comm = check_comm(comm)
+    _check_async_backend(comm, "iallreduce")
+    token = as_token(token)
+    x = jnp.asarray(x)
+    meta = _RequestMeta(
+        "iallreduce", comm.backend, tuple(jnp.shape(x)),
+        str(jnp.result_type(x)), _comm_key(comm),
+    )
+    if comm.backend == "self":
+        return Request(x, token.stamp, meta), token
+    if getattr(op, "is_user", False):
+        raise NotImplementedError(
+            "iallreduce supports builtin reduction ops only; route "
+            "user-defined ops through the blocking allreduce"
+        )
+    from mpi4jax_tpu.ops import _proc
+
+    h = int(_proc._handle(comm))
+    code = _proc._op_code(op)
+    if _use_ffi():
+        rid, stamp = _proc._call(
+            "t4j_iallreduce_submit", (_RID, _STAMP), x, token.stamp,
+            comm=np.int32(h), op=np.int32(code),
+        )
+        return Request(rid, stamp, meta), token.with_stamp(stamp)
+
+    def cb(x_, stamp_):
+        from mpi4jax_tpu.native import runtime
+
+        rid = runtime.host_iallreduce(h, np.asarray(x_), code)
+        return np.uint64(rid), stamp_
+
+    rid, stamp = _io(cb, (_RID, _STAMP), x, token.stamp)
+    return Request(rid, stamp, meta), token.with_stamp(stamp)
+
+
+@publishes_token
+def ireduce_scatter(x, op=reductions.SUM, *, comm=None, token=None):
+    """Nonblocking ``MPI_Reduce_scatter_block``: ``x`` has shape
+    ``(comm.size, *rest)``; :func:`wait` returns the reduction of row
+    ``rank`` with shape ``rest``.  Builtin ops only."""
+    op = check_op(op)
+    comm = check_comm(comm)
+    _check_async_backend(comm, "ireduce_scatter")
+    token = as_token(token)
+    x = jnp.asarray(x)
+    shape = tuple(jnp.shape(x))
+    if not shape or shape[0] != comm.size:
+        raise ValueError(
+            f"ireduce_scatter input must have shape (comm.size, ...) = "
+            f"({comm.size}, ...), got {shape}"
+        )
+    meta = _RequestMeta(
+        "ireduce_scatter", comm.backend, shape[1:],
+        str(jnp.result_type(x)), _comm_key(comm),
+    )
+    if comm.backend == "self":
+        return Request(x[0], token.stamp, meta), token
+    if getattr(op, "is_user", False):
+        raise NotImplementedError(
+            "ireduce_scatter supports builtin reduction ops only"
+        )
+    from mpi4jax_tpu.ops import _proc
+
+    h = int(_proc._handle(comm))
+    code = _proc._op_code(op)
+    if _use_ffi():
+        rid, stamp = _proc._call(
+            "t4j_ireduce_scatter_submit", (_RID, _STAMP), x, token.stamp,
+            comm=np.int32(h), op=np.int32(code),
+        )
+        return Request(rid, stamp, meta), token.with_stamp(stamp)
+
+    def cb(x_, stamp_):
+        from mpi4jax_tpu.native import runtime
+
+        rid = runtime.host_ireduce_scatter(h, np.asarray(x_), code)
+        return np.uint64(rid), stamp_
+
+    rid, stamp = _io(cb, (_RID, _STAMP), x, token.stamp)
+    return Request(rid, stamp, meta), token.with_stamp(stamp)
+
+
+@publishes_token
+def isend(x, dest, tag=0, *, comm=None, token=None):
+    """Nonblocking send: returns ``(request, token)`` immediately.  The
+    matching receive is a peer's :func:`irecv` (or blocking ``recv``).
+    ``wait`` on the request returns ``None`` — it marks the point after
+    which the payload has left this rank's send path."""
+    comm = check_comm(comm)
+    _check_async_backend(comm, "isend")
+    token = as_token(token)
+    x = jnp.asarray(x)
+    dest = check_rank_range(dest, "dest", comm.size)
+    tag = int(tag)
+    meta = _RequestMeta(
+        "isend", comm.backend, (), str(jnp.result_type(x)),
+        _comm_key(comm),
+    )
+    if comm.backend == "self":
+        raise NotImplementedError(
+            "isend on the self backend has no peer to receive; use the "
+            "proc backend (a launched multi-process job)"
+        )
+    from mpi4jax_tpu.ops import _proc
+
+    h = int(_proc._handle(comm))
+    if _use_ffi():
+        rid, stamp = _proc._call(
+            "t4j_isend_submit", (_RID, _STAMP), x, token.stamp,
+            comm=np.int32(h), dest=np.int32(dest), tag=np.int32(tag),
+        )
+        return Request(rid, stamp, meta), token.with_stamp(stamp)
+
+    def cb(x_, stamp_):
+        from mpi4jax_tpu.native import runtime
+
+        rid = runtime.host_isend(h, np.asarray(x_), dest, tag)
+        return np.uint64(rid), stamp_
+
+    rid, stamp = _io(cb, (_RID, _STAMP), x, token.stamp)
+    return Request(rid, stamp, meta), token.with_stamp(stamp)
+
+
+@publishes_token
+def irecv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None):
+    """Nonblocking receive into the shape/dtype of template ``x``.
+
+    The request parks in the progress engine until a matching message
+    arrives — it never blocks the engine, so collectives submitted
+    after it still make progress (MPI irecv semantics).  ``wait``
+    returns the received array."""
+    comm = check_comm(comm)
+    _check_async_backend(comm, "irecv")
+    token = as_token(token)
+    if source != ANY_SOURCE:
+        source = check_rank_range(source, "source", comm.size)
+    tag = int(tag)
+    meta = _RequestMeta(
+        "irecv", comm.backend, tuple(jnp.shape(x)),
+        str(jnp.result_type(x)), _comm_key(comm),
+    )
+    if comm.backend == "self":
+        raise NotImplementedError(
+            "irecv on the self backend has no peer to receive from; use "
+            "the proc backend (a launched multi-process job)"
+        )
+    from mpi4jax_tpu.ops import _proc
+
+    h = int(_proc._handle(comm))
+    shape = tuple(jnp.shape(x))
+    dtype = jnp.result_type(x)
+    if _use_ffi():
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        rid, stamp = _proc._call(
+            "t4j_irecv_submit", (_RID, _STAMP), token.stamp,
+            comm=np.int32(h), source=np.int32(source),
+            tag=np.int32(tag), nbytes=np.int64(nbytes),
+        )
+        return Request(rid, stamp, meta), token.with_stamp(stamp)
+
+    def cb(stamp_):
+        from mpi4jax_tpu.native import runtime
+
+        rid = runtime.host_irecv(h, shape, dtype, source, tag)
+        return np.uint64(rid), stamp_
+
+    rid, stamp = _io(cb, (_RID, _STAMP), token.stamp)
+    return Request(rid, stamp, meta), token.with_stamp(stamp)
+
+
+# ---------------------------------------------------------------- waits
+
+
+@publishes_token
+def wait(req, *, token=None, status=None):
+    """Complete a request: returns ``(result, token)``.
+
+    ``result`` is the op's output (reduced array for iallreduce, the
+    row block for ireduce_scatter, the received array for irecv) and
+    ``None`` for isend.  For an ``irecv`` request, ``status`` (a
+    :class:`~mpi4jax_tpu.ops.p2p.Status`) receives the matched
+    ``(source, tag)`` envelope — the only way to learn the sender of an
+    ``ANY_SOURCE`` receive, same out-param convention as blocking
+    :func:`~mpi4jax_tpu.ops.p2p.recv`.  Inside ``jit`` the wait is a
+    data dependency on the request id, so XLA keeps every submit before
+    its wait and is free to schedule independent compute between the
+    two — that window is the compute/comm overlap.  A request may be
+    waited exactly once; requests never waited are reported at finalize
+    and by t4j-lint rule T4J008."""
+    if not isinstance(req, Request):
+        raise TypeError(f"wait expects a Request, got {type(req)}")
+    from mpi4jax_tpu.ops.p2p import _deliver_status
+
+    _mark_consumed(req, "wait")
+    token = as_token(token)
+    meta = req.meta
+    if meta.backend == "self":
+        value = req.payload if meta.kind != "isend" else None
+        return value, token
+    if _use_ffi():
+        from mpi4jax_tpu.ops import _proc
+
+        # isend has no result payload: a 0-sized sink keeps one wait
+        # handler for every kind (ffi.cc AsyncWaitImpl)
+        out_sds = (jax.ShapeDtypeStruct((0,), np.uint8)
+                   if meta.kind == "isend" else _result_sds(meta))
+        out, stamp, st = _proc._call(
+            "t4j_async_wait", (out_sds, _STAMP, _STATUS),
+            req.payload, _merge(req, token),
+        )
+        if status is not None and meta.kind == "irecv":
+            _deliver_status(status, st)
+        if meta.kind == "isend":
+            return None, token.with_stamp(stamp)
+        return out, token.with_stamp(stamp)
+    from mpi4jax_tpu.telemetry import recorder as _telrec
+
+    if meta.kind == "isend":
+        def cb(rid_, stamp_):
+            from mpi4jax_tpu.native import runtime
+
+            with _telrec.py_op("wait", 0):
+                runtime.host_wait(int(rid_))
+            return stamp_
+
+        stamp = _io(cb, _STAMP, req.payload, _merge(req, token))
+        return None, token.with_stamp(stamp)
+
+    out_sds = _result_sds(meta)
+
+    def cb(rid_, stamp_):
+        from mpi4jax_tpu.native import runtime
+
+        with _telrec.py_op("wait", 0):
+            out, src_, tag_ = runtime.host_wait(int(rid_))
+        return np.asarray(out), np.array([src_, tag_], np.int32), stamp_
+
+    out, st, stamp = _io(cb, (out_sds, _STATUS, _STAMP), req.payload,
+                         _merge(req, token))
+    if status is not None and meta.kind == "irecv":
+        _deliver_status(status, st)
+    return out, token.with_stamp(stamp)
+
+
+def _waitall(reqs, *, token=None):
+    token = as_token(token)
+    results = []
+    for req in reqs:
+        value, token = wait(req, token=token)
+        results.append(value)
+    return results, token
+
+
+# The analyzer (analysis/record.py) binds the ORIGINAL call arguments
+# when it records the op, so a generator argument would reach it
+# exhausted and every request in it would lint as a T4J008 leak.
+# Materialize in a plain outer wrapper so the instrumented function —
+# and therefore the recorded event — always sees a tuple.
+_waitall.__name__ = "waitall"
+_waitall = publishes_token(_waitall)
+
+
+def waitall(reqs, *, token=None):
+    """Complete a sequence of requests (in order); returns
+    ``(results, token)`` with one entry per request (``None`` for
+    isends).  ``reqs`` may be any iterable of Requests."""
+    return _waitall(tuple(reqs), token=token)
+
+
+@publishes_token
+def test(req, *, token=None):
+    """Nonblocking completion probe: returns ``(done, token)`` with
+    ``done`` a scalar bool array.  The request is NOT consumed — call
+    :func:`wait` to fetch the result (it returns immediately once
+    ``done`` is True)."""
+    if not isinstance(req, Request):
+        raise TypeError(f"test expects a Request, got {type(req)}")
+    token = as_token(token)
+    if req.meta.backend == "self":
+        return jnp.asarray(True), token
+    if _use_ffi():
+        from mpi4jax_tpu.ops import _proc
+
+        done, stamp = _proc._call(
+            "t4j_async_test",
+            (jax.ShapeDtypeStruct((), np.bool_), _STAMP),
+            req.payload, _merge(req, token),
+        )
+        return done, token.with_stamp(stamp)
+
+    def cb(rid_, stamp_):
+        from mpi4jax_tpu.native import runtime
+
+        return np.bool_(runtime.host_test(int(rid_))), stamp_
+
+    done, stamp = _io(
+        cb, (jax.ShapeDtypeStruct((), np.bool_), _STAMP),
+        req.payload, _merge(req, token),
+    )
+    return done, token.with_stamp(stamp)
+
+
+def assert_requests_drained():
+    """Raise if this process holds async requests that were submitted
+    but never waited (the runtime counterpart of
+    ``Token.assert_drained``; t4j-lint reports the same statically as
+    rule T4J008)."""
+    from mpi4jax_tpu.native import runtime
+
+    runtime.async_assert_drained()
+
+
+def _merge(req, token):
+    """Stamp that depends on BOTH the request's submit and the ambient
+    token chain, so a wait is ordered after its submit and after any
+    ops chained on the token since."""
+    return req.stamp + 0 * token.stamp
+
+
+def _comm_key(comm):
+    from mpi4jax_tpu.ops._core import comm_key
+
+    return comm_key(comm)

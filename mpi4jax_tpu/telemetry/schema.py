@@ -58,6 +58,14 @@ KIND_NAMES = {
     34: "fault",
     40: "shm_stage",
     41: "shm_fold",
+    # async progress engine (docs/async.md).  Field overloads (the
+    # 32-byte record has no spare): `peer` carries the in-flight-depth
+    # gauge; `bytes` is the payload size for op_queued/op_progress but
+    # the op's EXECUTION duration in ns for op_complete — t4j-top
+    # derives queue depth and the engine overlap ratio from these.
+    50: "op_queued",
+    51: "op_progress",
+    52: "op_complete",
 }
 KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
 
@@ -65,6 +73,20 @@ KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
 # metrics-table rows.
 OP_KINDS = frozenset(range(1, 15))
 CONTROL_KINDS = frozenset((30, 31, 32, 33, 34))
+# Async engine instants (docs/async.md): per-request lifecycle markers.
+ASYNC_KINDS = frozenset((50, 51, 52))
+
+# Async events pack the submitted op's kind into the comm field's high
+# byte ((kind+1) << 24 | comm & 0xFFFFFF — dcn.cc async_evt_comm), so
+# t4j-top can attribute depth/busy-time per op without per-event ids.
+ASYNC_OP_NAMES = {1: "iallreduce", 2: "ireduce_scatter", 3: "isend",
+                  4: "irecv", 5: "blocking"}
+
+
+def decode_async_comm(field):
+    """(async op name, comm handle) from an async event's comm field."""
+    f = int(field)
+    return ASYNC_OP_NAMES.get((f >> 24) & 0xFF, "?"), f & 0xFFFFFF
 
 PHASE_INSTANT, PHASE_BEGIN, PHASE_END = 0, 1, 2
 PHASE_NAMES = {0: "instant", 1: "begin", 2: "end"}

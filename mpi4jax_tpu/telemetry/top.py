@@ -15,6 +15,9 @@ ranks rewrite their files at exit, and long-running jobs can call
 * per-link throughput (from trace-mode frame events) plus the
   self-healing reconnect/replay counters per link — the worst-link
   signal serving admission control keys on;
+* the async progress engine's per-op queue depth (max/mean), engine
+  busy time, and the per-rank overlap ratio — the share of engine comm
+  time NOT covered by a caller blocked in wait (docs/async.md);
 * per-rank totals (events, drops, faults).
 
 Console-script twin of ``t4j-lint`` (pyproject.toml); import-free of
@@ -61,6 +64,7 @@ def summarize(rank_objs):
     reg = MetricsRegistry()
     per_rank = []
     links = {}
+    async_rows = {}  # (rank, op) -> accumulators
     for obj in rank_objs:
         reg.merge(MetricsRegistry.from_snapshot(obj["metrics"]))
         rank = int(obj["rank"])
@@ -81,6 +85,52 @@ def summarize(rank_objs):
                 link["frames"] += 1
                 link["t_lo"] = min(link["t_lo"], e.t_ns)
                 link["t_hi"] = max(link["t_hi"], e.t_ns)
+        # async progress engine (docs/async.md): queue depth rides the
+        # events' peer field, op_complete's bytes is the execution
+        # duration in ns (schema.py ASYNC_KINDS field overloads)
+        for e in events:
+            if e.kind not in schema.ASYNC_KINDS:
+                continue
+            op, _comm = schema.decode_async_comm(e.comm)
+            row = async_rows.setdefault(
+                (rank, op),
+                {"submitted": 0, "completed": 0, "max_depth": 0,
+                 "depth_sum": 0, "depth_n": 0, "busy_ns": 0},
+            )
+            row["max_depth"] = max(row["max_depth"], max(e.peer, 0))
+            row["depth_sum"] += max(e.peer, 0)
+            row["depth_n"] += 1
+            if e.kind == schema.KIND_IDS["op_queued"]:
+                row["submitted"] += 1
+            elif e.kind == schema.KIND_IDS["op_complete"]:
+                row["completed"] += 1
+                row["busy_ns"] += e.bytes
+        # caller-side blocked time (python lane, trace mode): EVERY
+        # comm-op bracket occupies its caller — a blocking collective
+        # (routed through the engine as submit+wait) for its whole
+        # span, a submit for its (tiny) handoff, a wait for the
+        # residual comm the caller failed to hide.  Of the engine's
+        # busy time, the share NOT covered by a caller inside some comm
+        # bracket is comm overlapped with the callers' own compute.
+        # (Counting only "wait" spans here scored fully-blocking
+        # workloads as 100% overlapped — the inverse of the truth.)
+        blocked_ns = 0
+        begins = {}
+        for row_ in obj.get("py_events", ()):
+            t_ns, name, phase, _nb = row_[0], row_[1], row_[2], row_[3]
+            if phase == 1:
+                begins[name] = t_ns
+            elif phase == 2 and name in begins:
+                blocked_ns += max(0, t_ns - begins.pop(name))
+        rank_busy = sum(v["busy_ns"] for (r, _o), v in async_rows.items()
+                        if r == rank)
+        overlap_pct = None
+        if rank_busy > 0 and obj.get("py_events"):
+            overlap_pct = max(0.0, min(1.0, 1.0 - blocked_ns / rank_busy))
+            overlap_pct = round(100.0 * overlap_pct, 1)
+        for (r, _o), v in async_rows.items():
+            if r == rank:
+                v["overlap_pct"] = overlap_pct
         per_peer = (obj.get("link_stats") or {}).get("per_peer", {})
         for peer, s in per_peer.items():
             link = links.setdefault(
@@ -124,10 +174,24 @@ def summarize(rank_objs):
             "replayed_frames": link.get("replayed_frames", 0),
             "state": link.get("state", 0),
         })
+    async_out = []
+    for (rank, op), v in sorted(async_rows.items()):
+        async_out.append({
+            "rank": rank,
+            "op": op,
+            "submitted": v["submitted"],
+            "completed": v["completed"],
+            "max_depth": v["max_depth"],
+            "mean_depth": round(v["depth_sum"] / v["depth_n"], 2)
+            if v["depth_n"] else 0.0,
+            "busy_ms": round(v["busy_ns"] / 1e6, 3),
+            "overlap_pct": v.get("overlap_pct"),
+        })
     return {
         "ranks": per_rank,
         "ops": ops,
         "links": link_rows,
+        "async": async_out,
         "bytes_by_plane": reg.bytes_by_plane(),
     }
 
@@ -158,6 +222,18 @@ def render(summary):
                 f"{_fmt_bytes(s['bytes']):>10}"
                 f" {_fmt_ms(s['p50_ms'])}{_fmt_ms(s['p99_ms'])}"
                 f"{_fmt_ms(s['max_ms'])}"
+            )
+    if summary.get("async"):
+        out.append("")
+        out.append(f"  {'async op':<18}{'rank':>5}{'subm':>7}{'done':>7}"
+                   f"{'maxQ':>6}{'meanQ':>7}{'busy ms':>10}"
+                   f"{'overlap%':>10}")
+        for a in summary["async"]:
+            ov = "-" if a["overlap_pct"] is None else f"{a['overlap_pct']:.1f}"
+            out.append(
+                f"  {a['op']:<18}r{a['rank']:<4}{a['submitted']:>7}"
+                f"{a['completed']:>7}{a['max_depth']:>6}"
+                f"{a['mean_depth']:>7.2f}{a['busy_ms']:>10.3f}{ov:>10}"
             )
     if summary["links"]:
         out.append("")

@@ -62,6 +62,14 @@ communicators with multiple ranks per host (docs/performance.md
                                   hierarchical path is taken (default
                                   256 KiB, the measured crossover).
 
+Async progress engine / gradient bucketing (docs/async.md):
+
+* ``T4J_BUCKET_BYTES`` — gradient-bucket size for ``BucketedGradSync``
+                         (default 4 MiB): backprop-ordered gradients
+                         are packed into buckets of about this size and
+                         each bucket's ``iallreduce`` overlaps the rest
+                         of the backward pass.
+
 Telemetry (docs/observability.md):
 
 * ``T4J_TELEMETRY``       — ``off`` (default: zero-cost no-op),
@@ -110,6 +118,7 @@ __all__ = [
     "backoff_base",
     "backoff_max",
     "replay_bytes",
+    "bucket_bytes",
     "verify_mode",
     "telemetry_mode",
     "telemetry_bytes",
@@ -279,6 +288,26 @@ def replay_bytes():
         32 << 20,
         name="T4J_REPLAY_BYTES",
     )
+
+
+def bucket_bytes():
+    """Gradient-bucket size for ``BucketedGradSync`` in bytes (default
+    4 MiB; must be >= 1).  Backprop-ordered gradients are packed into
+    buckets of about this size and each bucket's ``iallreduce`` is
+    submitted as soon as the bucket is full, so its wire phase overlaps
+    the rest of the backward pass (docs/async.md "gradient bucketing").
+    Smaller buckets start overlapping earlier but pay more per-op
+    latency; larger ones amortise better but delay the first submit."""
+    v = byte_count(
+        os.environ.get("T4J_BUCKET_BYTES"), 4 << 20,
+        name="T4J_BUCKET_BYTES",
+    )
+    if v < 1:
+        raise ValueError(
+            "T4J_BUCKET_BYTES must be >= 1 (a gradient bucket cannot "
+            "be empty)"
+        )
+    return v
 
 
 def ring_min_bytes():

@@ -237,12 +237,88 @@ class TestFingerprintCore:
         assert "allreduce" in msg and "bcast" in msg
 
 
+class TestRequestRules:
+    """T4J008 — async request discipline (docs/async.md)."""
+
+    def test_never_waited(self, contracts):
+        events = [
+            ev(contracts, 0, "iallreduce", token_in=1, token_out=2,
+               request_out=500),
+        ]
+        findings = contracts.check_schedule(events)
+        assert [f.rule for f in findings] == ["T4J008"]
+        assert "never consumed" in findings[0].message
+
+    def test_waited_once_clean(self, contracts):
+        events = [
+            ev(contracts, 0, "iallreduce", token_in=1, token_out=2,
+               request_out=500),
+            ev(contracts, 1, "wait", token_in=2, token_out=3,
+               requests_in=(500,)),
+        ]
+        assert contracts.check_schedule(events) == []
+
+    def test_double_wait(self, contracts):
+        events = [
+            ev(contracts, 0, "iallreduce", token_in=1, token_out=2,
+               request_out=500),
+            ev(contracts, 1, "wait", token_in=2, token_out=3,
+               requests_in=(500,)),
+            ev(contracts, 2, "wait", token_in=3, token_out=4,
+               requests_in=(500,)),
+        ]
+        findings = contracts.check_schedule(events)
+        assert [f.rule for f in findings] == ["T4J008"]
+        assert "waited again" in findings[0].message
+        assert "exactly once" in findings[0].message
+
+    def test_waitall_consumes_many(self, contracts):
+        events = [
+            ev(contracts, 0, "isend", token_in=1, token_out=2,
+               request_out=500, dest=1, tag=0),
+            ev(contracts, 1, "irecv", token_in=2, token_out=3,
+               request_out=501, source=1, tag=0),
+            ev(contracts, 2, "waitall", token_in=3, token_out=4,
+               requests_in=(500, 501)),
+        ]
+        assert contracts.check_schedule(events) == []
+
+    def test_one_of_many_leaks(self, contracts):
+        events = [
+            ev(contracts, 0, "iallreduce", token_in=1, token_out=2,
+               request_out=500),
+            ev(contracts, 1, "iallreduce", token_in=2, token_out=3,
+               request_out=501),
+            ev(contracts, 2, "wait", token_in=3, token_out=4,
+               requests_in=(501,)),
+        ]
+        findings = contracts.check_schedule(events)
+        assert [f.rule for f in findings] == ["T4J008"]
+        # the finding anchors on the LEAKED submit, not the wait
+        assert findings[0].event_seq == 0
+
+    def test_test_probe_does_not_consume(self, contracts):
+        events = [
+            ev(contracts, 0, "iallreduce", token_in=1, token_out=2,
+               request_out=500),
+            ev(contracts, 1, "test", token_in=2, token_out=3,
+               requests_in=(500,)),
+            ev(contracts, 2, "wait", token_in=3, token_out=4,
+               requests_in=(500,)),
+        ]
+        assert contracts.check_schedule(events) == []
+
+    def test_rule_catalogued(self, contracts):
+        assert "T4J008" in contracts.RULES
+        assert "never waited" in contracts.RULES["T4J008"]
+
+
 class TestRuleCatalog:
     def test_ids_stable(self, contracts):
         # released IDs are frozen: renumbering breaks suppressions and
         # CI greps downstream
         assert set(contracts.RULES) == {
-            f"T4J00{i}" for i in range(1, 8)
+            f"T4J00{i}" for i in range(1, 9)
         }
 
     def test_finding_str_carries_rule_and_src(self, contracts):

@@ -1,0 +1,361 @@
+"""Nonblocking collectives and request futures on the proc tier
+(docs/async.md).
+
+Every case spawns a real multi-process world and drives the public
+async API (``iallreduce``/``isend``/``irecv``/``ireduce_scatter`` +
+``wait``/``waitall``/``test``) through jit, asserting results
+BIT-identical to the blocking counterparts — the engine executes the
+same op bodies, so any divergence is a routing bug, not a rounding
+difference.  Covered:
+
+* SUM and MAX over non-power-of-two sizes, waits issued out of order;
+* several overlapping requests in flight on one communicator;
+* isend/irecv (incl. ANY_SOURCE envelope reporting) and
+  ireduce_scatter;
+* request discipline: double wait raises, ``test`` does not consume,
+  a leaked request is reported at finalize (T4J008's runtime twin);
+* ``fault``-marked: an in-flight ``iallreduce`` rides out a flaky
+  fabric (rank 1 drops every TCP connection twice mid-collective) and
+  completes bit-identical with zero aborts — nonblocking requests
+  compose with the PR-5 self-healing transport.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import uuid
+from pathlib import Path
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401 -- probe only
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+PREAMBLE = """
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.native import runtime
+
+runtime.ensure_initialized()
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+rank, size = comm.rank(), comm.size
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_world(tmp_path, body, nprocs, env_common=None, timeout=180):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(body))
+    coord = f"127.0.0.1:{_free_port()}"
+    job = uuid.uuid4().hex[:12]
+    procs = []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(
+            T4J_RANK=str(rank), T4J_SIZE=str(nprocs), T4J_COORD=coord,
+            T4J_JOB=job,
+        )
+        env.update(env_common or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=env,
+                cwd=str(REPO),
+                start_new_session=True,
+            )
+        )
+    results = [None] * nprocs
+    deadline = time.monotonic() + timeout
+    try:
+        for rank, p in enumerate(procs):
+            left = max(1.0, deadline - time.monotonic())
+            try:
+                out, err = p.communicate(timeout=left)
+            except subprocess.TimeoutExpired:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                out, err = p.communicate()
+                results[rank] = ("HUNG", out, err)
+                continue
+            results[rank] = (p.returncode, out, err)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except OSError:
+                    pass
+    return results
+
+
+def _assert_ok(res, marker):
+    for rank, (rc, out, err) in enumerate(res):
+        assert rc == 0, (rank, rc, out[-3000:], err[-3000:])
+        assert marker in out, (rank, out[-3000:], err[-3000:])
+
+
+# --------------------------------------------------------------- identity
+
+
+MATRIX_BODY = PREAMBLE + """
+def check(label, got, want):
+    got, want = np.asarray(got), np.asarray(want)
+    assert got.dtype == want.dtype and got.shape == want.shape, label
+    assert got.tobytes() == want.tobytes(), (
+        label, got.ravel()[:4], want.ravel()[:4]
+    )
+
+# small integers: SUM is exact in f32 over any association, so
+# "nonblocking == blocking" is a bit-level contract
+def data(count, r, salt=0):
+    rng = np.random.default_rng(1000 * salt + 17 * r + count)
+    return rng.integers(0, 8, size=count).astype(np.float32)
+
+tok = m.create_token()
+
+# SUM and MAX, non-pow2 sizes, waits OUT OF ORDER, overlapping on one comm
+for count in (1, 997, 65537):
+    a, b = data(count, rank, 1), data(count, rank, 2)
+    ra, tok = m.iallreduce(jnp.asarray(a), m.SUM, comm=comm, token=tok)
+    rb, tok = m.iallreduce(jnp.asarray(b), m.MAX, comm=comm, token=tok)
+    vb, tok = m.wait(rb, token=tok)     # second request first
+    va, tok = m.wait(ra, token=tok)
+    wa, tok = m.allreduce(jnp.asarray(a), m.SUM, comm=comm, token=tok)
+    wb, tok = m.allreduce(jnp.asarray(b), m.MAX, comm=comm, token=tok)
+    check(f"iallreduce sum {count}", va, wa)
+    check(f"iallreduce max {count}", vb, wb)
+
+# deep in-flight pipeline: 6 overlapping requests, waitall
+depth = 6
+reqs = []
+for k in range(depth):
+    r, tok = m.iallreduce(jnp.asarray(data(4096, rank, 10 + k)), m.SUM,
+                          comm=comm, token=tok)
+    reqs.append(r)
+vals, tok = m.waitall(reqs, token=tok)
+for k, v in enumerate(vals):
+    want = data(4096, 0, 10 + k).astype(np.float32)
+    for r in range(1, size):
+        want = want + data(4096, r, 10 + k)
+    check(f"depth {k}", v, want)
+
+# isend/irecv ring with ANY_SOURCE + explicit source
+right, left = (rank + 1) % size, (rank - 1) % size
+rr, tok = m.irecv(jnp.zeros((64,)), source=left, tag=5, comm=comm,
+                  token=tok)
+rs, tok = m.isend(jnp.full((64,), float(rank)), right, tag=5, comm=comm,
+                  token=tok)
+(got, _none), tok = m.waitall([rr, rs], token=tok)
+check("ring irecv", got, np.full((64,), float(left), np.float32))
+
+# ireduce_scatter == blocking reduce_scatter (non-divisible block)
+x = np.stack([data(33, rank, 50 + row) for row in range(size)])
+rrs, tok = m.ireduce_scatter(jnp.asarray(x), m.SUM, comm=comm, token=tok)
+vrs, tok = m.wait(rrs, token=tok)
+wrs, tok = m.reduce_scatter(jnp.asarray(x), op=m.SUM, comm=comm,
+                            token=tok)
+check("ireduce_scatter", vrs, wrs)
+
+# test() polls without consuming; wait still reaps; double wait raises
+ry, tok = m.iallreduce(jnp.asarray(data(512, rank, 99)), m.SUM,
+                       comm=comm, token=tok)
+deadline = time.monotonic() + 30
+while True:
+    done, tok = m.test(ry, token=tok)
+    if bool(done):
+        break
+    assert time.monotonic() < deadline, "test never completed"
+vy, tok = m.wait(ry, token=tok)
+try:
+    m.wait(ry, token=tok)
+    raise SystemExit("double wait did not raise")
+except RuntimeError as e:
+    assert "exactly once" in str(e) or "already-consumed" in str(e), e
+
+m.assert_requests_drained()
+print("ASYNC-MATRIX-OK", flush=True)
+"""
+
+
+def test_async_matrix(tmp_path):
+    """Nonblocking results bit-identical to blocking across SUM/MAX,
+    non-pow2 sizes, out-of-order waits, overlapping requests, p2p, and
+    reduce_scatter — on the default (shm when available) plane."""
+    res = _spawn_world(tmp_path, MATRIX_BODY, nprocs=4)
+    _assert_ok(res, "ASYNC-MATRIX-OK")
+
+
+def test_async_matrix_tcp(tmp_path):
+    """Same matrix forced onto the segmented-ring TCP plane (the wire
+    path real multi-host jobs take)."""
+    res = _spawn_world(
+        tmp_path, MATRIX_BODY, nprocs=3,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_RING_MIN_BYTES": "0",
+            "T4J_SEG_BYTES": "4096",
+        },
+    )
+    _assert_ok(res, "ASYNC-MATRIX-OK")
+
+
+BUCKET_BODY = PREAMBLE + """
+from mpi4jax_tpu.models import train
+from mpi4jax_tpu.ops.allreduce import BucketedGradSync
+
+p = train.init_stack_params(jax.random.PRNGKey(0), 4, 64)
+xb = jax.random.normal(jax.random.PRNGKey(rank + 1), (16, 64))
+tb = jnp.zeros((16, 64))
+step_on = jax.jit(train.make_dp_train_step(
+    comm, overlap=True, bucket_bytes=1 << 14))
+step_off = jax.jit(train.make_dp_train_step(
+    comm, overlap=False, bucket_bytes=1 << 14))
+p_on, loss_on = step_on(p, (xb, tb))
+p_off, loss_off = step_off(p, (xb, tb))
+for a, b in zip(jax.tree_util.tree_leaves(p_on),
+                jax.tree_util.tree_leaves(p_off)):
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), (
+        "overlap on != off"
+    )
+assert float(loss_on) == float(loss_off), (loss_on, loss_off)
+
+# the generic (value_and_grad + BucketedGradSync) path on MLPParams
+p2 = train.init_params(jax.random.PRNGKey(2), 32, 64, 8, tp_size=1)
+xg = jax.random.normal(jax.random.PRNGKey(10 + rank), (4, 32))
+tg = jnp.zeros((4, 8))
+gstep_on = jax.jit(train.make_dp_train_step(
+    comm, overlap=True, bucket_bytes=1 << 12))
+gstep_off = jax.jit(train.make_dp_train_step(
+    comm, overlap=False, bucket_bytes=1 << 12))
+g_on, gl_on = gstep_on(p2, (xg, tg))
+g_off, gl_off = gstep_off(p2, (xg, tg))
+for a, b in zip(jax.tree_util.tree_leaves(g_on),
+                jax.tree_util.tree_leaves(g_off)):
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+m.assert_requests_drained()
+print("BUCKET-OK", flush=True)
+"""
+
+
+def test_bucketed_grad_sync_bit_identical(tmp_path):
+    """The DDP train step's overlap arm produces byte-identical params
+    and loss to the blocking arm (same buckets, same order) — the
+    property the benchmark's on/off comparison rests on."""
+    res = _spawn_world(tmp_path, BUCKET_BODY, nprocs=4)
+    _assert_ok(res, "BUCKET-OK")
+
+
+# ----------------------------------------------------------------- leaks
+
+
+LEAK_BODY = PREAMBLE + """
+tok = m.create_token()
+r, tok = m.iallreduce(jnp.ones((256,)), m.SUM, comm=comm, token=tok)
+jax.block_until_ready(tok.stamp)
+try:
+    m.assert_requests_drained()
+    raise SystemExit("assert_requests_drained did not raise")
+except Exception as e:
+    assert "never waited" in str(e), e
+print("LEAK-DETECTED-OK", flush=True)
+# exit WITHOUT waiting: finalize must report the leak on stderr (the
+# native engine completes the collective in its quiesce window first,
+# since every rank leaked the same one)
+"""
+
+
+def test_request_leak_reported_at_finalize(tmp_path):
+    res = _spawn_world(tmp_path, LEAK_BODY, nprocs=2)
+    for rank, (rc, out, err) in enumerate(res):
+        assert rc == 0, (rank, rc, out[-3000:], err[-3000:])
+        assert "LEAK-DETECTED-OK" in out, (rank, out[-2000:])
+        assert "never waited" in err, (rank, err[-2000:])
+
+
+# ----------------------------------------------------------------- fault
+
+
+FLAKY_BODY = PREAMBLE + """
+def data(count, r, it):
+    rng = np.random.default_rng(1000 * it + r)
+    return rng.integers(0, 64, size=count).astype(np.float32)
+
+tok = m.create_token()
+iters, count = 10, 64 * 1024
+for it in range(iters):
+    mine = data(count, rank, it)
+    want = data(count, 0, it)
+    for r in range(1, size):
+        want = want + data(count, r, it)
+    req, tok = m.iallreduce(jnp.asarray(mine), m.SUM, comm=comm,
+                            token=tok)
+    # the drops land mid-collective while the request is in flight on
+    # the progress thread; the caller is free until the wait
+    got, tok = m.wait(req, token=tok)
+    assert np.asarray(got).tobytes() == want.tobytes(), (
+        f"iteration {it}: differs from the fault-free reduction"
+    )
+m.assert_requests_drained()
+print("ASYNC-SELF-HEAL-OK", flush=True)
+"""
+
+
+@pytest.mark.fault
+def test_inflight_iallreduce_self_heals(tmp_path):
+    """flaky fabric: rank 1 drops every TCP connection twice while
+    iallreduce requests are in flight on the progress thread.  The
+    self-healing transport (PR 5) must reconnect and replay UNDER the
+    engine, every wait returning bit-identical results with zero
+    aborts — the deadline/abort/self-heal contract is plane-level, so
+    nonblocking ops inherit it unchanged."""
+    res = _spawn_world(
+        tmp_path, FLAKY_BODY, nprocs=8, timeout=240,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_RING_MIN_BYTES": "0",
+            "T4J_SEG_BYTES": "8192",
+            "T4J_FAULT_MODE": "flaky",
+            "T4J_FAULT_RANK": "1",
+            "T4J_FAULT_AFTER": "40",
+            "T4J_FAULT_COUNT": "2",
+        },
+    )
+    blob = ""
+    for rank, (rc, out, err) in enumerate(res):
+        assert rc == 0, (rank, rc, out[-3000:], err[-3000:])
+        assert "ASYNC-SELF-HEAL-OK" in out, (rank, out[-2000:])
+        blob += out + err
+    assert "dropping every TCP connection" in blob, blob[-3000:]
+    assert "reconnected" in blob, blob[-3000:]
+    assert "abort" not in blob, blob[-3000:]

@@ -119,6 +119,34 @@ class TestSegBytes:
             config.seg_bytes()
 
 
+class TestBucketBytes:
+    """T4J_BUCKET_BYTES — BucketedGradSync's bucket size
+    (docs/async.md "gradient bucketing")."""
+
+    def test_default_is_4m(self, monkeypatch):
+        monkeypatch.delenv("T4J_BUCKET_BYTES", raising=False)
+        assert config.bucket_bytes() == 4 << 20
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_BUCKET_BYTES", "65536")
+        assert config.bucket_bytes() == 65536
+
+    def test_suffix(self, monkeypatch):
+        monkeypatch.setenv("T4J_BUCKET_BYTES", "1M")
+        assert config.bucket_bytes() == 1 << 20
+
+    def test_zero_rejected(self, monkeypatch):
+        # an empty gradient bucket would never submit anything
+        monkeypatch.setenv("T4J_BUCKET_BYTES", "0")
+        with pytest.raises(ValueError, match="T4J_BUCKET_BYTES"):
+            config.bucket_bytes()
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_BUCKET_BYTES", "big")
+        with pytest.raises(ValueError, match="T4J_BUCKET_BYTES"):
+            config.bucket_bytes()
+
+
 class TestHierMode:
     def test_default_is_auto(self, monkeypatch):
         monkeypatch.delenv("T4J_HIER", raising=False)

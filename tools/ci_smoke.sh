@@ -41,15 +41,25 @@
 #                off-mode phase that must drain ZERO events
 #                (docs/observability.md).  ctypes only — runs on
 #                old-jax containers.
+#   9. async   — tools/async_smoke.py three times over: plain, under
+#                AddressSanitizer, and under ThreadSanitizer (the
+#                progress thread is exactly what TSan exists for).
+#                8-rank nonblocking matrix (iallreduce/isend/irecv/
+#                ireduce_scatter bit-identical to blocking, out-of-
+#                order waits, overlapping requests, parked irecv,
+#                test/double-wait/unknown-id semantics) plus a
+#                request-leak phase asserting the finalize report
+#                (docs/async.md).  ctypes only — runs on old-jax
+#                containers.
 #
-# Usage: tools/ci_smoke.sh [lane...]   (default: all eight)
+# Usage: tools/ci_smoke.sh [lane...]   (default: all nine)
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
-  lanes=(tier1 fault proc asan tsan lint resilience telemetry)
+  lanes=(tier1 fault proc asan tsan lint resilience telemetry async)
 fi
 
 run_lane() {
@@ -104,8 +114,16 @@ for lane in "${lanes[@]}"; do
       run_lane telemetry env T4J_SANITIZE=address timeout -k 10 900 \
         python tools/telemetry_smoke.py 8
       ;;
+    async)
+      run_lane async-plain env -u T4J_SANITIZE timeout -k 10 900 \
+        python tools/async_smoke.py 8
+      run_lane async-asan env T4J_SANITIZE=address timeout -k 10 900 \
+        python tools/async_smoke.py 8
+      run_lane async-tsan env T4J_SANITIZE=thread timeout -k 10 1800 \
+        python tools/async_smoke.py 4
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async)" >&2
       exit 2
       ;;
   esac

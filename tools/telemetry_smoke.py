@@ -146,6 +146,10 @@ def _load_lib(so):
     lib.t4j_c_sendrecv.restype = i32
     lib.t4j_c_barrier.argtypes = [i32]
     lib.t4j_c_barrier.restype = i32
+    lib.t4j_iallreduce.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_iallreduce.restype = u64
+    lib.t4j_waitall.argtypes = [ctypes.POINTER(u64), i32]
+    lib.t4j_waitall.restype = i32
     lib.t4j_telemetry_mode.restype = i32
     lib.t4j_telemetry_drain.argtypes = [vp, i64]
     lib.t4j_telemetry_drain.restype = i64
@@ -208,6 +212,28 @@ def worker(so):
         if lib.t4j_c_barrier(0):
             raise RuntimeError(f"barrier: {lib.t4j_last_error().decode()}")
 
+        # explicit nonblocking pair: the async progress engine must
+        # emit op_queued/op_progress/op_complete lifecycle events with
+        # the in-flight-depth gauge (docs/async.md)
+        a1 = np.full(COUNT, 1.0, np.float32)
+        a2 = np.full(COUNT, 2.0, np.float32)
+        o1, o2 = np.empty_like(a1), np.empty_like(a2)
+        import ctypes as _ct
+
+        u64_ = _ct.c_uint64
+        r1 = lib.t4j_iallreduce(0, ptr(a1), ptr(o1), COUNT, 0, 0)
+        r2 = lib.t4j_iallreduce(0, ptr(a2), ptr(o2), COUNT, 0, 0)
+        if not (r1 and r2):
+            raise RuntimeError(
+                f"iallreduce: {lib.t4j_last_error().decode()}"
+            )
+        pair = (u64_ * 2)(r1, r2)
+        if lib.t4j_waitall(pair, 2):
+            raise RuntimeError(
+                f"waitall: {lib.t4j_last_error().decode()}"
+            )
+        assert np.all(o1 == n) and np.all(o2 == 2 * n), "iallreduce wrong"
+
         # ---- drain the telemetry surface through the C API ----------
         mode = lib.t4j_telemetry_mode()
         buf = ctypes.create_string_buffer(32 * 65536)
@@ -245,6 +271,26 @@ def worker(so):
         frames = [e for e in events if tele.KIND_NAMES[e.kind].startswith(
             "frame")] if n > 1 else []
         assert n == 1 or frames, "multi-rank trace carries no frame events"
+        # async engine lifecycle: every explicit iallreduce above (and
+        # every routed blocking collective) queues and completes; with
+        # two submits back to back, some event must have seen depth >= 2
+        async_evs = [e for e in events if e.kind in tele.schema.ASYNC_KINDS]
+        queued = [e for e in async_evs
+                  if e.kind == tele.schema.KIND_IDS["op_queued"]]
+        completed = [e for e in async_evs
+                     if e.kind == tele.schema.KIND_IDS["op_complete"]]
+        assert queued and completed, (
+            "async engine emitted no op_queued/op_complete events"
+        )
+        assert len(queued) == len(completed), (len(queued), len(completed))
+        assert any(
+            tele.schema.decode_async_comm(e.comm)[0] == "iallreduce"
+            for e in queued
+        ), "no iallreduce-attributed async event"
+        assert max(e.peer for e in queued) >= 2, (
+            "in-flight depth gauge never reached 2 despite overlapping "
+            "submits"
+        )
         snap = tele.parse_snapshot(words)
         assert snap["rows"], "trace mode counted zero metrics rows"
         ar = [r for r in snap["rows"]
