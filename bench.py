@@ -15,9 +15,16 @@ import json
 import sys
 import time
 
-from mpi4jax_tpu.utils.runtime import best_mesh_shape, drain
-
 BASELINE_CELL_UPDATES_PER_SEC = 4.5e8  # 1x P100, BASELINE.md
+
+
+def best_mesh_shape(n_devices):
+    """Entrypoint re-export (tests/test_examples.py asserts it) —
+    resolved lazily so ``import bench`` keeps working on containers
+    where the package cannot import and only the skip paths run."""
+    from mpi4jax_tpu.utils.runtime import best_mesh_shape as impl
+
+    return impl(n_devices)
 
 # Nominal HBM bandwidth per chip (public spec sheets), keyed by jax
 # device_kind prefix — reported for context beside the calibration.
@@ -68,6 +75,8 @@ def hbm_copy_bandwidth(mb=512, chain=8, reps=6):
     import jax.numpy as jnp
     from jax import lax
 
+    from mpi4jax_tpu.utils.runtime import drain
+
     n = mb * 1024 * 1024 // 4
 
     @jax.jit
@@ -107,6 +116,8 @@ def matmul_roofline_tflops(shapes=((8192, 16), (16384, 16)), reps=6):
     import jax
     import jax.numpy as jnp
     from jax import lax
+
+    from mpi4jax_tpu.utils.runtime import drain
 
     best_tflops = 0.0
     for dim, chain in shapes:
@@ -166,7 +177,19 @@ import threading as _threading
 # print): first caller wins, later callers no-op — the output contract
 # is exactly one record on stdout no matter which paths race.
 _emit_lock = _threading.Lock()
-_emit_state = {"done": False}
+_emit_state = {"done": False, "out": None}
+
+# legs that could not run, keyed by leg name -> reason.  A skipped or
+# failed leg must still leave an explicit mark in the emitted record
+# (the BENCH trajectory needs "measured absent" to be distinguishable
+# from "never attempted"), so every skip path calls _skip() and the
+# record carries the dict under "skipped".
+_skipped = {}
+
+
+def _skip(leg, reason):
+    _skipped[leg] = str(reason)[:300]
+    print(f"[bench] {leg} skipped: {reason}", file=sys.stderr)
 
 
 def _emit_record(rec_or_fn, note=None):
@@ -174,7 +197,9 @@ def _emit_record(rec_or_fn, note=None):
     dict or a zero-arg callable (evaluated under the lock; retried —
     the main thread mutates ``extras`` without locking, and a dict
     unpack racing one insert raises RuntimeError).  Returns True if
-    THIS call emitted."""
+    THIS call emitted.  When ``--out FILE`` was given the same record
+    is also written there (inside the lock, so watchdog/deadline bails
+    record the trajectory point too)."""
     with _emit_lock:
         if _emit_state["done"]:
             return False
@@ -187,8 +212,18 @@ def _emit_record(rec_or_fn, note=None):
                 except RuntimeError:  # racing insert; writer finishes fast
                     if attempt == 2:
                         raise
+        if _skipped and "skipped" not in rec:
+            rec = dict(rec, skipped=dict(_skipped))
         _emit_state["done"] = True
         print(json.dumps(rec), flush=True)
+        if _emit_state["out"]:
+            try:
+                with open(_emit_state["out"], "w") as f:
+                    json.dump(rec, f, indent=2)
+                    f.write("\n")
+            except OSError as exc:
+                print(f"[bench] could not write --out file: {exc}",
+                      file=sys.stderr)
         if note:
             print(note, file=sys.stderr)
         return True
@@ -345,7 +380,7 @@ def native_bridge_status():
         return False, f"{type(exc).__name__}: {str(exc)[:300]}"
 
 
-def proc_busbw(timeout=600):
+def proc_busbw(timeout=600, mb=16, reps=10):
     """8-process DCN-bridge allreduce bus bandwidth (the proc tier over
     the same-host shm arena), via a launcher subprocess job.  Returns
     the full record dict (value + in-run ceiling keys) or None."""
@@ -358,7 +393,7 @@ def proc_busbw(timeout=600):
     return _metric_subprocess(
         [
             sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
-            str(script), "--mb", "16", "--reps", "10",
+            str(script), "--mb", str(mb), "--reps", str(reps),
         ],
         "allreduce_busbw_proc8", timeout, "proc busbw",
         env={"T4J_TELEMETRY": "counters"},
@@ -483,11 +518,12 @@ def proc_overlap_step(timeout=900):
     return on, off, speedup
 
 
-def main():
+def run_bench(quick=False):
     import jax
 
     import mpi4jax_tpu as m
     from mpi4jax_tpu.models import shallow_water as sw
+    from mpi4jax_tpu.utils.runtime import best_mesh_shape, drain
 
     devices = jax.devices()
     n_dev = len(devices)
@@ -516,7 +552,9 @@ def main():
 
     base = sw.SWConfig().bench_size()  # 3600 x 1800 f32
     candidates = {}
-    for ghost in (1, 2, 4):
+    # --quick (the CI bench lane): one schedule, fewer/shorter batches,
+    # cheap proc leg only — a trajectory point per PR, not a full sweep
+    for ghost in ((2,) if quick else (1, 2, 4)):
         cfg_g = replace(base, ghost=ghost)
         init = sw.make_init(cfg_g, comm)
         first = sw.make_first_step(cfg_g, comm)
@@ -562,8 +600,9 @@ def main():
     # capability (what the reference's dedicated-hardware numbers
     # measure); the median rides along in the JSON.
     per_call = max(tuned_per_call, 1e-3)
-    calls = max(4, min(800, int(2.0 / per_call)))
-    n_batches = 10
+    target_s = 1.0 if quick else 2.0
+    calls = max(4, min(800, int(target_s / per_call)))
+    n_batches = 3 if quick else 10
 
     def timed_batches(n, calls_n):
         nonlocal state
@@ -625,13 +664,16 @@ def main():
     extras = {"median_cell_updates_per_sec_per_chip": round(median_per_chip, 1)}
 
     def record():
-        return {
+        rec = {
             "metric": "shallow_water_cell_updates_per_sec_per_chip",
             "value": round(per_chip, 1),
             "unit": "cell-updates/s/chip",
             "vs_baseline": round(per_chip / BASELINE_CELL_UPDATES_PER_SEC, 4),
             **extras,
         }
+        if quick:
+            rec["quick"] = True
+        return rec
 
     # GLOBAL deadline: the extras phase (sweeps + three transformer
     # configs + rooflines) totals ~20 min of device time; if an outer
@@ -651,7 +693,7 @@ def main():
 
             _os._exit(0)
 
-    _deadline_timer = _threading.Timer(1500.0, _deadline)
+    _deadline_timer = _threading.Timer(600.0 if quick else 1500.0, _deadline)
     _deadline_timer.daemon = True
     _deadline_timer.start()
 
@@ -673,7 +715,9 @@ def main():
         (v for v in (hbm_before, hbm_after) if v is not None), default=None
     )
     nominal = nominal_hbm_gbps(devices[0])
-    if hbm_measured is not None:
+    if hbm_measured is None:
+        _skip("hbm_calibration", "no successful draw")
+    else:
         extras["hbm_copy_gbps"] = round(hbm_measured, 1)
         extras["hbm_reference_gbps"] = HBM_REFERENCE_GBPS
         if nominal:
@@ -696,38 +740,49 @@ def main():
     # measured shallow-water result.  Key names state what was
     # measured: a single-chip "allreduce" is elided by XLA, so n=1
     # reports the call-site dispatch floor, not a bandwidth.
-    try:
-        ar_gbps = round(
-            _run_with_watchdog(
-                lambda: allreduce_bandwidth(comm), record, 300,
-                "allreduce sweep",
-            ),
-            2,
-        )
-        ar_key = (
-            "allreduce_callsite_floor_gbps" if n_dev == 1
-            else "allreduce_busbw_gbps"
-        )
-        extras[ar_key] = ar_gbps
-        extras["allreduce_devices"] = n_dev
-    except Exception as exc:  # noqa: BLE001
-        print(f"[bench] allreduce sweep failed: {exc}", file=sys.stderr)
-    vmesh_gbps = virtual_mesh_busbw()  # subprocess: has its own timeout
+    if quick:
+        _skip("allreduce_sweep", "quick mode")
+    else:
+        try:
+            ar_gbps = round(
+                _run_with_watchdog(
+                    lambda: allreduce_bandwidth(comm), record, 300,
+                    "allreduce sweep",
+                ),
+                2,
+            )
+            ar_key = (
+                "allreduce_callsite_floor_gbps" if n_dev == 1
+                else "allreduce_busbw_gbps"
+            )
+            extras[ar_key] = ar_gbps
+            extras["allreduce_devices"] = n_dev
+        except Exception as exc:  # noqa: BLE001
+            _skip("allreduce_sweep", exc)
+    # subprocess: has its own timeout
+    vmesh_gbps = None if quick else virtual_mesh_busbw()
     if vmesh_gbps is not None:
         # 8-way busbw convention over the XLA CPU virtual mesh (the
         # mesh-tier collective on host shared memory) — kept for
         # round-over-round continuity under its historical key
         extras["allreduce_busbw_cpu8_hostmem_gbps"] = vmesh_gbps
+    elif quick:
+        _skip("vmesh_busbw", "quick mode")
+    else:
+        _skip("vmesh_busbw", "no record produced")
     # every leg below spawns launcher jobs over the compiled DCN
     # bridge: when it cannot build/load, skip them all with ONE clear
     # line instead of a per-leg timeout + traceback
     native_ok, native_reason = native_bridge_status()
     if not native_ok:
-        print(
-            f"[bench] skipping native-bridge benchmarks: {native_reason}",
-            file=sys.stderr,
-        )
-    procrec = proc_busbw() if native_ok else None
+        _skip("native_bridge", native_reason)
+    procrec = (
+        proc_busbw(mb=4 if quick else 16, reps=4 if quick else 10)
+        if native_ok else None
+    )
+    if procrec is None:
+        _skip("proc_busbw",
+              native_reason if not native_ok else "no record produced")
     if procrec is not None:
         # the DCN bridge proper: 8 OS processes over the same-host shm
         # arena (native/src/shm.cc) — the analog of the reference's
@@ -769,7 +824,18 @@ def main():
         for key, val in procrec.items():
             if key.startswith("bytes_") and isinstance(val, int):
                 extras[f"proc8_{key}"] = val
-    ring_rec, tree_rec = proc_tcp_busbw() if native_ok else (None, None)
+    run_heavy_proc = native_ok and not quick
+    if native_ok and quick:
+        _skip("proc_tcp_busbw", "quick mode")
+        _skip("proc_hier_busbw", "quick mode")
+        _skip("proc_overlap_step", "quick mode")
+    elif not native_ok:
+        _skip("proc_tcp_busbw", native_reason)
+        _skip("proc_hier_busbw", native_reason)
+        _skip("proc_overlap_step", native_reason)
+    ring_rec, tree_rec = proc_tcp_busbw() if run_heavy_proc else (None, None)
+    if run_heavy_proc and ring_rec is None and tree_rec is None:
+        _skip("proc_tcp_busbw", "no record produced")
     if ring_rec is not None:
         # the TCP tier proper (T4J_NO_SHM=1): segmented ring allreduce
         # vs the pre-PR2 tree path on the same 64 MB payload — the
@@ -785,8 +851,10 @@ def main():
     # x 4 local ranks, shm-leaf reduce + leader ring vs the flat path
     # on the same 64 MB payload, interleaved same-conditions pairs
     hier_rec, hflat_rec, hratio_rec = (
-        proc_hier_busbw() if native_ok else (None, None, None)
+        proc_hier_busbw() if run_heavy_proc else (None, None, None)
     )
+    if run_heavy_proc and hier_rec is None and hflat_rec is None:
+        _skip("proc_hier_busbw", "no record produced")
     if hier_rec is not None:
         extras["allreduce_busbw_proc8_hier_gbps"] = hier_rec["value"]
     if hflat_rec is not None:
@@ -797,8 +865,10 @@ def main():
     # bucketed compute/comm overlap on vs off, interleaved pairs — the
     # end-to-end step-time number, not just busbw (docs/async.md)
     ov_on, ov_off, ov_ratio = (
-        proc_overlap_step() if native_ok else (None, None, None)
+        proc_overlap_step() if run_heavy_proc else (None, None, None)
     )
+    if run_heavy_proc and ov_on is None and ov_off is None:
+        _skip("proc_overlap_step", "no record produced")
     if ov_on is not None:
         extras["train_step_ms_proc8_overlap_on"] = ov_on["value"]
     if ov_off is not None:
@@ -806,194 +876,206 @@ def main():
     if ov_ratio is not None:
         extras["overlap_speedup_proc8"] = ov_ratio["value"]
 
-    try:
-        extras["transformer_train_tokens_per_sec_bf16"] = (
-            transformer_tokens_per_sec(record)
-        )
-    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
-        print(f"[bench] transformer bench failed: {exc}", file=sys.stderr)
+    if quick:
+        for leg in ("transformer", "matmul_roofline",
+                    "transformer_large", "two_tier", "weak_scaling",
+                    "decode", "long_context", "decode_kv_bucket"):
+            _skip(leg, "quick mode")
+    else:
+        try:
+            extras["transformer_train_tokens_per_sec_bf16"] = (
+                transformer_tokens_per_sec(record)
+            )
+        except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+            _skip("transformer", exc)
 
-    # MFU demonstration: the compute-bound large config (~940M params,
-    # d_model 2048, seq 2048, remat).  Same watchdog contract as above.
-    # The in-run matmul roofline beside it separates "how much of the
-    # nameplate chip" (mfu_pct — bounded by the virtualised slice) from
-    # "how much of the granted slice" (mfu_vs_achievable_pct).
-    try:
-        extras["matmul_bf16_tflops"] = round(
-            _run_with_watchdog(
-                matmul_roofline_tflops, record, 300, "matmul roofline"
-            ),
-            1,
-        )
-    except Exception as exc:  # noqa: BLE001
-        print(f"[bench] matmul roofline failed: {exc}", file=sys.stderr)
-    try:
-        large = transformer_large_mfu(record)
-        if large is not None:
-            extras["transformer_large_tokens_per_sec_bf16"] = large["value"]
-            extras["transformer_large_tflops_per_sec"] = large[
+        # MFU demonstration: the compute-bound large config (~940M params,
+        # d_model 2048, seq 2048, remat).  Same watchdog contract as above.
+        # The in-run matmul roofline beside it separates "how much of the
+        # nameplate chip" (mfu_pct — bounded by the virtualised slice) from
+        # "how much of the granted slice" (mfu_vs_achievable_pct).
+        try:
+            extras["matmul_bf16_tflops"] = round(
+                _run_with_watchdog(
+                    matmul_roofline_tflops, record, 300, "matmul roofline"
+                ),
+                1,
+            )
+        except Exception as exc:  # noqa: BLE001
+            _skip("matmul_roofline", exc)
+        try:
+            large = transformer_large_mfu(record)
+            if large is not None:
+                extras["transformer_large_tokens_per_sec_bf16"] = large["value"]
+                extras["transformer_large_tflops_per_sec"] = large[
+                    "model_tflops_per_sec"
+                ]
+                if "mfu_pct" in large:
+                    extras["transformer_mfu_pct"] = large["mfu_pct"]
+                if "matmul_bf16_tflops" in extras:
+                    # "achievable" = the INDEPENDENT calibration probe, and
+                    # only the probe (VERDICT r3: max()-ing the workload in
+                    # turned the key into a tautology).  A workload reading
+                    # above the probe means the probe regressed — surfaced
+                    # as >100 %, never silently clamped.
+                    achievable = extras["matmul_bf16_tflops"]
+                    extras["achievable_bf16_tflops"] = round(achievable, 1)
+                    extras["transformer_mfu_vs_achievable_pct"] = round(
+                        100.0 * large["model_tflops_per_sec"] / achievable, 1
+                    )
+        except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+            _skip("transformer_large", exc)
+
+        # composed ICI+DCN allreduce (VERDICT r4 #6): two launcher
+        # processes x 8 virtual devices each through
+        # parallel.distributed.two_tier_allreduce, end to end.  On this
+        # box the number is floored by the virtual-ICI tier (8 CPU
+        # "devices" on one core); the DCN hop's own busbw rides in the
+        # subprocess record (docs/performance.md).
+        try:
+            import pathlib as _pl
+
+            tt_script = _pl.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
+            tt = None if not native_ok else _metric_subprocess(
+                [
+                    sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "2",
+                    str(tt_script), "--two-tier", "--mb", "32",
+                ],
+                "two_tier_allreduce_proc2x8", 300, "two-tier allreduce",
+            )
+            if tt:
+                extras["two_tier_allreduce_gbps"] = tt["value"]
+                extras["two_tier_dcn_busbw_gbps"] = tt["dcn_busbw_gbps"]
+            else:
+                _skip("two_tier", native_reason if not native_ok
+                      else "no record produced")
+        except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+            _skip("two_tier", exc)
+
+        # measured weak scaling on the launcher/DCN tier (VERDICT r4 #3):
+        # fixed work per rank, halo sendrecv over the proc transport; the
+        # curve's judgeable point on a 1-core box is the core-normalised
+        # aggregate efficiency at np=8 (docs/performance.md "Weak-scaling
+        # harness" has the full measured table)
+        try:
+            import pathlib as _pl
+
+            ws_script = _pl.Path(__file__).parent / "benchmarks" / "weak_scaling.py"
+
+            def _ws(nprocs):
+                rec = _metric_subprocess(
+                    [
+                        sys.executable, "-m", "mpi4jax_tpu.launch", "-np",
+                        str(nprocs), str(ws_script), "--proc", "--steps", "100",
+                    ],
+                    "weak_scaling_proc", 300, f"weak scaling np={nprocs}",
+                )
+                return rec["aggregate_cell_updates_per_sec"] if rec else None
+
+            ws1, ws8 = (_ws(1), _ws(8)) if native_ok else (None, None)
+            if ws1 and ws8:
+                extras["weak_scaling_proc8_core_normalized_eff"] = round(
+                    ws8 / ws1, 3
+                )
+            else:
+                _skip("weak_scaling", native_reason if not native_ok
+                      else "no record produced")
+        except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+            _skip("weak_scaling", exc)
+
+        # inference-side extra: greedy-decode throughput through the
+        # TP-sharded KV cache (batched prefill), benchmarks/transformer.py
+        try:
+            from benchmarks.transformer import run_decode
+
+            dec = _run_with_watchdog(
+                lambda: run_decode(bf16=True, batches=3), record, 600,
+                "decode bench",
+            )
+            extras["decode_tokens_per_sec_bf16"] = dec["value"]
+            if "hbm_bytes_per_step" in dec and extras.get("hbm_copy_gbps"):
+                # bandwidth bound (VERDICT r3 weak #6): generated tokens/s
+                # cannot exceed batch * HBM-rate / bytes-moved-per-step.
+                # The in-run copy probe counts read+write traffic while
+                # decode is read-dominated (weights stream in, only one KV
+                # position writes back), so ~100 % — or slightly above —
+                # reads as "saturating the measured-bandwidth bound", not a
+                # broken model (docs/performance.md "Decode throughput").
+                bound = (
+                    dec["batch"]
+                    * extras["hbm_copy_gbps"] * 1e9
+                    / dec["hbm_bytes_per_step"]
+                )
+                extras["decode_tokens_per_sec_bw_bound"] = round(bound, 1)
+                extras["decode_pct_of_bw_bound"] = round(
+                    100.0 * dec["value"] / bound, 1
+                )
+            # batch-scaling point (VERDICT r4 #7): the r5 sweep (docs/
+            # performance.md decode table) measured total throughput
+            # peaking at batch 16 — beyond it the per-step KV-cache read
+            # grows linearly while decode attention stays matrix-vector,
+            # so the leg crosses weight-bandwidth-bound -> KV-bound and
+            # NEVER compute-bound at this model size.  One extra measured
+            # point pins the peak beside the b8 reference.
+            dec16 = _run_with_watchdog(
+                lambda: run_decode(batch=16, bf16=True, batches=3), record,
+                600, "decode bench (batch 16)",
+            )
+            extras["decode_tokens_per_sec_batch16"] = dec16["value"]
+        except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+            _skip("decode", exc)
+
+        # long-context capability record: seq 8192 through the flash
+        # fwd+bwd — a configuration the dense path cannot run at all
+        try:
+            from benchmarks.transformer import SIZES, run
+
+            lcfg = dict(SIZES["long"])
+            lremat = lcfg.pop("remat", True)
+            limpl = lcfg.pop("attn_impl", "flash")
+            longrec = _run_with_watchdog(
+                lambda: run(
+                    bf16=True, batches=3, remat=lremat, attn_impl=limpl,
+                    **lcfg,
+                ),
+                record, 900, "long-context bench",
+            )
+            extras["transformer_long_seq"] = longrec["seq"]
+            extras["transformer_long_tokens_per_sec_bf16"] = longrec["value"]
+            extras["transformer_long_tflops_per_sec"] = longrec[
                 "model_tflops_per_sec"
             ]
-            if "mfu_pct" in large:
-                extras["transformer_mfu_pct"] = large["mfu_pct"]
-            if "matmul_bf16_tflops" in extras:
-                # "achievable" = the INDEPENDENT calibration probe, and
-                # only the probe (VERDICT r3: max()-ing the workload in
-                # turned the key into a tautology).  A workload reading
-                # above the probe means the probe regressed — surfaced
-                # as >100 %, never silently clamped.
-                achievable = extras["matmul_bf16_tflops"]
-                extras["achievable_bf16_tflops"] = round(achievable, 1)
-                extras["transformer_mfu_vs_achievable_pct"] = round(
-                    100.0 * large["model_tflops_per_sec"] / achievable, 1
-                )
-    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
-        print(f"[bench] large-transformer bench failed: {exc}", file=sys.stderr)
-
-    # composed ICI+DCN allreduce (VERDICT r4 #6): two launcher
-    # processes x 8 virtual devices each through
-    # parallel.distributed.two_tier_allreduce, end to end.  On this
-    # box the number is floored by the virtual-ICI tier (8 CPU
-    # "devices" on one core); the DCN hop's own busbw rides in the
-    # subprocess record (docs/performance.md).
-    try:
-        import pathlib as _pl
-
-        tt_script = _pl.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
-        tt = None if not native_ok else _metric_subprocess(
-            [
-                sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "2",
-                str(tt_script), "--two-tier", "--mb", "32",
-            ],
-            "two_tier_allreduce_proc2x8", 300, "two-tier allreduce",
-        )
-        if tt:
-            extras["two_tier_allreduce_gbps"] = tt["value"]
-            extras["two_tier_dcn_busbw_gbps"] = tt["dcn_busbw_gbps"]
-    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
-        print(f"[bench] two-tier leg failed: {exc}", file=sys.stderr)
-
-    # measured weak scaling on the launcher/DCN tier (VERDICT r4 #3):
-    # fixed work per rank, halo sendrecv over the proc transport; the
-    # curve's judgeable point on a 1-core box is the core-normalised
-    # aggregate efficiency at np=8 (docs/performance.md "Weak-scaling
-    # harness" has the full measured table)
-    try:
-        import pathlib as _pl
-
-        ws_script = _pl.Path(__file__).parent / "benchmarks" / "weak_scaling.py"
-
-        def _ws(nprocs):
-            rec = _metric_subprocess(
-                [
-                    sys.executable, "-m", "mpi4jax_tpu.launch", "-np",
-                    str(nprocs), str(ws_script), "--proc", "--steps", "100",
-                ],
-                "weak_scaling_proc", 300, f"weak scaling np={nprocs}",
-            )
-            return rec["aggregate_cell_updates_per_sec"] if rec else None
-
-        ws1, ws8 = (_ws(1), _ws(8)) if native_ok else (None, None)
-        if ws1 and ws8:
-            extras["weak_scaling_proc8_core_normalized_eff"] = round(
-                ws8 / ws1, 3
-            )
-    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
-        print(f"[bench] weak-scaling leg failed: {exc}", file=sys.stderr)
-
-    # inference-side extra: greedy-decode throughput through the
-    # TP-sharded KV cache (batched prefill), benchmarks/transformer.py
-    try:
-        from benchmarks.transformer import run_decode
-
-        dec = _run_with_watchdog(
-            lambda: run_decode(bf16=True, batches=3), record, 600,
-            "decode bench",
-        )
-        extras["decode_tokens_per_sec_bf16"] = dec["value"]
-        if "hbm_bytes_per_step" in dec and extras.get("hbm_copy_gbps"):
-            # bandwidth bound (VERDICT r3 weak #6): generated tokens/s
-            # cannot exceed batch * HBM-rate / bytes-moved-per-step.
-            # The in-run copy probe counts read+write traffic while
-            # decode is read-dominated (weights stream in, only one KV
-            # position writes back), so ~100 % — or slightly above —
-            # reads as "saturating the measured-bandwidth bound", not a
-            # broken model (docs/performance.md "Decode throughput").
-            bound = (
-                dec["batch"]
-                * extras["hbm_copy_gbps"] * 1e9
-                / dec["hbm_bytes_per_step"]
-            )
-            extras["decode_tokens_per_sec_bw_bound"] = round(bound, 1)
-            extras["decode_pct_of_bw_bound"] = round(
-                100.0 * dec["value"] / bound, 1
-            )
-        # batch-scaling point (VERDICT r4 #7): the r5 sweep (docs/
-        # performance.md decode table) measured total throughput
-        # peaking at batch 16 — beyond it the per-step KV-cache read
-        # grows linearly while decode attention stays matrix-vector,
-        # so the leg crosses weight-bandwidth-bound -> KV-bound and
-        # NEVER compute-bound at this model size.  One extra measured
-        # point pins the peak beside the b8 reference.
-        dec16 = _run_with_watchdog(
-            lambda: run_decode(batch=16, bf16=True, batches=3), record,
-            600, "decode bench (batch 16)",
-        )
-        extras["decode_tokens_per_sec_batch16"] = dec16["value"]
-    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
-        print(f"[bench] decode bench failed: {exc}", file=sys.stderr)
-
-    # long-context capability record: seq 8192 through the flash
-    # fwd+bwd — a configuration the dense path cannot run at all
-    try:
-        from benchmarks.transformer import SIZES, run
-
-        lcfg = dict(SIZES["long"])
-        lremat = lcfg.pop("remat", True)
-        limpl = lcfg.pop("attn_impl", "flash")
-        longrec = _run_with_watchdog(
-            lambda: run(
-                bf16=True, batches=3, remat=lremat, attn_impl=limpl,
-                **lcfg,
-            ),
-            record, 900, "long-context bench",
-        )
-        extras["transformer_long_seq"] = longrec["seq"]
-        extras["transformer_long_tokens_per_sec_bf16"] = longrec["value"]
-        extras["transformer_long_tflops_per_sec"] = longrec[
-            "model_tflops_per_sec"
-        ]
-        extras["transformer_long_tflops_incl_attn"] = longrec[
-            "model_tflops_incl_attn"
-        ]
-        if "mfu_pct" in longrec:
-            extras["transformer_long_mfu_pct"] = longrec["mfu_pct"]
-            extras["transformer_long_mfu_incl_attn_pct"] = longrec[
-                "mfu_incl_attn_pct"
+            extras["transformer_long_tflops_incl_attn"] = longrec[
+                "model_tflops_incl_attn"
             ]
-    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
-        print(f"[bench] long-context bench failed: {exc}", file=sys.stderr)
+            if "mfu_pct" in longrec:
+                extras["transformer_long_mfu_pct"] = longrec["mfu_pct"]
+                extras["transformer_long_mfu_incl_attn_pct"] = longrec[
+                    "mfu_incl_attn_pct"
+                ]
+        except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+            _skip("long_context", exc)
 
-    # bucketed-KV decode record (late r5) — deliberately the LAST extra
-    # so the global deadline can only ever cut THIS key, never the
-    # VERDICT-tracked long-context ones above.  The un-bucketed loop
-    # reads the full 512-position budget every step; kv_bucket grows
-    # the cache view in static buckets instead (make_global_decode) —
-    # the bucket sweep put the optimum at 16 and the batch sweep's new
-    # peak at batch 16: 12158 tokens/s vs the 6657 un-bucketed peak
-    # (docs/performance.md "Bucketed KV growth").
-    try:
-        from benchmarks.transformer import run_decode
+        # bucketed-KV decode record (late r5) — deliberately the LAST extra
+        # so the global deadline can only ever cut THIS key, never the
+        # VERDICT-tracked long-context ones above.  The un-bucketed loop
+        # reads the full 512-position budget every step; kv_bucket grows
+        # the cache view in static buckets instead (make_global_decode) —
+        # the bucket sweep put the optimum at 16 and the batch sweep's new
+        # peak at batch 16: 12158 tokens/s vs the 6657 un-bucketed peak
+        # (docs/performance.md "Bucketed KV growth").
+        try:
+            from benchmarks.transformer import run_decode
 
-        dec16b = _run_with_watchdog(
-            lambda: run_decode(
-                batch=16, bf16=True, batches=3, kv_bucket=16
-            ),
-            record, 600, "decode bench (batch 16, kv_bucket 16)",
-        )
-        extras["decode_tokens_per_sec_batch16_kv_bucket16"] = dec16b["value"]
-    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
-        print(f"[bench] bucketed decode bench failed: {exc}", file=sys.stderr)
+            dec16b = _run_with_watchdog(
+                lambda: run_decode(
+                    batch=16, bf16=True, batches=3, kv_bucket=16
+                ),
+                record, 600, "decode bench (batch 16, kv_bucket 16)",
+            )
+            extras["decode_tokens_per_sec_batch16_kv_bucket16"] = dec16b["value"]
+        except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+            _skip("decode_kv_bucket", exc)
 
     _deadline_timer.cancel()
     _emit_record(record)
@@ -1004,5 +1086,45 @@ def main():
     )
 
 
+def main(argv=None):
+    """CLI wrapper: --quick (the CI bench lane's cheap trajectory
+    point), --out FILE (write the emitted record there too).  When the
+    flagship cannot run at all (no jax/TPU, package version gate on
+    old-jax containers), a record with ``value: null`` and an explicit
+    ``skipped`` dict is still emitted — the trajectory distinguishes
+    "measured absent" from "never ran"."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI mode: one schedule, short batches, cheap "
+                         "proc leg only (tools/ci_smoke.sh bench)")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the emitted JSON record to FILE "
+                         "(e.g. BENCH_quick.json)")
+    args = ap.parse_args(argv)
+    _emit_state["out"] = args.out
+    try:
+        run_bench(quick=args.quick)
+    except BaseException as exc:  # noqa: BLE001 — the record must still emit
+        if isinstance(exc, KeyboardInterrupt):
+            raise
+        _skip("flagship", f"{type(exc).__name__}: {str(exc)[:300]}")
+        rec = {
+            "metric": "shallow_water_cell_updates_per_sec_per_chip",
+            "value": None,
+            "unit": "cell-updates/s/chip",
+            "vs_baseline": None,
+        }
+        if args.quick:
+            rec["quick"] = True
+        if not _emit_record(rec):
+            raise  # a watchdog already emitted; surface the real error
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

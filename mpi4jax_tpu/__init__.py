@@ -47,11 +47,14 @@ from mpi4jax_tpu.ops import (
     allgather,
     allreduce,
     alltoall,
+    annotate_step,
     as_token,
     barrier,
     assert_requests_drained,
     bcast,
     create_token,
+    current_step,
+    end_step,
     gather,
     iallreduce,
     ireduce_scatter,
@@ -64,6 +67,7 @@ from mpi4jax_tpu.ops import (
     scatter,
     send,
     sendrecv,
+    step_scope,
     test,
     token_array,
     wait,
@@ -152,12 +156,15 @@ __all__ = [
     "allgather",
     "allreduce",
     "alltoall",
+    "annotate_step",
     "assert_requests_drained",
     "as_token",
     "barrier",
     "bcast",
     "create_token",
+    "current_step",
     "default_comm",
+    "end_step",
     "gather",
     "get_default_comm",
     "has_cuda_support",
@@ -174,6 +181,7 @@ __all__ = [
     "send",
     "sendrecv",
     "set_default_comm",
+    "step_scope",
     "test",
     "token_array",
     "wait",

@@ -44,6 +44,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -55,6 +56,15 @@ def _free_port():
 
 def _say(msg):
     print(f"mpi4jax_tpu.launch: {msg}", file=sys.stderr, flush=True)
+
+
+def _swallow(fn):
+    """Run ``fn`` ignoring every failure (best-effort side work, e.g.
+    the exit-time metrics scrape — it must never take the job down)."""
+    try:
+        fn()
+    except Exception:
+        pass
 
 
 def child_main(argv):
@@ -185,6 +195,17 @@ def main(argv=None):
         "mode), and the launcher merges them into a Perfetto-loadable "
         "DIR/job.trace.json; inspect with t4j-top DIR",
     )
+    parser.add_argument(
+        "--metrics",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="live metrics exporter (docs/observability.md): rank k "
+        "serves its metrics snapshot + link stats on 127.0.0.1:PORT+k "
+        "(/metrics Prometheus text, /metrics.json), and the launcher "
+        "serves the aggregated job view — worst-link and straggler "
+        "gauges — on PORT+nprocs",
+    )
     parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("prog", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -199,6 +220,13 @@ def main(argv=None):
         parser.error("--timeout must be > 0 seconds (omit it for no deadline)")
     if args.restarts < 0:
         parser.error("--restarts must be >= 0")
+    if args.metrics is not None and not (
+        1 <= args.metrics and args.metrics + args.nprocs < 65536
+    ):
+        parser.error(
+            "--metrics PORT must leave room for nprocs+1 ports below "
+            "65536"
+        )
 
     attempts = args.restarts + 1
     for attempt in range(1, attempts + 1):
@@ -260,6 +288,46 @@ def _merge_telemetry(tel_dir, job):
         _say(f"telemetry merge failed: {type(e).__name__}: {e}")
 
 
+def _start_job_metrics(port, n, job):
+    """Serve the aggregated job metrics view on ``port + n``: each
+    scrape of the job endpoint scrapes every rank's ``/metrics.json``
+    (ranks that have not bootstrapped yet, or died, simply drop out of
+    ``ranks_reporting``) and aggregates — no polling thread, the
+    freshness is the scraper's.  Returns the exporter or None."""
+    try:
+        from mpi4jax_tpu.telemetry import exporter
+
+        def collect():
+            snaps = []
+            for r in range(n):
+                try:
+                    snaps.append(exporter.scrape(
+                        f"http://127.0.0.1:{port + r}/metrics.json",
+                        timeout=0.5,
+                    ))
+                except Exception:
+                    continue
+            if not snaps:
+                return None
+            agg = exporter.aggregate_snapshots(snaps, job=job)
+            # the exit-time summary runs after the rank endpoints are
+            # gone: remember the freshest live view any scrape saw
+            srv.last_agg = agg
+            return agg
+
+        srv = exporter.MetricsExporter(port + n, collect_fn=collect)
+        srv.last_agg = None
+        srv.start()
+        _say(
+            f"job metrics on http://127.0.0.1:{port + n}/metrics "
+            f"(per-rank: ports {port}..{port + n - 1})"
+        )
+        return srv
+    except Exception as e:  # noqa: BLE001 — metrics must not kill the launch
+        _say(f"job metrics aggregator failed: {type(e).__name__}: {e}")
+        return None
+
+
 def _run_job(args):
     """One launch attempt: spawn the workers, wait, fail fast."""
     n = args.nprocs
@@ -273,6 +341,9 @@ def _run_job(args):
     if args.telemetry:
         tel_dir = os.path.abspath(args.telemetry)
         os.makedirs(tel_dir, exist_ok=True)
+    metrics_srv = None
+    if args.metrics is not None:
+        metrics_srv = _start_job_metrics(args.metrics, n, job)
     procs = []
     for rank in range(n):
         env = dict(os.environ)
@@ -288,6 +359,12 @@ def _run_job(args):
             # trace unless the caller already chose a mode (counters
             # keeps the overhead at metrics-only for perf runs)
             env.setdefault("T4J_TELEMETRY", "trace")
+        if args.metrics is not None:
+            env["T4J_METRICS_PORT"] = str(args.metrics)
+            # the exporter serves the metrics table + link stats —
+            # counters mode records them at <=5% overhead; an explicit
+            # ambient choice (off included) still wins
+            env.setdefault("T4J_TELEMETRY", "counters")
         if args.shims:
             from mpi4jax_tpu import shims
 
@@ -309,12 +386,25 @@ def _run_job(args):
 
     try:
         remaining = set(range(n))
+        final_scrape_started = False
         while remaining:
             for i in list(remaining):
                 rc = procs[i].poll()
                 if rc is None:
                     continue
                 remaining.discard(i)
+                if metrics_srv is not None and remaining \
+                        and not final_scrape_started:
+                    # first exit: the surviving ranks still serve —
+                    # grab one job view (off-loop: the serial 0.5 s/
+                    # rank scrape must not delay the fail-fast kill
+                    # below) so the exit-time summary has data even
+                    # when nothing external ever scraped
+                    final_scrape_started = True
+                    threading.Thread(
+                        target=lambda: _swallow(metrics_srv.collect),
+                        daemon=True,
+                    ).start()
                 if rc != 0 and exit_code == 0:
                     exit_code = _job_exit_code(rc)
                     # fail fast: take the rest of the job down, and say
@@ -355,6 +445,29 @@ def _run_job(args):
         for p in procs:
             p.send_signal(signal.SIGINT)
         exit_code = 130
+    if metrics_srv is not None:
+        # the workers have exited, so their endpoints are gone — a
+        # fresh scrape can only come up empty; fall back to the
+        # freshest live view any scrape cached so the job's final
+        # straggler / worst-link line still lands in the launch log
+        try:
+            agg = metrics_srv.collect() or getattr(
+                metrics_srv, "last_agg", None
+            )
+            if agg:
+                worst = agg["worst_link"]
+                where = (f" (rank {worst['rank']})"
+                         if worst["rank"] is not None else "")
+                _say(
+                    f"job metrics final: {agg['ranks_reporting']} "
+                    f"rank(s) reporting, straggler="
+                    f"{agg['straggler'] if agg['straggler'] is not None else 'n/a'}, "
+                    f"worst link reconnects={worst['reconnects']}"
+                    + where
+                )
+        except Exception:
+            pass
+        metrics_srv.stop()
     if tel_dir and exit_code != 130:
         _merge_telemetry(tel_dir, job)
     return exit_code

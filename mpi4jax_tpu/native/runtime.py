@@ -29,6 +29,7 @@ __all__ = [
     "set_hier",
     "set_resilience",
     "set_telemetry",
+    "annotate_step",
     "telemetry_mode_name",
     "telemetry_drain",
     "telemetry_last",
@@ -152,6 +153,7 @@ def _load():
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
     ]
     lib.t4j_metrics_snapshot.restype = ctypes.c_int64
+    lib.t4j_annotate_step.argtypes = [ctypes.c_int64, ctypes.c_int32]
     # data plane for the host-callback tier (TPU staging path); every
     # call returns a status: 0 ok, nonzero = failed with t4j_last_error
     i32, u64, vp = ctypes.c_int32, ctypes.c_uint64, ctypes.c_void_p
@@ -384,6 +386,21 @@ def telemetry_mode_name():
     return _TEL_MODE_NAMES.get(int(lib.t4j_telemetry_mode()), "off")
 
 
+def annotate_step(index, phase):
+    """Emit a step-boundary event into the native ring (``phase`` 1 =
+    begin, 2 = end; ``index`` is the caller-assigned step number).
+    The public surface is :func:`mpi4jax_tpu.ops.step.annotate_step` —
+    this is the plumbing.  No-op (returns False) when the native
+    library was never loaded: single-process mesh/self jobs still get
+    the python-lane step record from the recorder, they just have no
+    native ring to mark.  Never loads or builds the library."""
+    lib = _state["lib"]
+    if lib is None:
+        return False
+    lib.t4j_annotate_step(int(index), int(phase))
+    return True
+
+
 def _decode_event_buffer(buf, nbytes):
     from mpi4jax_tpu.telemetry import schema as _schema
 
@@ -466,24 +483,13 @@ def metrics_snapshot():
 
 
 def _format_recent_events(events):
-    """Compact post-mortem rendering of the ring tail: op, peer, age
-    relative to the newest event."""
+    """Compact post-mortem rendering of the ring tail — delegates to
+    the shared :func:`telemetry.schema.format_recent_events` so
+    check_health, the launcher's first-failure report, and the
+    exporter's one-shot export all render the tail identically."""
     from mpi4jax_tpu.telemetry import schema as _schema
 
-    if not events:
-        return ""
-    newest = max(e.t_ns for e in events)
-    parts = []
-    for e in events:
-        desc = _schema.kind_name(e.kind)
-        phase = _schema.PHASE_NAMES.get(e.phase, "?")
-        if phase != "instant":
-            desc += f" {phase}"
-        if e.peer >= 0:
-            desc += f" peer=r{e.peer}"
-        age_ms = (newest - e.t_ns) / 1e6
-        parts.append(f"{desc} ({age_ms:.1f}ms ago)")
-    return "; ".join(parts)
+    return _schema.format_recent_events(events)
 
 
 def notify_abort(why):
@@ -992,11 +998,41 @@ def ensure_initialized():
         from mpi4jax_tpu.telemetry import dump
 
         dump.install_atexit(tel_dir)
+    # live metrics exporter (docs/observability.md "live exporter"):
+    # T4J_METRICS_PORT=P makes rank k serve its metrics snapshot +
+    # link stats on 127.0.0.1:P+k as Prometheus text (/metrics) and
+    # JSON (/metrics.json); the launcher's --metrics sets it and
+    # aggregates the job view
+    mport = config.metrics_port()
+    if mport:
+        try:
+            from mpi4jax_tpu.telemetry import exporter
+
+            srv = exporter.MetricsExporter(
+                mport + int(lib.t4j_world_rank())
+            )
+            srv.start()
+            _state["exporter"] = srv
+        except Exception as e:  # noqa: BLE001 — metrics must not kill the job
+            import sys as _sys
+
+            print(
+                f"t4j: metrics exporter failed to start: "
+                f"{type(e).__name__}: {e}",
+                file=_sys.stderr,
+                flush=True,
+            )
     atexit.register(finalize)
     return True
 
 
 def finalize():
+    srv = _state.pop("exporter", None)
+    if srv is not None:
+        try:
+            srv.stop()
+        except Exception:
+            pass
     lib = _state["lib"]
     if lib and lib.t4j_initialized():
         # request-leak detection (docs/async.md): loud on stderr — the

@@ -4203,11 +4203,19 @@ std::shared_ptr<AsyncOp> reap_request(uint64_t req, int* src_out,
     // a waiter is now blocked on this request: the engine may arm the
     // parked-recv deadline (see AsyncOp::wait_requested)
     op->wait_requested.store(true, std::memory_order_release);
+    // caller-side blocked bracket (telemetry.h kWait): the op body's
+    // OpScope lands on the ENGINE lane, so this pair is the only
+    // trace record of the CALLER sitting in a wait — blocking
+    // collectives (submit + wait) included
+    tel::trace_event(tel::kWait, tel::kBegin, tel::kPlaneNone,
+                     async_evt_comm(*op), -1, op->payload_bytes);
     // the 100ms tick is a backstop only: completions notify done_cv,
     // and a wedged op faults within its own T4J_OP_TIMEOUT, draining
     // the queue and flipping this state
     while (op->state < AsyncOp::kDone)
       e.done_cv.wait_for(lk, std::chrono::milliseconds(100));
+    tel::trace_event(tel::kWait, tel::kEnd, tel::kPlaneNone,
+                     async_evt_comm(*op), -1, op->payload_bytes);
     e.inflight.erase(req);
   }
   if (op->state == AsyncOp::kFailed) throw BridgeError(op->error);

@@ -666,6 +666,14 @@ int64_t t4j_metrics_snapshot(uint64_t* out, int64_t max_words) {
   return static_cast<int64_t>(t4j::tel::metrics_snapshot(
       out, max_words < 0 ? 0 : static_cast<size_t>(max_words)));
 }
+// Step marker (ops.step.annotate_step / step_scope): emit a step-
+// boundary event — phase 1 begin, 2 end — with the caller-assigned
+// step index.  No-op below counters mode; never fails.
+void t4j_annotate_step(int64_t index, int32_t phase) {
+  t4j::tel::step_event(
+      phase == 2 ? t4j::tel::kEnd : t4j::tel::kBegin,
+      index < 0 ? 0 : static_cast<uint64_t>(index));
+}
 
 // ---- async progress engine (docs/async.md) ------------------------------
 //

@@ -99,6 +99,25 @@ enum Kind : uint16_t {
   kOpQueued = 50,
   kOpProgress = 51,
   kOpComplete = 52,
+  // caller-side blocked wait (trace mode): a begin/end pair on the
+  // CALLER's lane around reap_request's blocked region.  Every op
+  // body executes on the progress-engine thread, so its OpScope lands
+  // on the ENGINE lane — without this bracket a trace cannot tell a
+  // caller that sat inside wait() (blocking submit+wait included)
+  // from one that computed while the engine ran.  telemetry/
+  // diagnose.py builds caller-blocked time from these + caller-lane
+  // op scopes, and engine wire time from the engine lane.
+  kWait = 53,
+  // step markers (docs/observability.md "step markers"): user-declared
+  // iteration boundaries emitted through ops.step.annotate_step /
+  // step_scope via t4j_annotate_step.  `bytes` carries the step INDEX
+  // (monotone per rank, assigned by the Python side so every rank's
+  // step k is the same user-level iteration); begin/end phases pair up
+  // like op scopes.  Recorded from counters mode up — they are rare
+  // (one pair per training step) and they are the ground truth every
+  // per-step aggregation in telemetry/diagnose.py anchors on, so a
+  // counters-mode post-mortem still knows which step it died in.
+  kStep = 60,
 };
 
 enum Phase : uint8_t { kInstant = 0, kBegin = 1, kEnd = 2 };
@@ -320,6 +339,15 @@ inline void trace_event(Kind kind, Phase phase, Plane plane, int comm,
 inline void control_event(Kind kind, int peer, uint64_t bytes) {
   if (mode() < kCounters) return;
   emit(kind, kInstant, kPlaneCtrl, -1, peer, bytes);
+}
+
+// Step-boundary record (ops.step.annotate_step via t4j_annotate_step):
+// one begin/end pair per user-declared step, the step index in
+// `bytes`.  Counters mode up, like control events — rare and the
+// anchor of every per-step aggregation (telemetry/diagnose.py).
+inline void step_event(Phase phase, uint64_t index) {
+  if (mode() < kCounters) return;
+  emit(kStep, phase, kPlaneCtrl, -1, -1, index);
 }
 
 // Drain up to max_bytes/32 events in ring order (oldest first),

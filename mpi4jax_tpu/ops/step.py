@@ -1,0 +1,124 @@
+"""Step markers: user-declared iteration boundaries for telemetry.
+
+Per-step performance diagnosis (``t4j-diagnose``,
+docs/observability.md "diagnosing a slow step") needs ground truth for
+where one training/serving step ends and the next begins — inferring
+boundaries from op cadence breaks the moment a step issues a variable
+number of collectives.  :func:`annotate_step` / :func:`step_scope` are
+that ground truth: each call emits a step-boundary event into the
+native telemetry ring (kind 60, counters mode up — one pair per step,
+negligible cost) and a named row on the python recorder lane (trace
+mode), so every rank's "step k" is the same user-level iteration and
+the cross-rank merger/diagnoser can align, attribute, and compare
+steps by index.
+
+Two idioms::
+
+    for batch in data:                      # marker style (torch-like)
+        m.annotate_step("train")            # closes the previous step
+        loss = train_step(state, batch)
+    m.end_step()                            # close the last one
+
+    for batch in data:                      # scope style
+        with m.step_scope("train"):
+            loss = train_step(state, batch)
+
+Call these at host level, OUTSIDE jit (one call per executed step —
+inside a traced function they would fire once at trace time, marking
+nothing).  Steps never nest: ``annotate_step`` auto-closes the open
+step, and a ``step_scope`` inside another closes the outer one first
+(diagnose flags the imbalance).  A rank that dies mid-step leaves its
+last step open on purpose — diagnose closes it at the rank's last
+event, which is exactly the truncated span a post-mortem wants.
+
+Import-free of jax (stdlib only) like the telemetry package, so the
+standalone harnesses and old-jax containers can load it.
+"""
+
+import threading
+from contextlib import contextmanager
+
+__all__ = ["annotate_step", "end_step", "step_scope", "current_step"]
+
+_PHASE_BEGIN, _PHASE_END = 1, 2
+
+_state = {
+    "lock": threading.Lock(),
+    "index": -1,   # last assigned step index
+    "open": None,  # (index, name) of the currently open step
+}
+
+
+def _emit(index, phase, name):
+    # native ring first (counters mode up; no-op when the bridge was
+    # never loaded), then the python recorder lane (trace mode) which
+    # carries the NAME — the 32-byte native record has no string field,
+    # so names ride as "step:<name>" rows with the index in nbytes
+    try:
+        from mpi4jax_tpu.native import runtime
+
+        runtime.annotate_step(index, phase)
+    except Exception:
+        pass  # a marker must never fail the step it marks
+    from mpi4jax_tpu.telemetry import recorder
+
+    recorder.record(f"step:{name}", phase, nbytes=index)
+
+
+def annotate_step(name="step"):
+    """Mark the boundary of a new step: closes the currently open step
+    (if any) and opens the next one.  Returns the new step's index
+    (0-based, monotone per process).  Call once per executed iteration,
+    at host level outside jit."""
+    name = str(name)
+    with _state["lock"]:
+        if _state["open"] is not None:
+            idx, open_name = _state["open"]
+            _emit(idx, _PHASE_END, open_name)
+        _state["index"] += 1
+        idx = _state["index"]
+        _state["open"] = (idx, name)
+        _emit(idx, _PHASE_BEGIN, name)
+        return idx
+
+
+def end_step():
+    """Close the currently open step (no-op when none is open).  The
+    marker-style loop calls this once after the loop so the last step
+    gets a real end instead of a truncated one."""
+    with _state["lock"]:
+        if _state["open"] is None:
+            return
+        idx, name = _state["open"]
+        _state["open"] = None
+        _emit(idx, _PHASE_END, name)
+
+
+@contextmanager
+def step_scope(name="step"):
+    """Context-manager form: begin a step on entry, end it on exit.
+    Yields the step index."""
+    idx = annotate_step(name)
+    try:
+        yield idx
+    finally:
+        with _state["lock"]:
+            if _state["open"] is not None and _state["open"][0] == idx:
+                _, open_name = _state["open"]
+                _state["open"] = None
+                _emit(idx, _PHASE_END, open_name)
+            # else: a nested annotate_step already closed us — the
+            # imbalance is visible to diagnose via the step stream
+
+
+def current_step():
+    """``(index, name)`` of the open step, or ``None``."""
+    with _state["lock"]:
+        return _state["open"]
+
+
+def _reset():
+    """Test hook: forget all step state."""
+    with _state["lock"]:
+        _state["index"] = -1
+        _state["open"] = None

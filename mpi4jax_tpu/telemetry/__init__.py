@@ -19,6 +19,15 @@ Every module here is import-free of jax (stdlib only), like
 the package itself cannot import.
 """
 
+from . import diagnose  # the submodule: diagnose.diagnose/main/...
+from .diagnose import diagnose_path, diff_reports
+from .exporter import (
+    MetricsExporter,
+    aggregate_snapshots,
+    build_snapshot,
+    render_prometheus,
+    validate_snapshot,
+)
 from .recorder import py_op
 from .registry import Histogram, MetricsRegistry
 from .schema import (
@@ -30,7 +39,9 @@ from .schema import (
     Event,
     SchemaError,
     check_begin_end_balance,
+    check_step_balance,
     decode_events,
+    format_recent_events,
     load_rank_file,
     load_trace,
     parse_snapshot,
@@ -44,13 +55,21 @@ __all__ = [
     "Event",
     "Histogram",
     "KIND_NAMES",
+    "MetricsExporter",
     "MetricsRegistry",
     "PLANE_NAMES",
     "RANK_FILE_SCHEMA",
     "SCHEMA_VERSION",
     "SchemaError",
+    "aggregate_snapshots",
+    "build_snapshot",
     "check_begin_end_balance",
+    "check_step_balance",
     "decode_events",
+    "diagnose",
+    "diagnose_path",
+    "diff_reports",
+    "format_recent_events",
     "load_rank_file",
     "load_trace",
     "merge_dir",
@@ -58,6 +77,8 @@ __all__ = [
     "parse_snapshot",
     "py_op",
     "rank_to_chrome_events",
+    "render_prometheus",
     "validate_rank_file",
+    "validate_snapshot",
     "validate_trace",
 ]

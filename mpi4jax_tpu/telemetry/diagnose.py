@@ -1,0 +1,1077 @@
+"""``t4j-diagnose``: cross-rank per-step performance diagnosis.
+
+    t4j-diagnose DIR                  # a --telemetry dir of rank files
+    t4j-diagnose DIR/job.trace.json   # or the merged Perfetto trace
+    t4j-diagnose DIR --json           # machine-readable report
+    t4j-diagnose DIR --diff base.json # A/B against a saved --json run
+
+The interpretation layer over the raw telemetry substrate
+(docs/observability.md "diagnosing a slow step"): where ``t4j-top``
+totals what happened, this answers *why a step was slow and who made
+it so*.  Anchored on the step markers every rank emits
+(:mod:`mpi4jax_tpu.ops.step` -> native event kind 60), it reconstructs,
+per step and per rank, the phase decomposition
+
+* **compute** — wall time outside every comm bracket (the caller was
+  doing its own work),
+* **blocked** — wall time the CALLER sat inside a comm bracket:
+  native ``wait`` brackets (kind 53, emitted on the caller's lane
+  around every blocking wait — routed blocking collectives included),
+  op scopes on non-engine lanes, and python-lane spans.  Op scopes on
+  the ENGINE lane are the op bodies executing on the progress thread
+  and are deliberately NOT caller-blocked time,
+* **wire** — progress-engine execution time (``op_complete`` events
+  carry the duration; for a blocking job wire ⊆ blocked),
+* **stall** — link repair time (``link_break``→``reconnect``) and
+  replay events inside the step,
+
+and from the cross-rank comparison derives:
+
+* the step's **critical rank** (straggler) and which phase bounds it —
+  late entry / excess compute, wire pacing (outbound frame gaps, the
+  slow-NIC / injected-delay signature), or link stalls;
+* per-rank straggler tallies and an entry-skew histogram;
+* the **measured per-step overlap ratio** — the share of engine wire
+  time NOT covered by a caller blocked in a comm bracket (replacing
+  t4j-top's rank-global estimate; docs/async.md "overlap caveats");
+* **per-link wait-cause attribution**: outbound-frame pacing gaps and
+  self-healing repair/replay events tied to the ops they stalled;
+* a **plane-choice audit**: bytes served by the tree plane at sizes
+  where the ring (or hierarchical) plane would have been selected.
+
+Jobs without step markers are analysed as ONE step spanning the whole
+trace, so the tool still works on pre-marker recordings — per-step
+attribution just degrades to per-job.
+
+Import-free of jax (stdlib only), like the rest of this package; the
+console-script twin of ``t4j-top`` (pyproject.toml).
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+from . import schema
+from .trace import MERGED_NAME, RANK_FILE_GLOB
+
+DIAG_SCHEMA = "t4j-diagnose-v1"
+
+DEFAULT_RING_MIN_BYTES = 256 << 10         # dcn.cc kDefaultRingMinBytes
+DEFAULT_LEADER_RING_MIN_BYTES = 256 << 10  # kDefaultLeaderRingMinBytes
+DEFAULT_STALL_GAP_MS = 5.0
+
+# a rank is only called the straggler when its excess over the median
+# exceeds this share of the step's job-level duration — below it the
+# step is reported balanced instead of blaming noise
+BALANCED_FRACTION = 0.10
+
+# entry-skew histogram bucket upper bounds, in ms (last = overflow)
+SKEW_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, float("inf"))
+
+# collectives with a size-based plane switchover (the plane audit set)
+_SWITCHED_OPS = frozenset(
+    schema.KIND_IDS[k] for k in ("allreduce", "reduce_scatter",
+                                 "allgather")
+)
+
+
+def parse_bytes(value, name="value"):
+    """``256K``/``4M``-style byte counts (the T4J_* knob syntax)."""
+    s = str(value).strip()
+    mult = 1
+    if s and s[-1] in "kKmMgG":
+        mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}[s[-1].lower()]
+        s = s[:-1]
+    try:
+        return int(s) * mult
+    except ValueError:
+        raise ValueError(
+            f"cannot interpret {name}={value!r} as a byte count"
+        ) from None
+
+
+# ---- interval arithmetic -------------------------------------------------
+
+
+def _union(intervals):
+    """Sorted, merged copy of ``[(lo, hi), ...]``."""
+    out = []
+    for lo, hi in sorted(i for i in intervals if i[1] > i[0]):
+        if out and lo <= out[-1][1]:
+            if hi > out[-1][1]:
+                out[-1] = (out[-1][0], hi)
+        else:
+            out.append((lo, hi))
+    return out
+
+def _clip(intervals, lo, hi):
+    return [(max(a, lo), min(b, hi)) for a, b in intervals
+            if min(b, hi) > max(a, lo)]
+
+
+def _total(intervals):
+    return sum(b - a for a, b in intervals)
+
+
+def _overlap(a, b):
+    """Total length of the intersection of two merged interval lists."""
+    out = 0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            out += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _median(values):
+    s = sorted(values)
+    if not s:
+        return 0.0
+    n = len(s)
+    return (s[(n - 1) // 2] + s[n // 2]) / 2
+
+
+# ---- per-rank extraction -------------------------------------------------
+
+
+class RankView:
+    """One rank's telemetry re-expressed on the job-relative timeline
+    (ns since the rank's bootstrap anchor), pre-digested for per-step
+    slicing."""
+
+    def __init__(self, rank):
+        self.rank = rank
+        self.steps = {}        # index -> [t0, t1 | None]
+        self.step_names = {}   # index -> name
+        self.op_spans = []     # (t0, t1, kind, plane, bytes, lane)
+        self.py_spans = []     # (t0, t1, name)
+        self.wait_spans = []   # (t0, t1) caller-lane wait brackets
+        self.engine_busy = []  # (t0, t1) from op_complete
+        self.engine_lanes = set()  # lanes carrying engine lifecycle
+        self.frame_tx = {}     # peer -> [t, ...]
+        self.frame_rx = {}     # peer -> [t, ...]
+        self.ctrl = []         # (t, kind_name, peer)
+        self.step_problems = []
+        self.last_t = 0
+        self.link_stats = {}
+        self.topology = {}
+        self.tuning = {}
+
+    @property
+    def blocked_spans(self):
+        """Merged union of every CALLER-side comm bracket: native wait
+        brackets (kind 53 — blocking collectives route submit + wait,
+        so these cover them too), op scopes on non-engine lanes (the
+        pre-engine caller-thread path, e.g. p2p), and python-lane
+        spans (async submit/wait whose native scope is negligible).
+        Op scopes on an engine lane are the bodies executing on the
+        progress thread — wire time, not caller-blocked time."""
+        return _union(
+            [(a, b) for a, b, _k, _p, _n, lane in self.op_spans
+             if lane not in self.engine_lanes]
+            + list(self.wait_spans)
+            + [(a, b) for a, b, _n in self.py_spans]
+        )
+
+    def finish(self):
+        """Close truncated structures at the rank's last seen event."""
+        for idx, span in self.steps.items():
+            if span[1] is None:
+                span[1] = max(self.last_t, span[0])
+                span.append(True)   # truncated marker
+            elif len(span) == 2:
+                span.append(False)
+        self.op_spans.sort()
+        self.py_spans.sort()
+        self.wait_spans.sort()
+        self.engine_busy = _union(self.engine_busy)
+        for ts in self.frame_tx.values():
+            ts.sort()
+        for ts in self.frame_rx.values():
+            ts.sort()
+        self.ctrl.sort()
+
+
+def rank_view_from_obj(obj):
+    """Build a :class:`RankView` from a validated per-rank telemetry
+    file (the primary input path)."""
+    view = RankView(int(obj["rank"]))
+    anchor = int(obj["anchor"]["mono_ns"])
+    view.link_stats = obj.get("link_stats") or {}
+    view.topology = obj.get("topology") or {}
+    view.tuning = obj.get("tuning") or {}
+    events = [schema.event_from_list(r) for r in obj["events"]]
+    view.step_problems = schema.check_step_balance(events)
+    op_stacks = {}    # lane -> [(t, kind), ...] for top-level detection
+    wait_stacks = {}  # lane -> [t, ...]
+    for e in events:
+        t = e.t_ns - anchor
+        view.last_t = max(view.last_t, t)
+        if e.kind == schema.STEP_KIND:
+            if e.phase == schema.PHASE_BEGIN:
+                # a re-begun index (restarted job half-drained) keeps
+                # the first span; check_step_balance already flagged it
+                view.steps.setdefault(e.bytes, [t, None])
+            elif e.phase == schema.PHASE_END:
+                span = view.steps.get(e.bytes)
+                if span is not None and span[1] is None:
+                    span[1] = t
+        elif e.kind in schema.OP_KINDS:
+            stack = op_stacks.setdefault(e.lane, [])
+            if e.phase == schema.PHASE_BEGIN:
+                stack.append((t, e.kind))
+            elif e.phase == schema.PHASE_END and stack:
+                t0, kind = stack.pop()
+                if not stack and kind == e.kind:
+                    # top-level span; END carries the served plane
+                    view.op_spans.append(
+                        (t0, t, e.kind, e.plane, e.bytes, e.lane)
+                    )
+        elif e.kind == schema.WAIT_KIND:
+            stack = wait_stacks.setdefault(e.lane, [])
+            if e.phase == schema.PHASE_BEGIN:
+                stack.append(t)
+            elif e.phase == schema.PHASE_END and stack:
+                view.wait_spans.append((stack.pop(), t))
+        elif e.kind in (schema.KIND_IDS["op_progress"],
+                        schema.KIND_IDS["op_complete"]):
+            # only the engine thread emits these: its lane's op scopes
+            # are body executions, not caller-blocked time
+            view.engine_lanes.add(e.lane)
+            if e.kind == schema.KIND_IDS["op_complete"]:
+                # bytes = execution duration in ns (field overload)
+                view.engine_busy.append((t - int(e.bytes), t))
+        elif e.kind == schema.KIND_IDS["frame_tx"]:
+            if e.peer >= 0:
+                view.frame_tx.setdefault(e.peer, []).append(t)
+        elif e.kind == schema.KIND_IDS["frame_rx"]:
+            if e.peer >= 0:
+                view.frame_rx.setdefault(e.peer, []).append(t)
+        elif e.kind in schema.CONTROL_KINDS:
+            view.ctrl.append((t, schema.kind_name(e.kind), e.peer))
+    # python lane: spans + step names
+    py_stack = {}
+    for t_ns, op, phase, nbytes in obj.get("py_events", ()):
+        t = int(t_ns) - anchor
+        view.last_t = max(view.last_t, t)
+        if str(op).startswith("step:"):
+            if phase == schema.PHASE_BEGIN:
+                view.step_names[int(nbytes)] = str(op)[5:]
+            continue
+        if phase == schema.PHASE_BEGIN:
+            py_stack.setdefault(op, []).append(t)
+        elif phase == schema.PHASE_END and py_stack.get(op):
+            t0 = py_stack[op].pop()
+            view.py_spans.append((t0, t, str(op)))
+    view.finish()
+    return view
+
+
+# ---- merged-trace input --------------------------------------------------
+
+
+def rank_views_from_trace(trace_obj):
+    """Rebuild per-rank views from a merged Chrome/Perfetto
+    ``job.trace.json`` (the secondary input path: the per-rank files
+    may have been cleaned up, the merged artifact archived).  The
+    merger wrote job-relative µs with the anchor already subtracted,
+    so the anchor here is zero."""
+    views = {}
+    plane_ids = {v: k for k, v in schema.PLANE_NAMES.items()}
+    op_stacks = {}    # (pid, tid) -> [(t, kind)]
+    wait_stacks = {}  # (pid, tid) -> [t]
+    py_stacks = {}    # pid -> {name: [t]}
+    for e in trace_obj["traceEvents"]:
+        if e["ph"] == "M":
+            continue
+        pid = int(e["pid"])
+        view = views.get(pid)
+        if view is None:
+            view = views[pid] = RankView(pid)
+        t = int(round(float(e["ts"]) * 1000.0))  # µs -> ns
+        view.last_t = max(view.last_t, t)
+        name = e["name"]
+        args = e.get("args") or {}
+        if name.startswith("py:"):
+            op = name[3:]
+            if op.startswith("step:"):
+                if e["ph"] == "B":
+                    view.step_names[int(args.get("bytes", 0))] = op[5:]
+                continue
+            stacks = py_stacks.setdefault(pid, {})
+            if e["ph"] == "B":
+                stacks.setdefault(op, []).append(t)
+            elif e["ph"] == "E" and stacks.get(op):
+                t0 = stacks[op].pop()
+                if not args.get("truncated"):  # parity w/ rank files
+                    view.py_spans.append((t0, t, op))
+            continue
+        if name == "step":
+            idx = int(args.get("bytes", 0))
+            if e["ph"] == "B":
+                view.steps.setdefault(idx, [t, None])
+            elif e["ph"] == "E":
+                span = view.steps.get(idx)
+                if span is not None and span[1] is None:
+                    span[1] = t
+                    if args.get("truncated"):
+                        # a merger-synthesized close of a dead rank's
+                        # open step: keep the truncated tag the
+                        # rank-file path would have derived
+                        span.append(True)
+            continue
+        kind = schema.KIND_IDS.get(name)
+        if kind is None:
+            continue
+        if kind in schema.OP_KINDS:
+            key = (pid, e["tid"])
+            stack = op_stacks.setdefault(key, [])
+            if e["ph"] == "B":
+                stack.append((t, kind))
+            elif e["ph"] == "E" and stack:
+                t0, k0 = stack.pop()
+                # merger-synthesized truncated closes are skipped for
+                # parity with the rank-file path, where an op begin
+                # with no end never becomes a span
+                if (not stack and k0 == kind
+                        and not args.get("truncated")):
+                    view.op_spans.append((
+                        t0, t, kind,
+                        plane_ids.get(args.get("plane"), 0),
+                        int(args.get("bytes", 0)),
+                        e["tid"],
+                    ))
+        elif kind == schema.WAIT_KIND:
+            key = (pid, e["tid"])
+            stack = wait_stacks.setdefault(key, [])
+            if e["ph"] == "B":
+                stack.append(t)
+            elif e["ph"] == "E" and stack:
+                t0 = stack.pop()
+                if not args.get("truncated"):
+                    view.wait_spans.append((t0, t))
+        elif name == "frame_tx" and int(args.get("peer", -1)) >= 0:
+            view.frame_tx.setdefault(int(args["peer"]), []).append(t)
+        elif name == "frame_rx" and int(args.get("peer", -1)) >= 0:
+            view.frame_rx.setdefault(int(args["peer"]), []).append(t)
+        elif kind in schema.CONTROL_KINDS:
+            view.ctrl.append((t, name, int(args.get("peer", -1))))
+        elif name in ("op_progress", "op_complete"):
+            # engine lifecycle instants mark the engine's tid: its op
+            # slices are body executions, not caller-blocked time
+            view.engine_lanes.add(e["tid"])
+            if name == "op_complete":
+                dur = int(args.get("bytes", 0))
+                view.engine_busy.append((t - dur, t))
+    for view in views.values():
+        view.finish()
+    return [views[k] for k in sorted(views)]
+
+
+def load_views(path):
+    """Path (telemetry dir, one rank file, or a merged trace) -> list
+    of :class:`RankView`."""
+    p = pathlib.Path(path)
+    if p.is_dir():
+        files = sorted(p.glob(RANK_FILE_GLOB))
+        if files:
+            return [rank_view_from_obj(schema.load_rank_file(f))
+                    for f in files]
+        merged = p / MERGED_NAME
+        if merged.exists():
+            return rank_views_from_trace(schema.load_trace(merged))
+        raise FileNotFoundError(
+            f"no {RANK_FILE_GLOB} files and no {MERGED_NAME} in {p}"
+        )
+    with open(p) as f:
+        obj = json.load(f)
+    if "traceEvents" in obj:
+        return rank_views_from_trace(schema.validate_trace(obj))
+    return [rank_view_from_obj(schema.validate_rank_file(obj))]
+
+
+# ---- the analysis --------------------------------------------------------
+
+
+def _tx_stall(view, lo, hi, gap_ns):
+    """(total stall ns, per-peer {peer: stall}, per-(peer, op) stall,
+    max local gap ns) from outbound frame pacing inside [lo, hi).
+
+    The metric is the **local send latency** of each outbound frame:
+    the time from the moment this rank's inputs were ready — its
+    previous tx, its last INBOUND frame, or the enclosing comm
+    activity's start, whichever is latest — to the tx itself.  The
+    distinction is what makes straggler attribution localise: in a
+    segmented ring a slow sender paces every downstream rank, so raw
+    inter-tx gaps inherit the delay fleet-wide, but downstream ranks
+    send immediately after their rx arrives (local latency ~0) while
+    the slow rank sits on ready inputs (local latency = its injected
+    or NIC-level delay).  Gaps above ``gap_ns`` count; frames outside
+    any comm-activity interval (op scope or engine-busy span) never
+    do, so compute pauses between collectives are not wire stalls."""
+    activity = _union(
+        [(a, b) for a, b, _k, _p, _n, _l in view.op_spans]
+        + list(view.engine_busy)
+    )
+    activity = _clip(activity, lo, hi)
+    # spans overlapping the window, start-sorted (op_spans already is).
+    # The probes below ride the time-ordered frame timeline, so both
+    # lookups advance monotone pointers — O(frames + spans) per step,
+    # not O(frames x spans): a 32Ki-event trace stays interactive.
+    win_ops = [(a, b, kind)
+               for a, b, kind, _p, _n, _l in view.op_spans
+               if min(b, hi) > max(a, lo)]
+    act_i = 0
+    op_j = 0
+    op_active = []  # started spans not yet ended, insertion = start order
+
+    def containing(t):
+        nonlocal act_i
+        while act_i < len(activity) and activity[act_i][1] < t:
+            act_i += 1
+        if (act_i < len(activity)
+                and activity[act_i][0] <= t <= activity[act_i][1]):
+            return activity[act_i]
+        return None
+
+    def op_of(t):
+        nonlocal op_j, op_active
+        while op_j < len(win_ops) and win_ops[op_j][0] <= t:
+            op_active.append(win_ops[op_j])
+            op_j += 1
+        op_active = [s for s in op_active if s[1] >= t]
+        if op_active:  # earliest-started (outermost) containing span
+            return schema.kind_name(op_active[0][2])
+        return "engine"
+
+    # merged wire-event timeline: (t, is_tx, peer), time-ordered
+    timeline = sorted(
+        [(t, True, peer) for peer, ts in view.frame_tx.items()
+         for t in ts]
+        + [(t, False, peer) for peer, ts in view.frame_rx.items()
+           for t in ts]
+    )
+    total = 0
+    per_peer = {}
+    per_peer_op = {}  # (peer, op name) -> stalled ns
+    max_gap = 0
+    last_ready = None  # latest own-tx or inbound-frame instant
+    last_act = None
+    for t, is_tx, peer in timeline:
+        if t < lo or t > hi:
+            last_ready = None
+            continue
+        act = containing(t)
+        if not is_tx:
+            if act is not None:
+                last_ready, last_act = t, act
+            continue
+        if act is not None:
+            ref = act[0] if (last_ready is None or last_act != act) \
+                else last_ready
+            gap = t - ref
+            max_gap = max(max_gap, gap)
+            if gap > gap_ns:
+                total += gap
+                per_peer[peer] = per_peer.get(peer, 0) + gap
+                key = (peer, op_of(t))
+                per_peer_op[key] = per_peer_op.get(key, 0) + gap
+            last_ready, last_act = t, act
+    return total, per_peer, per_peer_op, max_gap
+
+
+def _ctrl_stall(view, lo, hi):
+    """Per-peer ``{peer: {"ns", "replays", "breaks"}}`` inside
+    [lo, hi): a ``link_break`` opens a repair window closed by the
+    next ``reconnect`` on the same peer (or the window end — a break
+    the step never recovered from stalls it to the end).  Replay and
+    break counts are per peer too, so the links table attributes each
+    event to its own link, never the sum over all of them."""
+    open_break = {}
+    per_peer = {}
+
+    def rec(peer):
+        return per_peer.setdefault(
+            peer, {"ns": 0, "replays": 0, "breaks": 0}
+        )
+
+    for t, kind, peer in view.ctrl:
+        if t < lo or t > hi:
+            continue
+        if kind == "link_break":
+            rec(peer)["breaks"] += 1
+            open_break.setdefault(peer, t)
+        elif kind == "reconnect" and peer in open_break:
+            rec(peer)["ns"] += t - open_break.pop(peer)
+        elif kind == "replay":
+            rec(peer)["replays"] += 1
+    for peer, t0 in open_break.items():
+        rec(peer)["ns"] += hi - t0
+    return per_peer
+
+
+def _step_table(views):
+    """{index: {rank: (t0, t1, truncated)}} over every rank; when no
+    rank recorded a single step marker, the whole trace becomes step
+    -1 ("job")."""
+    table = {}
+    for view in views:
+        for idx, (t0, t1, trunc) in view.steps.items():
+            table.setdefault(int(idx), {})[view.rank] = (t0, t1, trunc)
+    if table:
+        return table
+    whole = {}
+    for view in views:
+        lo = min(
+            [a for a, _b, *_ in view.op_spans]
+            + [a for a, _b, _n in view.py_spans]
+            + ([view.last_t] if view.last_t else [0])
+        )
+        whole[view.rank] = (lo, view.last_t, False)
+    return {-1: whole}
+
+
+def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
+             stall_gap_ms=DEFAULT_STALL_GAP_MS):
+    """The full report dict over a list of :class:`RankView` (the
+    ``--json`` payload; :func:`render` turns it into tables)."""
+    gap_ns = int(stall_gap_ms * 1e6)
+    # knobs: CLI override > the job's recorded tuning > defaults
+    tunings = [v.tuning for v in views if v.tuning]
+    if ring_min_bytes is None:
+        ring_min_bytes = next(
+            (t["ring_min_bytes"] for t in tunings
+             if t.get("ring_min_bytes") is not None),
+            DEFAULT_RING_MIN_BYTES,
+        )
+    if leader_ring_min_bytes is None:
+        leader_ring_min_bytes = next(
+            (t["leader_ring_min_bytes"] for t in tunings
+             if t.get("leader_ring_min_bytes") is not None),
+            DEFAULT_LEADER_RING_MIN_BYTES,
+        )
+
+    table = _step_table(views)
+    by_rank = {v.rank: v for v in views}
+    names = {}
+    for view in views:
+        names.update(view.step_names)
+
+    steps = []
+    led = {}
+    skew_hist = [0] * len(SKEW_BUCKETS_MS)
+    rank_totals = {
+        v.rank: {"compute_ms": 0.0, "blocked_ms": 0.0, "wire_ms": 0.0,
+                 "tx_stall_ms": 0.0, "ctrl_stall_ms": 0.0,
+                 "overlap_num": 0.0, "overlap_den": 0.0, "steps": 0}
+        for v in views
+    }
+    link_stall = {}   # (rank, peer) -> {"pacing_ms", "repair_ms", ...}
+
+    for idx in sorted(table):
+        spans = table[idx]
+        t_begin = min(s[0] for s in spans.values())
+        t_end = max(s[1] for s in spans.values())
+        job_dur = max(t_end - t_begin, 1)
+        entry_skew = max(s[0] for s in spans.values()) - t_begin
+        per_rank = []
+        for rank in sorted(spans):
+            view = by_rank[rank]
+            lo, hi, trunc = spans[rank]
+            dur = max(hi - lo, 0)
+            blocked = _clip(view.blocked_spans, lo, hi)
+            blocked_ns = _total(blocked)
+            compute_ns = max(dur - blocked_ns, 0)
+            wire = _clip(view.engine_busy, lo, hi)
+            wire_ns = _total(wire)
+            overlap_pct = None
+            if wire_ns > 0:
+                covered = _overlap(wire, blocked)
+                overlap_pct = round(
+                    100.0 * max(0.0, 1.0 - covered / wire_ns), 1
+                )
+            tx_ns, tx_per_peer, tx_per_peer_op, max_gap = _tx_stall(
+                view, lo, hi, gap_ns
+            )
+            ctrl_per_peer = _ctrl_stall(view, lo, hi)
+            ctrl_ns = sum(c["ns"] for c in ctrl_per_peer.values())
+            for peer, ns in tx_per_peer.items():
+                rec = link_stall.setdefault(
+                    (rank, peer),
+                    {"pacing_ms": 0.0, "repair_ms": 0.0, "replays": 0,
+                     "breaks": 0, "ops": {}},
+                )
+                rec["pacing_ms"] += ns / 1e6
+            for peer, c in ctrl_per_peer.items():
+                rec = link_stall.setdefault(
+                    (rank, peer),
+                    {"pacing_ms": 0.0, "repair_ms": 0.0, "replays": 0,
+                     "breaks": 0, "ops": {}},
+                )
+                rec["repair_ms"] += c["ns"] / 1e6
+                rec["replays"] += c["replays"]
+                rec["breaks"] += c["breaks"]
+            for (peer, op), ns in tx_per_peer_op.items():
+                rec = link_stall[(rank, peer)]
+                rec["ops"][op] = rec["ops"].get(op, 0.0) + ns / 1e6
+            per_rank.append({
+                "rank": rank,
+                "dur_ms": dur / 1e6,
+                "entry_late_ms": (lo - t_begin) / 1e6,
+                "compute_ms": compute_ns / 1e6,
+                "blocked_ms": blocked_ns / 1e6,
+                "wire_ms": wire_ns / 1e6,
+                "overlap_pct": overlap_pct,
+                "tx_stall_ms": tx_ns / 1e6,
+                "max_tx_gap_ms": max_gap / 1e6,
+                "ctrl_stall_ms": ctrl_ns / 1e6,
+                "truncated": bool(trunc),
+            })
+            tot = rank_totals[rank]
+            tot["compute_ms"] += compute_ns / 1e6
+            tot["blocked_ms"] += blocked_ns / 1e6
+            tot["wire_ms"] += wire_ns / 1e6
+            tot["tx_stall_ms"] += tx_ns / 1e6
+            tot["ctrl_stall_ms"] += ctrl_ns / 1e6
+            if overlap_pct is not None:
+                tot["overlap_num"] += overlap_pct
+                tot["overlap_den"] += 1
+            tot["steps"] += 1
+
+        med_compute = _median([r["compute_ms"] for r in per_rank])
+        scores = []
+        for r in per_rank:
+            compute_excess = (max(0.0, r["compute_ms"] - med_compute)
+                              + r["entry_late_ms"])
+            components = {
+                "compute": compute_excess,
+                "wire": r["tx_stall_ms"],
+                "stall": r["ctrl_stall_ms"],
+            }
+            phase = max(components, key=lambda k: components[k])
+            scores.append((sum(components.values()), r["rank"], phase))
+        scores.sort(reverse=True)
+        critical_rank = None
+        critical_phase = "balanced"
+        if scores and scores[0][0] * 1e6 > BALANCED_FRACTION * job_dur:
+            critical_rank = scores[0][1]
+            critical_phase = scores[0][2]
+            led[critical_rank] = led.get(critical_rank, 0) + 1
+        for bucket, bound in enumerate(SKEW_BUCKETS_MS):
+            if entry_skew / 1e6 < bound:
+                skew_hist[bucket] += 1
+                break
+        overlaps = [r["overlap_pct"] for r in per_rank
+                    if r["overlap_pct"] is not None]
+        steps.append({
+            "index": idx,
+            "name": names.get(idx, "job" if idx == -1 else "step"),
+            "t_begin_ms": t_begin / 1e6,
+            "dur_ms": job_dur / 1e6,
+            "entry_skew_ms": entry_skew / 1e6,
+            "critical_rank": critical_rank,
+            "critical_phase": critical_phase,
+            "critical_excess_ms": scores[0][0] if scores else 0.0,
+            "overlap_pct": (round(sum(overlaps) / len(overlaps), 1)
+                            if overlaps else None),
+            "ranks": per_rank,
+        })
+
+    # plane audit over every top-level op span (END events carry the
+    # served plane): bytes the tree plane moved at sizes where the
+    # ring / hierarchical planes would have been selected
+    audit = {
+        "ring_min_bytes": int(ring_min_bytes),
+        "leader_ring_min_bytes": int(leader_ring_min_bytes),
+        "tree_bytes_over_ring_min": 0,
+        "tree_calls_over_ring_min": 0,
+        "flat_bytes_over_leader_min_on_multihost": 0,
+        "flat_calls_over_leader_min_on_multihost": 0,
+    }
+    plane_ids = {v: k for k, v in schema.PLANE_NAMES.items()}
+    for view in views:
+        topo = view.topology or {}
+        multihost = (int(topo.get("n_hosts", 1) or 1) > 1
+                     and int(topo.get("local_size", 1) or 1) > 1)
+        for _a, _b, kind, plane, nbytes, _lane in view.op_spans:
+            if kind not in _SWITCHED_OPS:
+                continue
+            if plane == plane_ids["tree"] and nbytes >= ring_min_bytes:
+                audit["tree_bytes_over_ring_min"] += nbytes
+                audit["tree_calls_over_ring_min"] += 1
+            if (multihost
+                    and plane in (plane_ids["tree"], plane_ids["ring"])
+                    and nbytes >= leader_ring_min_bytes):
+                audit["flat_bytes_over_leader_min_on_multihost"] += nbytes
+                audit["flat_calls_over_leader_min_on_multihost"] += 1
+
+    ranks_out = []
+    for rank in sorted(rank_totals):
+        tot = rank_totals[rank]
+        n = max(tot["steps"], 1)
+        ranks_out.append({
+            "rank": rank,
+            "steps": tot["steps"],
+            "steps_led": led.get(rank, 0),
+            "mean_compute_ms": round(tot["compute_ms"] / n, 3),
+            "mean_blocked_ms": round(tot["blocked_ms"] / n, 3),
+            "mean_wire_ms": round(tot["wire_ms"] / n, 3),
+            "tx_stall_ms": round(tot["tx_stall_ms"], 3),
+            "ctrl_stall_ms": round(tot["ctrl_stall_ms"], 3),
+            "mean_overlap_pct": (
+                round(tot["overlap_num"] / tot["overlap_den"], 1)
+                if tot["overlap_den"] else None
+            ),
+        })
+
+    links_out = []
+    for (rank, peer), rec in sorted(link_stall.items()):
+        stalled_ops = sorted(
+            rec["ops"].items(), key=lambda kv: kv[1], reverse=True
+        )
+        cause = ("repair" if rec["repair_ms"] > rec["pacing_ms"]
+                 else "pacing")
+        links_out.append({
+            "rank": rank,
+            "peer": peer,
+            "pacing_ms": round(rec["pacing_ms"], 3),
+            "repair_ms": round(rec["repair_ms"], 3),
+            "replays": rec["replays"],
+            "breaks": rec["breaks"],
+            "cause": cause,
+            "stalled_ops": [
+                {"op": op, "ms": round(ms, 3)} for op, ms in stalled_ops
+            ],
+        })
+    links_out.sort(
+        key=lambda r: r["pacing_ms"] + r["repair_ms"], reverse=True
+    )
+
+    durs = [s["dur_ms"] for s in steps]
+    overlaps = [s["overlap_pct"] for s in steps
+                if s["overlap_pct"] is not None]
+    attributed = [s for s in steps if s["critical_rank"] is not None]
+    top_straggler = max(led, key=lambda r: led[r]) if led else None
+    step_problems = sorted({
+        p for v in views for p in v.step_problems
+    })
+    return {
+        "schema": DIAG_SCHEMA,
+        "ranks": len(views),
+        "n_steps": len(steps),
+        "summary": {
+            "step_ms_median": round(_median(durs), 3) if durs else None,
+            "step_ms_max": round(max(durs), 3) if durs else None,
+            "entry_skew_ms_median": round(
+                _median([s["entry_skew_ms"] for s in steps]), 3
+            ) if steps else None,
+            "overlap_pct_median": (round(_median(overlaps), 1)
+                                   if overlaps else None),
+            "steps_attributed": len(attributed),
+            "straggler": top_straggler,
+            "straggler_share": (
+                round(led[top_straggler] / len(attributed), 3)
+                if attributed and top_straggler is not None else None
+            ),
+        },
+        "stragglers": {str(r): n for r, n in sorted(led.items())},
+        "entry_skew_hist_ms": {
+            ("<" + str(SKEW_BUCKETS_MS[i]) if i == 0 else
+             (f">={SKEW_BUCKETS_MS[i-1]:g}" if b == float("inf") else
+              f"{SKEW_BUCKETS_MS[i-1]:g}-{b:g}")): skew_hist[i]
+            for i, b in enumerate(SKEW_BUCKETS_MS)
+        },
+        "steps": steps,
+        "rank_summary": ranks_out,
+        "links": links_out,
+        "plane_audit": audit,
+        "step_marker_problems": step_problems,
+    }
+
+
+def diagnose_path(path, **kwargs):
+    return diagnose(load_views(path), **kwargs)
+
+
+# ---- A/B diff ------------------------------------------------------------
+
+_DIFF_KEYS = (
+    ("step_ms_median", "median step ms", False),
+    ("step_ms_max", "max step ms", False),
+    ("entry_skew_ms_median", "median entry skew ms", False),
+    ("overlap_pct_median", "median overlap %", True),
+)
+
+
+def diff_reports(cur, base):
+    """A/B delta between two ``--json`` reports: summary metrics with
+    relative change (sign-aware: overlap up = better, times down =
+    better), straggler movement, and per-link stall deltas."""
+    out = {"schema": DIAG_SCHEMA + "+diff", "metrics": [], "links": []}
+    for key, label, higher_better in _DIFF_KEYS:
+        a = base.get("summary", {}).get(key)
+        b = cur.get("summary", {}).get(key)
+        delta = None
+        better = None
+        if a is not None and b is not None:
+            delta = round(b - a, 3)
+            if a:
+                pct = round(100.0 * (b - a) / abs(a), 1)
+            else:
+                # a zero baseline (e.g. overlap of a pure-blocking
+                # run) has no finite relative change: null, never
+                # float('inf') — json.dumps would emit bare Infinity,
+                # which strict JSON parsers reject
+                pct = 0.0 if b == a else None
+            better = (delta >= 0) == higher_better or delta == 0
+            out["metrics"].append({
+                "metric": key, "label": label, "base": a, "cur": b,
+                "delta": delta, "delta_pct": pct,
+                "improved": better,
+            })
+        else:
+            out["metrics"].append({
+                "metric": key, "label": label, "base": a, "cur": b,
+                "delta": None, "delta_pct": None, "improved": None,
+            })
+    out["straggler"] = {
+        "base": base.get("summary", {}).get("straggler"),
+        "cur": cur.get("summary", {}).get("straggler"),
+    }
+    base_links = {(r["rank"], r["peer"]): r
+                  for r in base.get("links", ())}
+    for link in cur.get("links", ()):
+        key = (link["rank"], link["peer"])
+        prev = base_links.pop(key, None)
+        prev_ms = ((prev["pacing_ms"] + prev["repair_ms"])
+                   if prev else 0.0)
+        cur_ms = link["pacing_ms"] + link["repair_ms"]
+        out["links"].append({
+            "rank": link["rank"], "peer": link["peer"],
+            "base_stall_ms": round(prev_ms, 3),
+            "cur_stall_ms": round(cur_ms, 3),
+            "delta_ms": round(cur_ms - prev_ms, 3),
+        })
+    for (rank, peer), prev in sorted(base_links.items()):
+        prev_ms = prev["pacing_ms"] + prev["repair_ms"]
+        out["links"].append({
+            "rank": rank, "peer": peer,
+            "base_stall_ms": round(prev_ms, 3), "cur_stall_ms": 0.0,
+            "delta_ms": round(-prev_ms, 3),
+        })
+    return out
+
+
+# ---- rendering -----------------------------------------------------------
+
+
+def _fmt(v, nd=2, dash="-"):
+    return dash if v is None else f"{v:.{nd}f}"
+
+
+def render(report, max_steps=40):
+    out = []
+    summ = report["summary"]
+    out.append(
+        f"t4j-diagnose — {report['ranks']} rank(s), "
+        f"{report['n_steps']} step(s), "
+        f"median {_fmt(summ['step_ms_median'])} ms / "
+        f"max {_fmt(summ['step_ms_max'])} ms per step"
+    )
+    if summ["straggler"] is not None:
+        share = summ["straggler_share"]
+        out.append(
+            f"  straggler: r{summ['straggler']} led "
+            f"{report['stragglers'].get(str(summ['straggler']), 0)} of "
+            f"{summ['steps_attributed']} attributed step(s)"
+            + (f" ({100 * share:.0f}%)" if share is not None else "")
+        )
+    else:
+        out.append("  straggler: none (steps balanced)")
+    if summ["overlap_pct_median"] is not None:
+        out.append(
+            f"  measured overlap: median {summ['overlap_pct_median']}% "
+            "of wire time ran under caller compute"
+        )
+    hist = report["entry_skew_hist_ms"]
+    if any(hist.values()):
+        out.append("  entry-skew histogram (ms): " + "  ".join(
+            f"{k}:{v}" for k, v in hist.items() if v
+        ))
+    steps = report["steps"]
+    shown = steps if len(steps) <= max_steps else steps[-max_steps:]
+    if shown:
+        out.append("")
+        out.append(
+            f"  {'step':<8}{'name':<12}{'dur ms':>10}{'skew ms':>10}"
+            f"{'overlap%':>10}{'critical':>10}{'phase':>10}"
+        )
+        for s in shown:
+            crit = ("-" if s["critical_rank"] is None
+                    else f"r{s['critical_rank']}")
+            out.append(
+                f"  {s['index']:<8}{s['name'][:11]:<12}"
+                f"{s['dur_ms']:>10.2f}{s['entry_skew_ms']:>10.2f}"
+                f"{_fmt(s['overlap_pct'], 1):>10}{crit:>10}"
+                f"{s['critical_phase']:>10}"
+            )
+        if len(steps) > len(shown):
+            out.append(f"  ... ({len(steps) - len(shown)} earlier "
+                       "step(s) elided; --json has all)")
+    if report["rank_summary"]:
+        out.append("")
+        out.append(
+            f"  {'rank':<6}{'led':>5}{'compute':>10}{'blocked':>10}"
+            f"{'wire':>10}{'txstall':>10}{'repair':>10}{'overlap%':>10}"
+        )
+        for r in report["rank_summary"]:
+            out.append(
+                f"  r{r['rank']:<5}{r['steps_led']:>5}"
+                f"{r['mean_compute_ms']:>10.2f}"
+                f"{r['mean_blocked_ms']:>10.2f}"
+                f"{r['mean_wire_ms']:>10.2f}{r['tx_stall_ms']:>10.2f}"
+                f"{r['ctrl_stall_ms']:>10.2f}"
+                f"{_fmt(r['mean_overlap_pct'], 1):>10}"
+            )
+    links = report["links"][:10]
+    if links:
+        out.append("")
+        out.append(
+            f"  {'link':<12}{'pacing ms':>11}{'repair ms':>11}"
+            f"{'replays':>9}{'cause':>8}  stalled ops"
+        )
+        for link in links:
+            ops = ", ".join(
+                f"{o['op']} {o['ms']:.1f}ms"
+                for o in link["stalled_ops"][:3]
+            )
+            out.append(
+                f"  r{link['rank']}->r{link['peer']:<8}"
+                f"{link['pacing_ms']:>11.2f}{link['repair_ms']:>11.2f}"
+                f"{link['replays']:>9}{link['cause']:>8}  {ops}"
+            )
+    audit = report["plane_audit"]
+    if audit["tree_calls_over_ring_min"]:
+        mb = audit["tree_bytes_over_ring_min"] / 1e6
+        out.append("")
+        out.append(
+            f"  plane audit: {audit['tree_calls_over_ring_min']} "
+            f"call(s) / {mb:.1f} MB went TREE at sizes >= "
+            f"{audit['ring_min_bytes']} B where the ring plane is "
+            "selected by default — check T4J_RING_MIN_BYTES "
+            "(docs/performance.md)"
+        )
+    if audit["flat_calls_over_leader_min_on_multihost"]:
+        mb = audit["flat_bytes_over_leader_min_on_multihost"] / 1e6
+        out.append(
+            f"  plane audit: {audit['flat_calls_over_leader_min_on_multihost']} "
+            f"call(s) / {mb:.1f} MB ran FLAT on a multi-host topology "
+            f"at sizes >= {audit['leader_ring_min_bytes']} B where the "
+            "hierarchical plane applies — check T4J_HIER"
+        )
+    if report["step_marker_problems"]:
+        out.append("")
+        out.append("  step-marker problems: "
+                   + "; ".join(report["step_marker_problems"][:5]))
+    return "\n".join(out)
+
+
+def render_diff(diff):
+    out = ["t4j-diagnose --diff (cur vs base)"]
+    for m in diff["metrics"]:
+        if m["delta"] is None:
+            out.append(f"  {m['label']:<24} base={m['base']} "
+                       f"cur={m['cur']} (n/a)")
+            continue
+        arrow = "improved" if m["improved"] else "regressed"
+        if m["delta"] == 0:
+            arrow = "unchanged"
+        pct = ("" if m["delta_pct"] is None
+               else f"{m['delta_pct']:+.1f}%, ")
+        out.append(
+            f"  {m['label']:<24} {m['base']} -> {m['cur']} "
+            f"({m['delta']:+g}, {pct}{arrow})"
+        )
+    stra = diff["straggler"]
+    if stra["base"] != stra["cur"]:
+        out.append(f"  straggler moved: r{stra['base']} -> "
+                   f"r{stra['cur']}")
+    else:
+        out.append(f"  straggler unchanged: {stra['base']}")
+    moved = [link for link in diff["links"] if abs(link["delta_ms"]) > 1.0]
+    for link in sorted(moved, key=lambda r: -abs(r["delta_ms"]))[:8]:
+        out.append(
+            f"  link r{link['rank']}->r{link['peer']}: stall "
+            f"{link['base_stall_ms']} -> {link['cur_stall_ms']} ms "
+            f"({link['delta_ms']:+g})"
+        )
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="t4j-diagnose",
+        description="cross-rank per-step performance diagnosis "
+                    "(docs/observability.md)",
+    )
+    ap.add_argument("path", help="--telemetry directory, one "
+                                 "rank<k>.t4j.json, or a merged "
+                                 "job.trace.json")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report")
+    ap.add_argument("--diff", metavar="BASELINE.json", default=None,
+                    help="compare against a saved --json report")
+    ap.add_argument("--ring-min-bytes", default=None, metavar="N[KMG]",
+                    help="ring-plane switchover for the plane audit "
+                         "(default: the job's recorded tuning, else "
+                         f"{DEFAULT_RING_MIN_BYTES})")
+    ap.add_argument("--leader-ring-min-bytes", default=None,
+                    metavar="N[KMG]",
+                    help="hierarchical switchover for the plane audit")
+    ap.add_argument("--stall-gap-ms", type=float,
+                    default=DEFAULT_STALL_GAP_MS, metavar="MS",
+                    help="outbound frame gaps above this count as wire "
+                         f"stalls (default {DEFAULT_STALL_GAP_MS})")
+    args = ap.parse_args(argv)
+    try:
+        views = load_views(args.path)
+    except (FileNotFoundError, schema.SchemaError) as e:
+        print(f"t4j-diagnose: {e}", file=sys.stderr)
+        return 2
+    report = diagnose(
+        views,
+        ring_min_bytes=(parse_bytes(args.ring_min_bytes,
+                                    "--ring-min-bytes")
+                        if args.ring_min_bytes else None),
+        leader_ring_min_bytes=(
+            parse_bytes(args.leader_ring_min_bytes,
+                        "--leader-ring-min-bytes")
+            if args.leader_ring_min_bytes else None),
+        stall_gap_ms=args.stall_gap_ms,
+    )
+    if args.diff:
+        with open(args.diff) as f:
+            base = json.load(f)
+        if base.get("schema") != DIAG_SCHEMA:
+            print(
+                f"t4j-diagnose: {args.diff} is not a saved --json "
+                f"report (schema {base.get('schema')!r})",
+                file=sys.stderr,
+            )
+            return 2
+        diff = diff_reports(report, base)
+        print(json.dumps(diff) if args.json else render_diff(diff))
+        return 0
+    print(json.dumps(report) if args.json else render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

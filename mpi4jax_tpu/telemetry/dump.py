@@ -38,7 +38,7 @@ _hook_state = {"installed": False}
 # :func:`capture_runtime_state` just before teardown so the exit file
 # still carries the per-link counters.
 _accum = {"events": [], "py_events": [], "link_stats": None,
-          "topology": None}
+          "topology": None, "tuning": None}
 
 
 def capture_runtime_state():
@@ -63,6 +63,20 @@ def capture_runtime_state():
             _accum["topology"] = topo
     except Exception:
         pass
+    # the plane-selection knobs the job ran under: t4j-diagnose's
+    # plane audit judges served planes against THESE, not against
+    # whatever environment diagnose later runs in
+    try:
+        from mpi4jax_tpu.utils import config
+
+        _accum["tuning"] = {
+            "ring_min_bytes": config.ring_min_bytes(),
+            "seg_bytes": config.seg_bytes(),
+            "leader_ring_min_bytes": config.leader_ring_min_bytes(),
+            "hier": config.hier_mode(),
+        }
+    except Exception:
+        pass
 
 
 def rank_file_name(rank):
@@ -71,7 +85,8 @@ def rank_file_name(rank):
 
 def build_rank_obj(rank, world, anchor_mono_ns, anchor_unix_ns, mode,
                    events=(), py_events=(), metrics_words=(),
-                   dropped=0, link_stats=None, topology=None, job=None):
+                   dropped=0, link_stats=None, topology=None, job=None,
+                   tuning=None):
     """Assemble a schema-valid per-rank telemetry object from raw
     drains (``events``: iterable of :class:`schema.Event` or 8-field
     rows; ``metrics_words``: the u64 snapshot)."""
@@ -97,6 +112,7 @@ def build_rank_obj(rank, world, anchor_mono_ns, anchor_unix_ns, mode,
         "metrics": metrics,
         "link_stats": link_stats or {},
         "topology": topology or {},
+        "tuning": tuning or {},
     }
     return schema.validate_rank_file(obj)
 
@@ -130,6 +146,7 @@ def collect():
         link_stats=link,
         topology=_accum["topology"] or {},
         job=os.environ.get("T4J_JOB", ""),
+        tuning=_accum["tuning"] or {},
     )
 
 

@@ -1,0 +1,426 @@
+"""Live metrics exporter: per-rank snapshots over HTTP + one-shot files.
+
+The continuously-exported half of docs/observability.md: where the
+rank files / ``t4j-diagnose`` are retrospective, this serves each
+rank's CURRENT metrics table, link stats, and last telemetry events
+while the job runs — the data source serving admission control
+(ROADMAP item 5) and any Prometheus scrape needs.
+
+* ``T4J_METRICS_PORT=P`` (or the launcher's ``--metrics P``) makes
+  rank k serve ``127.0.0.1:P+k``:
+
+  - ``/metrics``       Prometheus text exposition
+  - ``/metrics.json``  the full JSON snapshot (:func:`validate_snapshot`)
+
+  wired in ``native.runtime.ensure_initialized`` / stopped at finalize.
+* :func:`export_file` writes the same snapshot once to disk — and
+  includes the ``check_health`` post-mortem surfaces (the "last
+  telemetry events" tail via the shared
+  :func:`schema.format_recent_events`, and the link-stats aggregate
+  WITH per-peer maxima), so the live view and the post-mortem agree.
+* ``launch.py --metrics P`` scrapes every rank's ``/metrics.json`` and
+  serves the :func:`aggregate_snapshots` job view — worst-link and
+  straggler gauges included — on port ``P + nprocs``.
+
+Import-free of jax (stdlib only): standalone harnesses plug their own
+``collect_fn`` (any zero-arg callable returning a snapshot dict).
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import schema
+from .registry import MetricsRegistry
+
+SNAPSHOT_SCHEMA = "t4j-metrics-v1"
+
+_SNAP_REQUIRED = ("schema", "rank", "world", "mode", "ts_unix_ns",
+                  "ops", "bytes_by_plane", "link_stats", "last_events",
+                  "dropped")
+
+
+class SnapshotError(schema.SchemaError):
+    """A metrics snapshot does not match the documented schema."""
+
+
+def validate_snapshot(obj):
+    """Raise :class:`SnapshotError` unless ``obj`` is a well-formed
+    exporter snapshot; returns ``obj``."""
+    if not isinstance(obj, dict):
+        raise SnapshotError("snapshot is not a JSON object")
+    for key in _SNAP_REQUIRED:
+        if key not in obj:
+            raise SnapshotError(f"snapshot is missing {key!r}")
+    if obj["schema"] != SNAPSHOT_SCHEMA:
+        raise SnapshotError(
+            f"snapshot schema {obj['schema']!r} != {SNAPSHOT_SCHEMA!r}"
+        )
+    for row in obj["ops"]:
+        for key in ("op", "plane", "count", "bytes"):
+            if key not in row:
+                raise SnapshotError(f"ops row is missing {key!r}")
+    if not isinstance(obj["last_events"], list):
+        raise SnapshotError("last_events must be a list")
+    return obj
+
+
+def build_snapshot(rank, world, mode, metrics, link_stats=None,
+                   last_events=(), dropped=0, step=None, job="",
+                   ts_unix_ns=None):
+    """Assemble a schema-valid snapshot from raw pieces.
+
+    ``metrics`` is a native u64-word snapshot, a parsed snapshot dict,
+    or a :class:`MetricsRegistry`; ``last_events`` an iterable of
+    :class:`schema.Event` (formatted via the shared
+    :func:`schema.format_recent_events` so this export and
+    ``check_health`` render identically)."""
+    if isinstance(metrics, MetricsRegistry):
+        reg = metrics
+    elif metrics:
+        reg = MetricsRegistry.from_snapshot(metrics)
+    else:
+        reg = MetricsRegistry()
+    ops = []
+    for op in reg.ops():
+        for plane in sorted({p for (_c, o, p) in reg.rows if o == op}):
+            row = reg.aggregate(op=op, plane=plane)
+            stats = row.stats()
+            stats.update(op=op, plane=plane)
+            ops.append(stats)
+    events = list(last_events)
+    obj = {
+        "schema": SNAPSHOT_SCHEMA,
+        "rank": int(rank),
+        "world": int(world),
+        "mode": str(mode),
+        "job": str(job or ""),
+        "ts_unix_ns": int(ts_unix_ns if ts_unix_ns is not None
+                          else time.time_ns()),
+        "step": step,
+        "dropped": int(dropped),
+        "ops": ops,
+        "bytes_by_plane": reg.bytes_by_plane(),
+        "link_stats": link_stats or {},
+        "last_events": schema.format_recent_events(events).split("; ")
+        if events else [],
+        "last_events_raw": [schema.event_to_list(e) for e in events],
+    }
+    return validate_snapshot(obj)
+
+
+def collect_snapshot():
+    """The in-package collector: pull everything from
+    ``native.runtime`` (``None`` when the bridge was never loaded).
+    The default ``collect_fn`` of :class:`MetricsExporter` and the
+    default source of :func:`export_file`."""
+    import os
+
+    from mpi4jax_tpu.native import runtime
+
+    if runtime._state["lib"] is None:
+        return None
+    step = None
+    try:
+        from mpi4jax_tpu.ops import step as step_mod
+
+        open_step = step_mod.current_step()
+        if open_step is not None:
+            step = {"index": open_step[0], "name": open_step[1]}
+    except Exception:
+        pass
+    return build_snapshot(
+        rank=int(os.environ.get("T4J_RANK", 0)),
+        world=int(os.environ.get("T4J_SIZE", 1)),
+        mode=runtime.telemetry_mode_name(),
+        metrics=runtime.metrics_snapshot(),
+        link_stats=runtime.link_stats(),
+        last_events=runtime.telemetry_last(8),
+        dropped=runtime.telemetry_dropped(),
+        step=step,
+        job=os.environ.get("T4J_JOB", ""),
+    )
+
+
+def export_file(path, obj=None):
+    """One-shot export: write a snapshot to ``path`` (collecting from
+    the live runtime when ``obj`` is None).  The file carries the same
+    "last telemetry events" tail and link-stats maxima check_health
+    reports, so post-mortem and live views agree.  Returns the path,
+    or ``None`` when there was nothing to export."""
+    import os
+    import pathlib
+
+    if obj is None:
+        obj = collect_snapshot()
+    if obj is None:
+        return None
+    p = pathlib.Path(path)
+    if p.parent.name:
+        p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(f".tmp{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(validate_snapshot(obj), f)
+    os.replace(tmp, p)
+    return p
+
+
+# ---- Prometheus text exposition ------------------------------------------
+
+
+def _esc(value):
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render_prometheus(obj, prefix="t4j"):
+    """Snapshot dict -> Prometheus text exposition format."""
+    validate_snapshot(obj)
+    rank = obj["rank"]
+    lines = []
+
+    def emit(name, labels, value, help_=None, type_="gauge"):
+        if value is None:
+            return
+        if help_ is not None:
+            lines.append(f"# HELP {prefix}_{name} {help_}")
+            lines.append(f"# TYPE {prefix}_{name} {type_}")
+        lbl = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+        lines.append(f"{prefix}_{name}{{{lbl}}} {value}")
+
+    base = {"rank": rank}
+    emit("up", base, 1, help_="rank exporter liveness")
+    emit("telemetry_dropped_total", base, obj["dropped"],
+         help_="native ring events dropped to overflow",
+         type_="counter")
+    if obj.get("step"):
+        emit("step_index", base, obj["step"].get("index"),
+             help_="index of the currently open step marker")
+    first = True
+    for row in obj["ops"]:
+        labels = dict(base, op=row["op"], plane=row["plane"])
+        emit("op_count_total", labels, row["count"],
+             help_="op invocations" if first else None, type_="counter")
+        emit("op_bytes_total", labels, row["bytes"],
+             help_="payload bytes" if first else None, type_="counter")
+        for q in ("p50", "p99", "max"):
+            v = row.get(f"{q}_ms")
+            if v is not None:
+                emit(f"op_latency_{q}_ms", labels, round(v, 4),
+                     help_=f"{q} op latency (histogram estimate)"
+                     if first else None)
+        first = False
+    first = True
+    for plane, nbytes in sorted(obj["bytes_by_plane"].items()):
+        emit("plane_bytes_total", dict(base, plane=plane), nbytes,
+             help_="payload bytes per data plane" if first else None,
+             type_="counter")
+        first = False
+    links = obj.get("link_stats") or {}
+    per_peer = links.get("per_peer") or {}
+    first = True
+    for peer, s in sorted(per_peer.items(), key=lambda kv: int(kv[0])):
+        labels = dict(base, peer=peer)
+        emit("link_reconnects_total", labels, s.get("reconnects"),
+             help_="self-healing reconnects per link" if first else None,
+             type_="counter")
+        emit("link_replayed_bytes_total", labels,
+             s.get("replayed_bytes"), type_="counter")
+        emit("link_state", labels, s.get("state"),
+             help_="0 up, 1 broken/repairing, 2 dead" if first else None)
+        first = False
+    agg = {k: v for k, v in links.items() if k != "per_peer"}
+    if agg:
+        emit("link_reconnects_sum", base, agg.get("reconnects"),
+             help_="reconnects over every link", type_="counter")
+        emit("worst_link_reconnects", base, agg.get("max_reconnects"),
+             help_="reconnects on the worst link (admission-control "
+                   "signal)")
+        emit("worst_link_replayed_bytes", base,
+             agg.get("max_replayed_bytes"))
+        if agg.get("worst_peer") is not None:
+            emit("worst_link_peer", base, agg.get("worst_peer"),
+                 help_="peer rank of the worst link")
+        emit("link_state_worst", base, agg.get("state"))
+    return "\n".join(lines) + "\n"
+
+
+# ---- job-level aggregation (the launcher's --metrics view) ---------------
+
+
+def aggregate_snapshots(objs, job=""):
+    """Per-rank snapshots -> one job-level view: totals, worst-link
+    gauges, and a straggler gauge (the rank with the LEAST time spent
+    inside comm ops — in a collective job everyone waits on the
+    straggler, so the rank that waits least is the one gating the
+    rest; ``t4j-diagnose`` is the precise per-step tool, this is the
+    live approximation admission control can poll)."""
+    objs = [o for o in objs if o]
+    ranks = []
+    worst = {"peer": None, "rank": None, "reconnects": 0,
+             "replayed_bytes": 0, "state": 0}
+    bytes_by_plane = {}
+    comm_ms = {}
+    total_dropped = 0
+    for obj in objs:
+        validate_snapshot(obj)
+        rank = int(obj["rank"])
+        ranks.append(rank)
+        total_dropped += int(obj["dropped"])
+        for plane, nbytes in obj["bytes_by_plane"].items():
+            bytes_by_plane[plane] = bytes_by_plane.get(plane, 0) + nbytes
+        busy = 0.0
+        for row in obj["ops"]:
+            mean = row.get("mean_ms")
+            if mean is not None:
+                busy += mean * row["count"]
+        comm_ms[rank] = round(busy, 3)
+        links = obj.get("link_stats") or {}
+        state = links.get("state", 0) or 0
+        if (links.get("max_reconnects", 0), state) > (
+                worst["reconnects"], worst["state"]):
+            worst.update(
+                rank=rank,
+                peer=links.get("worst_peer"),
+                reconnects=links.get("max_reconnects", 0),
+                replayed_bytes=links.get("max_replayed_bytes", 0),
+                state=state,
+            )
+    straggler = None
+    if len(comm_ms) > 1:
+        straggler = min(comm_ms, key=lambda r: comm_ms[r])
+    return {
+        "schema": SNAPSHOT_SCHEMA + "+job",
+        "job": job,
+        "ts_unix_ns": time.time_ns(),
+        "ranks": sorted(ranks),
+        "ranks_reporting": len(ranks),
+        "dropped": total_dropped,
+        "bytes_by_plane": bytes_by_plane,
+        "comm_ms_by_rank": {str(r): comm_ms[r] for r in sorted(comm_ms)},
+        "straggler": straggler,
+        "worst_link": worst,
+    }
+
+
+def render_prometheus_job(agg, prefix="t4j_job"):
+    """Job aggregate -> Prometheus text."""
+    lines = [
+        f"# HELP {prefix}_ranks_reporting ranks whose exporter "
+        "answered the last scrape",
+        f"# TYPE {prefix}_ranks_reporting gauge",
+        f"{prefix}_ranks_reporting {agg['ranks_reporting']}",
+        f"{prefix}_dropped_total {agg['dropped']}",
+    ]
+    for plane, nbytes in sorted(agg["bytes_by_plane"].items()):
+        lines.append(
+            f'{prefix}_plane_bytes_total{{plane="{_esc(plane)}"}} '
+            f"{nbytes}"
+        )
+    for rank, ms in agg["comm_ms_by_rank"].items():
+        lines.append(f'{prefix}_comm_ms{{rank="{rank}"}} {ms}')
+    if agg["straggler"] is not None:
+        lines.append(f"{prefix}_straggler_rank {agg['straggler']}")
+    worst = agg["worst_link"]
+    lines.append(f"{prefix}_worst_link_reconnects {worst['reconnects']}")
+    lines.append(
+        f"{prefix}_worst_link_replayed_bytes {worst['replayed_bytes']}"
+    )
+    lines.append(f"{prefix}_worst_link_state {worst['state']}")
+    if worst["rank"] is not None:
+        lines.append(f"{prefix}_worst_link_rank {worst['rank']}")
+    return "\n".join(lines) + "\n"
+
+
+# ---- the HTTP server -----------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "t4j-exporter/1"
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        exporter = self.server.exporter  # type: ignore[attr-defined]
+        try:
+            obj = exporter.collect()
+        except Exception as e:  # noqa: BLE001 — a scrape must not kill a rank
+            self._reply(500, "text/plain",
+                        f"collect failed: {type(e).__name__}: {e}\n")
+            return
+        if obj is None:
+            self._reply(503, "text/plain", "no telemetry yet\n")
+            return
+        if self.path.startswith("/metrics.json"):
+            self._reply(200, "application/json", json.dumps(obj))
+        elif self.path.startswith("/metrics"):
+            render = (render_prometheus_job
+                      if str(obj.get("schema", "")).endswith("+job")
+                      else render_prometheus)
+            self._reply(200, "text/plain; version=0.0.4", render(obj))
+        else:
+            self._reply(404, "text/plain",
+                        "try /metrics or /metrics.json\n")
+
+    def _reply(self, code, ctype, body):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):  # scrapes must not spam the job log
+        pass
+
+
+class MetricsExporter:
+    """Serve a snapshot callable on ``127.0.0.1:port`` in a daemon
+    thread.  ``port=0`` picks an ephemeral port (read it back from
+    ``.port`` after :meth:`start` — the tests' idiom)."""
+
+    def __init__(self, port, collect_fn=None, host="127.0.0.1"):
+        self._requested = (host, int(port))
+        self._collect = (collect_fn if collect_fn is not None
+                         else collect_snapshot)
+        self._httpd = None
+        self._thread = None
+
+    def collect(self):
+        return self._collect()
+
+    @property
+    def port(self):
+        if self._httpd is None:
+            return self._requested[1]
+        return self._httpd.server_address[1]
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer(self._requested, _Handler)
+        httpd.daemon_threads = True
+        httpd.exporter = self  # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="t4j-metrics", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+def scrape(url, timeout=1.0):
+    """GET ``url`` and parse the JSON body (the launcher's aggregator
+    helper); raises on HTTP/connection errors."""
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())

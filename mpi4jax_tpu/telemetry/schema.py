@@ -66,6 +66,20 @@ KIND_NAMES = {
     50: "op_queued",
     51: "op_progress",
     52: "op_complete",
+    # caller-side blocked wait (trace mode): begin/end on the CALLER's
+    # lane around reap_request's blocked region.  Op bodies execute on
+    # the engine thread (their scopes land on the ENGINE lane), so
+    # these pairs are the only native record of the caller sitting in
+    # a wait — blocking collectives (submit + wait) included.
+    # diagnose.py builds caller-blocked time from them.
+    53: "wait",
+    # step markers (ops.step.annotate_step / step_scope): begin/end
+    # pairs whose `bytes` field carries the step INDEX — the ground
+    # truth every per-step aggregation (telemetry/diagnose.py) anchors
+    # on.  Step NAMES ride the python lane as "step:<name>" rows with
+    # the index in nbytes (the 32-byte native record has no string
+    # field).
+    60: "step",
 }
 KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
 
@@ -75,6 +89,10 @@ OP_KINDS = frozenset(range(1, 15))
 CONTROL_KINDS = frozenset((30, 31, 32, 33, 34))
 # Async engine instants (docs/async.md): per-request lifecycle markers.
 ASYNC_KINDS = frozenset((50, 51, 52))
+# Caller-lane blocked-wait spans (begin/end pairs like op scopes).
+WAIT_KIND = 53
+# Step-boundary markers (docs/observability.md "step markers").
+STEP_KIND = 60
 
 # Async events pack the submitted op's kind into the comm field's high
 # byte ((kind+1) << 24 | comm & 0xFFFFFF — dcn.cc async_evt_comm), so
@@ -313,6 +331,69 @@ def validate_trace(obj):
 def load_trace(path):
     with open(path) as f:
         return validate_trace(json.load(f))
+
+
+def format_recent_events(events):
+    """Compact one-line rendering of a ring tail: op, peer, age
+    relative to the newest event.  THE shared formatter for every
+    surface that shows "last telemetry events" — runtime.check_health's
+    fault message, the launcher's first-failure report, and the
+    exporter's one-shot file export all call this, so the post-mortem
+    and live views agree byte for byte."""
+    if not events:
+        return ""
+    newest = max(e.t_ns for e in events)
+    parts = []
+    for e in events:
+        desc = kind_name(e.kind)
+        phase = PHASE_NAMES.get(e.phase, "?")
+        if phase != "instant":
+            desc += f" {phase}"
+        if e.kind == STEP_KIND:
+            desc += f" #{e.bytes}"
+        elif e.peer >= 0:
+            desc += f" peer=r{e.peer}"
+        age_ms = (newest - e.t_ns) / 1e6
+        parts.append(f"{desc} ({age_ms:.1f}ms ago)")
+    return "; ".join(parts)
+
+
+def check_step_balance(events):
+    """Problems list for the step-marker stream of one rank: every step
+    begin must be closed by an end carrying the SAME index before the
+    next begin opens (steps never nest — annotate_step auto-closes),
+    and indices must be monotone.  A dangling final begin is NOT a
+    problem: a rank that dies (or is drained) mid-step legitimately
+    leaves its last step open, and diagnose closes it at the last seen
+    event.  Empty list = clean."""
+    problems = []
+    open_idx = None
+    last_idx = None
+    for e in events:
+        if e.kind != STEP_KIND:
+            continue
+        if e.phase == PHASE_BEGIN:
+            if open_idx is not None:
+                problems.append(
+                    f"step #{e.bytes} began while step #{open_idx} was "
+                    "still open"
+                )
+            if last_idx is not None and e.bytes <= last_idx:
+                problems.append(
+                    f"step index went backwards: #{e.bytes} after "
+                    f"#{last_idx}"
+                )
+            open_idx = e.bytes
+            last_idx = e.bytes
+        elif e.phase == PHASE_END:
+            if open_idx is None:
+                problems.append(f"step #{e.bytes} ended but never began")
+            elif e.bytes != open_idx:
+                problems.append(
+                    f"step end #{e.bytes} closes step #{open_idx}"
+                )
+            open_idx = None
+    return problems
 
 
 def check_begin_end_balance(events):

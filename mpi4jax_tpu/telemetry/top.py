@@ -35,15 +35,34 @@ from .registry import MetricsRegistry
 from .trace import RANK_FILE_GLOB
 
 
-def load_rank_objs(path):
+def load_rank_objs(path, lenient=False):
     """Path (dir of rank files, or one rank file) -> list of validated
-    rank objects."""
+    rank objects.
+
+    ``lenient`` (the ``--follow`` mode) skips files that fail to read
+    or validate instead of raising: mid-job, rank files appear one at
+    a time as ranks drain (late ranks simply have no file yet), and a
+    non-atomic third-party writer can expose a torn file for one tick
+    — the next re-read picks both up.  With every present file broken
+    it still raises FileNotFoundError so the follow loop keeps
+    waiting."""
     p = pathlib.Path(path)
     if p.is_dir():
         files = sorted(p.glob(RANK_FILE_GLOB))
         if not files:
             raise FileNotFoundError(f"no {RANK_FILE_GLOB} files in {p}")
-        return [schema.load_rank_file(f) for f in files]
+        objs = []
+        for f in files:
+            try:
+                objs.append(schema.load_rank_file(f))
+            except (OSError, ValueError):  # SchemaError is a ValueError
+                if not lenient:
+                    raise
+        if not objs:
+            raise FileNotFoundError(
+                f"no readable {RANK_FILE_GLOB} files in {p} (yet)"
+            )
+        return objs
     return [schema.load_rank_file(p)]
 
 
@@ -182,8 +201,11 @@ def summarize(rank_objs):
             "submitted": v["submitted"],
             "completed": v["completed"],
             "max_depth": v["max_depth"],
+            # None (rendered "-"), not 0.0: a row whose events carried
+            # no depth samples has an UNKNOWN queue depth — zero would
+            # read as "measured empty" in the --json consumer
             "mean_depth": round(v["depth_sum"] / v["depth_n"], 2)
-            if v["depth_n"] else 0.0,
+            if v["depth_n"] else None,
             "busy_ms": round(v["busy_ns"] / 1e6, 3),
             "overlap_pct": v.get("overlap_pct"),
         })
@@ -229,11 +251,16 @@ def render(summary):
                    f"{'maxQ':>6}{'meanQ':>7}{'busy ms':>10}"
                    f"{'overlap%':>10}")
         for a in summary["async"]:
+            # pure-blocking traces (or drains that raced the engine)
+            # can leave overlap/queue-depth unknown: render "-", never
+            # a fabricated number
             ov = "-" if a["overlap_pct"] is None else f"{a['overlap_pct']:.1f}"
+            md = ("-" if a["mean_depth"] is None
+                  else f"{a['mean_depth']:.2f}")
             out.append(
                 f"  {a['op']:<18}r{a['rank']:<4}{a['submitted']:>7}"
                 f"{a['completed']:>7}{a['max_depth']:>6}"
-                f"{a['mean_depth']:>7.2f}{a['busy_ms']:>10.3f}{ov:>10}"
+                f"{md:>7}{a['busy_ms']:>10.3f}{ov:>10}"
             )
     if summary["links"]:
         out.append("")
@@ -280,11 +307,22 @@ def main(argv=None):
     args = ap.parse_args(argv)
     while True:
         try:
-            summary = summarize(load_rank_objs(args.path))
+            summary = summarize(
+                load_rank_objs(args.path, lenient=args.follow is not None)
+            )
         except FileNotFoundError as e:
             if args.follow is None:
                 print(f"t4j-top: {e}", file=sys.stderr)
                 return 2
+            summary = None
+        except (OSError, ValueError) as e:
+            # --follow mid-job: a single-file path can be mid-write by
+            # a non-atomic writer; report and keep following
+            if args.follow is None:
+                print(f"t4j-top: {e}", file=sys.stderr)
+                return 2
+            print(f"t4j-top: transient read failure, retrying: {e}",
+                  file=sys.stderr)
             summary = None
         if summary is not None:
             if args.json:

@@ -72,7 +72,7 @@ def rank_to_chrome_events(rank_obj):
         return (int(t_ns) - anchor_mono) / 1000.0
 
     last_ts = 0.0
-    open_spans = {}  # tid -> [name, ...]
+    open_spans = {}  # tid -> [(name, begin args), ...]
     for row in rank_obj["events"]:
         e = schema.event_from_list(row)
         tid = tids[e.lane]
@@ -85,13 +85,22 @@ def rank_to_chrome_events(rank_obj):
             "peer": e.peer,
             "bytes": e.bytes,
         }
-        if e.kind in schema.OP_KINDS and e.phase == schema.PHASE_BEGIN:
-            open_spans.setdefault(tid, []).append(name)
+        # step markers (kind 60) render as duration slices exactly like
+        # op scopes: one "step" span framing the ops of that iteration
+        # (args.bytes carries the step index), and caller-lane wait
+        # brackets (kind 53) as slices on the waiting thread;
+        # t4j-diagnose recovers per-step windows and caller-blocked
+        # time from a merged trace through these
+        is_span = (e.kind in schema.OP_KINDS
+                   or e.kind == schema.STEP_KIND
+                   or e.kind == schema.WAIT_KIND)
+        if is_span and e.phase == schema.PHASE_BEGIN:
+            open_spans.setdefault(tid, []).append((name, args))
             out.append({"name": name, "ph": "B", "ts": ts, "pid": rank,
                         "tid": tid, "args": args})
-        elif e.kind in schema.OP_KINDS and e.phase == schema.PHASE_END:
+        elif is_span and e.phase == schema.PHASE_END:
             stack = open_spans.get(tid, [])
-            if stack and stack[-1] == name:
+            if stack and stack[-1][0] == name:
                 stack.pop()
                 out.append({"name": name, "ph": "E", "ts": ts,
                             "pid": rank, "tid": tid, "args": args})
@@ -106,13 +115,16 @@ def rank_to_chrome_events(rank_obj):
     # must not get its truncated end placed BEFORE its begin
     for t_ns, _op, _phase, _nbytes in rank_obj["py_events"]:
         last_ts = max(last_ts, ts_us(t_ns))
-    # close spans cut off by death/drain at the last seen instant
+    # close spans cut off by death/drain at the last seen instant,
+    # keeping the BEGIN's args (plane/bytes — for a step span the step
+    # index): consumers of the merged trace (t4j-diagnose) must see
+    # the same identity + truncated flag the rank-file path derives
     for tid, stack in open_spans.items():
         while stack:
-            name = stack.pop()
+            name, bargs = stack.pop()
             out.append({"name": name, "ph": "E", "ts": last_ts,
                         "pid": rank, "tid": tid,
-                        "args": {"truncated": True}})
+                        "args": dict(bargs, truncated=True)})
     # python lane: same discipline as the native lanes — an end whose
     # begin is missing (dropped from the bounded recorder deque, or
     # crossed by another thread's bracket interleaving on this shared

@@ -86,6 +86,13 @@ Telemetry (docs/observability.md):
                             launcher's ``--telemetry DIR`` sets it and
                             merges the files into one Perfetto
                             ``job.trace.json``).
+* ``T4J_METRICS_PORT``    — live metrics exporter base port: rank k
+                            serves its metrics snapshot + link stats on
+                            ``127.0.0.1:<port>+k`` (Prometheus text at
+                            ``/metrics``, JSON at ``/metrics.json``);
+                            the launcher's ``--metrics PORT`` sets it
+                            and aggregates the job view on
+                            ``<port>+nprocs``.  Unset/0 = disabled.
 
 The byte knobs accept an optional K/M/G suffix
 (``T4J_SEG_BYTES=256K``) and all of them must be uniform across ranks
@@ -123,6 +130,7 @@ __all__ = [
     "telemetry_mode",
     "telemetry_bytes",
     "telemetry_dir",
+    "metrics_port",
 ]
 
 _TRUE = {"1", "true", "on", "yes"}
@@ -443,6 +451,36 @@ def telemetry_dir():
     if v is None or not str(v).strip():
         return None
     return str(v).strip()
+
+
+def metrics_port():
+    """Base port of the live metrics exporter (docs/observability.md
+    "live exporter"), or 0 when unset (disabled, the default).
+
+    Rank k serves its metrics snapshot + link stats on
+    ``127.0.0.1:<port>+k`` as Prometheus text (``/metrics``) and JSON
+    (``/metrics.json``); the launcher's ``--metrics PORT`` sets this
+    for every rank and serves the aggregated job view on
+    ``<port>+nprocs``.  The base must leave room for every rank below
+    65536 — validated against T4J_SIZE when present."""
+    v = os.environ.get("T4J_METRICS_PORT")
+    if v is None or not str(v).strip():
+        return 0
+    try:
+        port = int(str(v).strip())
+    except ValueError:
+        raise ValueError(
+            f"cannot interpret T4J_METRICS_PORT={v!r} as a port number"
+        ) from None
+    if port == 0:
+        return 0
+    world = int(os.environ.get("T4J_SIZE", "1") or 1)
+    if not 1 <= port or port + world > 65536:
+        raise ValueError(
+            f"T4J_METRICS_PORT={port} does not leave room for "
+            f"{world} rank port(s) below 65536"
+        )
+    return port
 
 
 def op_timeout():

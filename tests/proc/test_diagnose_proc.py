@@ -1,0 +1,162 @@
+"""Cross-rank diagnosis end-to-end over a real launcher job
+(docs/observability.md "diagnosing a slow step").
+
+An 8-rank ``--telemetry DIR`` job runs marked training steps
+(``annotate_step``/``step_scope`` through the package layer) with ONE
+rank slowed by the PR-1 fault injection (``T4J_FAULT_MODE=delay``:
+sleep before every outbound frame).  ``t4j-diagnose`` over the rank
+files must name that rank the step-critical straggler with the stall
+attributed to the WIRE phase, and tie a stalled link to it — the same
+acceptance bar the ci_smoke ``diagnose`` lane (tools/diagnose_smoke.py)
+enforces on the ctypes tier, here through the full jax op layer.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401 -- probe only
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+from mpi4jax_tpu.telemetry import diagnose, dump, exporter, schema
+
+from tests.proc.test_proc_backend import run_workers
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+NPROCS = 8
+STEPS = 10
+DELAY_RANK = 2
+DELAY_MS = 15
+
+WORKER = """
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+rank = comm.rank()
+
+tok = m.create_token()
+x = jnp.arange(4096.0, dtype=jnp.float32) + rank
+for it in range(%(steps)d):
+    with m.step_scope("train"):
+        y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        np.asarray(y)  # host sync inside the step
+assert m.current_step() is None
+tok = m.barrier(comm=comm, token=tok)
+print("WORKER-OK", rank, flush=True)
+""" % {"steps": STEPS}
+
+# frames must cross the wire so the delay fault (which sleeps before
+# outbound frames) bites and frame_tx pacing is observable
+DELAY_ENV = {
+    "T4J_NO_SHM": "1",
+    "T4J_RING_MIN_BYTES": "0",
+    "T4J_SEG_BYTES": "4096",
+    "T4J_FAULT_MODE": "delay",
+    "T4J_FAULT_RANK": str(DELAY_RANK),
+    "T4J_FAULT_DELAY_MS": str(DELAY_MS),
+    "T4J_FAULT_AFTER": "0",
+}
+
+
+def test_delayed_rank_is_named_straggler(tmp_path):
+    tel_dir = tmp_path / "tel"
+    proc = run_workers(
+        WORKER, nprocs=NPROCS, env=DELAY_ENV, timeout=600,
+        launch_args=("--telemetry", str(tel_dir)),
+    )
+    assert proc.stdout.count("WORKER-OK") == NPROCS, proc.stdout
+
+    files = sorted(tel_dir.glob("rank*.t4j.json"))
+    assert len(files) == NPROCS, [f.name for f in files]
+    report = diagnose.diagnose_path(tel_dir)
+
+    # every rank recorded every marked step, cleanly balanced
+    assert not report["step_marker_problems"], (
+        report["step_marker_problems"][:5]
+    )
+    steps = [s for s in report["steps"] if s["index"] >= 0]
+    assert len(steps) == STEPS, [s["index"] for s in steps]
+    assert all(s["name"] == "train" for s in steps)
+    assert all(len(s["ranks"]) == NPROCS for s in steps)
+
+    # the acceptance bar: the delayed rank fingered in >= 9/10 steps,
+    # with the stall attributed to its wire phase (local send latency
+    # localises the delay — downstream ranks inherit the pacing but
+    # send the moment their inputs arrive)
+    hits = [s for s in steps if s["critical_rank"] == DELAY_RANK]
+    assert len(hits) >= (len(steps) * 9) // 10, (
+        f"r{DELAY_RANK} fingered in {len(hits)}/{len(steps)} steps: "
+        f"{[(s['index'], s['critical_rank']) for s in steps]}"
+    )
+    wire_hits = [s for s in hits if s["critical_phase"] == "wire"]
+    assert len(wire_hits) > len(hits) // 2, (
+        [(s["index"], s["critical_phase"]) for s in hits]
+    )
+    assert report["summary"]["straggler"] == DELAY_RANK
+
+    # a stalled link is tied to the delayed rank and to the op
+    stalled = [link for link in report["links"]
+               if link["rank"] == DELAY_RANK and link["pacing_ms"] > 0]
+    assert stalled, report["links"]
+    assert any(o["op"] == "allreduce"
+               for o in stalled[0]["stalled_ops"])
+
+    # the merged trace (written by the launcher) reaches the same
+    # verdict through the secondary input path
+    merged = tel_dir / "job.trace.json"
+    assert merged.exists(), "launcher did not merge job.trace.json"
+    views = diagnose.rank_views_from_trace(schema.load_trace(merged))
+    merged_report = diagnose.diagnose(views)
+    assert merged_report["summary"]["straggler"] == DELAY_RANK
+
+    # post-mortem/live agreement: a snapshot built from the same rank
+    # file renders the identical last-events tail the exporter serves
+    obj = schema.load_rank_file(files[0])
+    events = [schema.event_from_list(r) for r in obj["events"]][-8:]
+    snap = exporter.build_snapshot(
+        rank=0, world=NPROCS, mode=obj["mode"],
+        metrics=obj["metrics"], link_stats=obj["link_stats"],
+        last_events=events, dropped=obj["dropped"], job=obj["job"],
+    )
+    exporter.validate_snapshot(snap)
+    assert "; ".join(snap["last_events"]) == (
+        schema.format_recent_events(events)
+    )
+
+
+def test_diagnose_cli_json_over_job_dir(tmp_path, capsys):
+    """The console-script path over a real (unfaulted, 2-rank) job:
+    --json must emit a schema-tagged report whose per-step table covers
+    both ranks."""
+    tel_dir = tmp_path / "tel"
+    env = {k: v for k, v in DELAY_ENV.items()
+           if not k.startswith("T4J_FAULT")}
+    proc = run_workers(
+        WORKER, nprocs=2, env=env, timeout=300,
+        launch_args=("--telemetry", str(tel_dir)),
+    )
+    assert proc.stdout.count("WORKER-OK") == 2, proc.stdout
+    assert diagnose.main([str(tel_dir), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["schema"] == diagnose.DIAG_SCHEMA
+    assert report["ranks"] == 2
+    assert report["n_steps"] == STEPS
+    # dump.collect captured the job's tuning: the plane audit judged
+    # served planes against the knobs the job actually ran under
+    assert report["plane_audit"]["ring_min_bytes"] == 0
+    (tmp_path / "base.json").write_text(json.dumps(report))
+    assert diagnose.main(
+        [str(tel_dir), "--diff", str(tmp_path / "base.json")]
+    ) == 0
+    assert "straggler" in capsys.readouterr().out

@@ -51,15 +51,30 @@
 #                request-leak phase asserting the finalize report
 #                (docs/async.md).  ctypes only — runs on old-jax
 #                containers.
+#  10. diagnose — tools/diagnose_smoke.py twice: plain and under
+#                AddressSanitizer.  An 8-rank trace job with step
+#                markers and ONE rank slowed by T4J_FAULT_MODE=delay:
+#                t4j-diagnose --json must finger that rank as the
+#                straggler in >= 9/10 steps with a "wire" attribution,
+#                the per-step overlap ratio must agree with the
+#                harness's ground truth, and every rank's exporter
+#                endpoint must serve a schema-valid snapshot
+#                (docs/observability.md "diagnosing a slow step").
+#                ctypes only — runs on old-jax containers.
+#  11. bench   — bench.py --quick --out BENCH_quick.json: the cheap
+#                trajectory point every PR records.  The record must
+#                appear and be valid JSON even when the flagship or
+#                the native legs cannot run (explicit "skipped" keys).
 #
-# Usage: tools/ci_smoke.sh [lane...]   (default: all nine)
+# Usage: tools/ci_smoke.sh [lane...]   (default: all eleven)
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
-  lanes=(tier1 fault proc asan tsan lint resilience telemetry async)
+  lanes=(tier1 fault proc asan tsan lint resilience telemetry async
+         diagnose bench)
 fi
 
 run_lane() {
@@ -122,8 +137,21 @@ for lane in "${lanes[@]}"; do
       run_lane async-tsan env T4J_SANITIZE=thread timeout -k 10 1800 \
         python tools/async_smoke.py 4
       ;;
+    diagnose)
+      run_lane diagnose-plain env -u T4J_SANITIZE timeout -k 10 900 \
+        python tools/diagnose_smoke.py 8
+      run_lane diagnose-asan env T4J_SANITIZE=address timeout -k 10 900 \
+        python tools/diagnose_smoke.py 8
+      ;;
+    bench)
+      run_lane bench timeout -k 10 2400 \
+        python bench.py --quick --out BENCH_quick.json
+      run_lane bench-record python -c \
+        'import json; rec = json.load(open("BENCH_quick.json")); \
+assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench)" >&2
       exit 2
       ;;
   esac
