@@ -474,6 +474,101 @@ def proc_hier_busbw(timeout=900):
     return hier, flat, ratio
 
 
+def proc_autotune_pair(timeout=900):
+    """Mis-default recovery (docs/performance.md "trace-guided
+    autotuning"): one 8-rank TCP-tier job running
+    ``proc_busbw.py --autotune-pair`` — interleaved allreduce batches
+    under a deliberately mis-defaulted T4J_SEG_BYTES (16K), the
+    autotuner's in-run fit, and the hand-tuned 1M default.  Returns
+    ``(autotuned_record, ratio_record)``; either may be None."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
+    argv = [
+        sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+        str(script), "--autotune-pair", "--mb", "16", "--reps", "5",
+    ]
+    import os as _os
+
+    env = dict(_os.environ)
+    env["T4J_NO_SHM"] = "1"  # T4J_SEG_BYTES governs the ring plane
+    env["T4J_TUNING_CACHE"] = "off"  # measure, don't read a stale fit
+    autotuned = ratio = None
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent), env=env,
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            metric = rec.get("metric", "")
+            if metric == "allreduce_busbw_proc8_seg_autotuned":
+                autotuned = rec
+            elif metric == "autotune_vs_default_proc8":
+                ratio = rec
+        if ratio is None:
+            print(
+                f"[bench] autotune pair produced no ratio record "
+                f"(rc={out.returncode}): {out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] autotune pair failed: {exc}", file=sys.stderr)
+    return autotuned, ratio
+
+
+def proc_halo_latency(timeout=900):
+    """Small-message latency: width-1 2-D halo exchange p50, coalescing
+    on vs off in interleaved pairs (docs/performance.md "small-message
+    coalescing").  Returns ``(on_record, off_record, speedup_record)``;
+    any may be None."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
+    argv = [
+        sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+        str(script), "--op", "halo", "--widths", "1", "--reps", "10",
+        "--halo-base", "32",
+    ]
+    import os as _os
+
+    env = dict(_os.environ)
+    env["T4J_TUNING_CACHE"] = "off"
+    on = off = speedup = None
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent), env=env,
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            metric = rec.get("metric", "")
+            if metric == "halo_p50_ms_proc8_w1":
+                if rec.get("coalesce") == "on":
+                    on = rec
+                else:
+                    off = rec
+            elif metric == "halo_coalesce_speedup_proc8_w1":
+                speedup = rec
+        if speedup is None:
+            print(
+                f"[bench] halo latency produced no speedup record "
+                f"(rc={out.returncode}): {out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] halo latency failed: {exc}", file=sys.stderr)
+    return on, off, speedup
+
+
 def proc_overlap_step(timeout=900):
     """DP train step with bucketed compute/comm overlap on vs off
     (docs/async.md "gradient bucketing"): one 8-rank launcher job
@@ -829,10 +924,14 @@ def run_bench(quick=False):
         _skip("proc_tcp_busbw", "quick mode")
         _skip("proc_hier_busbw", "quick mode")
         _skip("proc_overlap_step", "quick mode")
+        _skip("proc_autotune_pair", "quick mode")
+        _skip("proc_halo_latency", "quick mode")
     elif not native_ok:
         _skip("proc_tcp_busbw", native_reason)
         _skip("proc_hier_busbw", native_reason)
         _skip("proc_overlap_step", native_reason)
+        _skip("proc_autotune_pair", native_reason)
+        _skip("proc_halo_latency", native_reason)
     ring_rec, tree_rec = proc_tcp_busbw() if run_heavy_proc else (None, None)
     if run_heavy_proc and ring_rec is None and tree_rec is None:
         _skip("proc_tcp_busbw", "no record produced")
@@ -875,6 +974,32 @@ def run_bench(quick=False):
         extras["train_step_ms_proc8_overlap_off"] = ov_off["value"]
     if ov_ratio is not None:
         extras["overlap_speedup_proc8"] = ov_ratio["value"]
+    # trace-guided autotuning (this PR's tentpole): mis-defaulted
+    # T4J_SEG_BYTES recovered by the in-run fit, interleaved pairs
+    at_rec, at_ratio = (
+        proc_autotune_pair() if run_heavy_proc else (None, None)
+    )
+    if run_heavy_proc and at_rec is None and at_ratio is None:
+        _skip("proc_autotune_pair", "no record produced")
+    if at_rec is not None:
+        extras["allreduce_busbw_proc8_autotuned_gbps"] = at_rec["value"]
+    if at_ratio is not None:
+        extras["autotune_vs_default_ratio"] = at_ratio["value"]
+        if at_ratio.get("autotuned_vs_hand") is not None:
+            extras["autotune_vs_hand_ratio"] = at_ratio["autotuned_vs_hand"]
+    # small-message coalescing: width-1 halo exchange p50, fused wire
+    # frames on vs off, interleaved pairs
+    halo_on, halo_off, halo_ratio = (
+        proc_halo_latency() if run_heavy_proc else (None, None, None)
+    )
+    if run_heavy_proc and halo_on is None and halo_off is None:
+        _skip("proc_halo_latency", "no record produced")
+    if halo_on is not None:
+        extras["halo_p50_ms_proc8_w1_coalesce_on"] = halo_on["value"]
+    if halo_off is not None:
+        extras["halo_p50_ms_proc8_w1_coalesce_off"] = halo_off["value"]
+    if halo_ratio is not None:
+        extras["halo_coalesce_speedup_proc8"] = halo_ratio["value"]
 
     if quick:
         for leg in ("transformer", "matmul_roofline",

@@ -54,7 +54,7 @@ def main():
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--op", default="allreduce",
                     choices=["allreduce", "allgather", "alltoall",
-                             "reduce_scatter"])
+                             "reduce_scatter", "halo"])
     ap.add_argument(
         "--sweep", action="store_true",
         help="one JSON line per payload size, 1 KB -> --mb in x4 steps: "
@@ -75,6 +75,38 @@ def main():
         "(waitall at the end) vs the same chunks through blocking "
         "allreduces, interleaved same-conditions batches; one JSON "
         "record per arm plus the depth-speedup ratio",
+    )
+    ap.add_argument(
+        "--calibrate", action="store_true",
+        help="run the autotuner's calibration rounds (tree/ring per "
+        "size, segment candidates, hier when the topology allows, "
+        "fused/unfused coalescing pairs) measured via the telemetry "
+        "metrics table, and emit one JSON record per arm x size — the "
+        "per-size records mpi4jax_tpu.tuning.calibrate.fit_records "
+        "consumes — plus the fitted knob vector",
+    )
+    ap.add_argument(
+        "--autotune-pair", action="store_true",
+        help="interleaved same-conditions allreduce at --mb under a "
+        "deliberately mis-defaulted T4J_SEG_BYTES (16K), the "
+        "autotuner's in-run fitted segment, and the hand-tuned default "
+        "(1M): one record per arm plus autotuned-vs-misdefault and "
+        "autotuned-vs-hand ratios (run with T4J_NO_SHM=1 so the ring "
+        "plane, which T4J_SEG_BYTES governs, actually serves)",
+    )
+    ap.add_argument(
+        "--widths", default="1,4,16",
+        help="halo widths for --op halo (comma list)",
+    )
+    ap.add_argument(
+        "--fields", type=int, default=3,
+        help="field count per halo exchange (--op halo); the per-"
+        "direction slabs of all fields ride one fused frame when "
+        "coalescing is on",
+    )
+    ap.add_argument(
+        "--halo-base", type=int, default=64,
+        help="interior cells per side of the local halo block",
     )
     ap.add_argument(
         "--copy-gauntlet", action="store_true",
@@ -105,6 +137,15 @@ def main():
     assert comm.backend == "proc", "run under python -m mpi4jax_tpu.launch"
     n = comm.size
     rank = comm.rank()
+
+    if args.calibrate:
+        return _calibrate_main(args, comm)
+
+    if args.autotune_pair:
+        return _autotune_pair_main(args, comm)
+
+    if args.op == "halo":
+        return _halo_main(args, comm)
 
     if args.pairs:
         return _pairs_main(args, comm)
@@ -186,12 +227,13 @@ def _busbw_factor(op, n):
 
 def _telemetry_registry():
     """Cumulative metrics registry from the native snapshot, or ``None``
-    when T4J_TELEMETRY is off (docs/observability.md)."""
+    when telemetry is off (docs/observability.md).  The LIVE runtime
+    mode is authoritative — benchmark modes flip counters on in-process
+    (runtime.set_telemetry), which the env-derived config cannot see."""
     from mpi4jax_tpu.native import runtime
     from mpi4jax_tpu.telemetry.registry import MetricsRegistry
-    from mpi4jax_tpu.utils import config
 
-    if config.telemetry_mode() == "off":
+    if runtime.telemetry_mode_name() == "off":
         return None
     words = runtime.metrics_snapshot()
     return MetricsRegistry.from_snapshot(words) if words else None
@@ -483,6 +525,258 @@ def _inflight_main(args, comm):
         "chunk_mb": per * 4 / 1e6,
         "data_plane": algo,
     }), flush=True)
+
+
+def _calibrate_main(args, comm):
+    """The autotuner's calibration rounds as a standalone mode: emits
+    one JSON record per arm x size — the records
+    ``mpi4jax_tpu.tuning.calibrate.fit_records`` consumes — plus the
+    fitted knob vector, so a fleet can calibrate once offline and ship
+    the cache (docs/performance.md "trace-guided autotuning")."""
+    from mpi4jax_tpu import tuning
+    from mpi4jax_tpu.ops._proc import proc_topology
+
+    n = comm.size
+    knobs, measurements = tuning.calibrate.autotune(reps=max(args.reps, 3))
+    if comm.rank() != 0:
+        return
+    topo = proc_topology(comm)
+    for rec in measurements:
+        print(json.dumps({
+            "metric": "calibrate",
+            "nprocs": n,
+            "local_world": topo["local_size"],
+            "leader_world": topo["n_hosts"],
+            **rec,
+        }), flush=True)
+    refit = tuning.calibrate.fit_records(measurements)
+    print(json.dumps({
+        "metric": "calibrate_fit",
+        "nprocs": n,
+        "knobs": knobs,
+        "refit_from_records": refit,  # fit_records on the emitted JSON
+        "fingerprint": tuning.topology_fingerprint(topo, n),
+    }), flush=True)
+
+
+def _autotune_pair_main(args, comm):
+    """Mis-default recovery: interleaved same-conditions allreduce
+    batches at --mb under three segment sizes — a deliberately
+    mis-defaulted 16K, the autotuner's in-run fit, and the hand-tuned
+    1M default — so the BENCH trajectory shows the autotuner clawing
+    back what a wrong shipped default costs.  Run with T4J_NO_SHM=1:
+    T4J_SEG_BYTES governs the segmented ring, and on a same-host arena
+    comm the knob never serves."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu import tuning
+    from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.ops._proc import proc_topology
+
+    n = comm.size
+    per = max(int(args.mb * 1e6 / 4), n)
+    per -= per % max(n, 1)
+    x = jnp.ones((per,), jnp.float32)
+    nbytes = per * 4
+    factor = _busbw_factor("allreduce", n)
+    runtime.set_tuning(ring_min_bytes=0)  # the knob under test serves
+
+    # in-run fit: measure the segment candidates once, pick the best
+    # (the same fitter the cache-producing calibration uses)
+    if runtime.telemetry_mode_name() == "off":
+        runtime.set_telemetry(mode="counters")
+    tok = m.create_token()
+    seg_pts = []
+    for seg in tuning.calibrate.SEG_CANDIDATES:
+        runtime.set_tuning(seg_bytes=seg)
+        tok = _fence(comm, tok)
+        t0 = time.perf_counter()
+        for _ in range(max(args.reps // 2, 2)):
+            y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        np.asarray(y)
+        dt = (time.perf_counter() - t0) / max(args.reps // 2, 2)
+        # MAX across ranks so every rank picks the same segment
+        dt_max, tok = m.allreduce(
+            jnp.float32(dt), op=m.MAX, comm=comm, token=tok
+        )
+        seg_pts.append((seg, float(dt_max) * 1e3))
+    fitted = tuning.calibrate.fit_seg(seg_pts)
+
+    arms = {
+        "misdefault": 16 << 10,
+        "autotuned": fitted,
+        "hand": 1 << 20,
+    }
+    best = {a: float("inf") for a in arms}
+    for arm, seg in arms.items():  # warm every arm
+        runtime.set_tuning(seg_bytes=seg)
+        y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        np.asarray(y)
+    for _ in range(3):
+        for arm, seg in arms.items():
+            runtime.set_tuning(seg_bytes=seg)
+            tok = _fence(comm, tok)
+            t0 = time.perf_counter()
+            for _ in range(args.reps):
+                y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+            np.asarray(y)
+            best[arm] = min(
+                best[arm], (time.perf_counter() - t0) / args.reps
+            )
+    if comm.rank() != 0:
+        return
+    topo = proc_topology(comm)
+    vals = {}
+    for arm, seg in arms.items():
+        busbw = nbytes * factor / best[arm]
+        vals[arm] = busbw
+        print(json.dumps({
+            "metric": f"allreduce_busbw_proc{n}_seg_{arm}",
+            "value": round(busbw / 1e9, 3),
+            "unit": "GB/s",
+            "nprocs": n,
+            "payload_mb": nbytes / 1e6,
+            "sec_per_call": round(best[arm], 6),
+            "seg_bytes": seg,
+            "data_plane": "ring",
+            "local_world": topo["local_size"],
+            "leader_world": topo["n_hosts"],
+            "interleaved_pairs": True,
+        }), flush=True)
+    print(json.dumps({
+        "metric": f"autotune_vs_default_proc{n}",
+        "value": round(vals["autotuned"] / vals["misdefault"], 3),
+        "unit": "x",
+        "nprocs": n,
+        "autotuned_seg_bytes": fitted,
+        "misdefault_seg_bytes": 16 << 10,
+        "autotuned_vs_hand": round(vals["autotuned"] / vals["hand"], 3),
+    }), flush=True)
+
+
+def _halo_main(args, comm):
+    """Small-message latency microbench: p50/p99 of a full 2-D halo
+    exchange (``--fields`` fields, all four directions) at each
+    ``--widths`` width, coalescing on vs off in interleaved pairs.
+    The per-op evidence (p2p op count + mean over each timed window,
+    sendrecv/send/recv kinds merged) comes from the counters-mode
+    telemetry snapshot delta, so the records show the op-count
+    collapse (2*4*fields one-sided ops -> 4 fused exchanges) alongside
+    the wall latency."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu import tuning
+    from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.ops._proc import proc_topology
+    from mpi4jax_tpu.parallel import grid_comm
+    from mpi4jax_tpu.parallel.halo import halo_exchange_2d_batch
+
+    n = comm.size
+    rank = comm.rank()
+    ny = 1
+    for cand in range(int(n ** 0.5), 0, -1):
+        if n % cand == 0:
+            ny = cand
+            break
+    grid = grid_comm((ny, n // ny))
+    if runtime.telemetry_mode_name() == "off":
+        runtime.set_telemetry(mode="counters")
+    topo = proc_topology(comm)
+    widths = [int(w) for w in str(args.widths).split(",") if w.strip()]
+    reps = max(args.reps, 10)
+    rng = np.random.default_rng(11 + 3 * rank)
+
+    for w in widths:
+        side = args.halo_base + 2 * w
+        fields = [
+            jnp.asarray(rng.standard_normal((side, side), np.float64)
+                        .astype(np.float32))
+            for _ in range(args.fields)
+        ]
+        slab_bytes = 4 * args.fields * w * side  # one direction's frame
+
+        def exchange():
+            outs, _tok = halo_exchange_2d_batch(
+                fields, grid, periodic=(True, True), width=w
+            )
+            np.asarray(outs[-1])  # materialise: the exchange is done
+
+        times = {"off": [], "on": []}
+        telw = {"off": None, "on": None}
+        for mode, threshold in (("off", 0), ("on", 1 << 30)):
+            tuning.override_coalesce(threshold)
+            exchange()  # warm (compile + channel negotiation)
+        tok = m.create_token()
+        for _round in range(3):
+            for mode, threshold in (("off", 0), ("on", 1 << 30)):
+                tuning.override_coalesce(threshold)
+                tok = _fence(comm, tok)
+                before = _telemetry_registry()
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    exchange()
+                    times[mode].append(time.perf_counter() - t0)
+                after = _telemetry_registry()
+                if after is not None:
+                    window = (after.diff(before) if before is not None
+                              else after)
+                    # the fused path records kSendrecv (kSend/kRecv on
+                    # one-sided edges); the unfused loop records kSend
+                    # + kRecv per part — merge all three kinds so BOTH
+                    # arms produce the op-count evidence
+                    count, total_ms = 0, 0.0
+                    for opname in ("sendrecv", "send", "recv"):
+                        row = window.aggregate(op=opname)
+                        if row is not None and row.count:
+                            s = row.stats()
+                            count += s["count"]
+                            if s["mean_ms"]:
+                                total_ms += s["mean_ms"] * s["count"]
+                    telw[mode] = (count, total_ms)
+        tuning.override_coalesce(None)
+        if rank != 0:
+            continue
+        p = {}
+        for mode, ts in times.items():
+            ts = sorted(ts)
+            p[mode] = {
+                "p50": ts[len(ts) // 2] * 1e3,
+                "p99": ts[min(len(ts) - 1, int(len(ts) * 0.99))] * 1e3,
+            }
+            rec = {
+                "metric": f"halo_p50_ms_proc{n}_w{w}",
+                "value": round(p[mode]["p50"], 4),
+                "unit": "ms",
+                "coalesce": mode,
+                "p99_ms": round(p[mode]["p99"], 4),
+                "nprocs": n,
+                "grid": [ny, n // ny],
+                "width": w,
+                "fields": args.fields,
+                "direction_frame_bytes": slab_bytes,
+                "local_world": topo["local_size"],
+                "leader_world": topo["n_hosts"],
+                "coalesce_bytes": 0 if mode == "off" else 1 << 30,
+                "interleaved_pairs": True,
+            }
+            if telw[mode] is not None and telw[mode][0]:
+                count, total_ms = telw[mode]
+                rec["p2p_ops_per_window"] = count
+                rec["p2p_op_mean_ms"] = round(total_ms / count, 4)
+            print(json.dumps(rec), flush=True)
+        print(json.dumps({
+            "metric": f"halo_coalesce_speedup_proc{n}_w{w}",
+            "value": round(p["off"]["p50"] / p["on"]["p50"], 3),
+            "unit": "x",
+            "nprocs": n,
+            "width": w,
+            "fields": args.fields,
+            "p99_speedup": round(p["off"]["p99"] / p["on"]["p99"], 3),
+        }), flush=True)
 
 
 def _gauntlet_rate_gbps(comm, tok, mb=16, reps=4):

@@ -47,6 +47,7 @@ from mpi4jax_tpu.ops import (
     allgather,
     allreduce,
     alltoall,
+    alltoall_multi,
     annotate_step,
     as_token,
     barrier,
@@ -67,6 +68,7 @@ from mpi4jax_tpu.ops import (
     scatter,
     send,
     sendrecv,
+    sendrecv_multi,
     step_scope,
     test,
     token_array,
@@ -156,6 +158,7 @@ __all__ = [
     "allgather",
     "allreduce",
     "alltoall",
+    "alltoall_multi",
     "annotate_step",
     "assert_requests_drained",
     "as_token",
@@ -180,6 +183,7 @@ __all__ = [
     "scatter",
     "send",
     "sendrecv",
+    "sendrecv_multi",
     "set_default_comm",
     "step_scope",
     "test",

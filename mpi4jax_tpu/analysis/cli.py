@@ -87,7 +87,21 @@ def main(argv=None):
         "-q", "--quiet", action="store_true",
         help="only print findings and the final summary",
     )
+    parser.add_argument(
+        "--coalesce", action="store_true",
+        help="advisory: report runs of small same-peer messages in each "
+        "entry's recorded schedule that the fused wire path would "
+        "collapse into one frame (docs/performance.md \"small-message "
+        "coalescing\")",
+    )
+    parser.add_argument(
+        "--coalesce-bytes", type=int, default=None, metavar="BYTES",
+        help="threshold for --coalesce (default: the effective "
+        "T4J_COALESCE_BYTES); implies --coalesce",
+    )
     args = parser.parse_args(argv)
+    if args.coalesce_bytes is not None:
+        args.coalesce = True
 
     _ensure_devices()
     from mpi4jax_tpu.analysis.verify import verify_comm
@@ -123,6 +137,20 @@ def main(argv=None):
                 continue
             for note in report.notes:
                 print(f"{path}::{name}: note: {note}")
+            if args.coalesce:
+                # feed the recorded schedule forward into the
+                # coalescing planner (the run-time ops apply the same
+                # T4J_COALESCE_BYTES gate; this makes the plan visible)
+                from mpi4jax_tpu import tuning
+
+                threshold = (
+                    tuning.coalesce_bytes()
+                    if args.coalesce_bytes is None
+                    else args.coalesce_bytes
+                )
+                runs = tuning.coalesce.find_runs(report.events, threshold)
+                print(f"{path}::{name}: "
+                      + tuning.coalesce.render_plan(runs, threshold))
             if report.ok:
                 if not args.quiet:
                     print(f"{path}::{name}: {report}")

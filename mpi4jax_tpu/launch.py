@@ -206,6 +206,16 @@ def main(argv=None):
         "serves the aggregated job view — worst-link and straggler "
         "gauges — on PORT+nprocs",
     )
+    parser.add_argument(
+        "--autotune",
+        action="store_true",
+        help="calibrate the data-plane knob vector at init "
+        "(docs/performance.md \"trace-guided autotuning\"): every rank "
+        "runs a few collective timing rounds, the fit is persisted in "
+        "the topology-fingerprinted tuning cache (T4J_TUNING_CACHE) "
+        "and applied to this job; later jobs on the same fabric load "
+        "it automatically.  Explicit T4J_* knob env vars still win.",
+    )
     parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("prog", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -359,6 +369,8 @@ def _run_job(args):
             # trace unless the caller already chose a mode (counters
             # keeps the overhead at metrics-only for perf runs)
             env.setdefault("T4J_TELEMETRY", "trace")
+        if args.autotune:
+            env["T4J_AUTOTUNE"] = "1"
         if args.metrics is not None:
             env["T4J_METRICS_PORT"] = str(args.metrics)
             # the exporter serves the metrics table + link stats —

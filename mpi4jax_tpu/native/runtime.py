@@ -26,6 +26,8 @@ __all__ = [
     "last_error",
     "set_timeouts",
     "set_tuning",
+    "set_coalesce",
+    "coalesce_bytes",
     "set_hier",
     "set_resilience",
     "set_telemetry",
@@ -69,6 +71,8 @@ HANDLER_NAMES = [
     "t4j_send",
     "t4j_recv",
     "t4j_sendrecv",
+    "t4j_sendrecv_fused",
+    "t4j_alltoall_fused",
     "t4j_barrier",
     "t4j_bcast",
     "t4j_allgather",
@@ -116,6 +120,8 @@ def _load():
     lib.t4j_fault_msg.restype = ctypes.c_char_p
     lib.t4j_set_timeouts.argtypes = [ctypes.c_double, ctypes.c_double]
     lib.t4j_set_tuning.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.t4j_set_coalesce.argtypes = [ctypes.c_int64]
+    lib.t4j_coalesce_bytes.restype = ctypes.c_int64
     lib.t4j_set_hier.argtypes = [ctypes.c_int32, ctypes.c_int64]
     lib.t4j_set_resilience.argtypes = [
         ctypes.c_int32, ctypes.c_double, ctypes.c_double, ctypes.c_int64,
@@ -163,6 +169,15 @@ def _load():
     lib.t4j_c_recv.argtypes = [i32, vp, u64, i32, i32, i32p, i32p]
     lib.t4j_c_sendrecv.argtypes = [i32, vp, u64, vp, u64, i32, i32, i32,
                                    i32, i32p, i32p]
+    # fused multi-part p2p (small-message coalescing): pointer-array
+    # iovec surface, sizes as u64[]
+    vpp = ctypes.POINTER(ctypes.c_void_p)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.t4j_c_sendrecv_fused.argtypes = [
+        i32, vpp, u64p, i32, vpp, u64p, i32, i32, i32, i32, i32, i32p,
+        i32p,
+    ]
+    lib.t4j_c_alltoall_fused.argtypes = [i32, vpp, vpp, u64p, i32]
     lib.t4j_c_barrier.argtypes = [i32]
     lib.t4j_c_bcast.argtypes = [i32, vp, u64, i32]
     lib.t4j_c_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
@@ -201,7 +216,8 @@ def _load():
         "t4j_c_bcast", "t4j_c_allreduce", "t4j_c_hier_allreduce",
         "t4j_c_reduce", "t4j_c_scan",
         "t4j_c_reduce_scatter", "t4j_c_allgather", "t4j_c_gather",
-        "t4j_c_scatter", "t4j_c_alltoall",
+        "t4j_c_scatter", "t4j_c_alltoall", "t4j_c_sendrecv_fused",
+        "t4j_c_alltoall_fused",
     ):
         getattr(lib, name).restype = ctypes.c_int32
     _state["lib"] = lib
@@ -516,6 +532,25 @@ def set_tuning(ring_min_bytes=None, seg_bytes=None):
     )
 
 
+def set_coalesce(bytes_threshold=None):
+    """Runtime override of the small-message coalescing threshold
+    (docs/performance.md "small-message coalescing"), in bytes.
+
+    ``None`` keeps the current value; 0 disables fusion entirely (the
+    exact pre-coalescing wire behaviour).  Must be uniform across
+    ranks: both sides of a fused exchange must agree to fuse."""
+    lib = _load()
+    lib.t4j_set_coalesce(
+        -1 if bytes_threshold is None else int(bytes_threshold)
+    )
+
+
+def coalesce_bytes():
+    """The native layer's effective coalescing threshold in bytes."""
+    lib = _load()
+    return int(lib.t4j_coalesce_bytes())
+
+
 _HIER_MODES = {"auto": 0, "on": 1, "off": 2}
 
 
@@ -765,6 +800,60 @@ def host_recv(handle, shape, dtype, source, tag):
     return out, np.int32(src.value), np.int32(tg.value)
 
 
+def _ptr_array(arrays):
+    arr = (ctypes.c_void_p * max(len(arrays), 1))()
+    for i, a in enumerate(arrays):
+        arr[i] = a.ctypes.data
+    return arr
+
+
+def _u64_array(sizes):
+    return (ctypes.c_uint64 * max(len(sizes), 1))(*sizes)
+
+
+def host_sendrecv_fused(handle, send_arrays, recv_templates, source, dest,
+                        sendtag, recvtag):
+    """Fused multi-part sendrecv (docs/performance.md "small-message
+    coalescing"): every part in ``send_arrays`` travels in ONE wire
+    frame to ``dest``, and one frame from ``source`` is scattered into
+    arrays shaped like ``recv_templates`` (anything with ``.shape`` /
+    ``.dtype`` — ShapeDtypeStructs included, so callers need not
+    materialise template arrays).  Empty ``send_arrays`` /
+    ``recv_templates`` select the one-sided halves.  Returns
+    ``(outs, src, tag)``."""
+    import numpy as np
+
+    sends = [_contig(a) for a in send_arrays]
+    outs = [np.empty(tuple(t.shape), t.dtype) for t in recv_templates]
+    src = ctypes.c_int32(-1)
+    tg = ctypes.c_int32(-1)
+    _check(_state["lib"].t4j_c_sendrecv_fused(
+        handle, _ptr_array(sends),
+        _u64_array([a.nbytes for a in sends]), len(sends),
+        _ptr_array(outs), _u64_array([o.nbytes for o in outs]),
+        len(outs), source, dest, sendtag, recvtag,
+        ctypes.byref(src), ctypes.byref(tg),
+    ))
+    return outs, np.int32(src.value), np.int32(tg.value)
+
+
+def host_alltoall_fused(handle, parts):
+    """Fused multi-part alltoall: part i has shape ``(comm_size,
+    *rest_i)``; each peer receives ONE frame carrying its slice of
+    every part (bit-identical to per-part ``host_alltoall``).  Returns
+    the output parts."""
+    import numpy as np
+
+    parts = [_contig(p) for p in parts]
+    outs = [np.empty_like(p) for p in parts]
+    n = _state["lib"].t4j_comm_size(handle)
+    _check(_state["lib"].t4j_c_alltoall_fused(
+        handle, _ptr_array(parts), _ptr_array(outs),
+        _u64_array([p.nbytes // n for p in parts]), len(parts),
+    ))
+    return outs
+
+
 def host_sendrecv(handle, sendbuf, recvbuf, source, dest, sendtag, recvtag):
     import numpy as np
 
@@ -971,6 +1060,8 @@ def ensure_initialized():
 
     op_s, connect_s = config.op_timeout(), config.connect_timeout()
     ring_min, seg = config.ring_min_bytes(), config.seg_bytes()
+    coalesce = config.coalesce_bytes()
+    config.autotune_enabled()  # loud validation; the flag acts post-init
     hier, hier_min = config.hier_mode(), config.leader_ring_min_bytes()
     retry = config.retry_max()
     boff_base, boff_max = config.backoff_base(), config.backoff_max()
@@ -980,6 +1071,7 @@ def ensure_initialized():
     lib = _load()
     lib.t4j_set_timeouts(op_s, connect_s)
     lib.t4j_set_tuning(ring_min, seg)
+    lib.t4j_set_coalesce(coalesce)
     lib.t4j_set_hier(_HIER_MODES[hier], hier_min)
     lib.t4j_set_resilience(retry, boff_base, boff_max, replay)
     lib.t4j_set_telemetry(_TEL_MODES[tel_mode], tel_bytes)
@@ -992,6 +1084,28 @@ def ensure_initialized():
             else "native bridge init failed (check T4J_* env)"
         )
     _register_ffi_targets(lib)
+    # trace-guided tuning (docs/performance.md "trace-guided
+    # autotuning"): load the fingerprint-keyed cache and thread it
+    # through the same set_tuning/set_hier/set_coalesce plumbing;
+    # explicit T4J_* env always wins, rank 0's resolution is broadcast
+    # so divergent per-host cache files can never split the knob
+    # vector.  T4J_AUTOTUNE calibrates first (collective) and writes
+    # the cache.  A corrupt/stale cache degrades to env/defaults with
+    # a warning rather than killing the job.
+    try:
+        from mpi4jax_tpu import tuning
+
+        tuning.startup(progress=lambda m: print(m, flush=True))
+    except BridgeError:
+        raise  # a wedged collective during autotune is a real failure
+    except Exception as e:  # noqa: BLE001 — cache trouble must not kill
+        import sys as _sys
+
+        print(
+            f"t4j: tuning cache ignored: {type(e).__name__}: {e}",
+            file=_sys.stderr,
+            flush=True,
+        )
     if tel_dir is not None:
         # registered BEFORE finalize: atexit runs LIFO, so the drain
         # happens after teardown and carries the exit-phase events too

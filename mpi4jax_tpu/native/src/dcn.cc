@@ -171,6 +171,31 @@ long long seg_bytes() {
   return v;
 }
 
+// ------------------------------------------------ coalescing tuning
+//
+// Small-message coalescing threshold (docs/performance.md
+// "small-message coalescing"): the Python op layer fuses runs of
+// small same-peer messages into one wire frame when their combined
+// payload is at or below this many bytes.  The knob is mirrored here
+// so standalone ctypes harnesses and introspection read the same
+// effective value; 0 disables fusion entirely (exact pre-coalescing
+// wire behaviour).  -1 = "not set yet"; Python validates via
+// utils/config.py and calls set_coalesce, the env parse is the
+// fallback for hand-run processes.
+
+std::atomic<long long> g_coalesce_bytes{-1};
+
+constexpr long long kDefaultCoalesceBytes = 16 << 10;  // 16 KiB
+
+long long coalesce_bytes() {
+  long long v = g_coalesce_bytes.load(std::memory_order_relaxed);
+  if (v < 0) {
+    v = env_bytes("T4J_COALESCE_BYTES", kDefaultCoalesceBytes);
+    g_coalesce_bytes.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
 // ---------------------------------------------- hierarchical tuning
 //
 // Selection knobs for the two-tier (shm leaf + leader ring) path
@@ -2596,6 +2621,93 @@ Frame crecv(Comm& c, int src_idx, int tag, bool coll = true) {
           ") — ranks disagree on shapes or dtypes");
 }
 
+// ---------------------------------------- fused multi-part frames
+//
+// Small-message coalescing (docs/performance.md "small-message
+// coalescing"): a run of small messages for one peer travels as ONE
+// wire frame whose payload is a fused sub-header (magic, part count,
+// per-part sizes) followed by the concatenated part payloads.  The
+// frame goes through the ordinary csend/crecv path, so sequencing,
+// the replay ring, shm pipes, deadlines and telemetry need no new
+// code.  The receiver validates the sub-header against its own part
+// list — a mismatch means the ranks disagree on the fusion plan
+// (divergent T4J_COALESCE_BYTES or program), which is attributable
+// and abort-broadcast-worthy like any size mismatch.
+
+constexpr uint32_t kFusedMagic = 0x7446f001;
+
+struct FusedHead {
+  uint32_t magic;
+  uint32_t nparts;
+};
+static_assert(sizeof(FusedHead) == 8, "fused sub-header layout");
+
+size_t fused_payload_size(const size_t* nbytes, int n) {
+  size_t total = sizeof(FusedHead) +
+                 static_cast<size_t>(n) * sizeof(uint64_t);
+  for (int i = 0; i < n; ++i) total += nbytes[i];
+  return total;
+}
+
+// Gather `n` parts into one fused frame payload.
+Buf build_fused(const void* const* parts, const size_t* nbytes, int n) {
+  Buf b(fused_payload_size(nbytes, n));
+  auto* head = reinterpret_cast<FusedHead*>(b.data());
+  head->magic = kFusedMagic;
+  head->nparts = static_cast<uint32_t>(n);
+  auto* sizes = reinterpret_cast<uint64_t*>(b.data() + sizeof(FusedHead));
+  uint8_t* payload =
+      b.data() + sizeof(FusedHead) +
+      static_cast<size_t>(n) * sizeof(uint64_t);
+  for (int i = 0; i < n; ++i) {
+    sizes[i] = nbytes[i];
+    if (nbytes[i]) std::memcpy(payload, parts[i], nbytes[i]);
+    payload += nbytes[i];
+  }
+  return b;
+}
+
+// Scatter a matched fused frame into `n` caller part buffers,
+// validating the sub-header first.
+void scatter_fused(const Frame& f, void* const* parts,
+                   const size_t* nbytes, int n) {
+  auto bad = [&](const std::string& why) {
+    fail_op("fused frame from world rank r" + std::to_string(f.src) +
+            " (tag " + std::to_string(f.tag) + "): " + why +
+            " — ranks disagree on the coalescing plan (divergent "
+            "T4J_COALESCE_BYTES or shapes)");
+  };
+  if (f.data.size() < sizeof(FusedHead)) {
+    bad("matched a " + std::to_string(f.data.size()) +
+        "-byte message, too short for a fused sub-header");
+  }
+  const auto* head = reinterpret_cast<const FusedHead*>(f.data.data());
+  if (head->magic != kFusedMagic)
+    bad("matched a non-fused message where a fused frame was expected");
+  if (head->nparts != static_cast<uint32_t>(n))
+    bad("carries " + std::to_string(head->nparts) +
+        " part(s), receiver expected " + std::to_string(n));
+  if (f.data.size() != fused_payload_size(nbytes, n))
+    bad("total payload is " + std::to_string(f.data.size()) +
+        " bytes, receiver expected " +
+        std::to_string(fused_payload_size(nbytes, n)));
+  const auto* sizes =
+      reinterpret_cast<const uint64_t*>(f.data.data() + sizeof(FusedHead));
+  for (int i = 0; i < n; ++i) {
+    if (sizes[i] != static_cast<uint64_t>(nbytes[i]))
+      bad("part " + std::to_string(i) + " is " +
+          std::to_string(sizes[i]) + " bytes, receiver expected " +
+          std::to_string(nbytes[i]));
+  }
+  const uint8_t* payload =
+      f.data.data() + sizeof(FusedHead) +
+      static_cast<size_t>(n) * sizeof(uint64_t);
+  for (int i = 0; i < n; ++i) {
+    if (nbytes[i]) std::memcpy(parts[i], payload, nbytes[i]);
+    payload += nbytes[i];
+  }
+}
+
 // ------------------------------------------------------------ ring engine
 //
 // Bandwidth-optimal segmented ring collectives for the TCP tier.  The
@@ -3004,6 +3116,11 @@ void multi_send(Comm& c, int tag, std::vector<RootSend>& msgs) {
 // abort broadcast apply — a dead non-leader local rank surfaces on
 // every survivor as a contextual BridgeError (its sockets close; shm
 // waiters observe the posted fault via detail::stopped()).
+
+// Fused-alltoall channel (small-message coalescing): distinct from the
+// plain alltoall tag so a fused and an unfused alltoall on one comm can
+// never cross-match.
+constexpr int kTagA2AFused = kCollTagBase + 19;
 
 constexpr int kHierTagOk = kCollTagBase + 16;
 constexpr int kHierTagVerdict = kCollTagBase + 17;
@@ -3972,6 +4089,16 @@ void set_tuning(long long ring_min, long long seg) {
   if (seg >= 1) g_seg_bytes.store(seg, std::memory_order_relaxed);
 }
 
+void set_coalesce(long long bytes) {
+  // bytes: < 0 keeps, 0 disables fusion, > 0 sets the combined-payload
+  // threshold.  Must be uniform across ranks, like set_tuning: the two
+  // sides of a fused exchange must agree on the part list.
+  if (bytes >= 0)
+    g_coalesce_bytes.store(bytes, std::memory_order_relaxed);
+}
+
+long long coalesce_threshold() { return coalesce_bytes(); }
+
 void set_hier(int mode, long long min_bytes) {
   // mode: 0 auto, 1 on, 2 off (anything else keeps); min_bytes < 0
   // keeps.  Must be uniform across ranks, like set_tuning.
@@ -4699,6 +4826,115 @@ void sendrecv(int comm, const void* sendbuf, size_t send_nbytes,
       if (c.ranks[i] == f.src) *src_out = static_cast<int>(i);
   }
   if (tag_out) *tag_out = f.tag;
+}
+
+void sendrecv_fused(int comm, const void* const* send_parts,
+                    const size_t* send_nbytes, int n_send,
+                    void* const* recv_parts, const size_t* recv_nbytes,
+                    int n_recv, int source, int dest, int sendtag,
+                    int recvtag, int* src_out, int* tag_out) {
+  if (async_route()) {
+    run_on_engine(comm, [&] {
+      sendrecv_fused(comm, send_parts, send_nbytes, n_send, recv_parts,
+                     recv_nbytes, n_recv, source, dest, sendtag, recvtag,
+                     src_out, tag_out);
+    });
+    return;
+  }
+  Comm& c = get_comm(comm);
+  LogScope log("MPI_Sendrecv_fused",
+               "<- " + std::to_string(source) + " (" +
+                   std::to_string(n_recv) + " parts, tag " +
+                   std::to_string(recvtag) + ") / -> " +
+                   std::to_string(dest) + " (" + std::to_string(n_send) +
+                   " parts, tag " + std::to_string(sendtag) + ")");
+  int n = static_cast<int>(c.ranks.size());
+  if (n_send < 0 || n_recv < 0 || (n_send == 0 && n_recv == 0))
+    fail_arg("fused sendrecv needs at least one send or recv part");
+  if (n_send > 0 && (dest < 0 || dest >= n))
+    fail_arg("destination rank " + std::to_string(dest) +
+             " out of range for a " + std::to_string(n) +
+             "-member communicator");
+  if (n_recv > 0 && source != kAnySource && (source < 0 || source >= n))
+    fail_arg("source rank " + std::to_string(source) +
+             " out of range for a " + std::to_string(n) +
+             "-member communicator");
+  size_t total = 0;
+  for (int i = 0; i < n_send; ++i) total += send_nbytes[i];
+  for (int i = 0; i < n_recv; ++i) total += recv_nbytes[i];
+  tel::OpScope ts(
+      n_send == 0 ? tel::kRecv : (n_recv == 0 ? tel::kSend : tel::kSendrecv),
+      comm, total,
+      n_send > 0 ? c.ranks[dest]
+                 : (source == kAnySource ? -1 : c.ranks[source]));
+  // eager send-first order, exactly like sendrecv (a fused send cannot
+  // block the matching fused receive)
+  if (n_send > 0) {
+    Buf payload = build_fused(send_parts, send_nbytes, n_send);
+    csend(c, dest, sendtag, payload.data(), payload.size(),
+          /*coll=*/false);
+  }
+  if (n_recv > 0) {
+    Frame f = crecv(c, source, recvtag, /*coll=*/false);
+    scatter_fused(f, recv_parts, recv_nbytes, n_recv);
+    if (src_out) {
+      *src_out = 0;
+      for (size_t i = 0; i < c.ranks.size(); ++i)
+        if (c.ranks[i] == f.src) *src_out = static_cast<int>(i);
+    }
+    if (tag_out) *tag_out = f.tag;
+  }
+}
+
+void alltoall_fused(int comm, const void* const* parts, void* const* outs,
+                    const size_t* nbytes_each, int nparts) {
+  if (async_route()) {
+    run_on_engine(comm, [&] {
+      alltoall_fused(comm, parts, outs, nbytes_each, nparts);
+    });
+    return;
+  }
+  Comm& c = get_comm(comm);
+  LogScope log("MPI_Alltoall_fused",
+               std::to_string(nparts) + " parts per peer");
+  if (nparts < 0) fail_arg("negative part count");
+  if (nparts == 0) return;
+  int n = static_cast<int>(c.ranks.size());
+  int me = c.my_index;
+  size_t per_peer = 0;
+  for (int i = 0; i < nparts; ++i) per_peer += nbytes_each[i];
+  tel::OpScope ts(tel::kAlltoall, comm,
+                  per_peer * static_cast<size_t>(n));
+  if (shm::Arena* a = comm_arena(c)) {
+    // same-host arena: no wire frames exist to fuse — run the parts
+    // through the arena individually (bit-identical by construction)
+    ts.plane = tel::kPlaneShm;
+    for (int i = 0; i < nparts; ++i)
+      shm::alltoall(a, parts[i], outs[i], nbytes_each[i]);
+    return;
+  }
+  ts.plane = tel::kPlaneTree;
+  for (int i = 0; i < nparts; ++i) {
+    std::memcpy(static_cast<uint8_t*>(outs[i]) + nbytes_each[i] * me,
+                static_cast<const uint8_t*>(parts[i]) + nbytes_each[i] * me,
+                nbytes_each[i]);
+  }
+  // staggered pairwise exchange (same schedule as alltoall), one fused
+  // frame per peer instead of nparts frames
+  std::vector<const void*> sp(nparts);
+  std::vector<void*> rp(nparts);
+  for (int off = 1; off < n; ++off) {
+    int to = (me + off) % n;
+    int from = ((me - off) % n + n) % n;
+    for (int i = 0; i < nparts; ++i)
+      sp[i] = static_cast<const uint8_t*>(parts[i]) + nbytes_each[i] * to;
+    Buf payload = build_fused(sp.data(), nbytes_each, nparts);
+    csend(c, to, kTagA2AFused, payload.data(), payload.size());
+    Frame f = crecv(c, from, kTagA2AFused);
+    for (int i = 0; i < nparts; ++i)
+      rp[i] = static_cast<uint8_t*>(outs[i]) + nbytes_each[i] * from;
+    scatter_fused(f, rp.data(), nbytes_each, nparts);
+  }
 }
 
 void barrier(int comm) {

@@ -298,6 +298,53 @@ void scatter(int comm, const void* in, void* out, size_t nbytes_each,
              int root);
 void alltoall(int comm, const void* in, void* out, size_t nbytes_each);
 
+// -- small-message coalescing (docs/performance.md "small-message
+// coalescing") -------------------------------------------------------------
+// Fused multi-part p2p: every part of a fused call travels in ONE wire
+// frame — a single WireHeader followed by a fused sub-header (magic,
+// part count, per-part sizes) and the concatenated payloads — instead
+// of one frame (header + syscall + telemetry event) per part.  The
+// frame rides the normal p2p channel, so the replay ring, the shm
+// pipes, per-op deadlines and telemetry all apply unchanged; both
+// sides must agree on the part list (sizes are validated against the
+// sub-header, a mismatch is an attributable fail_op).  The FUSION
+// DECISION lives in the Python op layer, gated by T4J_COALESCE_BYTES
+// (mpi4jax_tpu/tuning/ calibrates it); the knob is mirrored here so
+// standalone harnesses and introspection see the effective value.
+//   T4J_COALESCE_BYTES  fuse runs of small same-peer messages whose
+//                       combined payload is at or below this many
+//                       bytes (default 16 KiB; 0 disables fusion —
+//                       the exact pre-coalescing wire behaviour).
+
+// bytes < 0 keeps the current value; 0 disables; > 0 sets.  Like the
+// other data-plane knobs it must be uniform across ranks (both sides
+// of a fused exchange must agree to fuse).
+void set_coalesce(long long bytes);
+long long coalesce_threshold();
+
+// Fused sendrecv: gather-send `n_send` parts as one frame to `dest`,
+// then scatter-recv `n_recv` parts from one frame from `source`
+// (eager send-first order, like sendrecv).  n_send == 0 makes it a
+// pure scatter-recv, n_recv == 0 a pure gather-send — the one-sided
+// halves a non-periodic halo edge rank needs.  src_out/tag_out carry
+// the matched envelope when n_recv > 0 (null ok).
+void sendrecv_fused(int comm, const void* const* send_parts,
+                    const size_t* send_nbytes, int n_send,
+                    void* const* recv_parts, const size_t* recv_nbytes,
+                    int n_recv, int source, int dest, int sendtag,
+                    int recvtag, int* src_out, int* tag_out);
+
+// Fused alltoall over `nparts` independent block arrays: part i holds
+// comm_size blocks of nbytes_each[i]; outs[i] receives block `rank`
+// of every member's part i.  Equivalent to nparts separate alltoall
+// calls (bit-identical outputs), but each peer receives ONE fused
+// frame carrying its slice of every part instead of nparts frames —
+// the MoE per-expert dispatch path (parallel/moe.py).  Same-host
+// arena communicators run the parts through the arena individually
+// (no wire frames to fuse there).
+void alltoall_fused(int comm, const void* const* parts, void* const* outs,
+                    const size_t* nbytes_each, int nparts);
+
 // -- async progress engine (docs/async.md) --------------------------------
 // Nonblocking ops: submit returns a request id (> 0) immediately; the
 // progress thread executes the wire phase.  Contract (MPI_I* model):

@@ -23,6 +23,7 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <vector>
 
 #include "dcn.h"
 #include "telemetry.h"
@@ -283,6 +284,94 @@ ffi::Error AlltoallImpl(ffi::AnyBuffer x, ffi::AnyBuffer stamp,
   });
 }
 
+// ---- fused multi-part p2p (small-message coalescing) --------------------
+//
+// Variadic handlers: the operand list is [send_0 .. send_{n_send-1},
+// stamp] and the result list [recv_0 .. recv_{n_recv-1}, stamp_out,
+// status], decoded through RemainingArgs/RemainingRets so one handler
+// serves every part count — a true iovec gather/scatter, no Python-
+// side packing copies.  n_send travels as an attribute; n_recv is
+// implied by the result arity.
+
+ffi::Error SendrecvFusedImpl(ffi::RemainingArgs args,
+                             ffi::RemainingRets rets, int32_t comm,
+                             int32_t source, int32_t dest, int32_t sendtag,
+                             int32_t recvtag, int32_t n_send) {
+  return guarded([&] {
+    if (args.size() < 1 || rets.size() < 2 ||
+        static_cast<size_t>(n_send) + 1 != args.size())
+      throw t4j::BridgeError("fused sendrecv: malformed call arity");
+    int n_recv = static_cast<int>(rets.size()) - 2;
+    std::vector<const void*> sp(n_send);
+    std::vector<size_t> sb(n_send);
+    for (int i = 0; i < n_send; ++i) {
+      auto b = args.get<ffi::AnyBuffer>(i);
+      if (!b.has_value())
+        throw t4j::BridgeError("fused sendrecv: bad send operand");
+      sp[i] = b->untyped_data();
+      sb[i] = b->size_bytes();
+    }
+    std::vector<void*> rp(n_recv);
+    std::vector<size_t> rb(n_recv);
+    for (int i = 0; i < n_recv; ++i) {
+      auto r = rets.get<ffi::AnyBuffer>(i);
+      if (!r.has_value())
+        throw t4j::BridgeError("fused sendrecv: bad recv result");
+      rp[i] = (*r)->untyped_data();
+      rb[i] = (*r)->size_bytes();
+    }
+    int src = -1, tag = -1;
+    t4j::sendrecv_fused(comm, sp.data(), sb.data(), n_send, rp.data(),
+                        rb.data(), n_recv, source, dest, sendtag, recvtag,
+                        &src, &tag);
+    auto status = rets.get<ffi::AnyBuffer>(rets.size() - 1);
+    if (status.has_value()) {
+      auto* st = static_cast<int32_t*>((*status)->untyped_data());
+      st[0] = src;
+      st[1] = tag;
+    }
+    auto stamp = args.get<ffi::AnyBuffer>(args.size() - 1);
+    auto stamp_out = rets.get<ffi::AnyBuffer>(rets.size() - 2);
+    if (stamp.has_value() && stamp_out.has_value() &&
+        (*stamp_out)->size_bytes() && stamp->size_bytes())
+      std::memcpy((*stamp_out)->untyped_data(), stamp->untyped_data(),
+                  (*stamp_out)->size_bytes());
+  });
+}
+
+// Operands [part_0 .. part_{np-1}, stamp], results [out_0 ..
+// out_{np-1}, stamp_out]; part count implied by the arity.
+ffi::Error AlltoallFusedImpl(ffi::RemainingArgs args,
+                             ffi::RemainingRets rets, int32_t comm) {
+  return guarded([&] {
+    // operands [part_0.., stamp] and results [out_0.., stamp_out]
+    // have the SAME arity: one buffer per part plus the stamp
+    if (args.size() < 2 || rets.size() != args.size())
+      throw t4j::BridgeError("fused alltoall: malformed call arity");
+    int np = static_cast<int>(rets.size()) - 1;
+    int n = t4j::comm_size(comm);
+    std::vector<const void*> parts(np);
+    std::vector<void*> outs(np);
+    std::vector<size_t> each(np);
+    for (int i = 0; i < np; ++i) {
+      auto b = args.get<ffi::AnyBuffer>(i);
+      auto r = rets.get<ffi::AnyBuffer>(i);
+      if (!b.has_value() || !r.has_value())
+        throw t4j::BridgeError("fused alltoall: bad part buffer");
+      parts[i] = b->untyped_data();
+      outs[i] = (*r)->untyped_data();
+      each[i] = b->size_bytes() / static_cast<size_t>(n);
+    }
+    t4j::alltoall_fused(comm, parts.data(), outs.data(), each.data(), np);
+    auto stamp = args.get<ffi::AnyBuffer>(args.size() - 1);
+    auto stamp_out = rets.get<ffi::AnyBuffer>(rets.size() - 1);
+    if (stamp.has_value() && stamp_out.has_value() &&
+        (*stamp_out)->size_bytes() && stamp->size_bytes())
+      std::memcpy((*stamp_out)->untyped_data(), stamp->untyped_data(),
+                  (*stamp_out)->size_bytes());
+  });
+}
+
 // ---- async submit / wait (docs/async.md) --------------------------------
 //
 // The in-jit fast path for ops/async_.py: a submit handler hands the
@@ -484,6 +573,23 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_alltoall, AlltoallImpl,
                                   .Ret<ffi::AnyBuffer>()
                                   .Attr<int32_t>("comm"));
 
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_sendrecv_fused, SendrecvFusedImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets()
+                                  .Attr<int32_t>("comm")
+                                  .Attr<int32_t>("source")
+                                  .Attr<int32_t>("dest")
+                                  .Attr<int32_t>("sendtag")
+                                  .Attr<int32_t>("recvtag")
+                                  .Attr<int32_t>("n_send"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_alltoall_fused, AlltoallFusedImpl,
+                              ffi::Ffi::Bind()
+                                  .RemainingArgs()
+                                  .RemainingRets()
+                                  .Attr<int32_t>("comm"));
+
 XLA_FFI_DEFINE_HANDLER_SYMBOL(t4j_iallreduce_submit, IallreduceSubmitImpl,
                               T4J_BUF.Arg<ffi::AnyBuffer>()
                                   .Ret<ffi::AnyBuffer>()
@@ -566,6 +672,12 @@ void t4j_set_tuning(int64_t ring_min_bytes, int64_t seg_bytes) {
 void t4j_set_hier(int32_t mode, int64_t min_bytes) {
   t4j::set_hier(mode, min_bytes);
 }
+// Small-message coalescing threshold (docs/performance.md
+// "small-message coalescing"): bytes < 0 keeps, 0 disables fusion,
+// > 0 sets.  Must be uniform across ranks like the other data-plane
+// knobs.
+void t4j_set_coalesce(int64_t bytes) { t4j::set_coalesce(bytes); }
+int64_t t4j_coalesce_bytes() { return t4j::coalesce_threshold(); }
 // Self-healing transport knobs (docs/failure-semantics.md
 // "self-healing transport"); must be set before t4j_init and
 // uniformly across ranks.  retry_max < 0 keeps, 0 disables; backoffs
@@ -870,6 +982,37 @@ int32_t t4j_c_scatter(int32_t comm, const void* in, void* out,
 int32_t t4j_c_alltoall(int32_t comm, const void* in, void* out,
                        uint64_t nbytes_each) {
   return c_guard([&] { t4j::alltoall(comm, in, out, nbytes_each); });
+}
+// Fused multi-part p2p (small-message coalescing): pointer-array
+// iovec surface for the staged/host-callback tier and standalone
+// harnesses.  Part sizes travel as u64 so ctypes callers never deal
+// with platform size_t.
+int32_t t4j_c_sendrecv_fused(int32_t comm, void* const* send_parts,
+                             const uint64_t* send_nbytes, int32_t n_send,
+                             void* const* recv_parts,
+                             const uint64_t* recv_nbytes, int32_t n_recv,
+                             int32_t source, int32_t dest, int32_t sendtag,
+                             int32_t recvtag, int32_t* src_out,
+                             int32_t* tag_out) {
+  return c_guard([&] {
+    std::vector<size_t> sb(send_nbytes, send_nbytes + n_send);
+    std::vector<size_t> rb(recv_nbytes, recv_nbytes + n_recv);
+    int s = -1, t = -1;
+    t4j::sendrecv_fused(comm, const_cast<const void* const*>(send_parts),
+                        sb.data(), n_send, recv_parts, rb.data(), n_recv,
+                        source, dest, sendtag, recvtag, &s, &t);
+    if (src_out) *src_out = s;
+    if (tag_out) *tag_out = t;
+  });
+}
+int32_t t4j_c_alltoall_fused(int32_t comm, void* const* parts,
+                             void* const* outs,
+                             const uint64_t* nbytes_each, int32_t nparts) {
+  return c_guard([&] {
+    std::vector<size_t> each(nbytes_each, nbytes_each + nparts);
+    t4j::alltoall_fused(comm, const_cast<const void* const*>(parts), outs,
+                        each.data(), nparts);
+  });
 }
 
 }  // extern "C"

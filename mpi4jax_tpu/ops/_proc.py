@@ -639,3 +639,201 @@ def proc_sendrecv(sendbuf, recvbuf, stamp, comm, source, dest, sendtag,
         recvtag=int(recvtag),
         _must_transpose=False,
     )
+
+
+# -- fused multi-part sendrecv (small-message coalescing) ------------------
+#
+# One wire frame for a run of small same-peer messages
+# (docs/performance.md "small-message coalescing"): operands are the
+# send parts (+ stamp), the recv parts come back as results, and the
+# native layer gathers/scatters iovec-style — no packing copies on
+# either side.  AD mirrors the single sendrecv primitive: the
+# transpose swaps source and dest AND the send/recv part lists, so
+# gradients travel the reverse network direction part-for-part.
+
+sendrecv_fused_p = Primitive("mpi4jax_tpu_proc_sendrecv_fused")
+sendrecv_fused_p.multiple_results = True
+
+
+def _srf_split(args, n_send, n_recv):
+    return (
+        args[:n_send],
+        args[n_send:n_send + n_recv],
+        args[n_send + n_recv],
+    )
+
+
+def _srf_impl(*args, comm, source, dest, sendtag, recvtag, n_send,
+              n_recv, _must_transpose):
+    if _must_transpose:
+        raise RuntimeError(
+            "forward-mode differentiation through sendrecv_multi is not "
+            "supported on the multi-process backend; use reverse mode"
+        )
+    sendbufs, recvbufs, stamp = _srf_split(args, n_send, n_recv)
+    if _staged():
+        from mpi4jax_tpu.native import runtime
+
+        h = int(_handle(comm))
+        templates = [
+            jax.ShapeDtypeStruct(jnp.shape(r), jnp.result_type(r))
+            for r in recvbufs
+        ]
+
+        def cb(*host_args):
+            sends = [np.asarray(a) for a in host_args[:-1]]
+            # templates pass through as ShapeDtypeStructs — the host
+            # wrapper allocates the result buffers itself
+            outs, src, tg = runtime.host_sendrecv_fused(
+                h, sends, templates, source, dest, sendtag, recvtag,
+            )
+            return (*outs, host_args[-1], np.array([src, tg], np.int32))
+
+        return _io(
+            cb, (*[_sds(r) for r in recvbufs], _STAMP, _STATUS),
+            *sendbufs, stamp,
+        )
+    return _call(
+        "t4j_sendrecv_fused",
+        (*[_sds(r) for r in recvbufs], _STAMP, _STATUS),
+        *sendbufs,
+        stamp,
+        comm=_handle(comm),
+        source=np.int32(source),
+        dest=np.int32(dest),
+        sendtag=np.int32(sendtag),
+        recvtag=np.int32(recvtag),
+        n_send=np.int32(n_send),
+    )
+
+
+def _srf_abstract(*args, n_send, n_recv, **kw):
+    recvs = args[n_send:n_send + n_recv]
+    stamp = args[n_send + n_recv]
+    return (*recvs, stamp, jax.core.ShapedArray((2,), np.int32))
+
+
+def _srf_jvp(primals, tangents, *, comm, source, dest, sendtag, recvtag,
+             n_send, n_recv, _must_transpose):
+    # the single-sendrecv scheme (sendrecv.py:320-361 in the
+    # reference): the tangent exchange binds with the marker flipped —
+    # executable only after a transpose flips it back
+    sends, recvs, stamp = _srf_split(primals, n_send, n_recv)
+    tsends = [
+        jnp.zeros_like(p) if type(t) is ad.Zero else t
+        for p, t in zip(sends, tangents[:n_send])
+    ]
+    trecvs = [
+        jnp.zeros_like(p) if type(t) is ad.Zero else t
+        for p, t in zip(recvs, tangents[n_send:n_send + n_recv])
+    ]
+    out = sendrecv_fused_p.bind(
+        *sends, *recvs, stamp, comm=comm, source=source, dest=dest,
+        sendtag=sendtag, recvtag=recvtag, n_send=n_send, n_recv=n_recv,
+        _must_transpose=_must_transpose,
+    )
+    stamp_out = out[n_recv]
+    jout = sendrecv_fused_p.bind(
+        *tsends, *trecvs, stamp_out, comm=comm, source=source, dest=dest,
+        sendtag=sendtag, recvtag=recvtag, n_send=n_send, n_recv=n_recv,
+        _must_transpose=not _must_transpose,
+    )
+    return (
+        out,
+        (*jout[:n_recv], _zero_like(jout[n_recv]), _zero_like(jout[n_recv + 1])),
+    )
+
+
+def _srf_transpose(cts, *args, comm, source, dest, sendtag, recvtag,
+                   n_send, n_recv, _must_transpose):
+    # gradients travel the reverse network direction: the transposed
+    # exchange SENDS the recv parts' cotangents back to `source` and
+    # RECEIVES the send parts' cotangents from `dest`, part for part
+    sends, recvs, stamp = _srf_split(args, n_send, n_recv)
+    out_cts = [
+        jnp.zeros(r.aval.shape, r.aval.dtype) if type(c) is ad.Zero else c
+        for r, c in zip(recvs, cts[:n_recv])
+    ]
+    send_templates = [
+        jnp.zeros(s.aval.shape, s.aval.dtype)
+        if ad.is_undefined_primal(s) else jnp.zeros_like(s)
+        for s in sends
+    ]
+    fresh = jnp.zeros((), np.float32)
+    res = sendrecv_fused_p.bind(
+        *out_cts, *send_templates, fresh, comm=comm, source=dest,
+        dest=source, sendtag=sendtag, recvtag=recvtag, n_send=n_recv,
+        n_recv=n_send, _must_transpose=not _must_transpose,
+    )
+    send_cts = [
+        res[i] if ad.is_undefined_primal(s) else None
+        for i, s in enumerate(sends)
+    ]
+    recv_cts = [
+        ad.Zero(r.aval) if ad.is_undefined_primal(r) else None
+        for r in recvs
+    ]
+    stamp_ct = (
+        ad.Zero(stamp.aval) if ad.is_undefined_primal(stamp) else None
+    )
+    return (*send_cts, *recv_cts, stamp_ct)
+
+
+sendrecv_fused_p.def_impl(_srf_impl)
+sendrecv_fused_p.def_abstract_eval(_srf_abstract)
+ad.primitive_jvps[sendrecv_fused_p] = _srf_jvp
+ad.primitive_transposes[sendrecv_fused_p] = _srf_transpose
+mlir.register_lowering(
+    sendrecv_fused_p, mlir.lower_fun(_srf_impl, multiple_results=True)
+)
+
+
+def proc_sendrecv_fused(sendbufs, recvbufs, stamp, comm, source, dest,
+                        sendtag, recvtag):
+    """Returns ``(*recv_parts, stamp, status[2])``.  ``source`` /
+    ``dest`` may be -1 (no send / no recv side) only when the matching
+    part list is empty."""
+    return sendrecv_fused_p.bind(
+        *sendbufs,
+        *recvbufs,
+        stamp,
+        comm=comm,
+        source=int(source),
+        dest=int(dest),
+        sendtag=int(sendtag),
+        recvtag=int(recvtag),
+        n_send=len(sendbufs),
+        n_recv=len(recvbufs),
+        _must_transpose=False,
+    )
+
+
+def proc_alltoall_fused(parts, stamp, comm):
+    """Fused multi-part alltoall: each peer receives ONE wire frame
+    carrying its slice of every part (bit-identical to per-part
+    alltoall; docs/performance.md "small-message coalescing").
+    Returns ``(outs, stamp)``."""
+    if _staged():
+        from mpi4jax_tpu.native import runtime
+        from mpi4jax_tpu.telemetry import recorder as _telrec
+
+        h = int(_handle(comm))
+        total = sum(int(np.prod(jnp.shape(p), dtype=np.int64))
+                    for p in parts)
+
+        def cb(*host_args):
+            arrs = [np.asarray(a) for a in host_args[:-1]]
+            with _telrec.py_op("staged_alltoall_fused", total):
+                outs = runtime.host_alltoall_fused(h, arrs)
+            return (*outs, host_args[-1])
+
+        out = _io(cb, (*[_sds(p) for p in parts], _STAMP), *parts, stamp)
+        return list(out[:-1]), out[-1]
+    out = _call(
+        "t4j_alltoall_fused",
+        (*[_sds(p) for p in parts], _STAMP),
+        *parts,
+        stamp,
+        comm=_handle(comm),
+    )
+    return list(out[:-1]), out[-1]

@@ -49,6 +49,7 @@ from mpi4jax_tpu.utils.validation import check_comm, check_op, check_root
 __all__ = [
     "allgather",
     "alltoall",
+    "alltoall_multi",
     "barrier",
     "bcast",
     "gather",
@@ -135,6 +136,58 @@ def alltoall(x, *, comm=None, token=None):
         y, stamp = _proc.proc_alltoall(x, token.stamp, comm)
         return y, token.with_stamp(stamp)
     raise _unsupported("alltoall", comm)
+
+
+@publishes_token
+def alltoall_multi(parts, *, comm=None, token=None, coalesce=None):
+    """Several independent alltoalls at once — the coalescing entry
+    point for per-expert dispatch (docs/performance.md "small-message
+    coalescing"; ``parallel.moe.topk_moe`` with multiple experts per
+    rank is the canonical caller).
+
+    Semantically identical to one :func:`alltoall` per part
+    (bit-identical outputs), but on the multi-process backend a small
+    run travels as ONE fused frame per peer — carrying that peer's
+    slice of every part — instead of ``len(parts)`` frames per peer.
+    Fusion applies when the combined per-peer payload is at or below
+    ``T4J_COALESCE_BYTES``; ``coalesce=True``/``False`` forces a side,
+    ``T4J_COALESCE_BYTES=0`` restores the exact per-part wire
+    behaviour.  Returns ``(outs, token)``.
+    """
+    comm = check_comm(comm)
+    token = as_token(token)
+    parts = [jnp.asarray(p) for p in parts]
+    for p in parts:
+        if p.ndim == 0 or p.shape[0] != comm.size:
+            raise ValueError(
+                f"alltoall input must have shape (nproc, ...) with "
+                f"nproc == comm.size={comm.size}, got shape {p.shape}"
+            )
+    if not parts:
+        return [], token
+    if comm.backend == "proc" and len(parts) > 1:
+        if isinstance(coalesce, bool):
+            fuse = coalesce
+        else:
+            from mpi4jax_tpu import tuning
+
+            per_peer = sum(
+                int(p.size) * p.dtype.itemsize // comm.size
+                for p in parts
+            )
+            fuse = tuning.coalesce_eligible(per_peer, len(parts))
+        if fuse:
+            from mpi4jax_tpu.ops import _proc
+
+            outs, stamp = _proc.proc_alltoall_fused(
+                parts, token.stamp, comm
+            )
+            return outs, token.with_stamp(stamp)
+    outs = []
+    for p in parts:
+        y, token = alltoall(p, comm=comm, token=token)
+        outs.append(y)
+    return outs, token
 
 
 @publishes_token

@@ -62,7 +62,15 @@ from mpi4jax_tpu.utils.validation import (
     check_static_int,
 )
 
-__all__ = ["send", "recv", "sendrecv", "Status", "ANY_SOURCE", "ANY_TAG"]
+__all__ = [
+    "send",
+    "recv",
+    "sendrecv",
+    "sendrecv_multi",
+    "Status",
+    "ANY_SOURCE",
+    "ANY_TAG",
+]
 
 
 class Status:
@@ -243,6 +251,38 @@ def _check_tag(tag, rendezvous_ok):
     return check_static_int(tag, "tag")
 
 
+def _proc_partner(spec, comm, role):
+    """Resolve a p2p partner spec to THIS process's partner rank on the
+    multi-process backend.
+
+    A plain int keeps the MPI per-rank addressing (each process passes
+    its own value).  A callable or (source, dest) pair list — the
+    mesh-backend pattern vocabulary, what ``shift_perm`` produces — is
+    resolved against ``comm.rank()``; returns ``None`` when this rank
+    has no partner in the pattern (the MPI_PROC_NULL analog: the op
+    side simply drops out).  This is what lets grid-shaped code (halo
+    exchanges over a :class:`~mpi4jax_tpu.parallel.proc.ProcGridComm`)
+    run unchanged on OS-process worlds.
+    """
+    if _is_static_rank_int(spec):
+        return check_rank_range(int(spec), role, comm.size)
+    pairs = _resolve_pairs(spec, comm.size, role)
+    me = int(comm.rank())
+    if role == "dest":
+        mine = [d for s, d in pairs if s == me]
+    else:
+        mine = [s for s, d in pairs if d == me]
+    if not mine:
+        return None
+    if len(mine) > 1:
+        raise ValueError(
+            f"{role} pattern gives rank {me} {len(mine)} partners "
+            f"({mine}); a p2p op takes exactly one — split the pattern "
+            "into separate calls"
+        )
+    return mine[0]
+
+
 def _rendezvous_send(x, dest, tag, comm, token):
     """Mesh send with a runtime destination: post the local shard to the
     host matching engine (ops/_rendezvous.py) via io_callback."""
@@ -372,9 +412,9 @@ def send(x, dest, tag=0, *, comm=None, token=None):
         from mpi4jax_tpu.ops import _proc
 
         tag = check_static_int(tag, "tag")
-        dest = check_rank_range(
-            check_static_int(dest, "dest"), "dest", comm.size
-        )
+        dest = _proc_partner(dest, comm, "dest")
+        if dest is None:
+            return token  # no partner in the pattern (MPI_PROC_NULL)
         stamp = _proc.proc_send(x, token.stamp, comm, dest, tag)
         return token.with_stamp(stamp)
     if comm.backend == "mesh" and (
@@ -418,9 +458,16 @@ def recv(x, source=ANY_SOURCE, tag=ANY_TAG, *, comm=None, token=None, status=Non
         from mpi4jax_tpu.ops import _proc
 
         tag = check_static_int(tag, "tag")
-        source = check_static_int(source, "source")
-        if source != ANY_SOURCE:
-            source = check_rank_range(source, "source", comm.size)
+        if _is_static_rank_int(source) and int(source) == ANY_SOURCE:
+            source = ANY_SOURCE
+        else:
+            source = _proc_partner(source, comm, "source")
+        if source is None:
+            # no inbound message in the pattern: keep the recv buffer
+            # (MPI_PROC_NULL semantics, matching the mesh merge path)
+            if status is not None:
+                status.source, status.tag = -1, -1
+            return x, token
         y, stamp, st = _proc.proc_recv(x, token.stamp, comm, source, tag)
         if status is not None:
             _deliver_status(status, st)
@@ -542,12 +589,28 @@ def sendrecv(
     if comm.backend == "proc":
         from mpi4jax_tpu.ops import _proc
 
-        source = check_rank_range(
-            check_static_int(source, "source"), "source", comm.size
-        )
-        dest = check_rank_range(
-            check_static_int(dest, "dest"), "dest", comm.size
-        )
+        source = _proc_partner(source, comm, "source")
+        dest = _proc_partner(dest, comm, "dest")
+        if source is None and dest is None:
+            if status is not None:
+                status.source, status.tag = -1, -1
+            return recvbuf, token
+        if source is None:
+            # send-only edge of a non-periodic pattern: the recv buffer
+            # is returned unchanged (MPI_PROC_NULL recv side)
+            stamp = _proc.proc_send(
+                sendbuf, token.stamp, comm, dest, sendtag
+            )
+            if status is not None:
+                status.source, status.tag = -1, -1
+            return recvbuf, token.with_stamp(stamp)
+        if dest is None:
+            y, stamp, st = _proc.proc_recv(
+                recvbuf, token.stamp, comm, source, recvtag
+            )
+            if status is not None:
+                _deliver_status(status, st)
+            return y, token.with_stamp(stamp)
         y, stamp, st = _proc.proc_sendrecv(
             sendbuf, recvbuf, token.stamp, comm, source, dest, sendtag,
             recvtag,
@@ -601,4 +664,142 @@ def sendrecv(
         return y, token
     raise NotImplementedError(
         f"sendrecv not implemented for backend {comm.backend!r}"
+    )
+
+
+@publishes_token
+def sendrecv_multi(
+    sendbufs,
+    recvbufs,
+    source,
+    dest,
+    sendtag=0,
+    recvtag=ANY_TAG,
+    *,
+    comm=None,
+    token=None,
+    status=None,
+    coalesce=None,
+):
+    """Exchange several same-pattern messages at once — the coalescing
+    entry point (docs/performance.md "small-message coalescing").
+
+    Semantically identical to one :func:`sendrecv` per
+    ``(sendbufs[i], recvbufs[i])`` pair along the same
+    ``source``/``dest`` pattern (bit-identical results), but on the
+    multi-process backend a small run travels as ONE fused wire frame
+    — a single header + gathered payloads — instead of one frame per
+    part.  Fusion applies when the combined payload is at or below
+    ``T4J_COALESCE_BYTES`` (autotuner-calibrated; both sides derive
+    the decision from the same knob).  ``coalesce=True``/``False``
+    forces a side (benchmark plumbing); ``T4J_COALESCE_BYTES=0``
+    restores the exact per-part wire behaviour.
+
+    ``sendbufs`` and ``recvbufs`` are independent lists (they usually
+    pair up, as in a halo exchange).  Returns ``(outs, token)`` with
+    ``outs`` shaped like ``recvbufs``; ranks without an inbound
+    partner in the pattern keep their recv buffers (MPI_PROC_NULL).
+    """
+    comm = check_comm(comm)
+    token = as_token(token)
+    check_static_int(sendtag, "sendtag")
+    check_static_int(recvtag, "recvtag")
+    sendbufs = [jnp.asarray(b) for b in sendbufs]
+    recvbufs = [jnp.asarray(b) for b in recvbufs]
+    if comm.backend == "proc":
+        from mpi4jax_tpu.ops import _proc
+
+        my_src = _proc_partner(source, comm, "source")
+        my_dst = _proc_partner(dest, comm, "dest")
+        sends = sendbufs if my_dst is not None else []
+        recvs = recvbufs if my_src is not None else []
+        if not sends and not recvs:
+            if status is not None:
+                status.source, status.tag = -1, -1
+            return list(recvbufs), token
+
+        # Fusion is decided PER WIRE DIRECTION: my send decision must
+        # match my receiver's expectation, and it does because both
+        # compute eligibility from the same part shapes (the program is
+        # uniform across ranks) and the same T4J_COALESCE_BYTES — an
+        # edge rank with only one side still agrees with its interior
+        # peer about that one direction.
+        def _eligible(bufs):
+            if isinstance(coalesce, bool):
+                return coalesce and len(bufs) >= 1
+            from mpi4jax_tpu import tuning
+
+            total = sum(int(b.size) * b.dtype.itemsize for b in bufs)
+            return tuning.coalesce_eligible(total, len(bufs))
+
+        fuse_send = bool(sends) and _eligible(sendbufs)
+        fuse_recv = bool(recvs) and _eligible(recvbufs)
+        outs = None
+        st = None
+        if fuse_send and fuse_recv:
+            out = _proc.proc_sendrecv_fused(
+                sends, recvs, token.stamp, comm, my_src, my_dst,
+                sendtag, recvtag,
+            )
+            outs = list(out[:len(recvs)])
+            token = token.with_stamp(out[len(recvs)])
+            st = out[len(recvs) + 1]
+        else:
+            if fuse_send:
+                out = _proc.proc_sendrecv_fused(
+                    sends, [], token.stamp, comm, -1, my_dst, sendtag,
+                    recvtag,
+                )
+                token = token.with_stamp(out[0])
+            elif sends:
+                # unfused: the exact pre-coalescing wire behaviour, one
+                # frame per part (eager sends first — cannot deadlock)
+                for sb in sends:
+                    token = send(sb, my_dst, sendtag, comm=comm,
+                                 token=token)
+            if fuse_recv:
+                out = _proc.proc_sendrecv_fused(
+                    [], recvs, token.stamp, comm, my_src, -1, sendtag,
+                    recvtag,
+                )
+                outs = list(out[:len(recvs)])
+                token = token.with_stamp(out[len(recvs)])
+                st = out[len(recvs) + 1]
+            elif recvs:
+                outs = []
+                for rb in recvs:
+                    y, token = recv(
+                        rb, my_src, recvtag, comm=comm, token=token,
+                        status=status,
+                    )
+                    outs.append(y)
+        if status is not None:
+            if st is not None:
+                _deliver_status(status, st)
+            elif not recvs:
+                status.source, status.tag = -1, -1
+        return (outs if recvs else list(recvbufs)), token
+    if comm.backend == "self":
+        outs = []
+        for sb, rb in zip(sendbufs, recvbufs):
+            y, token = sendrecv(
+                sb, rb, source, dest, sendtag, recvtag, comm=comm,
+                token=token, status=status,
+            )
+            outs.append(y)
+        return outs, token
+    if comm.backend == "mesh":
+        # one ppermute per part; fusion is a wire-tier concept (the ICI
+        # tier has no frame overhead to amortise — batching there is
+        # the caller's jnp.stack, see halo_exchange_2d_batch)
+        outs = []
+        for sb, rb in zip(sendbufs, recvbufs):
+            y, token = sendrecv(
+                sb, rb, source, dest, sendtag, recvtag, comm=comm,
+                token=token, status=status,
+            )
+            outs.append(y)
+        return outs, token
+    raise NotImplementedError(
+        f"sendrecv_multi not implemented for backend {comm.backend!r}"
     )

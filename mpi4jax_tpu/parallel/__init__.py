@@ -23,7 +23,7 @@ from mpi4jax_tpu.parallel.moe import (
     topk_moe,
     topk_route,
 )
-from mpi4jax_tpu.parallel.proc import ProcComm
+from mpi4jax_tpu.parallel.proc import ProcComm, ProcGridComm, grid_comm
 
 __all__ = [
     "distributed",
@@ -32,6 +32,8 @@ __all__ = [
     "MeshComm",
     "SelfComm",
     "ProcComm",
+    "ProcGridComm",
+    "grid_comm",
     "halo_exchange_2d",
     "local_attention",
     "ring_attention",

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from mpi4jax_tpu.ops._core import as_token, publishes_token
-from mpi4jax_tpu.ops.p2p import sendrecv
+from mpi4jax_tpu.ops.p2p import sendrecv, sendrecv_multi
 
 __all__ = ["halo_exchange_2d", "halo_exchange_2d_batch"]
 
@@ -104,6 +104,23 @@ def _exchange(arrs, comm, *, periodic, token, width, stack):
 
     def shift(slabs, templates, axis, disp, per):
         nonlocal token
+        if comm.backend == "proc":
+            # multi-process tier: the whole field group's slabs for this
+            # direction go through one sendrecv_multi — below
+            # T4J_COALESCE_BYTES they travel as ONE fused wire frame
+            # instead of one frame per field (docs/performance.md
+            # "small-message coalescing"); above it, per-part frames
+            # (the exact pre-coalescing behaviour).  No stacking copy
+            # either way.
+            sub = comm.sub(axis)
+            pairs = sub.shift_perm(axis, disp, periodic=per)
+            if not pairs:
+                return [None] * len(slabs)
+            outs, token = sendrecv_multi(
+                slabs, templates, source=pairs, dest=pairs, comm=sub,
+                token=token,
+            )
+            return list(outs)
         if stack:
             halo, token = _axis_shift(
                 jnp.stack(slabs), jnp.stack(templates), comm, axis, disp,

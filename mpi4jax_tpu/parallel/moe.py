@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi4jax_tpu.ops._core import as_token
-from mpi4jax_tpu.ops.collectives import alltoall
+from mpi4jax_tpu.ops.collectives import alltoall, alltoall_multi
 
 __all__ = [
     "expert_dispatch",
@@ -162,16 +162,27 @@ def topk_route(scores, k, capacity):
     return idx, gate, valid
 
 
-def topk_moe(x, scores, expert_fn, comm, *, k=1, capacity=None, token=None):
+def topk_moe(x, scores, expert_fn, comm, *, k=1, capacity=None, token=None,
+             coalesce=None):
     """Full token-choice MoE layer: route → alltoall dispatch → expert
     compute → alltoall combine → gate-weighted scatter-add.
 
-    Experts are ``comm.size`` (one per rank, as :func:`expert_dispatch`).
-    ``expert_fn(x_slot)`` maps the local expert's ``(n_src*capacity, d)``
-    buffer elementwise per token.  Dropped (overflow) tokens contribute
-    zero; tokens keep their gate weighting.  Differentiable end to end
-    (the reference's alltoall building block; gates through the score
-    gradient).
+    The expert count is ``scores.shape[1]`` and must be a multiple of
+    ``comm.size``: with ``E == comm.size`` (the classic layout) rank r
+    hosts expert r and ``expert_fn(x_slot)`` maps the local expert's
+    ``(n_src*capacity, d)`` buffer elementwise per token; with
+    ``E == m*comm.size`` rank r hosts experts ``r*m .. r*m+m-1`` and
+    ``expert_fn`` receives the stacked ``(m, n_src*capacity, d)`` local
+    buffers.  Dropped (overflow) tokens contribute zero; tokens keep
+    their gate weighting.  Differentiable end to end (the reference's
+    alltoall building block; gates through the score gradient).
+
+    Multi-expert dispatch is the canonical small-message path: each
+    expert's per-peer slice is ``capacity*d`` elements, and the ``m``
+    slices for one peer travel as ONE fused wire frame on the
+    multi-process backend when they fit ``T4J_COALESCE_BYTES``
+    (docs/performance.md "small-message coalescing"; ``coalesce``
+    forces a side, results are bit-identical either way).
 
     ``capacity`` defaults to ``ceil(k * T / E)`` (capacity factor 1).
     Returns ``(y, token)`` with ``y`` shaped like ``x``.
@@ -179,21 +190,46 @@ def topk_moe(x, scores, expert_fn, comm, *, k=1, capacity=None, token=None):
     token = as_token(token)
     n = comm.size
     t, d = x.shape
-    if scores.shape != (t, n):
+    if scores.ndim != 2 or scores.shape[0] != t or scores.shape[1] % n:
         raise ValueError(
-            f"scores must be (tokens, n_experts)=({t}, {n}), got "
+            f"scores must be (tokens, n_experts) with n_experts a "
+            f"multiple of comm.size={n} (tokens={t}), got "
             f"{scores.shape}"
         )
+    n_experts = scores.shape[1]
+    m = n_experts // n  # experts hosted per rank
     if capacity is None:
-        capacity = default_capacity(k, t, n)
+        capacity = default_capacity(k, t, n_experts)
     idx, gate, valid = topk_route(scores, k, capacity)
     buckets = x[idx] * valid[..., None].astype(x.dtype)  # (E, cap, d)
-    # one expert per rank: deliver each expert its buckets from every
-    # source rank
-    sent, token = alltoall(buckets, comm=comm, token=token)
-    # (n_src, cap, d) -> flatten source dim for the expert
-    out = expert_fn(sent.reshape(n * capacity, d)).reshape(n, capacity, d)
-    vals, token = alltoall(out, comm=comm, token=token)  # (E, cap, d)
+    # expert e = r*m + i lives on rank r as its local expert i: part i
+    # stacks expert i of every rank -> (n, cap, d), one alltoall slice
+    # per destination rank.  alltoall_multi fuses the m parts' slices
+    # per peer into one frame on the wire tier.
+    parts = [buckets[i::m] for i in range(m)]
+    sent_parts, token = alltoall_multi(
+        parts, comm=comm, token=token, coalesce=coalesce
+    )
+    if m == 1:
+        # classic one-expert-per-rank contract: flat (n_src*cap, d)
+        out = expert_fn(sent_parts[0].reshape(n * capacity, d))
+        out_parts = [out.reshape(n, capacity, d)]
+    else:
+        stacked = jnp.stack(
+            [s.reshape(n * capacity, d) for s in sent_parts]
+        )  # (m, n_src*cap, d)
+        out = expert_fn(stacked)
+        out_parts = [out[i].reshape(n, capacity, d) for i in range(m)]
+    back_parts, token = alltoall_multi(
+        out_parts, comm=comm, token=token, coalesce=coalesce
+    )
+    # reassemble (E, cap, d): part i's row r is expert r*m+i's result
+    if m == 1:
+        vals = back_parts[0]
+    else:
+        vals = jnp.stack(back_parts, axis=1).reshape(
+            n_experts, capacity, d
+        )
     y = jnp.zeros_like(x).at[idx.reshape(-1)].add(
         (gate[..., None] * vals).reshape(-1, d)
     )

@@ -10,10 +10,14 @@ Round-1 status: interface + world discovery; the native bridge lands with
 """
 
 from dataclasses import dataclass
+from math import prod
+
+import numpy as np
 
 from mpi4jax_tpu.parallel.comm import Comm
 
-__all__ = ["ProcComm", "world_comm_if_initialized"]
+__all__ = ["ProcComm", "ProcGridComm", "grid_comm",
+           "world_comm_if_initialized"]
 
 
 @dataclass(frozen=True)
@@ -75,6 +79,128 @@ class ProcComm(Comm):
             ranks=tuple(self.ranks[r] for r in members),
             context=self.context,
         )
+
+
+@dataclass(frozen=True)
+class ProcGridComm(ProcComm):
+    """A ProcComm with a Cartesian topology (MPI_Cart_create analog).
+
+    Gives the multi-process backend the same ``sub``/``shift_perm``
+    surface as :class:`MeshComm`, so grid-shaped code —
+    ``parallel.halo.halo_exchange_2d`` in particular — runs unchanged
+    on OS-process worlds.  Ranks are the row-major ravel of the axis
+    coordinates over ``self.ranks`` (axis 0 varies slowest), exactly
+    the MeshComm convention.
+    """
+
+    axes: tuple = ()
+    axis_sizes: tuple = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "axes", tuple(self.axes))
+        object.__setattr__(
+            self, "axis_sizes", tuple(int(s) for s in self.axis_sizes)
+        )
+        if len(self.axes) != len(self.axis_sizes):
+            raise ValueError("axes and axis_sizes must have equal length")
+        if prod(self.axis_sizes) != len(self.ranks):
+            raise ValueError(
+                f"grid {self.axis_sizes} needs "
+                f"{prod(self.axis_sizes)} ranks, comm has "
+                f"{len(self.ranks)}"
+            )
+
+    def clone(self):
+        from mpi4jax_tpu.parallel.comm import _context_counter
+
+        return ProcGridComm(
+            ranks=self.ranks, context=next(_context_counter),
+            axes=self.axes, axis_sizes=self.axis_sizes,
+        )
+
+    # -- topology helpers (the MeshComm surface) --------------------------
+
+    def rank_grid(self):
+        """ndarray of shape ``axis_sizes`` holding each coordinate's
+        COMM rank (not world rank)."""
+        return np.arange(self.size).reshape(self.axis_sizes)
+
+    def coords_of(self, rank):
+        return tuple(np.unravel_index(rank, self.axis_sizes))
+
+    def shift_perm(self, axis, disp, periodic=True):
+        """(source, dest) comm-rank pairs shifting data by ``disp``
+        along ``axis`` — same contract as MeshComm.shift_perm (edge
+        ranks of a non-periodic shift simply drop out, the
+        MPI_PROC_NULL analog)."""
+        ax = self.axes.index(axis)
+        n = self.axis_sizes[ax]
+        grid = self.rank_grid()
+        pairs = []
+        for src_coord in np.ndindex(*self.axis_sizes):
+            dst_coord = list(src_coord)
+            d = src_coord[ax] + disp
+            if periodic:
+                dst_coord[ax] = d % n
+            elif 0 <= d < n:
+                dst_coord[ax] = d
+            else:
+                continue
+            pairs.append(
+                (int(grid[src_coord]), int(grid[tuple(dst_coord)]))
+            )
+        return pairs
+
+    def sub(self, *axes):
+        """Sub-communicator over a subset of axes (MPI_Cart_sub).
+
+        Unlike the SPMD mesh (where one comm description covers every
+        device), each PROCESS gets the communicator of its own slab:
+        the ranks varying over ``axes`` with this process's other
+        coordinates held fixed.  The parent context is kept — the wire
+        channel hashes (ranks, context), so different rows get
+        disjoint channels automatically."""
+        for a in axes:
+            if a not in self.axes:
+                raise ValueError(f"axis {a!r} not in {self.axes}")
+        me = self.rank()
+        coords = dict(zip(self.axes, self.coords_of(me)))
+        sizes = tuple(self.axis_sizes[self.axes.index(a)] for a in axes)
+        grid = self.rank_grid()
+        members = []
+        for sub_coord in np.ndindex(*sizes):
+            full = tuple(
+                sub_coord[axes.index(a)] if a in axes else coords[a]
+                for a in self.axes
+            )
+            members.append(int(grid[full]))
+        return ProcGridComm(
+            ranks=tuple(self.ranks[r] for r in members),
+            context=self.context,
+            axes=tuple(axes),
+            axis_sizes=sizes,
+        )
+
+
+def grid_comm(axis_sizes, axes=None, base=None):
+    """Build a :class:`ProcGridComm` over ``base`` (default: the world
+    ProcComm) with the given axis sizes; ``axes`` defaults to
+    ``("y", "x")`` for 2-D grids, ``("axis0", ...)`` otherwise."""
+    if base is None:
+        base = world_comm_if_initialized()
+        if base is None:
+            raise RuntimeError(
+                "grid_comm: no multi-process world (launch with "
+                "python -m mpi4jax_tpu.launch, or pass base=)"
+            )
+    axis_sizes = tuple(int(s) for s in axis_sizes)
+    if axes is None:
+        axes = (("y", "x") if len(axis_sizes) == 2
+                else tuple(f"axis{i}" for i in range(len(axis_sizes))))
+    return ProcGridComm(
+        ranks=tuple(base.ranks), context=base.context,
+        axes=tuple(axes), axis_sizes=axis_sizes,
+    )
 
 
 def world_comm_if_initialized():

@@ -686,10 +686,29 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
 
     # plane audit over every top-level op span (END events carry the
     # served plane): bytes the tree plane moved at sizes where the
-    # ring / hierarchical planes would have been selected
+    # ring / hierarchical planes would have been selected.  The knobs
+    # judged against are the job's EFFECTIVE tuning — the rank files
+    # record what tuning.startup resolved (env > tuning cache >
+    # default), so a job that ran on cache-loaded values is audited
+    # against those, and the audit names the cache file + fingerprint
+    # it came from instead of assuming env-derived knobs.
+    tuning_meta = next(
+        (t for t in tunings if t.get("sources") or t.get("cache_file")),
+        tunings[0] if tunings else {},
+    )
+    knob_sources = tuning_meta.get("sources") or {}
     audit = {
         "ring_min_bytes": int(ring_min_bytes),
         "leader_ring_min_bytes": int(leader_ring_min_bytes),
+        "ring_min_source": knob_sources.get("ring_min_bytes"),
+        "leader_ring_min_source": knob_sources.get(
+            "leader_ring_min_bytes"
+        ),
+        "coalesce_bytes": tuning_meta.get("coalesce_bytes"),
+        "coalesce_source": knob_sources.get("coalesce_bytes"),
+        "tuning_cache_file": tuning_meta.get("cache_file"),
+        "tuning_fingerprint": tuning_meta.get("fingerprint"),
+        "autotuned": bool(tuning_meta.get("autotuned", False)),
         "tree_bytes_over_ring_min": 0,
         "tree_calls_over_ring_min": 0,
         "flat_bytes_over_leader_min_on_multihost": 0,
@@ -958,23 +977,38 @@ def render(report, max_steps=40):
                 f"{link['replays']:>9}{link['cause']:>8}  {ops}"
             )
     audit = report["plane_audit"]
+
+    def _knob(value, source):
+        return (f"{value} B ({source})" if source else f"{value} B")
+
+    if audit.get("tuning_cache_file") or audit.get("autotuned"):
+        out.append("")
+        origin = "autotuned this run" if audit.get("autotuned") else "loaded"
+        out.append(
+            f"  effective tuning: {origin} from cache "
+            f"{audit.get('tuning_cache_file') or '(not persisted)'} "
+            f"(fingerprint {audit.get('tuning_fingerprint')}); "
+            "explicit T4J_* env vars override cached values"
+        )
     if audit["tree_calls_over_ring_min"]:
         mb = audit["tree_bytes_over_ring_min"] / 1e6
         out.append("")
         out.append(
             f"  plane audit: {audit['tree_calls_over_ring_min']} "
-            f"call(s) / {mb:.1f} MB went TREE at sizes >= "
-            f"{audit['ring_min_bytes']} B where the ring plane is "
-            "selected by default — check T4J_RING_MIN_BYTES "
-            "(docs/performance.md)"
+            f"call(s) / {mb:.1f} MB went TREE at sizes >= the job's "
+            f"effective T4J_RING_MIN_BYTES="
+            f"{_knob(audit['ring_min_bytes'], audit.get('ring_min_source'))}"
+            " where the ring plane is selected — check the knob or "
+            "re-calibrate (docs/performance.md)"
         )
     if audit["flat_calls_over_leader_min_on_multihost"]:
         mb = audit["flat_bytes_over_leader_min_on_multihost"] / 1e6
         out.append(
             f"  plane audit: {audit['flat_calls_over_leader_min_on_multihost']} "
             f"call(s) / {mb:.1f} MB ran FLAT on a multi-host topology "
-            f"at sizes >= {audit['leader_ring_min_bytes']} B where the "
-            "hierarchical plane applies — check T4J_HIER"
+            f"at sizes >= the job's effective T4J_LEADER_RING_MIN_BYTES="
+            f"{_knob(audit['leader_ring_min_bytes'], audit.get('leader_ring_min_source'))}"
+            " where the hierarchical plane applies — check T4J_HIER"
         )
     if report["step_marker_problems"]:
         out.append("")

@@ -65,7 +65,32 @@ def capture_runtime_state():
         pass
     # the plane-selection knobs the job ran under: t4j-diagnose's
     # plane audit judges served planes against THESE, not against
-    # whatever environment diagnose later runs in
+    # whatever environment diagnose later runs in.  The job's
+    # EFFECTIVE tuning (env > tuning cache > default, as resolved by
+    # tuning.startup) is authoritative when available — env-only
+    # values would misjudge a job that ran on cache-loaded knobs —
+    # and the per-knob provenance plus the cache file/fingerprint ride
+    # along so the audit can name them.
+    try:
+        from mpi4jax_tpu import tuning as _tuning
+
+        eff = _tuning.effective()
+    except Exception:
+        eff = None
+    if eff is not None:
+        _accum["tuning"] = {
+            "ring_min_bytes": eff["knobs"]["ring_min_bytes"],
+            "seg_bytes": eff["knobs"]["seg_bytes"],
+            "leader_ring_min_bytes":
+                eff["knobs"]["leader_ring_min_bytes"],
+            "hier": eff["knobs"]["hier"],
+            "coalesce_bytes": eff["knobs"]["coalesce_bytes"],
+            "sources": dict(eff["sources"]),
+            "cache_file": eff["cache_file"],
+            "fingerprint": eff["fingerprint"],
+            "autotuned": bool(eff["autotuned"]),
+        }
+        return
     try:
         from mpi4jax_tpu.utils import config
 
@@ -74,6 +99,7 @@ def capture_runtime_state():
             "seg_bytes": config.seg_bytes(),
             "leader_ring_min_bytes": config.leader_ring_min_bytes(),
             "hier": config.hier_mode(),
+            "coalesce_bytes": config.coalesce_bytes(),
         }
     except Exception:
         pass

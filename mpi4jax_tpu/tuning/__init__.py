@@ -1,0 +1,263 @@
+"""Trace-guided autotuning and small-message coalescing
+(docs/performance.md "trace-guided autotuning").
+
+Two coupled halves:
+
+* **Autotuner** — :mod:`tuning.calibrate` self-measures the data-plane
+  knob vector (ring/tree and flat/hier crossovers, segment size,
+  coalescing threshold) through the existing native ops using the PR-6
+  telemetry metrics table, and :mod:`tuning.cache` persists the fit in
+  an on-disk cache keyed by a topology fingerprint
+  (:mod:`tuning.fingerprint`).  :func:`startup` runs at
+  ``runtime.ensure_initialized``: load cache -> resolve (explicit
+  ``T4J_*`` env always wins) -> broadcast rank 0's resolution ->
+  thread through the existing ``set_tuning``/``set_hier``/
+  ``set_coalesce`` plumbing.  ``T4J_AUTOTUNE=1`` (the launcher's
+  ``--autotune``) calibrates first and writes the cache.
+
+* **Coalescer** — :mod:`tuning.coalesce` plans fused wire frames from
+  the analyzer's recorded schedules; the ops layer applies the same
+  ``T4J_COALESCE_BYTES`` gate at run time via
+  :func:`coalesce_eligible`.
+
+This package is import-free of jax (like analysis/contracts.py and
+telemetry/), so the pure core runs on old-jax containers through the
+package-stub loader (tests/test_tuning.py).
+"""
+
+import os
+
+from mpi4jax_tpu.tuning import cache, calibrate, coalesce, fingerprint
+from mpi4jax_tpu.tuning.cache import KNOB_DEFAULTS, resolve
+from mpi4jax_tpu.tuning.fingerprint import (
+    KNOB_SCHEMA_VERSION,
+    topology_fingerprint,
+)
+
+__all__ = [
+    "cache",
+    "calibrate",
+    "coalesce",
+    "fingerprint",
+    "KNOB_DEFAULTS",
+    "KNOB_SCHEMA_VERSION",
+    "topology_fingerprint",
+    "resolve",
+    "startup",
+    "effective",
+    "coalesce_bytes",
+    "coalesce_eligible",
+    "override_coalesce",
+    "autotune_and_store",
+]
+
+# The job's effective tuning after startup(): {"knobs", "sources",
+# "fingerprint", "cache_file", "autotuned"}.  None before startup (or
+# outside multi-process jobs) — readers fall back to env/defaults.
+_state = {"effective": None, "coalesce_override": None}
+
+
+def effective():
+    """The effective tuning meta recorded at startup, or ``None``."""
+    return _state["effective"]
+
+
+def _reset():
+    """Test hook."""
+    _state["effective"] = None
+    _state["coalesce_override"] = None
+
+
+def coalesce_bytes():
+    """The effective coalescing threshold in bytes (0 = fusion off).
+
+    Resolution: explicit override (:func:`override_coalesce`, used by
+    benchmarks to flip sides in interleaved pairs) > the startup
+    resolution (env > cache > default) > env/default for jobs that
+    never ran startup."""
+    ov = _state["coalesce_override"]
+    if ov is not None:
+        return int(ov)
+    eff = _state["effective"]
+    if eff is not None:
+        return int(eff["knobs"]["coalesce_bytes"])
+    raw = os.environ.get("T4J_COALESCE_BYTES")
+    if raw is not None and str(raw).strip() != "":
+        try:
+            return cache._parse_bytes(raw)
+        except ValueError:
+            return KNOB_DEFAULTS["coalesce_bytes"]
+    return KNOB_DEFAULTS["coalesce_bytes"]
+
+
+def coalesce_eligible(total_bytes, nparts):
+    """Should a run of ``nparts`` messages totalling ``total_bytes``
+    travel as one fused frame?  A single message gains nothing (the
+    fused sub-header is pure overhead), and 0 disables fusion — the
+    exact pre-coalescing wire behaviour."""
+    if nparts < 2:
+        return False
+    threshold = coalesce_bytes()
+    return threshold > 0 and int(total_bytes) <= threshold
+
+
+def override_coalesce(bytes_or_none):
+    """Force the coalescing threshold for this process (``None``
+    restores the startup/env resolution).  Benchmark plumbing for
+    interleaved on/off pairs; uniform-across-ranks rules apply exactly
+    as for the env knob.  Mirrors into the native knob so standalone
+    introspection agrees."""
+    _state["coalesce_override"] = (
+        None if bytes_or_none is None else int(bytes_or_none)
+    )
+    try:
+        from mpi4jax_tpu.native import runtime
+
+        runtime.set_coalesce(coalesce_bytes())
+    except Exception:
+        pass
+
+
+def autotune_and_store(progress=None):
+    """Calibrate (collective!) and persist the fit on rank 0; returns
+    the fitted knob dict.  Requires an initialized bridge."""
+    import sys
+
+    from mpi4jax_tpu.native import runtime
+
+    knobs, measurements = calibrate.autotune(progress=progress)
+    topo = runtime.topology()
+    fp = topology_fingerprint(topo, runtime.world_size())
+    directory = cache.cache_dir()
+    if directory is not None and runtime.world_rank() == 0:
+        merged = dict(KNOB_DEFAULTS)
+        merged.update({k: v for k, v in knobs.items() if v is not None})
+        try:
+            cache.store(cache.cache_path(directory, fp), fp, merged,
+                        measurements)
+        except OSError as e:
+            # an unwritable cache dir must not take the job down — and
+            # CRUCIALLY must not stop rank 0 short of the knob
+            # broadcast in startup(), where every other rank is
+            # already blocked (they would sit until the op deadline)
+            print(
+                f"t4j: tuning cache not persisted "
+                f"({type(e).__name__}: {e}); the fit still applies to "
+                "this job",
+                file=sys.stderr,
+                flush=True,
+            )
+    return knobs
+
+
+_HIER_CODES = {"auto": 0, "on": 1, "off": 2}
+_HIER_NAMES = {v: k for k, v in _HIER_CODES.items()}
+
+
+def startup(progress=None):
+    """Load/resolve/apply the tuning vector for this job (called from
+    ``runtime.ensure_initialized`` after bootstrap; idempotent enough
+    to re-run, the last application wins).
+
+    Rank 0's resolution is broadcast to every rank before applying:
+    ranks can legitimately see different cache files (per-host
+    filesystems), and a divergent knob vector would run mismatched
+    wire algorithms and deadlock.
+    """
+    from mpi4jax_tpu.native import runtime
+
+    if not runtime.is_initialized():
+        return None
+
+    topo = runtime.topology()
+    world = runtime.world_size()
+    fp = topology_fingerprint(topo, world)
+    directory = cache.cache_dir()
+    cache_file = None
+    cached = None
+    if directory is not None:
+        path = cache.cache_path(directory, fp)
+        cached = cache.load(path, fp)
+        if cached is not None:
+            cache_file = str(path)
+
+    autotuned = False
+    try:
+        from mpi4jax_tpu.utils import config
+
+        want_autotune = config.truthy(
+            os.environ.get("T4J_AUTOTUNE"), default=False
+        )
+    except Exception:
+        want_autotune = str(
+            os.environ.get("T4J_AUTOTUNE", "")
+        ).strip().lower() in ("1", "true", "on", "yes")
+    if want_autotune:
+        # calibration is collective: every rank reaches here from
+        # ensure_initialized before any user traffic
+        fitted = autotune_and_store(progress=progress)
+        cached = {"knobs": fitted}
+        cache_file = (
+            str(cache.cache_path(directory, fp))
+            if directory is not None else None
+        )
+        autotuned = True
+
+    knobs, sources = resolve((cached or {}).get("knobs"))
+
+    if world > 1:
+        # rank 0's resolution wins everywhere (uniformity contract).
+        # The per-knob provenance rides along: without it a rank whose
+        # own filesystem has no cache file would record
+        # sources="default" for values that actually came from rank
+        # 0's cache — and t4j-diagnose would then name the wrong knob
+        # origin in the post-mortem.
+        import numpy as np
+
+        src_codes = {"default": 0, "cache": 1, "env": 2}
+        src_names = {v: k for k, v in src_codes.items()}
+        order = ("ring_min_bytes", "seg_bytes", "leader_ring_min_bytes",
+                 "hier", "coalesce_bytes")
+        vec = np.asarray(
+            [
+                knobs["ring_min_bytes"],
+                knobs["seg_bytes"],
+                knobs["leader_ring_min_bytes"],
+                _HIER_CODES.get(knobs["hier"], 0),
+                knobs["coalesce_bytes"],
+                *[src_codes.get(sources[k], 0) for k in order],
+            ],
+            np.int64,
+        )
+        vec = runtime.host_bcast(0, vec, 0)
+        knobs = {
+            "ring_min_bytes": int(vec[0]),
+            "seg_bytes": int(vec[1]),
+            "leader_ring_min_bytes": int(vec[2]),
+            "hier": _HIER_NAMES.get(int(vec[3]), "auto"),
+            "coalesce_bytes": int(vec[4]),
+        }
+        sources = {
+            k: src_names.get(int(vec[5 + i]), "default")
+            for i, k in enumerate(order)
+        }
+
+    runtime.set_tuning(
+        ring_min_bytes=knobs["ring_min_bytes"],
+        seg_bytes=knobs["seg_bytes"],
+    )
+    runtime.set_hier(
+        mode=knobs["hier"],
+        leader_ring_min_bytes=knobs["leader_ring_min_bytes"],
+    )
+    runtime.set_coalesce(knobs["coalesce_bytes"])
+
+    eff = {
+        "knobs": dict(knobs),
+        "sources": dict(sources),
+        "fingerprint": fp,
+        "cache_file": cache_file,
+        "autotuned": autotuned,
+    }
+    _state["effective"] = eff
+    return eff

@@ -1,0 +1,175 @@
+"""On-disk tuning cache: fingerprint-keyed knob vectors.
+
+One JSON file per topology fingerprint under the cache directory
+(``T4J_TUNING_CACHE``, default ``~/.cache/mpi4jax_tpu``;
+``T4J_TUNING_CACHE=off`` disables the cache entirely).  The file holds
+the calibrated knob vector plus the measurements it was fitted from,
+so ``t4j-diagnose`` can name both the file and the evidence.
+
+Precedence is resolved per knob in :func:`resolve`: an explicitly set
+``T4J_*`` environment variable always wins over a cached value, which
+wins over the built-in default — the operator's hand on a knob must
+never be silently overridden by a stale measurement.
+
+stdlib only (package-stub loadable on old-jax containers); the loud
+env validation lives in utils/config.py and already ran at bridge
+init, so the local parser here only has to agree with it on valid
+input.
+"""
+
+import json
+import os
+import pathlib
+
+from mpi4jax_tpu.tuning.fingerprint import KNOB_SCHEMA_VERSION
+
+__all__ = [
+    "CACHE_SCHEMA_VERSION",
+    "KNOBS",
+    "KNOB_DEFAULTS",
+    "cache_dir",
+    "cache_path",
+    "load",
+    "store",
+    "resolve",
+]
+
+# File-format version (independent of the knob schema: the file layout
+# can evolve without invalidating measurements, and vice versa).
+CACHE_SCHEMA_VERSION = 1
+
+# The calibratable knob vector, env name -> cache key.  hier is the
+# T4J_HIER mode string; everything else is a byte count.
+KNOBS = {
+    "T4J_RING_MIN_BYTES": "ring_min_bytes",
+    "T4J_SEG_BYTES": "seg_bytes",
+    "T4J_LEADER_RING_MIN_BYTES": "leader_ring_min_bytes",
+    "T4J_HIER": "hier",
+    "T4J_COALESCE_BYTES": "coalesce_bytes",
+}
+
+KNOB_DEFAULTS = {
+    "ring_min_bytes": 256 << 10,
+    "seg_bytes": 1 << 20,
+    "leader_ring_min_bytes": 256 << 10,
+    "hier": "auto",
+    "coalesce_bytes": 16 << 10,
+}
+
+_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
+
+
+def _parse_bytes(value):
+    """Local K/M/G byte parser agreeing with utils.config.byte_count on
+    valid input (invalid input already failed loudly at bridge init)."""
+    s = str(value).strip()
+    mult = 1
+    if s and s[-1].lower() in _SUFFIX:
+        mult = _SUFFIX[s[-1].lower()]
+        s = s[:-1].strip()
+    return int(s, 10) * mult
+
+
+def cache_dir(env=None):
+    """The cache directory, or ``None`` when disabled
+    (``T4J_TUNING_CACHE=off``).
+
+    With no explicit ``env`` this delegates to
+    ``utils.config.tuning_cache_dir`` — ONE implementation of the
+    default-path/"off" resolution — falling back to the local parse
+    only for standalone loads where the config module is unreachable
+    (the telemetry/recorder pattern).  The ``env`` parameter exists
+    for the pure-core tests.
+    """
+    if env is None:
+        try:
+            from mpi4jax_tpu.utils import config
+
+            v = config.tuning_cache_dir()
+            return None if v is None else pathlib.Path(v)
+        except Exception:
+            env = os.environ
+    v = str(env.get("T4J_TUNING_CACHE") or "").strip()
+    if v.lower() == "off":
+        return None
+    if v:
+        return pathlib.Path(v)
+    return pathlib.Path(os.path.expanduser("~")) / ".cache" / "mpi4jax_tpu"
+
+
+def cache_path(directory, fingerprint):
+    return pathlib.Path(directory) / f"t4j-tuning-{fingerprint}.json"
+
+
+def load(path, fingerprint, knob_schema=KNOB_SCHEMA_VERSION):
+    """Load and validate a cache file.
+
+    Returns the cache object, or ``None`` when the file is missing,
+    unreadable, written under another cache/knob schema, or carries a
+    different fingerprint (a renamed/copied file must not smuggle a
+    foreign fabric's knobs in).
+    """
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(obj, dict):
+        return None
+    if obj.get("cache_schema") != CACHE_SCHEMA_VERSION:
+        return None
+    if obj.get("knob_schema") != knob_schema:
+        return None
+    if obj.get("fingerprint") != fingerprint:
+        return None
+    if not isinstance(obj.get("knobs"), dict):
+        return None
+    return obj
+
+
+def store(path, fingerprint, knobs, measurements=None,
+          knob_schema=KNOB_SCHEMA_VERSION):
+    """Atomically write a cache file; returns the path."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    obj = {
+        "cache_schema": CACHE_SCHEMA_VERSION,
+        "knob_schema": knob_schema,
+        "fingerprint": fingerprint,
+        "knobs": {k: knobs[k] for k in knobs},
+        "measurements": measurements or [],
+    }
+    tmp = path.with_suffix(f".tmp{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def resolve(cache_knobs, env=None):
+    """Per-knob effective value + provenance.
+
+    Returns ``(knobs, sources)`` — ``knobs`` maps cache key -> value,
+    ``sources`` maps cache key -> ``"env" | "cache" | "default"``.
+    An explicitly set (non-empty) env var wins over the cache, which
+    wins over the default.
+    """
+    env = os.environ if env is None else env
+    cache_knobs = cache_knobs or {}
+    knobs, sources = {}, {}
+    for env_name, key in KNOBS.items():
+        raw = env.get(env_name)
+        if raw is not None and str(raw).strip() != "":
+            if key == "hier":
+                knobs[key] = str(raw).strip().lower()
+            else:
+                knobs[key] = _parse_bytes(raw)
+            sources[key] = "env"
+        elif key in cache_knobs and cache_knobs[key] is not None:
+            v = cache_knobs[key]
+            knobs[key] = str(v) if key == "hier" else int(v)
+            sources[key] = "cache"
+        else:
+            knobs[key] = KNOB_DEFAULTS[key]
+            sources[key] = "default"
+    return knobs, sources

@@ -1,0 +1,312 @@
+"""Calibration driver: self-measure the data-plane knob vector.
+
+The shipped ``T4J_*`` defaults were measured once on one loopback box
+(docs/performance.md) and are wrong everywhere else.  This driver runs
+a few timed rounds per comm x size-bucket x plane through the EXISTING
+native ops, measured via the PR-6 telemetry metrics table (snapshot
+deltas of the per-op latency histograms — no new timing code path),
+fits the crossovers, and hands the result to :mod:`tuning.cache`.
+
+Two layers:
+
+* pure fitters (:func:`fit_crossover`, :func:`fit_seg`,
+  :func:`fit_coalesce`, :func:`fit_records`) — stdlib only, consumed
+  by the ``proc_busbw.py --calibrate`` JSON as well, unit-tested on
+  old-jax containers;
+* the collective driver (:func:`autotune`) — every rank runs the SAME
+  arm schedule, each arm's measured time is max-reduced across ranks
+  through the native allreduce so all ranks fit the identical knob
+  vector (a collective is only as fast as its slowest member, and a
+  divergent fit would desynchronise the data plane).
+"""
+
+import time
+
+__all__ = [
+    "DEFAULT_SIZES",
+    "SEG_CANDIDATES",
+    "COALESCE_SIZES",
+    "fit_crossover",
+    "fit_seg",
+    "fit_coalesce",
+    "fit_records",
+    "autotune",
+]
+
+# Size ladder straddling both shipped crossover defaults (256 KiB).
+DEFAULT_SIZES = (16 << 10, 64 << 10, 256 << 10, 1 << 20, 4 << 20)
+# Segment candidates around the shipped 1 MiB default.
+SEG_CANDIDATES = (128 << 10, 256 << 10, 512 << 10, 1 << 20, 2 << 20)
+# Combined-payload sizes for the fused-vs-unfused p2p pair (4 parts).
+COALESCE_SIZES = (1 << 10, 4 << 10, 16 << 10, 64 << 10, 256 << 10)
+
+
+# --------------------------------------------------------------- fitters
+
+
+def fit_crossover(points):
+    """Tree->ring (or flat->hier) switchover from paired timings.
+
+    ``points``: iterable of ``(size_bytes, small_ms, big_ms)`` where
+    ``small_ms`` is the latency-optimal arm (tree/flat) and ``big_ms``
+    the bandwidth-optimal arm (ring/hier).  Returns the switchover in
+    bytes: the boundary that minimises the total time of always
+    choosing the small arm below it and the big arm at/above it.  This
+    is robust to a noisy single inversion, unlike "first size where
+    big wins".  Falls back to ``None`` on no data.
+    """
+    pts = sorted((int(s), float(a), float(b)) for s, a, b in points)
+    if not pts:
+        return None
+    # candidate boundaries: below everything, between sizes, above all
+    bounds = [pts[0][0]] + [pts[i][0] for i in range(1, len(pts))] + [
+        pts[-1][0] * 4
+    ]
+    best_bound, best_cost = None, None
+    for bound in bounds:
+        cost = sum(a if s < bound else b for s, a, b in pts)
+        if best_cost is None or cost < best_cost:
+            best_bound, best_cost = bound, cost
+    return int(best_bound)
+
+
+def fit_seg(points):
+    """Best ring segment size from ``(seg_bytes, ms)`` pairs (argmin;
+    ties break toward the larger segment — fewer per-segment deadline
+    checks).  ``None`` on no data."""
+    pts = sorted(((float(ms), -int(seg)) for seg, ms in points))
+    if not pts:
+        return None
+    return -pts[0][1]
+
+
+def fit_coalesce(points):
+    """Coalescing threshold from fused-vs-unfused pairs.
+
+    ``points``: ``(total_bytes, fused_ms, unfused_ms)``.  Returns the
+    largest total size at which fusing won (as the inclusive
+    threshold), or 0 when fusing never won (coalescing off).
+    """
+    best = 0
+    for total, fused, unfused in points:
+        if float(fused) < float(unfused) and int(total) > best:
+            best = int(total)
+    return best
+
+
+def fit_records(records):
+    """Fit the knob vector from ``proc_busbw.py --calibrate`` JSON
+    records (each: ``{"arm", "payload_bytes", "mean_ms", ...}``, arms
+    ``tree|ring|hier|flat|seg:<bytes>|fused|unfused``).
+
+    Returns a partial knob dict (only the knobs the records cover).
+    """
+    by = {}
+    for r in records or ():
+        by.setdefault(str(r.get("arm")), []).append(r)
+
+    def pair(small_arm, big_arm):
+        small = {int(r["payload_bytes"]): float(r["mean_ms"])
+                 for r in by.get(small_arm, ())}
+        big = {int(r["payload_bytes"]): float(r["mean_ms"])
+               for r in by.get(big_arm, ())}
+        return [(s, small[s], big[s]) for s in sorted(small)
+                if s in big]
+
+    knobs = {}
+    ring_pts = pair("tree", "ring")
+    if ring_pts:
+        knobs["ring_min_bytes"] = fit_crossover(ring_pts)
+    seg_pts = []
+    for arm, rows in by.items():
+        if arm.startswith("seg:"):
+            for r in rows:
+                seg_pts.append((int(arm[4:]), float(r["mean_ms"])))
+    if seg_pts:
+        knobs["seg_bytes"] = fit_seg(seg_pts)
+    hier_pts = pair("flat", "hier")
+    if hier_pts:
+        knobs["leader_ring_min_bytes"] = fit_crossover(hier_pts)
+        knobs["hier"] = "auto"
+    co_pts = pair("unfused", "fused")
+    if co_pts:
+        # pair() returns (size, unfused, fused); fit wants (size, fused,
+        # unfused)
+        knobs["coalesce_bytes"] = fit_coalesce(
+            [(s, f, u) for s, u, f in co_pts]
+        )
+    return knobs
+
+
+# --------------------------------------------------------------- driver
+
+
+def _metrics_registry(runtime):
+    from mpi4jax_tpu.telemetry.registry import MetricsRegistry
+
+    words = runtime.metrics_snapshot()
+    return MetricsRegistry.from_snapshot(words) if words else None
+
+
+def _measure_arm(runtime, run_one, op, reps):
+    """Wall time per rep of ``run_one`` measured through the telemetry
+    metrics table (snapshot delta over the window, docs/observability.md)
+    — the PR-6 measurement path, not new timing code.  Falls back to
+    wall-clock when the table is unavailable (telemetry hard-off)."""
+    t0 = time.perf_counter()
+    before = _metrics_registry(runtime)
+    for _ in range(reps):
+        run_one()
+    wall = (time.perf_counter() - t0) / reps
+    after = _metrics_registry(runtime)
+    if before is not None and after is not None:
+        row = after.diff(before).aggregate(op=op)
+        if row is not None and row.count:
+            s = row.stats()
+            if s["mean_ms"]:
+                # total measured op time in the window, per rep
+                return s["mean_ms"] * s["count"] / reps
+    return wall * 1e3
+
+
+def autotune(sizes=None, seg_candidates=None, coalesce_sizes=None,
+             reps=5, progress=None):
+    """Collective knob calibration on the world communicator.
+
+    Every rank must call this at the same point (it runs real
+    collectives).  Returns ``(knobs, measurements)`` — identical on
+    every rank (per-arm times are MAX-reduced across ranks before the
+    fit).  The caller owns persisting/applying the result
+    (:func:`tuning.startup` does both for ``--autotune`` runs).
+
+    The ladders default to the MODULE attributes at call time (not at
+    def time), so a harness that shrinks ``calibrate.DEFAULT_SIZES``
+    before calling :func:`tuning.startup` actually shrinks the run.
+    """
+    import numpy as np
+
+    from mpi4jax_tpu.native import runtime
+
+    if sizes is None:
+        sizes = DEFAULT_SIZES
+    if seg_candidates is None:
+        seg_candidates = SEG_CANDIDATES
+    if coalesce_sizes is None:
+        coalesce_sizes = COALESCE_SIZES
+
+    lib = runtime._state["lib"]
+    if lib is None or not lib.t4j_initialized():
+        raise RuntimeError("autotune requires an initialized bridge")
+    world = 0  # pre-created world communicator handle
+    n = int(lib.t4j_comm_size(world))
+    me = int(lib.t4j_comm_rank(world))
+
+    # measurement rides the PR-6 metrics table: make sure it counts
+    prev_mode = runtime.telemetry_mode_name()
+    if prev_mode == "off":
+        runtime.set_telemetry(mode="counters")
+
+    def say(msg):
+        if progress is not None and me == 0:
+            progress(f"[autotune] {msg}")
+
+    def sync_max(ms):
+        """MAX across ranks so every rank fits identical numbers."""
+        out = runtime.host_allreduce(
+            world, np.asarray([ms], np.float64), 3  # 3 = MAX
+        )
+        return float(out[0])
+
+    measurements = []
+
+    def arm(name, payload_bytes, op, run_one):
+        runtime.host_barrier(world)
+        run_one()  # warm (negotiation, first-touch) outside the window
+        runtime.host_barrier(world)
+        ms = sync_max(_measure_arm(runtime, run_one, op, reps))
+        measurements.append(
+            {"arm": name, "payload_bytes": int(payload_bytes),
+             "mean_ms": ms, "op": op}
+        )
+        return ms
+
+    # ---- ring_min: tree vs ring per size --------------------------------
+    ring_pts = []
+    for size in sizes:
+        count = max(size // 4, n)
+        x = np.ones(count, np.float32)
+        run = lambda: runtime.host_allreduce(world, x, 0)  # noqa: E731
+        runtime.set_tuning(ring_min_bytes=1 << 40)  # force trees
+        t_tree = arm("tree", count * 4, "allreduce", run)
+        runtime.set_tuning(ring_min_bytes=0)  # force ring
+        t_ring = arm("ring", count * 4, "allreduce", run)
+        ring_pts.append((count * 4, t_tree, t_ring))
+        say(f"allreduce {count * 4}B: tree {t_tree:.3f}ms "
+            f"ring {t_ring:.3f}ms")
+    knobs = {"ring_min_bytes": fit_crossover(ring_pts)}
+
+    # ---- seg: ring segment size at the largest payload ------------------
+    big = max(sizes)
+    count = max(big // 4, n)
+    x = np.ones(count, np.float32)
+    runtime.set_tuning(ring_min_bytes=0)
+    seg_pts = []
+    for seg in seg_candidates:
+        runtime.set_tuning(seg_bytes=seg)
+        ms = arm(f"seg:{seg}", count * 4, "allreduce",
+                 lambda: runtime.host_allreduce(world, x, 0))
+        seg_pts.append((seg, ms))
+        say(f"seg {seg}B: {ms:.3f}ms")
+    knobs["seg_bytes"] = fit_seg(seg_pts)
+
+    # ---- hier: flat vs hierarchical per size (topology permitting) ------
+    topo = runtime.topology() or {}
+    if int(topo.get("n_hosts", 1)) > 1 and int(topo.get("local_size", 1)) > 1:
+        hier_pts = []
+        for size in sizes:
+            count = max(size // 4, n)
+            x = np.ones(count, np.float32)
+            run = lambda: runtime.host_allreduce(world, x, 0)  # noqa: E731
+            runtime.set_hier(mode="off")
+            t_flat = arm("flat", count * 4, "allreduce", run)
+            runtime.set_hier(mode="on")
+            t_hier = arm("hier", count * 4, "allreduce", run)
+            hier_pts.append((count * 4, t_flat, t_hier))
+            say(f"hier {count * 4}B: flat {t_flat:.3f}ms "
+                f"hier {t_hier:.3f}ms")
+        knobs["leader_ring_min_bytes"] = fit_crossover(hier_pts)
+        knobs["hier"] = "auto"
+        runtime.set_hier(mode="auto")
+
+    # ---- coalesce: fused vs unfused 4-part neighbour exchange -----------
+    if n > 1:
+        dest, source = (me + 1) % n, (me - 1) % n
+        co_pts = []
+        for total in coalesce_sizes:
+            # 4 float32 parts summing to ~total bytes
+            part = max(total // 16, 1)
+            parts = [np.full(part, float(i), np.float32)
+                     for i in range(4)]
+            tmpl = [np.empty(part, np.float32) for _ in range(4)]
+
+            def fused():
+                runtime.host_sendrecv_fused(
+                    world, parts, tmpl, source, dest, 31, 31
+                )
+
+            def unfused():
+                for p, t in zip(parts, tmpl):
+                    runtime.host_sendrecv(world, p, t, source, dest,
+                                          32, 32)
+
+            t_f = arm("fused", part * 16, "sendrecv", fused)
+            t_u = arm("unfused", part * 16, "sendrecv", unfused)
+            co_pts.append((part * 16, t_f, t_u))
+            say(f"coalesce {part * 16}B: fused {t_f:.3f}ms "
+                f"unfused {t_u:.3f}ms")
+        knobs["coalesce_bytes"] = fit_coalesce(co_pts)
+
+    if prev_mode == "off":
+        runtime.set_telemetry(mode="off")
+    say(f"fitted {knobs}")
+    return knobs, measurements

@@ -1,0 +1,145 @@
+"""Coalescing planner: find fusible runs of small same-peer messages
+in a recorded communication schedule.
+
+The PR-4 analyzer (mpi4jax_tpu/analysis/) records every public op's
+comm / pattern / shape before execution; this pass walks that schedule
+and reports maximal runs of consecutive small point-to-point ops that
+address the same peer on the same communicator — exactly the shapes
+the fused wire path (``sendrecv_multi`` / ``alltoall_multi``,
+docs/performance.md "small-message coalescing") collapses into one
+frame.  ``t4j-lint --coalesce`` prints the plan as an advisory so the
+feed-forward is visible: the ops layer applies the same
+``T4J_COALESCE_BYTES`` gate at run time.
+
+Events are duck-typed (``analysis.contracts.CommEvent`` or plain
+dicts with the same vocabulary) so the planner stays stdlib-only and
+loadable on old-jax containers.
+"""
+
+__all__ = ["message_bytes", "find_runs", "render_plan"]
+
+# dtype -> itemsize for the analyzer's string dtypes (the native
+# bridge's 15-entry table, dcn.h)
+_ITEMSIZE = {
+    "float32": 4, "float64": 8, "int8": 1, "int16": 2, "int32": 4,
+    "int64": 8, "uint8": 1, "uint16": 2, "uint32": 4, "uint64": 8,
+    "bool": 1, "complex64": 8, "complex128": 16, "float16": 2,
+    "bfloat16": 2,
+}
+
+# op kinds the fused p2p path can absorb (collectives other than
+# alltoall have their own wire schedules and are out of scope)
+_P2P_KINDS = ("send", "sendrecv", "sendrecv_multi")
+_A2A_KINDS = ("alltoall",)
+
+
+def _get(ev, name, default=None):
+    if isinstance(ev, dict):
+        return ev.get(name, default)
+    return getattr(ev, name, default)
+
+
+def message_bytes(ev):
+    """Payload bytes of a recorded op, or ``None`` when the record has
+    no shape/dtype (e.g. barrier)."""
+    shape = _get(ev, "shape") or ()
+    dtype = str(_get(ev, "dtype") or "")
+    if dtype not in _ITEMSIZE:
+        return None
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * _ITEMSIZE[dtype]
+
+
+def _peer_key(ev):
+    """Identity of the wire peer a p2p op addresses (dest spec as the
+    analyzer normalised it: int, pair tuple, or marker string)."""
+    dest = _get(ev, "dest")
+    if dest is None:
+        return None
+    return (str(_get(ev, "comm_key")), repr(dest), _get(ev, "tag"))
+
+
+def find_runs(events, threshold, min_run=2):
+    """Maximal runs of consecutive small same-peer p2p ops.
+
+    Returns a list of dicts: ``{"kind", "comm_key", "peer", "count",
+    "total_bytes", "first_seq", "last_seq", "anchors"}``.  A run is
+    reported when it has at least ``min_run`` members and its combined
+    payload is at or below ``threshold`` bytes (``threshold <= 0``
+    disables coalescing: no runs).  Consecutive small alltoalls on one
+    comm are reported as ``kind="alltoall"`` runs (the
+    ``alltoall_multi`` shape).
+    """
+    runs = []
+    if threshold is None or threshold <= 0:
+        return runs
+    cur = None
+
+    def flush():
+        nonlocal cur
+        if cur is not None and cur["count"] >= min_run:
+            runs.append(cur)
+        cur = None
+
+    for ev in events or ():
+        kind = str(_get(ev, "kind") or "")
+        nbytes = message_bytes(ev)
+        if kind in _P2P_KINDS:
+            key = ("p2p", _peer_key(ev))
+        elif kind in _A2A_KINDS:
+            key = ("alltoall", str(_get(ev, "comm_key")))
+        else:
+            flush()
+            continue
+        if nbytes is None or key[1] is None:
+            flush()
+            continue
+        if cur is not None and cur["_key"] == key and \
+                cur["total_bytes"] + nbytes <= threshold:
+            cur["count"] += 1
+            cur["total_bytes"] += nbytes
+            cur["last_seq"] = _get(ev, "seq")
+            anchor = _get(ev, "src_info")
+            if anchor and anchor not in cur["anchors"]:
+                cur["anchors"].append(anchor)
+            continue
+        flush()
+        if nbytes <= threshold:
+            cur = {
+                "_key": key,
+                "kind": "alltoall" if key[0] == "alltoall" else "p2p",
+                "comm_key": str(_get(ev, "comm_key")),
+                "peer": None if key[0] == "alltoall" else key[1][1],
+                "count": 1,
+                "total_bytes": nbytes,
+                "first_seq": _get(ev, "seq"),
+                "last_seq": _get(ev, "seq"),
+                "anchors": [a for a in [_get(ev, "src_info")] if a],
+            }
+    flush()
+    for r in runs:
+        r.pop("_key", None)
+    return runs
+
+
+def render_plan(runs, threshold):
+    """Human-readable advisory (one line per run)."""
+    if not runs:
+        return (f"no coalescable runs at T4J_COALESCE_BYTES="
+                f"{int(threshold)}")
+    lines = [
+        f"{len(runs)} coalescable run(s) at T4J_COALESCE_BYTES="
+        f"{int(threshold)}:"
+    ]
+    for r in runs:
+        where = f" ({r['anchors'][0]})" if r["anchors"] else ""
+        target = ("alltoall_multi" if r["kind"] == "alltoall"
+                  else "sendrecv_multi")
+        lines.append(
+            f"  steps {r['first_seq']}..{r['last_seq']}: {r['count']} "
+            f"{r['kind']} op(s), {r['total_bytes']} bytes total -> one "
+            f"fused frame via {target}{where}"
+        )
+    return "\n".join(lines)

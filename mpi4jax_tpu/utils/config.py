@@ -62,6 +62,24 @@ communicators with multiple ranks per host (docs/performance.md
                                   hierarchical path is taken (default
                                   256 KiB, the measured crossover).
 
+Trace-guided autotuning + small-message coalescing
+(docs/performance.md "trace-guided autotuning"):
+
+* ``T4J_COALESCE_BYTES`` — fuse runs of small same-peer messages into
+                           one wire frame when their combined payload
+                           is at or below this many bytes (default
+                           16 KiB; 0 disables fusion — the exact
+                           pre-coalescing wire behaviour).  The
+                           autotuner calibrates it.
+* ``T4J_TUNING_CACHE``   — directory of the fingerprint-keyed tuning
+                           cache (default ``~/.cache/mpi4jax_tpu``;
+                           ``off`` disables cache load AND store).
+* ``T4J_AUTOTUNE``       — truthy: calibrate the knob vector at init
+                           (collective, a few seconds) and persist it
+                           to the cache; the launcher's ``--autotune``
+                           sets it.  Explicit ``T4J_*`` knob env vars
+                           always win over calibrated/cached values.
+
 Async progress engine / gradient bucketing (docs/async.md):
 
 * ``T4J_BUCKET_BYTES`` — gradient-bucket size for ``BucketedGradSync``
@@ -119,6 +137,9 @@ __all__ = [
     "int_count",
     "ring_min_bytes",
     "seg_bytes",
+    "coalesce_bytes",
+    "tuning_cache_dir",
+    "autotune_enabled",
     "hier_mode",
     "leader_ring_min_bytes",
     "retry_max",
@@ -345,6 +366,39 @@ def seg_bytes():
             "T4J_SEG_BYTES must be >= 1 (a ring segment cannot be empty)"
         )
     return v
+
+
+def coalesce_bytes():
+    """Small-message coalescing threshold in bytes (docs/performance.md
+    "small-message coalescing"): runs of small same-peer messages whose
+    combined payload is at or below this travel as ONE fused wire
+    frame.  0 disables fusion entirely — the exact pre-coalescing wire
+    behaviour.  Must be uniform across ranks (both sides of a fused
+    exchange must agree to fuse); the autotuner calibrates it."""
+    return byte_count(
+        os.environ.get("T4J_COALESCE_BYTES"),
+        16 << 10,
+        name="T4J_COALESCE_BYTES",
+    )
+
+
+def tuning_cache_dir():
+    """Directory of the fingerprint-keyed on-disk tuning cache
+    (docs/performance.md "trace-guided autotuning"), or ``None`` when
+    disabled with ``T4J_TUNING_CACHE=off``.  Defaults to
+    ``~/.cache/mpi4jax_tpu``."""
+    v = str(os.environ.get("T4J_TUNING_CACHE") or "").strip()
+    if v.lower() == "off":
+        return None
+    if v:
+        return v
+    return os.path.join(os.path.expanduser("~"), ".cache", "mpi4jax_tpu")
+
+
+def autotune_enabled():
+    """Truthy ``T4J_AUTOTUNE``: run the collective knob calibration at
+    bridge init and persist the fit (the launcher's ``--autotune``)."""
+    return truthy(os.environ.get("T4J_AUTOTUNE"), default=False)
 
 
 def hier_mode():

@@ -1,0 +1,280 @@
+"""Fused-vs-unfused bit-identity for the small-message coalescing path
+(docs/performance.md "small-message coalescing").
+
+The fused wire path must be invisible except for speed: a halo
+exchange or MoE dispatch run with coalescing on (runs of small
+same-peer messages travel as ONE fused frame) must produce bytes
+identical to the per-part frames (``T4J_COALESCE_BYTES=0``, the exact
+pre-coalescing wire behaviour), across widths, non-divisible shapes,
+periodic and open boundaries, and — marker ``fault`` — across a flaky
+link that drops mid-fused-frame and self-heals through the PR-5 replay
+ring.
+"""
+
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401 -- probe only
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def _run(worker, nprocs, env_extra=None, timeout=300):
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(textwrap.dedent(worker))
+        path = f.name
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("T4J_COALESCE_BYTES", None)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["T4J_TUNING_CACHE"] = "off"  # knobs under explicit test control
+    env.update(env_extra or {})
+    popen = subprocess.Popen(
+        [
+            sys.executable, "-m", "mpi4jax_tpu.launch",
+            "-np", str(nprocs), path,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=str(REPO),
+        start_new_session=True,
+    )
+    try:
+        out, err = popen.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(popen.pid), signal.SIGKILL)
+        out, err = popen.communicate()
+        raise AssertionError(f"job timed out\n--- out:\n{out}\n--- err:\n{err}")
+    finally:
+        os.unlink(path)
+    assert popen.returncode == 0, (
+        f"job failed rc={popen.returncode}\n--- out:\n{out}\n--- err:\n{err}"
+    )
+    return out, err
+
+
+HALO_WORKER = """
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu import tuning
+from mpi4jax_tpu.parallel import grid_comm
+from mpi4jax_tpu.parallel.halo import halo_exchange_2d, halo_exchange_2d_batch
+
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+n, rank = comm.size, comm.rank()
+ny = 2 if n % 2 == 0 and n > 2 else 1
+g = grid_comm((ny, n // ny))
+rng = np.random.default_rng(123 + 17 * rank)
+
+
+def check(label, a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype and a.shape == b.shape, (label, a.shape)
+    assert a.tobytes() == b.tobytes(), (label,)
+
+
+# widths x odd (non-divisible) shapes x boundary conditions
+for w, ny_i, nx_i, periodic in [
+    (1, 10, 13, (True, True)),
+    (2, 7, 11, (False, True)),
+    (1, 5, 9, (False, False)),
+]:
+    fields = [
+        jnp.asarray(
+            rng.standard_normal((ny_i + 2 * w, nx_i + 2 * w))
+            .astype(np.float32)
+        )
+        for _ in range(3)
+    ]
+    tuning.override_coalesce(0)   # per-part frames (pre-coalescing wire)
+    off_b, _ = halo_exchange_2d_batch(fields, g, periodic=periodic, width=w)
+    off_b = [np.asarray(o) for o in off_b]
+    off_1, _ = halo_exchange_2d(fields[0], g, periodic=periodic, width=w)
+    off_1 = np.asarray(off_1)
+    tuning.override_coalesce(1 << 30)  # every run fuses
+    on_b, _ = halo_exchange_2d_batch(fields, g, periodic=periodic, width=w)
+    on_1, _ = halo_exchange_2d(fields[0], g, periodic=periodic, width=w)
+    tuning.override_coalesce(None)
+    for i, (a, b) in enumerate(zip(off_b, on_b)):
+        check(f"batch w={w} {periodic} field={i}", a, b)
+    check(f"single w={w} {periodic}", off_1, on_1)
+
+# mixed dtypes/shapes through sendrecv_multi directly
+parts = [
+    jnp.asarray(rng.standard_normal(5).astype(np.float32)),
+    jnp.asarray(rng.integers(0, 100, (3, 2)).astype(np.int64)),
+    jnp.asarray(rng.standard_normal(1).astype(np.float64)),
+]
+templates = [jnp.zeros_like(p) for p in parts]
+ring = [(r, (r + 1) % n) for r in range(n)]
+on, _ = m.sendrecv_multi(parts, templates, source=ring, dest=ring,
+                         comm=comm, coalesce=True)
+off, _ = m.sendrecv_multi(parts, templates, source=ring, dest=ring,
+                          comm=comm, coalesce=False)
+for i, (a, b) in enumerate(zip(on, off)):
+    check(f"sendrecv_multi part {i}", a, b)
+
+print(f"HALO-COALESCE-OK {rank}", flush=True)
+"""
+
+
+MOE_WORKER = """
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.parallel.moe import topk_moe
+
+comm = m.get_default_comm()
+n, rank = comm.size, comm.rank()
+rng = np.random.default_rng(7 + 3 * rank)
+
+for m_experts, t_loc, d, k in [(2, 16, 8, 2), (3, 12, 5, 1)]:
+    E = m_experts * n
+    x = jnp.asarray(rng.standard_normal((t_loc, d)).astype(np.float32))
+    scores = jax.nn.softmax(
+        jnp.asarray(rng.standard_normal((t_loc, E)).astype(np.float32)),
+        axis=-1,
+    )
+    scale = 1.0 + rank
+
+    def expert_fn(v):  # (m, n*cap, d): stacked local experts
+        return v * scale
+
+    y_on, _ = topk_moe(x, scores, expert_fn, comm, k=k, coalesce=True)
+    y_off, _ = topk_moe(x, scores, expert_fn, comm, k=k, coalesce=False)
+    a, b = np.asarray(y_on), np.asarray(y_off)
+    assert a.tobytes() == b.tobytes(), (m_experts, k)
+
+# alltoall_multi with ragged part shapes
+parts = [
+    jnp.asarray(rng.standard_normal((n, 4)).astype(np.float32)),
+    jnp.asarray(rng.standard_normal((n, 2, 3)).astype(np.float32)),
+    jnp.asarray(rng.integers(0, 9, (n, 1)).astype(np.int32)),
+]
+on, _ = m.alltoall_multi(parts, comm=comm, coalesce=True)
+off, _ = m.alltoall_multi(parts, comm=comm, coalesce=False)
+for i, (a, b) in enumerate(zip(on, off)):
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), i
+
+print(f"MOE-COALESCE-OK {rank}", flush=True)
+"""
+
+
+FAULT_WORKER = """
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu import tuning
+from mpi4jax_tpu.native import runtime
+from mpi4jax_tpu.parallel import grid_comm
+from mpi4jax_tpu.parallel.halo import halo_exchange_2d_batch
+
+comm = m.get_default_comm()
+n, rank = comm.size, comm.rank()
+g = grid_comm((2, n // 2))
+rng = np.random.default_rng(5 + rank)
+w = 1
+fields = [
+    jnp.asarray(rng.standard_normal((12, 12)).astype(np.float32))
+    for _ in range(3)
+]
+
+# reference result with coalescing OFF, before any fault arms (the
+# flaky plan counts sent frames, T4J_FAULT_AFTER leaves headroom)
+tuning.override_coalesce(0)
+ref, _ = halo_exchange_2d_batch(fields, g, periodic=(True, True), width=w)
+ref = [np.asarray(r) for r in ref]
+
+# fused exchanges, repeated so the configured drops land mid-stream:
+# every repetition must be bit-identical to the unfused reference
+tuning.override_coalesce(1 << 30)
+for rep in range(30):
+    outs, _ = halo_exchange_2d_batch(
+        fields, g, periodic=(True, True), width=w
+    )
+    for i, o in enumerate(outs):
+        assert np.asarray(o).tobytes() == ref[i].tobytes(), (rep, i)
+
+stats = runtime.link_stats()
+print(f"FAULT-COALESCE-OK {rank} reconnects={stats['reconnects']}",
+      flush=True)
+"""
+
+
+@pytest.mark.parametrize("nprocs", [2, 8])
+def test_halo_fused_vs_unfused_bit_identity(nprocs):
+    out, _err = _run(HALO_WORKER, nprocs)
+    for r in range(nprocs):
+        assert f"HALO-COALESCE-OK {r}" in out, out
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_topk_moe_dispatch_fused_bit_identity(nprocs):
+    out, _err = _run(MOE_WORKER, nprocs)
+    for r in range(nprocs):
+        assert f"MOE-COALESCE-OK {r}" in out, out
+
+
+def test_halo_fused_over_tcp_no_shm():
+    # same bit-identity with the shm pipes disabled: the fused frames
+    # ride the TCP links (the replay-ring transport)
+    out, _err = _run(HALO_WORKER, 4, env_extra={"T4J_NO_SHM": "1"})
+    for r in range(4):
+        assert f"HALO-COALESCE-OK {r}" in out, out
+
+
+@pytest.mark.fault
+def test_fused_frames_survive_flaky_link():
+    """A rank whose TCP connections drop mid-run (flaky fault mode)
+    must self-heal through the replay ring with fused frames in
+    flight: zero aborts, results bit-identical, reconnects counted."""
+    out, _err = _run(
+        FAULT_WORKER, 4,
+        env_extra={
+            "T4J_NO_SHM": "1",  # drops need real TCP links
+            "T4J_FAULT_MODE": "flaky",
+            "T4J_FAULT_RANK": "1",
+            "T4J_FAULT_AFTER": "60",
+            "T4J_FAULT_COUNT": "2",
+            "T4J_RETRY_MAX": "5",
+        },
+        timeout=420,
+    )
+    for r in range(4):
+        assert f"FAULT-COALESCE-OK {r}" in out, out
+    # the faulty rank's links actually dropped and reconnected
+    import re
+
+    counts = {
+        int(m.group(1)): int(m.group(2))
+        for m in re.finditer(r"FAULT-COALESCE-OK (\d+) reconnects=(\d+)",
+                             out)
+    }
+    assert counts[1] > 0, counts

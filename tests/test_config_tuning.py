@@ -187,6 +187,58 @@ class TestLeaderRingMinBytes:
             config.leader_ring_min_bytes()
 
 
+class TestCoalesceBytes:
+    def test_default_is_16k(self, monkeypatch):
+        monkeypatch.delenv("T4J_COALESCE_BYTES", raising=False)
+        assert config.coalesce_bytes() == 16 << 10
+
+    def test_env_value_with_suffix(self, monkeypatch):
+        monkeypatch.setenv("T4J_COALESCE_BYTES", "64K")
+        assert config.coalesce_bytes() == 64 << 10
+
+    def test_zero_disables_fusion(self, monkeypatch):
+        monkeypatch.setenv("T4J_COALESCE_BYTES", "0")
+        assert config.coalesce_bytes() == 0
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_COALESCE_BYTES", "small")
+        with pytest.raises(ValueError, match="T4J_COALESCE_BYTES"):
+            config.coalesce_bytes()
+
+
+class TestTuningCacheDir:
+    def test_default_under_home_cache(self, monkeypatch):
+        monkeypatch.delenv("T4J_TUNING_CACHE", raising=False)
+        assert config.tuning_cache_dir().endswith("mpi4jax_tpu")
+
+    def test_explicit_dir(self, monkeypatch):
+        monkeypatch.setenv("T4J_TUNING_CACHE", "/tmp/somewhere")
+        assert config.tuning_cache_dir() == "/tmp/somewhere"
+
+    @pytest.mark.parametrize("v", ["off", "OFF", " off "])
+    def test_off_disables(self, monkeypatch, v):
+        monkeypatch.setenv("T4J_TUNING_CACHE", v)
+        assert config.tuning_cache_dir() is None
+
+
+class TestAutotune:
+    def test_default_off(self, monkeypatch):
+        monkeypatch.delenv("T4J_AUTOTUNE", raising=False)
+        assert config.autotune_enabled() is False
+
+    @pytest.mark.parametrize("v,want", [
+        ("1", True), ("true", True), ("0", False), ("", False),
+    ])
+    def test_truthy(self, monkeypatch, v, want):
+        monkeypatch.setenv("T4J_AUTOTUNE", v)
+        assert config.autotune_enabled() is want
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_AUTOTUNE", "maybe")
+        with pytest.raises(ValueError):
+            config.autotune_enabled()
+
+
 class TestRetryMax:
     def test_default_is_3(self, monkeypatch):
         monkeypatch.delenv("T4J_RETRY_MAX", raising=False)

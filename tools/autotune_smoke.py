@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Autotune smoke lane: calibration, tuning cache, and fused wire
+frames end-to-end (docs/performance.md "trace-guided autotuning").
+
+Two phases over an N-rank (default 8) proc world driven through
+``native/runtime.py``'s ctypes surface plus the jax-free ``tuning``
+package (stub-loaded, so the lane runs on old-jax containers and under
+sanitizer preloads — the tools/telemetry_smoke.py harness shape):
+
+  1. calibrate — every rank runs ``tuning.startup`` with
+                 ``T4J_AUTOTUNE=1``: the collective calibration rounds
+                 (measured through the telemetry metrics table) fit the
+                 knob vector identically on every rank, rank 0 persists
+                 it to the fingerprint-keyed cache, and the fit is
+                 applied through set_tuning/set_hier/set_coalesce.
+  2. reload    — a fresh world on the same topology loads the cache at
+                 startup (per-knob provenance says "cache"), an
+                 explicitly set ``T4J_SEG_BYTES`` still wins ("env"),
+                 and the fused gather-send/scatter-recv path is
+                 bit-identical to per-part frames for a halo-shaped
+                 neighbour exchange and a multi-part alltoall.
+
+Run under AddressSanitizer by exporting ``T4J_SANITIZE=address`` before
+invoking (tools/ci_smoke.sh does).
+
+Usage: python tools/autotune_smoke.py [nprocs] [--phase calibrate|reload]
+"""
+
+import importlib
+import importlib.util
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import tempfile
+import types
+import uuid
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _stub_packages():
+    """Lightweight package stubs so the jax-free submodules (tuning/,
+    telemetry/, utils/config.py, native/runtime.py) import by their
+    real dotted names on containers where the package __init__ refuses
+    (old jax) — the tools/telemetry_smoke.py pattern."""
+    for name in ("mpi4jax_tpu", "mpi4jax_tpu.utils", "mpi4jax_tpu.native"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [str(REPO / name.replace(".", "/"))]
+            sys.modules[name] = mod
+
+
+def _load(name):
+    try:
+        return importlib.import_module(name)
+    except Exception:
+        _stub_packages()
+        return importlib.import_module(name)
+
+
+def _sanitizer_env():
+    san = os.environ.get("T4J_SANITIZE", "").strip().lower()
+    if not san:
+        return {}
+    lib = {"address": "libasan.so", "asan": "libasan.so",
+           "1": "libasan.so", "thread": "libtsan.so",
+           "tsan": "libtsan.so"}.get(san)
+    if lib is None:
+        return {}
+    paths = []
+    for name in (lib, "libstdc++.so.6"):
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={name}"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+        if out and out != name:
+            paths.append(out)
+    if not paths:
+        return {}
+    return {
+        "LD_PRELOAD": " ".join(paths),
+        "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
+        "TSAN_OPTIONS": "report_bugs=1",
+    }
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ worker
+
+
+def worker():
+    import numpy as np
+
+    runtime = _load("mpi4jax_tpu.native.runtime")
+    config = _load("mpi4jax_tpu.utils.config")
+    tuning = _load("mpi4jax_tpu.tuning")
+
+    rank = int(os.environ["T4J_RANK"])
+    n = int(os.environ["T4J_SIZE"])
+    phase = os.environ["SMOKE_PHASE"]
+
+    # smoke-sized calibration ladders: the lane checks the plumbing
+    # (uniform fit, cache round-trip, knob application), not the fit
+    # quality — the real ladders run via --autotune / --calibrate
+    tuning.calibrate.DEFAULT_SIZES = (16 << 10, 128 << 10)
+    tuning.calibrate.SEG_CANDIDATES = (32 << 10, 128 << 10)
+    tuning.calibrate.COALESCE_SIZES = (1 << 10, 16 << 10)
+
+    # the ensure_initialized sequence minus the jax-only FFI
+    # registration (this harness never compiles programs)
+    lib = runtime._load()
+    lib.t4j_set_timeouts(config.op_timeout(), config.connect_timeout())
+    lib.t4j_set_tuning(config.ring_min_bytes(), config.seg_bytes())
+    lib.t4j_set_coalesce(config.coalesce_bytes())
+    lib.t4j_set_hier(
+        runtime._HIER_MODES[config.hier_mode()],
+        config.leader_ring_min_bytes(),
+    )
+    rc = lib.t4j_init()
+    assert rc == 0, (rc, runtime.last_error())
+    eff = tuning.startup()
+    assert eff is not None
+
+    if phase == "calibrate":
+        assert eff["autotuned"], eff
+        # every knob must have reached the native layer identically
+        assert runtime.coalesce_bytes() == eff["knobs"]["coalesce_bytes"]
+        if rank == 0:
+            assert eff["cache_file"], eff
+            assert os.path.exists(eff["cache_file"]), eff["cache_file"]
+            obj = json.load(open(eff["cache_file"]))
+            assert obj["fingerprint"] == eff["fingerprint"]
+            assert obj["knobs"]["seg_bytes"] == eff["knobs"]["seg_bytes"]
+            assert obj["measurements"], "cache carries no evidence"
+        print(f"SMOKE-CAL-OK {rank} " + json.dumps(eff["knobs"]),
+              flush=True)
+    else:
+        assert not eff["autotuned"], eff
+        assert "cache" in set(eff["sources"].values()), eff["sources"]
+        assert eff["cache_file"], eff
+        if os.environ.get("T4J_SEG_BYTES"):
+            # explicit env beats the cached value
+            assert eff["sources"]["seg_bytes"] == "env", eff["sources"]
+            assert eff["knobs"]["seg_bytes"] == config.seg_bytes()
+
+        # fused halo-shaped neighbour exchange == per-part frames,
+        # bit for bit (three ragged parts, both ring directions)
+        rng = np.random.default_rng(3 + 7 * rank)
+        for disp in (1, n - 1):
+            dest, source = (rank + disp) % n, (rank - disp) % n
+            parts = [
+                rng.standard_normal(s).astype(np.float32)
+                for s in (7, 33, 1)
+            ]
+            tmpl = [np.empty_like(p) for p in parts]
+            fused, src, _tag = runtime.host_sendrecv_fused(
+                0, parts, tmpl, source, dest, 5, 5
+            )
+            assert int(src) == source, (src, source)
+            unfused = []
+            for p, t in zip(parts, tmpl):
+                o, _, _ = runtime.host_sendrecv(
+                    0, p, t, source, dest, 6, 6
+                )
+                unfused.append(o)
+            for i, (a, b) in enumerate(zip(fused, unfused)):
+                assert a.tobytes() == b.tobytes(), (disp, i)
+
+        # one-sided halves (a non-periodic halo edge): even ranks
+        # gather-send, odd ranks scatter-recv
+        if n % 2 == 0:
+            parts = [np.full(9, 1.5 + rank, np.float32)]
+            if rank % 2 == 0:
+                runtime.host_sendrecv_fused(
+                    0, parts, [], -1, rank + 1, 9, 9
+                )
+            else:
+                outs, src, _ = runtime.host_sendrecv_fused(
+                    0, [], [np.empty(9, np.float32)], rank - 1, -1, 9, 9
+                )
+                want = np.full(9, 1.5 + rank - 1, np.float32)
+                assert outs[0].tobytes() == want.tobytes()
+
+        # fused multi-part alltoall == per-part alltoalls
+        parts = [
+            rng.standard_normal((n, 4)).astype(np.float32),
+            rng.standard_normal((n, 2)).astype(np.float64),
+        ]
+        fused = runtime.host_alltoall_fused(0, parts)
+        for i, p in enumerate(parts):
+            ref = runtime.host_alltoall(0, p)
+            assert fused[i].tobytes() == ref.tobytes(), i
+        print(f"SMOKE-RELOAD-OK {rank}", flush=True)
+
+    lib.t4j_finalize()
+
+
+# ------------------------------------------------------------------ driver
+
+
+def run_phase(phase, n, cache_dir):
+    coord = f"127.0.0.1:{_free_port()}"
+    job = uuid.uuid4().hex[:8]
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.update(
+            T4J_RANK=str(r), T4J_SIZE=str(n), T4J_COORD=coord,
+            T4J_JOB=job, T4J_NO_SHM="1",
+            T4J_TUNING_CACHE=str(cache_dir),
+            SMOKE_PHASE=phase,
+        )
+        env.pop("T4J_AUTOTUNE", None)
+        env.pop("T4J_SEG_BYTES", None)
+        if phase == "calibrate":
+            env["T4J_AUTOTUNE"] = "1"
+        else:
+            env["T4J_SEG_BYTES"] = "262144"  # env must beat the cache
+        env.update(_sanitizer_env())
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__, "worker"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    ok = True
+    outs = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        if p.returncode != 0:
+            ok = False
+        print(f"--- [{phase}] rank {r} (rc={p.returncode}) ---")
+        print(out[-2000:])
+    if not ok:
+        return False
+    marker = ("SMOKE-CAL-OK" if phase == "calibrate"
+              else "SMOKE-RELOAD-OK")
+    if not all(marker in o for o in outs):
+        return False
+    if phase == "calibrate":
+        # the fitted knob vector must be IDENTICAL on every rank (a
+        # divergent fit would desynchronise the data plane)
+        vecs = {o.split(marker, 1)[1].split(None, 1)[1].strip()
+                for o in outs if marker in o}
+        if len(vecs) != 1:
+            print(f"FAIL: ranks fitted divergent knob vectors: {vecs}")
+            return False
+        files = list(pathlib.Path(cache_dir).glob("t4j-tuning-*.json"))
+        if len(files) != 1:
+            print(f"FAIL: expected one cache file, found {files}")
+            return False
+    return True
+
+
+def main():
+    argv = list(sys.argv[1:])
+    phases = ["calibrate", "reload"]
+    if "--phase" in argv:
+        i = argv.index("--phase")
+        phases = [argv[i + 1]]
+        del argv[i:i + 2]  # the value must not be parsed as nprocs
+    args = [a for a in argv if not a.startswith("--")]
+    n = int(args[0]) if args else 8
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="t4j_autotune_") as d:
+        for phase in phases:
+            ok = run_phase(phase, n, d) and ok
+    print("AUTOTUNE-SMOKE-OK" if ok else "AUTOTUNE-SMOKE-FAIL")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker()
+    else:
+        main()

@@ -65,8 +65,20 @@
 #                trajectory point every PR records.  The record must
 #                appear and be valid JSON even when the flagship or
 #                the native legs cannot run (explicit "skipped" keys).
+#  12. autotune — tools/autotune_smoke.py twice: plain and under
+#                AddressSanitizer.  An 8-rank calibrate phase (the
+#                collective knob fit measured through the telemetry
+#                metrics table must converge to ONE vector across
+#                ranks and persist to the fingerprint-keyed cache)
+#                followed by a reload phase (cache-loaded knobs with
+#                per-knob provenance, explicit T4J_SEG_BYTES beating
+#                the cache, and the fused gather-send/scatter-recv +
+#                fused-alltoall paths bit-identical to per-part
+#                frames; docs/performance.md "trace-guided
+#                autotuning").  ctypes + the jax-free tuning package
+#                only — runs on old-jax containers.
 #
-# Usage: tools/ci_smoke.sh [lane...]   (default: all eleven)
+# Usage: tools/ci_smoke.sh [lane...]   (default: all twelve)
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -74,7 +86,7 @@ cd "$(dirname "$0")/.."
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
   lanes=(tier1 fault proc asan tsan lint resilience telemetry async
-         diagnose bench)
+         diagnose bench autotune)
 fi
 
 run_lane() {
@@ -150,8 +162,14 @@ for lane in "${lanes[@]}"; do
         'import json; rec = json.load(open("BENCH_quick.json")); \
 assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
       ;;
+    autotune)
+      run_lane autotune-plain env -u T4J_SANITIZE timeout -k 10 900 \
+        python tools/autotune_smoke.py 8
+      run_lane autotune-asan env T4J_SANITIZE=address timeout -k 10 900 \
+        python tools/autotune_smoke.py 8
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|autotune)" >&2
       exit 2
       ;;
   esac
