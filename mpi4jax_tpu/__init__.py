@@ -75,6 +75,7 @@ from mpi4jax_tpu.ops import (
     wait,
     waitall,
 )
+from mpi4jax_tpu.native.runtime import WorldResized
 from mpi4jax_tpu.parallel import (
     Comm,
     MeshComm,
@@ -155,6 +156,7 @@ __all__ = [
     "SelfComm",
     "Status",
     "Token",
+    "WorldResized",
     "allgather",
     "allreduce",
     "alltoall",

@@ -207,6 +207,21 @@ def main(argv=None):
         "gauges — on PORT+nprocs",
     )
     parser.add_argument(
+        "--elastic",
+        choices=("shrink", "rejoin"),
+        default=None,
+        metavar="MODE",
+        help="elastic world membership (docs/failure-semantics.md "
+        "\"elastic membership\"): a dead rank no longer takes the job "
+        "down — survivors agree on a reduced world and continue "
+        "(shrink), and with MODE=rejoin the launcher relaunches ONLY "
+        "the dead slot (T4J_REJOIN=1) so the replacement re-bootstraps "
+        "into the mesh at the next epoch fence.  Sets T4J_ELASTIC for "
+        "every rank; T4J_MIN_WORLD floors the shrink.  Composes with "
+        "--restarts: the whole world restarts only when the job "
+        "actually failed (e.g. it fell below T4J_MIN_WORLD).",
+    )
+    parser.add_argument(
         "--autotune",
         action="store_true",
         help="calibrate the data-plane knob vector at init "
@@ -354,8 +369,7 @@ def _run_job(args):
     metrics_srv = None
     if args.metrics is not None:
         metrics_srv = _start_job_metrics(args.metrics, n, job)
-    procs = []
-    for rank in range(n):
+    def spawn(rank, rejoin=False):
         env = dict(os.environ)
         env.update(
             T4J_RANK=str(rank),
@@ -364,6 +378,12 @@ def _run_job(args):
             T4J_PLATFORM=args.platform,
             T4J_JOB=job,
         )
+        if args.elastic:
+            env["T4J_ELASTIC"] = args.elastic
+        if rejoin:
+            # replacement slot: re-bootstrap through rank 0's kept-open
+            # coordinator port instead of the full-world rendezvous
+            env["T4J_REJOIN"] = "1"
         if tel_dir:
             env["T4J_TELEMETRY_DIR"] = tel_dir
             # trace unless the caller already chose a mode (counters
@@ -390,11 +410,23 @@ def _run_job(args):
             "--child",
             *args.prog,
         ]
-        procs.append(subprocess.Popen(cmd, env=env))
+        return subprocess.Popen(cmd, env=env)
+
+    procs = [spawn(rank) for rank in range(n)]
 
     exit_code = 0
     start = time.monotonic()
     terminated_at = None  # first terminate time, for SIGKILL escalation
+    elastic = args.elastic
+    # membership bookkeeping for the elastic summary: the launcher's
+    # view of the epoch history (boot -> shrink -> rejoin -> ...),
+    # printed next to the children's link-stats dumps at job end
+    epoch_guess = 0
+    members = n
+    history = [f"boot({n})"]
+    exited_ok = set()
+    last_bad_rc = None
+    relaunches = 0
 
     try:
         remaining = set(range(n))
@@ -417,6 +449,48 @@ def _run_job(args):
                         target=lambda: _swallow(metrics_srv.collect),
                         daemon=True,
                     ).start()
+                if rc == 0:
+                    exited_ok.add(i)
+                    continue
+                if elastic and exit_code == 0 and terminated_at is None:
+                    # elastic membership: a dead rank is a shrink, not
+                    # the job's end — the survivors' native layer is
+                    # agreeing on the reduced world right now
+                    last_bad_rc = rc
+                    epoch_guess += 1
+                    members -= 1
+                    history.append(
+                        f"e{epoch_guess}:shrink({members}) "
+                        f"[rank {i} {_describe_exit(rc)} at "
+                        f"+{time.monotonic() - start:.1f}s]"
+                    )
+                    _say(
+                        f"rank {i} {_describe_exit(rc)} — elastic "
+                        f"{args.elastic}: {len(remaining)} rank(s) "
+                        "continue"
+                    )
+                    if tel_dir:
+                        _telemetry_failure_report(tel_dir, i)
+                    if (args.elastic == "rejoin" and i != 0
+                            and relaunches < n):
+                        # relaunch ONLY the dead slot; the replacement
+                        # re-bootstraps via the incarnation handshake
+                        # and joins at the next epoch fence.  (A dead
+                        # rank 0 cannot rejoin — it owns the
+                        # coordinator port — so its world stays
+                        # shrunk.)
+                        relaunches += 1
+                        epoch_guess += 1
+                        members += 1
+                        history.append(
+                            f"e{epoch_guess}:rejoin({members}) "
+                            f"[rank {i} relaunched]"
+                        )
+                        _say(f"relaunching rank {i} as a rejoin "
+                             f"replacement ({relaunches} so far)")
+                        procs[i] = spawn(i, rejoin=True)
+                        remaining.add(i)
+                    continue
                 if rc != 0 and exit_code == 0:
                     exit_code = _job_exit_code(rc)
                     # fail fast: take the rest of the job down, and say
@@ -457,6 +531,37 @@ def _run_job(args):
         for p in procs:
             p.send_signal(signal.SIGINT)
         exit_code = 130
+    if elastic and exit_code == 0:
+        # the job succeeded iff the final membership — every rank that
+        # was not declared dead — finished cleanly and stayed at or
+        # above the floor; below it, the nonzero code flows into
+        # --restarts' whole-world relaunch
+        try:
+            from mpi4jax_tpu.utils import config as _config
+
+            floor = _config.min_world()
+        except Exception:
+            # an unparsable floor already failed every child loudly at
+            # ensure_initialized; the summary check degrades quietly
+            floor = 1
+        if len(exited_ok) < max(floor, 1):
+            exit_code = _job_exit_code(last_bad_rc)
+            _say(
+                f"only {len(exited_ok)} rank(s) finished cleanly — "
+                f"below T4J_MIN_WORLD={floor}; the elastic world did "
+                "not survive"
+            )
+    if elastic and exit_code != 130:
+        # the membership/epoch history, next to the children's
+        # link-stats dumps: the post-mortem (or success report) shows
+        # how the world evolved, not just how it ended
+        final = sorted(exited_ok) if exited_ok else []
+        _say("world membership history: " + " -> ".join(history))
+        _say(
+            f"final world membership: {len(final)}/{n} rank(s) "
+            f"[{', '.join(str(r) for r in final)}] after "
+            f"{epoch_guess} membership epoch(s)"
+        )
     if metrics_srv is not None:
         # the workers have exited, so their endpoints are gone — a
         # fresh scrape can only come up empty; fall back to the

@@ -37,6 +37,7 @@ __all__ = [
     "make_global_train_step",
     "make_global_zero_train_step",
     "make_dp_train_step",
+    "run_elastic",
 ]
 
 
@@ -509,3 +510,185 @@ def make_global_zero_train_step(mesh, comm_dp, comm_tp, lr=1e-2, momentum=0.9):
         )
     )
     return step, init_opt_state
+
+
+# ------------------------------------------------- elastic training loop
+
+
+def _resize_interrupted(exc):
+    """True for an op failure caused by an elastic resize: the native
+    ResizeInterrupted status (an op drained mid-resize), or a
+    stale-communicator error from a CACHED jit executable — a rank
+    that sat in compute through the whole resize window sees the
+    latter, because check_health only runs at trace time and its
+    compiled step goes straight to the (invalidated) native handle."""
+    s = str(exc)
+    return "ResizeInterrupted" in s or "world resize" in s or \
+        "not a member of the current world" in s
+
+
+def run_elastic(nsteps, checkpoint_dir, *, d=32, layers=2, batch=4,
+                lr=1e-2, save_every=2, seed=0, dtype=jnp.float32,
+                log=print):
+    """Elastic data-parallel training loop (docs/failure-semantics.md
+    "elastic membership"): the job survives rank deaths under
+    ``T4J_ELASTIC=shrink`` and grows back under ``rejoin`` instead of
+    restarting from scratch.
+
+    The recovery contract this loop implements — the template for any
+    elastic trainer on this stack:
+
+    1. Every rank checkpoints its (replicated) state into its OWN
+       per-rank :class:`~mpi4jax_tpu.utils.checkpoint.Manager` series
+       every ``save_every`` steps.
+    2. At loop entry AND after every resize, the members agree on the
+       resume point with a MIN-allreduce of their latest durably saved
+       steps (ranks may have died between saves, and a rejoined
+       replacement inherits its predecessor's possibly-lagging
+       series) and everyone restores that step — the state
+       redistribution.
+    3. A mid-step membership change surfaces as an op failure carrying
+       the native ``ResizeInterrupted`` status (from a cached jit) or
+       as :class:`~mpi4jax_tpu.WorldResized` directly (from
+       ``check_health`` at the next op).  The loop waits the resize
+       out, calls :func:`runtime.refresh_after_resize` (drops stale
+       comm handles, re-resolves the tuning knobs for the NEW topology
+       fingerprint — collective, so every member calls it), rebuilds
+       the communicator and the jitted step over the surviving world,
+       and resumes at the agreed step.
+
+    Losses after a shrink are NOT bit-identical to the full-world run
+    (fewer micro-batches per global step; docs/sharp-bits.md).
+
+    Returns ``{"resizes", "final_world", "final_epoch", "last_step",
+    "losses"}``.
+    """
+    import numpy as np
+
+    from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.native.runtime import WorldResized
+    from mpi4jax_tpu.ops.allreduce import allreduce as _allreduce
+    from mpi4jax_tpu.ops import reductions as _red
+    from mpi4jax_tpu.parallel.proc import world_comm_if_initialized
+    from mpi4jax_tpu.utils import checkpoint
+
+    runtime.ensure_initialized()
+    comm = world_comm_if_initialized()
+    if comm is None:
+        raise RuntimeError(
+            "run_elastic needs a multi-process world "
+            "(python -m mpi4jax_tpu.launch -np N --elastic shrink ...)"
+        )
+    rank = runtime.world_rank()
+    mgr = checkpoint.Manager(f"{checkpoint_dir}/rank{rank}",
+                             max_to_keep=5)
+
+    def template():
+        return init_stack_params(jax.random.PRNGKey(seed), layers, d)
+
+    def build(c):
+        return jax.jit(make_dp_train_step(c, lr=lr, overlap=False))
+
+    def batch_for(i, c):
+        # deterministic per (member index, step): reproducible streams
+        # whose partition follows the membership
+        k = jax.random.fold_in(jax.random.PRNGKey(seed),
+                               1009 * i + c.rank())
+        x = jax.random.normal(k, (batch, d), dtype)
+        t = jax.random.normal(jax.random.fold_in(k, 1), (batch, d),
+                              dtype)
+        return x, t
+
+    def sync_start(c):
+        """Agree on the resume point: MIN over every member's latest
+        durably saved step (-1 = nothing saved)."""
+        mgr.wait_until_finished()
+        local = mgr.latest_step()
+        local = -1 if local is None else int(local)
+        agreed, _ = _allreduce(
+            jnp.asarray([local], jnp.int32), op=_red.MIN, comm=c,
+            token=create_token(),
+        )
+        agreed = int(np.asarray(agreed)[0])
+        if agreed < 0:
+            return template(), 0
+        return mgr.restore(agreed, like=template()), agreed + 1
+
+    # Every recovery action runs INSIDE the try via pending flags: the
+    # rendezvous and rebuild are themselves collectives, so a SECOND
+    # resize (e.g. the rejoin landing right after a shrink) can
+    # interrupt them too — the flags make each pass idempotent and the
+    # handler never does comm work where a raise would escape the loop.
+    step = build(comm)
+    resizes = 0
+    epoch = (runtime.world_info() or {}).get("epoch", 0)
+    losses = []
+    params = None
+    i = 0
+    pending_rebuild = False
+    pending_sync = True
+    while pending_rebuild or pending_sync or i < nsteps:
+        try:
+            if pending_rebuild:
+                # drop stale comm handles, re-resolve the tuning knobs
+                # for the NEW topology fingerprint (collective: the
+                # rejoiner pairs it with the resolution inside its own
+                # ensure_initialized), rebuild the comm and the step
+                runtime.refresh_after_resize()
+                comm = world_comm_if_initialized()
+                step = build(comm)
+                pending_rebuild = False
+                pending_sync = True
+            if pending_sync:
+                params, i = sync_start(comm)
+                pending_sync = False
+                continue
+            params, loss = step(params, batch_for(i, comm))
+            losses.append(float(loss))
+            if save_every and (i % save_every == 0 or i == nsteps - 1):
+                mgr.save(i, params)
+            i += 1
+        except WorldResized as w:
+            resizes += 1
+            epoch = w.epoch
+            pending_rebuild = True
+            log(
+                f"t4j elastic: world now {len(w.new_world)} member(s) "
+                f"at epoch {w.epoch} — re-resolving tuning and "
+                "resuming from the last agreed checkpoint",
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001 — only resize marks pass
+            if not _resize_interrupted(e):
+                raise
+            runtime.resize_wait()
+            info = runtime.world_info() or {}
+            if info.get("epoch", epoch) == epoch and not pending_rebuild:
+                # settled with no epoch change: either the runtime's
+                # own epoch tracking still owes us a WorldResized, or
+                # the resize escalated to a fault — surface whichever
+                try:
+                    runtime.check_health()
+                except WorldResized as w:
+                    resizes += 1
+                    epoch = w.epoch
+                    pending_rebuild = True
+                    continue
+                raise
+            resizes += 1
+            epoch = info.get("epoch", epoch)
+            pending_rebuild = True
+            log(
+                f"t4j elastic: step {i} interrupted by a resize "
+                f"(epoch {epoch}) — rebuilding",
+                flush=True,
+            )
+    mgr.close()
+    info = runtime.world_info() or {}
+    return {
+        "resizes": resizes,
+        "final_world": comm.size,
+        "final_epoch": int(info.get("epoch", epoch)),
+        "last_step": i - 1,
+        "losses": losses,
+    }

@@ -30,6 +30,12 @@ __all__ = [
     "coalesce_bytes",
     "set_hier",
     "set_resilience",
+    "set_elastic",
+    "world_info",
+    "alive_ranks",
+    "resize_wait",
+    "refresh_after_resize",
+    "WorldResized",
     "set_telemetry",
     "annotate_step",
     "telemetry_mode_name",
@@ -61,6 +67,38 @@ class BridgeError(RuntimeError):
     peer's abort broadcast).  The message carries rank/peer/op context
     from the native layer.  The bridge is faulted afterwards: every
     further proc-tier op raises until the job restarts."""
+
+
+class WorldResized(RuntimeError):
+    """The world membership changed under an elastic resize
+    (docs/failure-semantics.md "elastic membership").
+
+    Raised at the NEXT proc-tier op after a resize committed (and by
+    :func:`check_health` directly).  Unlike :class:`BridgeError` this
+    is recoverable: the transport is already rebuilt over the new
+    membership — user code must drop its pre-resize communicators,
+    rebuild them over ``new_world``, redistribute state (e.g. via
+    ``utils/checkpoint.py``), and continue.  ``models/train.py``'s
+    elastic loop does exactly that.
+
+    Attributes:
+        old_world: tuple of world ranks before the resize.
+        new_world: tuple of world ranks after it.
+        epoch: the committed world epoch (bumps by 1 per resize).
+    """
+
+    def __init__(self, old_world, new_world, epoch):
+        self.old_world = tuple(old_world)
+        self.new_world = tuple(new_world)
+        self.epoch = int(epoch)
+        joined = ",".join(str(r) for r in self.new_world)
+        super().__init__(
+            f"world resized at epoch {self.epoch}: "
+            f"{len(self.old_world)} -> {len(self.new_world)} member(s) "
+            f"(now [{joined}]) — rebuild communicators over the new "
+            "world and redistribute state "
+            "(docs/failure-semantics.md \"elastic membership\")"
+        )
 
 HANDLER_NAMES = [
     "t4j_allreduce",
@@ -126,6 +164,17 @@ def _load():
     lib.t4j_set_resilience.argtypes = [
         ctypes.c_int32, ctypes.c_double, ctypes.c_double, ctypes.c_int64,
     ]
+    lib.t4j_set_elastic.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_double,
+    ]
+    lib.t4j_world_info.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.t4j_world_info.restype = ctypes.c_int32
+    lib.t4j_resize_wait.argtypes = [ctypes.c_double]
+    lib.t4j_resize_wait.restype = ctypes.c_int32
     lib.t4j_link_stats.argtypes = [
         ctypes.c_int32,
         ctypes.POINTER(ctypes.c_uint64),
@@ -255,6 +304,11 @@ def check_health():
     lib = _state["lib"]
     if lib is None or not lib.t4j_initialized():
         return
+    # elastic membership first: a committed resize surfaces as the
+    # recoverable WorldResized (the transport is already rebuilt), not
+    # as a fault — and an in-flight resize is waited out so the caller
+    # sees the verdict
+    _check_world_epoch(lib)
     if lib.t4j_health():
         raw = lib.t4j_fault_msg()
         msg = raw.decode("utf-8", "replace") if raw else "bridge faulted"
@@ -365,6 +419,160 @@ def set_resilience(retry_max=None, backoff_base_s=None, backoff_max_s=None,
         -1.0 if backoff_max_s is None else float(backoff_max_s),
         -1 if replay_bytes is None else int(replay_bytes),
     )
+
+
+_ELASTIC_MODES = {"off": 0, "shrink": 1, "rejoin": 2}
+
+
+def set_elastic(mode=None, min_world=None, resize_timeout_s=None):
+    """Runtime override of the elastic-membership knobs
+    (docs/failure-semantics.md "elastic membership").
+
+    ``mode`` is ``"off"`` (a dead rank aborts the whole job, the
+    default), ``"shrink"`` (survivors agree on a reduced world and
+    continue) or ``"rejoin"`` (shrink, plus rank 0 keeps the bootstrap
+    coordinator port open for relaunched replacements); ``None`` keeps
+    the current setting.  Must be set before init and uniformly across
+    ranks (the launcher propagates ``T4J_ELASTIC`` / ``T4J_MIN_WORLD``
+    / ``T4J_RESIZE_TIMEOUT``)."""
+    lib = _load()
+    if mode is not None and str(mode) not in _ELASTIC_MODES:
+        raise ValueError(
+            f"cannot interpret elastic mode {mode!r} "
+            "(want off|shrink|rejoin)"
+        )
+    code = -1 if mode is None else _ELASTIC_MODES[str(mode)]
+    lib.t4j_set_elastic(
+        code,
+        0 if min_world is None else int(min_world),
+        -1.0 if resize_timeout_s is None else float(resize_timeout_s),
+    )
+
+
+def world_info():
+    """Live membership view, or ``None`` before init.
+
+    Returns ``{"epoch", "boot_size", "alive_count", "alive_mask",
+    "resizing", "stale_frames"}`` — ``epoch`` 0 is the bootstrap world
+    and bumps once per committed elastic resize; ``alive_mask`` bit r
+    means world rank r is a member; ``resizing`` is True while a
+    membership agreement/rebuild is in flight; ``stale_frames`` counts
+    frames dropped for carrying a pre-resize epoch (diagnostic)."""
+    lib = _state["lib"]
+    if lib is None or not lib.t4j_initialized():
+        return None
+    epoch = ctypes.c_uint32(0)
+    alive = ctypes.c_int32(0)
+    mask = ctypes.c_uint64(0)
+    resizing = ctypes.c_int32(0)
+    stale = ctypes.c_uint64(0)
+    if not lib.t4j_world_info(
+        ctypes.byref(epoch), ctypes.byref(alive), ctypes.byref(mask),
+        ctypes.byref(resizing), ctypes.byref(stale),
+    ):
+        return None
+    return {
+        "epoch": int(epoch.value),
+        "boot_size": int(lib.t4j_world_size()),
+        "alive_count": int(alive.value),
+        "alive_mask": int(mask.value),
+        "resizing": bool(resizing.value),
+        "stale_frames": int(stale.value),
+    }
+
+
+def _mask_ranks(mask, boot_size):
+    if boot_size > 64:
+        return tuple(range(boot_size))
+    return tuple(r for r in range(boot_size) if (mask >> r) & 1)
+
+
+def alive_ranks():
+    """The current members as a sorted tuple of world ranks (the full
+    bootstrap range before init or outside elastic jobs)."""
+    info = world_info()
+    if info is None:
+        return None
+    return _mask_ranks(info["alive_mask"], info["boot_size"])
+
+
+def effective_world_size():
+    """Current member count (= :func:`world_size` until a resize
+    shrinks the membership).  The tuning layer keys its topology
+    fingerprint off this, so a resize re-resolves the knobs."""
+    info = world_info()
+    if info is None:
+        return world_size()
+    return info["alive_count"]
+
+
+def resize_wait(timeout_s=None):
+    """Block until no elastic resize is in progress (True when
+    settled).  ``None`` uses twice the configured T4J_RESIZE_TIMEOUT
+    plus slack — a resize that cannot finish inside that posts a fault
+    anyway."""
+    lib = _state["lib"]
+    if lib is None or not lib.t4j_initialized():
+        return True
+    if timeout_s is None:
+        from mpi4jax_tpu.utils import config
+
+        timeout_s = 2 * config.resize_timeout() + 10.0
+    return bool(lib.t4j_resize_wait(float(timeout_s)))
+
+
+def _check_world_epoch(lib):
+    """Raise :class:`WorldResized` when the membership changed since
+    the last check (clearing the stale comm-handle cache first); wait
+    out an in-flight resize so the caller sees the verdict, not the
+    turbulence."""
+    info = world_info()
+    if info is None:
+        return
+    if info["resizing"]:
+        resize_wait()
+        info = world_info()
+        if info is None:
+            return
+    last = _state.get("world_view")
+    if last is None:
+        _state["world_view"] = info
+        return
+    if info["epoch"] != last["epoch"]:
+        _state["world_view"] = info
+        _state["comm_cache"].clear()  # pre-resize handles are stale
+        raise WorldResized(
+            _mask_ranks(last["alive_mask"], info["boot_size"]),
+            _mask_ranks(info["alive_mask"], info["boot_size"]),
+            info["epoch"],
+        )
+
+
+def refresh_after_resize(progress=None):
+    """Re-resolve the substrate for the resized world: drop the stale
+    comm-handle cache and re-run the tuning resolution against the NEW
+    topology fingerprint (docs/performance.md "trace-guided
+    autotuning").  COLLECTIVE — every surviving member must call it
+    (the elastic training loop does, right after catching
+    :class:`WorldResized`; a rejoined replacement runs the same
+    resolution inside its own ``ensure_initialized``)."""
+    _state["comm_cache"].clear()
+    try:
+        from mpi4jax_tpu import tuning
+
+        return tuning.startup(progress=progress)
+    except BridgeError:
+        raise
+    except Exception as e:  # noqa: BLE001 — cache trouble must not kill
+        import sys as _sys
+
+        print(
+            "t4j: tuning re-resolution after resize skipped: "
+            f"{type(e).__name__}: {e}",
+            file=_sys.stderr,
+            flush=True,
+        )
+        return None
 
 
 _TEL_MODES = {"off": 0, "counters": 1, "trace": 2}
@@ -1066,6 +1274,18 @@ def ensure_initialized():
     retry = config.retry_max()
     boff_base, boff_max = config.backoff_base(), config.backoff_max()
     replay = config.replay_bytes()
+    elastic = config.elastic_mode()
+    world_floor = config.min_world()
+    resize_s = config.resize_timeout()
+    if elastic != "off" and retry == 0:
+        raise ValueError(
+            "T4J_ELASTIC="
+            f"{elastic} requires T4J_RETRY_MAX > 0: the elastic rung "
+            "triggers when the self-healing ladder's escalation "
+            "declares a rank unrecoverable, and T4J_RETRY_MAX=0 "
+            "disables that ladder entirely "
+            "(docs/failure-semantics.md \"elastic membership\")"
+        )
     tel_mode, tel_bytes = config.telemetry_mode(), config.telemetry_bytes()
     tel_dir = config.telemetry_dir()
     lib = _load()
@@ -1074,6 +1294,7 @@ def ensure_initialized():
     lib.t4j_set_coalesce(coalesce)
     lib.t4j_set_hier(_HIER_MODES[hier], hier_min)
     lib.t4j_set_resilience(retry, boff_base, boff_max, replay)
+    lib.t4j_set_elastic(_ELASTIC_MODES[elastic], world_floor, resize_s)
     lib.t4j_set_telemetry(_TEL_MODES[tel_mode], tel_bytes)
     rc = lib.t4j_init()
     if rc != 0:
@@ -1084,6 +1305,9 @@ def ensure_initialized():
             else "native bridge init failed (check T4J_* env)"
         )
     _register_ffi_targets(lib)
+    # membership baseline: a rejoined replacement starts at the
+    # survivors' current epoch without a spurious WorldResized
+    _state["world_view"] = world_info()
     # trace-guided tuning (docs/performance.md "trace-guided
     # autotuning"): load the fingerprint-keyed cache and thread it
     # through the same set_tuning/set_hier/set_coalesce plumbing;

@@ -310,6 +310,109 @@ long long replay_bytes() {
 
 bool resilience_on() { return retry_max() > 0 && g_size > 1; }
 
+// --------------------------------------------- elastic membership knobs
+//
+// T4J_ELASTIC=off|shrink|rejoin (docs/failure-semantics.md "elastic
+// membership"): what happens when a rank is declared unrecoverable.
+// off (the default) keeps today's exact abort path; shrink lets the
+// survivors agree on a reduced world and continue; rejoin additionally
+// keeps the bootstrap coordinator port open so a relaunched
+// replacement can re-bootstrap into the mesh at the next epoch fence.
+// T4J_MIN_WORLD floors the shrink (below it the legacy abort fires);
+// T4J_RESIZE_TIMEOUT bounds each agreement/rebuild phase.  -1 = "not
+// set yet"; Python validates via utils/config.py and calls
+// set_elastic before init, the env parse is the fallback for hand-run
+// processes.
+
+constexpr int kElasticOff = 0, kElasticShrink = 1, kElasticRejoin = 2;
+
+std::atomic<int> g_elastic_mode{-1};
+std::atomic<int> g_min_world{-1};
+std::atomic<double> g_resize_timeout_s{-1.0};
+
+int elastic_mode() {
+  int v = g_elastic_mode.load(std::memory_order_relaxed);
+  if (v < 0) {
+    const char* s = std::getenv("T4J_ELASTIC");
+    v = kElasticOff;
+    if (s && s[0]) {
+      if (!std::strcmp(s, "shrink")) v = kElasticShrink;
+      else if (!std::strcmp(s, "rejoin")) v = kElasticRejoin;
+      // anything else keeps off; utils/config.py rejects loudly
+    }
+    g_elastic_mode.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+int min_world() {
+  int v = g_min_world.load(std::memory_order_relaxed);
+  if (v < 1) {
+    v = static_cast<int>(env_int("T4J_MIN_WORLD", 1));
+    if (v < 1) v = 1;
+    g_min_world.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+double resize_timeout() {
+  double v = g_resize_timeout_s.load(std::memory_order_relaxed);
+  if (v <= 0) {
+    v = env_seconds("T4J_RESIZE_TIMEOUT", 30.0);
+    if (v <= 0) v = 30.0;
+    g_resize_timeout_s.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+// -------------------------------------------- elastic membership state
+//
+// The world's rank-id space stays the BOOTSTRAP space for the whole
+// job (g_rank/g_size never change; g_peers/g_endpoints keep their
+// indexing).  Membership is the alive mask: a resize flips bits off
+// (shrink) or back on (rejoin) and bumps the world epoch.  The epoch
+// is stamped into every wire frame so traffic from a previous
+// membership can never be delivered into the resized world.
+
+std::atomic<uint32_t> g_world_epoch{0};
+std::atomic<uint64_t> g_alive_mask{0};
+std::atomic<bool> g_resizing{false};
+// wire context of the (rebuilt) world communicator: 0 at bootstrap, a
+// per-epoch derived id after a resize so old-world collective frames
+// can never match new-world receives even before the epoch check
+int g_world_ctx = 0;
+
+int popcount64(uint64_t v) {
+  int n = 0;
+  while (v) {
+    v &= v - 1;
+    ++n;
+  }
+  return n;
+}
+
+int alive_count() {
+  if (g_size > 64) return g_size;  // elastic disabled: nobody leaves
+  return popcount64(g_alive_mask.load(std::memory_order_relaxed));
+}
+
+bool rank_alive(int r) {
+  if (r < 0 || r >= g_size) return false;
+  if (r >= 64) return true;  // beyond the mask, elastic is disabled
+  return (g_alive_mask.load(std::memory_order_relaxed) >> r) & 1;
+}
+
+// Elastic escalation is reachable at all only when the self-healing
+// layer is on (escalation IS its last rung) and the membership fits
+// the u64 agreement mask.
+bool elastic_usable() {
+  return elastic_mode() != kElasticOff && g_size > 1 && g_size <= 64 &&
+         resilience_on() &&
+         !g_shutting_down.load(std::memory_order_acquire) &&
+         !g_finalizing.load(std::memory_order_acquire) &&
+         !g_faulted.load(std::memory_order_acquire);
+}
+
 // Exponential backoff with +/-25% jitter for reconnect attempt
 // `attempt` (0-based), capped at T4J_BACKOFF_MAX.  Jitter keeps the
 // two ends of a broken link (and many links broken by one NIC blip)
@@ -498,9 +601,26 @@ std::string posted_fault_msg() {
   return g_fault_msg;
 }
 
-// The bridge stopped under us (fault posted elsewhere, or finalize):
-// throw the recorded context so Python sees WHY, not just "stuck".
+// Contextual message for an op interrupted by an elastic resize: the
+// marker string "ResizeInterrupted" is the contract the Python tier
+// (native/runtime.py) keys on to convert the failure into
+// WorldResized instead of a fatal BridgeError.
+std::string resize_interrupted_msg() {
+  return err_prefix() + std::string(cur_op()) +
+         ": interrupted by elastic world resize (epoch " +
+         std::to_string(g_world_epoch.load(std::memory_order_relaxed) + 1) +
+         " forming) — ResizeInterrupted: the op did not complete and "
+         "must be reissued on the resized world "
+         "(docs/failure-semantics.md \"elastic membership\")";
+}
+
+// The bridge stopped under us (fault posted elsewhere, a resize in
+// progress, or finalize): throw the recorded context so Python sees
+// WHY, not just "stuck".
 [[noreturn]] void raise_stopped() {
+  if (g_resizing.load(std::memory_order_acquire) &&
+      !g_faulted.load(std::memory_order_acquire))
+    throw BridgeError(resize_interrupted_msg());
   std::string m = posted_fault_msg();
   if (m.empty())
     m = err_prefix() + std::string(cur_op()) +
@@ -550,7 +670,7 @@ struct Frame {
   Buf data;
 };
 
-constexpr uint32_t kMagic = 0x7446a002;  // bumped: header gained seq
+constexpr uint32_t kMagic = 0x7446a003;  // bumped: header gained epoch
 
 struct WireHeader {
   uint32_t magic;
@@ -563,8 +683,23 @@ struct WireHeader {
   // seq <= last-delivered, which is what makes the reconnect replay
   // idempotent (docs/failure-semantics.md "self-healing transport").
   uint64_t seq;
+  // World epoch the frame was built in (docs/failure-semantics.md
+  // "elastic membership"): receivers drop data frames whose epoch is
+  // not the current one, so traffic interrupted by a resize can never
+  // be delivered into the resized world.  Abort control frames pass
+  // regardless (a rank aborting mid-resize must still be heard).
+  uint32_t epoch;
+  uint32_t pad;
 };
-static_assert(sizeof(WireHeader) == 32, "wire header layout");
+static_assert(sizeof(WireHeader) == 40, "wire header layout");
+
+uint32_t cur_epoch() {
+  return g_world_epoch.load(std::memory_order_relaxed);
+}
+
+// Frames a resize dropped for carrying a stale world epoch (pure
+// diagnostic; the drop itself is the correctness mechanism).
+std::atomic<uint64_t> g_stale_frames{0};
 
 // Reserved wire ctx for abort control frames.  Real channels are
 // enc_ctx(ctx30bit) <= 2^31, so this value can never collide.
@@ -594,6 +729,39 @@ struct ReconReply {
   uint64_t last_recv_seq;
 };
 static_assert(sizeof(ReconReply) == 32, "recon reply layout");
+
+// Elastic-membership control messages (docs/failure-semantics.md
+// "elastic membership"): out-of-band 32-byte frames on FRESH dials to
+// a peer's mesh listener (or, for kRejoinHello, to rank 0's kept-open
+// bootstrap coordinator port), so the agreement never depends on the
+// possibly-torn data-plane byte streams.  Same first-4-bytes-magic
+// discipline as ReconHello — the reconnect acceptor branches on it.
+constexpr uint32_t kResizeMagic = 0x7446d001;
+
+enum ResizeMsgType : uint32_t {
+  kResizeReport = 1,   // mask = sender's suspected-dead set
+  kResizeVerdict = 2,  // mask = final ALIVE set (0 = abort the job)
+  kResizeDial = 3,     // link-rebuild handshake at `epoch`
+  kResizeAck = 4,      // dial reply; mask = 1 accept, 0 reject
+  kRejoinHello = 5,    // replacement process -> coordinator; +PeerAddr
+  kResizeGrow = 6,     // verdict adding `rank` back; +PeerAddr payload
+};
+
+struct ResizeMsg {
+  uint32_t magic;
+  uint32_t type;   // ResizeMsgType
+  uint32_t rank;   // sender's world rank (kResizeGrow: the rejoiner)
+  uint32_t epoch;  // epoch the message proposes / targets
+  uint64_t mask;   // see ResizeMsgType
+  uint64_t token;  // sender's bootstrap incarnation token
+};
+static_assert(sizeof(ResizeMsg) == 32, "resize msg layout");
+
+// Defined with the resize engine (end of this namespace): the
+// reconnect acceptor and the link-escalation path call into them.
+bool try_begin_resize(int peer, const std::string& why);
+void enter_resize(uint64_t dead_delta, const std::string& why);
+void handle_resize_msg(int fd, const ResizeMsg& m);
 
 // A sent frame retained for replay-after-reconnect: the payload lives
 // at `off` inside the link's circular replay arena (never split across
@@ -674,6 +842,12 @@ struct PeerEndpoint {
 std::vector<PeerEndpoint>& g_endpoints = *new std::vector<PeerEndpoint>;
 uint64_t g_my_boot_token = 0;
 int g_listen_fd = -1;  // mesh listener, kept open for reconnects
+// Bootstrap coordinator listener: rank 0 keeps it open for the job's
+// lifetime when T4J_ELASTIC=rejoin, so a relaunched replacement
+// process can re-bootstrap into the mesh (docs/failure-semantics.md
+// "elastic membership").  -1 everywhere else.
+int g_coord_listen_fd = -1;
+void coord_accept_loop();  // defined with the resize engine
 
 // Reader threads are joined in finalize(); detach-on-destruction for
 // the same abnormal-exit reason as PeerLink::reader.
@@ -889,10 +1063,15 @@ void set_nonblock(int fd) {
   if (fl >= 0) ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
 }
 
-// 1 = ready, 0 = deadline expired, -1 = bridge stopped under us
-int io_wait(int fd, short events, const Deadline& dl) {
+// 1 = ready, 0 = deadline expired, -1 = bridge stopped under us.
+// ignore_stop: the elastic-resize control plane runs WHILE the bridge
+// is soft-stopped (g_stop is exactly what interrupts the data plane
+// during a resize), so its I/O opts out of the stop check — the
+// deadline still bounds it.
+int io_wait(int fd, short events, const Deadline& dl,
+            bool ignore_stop = false) {
   for (;;) {
-    if (g_stop.load(std::memory_order_acquire)) return -1;
+    if (!ignore_stop && g_stop.load(std::memory_order_acquire)) return -1;
     int tick = dl.remaining_ms(100);
     if (dl.bounded && tick == 0) return 0;
     pollfd pfd{fd, events, 0};
@@ -902,7 +1081,8 @@ int io_wait(int fd, short events, const Deadline& dl) {
   }
 }
 
-IoStatus nb_read_all(int fd, void* buf, size_t n, const Deadline& dl) {
+IoStatus nb_read_all(int fd, void* buf, size_t n, const Deadline& dl,
+                     bool ignore_stop = false) {
   char* p = static_cast<char*>(buf);
   while (n > 0) {
     ssize_t r = ::read(fd, p, n);
@@ -914,7 +1094,7 @@ IoStatus nb_read_all(int fd, void* buf, size_t n, const Deadline& dl) {
     if (r == 0) return IoStatus::kEof;
     if (errno == EINTR) continue;
     if (errno == EAGAIN || errno == EWOULDBLOCK) {
-      int w = io_wait(fd, POLLIN, dl);
+      int w = io_wait(fd, POLLIN, dl, ignore_stop);
       if (w == 1) continue;
       return w == 0 ? IoStatus::kTimeout : IoStatus::kStopped;
     }
@@ -925,7 +1105,8 @@ IoStatus nb_read_all(int fd, void* buf, size_t n, const Deadline& dl) {
 
 // Gathered write via sendmsg(MSG_NOSIGNAL): a dead peer surfaces as
 // EPIPE (-> contextual error) instead of a process-killing SIGPIPE.
-IoStatus nb_write_all(int fd, iovec* iov, int iovcnt, const Deadline& dl) {
+IoStatus nb_write_all(int fd, iovec* iov, int iovcnt, const Deadline& dl,
+                      bool ignore_stop = false) {
   msghdr mh{};
   while (iovcnt > 0) {
     mh.msg_iov = iov;
@@ -934,7 +1115,7 @@ IoStatus nb_write_all(int fd, iovec* iov, int iovcnt, const Deadline& dl) {
     if (w < 0) {
       if (errno == EINTR) continue;
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
-        int rc = io_wait(fd, POLLOUT, dl);
+        int rc = io_wait(fd, POLLOUT, dl, ignore_stop);
         if (rc == 1) continue;
         return rc == 0 ? IoStatus::kTimeout : IoStatus::kStopped;
       }
@@ -966,7 +1147,7 @@ void broadcast_abort(const std::string& why) {
   if (!g_initialized || g_abort_sent.exchange(true)) return;
   std::string msg = why.size() > 512 ? why.substr(0, 512) : why;
   WireHeader h{kMagic, static_cast<uint32_t>(g_rank), kAbortCtx, 1,
-               static_cast<uint64_t>(msg.size()), 0};
+               static_cast<uint64_t>(msg.size()), 0, cur_epoch(), 0};
   Deadline dl = Deadline::after(1.0);  // do not let goodbye block us
   for (int peer = 0; peer < static_cast<int>(g_peers.size()); ++peer) {
     if (peer == g_rank) continue;
@@ -1078,6 +1259,15 @@ void reader_loop(int peer, int fd) {
                    "-byte body pending)");
         return;
       }
+    }
+    if (h.epoch != cur_epoch()) {
+      // stale-epoch traffic (a frame built before a world resize):
+      // the op it belonged to was already interrupted with
+      // ResizeInterrupted, so delivering it into the resized world
+      // would corrupt matching.  Post-resize links are fresh
+      // connections, so this is belt-and-braces, not the mechanism.
+      g_stale_frames.fetch_add(1, std::memory_order_relaxed);
+      continue;
     }
     if (h.seq) {
       // sequenced TCP frame: drop reconnect-replay duplicates, and
@@ -1245,7 +1435,7 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
   maybe_inject_send_fault();
   WireHeader h{kMagic, static_cast<uint32_t>(g_rank),
                static_cast<uint32_t>(ctx), static_cast<uint32_t>(tag + 1),
-               static_cast<uint64_t>(nbytes), 0};
+               static_cast<uint64_t>(nbytes), 0, cur_epoch(), 0};
   if (world_dest < static_cast<int>(g_tx_pipes.size()) &&
       g_tx_pipes[world_dest]) {
     shm::Pipe* pipe = g_tx_pipes[world_dest];
@@ -1463,7 +1653,8 @@ int tcp_accept(int listen_fd, const Deadline& dl, const std::string& who) {
 // own the retry policy.  `dl` bounds the in-progress wait; *stopped is
 // set when the bridge stopped mid-wait.
 int dial_once(const std::string& host, uint16_t port, const Deadline& dl,
-              std::string* why, bool* stopped = nullptr) {
+              std::string* why, bool* stopped = nullptr,
+              bool ignore_stop = false) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) {
     *why = std::string("socket: ") + std::strerror(errno);
@@ -1481,7 +1672,7 @@ int dial_once(const std::string& host, uint16_t port, const Deadline& dl,
   }
   int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
   if (rc != 0 && errno == EINPROGRESS) {
-    int w = io_wait(fd, POLLOUT, dl);
+    int w = io_wait(fd, POLLOUT, dl, ignore_stop);
     if (w == 1) {
       int soerr = 0;
       socklen_t slen = sizeof(soerr);
@@ -1569,13 +1760,27 @@ int tcp_connect(const std::string& host, uint16_t port,
 // when it wakes, not an empty "bridge already shut down".
 void escalate_link(int peer, const std::string& why) {
   tel::control_event(tel::kLinkDead, peer, 0);
+  // Elastic membership (docs/failure-semantics.md "elastic
+  // membership"): an unrecoverable LINK to a peer is the signal that
+  // the RANK is gone — with T4J_ELASTIC=shrink|rejoin the survivors
+  // agree on a reduced world instead of aborting the whole job.  off
+  // keeps the exact abort path below, byte for byte.
+  std::string extra;
+  if (elastic_usable()) {
+    if (try_begin_resize(peer, why)) return;
+    // the shrink was refused (world would fall below the floor, or
+    // the peer is already accounted dead by an active resize): name
+    // the reason next to the legacy escalation
+    extra = " (T4J_ELASTIC: surviving world would fall below "
+            "T4J_MIN_WORLD=" + std::to_string(min_world()) + ")";
+  }
   PeerLink& p = g_peers[peer];
   if (!g_shutting_down.load() &&
       !g_stop.load(std::memory_order_acquire) &&
       !g_finalizing.load(std::memory_order_acquire)) {
     std::string msg = err_prefix() + "link to peer r" +
                       std::to_string(peer) + " could not be repaired (" +
-                      why + ") — escalating to abort";
+                      why + ") — escalating to abort" + extra;
     broadcast_abort(msg);
     post_fault(msg);
   }
@@ -1735,6 +1940,14 @@ void dial_repair(int peer) {
 void watchdog_repair(int peer) {
   PeerLink& p = g_peers[peer];
   Deadline dl = Deadline::after(repair_budget_s());
+  // Elastic mode probes the peer's mesh listener while waiting: the
+  // listener is open for the peer PROCESS's whole lifetime, so a
+  // refused dial means the process is gone and the resize can start
+  // now instead of after the full repair budget (which is sized for a
+  // live-but-redialing peer).  Off-mode behaviour is untouched — the
+  // probe only runs when an escalation could go elastic.
+  Deadline next_probe = Deadline::after(0.5);
+  int refused = 0;
   std::unique_lock<std::mutex> lk(p.mu);
   while (p.state == PeerLink::kBroken) {
     if (g_stop.load(std::memory_order_acquire)) return;
@@ -1745,6 +1958,26 @@ void watchdog_repair(int peer) {
                     "budget — peer dead or unreachable");
       return;
     }
+    if (elastic_mode() != kElasticOff && next_probe.expired()) {
+      lk.unlock();
+      std::string why;
+      int fd = dial_once(g_endpoints[peer].host, g_endpoints[peer].port,
+                         Deadline::after(1.0), &why);
+      if (fd >= 0) {
+        ::close(fd);
+        refused = 0;  // listener up: the peer lives, keep waiting
+      } else if (why == std::strerror(ECONNREFUSED)) {
+        if (++refused >= 3) {
+          escalate_link(peer,
+                        "peer's mesh listener refuses connections — "
+                        "process dead");
+          return;
+        }
+      }
+      next_probe = Deadline::after(0.5);
+      lk.lock();
+      continue;
+    }
     p.cv.wait_for(lk, std::chrono::milliseconds(100));
   }
 }
@@ -1752,6 +1985,15 @@ void watchdog_repair(int peer) {
 void mark_broken(int peer, const std::string& why) {
   if (peer < 0 || peer >= g_size || peer == g_rank) return;
   PeerLink& p = g_peers[peer];
+  if (g_resizing.load(std::memory_order_acquire)) {
+    // an elastic resize owns every link right now: the rebuild
+    // replaces them wholesale, so per-link repair cycles would only
+    // race it (and noisily re-establish old-epoch connections)
+    std::lock_guard<std::mutex> lk(p.mu);
+    if (p.state == PeerLink::kUp) p.state = PeerLink::kBroken;
+    p.cv.notify_all();
+    return;
+  }
   bool spawn = false;
   {
     std::lock_guard<std::mutex> lk(p.mu);
@@ -1795,8 +2037,23 @@ void mark_broken(int peer, const std::string& why) {
 void handle_reconnect(int fd) {
   Deadline dl = Deadline::after(connect_timeout());
   ReconHello hello{};
-  if (nb_read_all(fd, &hello, sizeof(hello), dl) != IoStatus::kOk ||
-      hello.magic != kReconMagic) {
+  // ignore_stop: during an elastic resize g_stop is set, but THIS
+  // listener carries the membership agreement — the read must
+  // proceed (the deadline still bounds it)
+  if (nb_read_all(fd, &hello, sizeof(hello), dl,
+                  /*ignore_stop=*/true) != IoStatus::kOk) {
+    ::close(fd);
+    return;
+  }
+  if (hello.magic == kResizeMagic) {
+    // elastic-membership control dial (same 32-byte first read as the
+    // reconnect hello; the magic disambiguates)
+    ResizeMsg m{};
+    std::memcpy(&m, &hello, sizeof(m));
+    handle_resize_msg(fd, m);
+    return;
+  }
+  if (hello.magic != kReconMagic) {
     ::close(fd);  // not a reconnect dial: stray/garbled connection
     return;
   }
@@ -1873,7 +2130,13 @@ void handle_reconnect(int fd) {
 // Reconnect acceptor: owns the mesh listener after bootstrap and
 // hands each dial to its own handler thread.
 void accept_loop() {
-  while (!g_stop.load(std::memory_order_acquire)) {
+  // g_stop alone must not end the acceptor: an elastic resize sets it
+  // while the membership agreement is still flowing through THIS
+  // listener.  The acceptor ends on teardown, or on a terminal stop
+  // (fault/finalize) with no resize in progress.
+  while (!g_shutting_down.load(std::memory_order_acquire) &&
+         (!g_stop.load(std::memory_order_acquire) ||
+          g_resizing.load(std::memory_order_acquire))) {
     pollfd pfd{g_listen_fd, POLLIN, 0};
     int rc = ::poll(&pfd, 1, 100);
     if (rc <= 0) continue;
@@ -1973,6 +2236,11 @@ void pipe_reader_loop(int peer, shm::Pipe* pipe) {
     if (h.nbytes &&
         !shm::pipe_read(pipe, f.data.data(), h.nbytes, g_stop))
       return;
+    if (h.epoch != cur_epoch()) {
+      // stale-epoch pipe frame (see reader_loop): drop, never deliver
+      g_stale_frames.fetch_add(1, std::memory_order_relaxed);
+      continue;
+    }
     {
       std::lock_guard<std::mutex> lk(g_mail_mu);
       g_mailbox.push_back(std::move(f));
@@ -2006,12 +2274,22 @@ void setup_pipes() {
     g_tx_pipes.assign(g_size, nullptr);
   }
   if (g_size < 2 || static_cast<int>(g_host_fps.size()) != g_size) return;
-  std::vector<int> local;  // same-host world ranks, ascending (incl. me)
+  // the pipe segment namespace carries the world epoch: a resize
+  // rebuilds the same-host transport from scratch over the SURVIVING
+  // members, and epoch-suffixed names can never collide with the old
+  // world's (already-unlinked) segments
+  std::string pipe_job = g_job;
+  if (cur_epoch() != 0)
+    pipe_job += "_e" + std::to_string(cur_epoch());
+  std::vector<int> local;  // same-host ALIVE world ranks, ascending
   for (int r = 0; r < g_size; ++r)
-    if (g_host_fps[r] == g_host_fps[g_rank]) local.push_back(r);
+    if (rank_alive(r) && g_host_fps[r] == g_host_fps[g_rank])
+      local.push_back(r);
   if (local.size() < 2) return;
   int leader = local[0];
-  int wctx = enc_ctx(0, /*coll=*/true);  // world comm's collective channel
+  // the WORLD comm's collective channel (epoch-derived after a
+  // resize): the agreement rounds must ride the current world's ctx
+  int wctx = enc_ctx(g_world_ctx, /*coll=*/true);
 
   auto agree = [&](uint8_t mine, int tag) -> uint8_t {
     uint8_t ok = mine;
@@ -2048,7 +2326,7 @@ void setup_pipes() {
 
   {
     std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
-    g_my_pipes = shm::pipes_create(g_job.c_str(), g_rank, n_sources);
+    g_my_pipes = shm::pipes_create(pipe_job.c_str(), g_rank, n_sources);
   }
   if (!agree(g_my_pipes != nullptr, kPipeTagCreated)) {
     std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
@@ -2063,7 +2341,7 @@ void setup_pipes() {
   bool all_ok = true;
   for (int r : local) {
     if (r == g_rank) continue;
-    tx[r] = shm::pipe_attach(g_job.c_str(), r, slot_of(r, g_rank),
+    tx[r] = shm::pipe_attach(pipe_job.c_str(), r, slot_of(r, g_rank),
                              n_sources);
     if (!tx[r]) {
       all_ok = false;
@@ -2188,7 +2466,14 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
                  "coordinator table broadcast to rank " + std::to_string(i));
       ::close(fds[i]);
     }
-    ::close(coord_fd);
+    if (elastic_mode() == kElasticRejoin) {
+      // the coordinator port stays open for the job's lifetime: a
+      // relaunched replacement process (T4J_REJOIN=1) re-bootstraps
+      // through it into the surviving mesh at the next epoch fence
+      g_coord_listen_fd = coord_fd;
+    } else {
+      ::close(coord_fd);
+    }
   } else {
     int fd = tcp_connect(coord_host, coord_port, "coordinator (rank 0)");
     uint32_t rank_and_port[2] = {static_cast<uint32_t>(g_rank), my_port};
@@ -2255,6 +2540,8 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
   } else {
     ::close(listen_fd);
   }
+  if (g_coord_listen_fd >= 0)
+    g_accept_thread.v.emplace_back(coord_accept_loop);
   setup_pipes();
 }
 
@@ -2301,8 +2588,17 @@ constexpr int kCollTagBase = 1 << 24;
 
 Comm& get_comm(int handle) {
   std::lock_guard<std::mutex> lk(g_comm_mu);
-  if (handle < 0 || handle >= static_cast<int>(g_comms.size()))
-    fail_arg("invalid communicator handle " + std::to_string(handle));
+  if (handle < 0 || handle >= static_cast<int>(g_comms.size())) {
+    std::string hint;
+    if (g_world_epoch.load(std::memory_order_relaxed) != 0)
+      hint = " (the world resized at epoch " +
+             std::to_string(
+                 g_world_epoch.load(std::memory_order_relaxed)) +
+             ": pre-resize communicator handles are stale — rebuild "
+             "them over the resized world)";
+    fail_arg("invalid communicator handle " + std::to_string(handle) +
+             hint);
+  }
   return g_comms[handle];
 }
 
@@ -2986,7 +3282,8 @@ void multi_send(Comm& c, int tag, std::vector<RootSend>& msgs) {
     t.h = WireHeader{kMagic, static_cast<uint32_t>(g_rank),
                      static_cast<uint32_t>(enc_ctx(c.ctx, true)),
                      static_cast<uint32_t>(tag + 1),
-                     static_cast<uint64_t>(tcp[i].nbytes), 0};
+                     static_cast<uint64_t>(tcp[i].nbytes), 0,
+                     cur_epoch(), 0};
     if (healing) {
       t.h.seq = ++p.send_seq;
       ring_append(p, t.h, tcp[i].p, tcp[i].nbytes);
@@ -3838,12 +4135,22 @@ void engine_loop() {
       }
     }
     if (quit || g_stop.load(std::memory_order_acquire)) {
-      // no further progress is possible: drain everything as failed so
-      // waiters observe the fault context instead of hanging
+      // no further progress is possible right now: drain everything as
+      // failed so waiters observe the context instead of hanging.  A
+      // fault is terminal; an elastic resize is NOT — interrupted
+      // requests fail with a ResizeInterrupted status and the engine
+      // resumes service once the resized world is up (g_stop clears).
       std::string why = posted_fault_msg();
-      if (why.empty())
-        why = err_prefix() + "async request abandoned: bridge " +
-              std::string(quit ? "finalized" : "stopped");
+      if (why.empty()) {
+        if (!quit && g_resizing.load(std::memory_order_acquire))
+          why = err_prefix() +
+                "async request interrupted by elastic world resize — "
+                "ResizeInterrupted: reissue it on the resized world "
+                "(docs/failure-semantics.md \"elastic membership\")";
+        else
+          why = err_prefix() + "async request abandoned: bridge " +
+                std::string(quit ? "finalized" : "stopped");
+      }
       if (next) async_complete(next, true, why);
       for (;;) {
         std::shared_ptr<AsyncOp> q;
@@ -3859,16 +4166,26 @@ void engine_loop() {
       for (auto& p : parked) async_complete(p, true, why);
       parked.clear();
       if (quit) return;
-      // faulted but not finalizing: submits are rejected at the door
+      // stopped but not finalizing: submits are rejected at the door
       // once g_stop is set, but a submit that passed that check just
-      // before the fault may still land in the queue — keep draining
+      // before the stop may still land in the queue — keep draining
       // late arrivals as failed (their waiters would otherwise block
-      // forever) until finalize joins us
-      for (;;) {
+      // forever) until finalize joins us, OR until a completed elastic
+      // resize clears the stop, in which case normal service resumes
+      // on the resized world (finish_resize notifies e.cv).
+      bool resume = false;
+      while (!resume) {
         std::shared_ptr<AsyncOp> late;
         {
           std::unique_lock<std::mutex> lk(e.mu);
-          while (e.queue.empty() && !e.quit) e.cv.wait(lk);
+          while (e.queue.empty() && !e.quit &&
+                 g_stop.load(std::memory_order_acquire))
+            e.cv.wait(lk);
+          if (e.quit && e.queue.empty()) return;
+          if (!e.quit && !g_stop.load(std::memory_order_acquire)) {
+            resume = true;  // resized world is up: back to service
+            break;
+          }
           if (e.queue.empty()) return;  // e.quit
           late = e.queue.front();
           e.queue.pop_front();
@@ -3876,6 +4193,7 @@ void engine_loop() {
         }
         async_complete(late, true, why);
       }
+      continue;
     }
     if (next) {
       {
@@ -4035,6 +4353,898 @@ void stop_async_engine() {
   }
 }
 
+// -------------------------------------------------- elastic resize engine
+//
+// Shrink-to-survive and rejoin instead of whole-job abort
+// (docs/failure-semantics.md "elastic membership").  The escalation
+// ladder grows one rung: retry -> reconnect+replay -> SHRINK/REJOIN ->
+// abort.  When escalate_link declares a rank unrecoverable and
+// T4J_ELASTIC is shrink|rejoin:
+//
+//   1. Every survivor that notices (or is told) enters a resize: the
+//      bridge soft-stops (g_stop) so every in-flight op — blocked
+//      callers, shm-arena waiters, queued/parked/running engine
+//      requests — drains promptly with a ResizeInterrupted status
+//      (NOT a fault: the stop clears when the resized world is up).
+//   2. Survivors flood their suspected-dead masks to every presumed-
+//      alive peer over FRESH dials to the mesh listeners (the same
+//      out-of-band channel the reconnect handshake uses, incarnation
+//      tokens verifying identity), so the agreement never rides the
+//      possibly-torn data-plane streams.  The lowest surviving rank
+//      arbitrates: it ANDs the reports within T4J_RESIZE_TIMEOUT
+//      (silent ranks are dead — cascades fold in), floors the result
+//      against T4J_MIN_WORLD, and floods the verdict (the final alive
+//      mask).  A silent arbiter is itself presumed dead and the
+//      next-lowest survivor takes over — every rank flooded to
+//      everyone, so the successor already holds the reports.
+//   3. Survivors apply the verdict: world epoch bumps (stamped into
+//      every wire frame; stale-epoch traffic is dropped), per-link
+//      sequence/replay state resets, the world communicator is rebuilt
+//      over the members (every other comm handle is invalidated — the
+//      Python tier surfaces WorldResized and rebuilds), fresh TCP
+//      links come up pair-by-pair (bootstrap orientation, epoch-
+//      checked handshake), the same-host pipe transport re-negotiates
+//      under an epoch-suffixed namespace, and a barrier over the new
+//      world fences the epoch before user traffic resumes.
+//   4. rejoin mode: rank 0 keeps the bootstrap coordinator port open.
+//      A relaunched replacement process (T4J_REJOIN=1) dials it with a
+//      FRESH incarnation token; rank 0 runs a grow resize — the
+//      verdict carries the rejoiner's new endpoint/token to every
+//      survivor and the full endpoint table back to the rejoiner —
+//      and the rejoiner joins the link rebuild at the next epoch
+//      fence.  (This is the same incarnation-token machinery that
+//      makes a RESTARTED process unrecoverable for plain reconnect:
+//      the fresh token now has a legal path back in.)
+//
+// Failure at any step falls back to the legacy rung: posted fault,
+// job over — fail-stop remains the backstop.
+
+struct ResizeState {
+  std::mutex mu;
+  std::condition_variable cv;  // inbox arrivals, epoch advances
+  bool active = false;         // a resize thread owns the protocol
+  uint64_t pending_dead = 0;   // accumulated suspected-dead mask
+  // out-of-band inbox: reports/verdicts landed on the mesh listener
+  // (addrs is index-parallel: the grow verdict's PeerAddr payload)
+  std::vector<ResizeMsg> inbox;
+  std::vector<PeerAddr> addrs;
+  // rejoin trigger (rank 0 only): the replacement's identity and its
+  // still-open coordinator connection (answered at the verdict)
+  int grow_rank = -1;
+  PeerAddr grow_addr{};
+  int grow_fd = -1;
+};
+
+// leaked: handler threads and the resize thread are detached
+ResizeState& g_resize = *new ResizeState;
+
+// One 32-byte control message (plus an optional PeerAddr payload) on a
+// fresh dial to `dest`'s mesh listener.  Fire-and-forget: a false
+// return means the listener is unreachable — for the agreement that
+// IS information (the rank is dead).
+bool send_resize_msg(int dest, const ResizeMsg& m, const PeerAddr* addr) {
+  if (dest < 0 || dest >= static_cast<int>(g_endpoints.size()))
+    return false;
+  std::string why;
+  int fd = dial_once(g_endpoints[dest].host, g_endpoints[dest].port,
+                     Deadline::after(connect_timeout()), &why, nullptr,
+                     /*ignore_stop=*/true);
+  if (fd < 0) return false;
+  Deadline dl = Deadline::after(connect_timeout());
+  iovec iov[2] = {{const_cast<ResizeMsg*>(&m), sizeof(m)},
+                  {const_cast<PeerAddr*>(addr),
+                   addr ? sizeof(PeerAddr) : 0}};
+  IoStatus st = nb_write_all(fd, iov, addr ? 2 : 1, dl,
+                             /*ignore_stop=*/true);
+  ::close(fd);
+  return st == IoStatus::kOk;
+}
+
+// Quiesce the local data plane for the membership change: the readers
+// and the engine drain against g_stop, the same-host transports are
+// dropped (they are rebuilt over the new membership), every TCP link
+// is closed and its sequence/replay state reset (no replay crosses an
+// epoch — interrupted ops are REISSUED by the caller, not resumed),
+// and pre-resize mailbox frames are purged.
+void quiesce_for_resize() {
+  for (auto& p : g_peers) {
+    {
+      std::lock_guard<std::mutex> lk(p.send_mu);
+      if (p.fd >= 0) ::shutdown(p.fd, SHUT_RDWR);
+    }
+    p.cv.notify_all();
+    std::lock_guard<std::mutex> jk(p.join_mu);
+    if (p.reader.joinable()) p.reader.join();
+  }
+  g_pipe_readers.join_all();
+  // the engine fails its queued/parked/running requests against the
+  // stop; bound the wait (a wedged op body is additionally bounded by
+  // its own per-op deadline and the overall resize window)
+  Deadline dl = Deadline::after(resize_timeout());
+  while (engine().depth.load(std::memory_order_relaxed) > 0 &&
+         !dl.expired())
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  {
+    std::lock_guard<std::mutex> lk(g_pipe_pub_mu);
+    for (auto*& tx : g_tx_pipes) {
+      if (tx) shm::pipe_close(tx);
+      tx = nullptr;
+    }
+    if (g_my_pipes) {
+      shm::pipes_destroy(g_my_pipes);
+      g_my_pipes = nullptr;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_comm_mu);
+    for (auto& c : g_comms) {
+      if (c.arena) shm::destroy(c.arena);
+      c.arena = nullptr;
+      c.arena_checked = true;
+    }
+  }
+  for (auto& p : g_peers) {
+    std::lock_guard<std::mutex> slk(p.send_mu);
+    if (p.fd >= 0) {
+      ::close(p.fd);
+      p.fd = -1;
+    }
+    p.send_seq = 0;
+    p.ring.clear();
+    p.ring_head = 0;
+    p.ring_min_seq = 1;
+    p.recv_seq.store(0, std::memory_order_relaxed);
+    std::lock_guard<std::mutex> lk(p.mu);
+    if (p.state != PeerLink::kDead) p.state = PeerLink::kBroken;
+    p.repairing = false;
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_mail_mu);
+    g_mailbox.clear();
+  }
+}
+
+// Commit a membership verdict: mark departures, adopt a rejoiner's
+// fresh identity, bump the epoch, and rebuild the world communicator
+// over the members.  Every other comm handle is invalidated (the
+// Python tier clears its cache when it surfaces WorldResized).
+void apply_membership(uint64_t final_alive, uint32_t epoch, int grow_rank,
+                      const PeerAddr* grow_addr) {
+  uint64_t old = g_alive_mask.load(std::memory_order_relaxed);
+  uint64_t died = old & ~final_alive;
+  for (int r = 0; r < g_size && r < 64; ++r) {
+    if (!((died >> r) & 1)) continue;
+    tel::control_event(tel::kRankDead, r, epoch);
+    std::fprintf(stderr,
+                 "r%d | t4j: rank r%d left the world at epoch %u\n",
+                 g_rank, r, epoch);
+    PeerLink& p = g_peers[r];
+    std::lock_guard<std::mutex> lk(p.mu);
+    p.state = PeerLink::kDead;
+  }
+  std::fflush(stderr);
+  if (grow_rank >= 0 && grow_addr) {
+    char ip[INET_ADDRSTRLEN];
+    in_addr a{grow_addr->ip};
+    ::inet_ntop(AF_INET, &a, ip, sizeof(ip));
+    g_endpoints[grow_rank].host = ip;
+    g_endpoints[grow_rank].port = grow_addr->port;
+    g_endpoints[grow_rank].boot_token = grow_addr->boot_token;
+    if (grow_rank < static_cast<int>(g_host_fps.size()))
+      g_host_fps[grow_rank] = grow_addr->host_fp;
+    PeerLink& p = g_peers[grow_rank];
+    std::lock_guard<std::mutex> lk(p.mu);
+    p.state = PeerLink::kBroken;  // rebuilt below like every survivor
+  }
+  g_alive_mask.store(final_alive, std::memory_order_relaxed);
+  g_world_epoch.store(epoch, std::memory_order_release);
+  g_world_ctx = derive_hier_ctx(0, 'E', epoch);
+  std::lock_guard<std::mutex> lk(g_comm_mu);
+  g_comms.clear();
+  Comm world;
+  world.my_index = -1;
+  for (int r = 0; r < g_size; ++r)
+    if ((final_alive >> r) & 1) {
+      if (r == g_rank)
+        world.my_index = static_cast<int>(world.ranks.size());
+      world.ranks.push_back(r);
+    }
+  world.ctx = g_world_ctx;
+  g_comms.push_back(world);
+}
+
+// Install a freshly handshaken link (reader started separately once
+// the stop clears — a reader started under g_stop would exit at once).
+void install_link(int r, int fd) {
+  PeerLink& p = g_peers[r];
+  {
+    std::lock_guard<std::mutex> lk(p.send_mu);
+    if (p.fd >= 0) ::shutdown(p.fd, SHUT_RDWR);
+  }
+  {
+    std::lock_guard<std::mutex> jk(p.join_mu);
+    if (p.reader.joinable()) p.reader.join();
+  }
+  {
+    std::lock_guard<std::mutex> slk(p.send_mu);
+    if (p.fd >= 0) ::close(p.fd);
+    p.fd = fd;
+    p.send_seq = 0;
+    p.ring.clear();
+    p.ring_head = 0;
+    p.ring_min_seq = 1;
+    p.recv_seq.store(0, std::memory_order_relaxed);
+    std::lock_guard<std::mutex> lk(p.mu);
+    p.state = PeerLink::kUp;
+    ++p.epoch;
+  }
+  p.cv.notify_all();
+}
+
+void start_reader(int r) {
+  PeerLink& p = g_peers[r];
+  std::lock_guard<std::mutex> slk(p.send_mu);
+  if (p.fd < 0) return;
+  std::lock_guard<std::mutex> jk(p.join_mu);
+  if (!p.reader.joinable())
+    p.reader = std::thread(reader_loop, r, p.fd);
+}
+
+void start_readers(uint64_t alive) {
+  for (int r = 0; r < g_size && r < 64; ++r)
+    if (r != g_rank && ((alive >> r) & 1)) start_reader(r);
+}
+
+// Dialer side of the pair-by-pair link rebuild (bootstrap
+// orientation: the higher rank dials the lower rank's mesh listener).
+bool rebuild_dial(int r, uint32_t epoch, const Deadline& dl) {
+  std::string why = "dial failed";
+  int attempt = 0;
+  while (!dl.expired()) {
+    if (g_shutting_down.load(std::memory_order_acquire) ||
+        g_faulted.load(std::memory_order_acquire))
+      return false;
+    int fd = dial_once(g_endpoints[r].host, g_endpoints[r].port,
+                       Deadline::after(connect_timeout()), &why, nullptr,
+                       /*ignore_stop=*/true);
+    if (fd >= 0) {
+      Deadline io = Deadline::after(connect_timeout());
+      ResizeMsg m{kResizeMagic, kResizeDial,
+                  static_cast<uint32_t>(g_rank), epoch, 0,
+                  g_my_boot_token};
+      iovec iov[1] = {{&m, sizeof(m)}};
+      ResizeMsg ack{};
+      if (nb_write_all(fd, iov, 1, io, true) == IoStatus::kOk &&
+          nb_read_all(fd, &ack, sizeof(ack), io, true) == IoStatus::kOk &&
+          ack.magic == kResizeMagic && ack.type == kResizeAck &&
+          ack.mask == 1 && ack.epoch == epoch) {
+        install_link(r, fd);
+        return true;
+      }
+      ::close(fd);
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(
+        static_cast<int>(backoff_delay_s(attempt++) * 1000)));
+  }
+  return false;
+}
+
+// Rebuild every surviving pair's TCP link at `epoch`: dial the lower
+// alive ranks, wait for the higher ones to dial us (their handshakes
+// are answered by handle_resize_msg on the accept thread).
+bool rebuild_links(uint64_t alive, uint32_t epoch) {
+  Deadline dl = Deadline::after(resize_timeout() + connect_timeout());
+  for (int r = 0; r < g_rank && r < 64; ++r) {
+    if (!((alive >> r) & 1)) continue;
+    if (!rebuild_dial(r, epoch, dl)) return false;
+  }
+  for (int r = g_rank + 1; r < g_size && r < 64; ++r) {
+    if (!((alive >> r) & 1)) continue;
+    PeerLink& p = g_peers[r];
+    std::unique_lock<std::mutex> lk(p.mu);
+    while (p.state != PeerLink::kUp) {
+      if (dl.expired() ||
+          g_shutting_down.load(std::memory_order_acquire) ||
+          g_faulted.load(std::memory_order_acquire))
+        return false;
+      p.cv.wait_for(lk, std::chrono::milliseconds(100));
+    }
+  }
+  return true;
+}
+
+// Resize failure: fall back to the legacy rung.  The data links are
+// already torn down, so there is no abort broadcast to ride — peers
+// that cannot complete their own resize reach this same conclusion
+// through their T4J_RESIZE_TIMEOUT.
+void resize_abort(const std::string& why) {
+  std::string msg = err_prefix() + "elastic resize failed: " + why +
+                    " — escalating to abort "
+                    "(docs/failure-semantics.md \"elastic membership\")";
+  int stale_fd = -1;
+  {
+    std::lock_guard<std::mutex> lk(g_resize.mu);
+    g_resize.inbox.clear();
+    g_resize.addrs.clear();
+    g_resize.pending_dead = 0;
+    g_resize.active = false;
+    stale_fd = g_resize.grow_fd;
+    g_resize.grow_fd = -1;
+    g_resize.grow_rank = -1;
+  }
+  if (stale_fd >= 0) ::close(stale_fd);
+  post_fault(msg);
+  g_resizing.store(false, std::memory_order_release);
+  g_resize.cv.notify_all();
+  wake_async_engine();
+}
+
+// Membership agreement for a shrink.  Every entrant floods its
+// suspected-dead mask to every presumed survivor; the lowest
+// surviving rank arbitrates.  Returns true with *out_alive = the
+// agreed membership; false = the job must abort.
+bool shrink_agreement(uint64_t alive, uint64_t dead, uint32_t epoch,
+                      uint64_t* out_alive) {
+  auto flood = [&](uint64_t d) {
+    ResizeMsg m{kResizeMagic, kResizeReport,
+                static_cast<uint32_t>(g_rank), epoch, d,
+                g_my_boot_token};
+    for (int r = 0; r < g_size && r < 64; ++r) {
+      if (r == g_rank || !((alive >> r) & 1) || ((d >> r) & 1)) continue;
+      m.mask = d;
+      if (!send_resize_msg(r, m, nullptr))
+        d |= 1ull << r;  // unreachable listener: fold the cascade in
+    }
+    return d;
+  };
+  dead = flood(dead);
+  Deadline total = Deadline::after(3 * resize_timeout() + 5.0);
+  for (;;) {
+    if (g_shutting_down.load(std::memory_order_acquire) ||
+        g_faulted.load(std::memory_order_acquire) || total.expired())
+      return false;
+    int coord = -1;
+    for (int r = 0; r < g_size && r < 64; ++r)
+      if (((alive >> r) & 1) && !((dead >> r) & 1)) {
+        coord = r;
+        break;
+      }
+    if (coord < 0) return false;
+    if ((dead >> g_rank) & 1) return false;  // peers declared me dead
+    if (coord == g_rank) {
+      // arbiter: collect every survivor's flood, AND silence into the
+      // dead set, floor against T4J_MIN_WORLD, flood the verdict
+      Deadline dl = Deadline::after(resize_timeout());
+      uint64_t have = 1ull << g_rank;
+      {
+        std::unique_lock<std::mutex> lk(g_resize.mu);
+        for (;;) {
+          for (const ResizeMsg& r : g_resize.inbox) {
+            if (r.type != kResizeReport || r.epoch != epoch) continue;
+            dead |= r.mask;
+            if (r.rank < 64) have |= 1ull << r.rank;
+          }
+          uint64_t expected = alive & ~dead & ~(1ull << g_rank);
+          if ((have & expected) == expected) break;
+          if (dl.expired()) {
+            dead |= expected & ~have;  // silent ranks are gone too
+            break;
+          }
+          g_resize.cv.wait_for(lk, std::chrono::milliseconds(50));
+        }
+      }
+      uint64_t final_alive = alive & ~dead;
+      bool ok = popcount64(final_alive) >= min_world() &&
+                ((final_alive >> g_rank) & 1);
+      ResizeMsg v{kResizeMagic, kResizeVerdict,
+                  static_cast<uint32_t>(g_rank), epoch,
+                  ok ? final_alive : 0, g_my_boot_token};
+      for (int r = 0; r < g_size && r < 64; ++r) {
+        if (r == g_rank || !((final_alive >> r) & 1)) continue;
+        (void)send_resize_msg(r, v, nullptr);
+      }
+      if (!ok) return false;
+      *out_alive = final_alive;
+      return true;
+    }
+    // follower: wait for the arbiter's verdict, folding in any late
+    // reports (cascades).  A silent arbiter is itself dead — mark it
+    // and loop; the next-lowest survivor already holds every flood.
+    Deadline dl = Deadline::after(resize_timeout() + 2.0);
+    bool got = false;
+    uint64_t verdict = 0;
+    {
+      std::unique_lock<std::mutex> lk(g_resize.mu);
+      while (!dl.expired() &&
+             !g_shutting_down.load(std::memory_order_acquire)) {
+        for (const ResizeMsg& r : g_resize.inbox) {
+          if (r.epoch != epoch) continue;
+          if (r.type == kResizeVerdict &&
+              static_cast<int>(r.rank) == coord) {
+            got = true;
+            verdict = r.mask;
+          } else if (r.type == kResizeReport) {
+            dead |= r.mask;
+          }
+        }
+        if (got || ((dead >> coord) & 1)) break;
+        g_resize.cv.wait_for(lk, std::chrono::milliseconds(50));
+      }
+    }
+    if (got) {
+      if (verdict == 0 || !((verdict >> g_rank) & 1))
+        return false;  // abort verdict, or I am not in the new world
+      *out_alive = verdict;
+      return true;
+    }
+    if (!((dead >> coord) & 1)) {
+      dead |= 1ull << coord;
+      dead = flood(dead);  // the successor arbiter must hear of it
+    }
+  }
+}
+
+// Close out a successful resize: resume the data plane, fence the
+// epoch with a barrier over the new world, release the Python gate.
+void finish_resize(uint32_t epoch) {
+  int stale_fd = -1;
+  {
+    std::lock_guard<std::mutex> lk(g_resize.mu);
+    g_resize.inbox.clear();
+    g_resize.addrs.clear();
+    g_resize.pending_dead = 0;
+    stale_fd = g_resize.grow_fd;  // a rejoin that raced this resize
+    g_resize.grow_fd = -1;        // re-dials once we are done
+    g_resize.grow_rank = -1;
+  }
+  if (stale_fd >= 0) ::close(stale_fd);
+  // back in service: the stop clears FIRST (readers started under
+  // g_stop would exit immediately), then the data plane comes up
+  if (!g_faulted.load(std::memory_order_acquire))
+    g_stop.store(false, std::memory_order_release);
+  start_readers(g_alive_mask.load(std::memory_order_relaxed));
+  wake_async_engine();  // the drained engine resumes service
+  // same-host transports re-negotiate over the members now that the
+  // data plane is live again (the agreement rounds ride raw TCP)
+  setup_pipes();
+  std::fprintf(stderr,
+               "r%d | t4j: world resized: epoch %u, %d member(s), "
+               "mask 0x%llx\n",
+               g_rank, epoch, alive_count(),
+               static_cast<unsigned long long>(
+                   g_alive_mask.load(std::memory_order_relaxed)));
+  std::fflush(stderr);
+  // protocol ownership ends before the fence: a member dying DURING
+  // the fence may legitimately start the next resize
+  {
+    std::lock_guard<std::mutex> lk(g_resize.mu);
+    g_resize.active = false;
+  }
+  // epoch fence: every member reaches the new epoch before user
+  // traffic resumes (the rejoiner pairs this with its init barrier)
+  try {
+    barrier(0);
+  } catch (const BridgeError&) {
+    // a member died at the fence: the live escalation machinery owns
+    // the follow-up (next resize, or abort)
+  }
+  tel::control_event(tel::kResizeDone, alive_count(), epoch);
+  {
+    // release the Python-side gate unless a NEW resize already took
+    // ownership during the fence
+    std::lock_guard<std::mutex> lk(g_resize.mu);
+    if (!g_resize.active)
+      g_resizing.store(false, std::memory_order_release);
+  }
+  g_resize.cv.notify_all();
+}
+
+// The resize protocol body (one detached thread per resize, spawned
+// by the first enter_resize).
+void resize_main() {
+  quiesce_for_resize();
+  if (g_shutting_down.load(std::memory_order_acquire)) return;
+  uint64_t alive = g_alive_mask.load(std::memory_order_relaxed);
+  uint32_t epoch = cur_epoch() + 1;
+  uint64_t dead;
+  int grow_rank;
+  PeerAddr grow_addr{};
+  int grow_fd;
+  {
+    std::lock_guard<std::mutex> lk(g_resize.mu);
+    dead = g_resize.pending_dead;
+    grow_rank = g_resize.grow_rank;
+    grow_addr = g_resize.grow_addr;
+    grow_fd = g_resize.grow_fd;
+    g_resize.grow_rank = -1;
+    g_resize.grow_fd = -1;
+  }
+  uint64_t final_alive = 0;
+  int add_rank = -1;
+  PeerAddr add_addr{};
+  bool ok = false;
+  if (grow_rank >= 0 && dead == 0) {
+    // grow resize, coordinator side (rank 0): announce the rejoiner's
+    // fresh identity to every survivor, then answer the rejoiner with
+    // the verdict + the full endpoint table over its coordinator dial
+    add_rank = grow_rank;
+    add_addr = grow_addr;
+    final_alive = alive | (1ull << grow_rank);
+    ResizeMsg v{kResizeMagic, kResizeGrow,
+                static_cast<uint32_t>(grow_rank), epoch, final_alive,
+                g_my_boot_token};
+    for (int r = 0; r < g_size && r < 64; ++r) {
+      if (r == g_rank || !((alive >> r) & 1)) continue;
+      (void)send_resize_msg(r, v, &add_addr);
+    }
+    if (grow_fd >= 0) {
+      std::vector<PeerAddr> table(g_size);
+      for (int r = 0; r < g_size; ++r) {
+        in_addr a{};
+        ::inet_pton(AF_INET, g_endpoints[r].host.c_str(), &a);
+        table[r].ip = a.s_addr;
+        table[r].port = g_endpoints[r].port;
+        table[r].pad = 0;
+        table[r].host_fp =
+            r < static_cast<int>(g_host_fps.size()) ? g_host_fps[r] : 0;
+        table[r].boot_token = g_endpoints[r].boot_token;
+      }
+      table[grow_rank] = add_addr;
+      Deadline io = Deadline::after(connect_timeout());
+      iovec iov[2] = {{&v, sizeof(v)},
+                      {table.data(), sizeof(PeerAddr) * table.size()}};
+      (void)nb_write_all(grow_fd, iov, 2, io, /*ignore_stop=*/true);
+      ::close(grow_fd);
+      grow_fd = -1;
+    }
+    ok = true;
+  } else {
+    if (grow_fd >= 0) {
+      ::close(grow_fd);  // a shrink takes precedence; the rejoiner
+      grow_fd = -1;      // re-dials once the world settles
+    }
+    // survivor side of a grow: the coordinator's verdict is already
+    // in the inbox (it is what triggered this resize)
+    {
+      std::lock_guard<std::mutex> lk(g_resize.mu);
+      for (size_t i = 0; i < g_resize.inbox.size(); ++i) {
+        const ResizeMsg& msg = g_resize.inbox[i];
+        if (msg.type == kResizeGrow && msg.epoch == epoch &&
+            static_cast<int>(msg.rank) < 64) {
+          add_rank = static_cast<int>(msg.rank);
+          add_addr = i < g_resize.addrs.size() ? g_resize.addrs[i]
+                                               : PeerAddr{};
+          final_alive = msg.mask;
+          ok = true;
+        }
+      }
+    }
+    if (!ok)
+      ok = shrink_agreement(alive, dead, epoch, &final_alive);
+  }
+  if (!ok) {
+    resize_abort(
+        "the membership agreement did not converge (arbiter verdict "
+        "missing, this rank voted out, or the surviving world would "
+        "fall below T4J_MIN_WORLD=" + std::to_string(min_world()) + ")");
+    return;
+  }
+  apply_membership(final_alive, epoch, add_rank,
+                   add_rank >= 0 ? &add_addr : nullptr);
+  if (!rebuild_links(final_alive, epoch)) {
+    resize_abort("could not re-establish the mesh over the agreed "
+                 "membership within T4J_RESIZE_TIMEOUT");
+    return;
+  }
+  finish_resize(epoch);
+}
+
+// resize_main runs on a detached thread: nothing may escape it.
+void resize_main_guarded() {
+  try {
+    resize_main();
+  } catch (const std::exception& e) {
+    resize_abort(std::string("unexpected failure in the resize "
+                             "protocol: ") + e.what());
+  }
+}
+
+bool try_begin_resize(int peer, const std::string& why) {
+  uint64_t bit =
+      (peer >= 0 && peer < 64) ? (1ull << peer) : 0;
+  if (bit && !rank_alive(peer))
+    return true;  // already outside the membership: a resize owns it
+  uint64_t pending;
+  {
+    std::lock_guard<std::mutex> lk(g_resize.mu);
+    pending = g_resize.pending_dead;
+  }
+  uint64_t survivors =
+      g_alive_mask.load(std::memory_order_relaxed) & ~(pending | bit);
+  if (popcount64(survivors) < min_world()) return false;
+  enter_resize(bit, "link to peer r" + std::to_string(peer) +
+                        " unrecoverable: " + why);
+  return true;
+}
+
+void enter_resize(uint64_t dead_delta, const std::string& why) {
+  bool first = false;
+  {
+    std::lock_guard<std::mutex> lk(g_resize.mu);
+    g_resize.pending_dead |= dead_delta;
+    if (!g_resize.active) {
+      g_resize.active = true;
+      first = true;
+      g_resizing.store(true, std::memory_order_release);
+    }
+  }
+  g_resize.cv.notify_all();
+  if (!first) return;
+  uint32_t next = cur_epoch() + 1;
+  std::fprintf(stderr,
+               "r%d | t4j: elastic resize toward epoch %u "
+               "(T4J_ELASTIC=%s): %s\n",
+               g_rank, next,
+               elastic_mode() == kElasticRejoin ? "rejoin" : "shrink",
+               why.c_str());
+  std::fflush(stderr);
+  tel::control_event(tel::kResizeBegin, -1, next);
+  // soft stop: every blocked op drains with ResizeInterrupted; the
+  // stop clears again in finish_resize
+  g_stop.store(true, std::memory_order_release);
+  wake_all_pipes();
+  wake_async_engine();
+  for (auto& p : g_peers) p.cv.notify_all();
+  std::thread(resize_main_guarded).detach();
+}
+
+// Acceptor side of the out-of-band resize channel (dials landing on
+// the mesh listener whose first 4 bytes are kResizeMagic).
+void handle_resize_msg(int fd, const ResizeMsg& m) {
+  Deadline dl = Deadline::after(connect_timeout());
+  int r = static_cast<int>(m.rank);
+  if (elastic_mode() == kElasticOff || r < 0 || r >= g_size ||
+      r >= 64 || static_cast<int>(g_endpoints.size()) != g_size) {
+    ::close(fd);
+    return;
+  }
+  switch (m.type) {
+    case kResizeReport:
+    case kResizeVerdict: {
+      if (m.token != g_endpoints[r].boot_token) break;  // stale sender
+      {
+        std::lock_guard<std::mutex> lk(g_resize.mu);
+        g_resize.inbox.push_back(m);
+        g_resize.addrs.push_back(PeerAddr{});
+      }
+      enter_resize(
+          m.type == kResizeReport ? m.mask : 0,
+          m.type == kResizeReport
+              ? "peer r" + std::to_string(r) +
+                    " flooded a suspected-dead set"
+              : "membership verdict from arbiter r" + std::to_string(r));
+      g_resize.cv.notify_all();
+      break;
+    }
+    case kResizeGrow: {
+      // from the grow coordinator (rank 0); the payload is the
+      // rejoiner's fresh endpoint/incarnation
+      if (m.token != g_endpoints[0].boot_token) break;
+      PeerAddr addr{};
+      if (nb_read_all(fd, &addr, sizeof(addr), dl,
+                      /*ignore_stop=*/true) != IoStatus::kOk)
+        break;
+      {
+        std::lock_guard<std::mutex> lk(g_resize.mu);
+        g_resize.inbox.push_back(m);
+        g_resize.addrs.push_back(addr);
+      }
+      enter_resize(0, "rank r" + std::to_string(r) +
+                          " rejoins at the next epoch fence");
+      g_resize.cv.notify_all();
+      break;
+    }
+    case kResizeDial: {
+      // link-rebuild handshake: answer once OUR membership reaches
+      // the dial's epoch (the verdict may still be in flight here)
+      bool accept_dial = m.token != 0;
+      {
+        std::unique_lock<std::mutex> lk(g_resize.mu);
+        Deadline wd = Deadline::after(resize_timeout());
+        while (cur_epoch() < m.epoch && !wd.expired() &&
+               !g_shutting_down.load(std::memory_order_acquire))
+          g_resize.cv.wait_for(lk, std::chrono::milliseconds(50));
+      }
+      accept_dial = accept_dial && cur_epoch() == m.epoch &&
+                    rank_alive(r) &&
+                    m.token == g_endpoints[r].boot_token;
+      ResizeMsg ack{kResizeMagic, kResizeAck,
+                    static_cast<uint32_t>(g_rank), cur_epoch(),
+                    accept_dial ? 1ull : 0ull, g_my_boot_token};
+      iovec iov[1] = {{&ack, sizeof(ack)}};
+      if (nb_write_all(fd, iov, 1, dl, /*ignore_stop=*/true) !=
+              IoStatus::kOk ||
+          !accept_dial) {
+        ::close(fd);
+        return;
+      }
+      install_link(r, fd);
+      if (!g_stop.load(std::memory_order_acquire)) start_reader(r);
+      return;  // fd now owned by the link
+    }
+    default:
+      break;
+  }
+  ::close(fd);
+}
+
+// Rank 0's coordinator listener (rejoin mode): replacement processes
+// re-bootstrap through it.
+void handle_rejoin_dial(int fd) {
+  Deadline dl = Deadline::after(connect_timeout());
+  ResizeMsg m{};
+  PeerAddr addr{};
+  if (nb_read_all(fd, &m, sizeof(m), dl, true) != IoStatus::kOk ||
+      m.magic != kResizeMagic || m.type != kRejoinHello ||
+      nb_read_all(fd, &addr, sizeof(addr), dl, true) != IoStatus::kOk) {
+    ::close(fd);
+    return;
+  }
+  int r = static_cast<int>(m.rank);
+  if (r <= 0 || r >= g_size || r >= 64 || rank_alive(r) ||
+      elastic_mode() != kElasticRejoin ||
+      g_faulted.load(std::memory_order_acquire) ||
+      g_shutting_down.load(std::memory_order_acquire)) {
+    // rank still a member (old incarnation not yet declared dead), a
+    // bad slot, or nothing to rejoin: the replacement re-dials with
+    // backoff until the world settles
+    ::close(fd);
+    return;
+  }
+  sockaddr_in peer{};
+  socklen_t len = sizeof(peer);
+  ::getpeername(fd, reinterpret_cast<sockaddr*>(&peer), &len);
+  addr.ip = peer.sin_addr.s_addr;
+  addr.boot_token = m.token;
+  {
+    std::lock_guard<std::mutex> lk(g_resize.mu);
+    if (g_resize.active || g_resize.grow_fd >= 0) {
+      ::close(fd);  // a resize is running: the replacement re-dials
+      return;
+    }
+    g_resize.grow_rank = r;
+    g_resize.grow_addr = addr;
+    g_resize.grow_fd = fd;
+  }
+  std::fprintf(stderr,
+               "r%d | t4j: rank r%d re-bootstrapped (fresh incarnation) "
+               "— growing the world back\n",
+               g_rank, r);
+  std::fflush(stderr);
+  enter_resize(0, "rank r" + std::to_string(r) +
+                      " re-bootstrapped and requests rejoin");
+}
+
+void coord_accept_loop() {
+  while (!g_shutting_down.load(std::memory_order_acquire)) {
+    pollfd pfd{g_coord_listen_fd, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, 100);
+    if (rc <= 0) continue;
+    sockaddr_in peer{};
+    socklen_t len = sizeof(peer);
+    int fd = ::accept(g_coord_listen_fd,
+                      reinterpret_cast<sockaddr*>(&peer), &len);
+    if (fd < 0) continue;
+    set_nonblock(fd);
+    tune_socket(fd);
+    std::thread(handle_rejoin_dial, fd).detach();
+  }
+}
+
+// Replacement-process bootstrap (T4J_REJOIN=1, docs/failure-semantics
+// "elastic membership"): instead of the full-world rendezvous, dial
+// rank 0's kept-open coordinator port with a FRESH incarnation token,
+// receive the surviving world's endpoint table + membership + target
+// epoch, and join the link rebuild at the epoch fence.
+void rejoin_bootstrap(const std::string& coord_host, uint16_t coord_port) {
+  {
+    std::mt19937_64 rng(std::random_device{}() ^
+                        static_cast<uint64_t>(::getpid()));
+    g_my_boot_token = rng();
+    if (!g_my_boot_token) g_my_boot_token = 1;
+  }
+  uint16_t my_port = 0;
+  int listen_fd = tcp_listen(&my_port);
+  uint64_t my_fp = host_fingerprint();
+  ResizeMsg grow{};
+  std::vector<PeerAddr> table(g_size);
+  Deadline dl = Deadline::after(connect_timeout() + 2 * resize_timeout());
+  int attempt = 0;
+  for (;;) {
+    if (dl.expired())
+      fail_boot(
+          "rejoin: the surviving world did not accept the re-bootstrap "
+          "within the window (is the job running with "
+          "T4J_ELASTIC=rejoin, and is rank 0 alive?)");
+    std::string why;
+    int fd = dial_once(coord_host, coord_port,
+                       Deadline::after(connect_timeout()), &why);
+    if (fd < 0) {
+      if (!backoff_sleep(backoff_delay_s(attempt++))) raise_stopped();
+      continue;
+    }
+    ResizeMsg hello{kResizeMagic, kRejoinHello,
+                    static_cast<uint32_t>(g_rank), 0, 0,
+                    g_my_boot_token};
+    PeerAddr me{0, my_port, 0, my_fp, g_my_boot_token};
+    iovec iov[2] = {{&hello, sizeof(hello)}, {&me, sizeof(me)}};
+    Deadline io = Deadline::after(connect_timeout() + resize_timeout());
+    if (nb_write_all(fd, iov, 2, io) == IoStatus::kOk &&
+        nb_read_all(fd, &grow, sizeof(grow), io) == IoStatus::kOk &&
+        grow.magic == kResizeMagic && grow.type == kResizeGrow &&
+        static_cast<int>(grow.rank) == g_rank && grow.mask != 0 &&
+        nb_read_all(fd, table.data(), sizeof(PeerAddr) * g_size, io) ==
+            IoStatus::kOk) {
+      ::close(fd);
+      break;
+    }
+    ::close(fd);
+    if (!backoff_sleep(backoff_delay_s(attempt++))) raise_stopped();
+  }
+  // adopt the surviving world's identity table and membership
+  g_host_fps.resize(g_size);
+  g_endpoints.assign(g_size, PeerEndpoint{});
+  for (int i = 0; i < g_size; ++i) {
+    g_host_fps[i] = table[i].host_fp;
+    char ip[INET_ADDRSTRLEN];
+    in_addr a{table[i].ip};
+    ::inet_ntop(AF_INET, &a, ip, sizeof(ip));
+    g_endpoints[i].host = (i == 0) ? coord_host : std::string(ip);
+    g_endpoints[i].port = table[i].port;
+    g_endpoints[i].boot_token = table[i].boot_token;
+  }
+  g_host_fps[g_rank] = my_fp;
+  g_endpoints[g_rank].boot_token = g_my_boot_token;
+  g_alive_mask.store(grow.mask, std::memory_order_relaxed);
+  g_world_epoch.store(grow.epoch, std::memory_order_release);
+  g_world_ctx = derive_hier_ctx(0, 'E', grow.epoch);
+  g_peers = std::vector<PeerLink>(g_size);
+  for (int r = 0; r < g_size; ++r) {
+    if (r == g_rank) continue;
+    std::lock_guard<std::mutex> lk(g_peers[r].mu);
+    g_peers[r].state =
+        rank_alive(r) ? PeerLink::kBroken : PeerLink::kDead;
+  }
+  g_listen_fd = listen_fd;
+  g_accept_thread.v.emplace_back(accept_loop);
+  {
+    std::lock_guard<std::mutex> lk(g_comm_mu);
+    g_comms.clear();
+    Comm world;
+    world.my_index = -1;
+    for (int r = 0; r < g_size; ++r)
+      if (rank_alive(r)) {
+        if (r == g_rank)
+          world.my_index = static_cast<int>(world.ranks.size());
+        world.ranks.push_back(r);
+      }
+    world.ctx = g_world_ctx;
+    g_comms.push_back(world);
+  }
+  std::fprintf(stderr,
+               "r%d | t4j: rejoining the world at epoch %u "
+               "(%d member(s))\n",
+               g_rank, grow.epoch, alive_count());
+  std::fflush(stderr);
+  if (!rebuild_links(grow.mask, grow.epoch))
+    fail_boot("rejoin: could not re-establish the mesh with the "
+              "survivors within T4J_RESIZE_TIMEOUT");
+  start_readers(grow.mask);
+  setup_pipes();
+  // the caller (init_from_env) runs the join barrier, which pairs
+  // with the survivors' epoch-fence barrier
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------- public
@@ -4121,6 +5331,44 @@ void set_resilience(int retry, double base_s, double max_s,
   if (replay >= 0) g_replay_bytes.store(replay, std::memory_order_relaxed);
 }
 
+void set_elastic(int mode, int min_world_v, double resize_timeout_s) {
+  // mode: 0 off, 1 shrink, 2 rejoin (other values keep).  min_world:
+  // >= 1 sets, else keeps.  resize_timeout_s: > 0 sets, else keeps.
+  // Must be set before init (rejoin mode decides whether rank 0 keeps
+  // the coordinator port open at bootstrap) and uniformly across
+  // ranks; utils/config.py owns validation, including the rejection
+  // of elastic + T4J_RETRY_MAX=0 (escalation — elastic's trigger — is
+  // the self-healing ladder's last rung).
+  if (mode >= kElasticOff && mode <= kElasticRejoin)
+    g_elastic_mode.store(mode, std::memory_order_relaxed);
+  if (min_world_v >= 1)
+    g_min_world.store(min_world_v, std::memory_order_relaxed);
+  if (resize_timeout_s > 0)
+    g_resize_timeout_s.store(resize_timeout_s, std::memory_order_relaxed);
+}
+
+bool world_info(WorldInfo* out) {
+  if (!out || !g_initialized) return false;
+  out->epoch = g_world_epoch.load(std::memory_order_acquire);
+  out->boot_size = g_size;
+  out->alive_count = alive_count();
+  out->alive_mask = g_alive_mask.load(std::memory_order_relaxed);
+  out->resizing = g_resizing.load(std::memory_order_acquire);
+  out->stale_frames = g_stale_frames.load(std::memory_order_relaxed);
+  return true;
+}
+
+bool resize_wait(double timeout_s) {
+  if (!g_resizing.load(std::memory_order_acquire)) return true;
+  Deadline dl = Deadline::after(timeout_s);
+  std::unique_lock<std::mutex> lk(g_resize.mu);
+  while (g_resizing.load(std::memory_order_acquire)) {
+    if (timeout_s <= 0 || dl.expired()) break;
+    g_resize.cv.wait_for(lk, std::chrono::milliseconds(50));
+  }
+  return !g_resizing.load(std::memory_order_acquire);
+}
+
 bool link_stats(int peer, LinkStats* out) {
   if (!out || !g_initialized ||
       static_cast<int>(g_peers.size()) != g_size)
@@ -4162,6 +5410,7 @@ bool topology(TopoInfo* out) {
   TopoInfo t{-1, 0, 0, -1, 0};
   uint64_t mine = g_host_fps[g_rank];
   for (int r = 0; r < g_size; ++r) {
+    if (!rank_alive(r)) continue;  // departed members leave the map
     uint64_t fp = g_host_fps[r];
     bool seen = false;
     for (uint64_t k : fps)
@@ -4545,6 +5794,18 @@ int init_from_env() {
     throw BridgeError(err_prefix() + "invalid T4J_RANK=" +
                       std::string(rank_s) + " / T4J_SIZE=" +
                       std::string(size_s));
+  // full bootstrap membership (elastic resizes flip bits later); a
+  // rejoining replacement adopts the survivors' mask/epoch instead
+  g_alive_mask.store(
+      g_size >= 64 ? ~0ull : ((1ull << g_size) - 1),
+      std::memory_order_relaxed);
+  g_world_epoch.store(0, std::memory_order_relaxed);
+  g_world_ctx = 0;
+  const char* rejoin_s = std::getenv("T4J_REJOIN");
+  bool rejoining = rejoin_s && rejoin_s[0] &&
+                   std::strcmp(rejoin_s, "0") != 0 &&
+                   elastic_mode() == kElasticRejoin && g_rank != 0 &&
+                   g_size > 1 && g_size <= 64;
   parse_fault_plan();
   if (fault_armed(FaultPlan::kRefuse)) {
     // connect-failure injection: never join the bootstrap, so every
@@ -4586,10 +5847,13 @@ int init_from_env() {
     std::string host = coord.substr(0, colon);
     uint16_t port = static_cast<uint16_t>(std::atoi(coord.c_str() + colon + 1));
     g_in_init.store(true, std::memory_order_relaxed);
-    bootstrap(host, port);
+    if (rejoining)
+      rejoin_bootstrap(host, port);  // builds the world comm itself
+    else
+      bootstrap(host, port);
   }
 
-  {
+  if (!rejoining) {
     std::lock_guard<std::mutex> lk(g_comm_mu);
     Comm world;
     for (int i = 0; i < g_size; ++i) world.ranks.push_back(i);
@@ -4628,6 +5892,9 @@ int init_from_env() {
 
 void finalize() {
   if (!g_initialized) return;
+  // let an in-progress elastic resize settle first: tearing the
+  // transports down under the rebuild would race the resize thread
+  (void)resize_wait(resize_timeout());
   g_finalizing.store(true, std::memory_order_release);
   // A leaked in-flight async request may still be executing on the
   // progress thread — let it finish (bounded by the connect deadline,
@@ -4694,11 +5961,16 @@ void finalize() {
       g_my_pipes = nullptr;
     }
   }
-  // the reconnect acceptor observes g_stop within its poll tick
+  // the reconnect/coordinator acceptors observe the teardown flags
+  // within their poll ticks
   g_accept_thread.join_all();
   if (g_listen_fd >= 0) {
     ::close(g_listen_fd);
     g_listen_fd = -1;
+  }
+  if (g_coord_listen_fd >= 0) {
+    ::close(g_coord_listen_fd);
+    g_coord_listen_fd = -1;
   }
   // shutdown first (wakes blocked readers with EOF/error), close only
   // after every reader has exited — closing a fd a thread is blocked on
@@ -4735,6 +6007,13 @@ int comm_create(const int* world_ranks, int n, int ctx) {
   for (int i = 0; i < n; ++i) {
     int r = world_ranks[i];
     if (r < 0 || r >= g_size) fail_arg("comm_create: world rank " + std::to_string(r) + " out of range [0, " + std::to_string(g_size) + ")");
+    if (!rank_alive(r))
+      fail_arg("comm_create: world rank " + std::to_string(r) +
+               " is not a member of the current world (left at or "
+               "before epoch " +
+               std::to_string(g_world_epoch.load(
+                   std::memory_order_relaxed)) +
+               " — rebuild communicators over the resized world)");
     if (r == g_rank) c.my_index = i;
     c.ranks.push_back(r);
   }

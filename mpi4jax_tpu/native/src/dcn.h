@@ -199,6 +199,58 @@ void set_hier(int mode, long long min_bytes);
 void set_resilience(int retry, double base_s, double max_s,
                     long long replay);
 
+// -- elastic world membership (docs/failure-semantics.md "elastic
+// membership") --------------------------------------------------------------
+// When a rank is declared unrecoverable (its link exhausted the
+// retry/replay budget), T4J_ELASTIC decides what happens next:
+//   off    — today's exact behaviour: abort broadcast, whole job dies.
+//   shrink — survivors agree on a reduced world (suspected-dead sets
+//            flooded over the surviving mesh, lowest surviving rank
+//            arbitrates), in-flight ops drain with a ResizeInterrupted
+//            status, ring/hier/shm topology is rebuilt over the
+//            survivors under a bumped world epoch (stamped into every
+//            wire frame so stale-epoch traffic is rejected), and the
+//            job continues at the reduced size.  The Python tier
+//            surfaces WorldResized at the next op.
+//   rejoin — shrink, plus rank 0 keeps the bootstrap coordinator port
+//            open: a relaunched replacement process (T4J_REJOIN=1)
+//            re-bootstraps through it with a fresh incarnation token
+//            and joins at the next epoch fence (grow resize).
+// Floors and bounds:
+//   T4J_MIN_WORLD       below this many survivors the legacy abort
+//                       fires instead of a shrink (default 1).
+//   T4J_RESIZE_TIMEOUT  per-phase bound on the membership agreement /
+//                       rebuild (seconds, default 30).
+// Elastic requires self-healing on (T4J_RETRY_MAX > 0 — escalation is
+// what triggers it; utils/config.py rejects the combination) and a
+// bootstrap world of at most 64 ranks (the agreement floods a u64
+// membership mask).
+// mode: 0 off, 1 shrink, 2 rejoin (other values keep).  min_world:
+// >= 1 sets, else keeps.  resize_timeout_s: > 0 sets, else keeps.
+// Must be set before init and uniformly across ranks.
+void set_elastic(int mode, int min_world, double resize_timeout_s);
+
+// Live membership view.  epoch 0 = the bootstrap world; every
+// completed resize bumps it.  alive_mask bit r = world rank r is a
+// member.  Returns false before init.
+struct WorldInfo {
+  uint32_t epoch;
+  int boot_size;    // T4J_SIZE at bootstrap (rank ids keep this space)
+  int alive_count;  // current members
+  uint64_t alive_mask;
+  bool resizing;    // a membership agreement/rebuild is in progress
+  // frames dropped for carrying a stale world epoch (diagnostic: the
+  // drop is belt-and-braces — post-resize links are fresh — so a
+  // nonzero count in a post-mortem flags pre-resize traffic arriving
+  // where it never should)
+  uint64_t stale_frames;
+};
+bool world_info(WorldInfo* out);
+
+// Block until no resize is in progress (bounded by timeout_s; <= 0 =
+// one nonblocking check).  Returns true when settled.
+bool resize_wait(double timeout_s);
+
 // Per-peer self-healing counters (t4j_link_stats / runtime.link_stats):
 // how many times the link reconnected and how much it replayed.
 // state: 0 = up, 1 = broken (repair in progress), 2 = dead.

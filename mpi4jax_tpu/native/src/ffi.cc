@@ -687,6 +687,36 @@ void t4j_set_resilience(int32_t retry_max, double backoff_base_s,
   t4j::set_resilience(retry_max, backoff_base_s, backoff_max_s,
                       replay_bytes);
 }
+// Elastic membership knobs (docs/failure-semantics.md "elastic
+// membership"): mode 0 off, 1 shrink, 2 rejoin (other values keep);
+// min_world >= 1 sets; resize_timeout_s > 0 sets.  Must be set before
+// t4j_init and uniformly across ranks; utils/config.py owns
+// validation (including rejecting elastic with T4J_RETRY_MAX=0).
+void t4j_set_elastic(int32_t mode, int32_t min_world,
+                     double resize_timeout_s) {
+  t4j::set_elastic(mode, min_world, resize_timeout_s);
+}
+// Live membership: world epoch (0 = bootstrap), current member count,
+// alive bitmask (bit r = world rank r is a member), whether a resize
+// is in progress, and the stale-epoch frame drop counter (diagnostic).
+// Returns 1 when filled, 0 before init.
+int32_t t4j_world_info(uint32_t* epoch, int32_t* alive_count,
+                       uint64_t* alive_mask, int32_t* resizing,
+                       uint64_t* stale_frames) {
+  t4j::WorldInfo w;
+  if (!t4j::world_info(&w)) return 0;
+  if (epoch) *epoch = w.epoch;
+  if (alive_count) *alive_count = w.alive_count;
+  if (alive_mask) *alive_mask = w.alive_mask;
+  if (resizing) *resizing = w.resizing ? 1 : 0;
+  if (stale_frames) *stale_frames = w.stale_frames;
+  return 1;
+}
+// Block until no resize is in progress (bounded by timeout_s; <= 0 =
+// one nonblocking check).  Returns 1 when settled, 0 on timeout.
+int32_t t4j_resize_wait(double timeout_s) {
+  return t4j::resize_wait(timeout_s) ? 1 : 0;
+}
 // Per-peer reconnect/replay counters.  peer >= 0 selects one link;
 // peer < 0 aggregates every link (state = worst: 0 up, 1 broken,
 // 2 dead).  Returns 1 when the outputs were filled, 0 before init or

@@ -118,6 +118,16 @@ enum Kind : uint16_t {
   // per-step aggregation in telemetry/diagnose.py anchors on, so a
   // counters-mode post-mortem still knows which step it died in.
   kStep = 60,
+  // elastic world membership (docs/failure-semantics.md "elastic
+  // membership"): control instants recorded from counters mode up.
+  // kResizeBegin/kResizeDone carry the forming/committed world epoch
+  // in `bytes` (done additionally carries the new alive count in
+  // `peer`); kRankDead marks a rank leaving the membership (`peer` =
+  // the departed world rank, `bytes` = the epoch that removed it) —
+  // distinct from kLinkDead, which is one LINK's terminal verdict.
+  kResizeBegin = 61,
+  kResizeDone = 62,
+  kRankDead = 63,
 };
 
 enum Phase : uint8_t { kInstant = 0, kBegin = 1, kEnd = 2 };

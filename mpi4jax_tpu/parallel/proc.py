@@ -204,11 +204,18 @@ def grid_comm(axis_sizes, axes=None, base=None):
 
 
 def world_comm_if_initialized():
-    """Return the world ProcComm if the native runtime is up, else None."""
+    """Return the world ProcComm if the native runtime is up, else None.
+
+    After an elastic resize (docs/failure-semantics.md "elastic
+    membership") the world is the CURRENT membership, not the bootstrap
+    rank range — departed ranks drop out of the communicator."""
     try:
         from mpi4jax_tpu.native import runtime
     except ImportError:
         return None
     if not runtime.is_initialized():
         return None
-    return ProcComm(ranks=tuple(range(runtime.world_size())))
+    alive = runtime.alive_ranks()
+    if alive is None:
+        alive = tuple(range(runtime.world_size()))
+    return ProcComm(ranks=tuple(alive))

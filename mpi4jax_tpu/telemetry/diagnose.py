@@ -488,14 +488,26 @@ def _tx_stall(view, lo, hi, gap_ns):
 
 
 def _ctrl_stall(view, lo, hi):
-    """Per-peer ``{peer: {"ns", "replays", "breaks"}}`` inside
-    [lo, hi): a ``link_break`` opens a repair window closed by the
-    next ``reconnect`` on the same peer (or the window end — a break
-    the step never recovered from stalls it to the end).  Replay and
-    break counts are per peer too, so the links table attributes each
-    event to its own link, never the sum over all of them."""
+    """``(per_peer, resize_ns)`` inside [lo, hi).
+
+    ``per_peer`` is ``{peer: {"ns", "replays", "breaks"}}``: a
+    ``link_break`` opens a repair window closed by the next
+    ``reconnect`` on the same peer (or the window end — a break the
+    step never recovered from stalls it to the end).  Replay and break
+    counts are per peer too, so the links table attributes each event
+    to its own link, never the sum over all of them.
+
+    ``resize_ns`` is the time spent inside elastic-resize windows
+    (``resize_begin`` → ``resize_done``, docs/failure-semantics.md
+    "elastic membership").  Resize stall is its OWN phase, not link
+    repair: per-peer repair intervals are clipped against the resize
+    windows, so a link that broke because the whole world was resizing
+    is never misbinned as that link's repair time."""
     open_break = {}
+    repair_ivs = {}  # peer -> [(t0, t1)]
     per_peer = {}
+    resize_open = None
+    resize_ivs = []
 
     def rec(peer):
         return per_peer.setdefault(
@@ -505,16 +517,35 @@ def _ctrl_stall(view, lo, hi):
     for t, kind, peer in view.ctrl:
         if t < lo or t > hi:
             continue
-        if kind == "link_break":
+        if kind == "resize_begin":
+            if resize_open is None:
+                resize_open = t
+        elif kind == "resize_done":
+            if resize_open is not None:
+                resize_ivs.append((resize_open, t))
+                resize_open = None
+            else:
+                # the begin predates this window: charge from its start
+                resize_ivs.append((lo, t))
+        elif kind == "link_break":
             rec(peer)["breaks"] += 1
             open_break.setdefault(peer, t)
         elif kind == "reconnect" and peer in open_break:
-            rec(peer)["ns"] += t - open_break.pop(peer)
+            repair_ivs.setdefault(peer, []).append(
+                (open_break.pop(peer), t)
+            )
         elif kind == "replay":
             rec(peer)["replays"] += 1
+    if resize_open is not None:
+        resize_ivs.append((resize_open, hi))
     for peer, t0 in open_break.items():
-        rec(peer)["ns"] += hi - t0
-    return per_peer
+        repair_ivs.setdefault(peer, []).append((t0, hi))
+    resize_ivs = _union(resize_ivs)
+    resize_ns = _total(resize_ivs)
+    for peer, ivs in repair_ivs.items():
+        ivs = _union(ivs)
+        rec(peer)["ns"] += _total(ivs) - _overlap(ivs, resize_ivs)
+    return per_peer, resize_ns
 
 
 def _step_table(views):
@@ -570,6 +601,7 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
     rank_totals = {
         v.rank: {"compute_ms": 0.0, "blocked_ms": 0.0, "wire_ms": 0.0,
                  "tx_stall_ms": 0.0, "ctrl_stall_ms": 0.0,
+                 "resize_ms": 0.0,
                  "overlap_num": 0.0, "overlap_den": 0.0, "steps": 0}
         for v in views
     }
@@ -600,7 +632,7 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
             tx_ns, tx_per_peer, tx_per_peer_op, max_gap = _tx_stall(
                 view, lo, hi, gap_ns
             )
-            ctrl_per_peer = _ctrl_stall(view, lo, hi)
+            ctrl_per_peer, resize_ns = _ctrl_stall(view, lo, hi)
             ctrl_ns = sum(c["ns"] for c in ctrl_per_peer.values())
             for peer, ns in tx_per_peer.items():
                 rec = link_stall.setdefault(
@@ -632,6 +664,7 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
                 "tx_stall_ms": tx_ns / 1e6,
                 "max_tx_gap_ms": max_gap / 1e6,
                 "ctrl_stall_ms": ctrl_ns / 1e6,
+                "resize_ms": resize_ns / 1e6,
                 "truncated": bool(trunc),
             })
             tot = rank_totals[rank]
@@ -640,6 +673,7 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
             tot["wire_ms"] += wire_ns / 1e6
             tot["tx_stall_ms"] += tx_ns / 1e6
             tot["ctrl_stall_ms"] += ctrl_ns / 1e6
+            tot["resize_ms"] += resize_ns / 1e6
             if overlap_pct is not None:
                 tot["overlap_num"] += overlap_pct
                 tot["overlap_den"] += 1
@@ -654,6 +688,10 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
                 "compute": compute_excess,
                 "wire": r["tx_stall_ms"],
                 "stall": r["ctrl_stall_ms"],
+                # elastic resizes are their own phase: membership
+                # agreement/rebuild time must not masquerade as link
+                # repair (docs/failure-semantics.md)
+                "resize": r["resize_ms"],
             }
             phase = max(components, key=lambda k: components[k])
             scores.append((sum(components.values()), r["rank"], phase))
@@ -744,6 +782,7 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
             "mean_wire_ms": round(tot["wire_ms"] / n, 3),
             "tx_stall_ms": round(tot["tx_stall_ms"], 3),
             "ctrl_stall_ms": round(tot["ctrl_stall_ms"], 3),
+            "resize_stall_ms": round(tot["resize_ms"], 3),
             "mean_overlap_pct": (
                 round(tot["overlap_num"] / tot["overlap_den"], 1)
                 if tot["overlap_den"] else None

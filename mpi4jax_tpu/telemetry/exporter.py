@@ -68,7 +68,7 @@ def validate_snapshot(obj):
 
 def build_snapshot(rank, world, mode, metrics, link_stats=None,
                    last_events=(), dropped=0, step=None, job="",
-                   ts_unix_ns=None):
+                   ts_unix_ns=None, world_info=None):
     """Assemble a schema-valid snapshot from raw pieces.
 
     ``metrics`` is a native u64-word snapshot, a parsed snapshot dict,
@@ -103,6 +103,9 @@ def build_snapshot(rank, world, mode, metrics, link_stats=None,
         "ops": ops,
         "bytes_by_plane": reg.bytes_by_plane(),
         "link_stats": link_stats or {},
+        # elastic membership view (docs/failure-semantics.md "elastic
+        # membership"): {} outside elastic jobs / before init
+        "world_info": dict(world_info or {}),
         "last_events": schema.format_recent_events(events).split("; ")
         if events else [],
         "last_events_raw": [schema.event_to_list(e) for e in events],
@@ -140,6 +143,7 @@ def collect_snapshot():
         dropped=runtime.telemetry_dropped(),
         step=step,
         job=os.environ.get("T4J_JOB", ""),
+        world_info=runtime.world_info(),
     )
 
 
@@ -243,6 +247,18 @@ def render_prometheus(obj, prefix="t4j"):
             emit("worst_link_peer", base, agg.get("worst_peer"),
                  help_="peer rank of the worst link")
         emit("link_state_worst", base, agg.get("state"))
+    wi = obj.get("world_info") or {}
+    if wi:
+        # elastic membership gauges: dashboards follow the RESIZED
+        # world instead of flatlining on the bootstrap size
+        emit("world_size", base, wi.get("alive_count"),
+             help_="current world membership (elastic resizes shrink "
+                   "and regrow it)")
+        emit("world_epoch", base, wi.get("epoch"),
+             help_="membership epoch (0 = bootstrap; +1 per resize)")
+        emit("world_resizing", base,
+             1 if wi.get("resizing") else 0,
+             help_="1 while a membership agreement/rebuild is running")
     return "\n".join(lines) + "\n"
 
 
@@ -290,6 +306,19 @@ def aggregate_snapshots(objs, job=""):
     straggler = None
     if len(comm_ms) > 1:
         straggler = min(comm_ms, key=lambda r: comm_ms[r])
+    # elastic membership: the freshest epoch any rank reports wins
+    # (mid-resize scrapes can catch ranks on both sides of the fence)
+    world = {}
+    for obj in objs:
+        wi = obj.get("world_info") or {}
+        if wi and int(wi.get("epoch", 0)) >= int(world.get("epoch", -1)):
+            world = wi
+    departed = []
+    if world:
+        boot = int(world.get("boot_size", 0))
+        mask = int(world.get("alive_mask", 0))
+        if 0 < boot <= 64:
+            departed = [r for r in range(boot) if not (mask >> r) & 1]
     return {
         "schema": SNAPSHOT_SCHEMA + "+job",
         "job": job,
@@ -301,6 +330,9 @@ def aggregate_snapshots(objs, job=""):
         "comm_ms_by_rank": {str(r): comm_ms[r] for r in sorted(comm_ms)},
         "straggler": straggler,
         "worst_link": worst,
+        "world_size": world.get("alive_count"),
+        "world_epoch": world.get("epoch"),
+        "departed_ranks": departed,
     }
 
 
@@ -330,6 +362,15 @@ def render_prometheus_job(agg, prefix="t4j_job"):
     lines.append(f"{prefix}_worst_link_state {worst['state']}")
     if worst["rank"] is not None:
         lines.append(f"{prefix}_worst_link_rank {worst['rank']}")
+    if agg.get("world_size") is not None:
+        # the t4j_world_size / t4j_world_epoch membership gauges
+        # (docs/failure-semantics.md "elastic membership"): dashboards
+        # track the resized world; departed ranks stay visible as
+        # marked series instead of silently flatlining
+        lines.append(f"t4j_world_size {agg['world_size']}")
+        lines.append(f"t4j_world_epoch {agg['world_epoch']}")
+        for r in agg.get("departed_ranks", []):
+            lines.append(f't4j_rank_departed{{rank="{r}"}} 1')
     return "\n".join(lines) + "\n"
 
 

@@ -80,13 +80,26 @@ KIND_NAMES = {
     # the index in nbytes (the 32-byte native record has no string
     # field).
     60: "step",
+    # elastic world membership (docs/failure-semantics.md "elastic
+    # membership"), recorded from counters mode up like the other
+    # control events.  resize_begin/resize_done carry the forming/
+    # committed world epoch in `bytes` (done also carries the new
+    # member count in `peer`); rank_dead marks a rank leaving the
+    # membership (`peer` = the departed world rank, `bytes` = the
+    # epoch that removed it) — distinct from link_dead, which is one
+    # LINK's terminal verdict.
+    61: "resize_begin",
+    62: "resize_done",
+    63: "rank_dead",
 }
 KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
 
 # Op-level kinds: the ones that appear as begin/end pairs and as
 # metrics-table rows.
 OP_KINDS = frozenset(range(1, 15))
-CONTROL_KINDS = frozenset((30, 31, 32, 33, 34))
+CONTROL_KINDS = frozenset((30, 31, 32, 33, 34, 61, 62, 63))
+# Elastic membership instants (a subset of the control kinds).
+RESIZE_BEGIN_KIND, RESIZE_DONE_KIND, RANK_DEAD_KIND = 61, 62, 63
 # Async engine instants (docs/async.md): per-request lifecycle markers.
 ASYNC_KINDS = frozenset((50, 51, 52))
 # Caller-lane blocked-wait spans (begin/end pairs like op scopes).

@@ -90,6 +90,16 @@ def summarize(rank_objs):
         events = [schema.event_from_list(r) for r in obj["events"]]
         faults = sum(1 for e in events
                      if e.kind == schema.KIND_IDS["fault"])
+        # elastic membership (docs/failure-semantics.md): resize_done
+        # carries the committed epoch in bytes and the new member
+        # count in peer; rank_dead names each departure
+        resizes = sum(1 for e in events
+                      if e.kind == schema.RESIZE_BEGIN_KIND)
+        dones = [e for e in events if e.kind == schema.RESIZE_DONE_KIND]
+        world_epoch = int(dones[-1].bytes) if dones else 0
+        world_size = int(dones[-1].peer) if dones else None
+        dead_ranks = sorted({int(e.peer) for e in events
+                             if e.kind == schema.RANK_DEAD_KIND})
         t_lo = min((e.t_ns for e in events), default=0)
         t_hi = max((e.t_ns for e in events), default=0)
         span_s = (t_hi - t_lo) / 1e9 if t_hi > t_lo else 0.0
@@ -172,6 +182,10 @@ def summarize(rank_objs):
             "span_s": span_s,
             "reconnects": ((obj.get("link_stats") or {})
                            .get("aggregate") or {}).get("reconnects", 0),
+            "resizes": resizes,
+            "world_epoch": world_epoch,
+            "world_size": world_size,
+            "dead_ranks": dead_ranks,
         })
     ops = []
     for op in reg.ops():
@@ -234,6 +248,14 @@ def render(summary):
         out.append("  plane bytes: " + "  ".join(
             f"{k}={_fmt_bytes(v)}" for k, v in sorted(plane.items())
         ))
+    resized = [r for r in ranks if r.get("world_epoch")]
+    if resized:
+        r = max(resized, key=lambda x: x["world_epoch"])
+        departed = ", ".join(f"r{d}" for d in r.get("dead_ranks", []))
+        out.append(
+            f"  elastic: world epoch {r['world_epoch']}, "
+            f"{r['world_size']} member(s); departed: {departed or '-'}"
+        )
     if summary["ops"]:
         out.append("")
         out.append(f"  {'op':<16}{'plane':<7}{'count':>8}{'bytes':>10}"

@@ -127,7 +127,9 @@ def autotune_and_store(progress=None):
 
     knobs, measurements = calibrate.autotune(progress=progress)
     topo = runtime.topology()
-    fp = topology_fingerprint(topo, runtime.world_size())
+    # the EFFECTIVE world (elastic resizes shrink it): a resized job
+    # fingerprints — and caches — as the topology it actually runs on
+    fp = topology_fingerprint(topo, runtime.effective_world_size())
     directory = cache.cache_dir()
     if directory is not None and runtime.world_rank() == 0:
         merged = dict(KNOB_DEFAULTS)
@@ -170,7 +172,11 @@ def startup(progress=None):
         return None
 
     topo = runtime.topology()
-    world = runtime.world_size()
+    # the EFFECTIVE world: after an elastic resize the topology
+    # fingerprint changes with the membership, so
+    # runtime.refresh_after_resize() re-resolving through here lands
+    # on the resized world's own cache entry
+    world = runtime.effective_world_size()
     fp = topology_fingerprint(topo, world)
     directory = cache.cache_dir()
     cache_file = None

@@ -39,6 +39,30 @@ transport" — the retry -> reconnect+replay -> abort escalation ladder):
                           can lose in flight (the two kernel socket
                           buffers) or recovery escalates to abort.
 
+Elastic world membership (docs/failure-semantics.md "elastic
+membership" — the shrink/rejoin rung between reconnect+replay and
+abort):
+
+* ``T4J_ELASTIC``        — ``off`` (default: a dead rank aborts the
+                           whole job, today's exact behaviour),
+                           ``shrink`` (survivors agree on a reduced
+                           world and continue; the Python tier raises
+                           ``WorldResized`` at the next op), or
+                           ``rejoin`` (shrink, plus rank 0 keeps the
+                           bootstrap coordinator port open so a
+                           relaunched replacement re-bootstraps into
+                           the mesh at the next epoch fence).
+                           Requires ``T4J_RETRY_MAX > 0`` (escalation
+                           — elastic's trigger — is the self-healing
+                           ladder's last rung) and a world of at most
+                           64 ranks.
+* ``T4J_MIN_WORLD``      — survivor floor (default 1): a shrink that
+                           would leave fewer members than this fires
+                           the legacy abort instead.
+* ``T4J_RESIZE_TIMEOUT`` — per-phase bound on the membership
+                           agreement / link rebuild in seconds
+                           (default 30).
+
 Data-plane tuning for the TCP-tier collectives (docs/performance.md
 "TCP-tier algorithm selection"):
 
@@ -146,6 +170,9 @@ __all__ = [
     "backoff_base",
     "backoff_max",
     "replay_bytes",
+    "elastic_mode",
+    "min_world",
+    "resize_timeout",
     "bucket_bytes",
     "verify_mode",
     "telemetry_mode",
@@ -302,6 +329,55 @@ def backoff_max():
         raise ValueError(
             "T4J_BACKOFF_MAX must be >= T4J_BACKOFF_BASE "
             f"(got {v} < {backoff_base()})"
+        )
+    return v
+
+
+def elastic_mode():
+    """Elastic world-membership mode (docs/failure-semantics.md
+    "elastic membership"): ``off`` (default — a dead rank aborts the
+    whole job), ``shrink`` (survivors agree on a reduced world and
+    continue) or ``rejoin`` (shrink, plus a relaunched replacement can
+    re-bootstrap into the mesh).  Anything else raises — a typo'd mode
+    must fail at launch, not silently fall back to fail-stop."""
+    v = os.environ.get("T4J_ELASTIC")
+    if v is None or not str(v).strip():
+        return "off"
+    v = str(v).strip().lower()
+    if v not in ("off", "shrink", "rejoin"):
+        raise ValueError(
+            f"cannot interpret T4J_ELASTIC={v!r} (want off|shrink|rejoin)"
+        )
+    return v
+
+
+def min_world():
+    """Survivor floor for an elastic shrink (default 1, must be >= 1):
+    a shrink that would leave fewer members than this fires the legacy
+    abort instead — the job is presumed no longer viable at that
+    size."""
+    v = int_count(os.environ.get("T4J_MIN_WORLD"), 1,
+                  name="T4J_MIN_WORLD")
+    if v < 1:
+        raise ValueError(
+            "T4J_MIN_WORLD must be >= 1 (a world cannot shrink to "
+            "nothing)"
+        )
+    return v
+
+
+def resize_timeout():
+    """Per-phase bound on the elastic membership agreement and link
+    rebuild, in seconds (default 30, strictly positive): past it the
+    resize escalates to the legacy abort."""
+    v = seconds(
+        os.environ.get("T4J_RESIZE_TIMEOUT"), 30.0,
+        name="T4J_RESIZE_TIMEOUT",
+    )
+    if v <= 0:
+        raise ValueError(
+            "T4J_RESIZE_TIMEOUT must be > 0 (the membership agreement "
+            "cannot wait forever for a dead rank's reports)"
         )
     return v
 

@@ -748,3 +748,236 @@ time.sleep(60)
     assert rc == 9, (rc, out[-1000:], err[-2000:])
     assert "restart budget exhausted" in err, err[-2000:]
     assert "attempt 2/2" in err, err[-2000:]
+
+
+# ------------------------------------------------ elastic membership
+
+
+def test_dead_rank_shrinks_world(tmp_path):
+    """T4J_ELASTIC=shrink (docs/failure-semantics.md "elastic
+    membership"): an 8-rank job loses rank 3 mid-run and COMPLETES at
+    7 ranks with zero full restarts.  Every survivor's in-flight op
+    drains with a ResizeInterrupted status, check_health surfaces
+    WorldResized at the next op, communicators rebuilt over the
+    survivors produce the exact survivor-set reduction, the tuning
+    layer re-resolves against the shrunk topology fingerprint, and the
+    exporter snapshot reports the reduced membership (dashboards see
+    t4j_world_size drop instead of flatlining)."""
+    body = PREAMBLE + f"""
+from mpi4jax_tpu.native.runtime import WorldResized
+from mpi4jax_tpu import tuning
+from mpi4jax_tpu.telemetry import exporter
+
+fp_before = (tuning.effective() or {{}}).get("fingerprint")
+x = jnp.ones((32 * 1024,), jnp.float32)
+resized = False
+done = 0
+t0 = time.monotonic()
+while done < 6:
+    assert time.monotonic() - t0 < 120, "timed out before completing"
+    try:
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        v = float(np.asarray(y)[0])
+        assert v == float(comm.size), (v, comm.size)
+        if resized:
+            done += 1
+    except WorldResized as e:
+        resized = True
+        assert 3 not in e.new_world and len(e.new_world) == size - 1, e
+        runtime.refresh_after_resize()
+        comm = m.get_default_comm()
+        assert comm.size == size - 1, comm.ranks
+    except Exception as e:
+        if "ResizeInterrupted" not in str(e):
+            raise
+        runtime.resize_wait()
+        try:
+            runtime.check_health()
+        except WorldResized as w:
+            resized = True
+            assert 3 not in w.new_world, w
+            runtime.refresh_after_resize()
+            comm = m.get_default_comm()
+assert resized, "the world never resized"
+info = runtime.world_info()
+assert info["epoch"] == 1 and info["alive_count"] == size - 1, info
+# the tuning layer re-resolved for the shrunk topology fingerprint
+fp_after = (tuning.effective() or {{}}).get("fingerprint")
+assert fp_after and fp_after != fp_before, (fp_before, fp_after)
+# the exporter's snapshot tracks the membership (job dashboards
+# aggregate these into t4j_world_size / t4j_world_epoch)
+snap = exporter.collect_snapshot()
+assert snap["world_info"]["alive_count"] == size - 1, snap["world_info"]
+assert snap["world_info"]["epoch"] == 1
+text = exporter.render_prometheus(snap)
+assert "world_size" in text and "world_epoch" in text
+print(f"SHRUNK-OK {{rank}} epoch={{info['epoch']}} "
+      f"alive={{info['alive_count']}}", flush=True)
+sys.exit(0)
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=8, timeout=240,
+        env_common={
+            "T4J_ELASTIC": "shrink",
+            "T4J_MIN_WORLD": "2",
+            "T4J_RESIZE_TIMEOUT": "15",
+            "T4J_CONNECT_TIMEOUT": "8",
+            "T4J_RETRY_MAX": "2",
+            "T4J_BACKOFF_BASE": "0.05",
+            "T4J_BACKOFF_MAX": "0.3",
+            "T4J_FAULT_MODE": "die_after",
+            "T4J_FAULT_RANK": "3",
+            "T4J_FAULT_DELAY_MS": "2500",
+        },
+    )
+    rc3, _, err3 = res[3]
+    assert rc3 == 42, (rc3, err3[-2000:])  # the planted death
+    for r in (0, 1, 2, 4, 5, 6, 7):
+        rc, out, err = res[r]
+        assert rc == 0, (r, rc, out[-2000:], err[-3000:])
+        assert "SHRUNK-OK" in out, (r, out[-2000:])
+        assert "escalating to abort" not in err, (r, err[-2000:])
+
+
+def test_shrink_below_min_world_aborts(tmp_path):
+    """A shrink that would leave fewer survivors than T4J_MIN_WORLD
+    fires the LEGACY abort instead, naming the floor: the job is
+    presumed no longer viable at that size, and the launcher's
+    --restarts whole-world relaunch takes over from here."""
+    body = PREAMBLE + f"""
+x = jnp.ones((1024,), jnp.float32)
+try:
+    for i in range(500):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+    print("NO-RAISE", flush=True)
+    sys.exit({NO_RAISE})
+except Exception as e:
+    print(f"OP-RAISED: {{type(e).__name__}}: {{e}}", flush=True)
+    sys.exit({RAISED})
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=4, timeout=240,
+        env_common={
+            "T4J_ELASTIC": "shrink",
+            "T4J_MIN_WORLD": "4",  # any death puts the world below it
+            "T4J_RESIZE_TIMEOUT": "10",
+            "T4J_CONNECT_TIMEOUT": "8",
+            "T4J_RETRY_MAX": "2",
+            "T4J_BACKOFF_BASE": "0.05",
+            "T4J_BACKOFF_MAX": "0.3",
+            "T4J_FAULT_MODE": "die_after",
+            "T4J_FAULT_RANK": "2",
+            "T4J_FAULT_DELAY_MS": "2000",
+        },
+    )
+    rc2, _, _ = res[2]
+    assert rc2 == 42
+    floor_named = False
+    for r in (0, 1, 3):
+        rc, out, err = res[r]
+        assert rc == RAISED, (r, rc, out[-2000:], err[-2000:])
+        if "T4J_MIN_WORLD" in (out + err):
+            floor_named = True
+    assert floor_named, "no survivor named the T4J_MIN_WORLD floor"
+
+
+def test_elastic_off_abort_report_stable(tmp_path):
+    """T4J_ELASTIC=off preserves today's abort behaviour exactly: the
+    legacy escalation line, with no elastic/resize wording anywhere —
+    the fault/resilience matrices must read byte-identically to the
+    pre-elastic layer."""
+    import re
+
+    body = PREAMBLE + f"""
+x = jnp.ones((1024,), jnp.float32)
+try:
+    for i in range(500):
+        y, _ = m.allreduce(x, op=m.SUM, comm=comm)
+        np.asarray(y)
+    print("NO-RAISE", flush=True)
+    sys.exit({NO_RAISE})
+except Exception as e:
+    print(f"OP-RAISED: {{type(e).__name__}}: {{e}}", flush=True)
+    sys.exit({RAISED})
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=3, timeout=240,
+        env_common={
+            "T4J_ELASTIC": "off",
+            "T4J_CONNECT_TIMEOUT": "8",
+            "T4J_RETRY_MAX": "2",
+            "T4J_BACKOFF_BASE": "0.05",
+            "T4J_BACKOFF_MAX": "0.3",
+            "T4J_FAULT_MODE": "die_after",
+            "T4J_FAULT_RANK": "1",
+            "T4J_FAULT_DELAY_MS": "2000",
+        },
+    )
+    rc1, _, _ = res[1]
+    assert rc1 == 42
+    legacy = re.compile(
+        r"link to peer r\d+ could not be repaired \(.*\) — "
+        r"escalating to abort$", re.M)
+    for r in (0, 2):
+        rc, out, err = res[r]
+        assert rc == RAISED, (r, rc, out[-2000:], err[-2000:])
+        blob = out + err
+        assert legacy.search(blob), (r, blob[-2000:])
+        for word in ("T4J_ELASTIC", "ResizeInterrupted", "resize"):
+            assert word not in blob, (r, word, blob[-2000:])
+
+
+def test_elastic_training_loop_survives_and_rejoins(tmp_path):
+    """The full acceptance flow through the launcher and the elastic
+    training loop (models/train.run_elastic): an 8-rank training job
+    loses rank 3 mid-run under ``--elastic rejoin``, the survivors
+    shrink and continue from the last agreed checkpoint, the launcher
+    relaunches ONLY the dead slot (T4J_REJOIN=1), the replacement
+    re-bootstraps through rank 0's kept-open coordinator port, and the
+    job finishes with every slot exiting 0 — zero full restarts.  The
+    launcher's summary prints the membership/epoch history."""
+    pytest.importorskip("orbax.checkpoint")
+    ckpt = tmp_path / "ckpt"
+    marker = tmp_path / "died_once"
+    prog = tmp_path / "train_prog.py"
+    prog.write_text(textwrap.dedent(f"""
+        import os
+        import threading
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from mpi4jax_tpu.models.train import run_elastic
+
+        rank = int(os.environ.get("T4J_RANK", "-1"))
+        marker = {str(marker)!r}
+        if rank == 3 and not os.path.exists(marker):
+            open(marker, "w").write("x")
+            # die mid-run, once: the relaunched replacement sees the
+            # marker and lives
+            threading.Timer(4.0, lambda: os._exit(42)).start()
+        out = run_elastic(16, {str(ckpt)!r}, d=16, layers=1, batch=2,
+                          save_every=2)
+        print("ELASTIC-TRAIN-OK", rank, out["final_world"],
+              out["final_epoch"], out["resizes"], flush=True)
+    """))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(
+        T4J_MIN_WORLD="2", T4J_RESIZE_TIMEOUT="15",
+        T4J_CONNECT_TIMEOUT="10", T4J_RETRY_MAX="2",
+        T4J_BACKOFF_BASE="0.05", T4J_BACKOFF_MAX="0.3",
+    )
+    p = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+         "--elastic", "rejoin", "--timeout", "300", str(prog)],
+        capture_output=True, text=True, env=env, cwd=str(REPO),
+        timeout=360,
+    )
+    blob = p.stdout + p.stderr
+    assert p.returncode == 0, blob[-4000:]
+    assert "relaunching rank 3 as a rejoin replacement" in blob, blob[-4000:]
+    assert "world membership history" in blob, blob[-4000:]
+    assert "rejoin(8)" in blob, blob[-4000:]
+    assert blob.count("ELASTIC-TRAIN-OK") >= 8, blob[-4000:]

@@ -423,3 +423,101 @@ def test_ensure_initialized_rejects_bad_tuning(monkeypatch):
     monkeypatch.setenv("T4J_RING_MIN_BYTES", "not-a-size")
     with pytest.raises(ValueError, match="T4J_RING_MIN_BYTES"):
         runtime.ensure_initialized()
+
+
+class TestElasticMode:
+    """T4J_ELASTIC (docs/failure-semantics.md "elastic membership"):
+    the shrink/rejoin rung of the escalation ladder, following the
+    PR-5 knob pattern — validated loudly before the native bridge ever
+    sees the value."""
+
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("T4J_ELASTIC", raising=False)
+        assert config.elastic_mode() == "off"
+
+    @pytest.mark.parametrize("mode", ["off", "shrink", "rejoin"])
+    def test_modes(self, monkeypatch, mode):
+        monkeypatch.setenv("T4J_ELASTIC", mode)
+        assert config.elastic_mode() == mode
+
+    def test_case_and_space_tolerant(self, monkeypatch):
+        monkeypatch.setenv("T4J_ELASTIC", "  Shrink ")
+        assert config.elastic_mode() == "shrink"
+
+    @pytest.mark.parametrize("bad", ["on", "1", "grow", "elastic"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        # a typo'd mode must fail at launch, not silently run
+        # fail-stop and abort the job on the first dead rank
+        monkeypatch.setenv("T4J_ELASTIC", bad)
+        with pytest.raises(ValueError, match="T4J_ELASTIC"):
+            config.elastic_mode()
+
+
+class TestMinWorld:
+    def test_default_is_1(self, monkeypatch):
+        monkeypatch.delenv("T4J_MIN_WORLD", raising=False)
+        assert config.min_world() == 1
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_MIN_WORLD", "4")
+        assert config.min_world() == 4
+
+    @pytest.mark.parametrize("bad", ["0", "-1", "half", "2.5"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        # the floor must stay >= 1: a world cannot shrink to nothing,
+        # and a typo must not silently disable the floor
+        monkeypatch.setenv("T4J_MIN_WORLD", bad)
+        with pytest.raises(ValueError, match="T4J_MIN_WORLD"):
+            config.min_world()
+
+
+class TestResizeTimeout:
+    def test_default_is_30(self, monkeypatch):
+        monkeypatch.delenv("T4J_RESIZE_TIMEOUT", raising=False)
+        assert config.resize_timeout() == pytest.approx(30.0)
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_RESIZE_TIMEOUT", "7.5")
+        assert config.resize_timeout() == pytest.approx(7.5)
+
+    @pytest.mark.parametrize("bad", ["0", "-3", "soon"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        # the agreement cannot wait forever for a dead rank's report
+        monkeypatch.setenv("T4J_RESIZE_TIMEOUT", bad)
+        with pytest.raises(ValueError, match="T4J_RESIZE_TIMEOUT"):
+            config.resize_timeout()
+
+
+def test_ensure_initialized_rejects_elastic_without_retries(monkeypatch):
+    """T4J_ELASTIC needs the self-healing ladder: its trigger is the
+    escalation after exhausted reconnect retries, and T4J_RETRY_MAX=0
+    removes that ladder — the combination must fail at launch instead
+    of silently never going elastic."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_ELASTIC", "shrink")
+    monkeypatch.setenv("T4J_RETRY_MAX", "0")
+    with pytest.raises(ValueError, match="T4J_RETRY_MAX"):
+        runtime.ensure_initialized()
+
+
+def test_ensure_initialized_rejects_bad_elastic(monkeypatch):
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_ELASTIC", "grow")
+    with pytest.raises(ValueError, match="T4J_ELASTIC"):
+        runtime.ensure_initialized()

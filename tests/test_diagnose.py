@@ -671,3 +671,117 @@ class TestStepMarkers:
         idx = step_mod.annotate_step("solo")
         step_mod.end_step()
         assert idx == 0
+
+
+class TestResizePhase:
+    """Elastic resize windows (docs/failure-semantics.md "elastic
+    membership") are their OWN diagnosis phase: the membership
+    agreement/rebuild time must not be misbinned as link repair, and
+    repair windows overlapping a resize are clipped against it."""
+
+    RB = schema.RESIZE_BEGIN_KIND
+    RD = schema.RESIZE_DONE_KIND
+
+    def test_resize_window_is_its_own_phase(self):
+        LB = schema.KIND_IDS["link_break"]
+        RC = schema.KIND_IDS["reconnect"]
+        events = [
+            ev(0.0, STEP, B, nbytes=0),
+            # a resize spanning 10..40 ms; the link to the dead peer
+            # breaks inside it and "recovers" (the rebuild) inside it
+            ev(10.0, self.RB, 0, peer=-1, nbytes=1),
+            ev(12.0, LB, 0, peer=3),
+            ev(38.0, RC, 0, peer=3),
+            ev(40.0, self.RD, 0, peer=7, nbytes=1),
+            ev(80.0, STEP, E, nbytes=0),
+        ]
+        report = diagnose.diagnose(
+            [diagnose.rank_view_from_obj(rank_obj(0, events, world=1))]
+        )
+        row = report["steps"][0]["ranks"][0]
+        assert row["resize_ms"] == pytest.approx(30.0)
+        # the 26 ms break->reconnect window lies INSIDE the resize:
+        # clipped to zero repair, not double-attributed
+        links = {link["peer"]: link for link in report["links"]}
+        assert links[3]["repair_ms"] == pytest.approx(0.0)
+        assert report["rank_summary"][0]["resize_stall_ms"] == \
+            pytest.approx(30.0)
+
+    def test_repair_outside_resize_still_counts(self):
+        LB = schema.KIND_IDS["link_break"]
+        RC = schema.KIND_IDS["reconnect"]
+        events = [
+            ev(0.0, STEP, B, nbytes=0),
+            ev(5.0, LB, 0, peer=1),
+            ev(9.0, RC, 0, peer=1),   # plain 4 ms repair, no resize
+            ev(20.0, self.RB, 0, peer=-1, nbytes=1),
+            ev(30.0, self.RD, 0, peer=2, nbytes=1),
+            ev(60.0, STEP, E, nbytes=0),
+        ]
+        report = diagnose.diagnose(
+            [diagnose.rank_view_from_obj(rank_obj(0, events, world=1))]
+        )
+        row = report["steps"][0]["ranks"][0]
+        assert row["resize_ms"] == pytest.approx(10.0)
+        links = {link["peer"]: link for link in report["links"]}
+        assert links[1]["repair_ms"] == pytest.approx(4.0)
+
+    def test_unclosed_resize_stalls_to_step_end(self):
+        events = [
+            ev(0.0, STEP, B, nbytes=0),
+            ev(10.0, self.RB, 0, peer=-1, nbytes=1),
+            ev(50.0, STEP, E, nbytes=0),
+        ]
+        report = diagnose.diagnose(
+            [diagnose.rank_view_from_obj(rank_obj(0, events, world=1))]
+        )
+        row = report["steps"][0]["ranks"][0]
+        assert row["resize_ms"] == pytest.approx(40.0)
+
+
+class TestExporterMembership:
+    """The membership gauges (docs/observability.md): per-rank
+    t4j_world_* series, job-level aggregation, and departed-rank
+    marking — dashboards must follow the resized world instead of
+    flatlining."""
+
+    def _snap(self, rank, epoch=1, alive=7, mask=0xF7, boot=8):
+        return exporter.build_snapshot(
+            rank=rank, world=boot, mode="counters", metrics=[],
+            world_info={"epoch": epoch, "boot_size": boot,
+                        "alive_count": alive, "alive_mask": mask,
+                        "resizing": False},
+        )
+
+    def test_rank_prometheus_world_gauges(self):
+        text = exporter.render_prometheus(self._snap(0))
+        assert 't4j_world_size{rank="0"} 7' in text
+        assert 't4j_world_epoch{rank="0"} 1' in text
+        assert 't4j_world_resizing{rank="0"} 0' in text
+
+    def test_snapshot_without_world_info_unchanged(self):
+        snap = exporter.build_snapshot(rank=0, world=2,
+                                       mode="counters", metrics=[])
+        assert snap["world_info"] == {}
+        assert "t4j_world_size" not in exporter.render_prometheus(snap)
+
+    def test_job_aggregate_tracks_membership(self):
+        # freshest epoch wins even when a stale-scrape rank still
+        # reports the pre-resize view
+        stale = self._snap(1, epoch=0, alive=8, mask=0xFF)
+        agg = exporter.aggregate_snapshots(
+            [self._snap(0), stale], job="j")
+        assert agg["world_size"] == 7
+        assert agg["world_epoch"] == 1
+        assert agg["departed_ranks"] == [3]
+        text = exporter.render_prometheus_job(agg)
+        assert "t4j_world_size 7" in text
+        assert "t4j_world_epoch 1" in text
+        assert 't4j_rank_departed{rank="3"} 1' in text
+
+    def test_job_aggregate_without_world_info(self):
+        agg = exporter.aggregate_snapshots(
+            [exporter.build_snapshot(rank=0, world=2, mode="counters",
+                                     metrics=[])], job="j")
+        assert agg["world_size"] is None
+        assert "t4j_world_size" not in exporter.render_prometheus_job(agg)

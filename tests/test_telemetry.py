@@ -490,3 +490,40 @@ class TestTop:
 
     def test_cli_missing_dir_errors(self, tmp_path, capsys):
         assert top.main([str(tmp_path / "nope")]) == 2
+
+
+class TestElasticEvents:
+    """Elastic-membership control events (docs/failure-semantics.md
+    "elastic membership"): kinds 61-63 decode by name, count as
+    control events, and t4j-top derives the membership line from
+    them."""
+
+    def test_kind_names_and_control_class(self):
+        assert schema.kind_name(61) == "resize_begin"
+        assert schema.kind_name(62) == "resize_done"
+        assert schema.kind_name(63) == "rank_dead"
+        assert {61, 62, 63} <= schema.CONTROL_KINDS
+
+    def test_top_membership_line(self):
+        anchor = 10_000
+        events = [
+            # epoch-1 shrink: begin, rank 3 departs, done with 7 left
+            schema.Event(anchor + 1_000, 61, 0, 5, -1, -1, 5, 1),
+            schema.Event(anchor + 1_200, 63, 0, 5, -1, 3, 5, 1),
+            schema.Event(anchor + 2_000, 62, 0, 5, -1, 7, 5, 1),
+        ]
+        obj = make_rank_obj(0, world=8, events=events)
+        summary = top.summarize([obj])
+        r0 = summary["ranks"][0]
+        assert r0["resizes"] == 1
+        assert r0["world_epoch"] == 1
+        assert r0["world_size"] == 7
+        assert r0["dead_ranks"] == [3]
+        text = top.render(summary)
+        assert "elastic: world epoch 1, 7 member(s)" in text
+        assert "departed: r3" in text
+
+    def test_top_without_resizes_stays_silent(self):
+        summary = top.summarize([make_rank_obj(0)])
+        assert summary["ranks"][0]["world_epoch"] == 0
+        assert "elastic:" not in top.render(summary)

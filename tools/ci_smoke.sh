@@ -65,7 +65,19 @@
 #                trajectory point every PR records.  The record must
 #                appear and be valid JSON even when the flagship or
 #                the native legs cannot run (explicit "skipped" keys).
-#  12. autotune — tools/autotune_smoke.py twice: plain and under
+#  12. elastic — tools/elastic_smoke.py twice: plain and under
+#                AddressSanitizer.  Elastic world membership
+#                (docs/failure-semantics.md "elastic membership"):
+#                an 8-rank job loses a rank mid-collective and
+#                completes at 7 (T4J_ELASTIC=shrink, shm and TCP
+#                transports), a shrink below T4J_MIN_WORLD aborts
+#                naming the floor, T4J_ELASTIC=off reproduces the
+#                legacy abort report byte-for-byte, and a relaunched
+#                replacement re-bootstraps through the kept-open
+#                coordinator port and rejoins at epoch 2
+#                (T4J_ELASTIC=rejoin).  ctypes only — runs on old-jax
+#                containers.
+#  13. autotune — tools/autotune_smoke.py twice: plain and under
 #                AddressSanitizer.  An 8-rank calibrate phase (the
 #                collective knob fit measured through the telemetry
 #                metrics table must converge to ONE vector across
@@ -86,7 +98,7 @@ cd "$(dirname "$0")/.."
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
   lanes=(tier1 fault proc asan tsan lint resilience telemetry async
-         diagnose bench autotune)
+         diagnose bench elastic autotune)
 fi
 
 run_lane() {
@@ -162,6 +174,12 @@ for lane in "${lanes[@]}"; do
         'import json; rec = json.load(open("BENCH_quick.json")); \
 assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
       ;;
+    elastic)
+      run_lane elastic-plain env -u T4J_SANITIZE timeout -k 10 1200 \
+        python tools/elastic_smoke.py 8
+      run_lane elastic-asan env T4J_SANITIZE=address timeout -k 10 1800 \
+        python tools/elastic_smoke.py 8
+      ;;
     autotune)
       run_lane autotune-plain env -u T4J_SANITIZE timeout -k 10 900 \
         python tools/autotune_smoke.py 8
@@ -169,7 +187,7 @@ assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
         python tools/autotune_smoke.py 8
       ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|autotune)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune)" >&2
       exit 2
       ;;
   esac

@@ -1,0 +1,444 @@
+#!/usr/bin/env python
+"""Elastic-membership smoke lane: shrink-to-survive and rejoin
+end-to-end (docs/failure-semantics.md "elastic membership").
+
+Five phases over an N-rank (default 8) proc world driven through the
+native bridge's ctypes C API (no jax import in the workers, so the
+lane runs on old-jax containers and under sanitizer preloads alike):
+
+  1. shrink      — rank 3 dies mid-collective (T4J_FAULT_MODE=
+                   die_after) under T4J_ELASTIC=shrink.  Every
+                   survivor's in-flight op must drain with a
+                   ResizeInterrupted status, the membership agreement
+                   must settle on epoch 1 with N-1 members, and the
+                   survivors must complete further collectives on the
+                   shrunk world with the exact survivor-sum — ZERO
+                   aborts, zero restarts.  Runs with the same-host
+                   shm transports on (arena + pipes rebuilt over the
+                   survivors).
+  2. shrink-tcp  — the same under T4J_NO_SHM=1 on the segmented ring
+                   path (the interruption lands mid-segment-stream).
+  3. min-world   — same death with T4J_MIN_WORLD above the survivor
+                   count: the legacy abort must fire, naming the knob.
+  4. off         — same death with T4J_ELASTIC=off: the legacy abort
+                   report must be BYTE-STABLE (the pre-elastic
+                   escalation line, with no elastic/resize wording).
+  5. rejoin      — T4J_ELASTIC=rejoin: after the shrink, the driver
+                   relaunches the dead slot with T4J_REJOIN=1.  The
+                   replacement re-bootstraps through rank 0's
+                   kept-open coordinator port with a fresh incarnation
+                   token, the world grows back to N at epoch 2, and
+                   EVERY member (replacement included) completes
+                   collectives on the regrown world.
+
+Run under AddressSanitizer by exporting ``T4J_SANITIZE=address``
+before invoking (tools/ci_smoke.sh does).
+
+Usage: python tools/elastic_smoke.py [nprocs] [--phase NAME]
+"""
+
+import importlib.util
+import os
+import pathlib
+import re
+import socket
+import subprocess
+import sys
+import time
+import types
+import uuid
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+RAISED = 23          # worker exit: fatal bridge error surfaced
+DIED = 42            # the die_after victim's exit code
+GOAL = 6             # successful collectives required at the target epoch
+COUNT = 16 * 1024    # f64 elements per allreduce (128 KB)
+PHASES = ["shrink", "shrink-tcp", "min-world", "off", "rejoin"]
+
+
+def _load_build_module():
+    """mpi4jax_tpu.native.build via package stubs (old-jax containers:
+    the package __init__ refuses, but build/config are version-free)."""
+    try:
+        from mpi4jax_tpu.native import build  # noqa: PLC0415
+
+        return build
+    except Exception:
+        pass
+    for name in ("mpi4jax_tpu", "mpi4jax_tpu.utils", "mpi4jax_tpu.native"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [str(REPO / name.replace(".", "/"))]
+            sys.modules[name] = mod
+    for name, rel in (
+        ("mpi4jax_tpu.utils.config", "mpi4jax_tpu/utils/config.py"),
+        ("mpi4jax_tpu.native.build", "mpi4jax_tpu/native/build.py"),
+    ):
+        if name in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(name, REPO / rel)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["mpi4jax_tpu.native.build"]
+
+
+def _sanitizer_env():
+    san = os.environ.get("T4J_SANITIZE", "").strip().lower()
+    if not san:
+        return {}
+    lib = {"address": "libasan.so", "asan": "libasan.so",
+           "1": "libasan.so", "thread": "libtsan.so",
+           "tsan": "libtsan.so"}.get(san)
+    if lib is None:
+        return {}
+    paths = []
+    for name in (lib, "libstdc++.so.6"):
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={name}"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+        if out and out != name:
+            paths.append(out)
+    if not paths:
+        return {}
+    return {
+        "LD_PRELOAD": " ".join(paths),
+        "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
+        "TSAN_OPTIONS": "report_bugs=1",
+    }
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ worker
+
+
+def _load_lib(so):
+    import ctypes
+
+    lib = ctypes.CDLL(so)
+    i32, u32, u64, vp = (ctypes.c_int32, ctypes.c_uint32,
+                         ctypes.c_uint64, ctypes.c_void_p)
+    lib.t4j_init.restype = ctypes.c_int
+    lib.t4j_last_error.restype = ctypes.c_char_p
+    lib.t4j_health.restype = i32
+    lib.t4j_c_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_c_allreduce.restype = i32
+    lib.t4j_c_allgather.argtypes = [i32, vp, vp, u64]
+    lib.t4j_c_allgather.restype = i32
+    lib.t4j_world_info.argtypes = [
+        ctypes.POINTER(u32), ctypes.POINTER(i32), ctypes.POINTER(u64),
+        ctypes.POINTER(i32), ctypes.POINTER(u64),
+    ]
+    lib.t4j_world_info.restype = i32
+    lib.t4j_resize_wait.argtypes = [ctypes.c_double]
+    lib.t4j_resize_wait.restype = i32
+    return lib
+
+
+def _world_info(lib):
+    import ctypes
+
+    epoch = ctypes.c_uint32(0)
+    alive = ctypes.c_int32(0)
+    mask = ctypes.c_uint64(0)
+    resizing = ctypes.c_int32(0)
+    stale = ctypes.c_uint64(0)
+    lib.t4j_world_info(ctypes.byref(epoch), ctypes.byref(alive),
+                       ctypes.byref(mask), ctypes.byref(resizing),
+                       ctypes.byref(stale))
+    return epoch.value, alive.value, mask.value, bool(resizing.value)
+
+
+def worker(so):
+    import numpy as np
+
+    def ptr(a):
+        return a.ctypes.data_as(__import__("ctypes").c_void_p)
+
+    lib = _load_lib(so)
+    rc = lib.t4j_init()
+    if rc != 0:
+        print(f"init rc={rc}: {lib.t4j_last_error().decode()}",
+              flush=True)
+        sys.exit(RAISED)
+    rank = lib.t4j_world_rank()
+    n = lib.t4j_world_size()
+    target_epoch = int(os.environ.get("SMOKE_TARGET_EPOCH", "0"))
+    t0 = time.monotonic()
+
+    def mask_sum(mask):
+        return float(sum(r + 1 for r in range(n) if (mask >> r) & 1))
+
+    done_final = 0
+    total_ok = 0
+    interruptions = 0
+    try:
+        while done_final < GOAL:
+            if time.monotonic() - t0 > 90:
+                raise RuntimeError(
+                    f"timed out before {GOAL} collectives at epoch "
+                    f"{target_epoch} (reached epoch "
+                    f"{_world_info(lib)[0]})"
+                )
+            pre_epoch, _, pre_mask, _ = _world_info(lib)
+            data = np.full(COUNT, float(rank + 1), np.float64)
+            out = np.empty_like(data)
+            st = lib.t4j_c_allreduce(0, ptr(data), ptr(out), COUNT,
+                                     1, 0)  # f64, SUM
+            if st:
+                err = lib.t4j_last_error().decode()
+                if "ResizeInterrupted" in err:
+                    interruptions += 1
+                    if not lib.t4j_resize_wait(45.0):
+                        raise RuntimeError(
+                            "resize did not settle within 45s"
+                        )
+                    if lib.t4j_health():
+                        raise RuntimeError(
+                            "bridge faulted during the resize: "
+                            + lib.t4j_last_error().decode()
+                        )
+                    continue  # reissue on the resized world
+                raise RuntimeError(err)
+            epoch, alive, mask, _ = _world_info(lib)
+            # a completed collective reduces over ONE membership: the
+            # pre-call world or (when a resize landed between the
+            # query and the call) the post-call world
+            want = (mask_sum(mask), mask_sum(pre_mask))
+            v = float(out[0])
+            if v not in want or not np.all(out == out[0]):
+                raise RuntimeError(
+                    f"allreduce value {v} matches no membership sum "
+                    f"{want} (epoch {pre_epoch}->{epoch})"
+                )
+            total_ok += 1
+            if epoch == target_epoch:
+                done_final += 1
+        # one allgather on the final world so a second collective
+        # shape crosses the rebuilt links/arena too
+        epoch, alive, mask, _ = _world_info(lib)
+        members = [r for r in range(n) if (mask >> r) & 1]
+        mine = np.full(256, float(rank), np.float64)
+        g = np.empty((len(members), 256), np.float64)
+        st = lib.t4j_c_allgather(0, ptr(mine), ptr(g), mine.nbytes)
+        if st:
+            raise RuntimeError(
+                f"allgather: {lib.t4j_last_error().decode()}"
+            )
+        assert np.array_equal(
+            g, np.broadcast_to(
+                np.asarray(members, np.float64)[:, None],
+                (len(members), 256))
+        ), "allgather over the resized world is wrong"
+        print(
+            f"ELASTIC-OK {rank} epoch={epoch} alive={alive} "
+            f"mask={mask:#x} interruptions={interruptions} "
+            f"collectives={total_ok} "
+            f"elapsed={time.monotonic() - t0:.2f}s",
+            flush=True,
+        )
+        lib.t4j_finalize()
+        sys.exit(0)
+    except (RuntimeError, AssertionError) as e:
+        print(f"OP-RAISED after {time.monotonic() - t0:.2f}s: {e}",
+              flush=True)
+        sys.exit(RAISED)
+
+
+# ------------------------------------------------------------------ driver
+
+
+def _spawn(so, rank, n, coord, job, extra_env):
+    env = dict(os.environ)
+    env.update(
+        T4J_RANK=str(rank), T4J_SIZE=str(n), T4J_COORD=coord,
+        T4J_JOB=job,
+        # tight, test-sized ladder: fast death detection without
+        # touching the defaults real jobs see
+        T4J_CONNECT_TIMEOUT="6", T4J_OP_TIMEOUT="30",
+        T4J_RETRY_MAX="2", T4J_BACKOFF_BASE="0.05",
+        T4J_BACKOFF_MAX="0.3", T4J_RESIZE_TIMEOUT="10",
+        # segmented ring with small segments: interruptions land
+        # mid-stream, not at op boundaries
+        T4J_RING_MIN_BYTES="0", T4J_SEG_BYTES="8192",
+        T4J_TELEMETRY="counters",
+    )
+    env.update(extra_env)
+    env.update(_sanitizer_env())
+    return subprocess.Popen(
+        [sys.executable, __file__, "worker", so],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def run_phase(phase, n, so):
+    victim = 3
+    coord = f"127.0.0.1:{_free_port()}"
+    job = uuid.uuid4().hex[:8]
+    elastic = {"shrink": "shrink", "shrink-tcp": "shrink",
+               "min-world": "shrink", "off": "off",
+               "rejoin": "rejoin"}[phase]
+    base = {
+        "T4J_ELASTIC": elastic,
+        "T4J_MIN_WORLD": str(n) if phase == "min-world" else "2",
+        "SMOKE_TARGET_EPOCH": "2" if phase == "rejoin" else "1",
+    }
+    if phase == "shrink-tcp":
+        base["T4J_NO_SHM"] = "1"
+    fault = {
+        "T4J_FAULT_MODE": "die_after",
+        "T4J_FAULT_RANK": str(victim),
+        "T4J_FAULT_DELAY_MS": "800",
+    }
+    procs = {}
+    for r in range(n):
+        env = dict(base)
+        env.update(fault)
+        procs[r] = _spawn(so, r, n, coord, job, env)
+
+    outs = {r: "" for r in range(n)}
+    replacement = None
+    deadline = time.monotonic() + 240
+    # reap; in the rejoin phase, relaunch the victim's slot (fresh
+    # process, T4J_REJOIN=1) once it died — exactly what
+    # launch.py --elastic automates
+    live = dict(procs)
+    rcs = {}
+    while live and time.monotonic() < deadline:
+        for r, p in list(live.items()):
+            rc = p.poll()
+            if rc is None:
+                continue
+            out, _ = p.communicate()
+            if r == victim and replacement is None:
+                outs[r] = out
+                rcs[r] = rc
+            else:
+                outs[r] = outs.get(r, "") + out
+                rcs[r] = rc
+            del live[r]
+            if (phase == "rejoin" and r == victim
+                    and replacement is None):
+                env = dict(base)
+                env["T4J_REJOIN"] = "1"
+                replacement = _spawn(so, victim, n, coord, job, env)
+                live[victim] = replacement
+        time.sleep(0.05)
+    for r, p in live.items():
+        p.kill()
+        out, _ = p.communicate()
+        outs[r] = outs.get(r, "") + out
+        rcs[r] = "timeout"
+
+    ok = True
+    for r in range(n):
+        print(f"--- [{phase}] rank {r} (rc={rcs.get(r)}) ---")
+        print(outs[r][-2500:])
+    survivors = [r for r in range(n) if r != victim]
+    blob = "\n".join(outs.values())
+    surv_blob = "\n".join(outs[r] for r in survivors)
+
+    if phase in ("shrink", "shrink-tcp"):
+        for r in survivors:
+            if rcs.get(r) != 0:
+                ok = False
+                print(f"FAIL: survivor {r} rc={rcs.get(r)} (want 0)")
+        if rcs.get(victim) != DIED:
+            ok = False
+            print(f"FAIL: victim rc={rcs.get(victim)} (want {DIED})")
+        if f"alive={n - 1}" not in surv_blob or "epoch=1" not in surv_blob:
+            ok = False
+            print("FAIL: survivors never reported the shrunk world")
+        if "escalating to abort" in surv_blob:
+            ok = False
+            print("FAIL: an abort fired during an elastic shrink")
+        hits = [int(m) for m in re.findall(r"interruptions=(\d+)",
+                                           surv_blob)]
+        if not hits or max(hits) < 1:
+            ok = False
+            print("FAIL: no in-flight op drained as ResizeInterrupted")
+    elif phase == "min-world":
+        # below the floor the legacy abort fires, naming the knob
+        if "T4J_MIN_WORLD" not in blob:
+            ok = False
+            print("FAIL: the min-world refusal never named the knob")
+        for r in survivors:
+            if rcs.get(r) != RAISED:
+                ok = False
+                print(f"FAIL: survivor {r} rc={rcs.get(r)} "
+                      f"(want {RAISED})")
+    elif phase == "off":
+        # byte-stable legacy report: the pre-elastic escalation line,
+        # with no elastic/resize wording anywhere
+        pat = re.compile(
+            r"link to peer r\d+ could not be repaired \(.*\) — "
+            r"escalating to abort$", re.M)
+        if not pat.search(blob):
+            ok = False
+            print("FAIL: the legacy escalation line is not byte-stable")
+        for word in ("T4J_ELASTIC", "resize", "epoch"):
+            if word in surv_blob:
+                ok = False
+                print(f"FAIL: off-mode output mentions {word!r}")
+        for r in survivors:
+            if rcs.get(r) != RAISED:
+                ok = False
+                print(f"FAIL: survivor {r} rc={rcs.get(r)} "
+                      f"(want {RAISED})")
+    elif phase == "rejoin":
+        for r in survivors:
+            if rcs.get(r) != 0:
+                ok = False
+                print(f"FAIL: survivor {r} rc={rcs.get(r)} (want 0)")
+        if rcs.get(victim) != 0:
+            ok = False
+            print(f"FAIL: replacement rc={rcs.get(victim)} (want 0)")
+        if f"alive={n}" not in blob or "epoch=2" not in blob:
+            ok = False
+            print("FAIL: the world never grew back to full size")
+        if "rejoining the world at epoch" not in outs[victim]:
+            ok = False
+            print("FAIL: the replacement never re-bootstrapped")
+        if "escalating to abort" in blob:
+            ok = False
+            print("FAIL: an abort fired during the rejoin cycle")
+    return ok
+
+
+def main():
+    argv = list(sys.argv[1:])
+    phases = list(PHASES)
+    if "--phase" in argv:
+        i = argv.index("--phase")
+        phases = [argv[i + 1]]
+        del argv[i:i + 2]
+    args = [a for a in argv if not a.startswith("--")]
+    n = int(args[0]) if args else 8
+    build = _load_build_module()
+    so = str(build.ensure_built())
+    ok = True
+    for phase in phases:
+        pn = 4 if phase == "min-world" else n
+        print(f"=== elastic phase: {phase} (n={pn}) ===", flush=True)
+        if not run_phase(phase, pn, so):
+            ok = False
+            print(f"=== phase {phase} FAILED ===")
+        else:
+            print(f"=== phase {phase} ok ===")
+    print("ELASTIC-SMOKE-OK" if ok else "ELASTIC-SMOKE-FAILED")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker(sys.argv[2])
+    else:
+        main()
