@@ -12,6 +12,7 @@ vs_baseline > 1 means faster than the reference's GPU per chip.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -224,6 +225,26 @@ def _emit_record(rec_or_fn, note=None):
             except OSError as exc:
                 print(f"[bench] could not write --out file: {exc}",
                       file=sys.stderr)
+            # the perf TRAJECTORY: append a timestamped copy of the
+            # same record (explicit skip keys included) to a history
+            # jsonl next to the --out file, so successive runs are
+            # comparable instead of each overwriting the last snapshot
+            # (--out stays the latest-record view)
+            try:
+                hist = os.path.join(
+                    os.path.dirname(os.path.abspath(_emit_state["out"])),
+                    "BENCH_history.jsonl",
+                )
+                stamped = dict(rec)
+                stamped["ts_unix"] = round(time.time(), 3)
+                stamped["ts_iso"] = time.strftime(
+                    "%Y-%m-%dT%H:%M:%S%z", time.localtime()
+                )
+                with open(hist, "a") as f:
+                    f.write(json.dumps(stamped) + "\n")
+            except OSError as exc:
+                print(f"[bench] could not append BENCH_history.jsonl: "
+                      f"{exc}", file=sys.stderr)
         if note:
             print(note, file=sys.stderr)
         return True

@@ -34,6 +34,16 @@ one Perfetto-loadable ``DIR/job.trace.json`` with all ranks on one
 aligned timeline.  Inspect with ``t4j-top DIR`` or load the merged
 trace at https://ui.perfetto.dev.
 
+It also arms the crash-consistent flight recorder (``T4J_FLIGHT=on``
+into ``DIR`` unless the environment explicitly chose, docs/
+observability.md "flight recorder"): each rank's event ring + metrics
+table live in an mmap'd ``DIR/rank<k>-<boot>.t4jflight`` file, so a
+rank killed by SIGKILL / segfault / OOM — which never runs any drain —
+still leaves its last events on disk.  On a failed job the launcher
+runs ``t4j-postmortem DIR`` and prints the verdict (first-failing
+rank, its last in-flight op, the affected links, and how the death
+ordered against any elastic resize) under the first-failure report.
+
 Children default to the CPU platform (one XLA CPU per process, the
 reference's process model); override with ``--platform``.
 """
@@ -277,24 +287,72 @@ def main(argv=None):
     return exit_code
 
 
+def _flight_dir(tel_dir):
+    """Where the children actually wrote their flight files: spawn()
+    lets an explicit ambient T4J_FLIGHT_DIR win over the telemetry
+    dir, so the post-mortem readers must follow the same choice."""
+    return os.environ.get("T4J_FLIGHT_DIR", "").strip() or tel_dir
+
+
 def _telemetry_failure_report(tel_dir, rank):
-    """Print the dying rank's last telemetry events (drained by the
-    child's abort path) under the first-failure line — the post-mortem
-    shows WHAT the rank was doing, not just that it died."""
+    """Print the dying rank's last telemetry events under the
+    first-failure line — the post-mortem shows WHAT the rank was
+    doing, not just that it died.  Prefers the drained rank file (the
+    abort path wrote it); a hard-killed rank never drained, so fall
+    back to its crash-consistent flight-recorder file, whose mmap'd
+    ring survived the kill (docs/observability.md "flight
+    recorder")."""
     try:
         from mpi4jax_tpu.native.runtime import _format_recent_events
         from mpi4jax_tpu.telemetry import dump, schema
 
         path = os.path.join(tel_dir, dump.rank_file_name(rank))
-        if not os.path.exists(path):
-            return
-        obj = schema.load_rank_file(path)
-        events = [schema.event_from_list(r) for r in obj["events"][-8:]]
+        events = []
+        source = "drained"
+        if os.path.exists(path):
+            obj = schema.load_rank_file(path)
+            events = [schema.event_from_list(r)
+                      for r in obj["events"][-8:]]
+        else:
+            fdir = _flight_dir(tel_dir)
+            flights = sorted(
+                f for f in os.listdir(fdir)
+                if f.startswith(f"rank{rank}-")
+                and f.endswith(".t4jflight")
+            )
+            if not flights:
+                return
+            obj = schema.read_flight_file(
+                os.path.join(fdir, flights[-1]))
+            events = obj["events"][-8:]
+            source = "flight recorder"
         tail = _format_recent_events(events)
         if tail:
-            _say(f"rank {rank} last telemetry events: {tail}")
+            _say(f"rank {rank} last telemetry events ({source}): {tail}")
     except Exception:
         pass  # the report must never mask the real failure
+
+
+def _postmortem_report(tel_dir):
+    """Run the cross-rank death analysis over the drained + flight
+    files and print the verdict under the first-failure report: WHO
+    failed first, its last in-flight op/step, the affected links, each
+    peer's view, and the death-vs-resize ordering (t4j-postmortem's
+    summary, docs/observability.md "flight recorder")."""
+    try:
+        from mpi4jax_tpu.telemetry import postmortem
+
+        # stale_s=0: every child has been reaped by now, so a fresh
+        # heartbeat only dates the death — it cannot mean "alive"
+        fdir = _flight_dir(tel_dir)
+        report = postmortem.analyze_dir(tel_dir, stale_s=0.0,
+                                        flight_dir=fdir)
+        for line in postmortem.summary_lines(report):
+            _say(f"postmortem: {line}")
+        extra = f" --flight-dir {fdir}" if fdir != tel_dir else ""
+        _say(f"postmortem: full report: t4j-postmortem {tel_dir}{extra}")
+    except Exception:
+        pass  # best-effort: never mask the real failure
 
 
 def _merge_telemetry(tel_dir, job):
@@ -389,6 +447,12 @@ def _run_job(args):
             # trace unless the caller already chose a mode (counters
             # keeps the overhead at metrics-only for perf runs)
             env.setdefault("T4J_TELEMETRY", "trace")
+            # crash-consistent flight recorder: without it a
+            # SIGKILL'd/segfaulted rank loses its entire ring — and
+            # that is the rank every postmortem needs.  An explicit
+            # ambient T4J_FLIGHT (off included) still wins.
+            env.setdefault("T4J_FLIGHT", "on")
+            env.setdefault("T4J_FLIGHT_DIR", tel_dir)
         if args.autotune:
             env["T4J_AUTOTUNE"] = "1"
         if args.metrics is not None:
@@ -586,6 +650,12 @@ def _run_job(args):
             pass
         metrics_srv.stop()
     if tel_dir and exit_code != 130:
+        # cross-rank death analysis from the drained + flight files:
+        # on a failed job it names the first failure; on an elastic
+        # job that shrank-and-survived it documents the departures
+        # next to the membership history above
+        if exit_code != 0 or (elastic and epoch_guess > 0):
+            _postmortem_report(tel_dir)
         _merge_telemetry(tel_dir, job)
     return exit_code
 

@@ -37,6 +37,8 @@ __all__ = [
     "refresh_after_resize",
     "WorldResized",
     "set_telemetry",
+    "set_flight",
+    "flight_info",
     "annotate_step",
     "telemetry_mode_name",
     "telemetry_drain",
@@ -200,6 +202,16 @@ def _load():
     ]
     lib.t4j_telemetry_peek_last.restype = ctypes.c_int64
     lib.t4j_telemetry_dropped.restype = ctypes.c_uint64
+    lib.t4j_set_flight.argtypes = [ctypes.c_int32, ctypes.c_char_p]
+    lib.t4j_flight_info.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.t4j_flight_info.restype = ctypes.c_int32
     lib.t4j_telemetry_anchor.argtypes = [
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
     ]
@@ -608,6 +620,50 @@ def telemetry_mode_name():
     if lib is None:
         return "off"
     return _TEL_MODE_NAMES.get(int(lib.t4j_telemetry_mode()), "off")
+
+
+def set_flight(enabled=None, directory=None):
+    """Pre-init override of the flight-recorder knobs
+    (docs/observability.md "flight recorder"): ``enabled`` True/False
+    (None keeps), ``directory`` the file location (None keeps).  Must
+    run before :func:`ensure_initialized` — the mmap'd arena is
+    created once during bridge init."""
+    lib = _load()
+    code = -1 if enabled is None else (1 if enabled else 0)
+    lib.t4j_set_flight(
+        code, None if directory is None else str(directory).encode()
+    )
+
+
+def flight_info():
+    """Live status of this rank's flight recorder, or ``None`` when it
+    is off / the bridge never initialized: ``{"path", "file_bytes",
+    "heartbeat_ns" (CLOCK_MONOTONIC), "heartbeat_count", "epoch",
+    "heartbeat_age_s"}``."""
+    lib = _state["lib"]
+    if lib is None:
+        return None
+    path = ctypes.create_string_buffer(4096)
+    fb = ctypes.c_uint64(0)
+    hb = ctypes.c_uint64(0)
+    hc = ctypes.c_uint64(0)
+    ep = ctypes.c_uint64(0)
+    if not lib.t4j_flight_info(path, len(path), ctypes.byref(fb),
+                               ctypes.byref(hb), ctypes.byref(hc),
+                               ctypes.byref(ep)):
+        return None
+    import time as _time
+
+    now = _time.clock_gettime_ns(_time.CLOCK_MONOTONIC)
+    return {
+        "path": path.value.decode(errors="replace"),
+        "file_bytes": int(fb.value),
+        "heartbeat_ns": int(hb.value),
+        "heartbeat_count": int(hc.value),
+        "epoch": int(ep.value),
+        "heartbeat_age_s": max(0.0, (now - int(hb.value)) / 1e9)
+        if hb.value else None,
+    }
 
 
 def annotate_step(index, phase):
@@ -1288,6 +1344,8 @@ def ensure_initialized():
         )
     tel_mode, tel_bytes = config.telemetry_mode(), config.telemetry_bytes()
     tel_dir = config.telemetry_dir()
+    flight = config.flight_enabled()
+    fdir = config.flight_dir() or tel_dir
     lib = _load()
     lib.t4j_set_timeouts(op_s, connect_s)
     lib.t4j_set_tuning(ring_min, seg)
@@ -1296,6 +1354,12 @@ def ensure_initialized():
     lib.t4j_set_resilience(retry, boff_base, boff_max, replay)
     lib.t4j_set_elastic(_ELASTIC_MODES[elastic], world_floor, resize_s)
     lib.t4j_set_telemetry(_TEL_MODES[tel_mode], tel_bytes)
+    # crash-consistent flight recorder (docs/observability.md "flight
+    # recorder"): must be decided before init — the mmap'd arena is
+    # created inside t4j_init while the process is single-threaded
+    lib.t4j_set_flight(
+        1 if flight else 0, None if fdir is None else str(fdir).encode()
+    )
     rc = lib.t4j_init()
     if rc != 0:
         detail = last_error()

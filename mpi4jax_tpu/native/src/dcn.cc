@@ -1071,6 +1071,12 @@ void set_nonblock(int fd) {
 int io_wait(int fd, short events, const Deadline& dl,
             bool ignore_stop = false) {
   for (;;) {
+    // flight-recorder liveness: every blocked I/O path ticks through
+    // here at least every 100ms, so a fresh heartbeat means "alive
+    // (possibly wedged on a peer)" while a frozen one means the
+    // process itself is gone — the dead-vs-wedged distinction
+    // t4j-postmortem and t4j-top key on
+    tel::flight_heartbeat();
     if (!ignore_stop && g_stop.load(std::memory_order_acquire)) return -1;
     int tick = dl.remaining_ms(100);
     if (dl.bounded && tick == 0) return 0;
@@ -4125,8 +4131,13 @@ void engine_loop() {
     {
       std::unique_lock<std::mutex> lk(e.mu);
       while (e.queue.empty() && !e.quit && parked.empty() &&
-             !g_stop.load(std::memory_order_acquire))
-        e.cv.wait(lk);
+             !g_stop.load(std::memory_order_acquire)) {
+        // bounded idle wait so the progress engine keeps bumping the
+        // flight-recorder heartbeat even when no op (and no socket
+        // poll) is in flight
+        tel::flight_heartbeat();
+        e.cv.wait_for(lk, std::chrono::milliseconds(200));
+      }
       quit = e.quit;
       if (!e.queue.empty()) {
         next = e.queue.front();
@@ -4179,8 +4190,12 @@ void engine_loop() {
         {
           std::unique_lock<std::mutex> lk(e.mu);
           while (e.queue.empty() && !e.quit &&
-                 g_stop.load(std::memory_order_acquire))
-            e.cv.wait(lk);
+                 g_stop.load(std::memory_order_acquire)) {
+            // same bounded wait while soft-stopped (resize in flight):
+            // a resizing rank is alive, and its heartbeat must say so
+            tel::flight_heartbeat();
+            e.cv.wait_for(lk, std::chrono::milliseconds(200));
+          }
           if (e.quit && e.queue.empty()) return;
           if (!e.quit && !g_stop.load(std::memory_order_acquire)) {
             resume = true;  // resized world is up: back to service
@@ -4538,6 +4553,7 @@ void apply_membership(uint64_t final_alive, uint32_t epoch, int grow_rank,
   }
   g_alive_mask.store(final_alive, std::memory_order_relaxed);
   g_world_epoch.store(epoch, std::memory_order_release);
+  tel::flight_set_epoch(epoch);  // postmortems order deaths vs resizes
   g_world_ctx = derive_hier_ctx(0, 'E', epoch);
   std::lock_guard<std::mutex> lk(g_comm_mu);
   g_comms.clear();
@@ -5207,6 +5223,7 @@ void rejoin_bootstrap(const std::string& coord_host, uint16_t coord_port) {
   g_endpoints[g_rank].boot_token = g_my_boot_token;
   g_alive_mask.store(grow.mask, std::memory_order_relaxed);
   g_world_epoch.store(grow.epoch, std::memory_order_release);
+  tel::flight_set_epoch(grow.epoch);
   g_world_ctx = derive_hier_ctx(0, 'E', grow.epoch);
   g_peers = std::vector<PeerLink>(g_size);
   for (int r = 0; r < g_size; ++r) {
@@ -5801,6 +5818,13 @@ int init_from_env() {
       std::memory_order_relaxed);
   g_world_epoch.store(0, std::memory_order_relaxed);
   g_world_ctx = 0;
+  // crash-consistent flight recorder (T4J_FLIGHT=on): map the event
+  // ring + metrics table into a per-rank file NOW, while the process
+  // is still single-threaded (the bootstrap below spawns the accept/
+  // reader threads), so even bootstrap-phase control events land in
+  // storage that survives a SIGKILL (docs/observability.md "flight
+  // recorder")
+  tel::flight_init(g_rank, g_size, 0);
   const char* rejoin_s = std::getenv("T4J_REJOIN");
   bool rejoining = rejoin_s && rejoin_s[0] &&
                    std::strcmp(rejoin_s, "0") != 0 &&
@@ -5871,6 +5895,11 @@ int init_from_env() {
   // alignment error, not wall-clock skew (docs/observability.md
   // "clock alignment")
   tel::capture_anchor();
+  // flight-recorder identity: the bootstrap incarnation token pairs
+  // the file with the link-layer identity peers saw, and a rejoining
+  // replacement adopts the survivors' epoch during rejoin_bootstrap
+  tel::flight_set_token(g_my_boot_token);
+  tel::flight_set_epoch(g_world_epoch.load(std::memory_order_relaxed));
   g_in_init.store(false, std::memory_order_relaxed);
   if (fault_armed(FaultPlan::kDieAfter)) {
     // time-based death, armed only after init: kills the rank even
@@ -5997,6 +6026,10 @@ void finalize() {
       p.fd = -1;
     }
   }
+  // flight recorder: mark the clean exit so a postmortem never
+  // mistakes this rank's file for a hard death (the mapping itself
+  // stays live — teardown-phase events keep landing in it)
+  tel::flight_mark_finalized();
   g_initialized = false;
 }
 

@@ -21,6 +21,7 @@
 // (mpi4jax_tpu/native/runtime.py).
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -815,6 +816,34 @@ void t4j_annotate_step(int64_t index, int32_t phase) {
   t4j::tel::step_event(
       phase == 2 ? t4j::tel::kEnd : t4j::tel::kBegin,
       index < 0 ? 0 : static_cast<uint64_t>(index));
+}
+// Flight recorder (docs/observability.md "flight recorder"): on < 0
+// keeps, dir null/empty keeps.  Must run before t4j_init — the mmap'd
+// arena is created once during init, while still single-threaded.
+// utils/config.py owns validation (T4J_FLIGHT / T4J_FLIGHT_DIR); the
+// env parse in telemetry.h is the fallback for hand-run processes.
+void t4j_set_flight(int32_t on, const char* dir) {
+  t4j::tel::set_flight(on, dir);
+}
+// Live status of this rank's flight recorder: returns 1 and fills the
+// out-params when active, 0 when off/unmapped.  heartbeat_ns is the
+// recorder's CLOCK_MONOTONIC heartbeat (compare against the anchor to
+// translate to wall time).
+int32_t t4j_flight_info(char* path_out, int32_t path_cap,
+                        uint64_t* file_bytes, uint64_t* heartbeat_ns,
+                        uint64_t* heartbeat_count, uint64_t* epoch) {
+  std::string path;
+  uint64_t fb = 0, hb = 0, hc = 0, ep = 0;
+  if (!t4j::tel::flight_info(&path, &fb, &hb, &hc, &ep)) return 0;
+  if (path_out && path_cap > 0) {
+    std::snprintf(path_out, static_cast<size_t>(path_cap), "%s",
+                  path.c_str());
+  }
+  if (file_bytes) *file_bytes = fb;
+  if (heartbeat_ns) *heartbeat_ns = hb;
+  if (heartbeat_count) *heartbeat_count = hc;
+  if (epoch) *epoch = ep;
+  return 1;
 }
 
 // ---- async progress engine (docs/async.md) ------------------------------

@@ -177,6 +177,10 @@ void wait_for(Hdr* h, Pred ok) {
   double t0 = now_s();
   bool warned = false;
   for (;;) {
+    // a rank wedged on the shm arena still ticks its flight-recorder
+    // heartbeat (bounded by the futex timeout below), so postmortem
+    // readers see "alive but stalled", not "dead"
+    tel::flight_heartbeat();
     if (detail::stopped()) detail::raise_stop();
     uint32_t seen = h->progress.load(std::memory_order_acquire);
     if (ok()) return;

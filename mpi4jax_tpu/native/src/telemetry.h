@@ -39,17 +39,43 @@
 // The event layout is mirrored byte-for-byte by
 // mpi4jax_tpu/telemetry/schema.py (struct format "<QHBBiiIQ"); bump
 // kSchemaVersion when changing either.
+//
+// Flight recorder (T4J_FLIGHT=on, docs/observability.md "flight
+// recorder"): the ring slots, the metrics table, and a fixed header
+// (magic / schema / rank / boot incarnation / world epoch / clock
+// anchor / heartbeat) live in a per-rank mmap'd file instead of the
+// heap.  mmap(MAP_SHARED) makes the page cache the storage: a rank
+// killed by SIGKILL / segfault / OOM loses NOTHING it had published —
+// the seqlock ticket discipline that already detects torn reads on
+// the drain path makes every slot independently validatable by an
+// offline reader (telemetry/postmortem.py), so the dying rank's last
+// events survive without any cooperative drain.  The heartbeat word
+// is bumped by the progress-engine thread and the io poll loops so a
+// reader can distinguish "process dead" (heartbeat frozen) from
+// "alive but wedged" (heartbeat fresh, no op progress).  The file
+// layout is mirrored by telemetry/schema.py (FLIGHT_HEADER_STRUCT);
+// bump kFlightVersion when changing either.
 
 #pragma once
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <atomic>
+#include <cerrno>
 #include <chrono>
+#include <cstddef>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
 #include <memory>
 #include <mutex>
+#include <new>
+#include <string>
 #include <thread>
 
 namespace t4j {
@@ -173,6 +199,110 @@ inline uint32_t thread_lane() {
   return lane;
 }
 
+// ---- flight-recorder header ---------------------------------------------
+//
+// The first 160 bytes of a rank's flight file (rank<k>-<boot>.t4jflight).
+// Every mutable word is a lock-free atomic living IN the mapping, so
+// the on-disk view is always within one store of the live view; the
+// offline reader (telemetry/schema.py read_flight_file) needs no
+// cooperation from the writer, dead or alive.  Mirrored by
+// FLIGHT_HEADER_STRUCT — keep the offsets pinned by the asserts below.
+
+constexpr uint32_t kFlightVersion = 1;
+constexpr char kFlightMagic[8] = {'T', '4', 'J', 'F', 'L', 'T', '1', 0};
+constexpr uint32_t kFlightFinalized = 1;  // flags: clean finalize ran
+
+struct FlightHeader {
+  char magic[8];
+  uint32_t version;  // kFlightVersion (file layout)
+  uint32_t schema;   // kSchemaVersion (event record layout)
+  int32_t rank;
+  int32_t world;
+  std::atomic<uint32_t> world_epoch;  // elastic membership epoch
+  std::atomic<uint32_t> mode;         // telemetry mode at last set()
+  uint64_t boot_unix_ns;              // process boot incarnation (time)
+  std::atomic<uint64_t> boot_token;   // bootstrap incarnation token
+  std::atomic<uint64_t> anchor_mono_ns;
+  std::atomic<uint64_t> anchor_unix_ns;
+  uint64_t nslots;
+  std::atomic<uint64_t> widx;     // the LIVE ring write cursor
+  std::atomic<uint64_t> dropped;  // the LIVE overflow counter
+  std::atomic<uint64_t> heartbeat_ns;     // mono; engine/poll threads bump
+  std::atomic<uint64_t> heartbeat_count;
+  std::atomic<uint32_t> flags;  // kFlightFinalized on clean exit
+  uint32_t pad;
+  uint64_t slots_off;      // byte offset of the Slot array
+  uint64_t metrics_off;    // byte offset of the raw metrics Table
+  uint64_t metrics_bytes;  // sizeof(Table)
+  uint64_t reserved[3];
+};
+static_assert(sizeof(FlightHeader) == 160, "flight header layout");
+static_assert(offsetof(FlightHeader, boot_unix_ns) == 32, "flight layout");
+static_assert(offsetof(FlightHeader, widx) == 72, "flight layout");
+static_assert(offsetof(FlightHeader, flags) == 104, "flight layout");
+static_assert(offsetof(FlightHeader, slots_off) == 112, "flight layout");
+static_assert(std::atomic<uint64_t>::is_always_lock_free,
+              "flight mapping needs lock-free u64 atomics");
+
+struct FlightState {
+  std::atomic<FlightHeader*> header{nullptr};
+  void* base = nullptr;
+  size_t map_bytes = 0;
+  std::string path;  // set before header is published, then immutable
+};
+
+inline FlightState& flight_state() {
+  static FlightState& s = *new FlightState;  // leaked: see ring()
+  return s;
+}
+
+inline FlightHeader* flight_header() {
+  return flight_state().header.load(std::memory_order_acquire);
+}
+
+// One relaxed store + add when the recorder is on, one relaxed load
+// when it is off: cheap enough for the io poll loops.
+inline void flight_heartbeat() {
+  FlightHeader* h = flight_header();
+  if (!h) return;
+  h->heartbeat_ns.store(now_ns(), std::memory_order_relaxed);
+  h->heartbeat_count.fetch_add(1, std::memory_order_relaxed);
+}
+
+inline void flight_set_epoch(uint32_t epoch) {
+  FlightHeader* h = flight_header();
+  if (h) h->world_epoch.store(epoch, std::memory_order_relaxed);
+}
+
+inline void flight_set_token(uint64_t token) {
+  FlightHeader* h = flight_header();
+  if (h) h->boot_token.store(token, std::memory_order_relaxed);
+}
+
+inline void flight_set_mode_word(uint32_t m) {
+  FlightHeader* h = flight_header();
+  if (h) h->mode.store(m, std::memory_order_relaxed);
+}
+
+inline void flight_anchor_sync(uint64_t mono, uint64_t unix_ns) {
+  FlightHeader* h = flight_header();
+  if (!h) return;
+  h->anchor_mono_ns.store(mono, std::memory_order_relaxed);
+  h->anchor_unix_ns.store(unix_ns, std::memory_order_relaxed);
+}
+
+// Clean-finalize mark: a reader finding it knows the rank exited
+// cooperatively (its drained rank file is the richer artifact); a
+// flight file WITHOUT it is a hard death or a still-running rank —
+// the heartbeat age tells those apart.
+inline void flight_mark_finalized() {
+  FlightState& s = flight_state();
+  FlightHeader* h = s.header.load(std::memory_order_acquire);
+  if (!h) return;
+  h->flags.fetch_or(kFlightFinalized, std::memory_order_relaxed);
+  ::msync(s.base, s.map_bytes, MS_ASYNC);
+}
+
 // ---- knobs --------------------------------------------------------------
 
 inline std::atomic<int>& mode_cell() {
@@ -229,12 +359,64 @@ inline long long ring_bytes() {
 // (native/runtime.py threads it through before t4j_init; the ring is
 // sized on first use and never re-sized).
 inline void set(int m, long long ring) {
-  if (m >= kOff && m <= kTrace)
+  if (m >= kOff && m <= kTrace) {
     mode_cell().store(m, std::memory_order_relaxed);
+    flight_set_mode_word(static_cast<uint32_t>(m));
+  }
   if (ring >= 0) {
     if (ring < kMinRingBytes) ring = kMinRingBytes;
     ring_bytes_cell().store(ring, std::memory_order_relaxed);
   }
+}
+
+// ---- flight-recorder knobs ----------------------------------------------
+//
+// T4J_FLIGHT truthy turns the recorder on; T4J_FLIGHT_DIR names the
+// directory (falling back to T4J_TELEMETRY_DIR, then ".").  Both can
+// be overridden pre-init via t4j_set_flight (utils/config.py is the
+// loud validator, this parse is the hand-run fallback).  The file is
+// sized by T4J_TELEMETRY_BYTES — the same knob that bounds the heap
+// ring, since the slots ARE the ring.
+
+inline std::atomic<int>& flight_on_cell() {
+  static std::atomic<int> v{-1};
+  return v;
+}
+
+inline std::string& flight_dir_cell() {
+  static std::string& s = *new std::string;  // set pre-init only
+  return s;
+}
+
+inline bool flight_on() {
+  int v = flight_on_cell().load(std::memory_order_relaxed);
+  if (v < 0) {
+    const char* s = std::getenv("T4J_FLIGHT");
+    v = 0;
+    if (s && s[0] && std::strcmp(s, "0") != 0 &&
+        std::strcmp(s, "off") != 0 && std::strcmp(s, "false") != 0 &&
+        std::strcmp(s, "no") != 0)
+      v = 1;
+    flight_on_cell().store(v, std::memory_order_relaxed);
+  }
+  return v > 0;
+}
+
+inline std::string flight_dir() {
+  if (!flight_dir_cell().empty()) return flight_dir_cell();
+  const char* s = std::getenv("T4J_FLIGHT_DIR");
+  if (s && s[0]) return s;
+  s = std::getenv("T4J_TELEMETRY_DIR");
+  if (s && s[0]) return s;
+  return ".";
+}
+
+// t4j_set_flight(on, dir): on < 0 keeps, dir null/empty keeps.  Must
+// run before t4j_init (single-threaded; the mapping is created once).
+inline void set_flight(int on, const char* dir) {
+  if (on >= 0)
+    flight_on_cell().store(on ? 1 : 0, std::memory_order_relaxed);
+  if (dir && dir[0]) flight_dir_cell() = dir;
 }
 
 // ---- clock anchor -------------------------------------------------------
@@ -264,8 +446,10 @@ inline void capture_anchor() {
   clock_gettime(CLOCK_REALTIME, &ts);
   uint64_t real = static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
                   static_cast<uint64_t>(ts.tv_nsec);
-  anchor_cell().mono_ns.store(now_ns(), std::memory_order_relaxed);
+  uint64_t mono = now_ns();
+  anchor_cell().mono_ns.store(mono, std::memory_order_relaxed);
   anchor_cell().unix_ns.store(real, std::memory_order_relaxed);
+  flight_anchor_sync(mono, real);  // the offline reader's copy
 }
 
 // Returns false (and captures now) when no bootstrap anchor was taken
@@ -285,14 +469,25 @@ struct Slot {
   std::atomic<uint64_t> ticket{0};  // index+1 once the payload is valid
   Event ev;
 };
+// The flight file stores Slots verbatim; telemetry/schema.py mirrors
+// this 40-byte layout (ticket u64 + the 32-byte Event).
+static_assert(sizeof(Slot) == 40, "flight slot layout");
 
+// The slot array and write cursor sit behind pointers so flight_init
+// can retarget them into the mmap'd file (done once, pre-bootstrap,
+// while the process is still single-threaded — the bridge's reader/
+// engine/repair threads all spawn later, and thread creation
+// publishes the swapped pointers to them).
 struct Ring {
-  std::unique_ptr<Slot[]> slots;
-  size_t nslots = 0;  // power of two
+  Slot* slots = nullptr;
+  std::unique_ptr<Slot[]> heap;  // owns the storage when not mapped
+  size_t nslots = 0;             // power of two
   size_t mask = 0;
-  std::atomic<uint64_t> widx{0};
+  std::atomic<uint64_t>* widx = nullptr;
+  std::atomic<uint64_t>* dropped = nullptr;
+  std::atomic<uint64_t> widx_own{0};
+  std::atomic<uint64_t> dropped_own{0};
   uint64_t ridx = 0;  // guarded by drain_mu
-  std::atomic<uint64_t> dropped{0};
   std::mutex drain_mu;
 };
 
@@ -305,9 +500,12 @@ inline Ring& ring() {
     size_t want = static_cast<size_t>(ring_bytes()) / sizeof(Event);
     size_t n = 1;
     while (n * 2 <= want) n *= 2;
-    rr->slots.reset(new Slot[n]);
+    rr->heap.reset(new Slot[n]);
+    rr->slots = rr->heap.get();
     rr->nslots = n;
     rr->mask = n - 1;
+    rr->widx = &rr->widx_own;
+    rr->dropped = &rr->dropped_own;
     return rr;
   }();
   return r;
@@ -316,7 +514,7 @@ inline Ring& ring() {
 inline void emit(Kind kind, Phase phase, Plane plane, int comm, int peer,
                  uint64_t bytes) {
   Ring& r = ring();
-  uint64_t idx = r.widx.fetch_add(1, std::memory_order_relaxed);
+  uint64_t idx = r.widx->fetch_add(1, std::memory_order_relaxed);
   Slot& s = r.slots[idx & r.mask];
   // invalidate first so a concurrent drain of a lapped slot never
   // reads a half-written payload with a stale valid ticket; the full
@@ -371,11 +569,11 @@ inline void step_event(Phase phase, uint64_t index) {
 inline size_t drain(void* out, size_t max_bytes) {
   Ring& r = ring();
   std::lock_guard<std::mutex> lk(r.drain_mu);
-  uint64_t w = r.widx.load(std::memory_order_acquire);
+  uint64_t w = r.widx->load(std::memory_order_acquire);
   uint64_t start = r.ridx;
   if (w > r.nslots && start < w - r.nslots) {
-    r.dropped.fetch_add((w - r.nslots) - start,
-                        std::memory_order_relaxed);
+    r.dropped->fetch_add((w - r.nslots) - start,
+                         std::memory_order_relaxed);
     start = w - r.nslots;
   }
   Event* dst = static_cast<Event*>(out);
@@ -403,7 +601,7 @@ inline size_t drain(void* out, size_t max_bytes) {
 inline size_t peek_last(void* out, size_t max_bytes) {
   Ring& r = ring();
   std::lock_guard<std::mutex> lk(r.drain_mu);
-  uint64_t w = r.widx.load(std::memory_order_acquire);
+  uint64_t w = r.widx->load(std::memory_order_acquire);
   size_t cap = max_bytes / sizeof(Event);
   uint64_t lo = 0;
   if (w > cap) lo = w - cap;
@@ -422,7 +620,7 @@ inline size_t peek_last(void* out, size_t max_bytes) {
 }
 
 inline uint64_t dropped() {
-  return ring().dropped.load(std::memory_order_relaxed);
+  return ring().dropped->load(std::memory_order_relaxed);
 }
 
 // ---- metrics table ------------------------------------------------------
@@ -456,10 +654,31 @@ struct Row {
 struct Table {
   Row rows[kMaxComm][kMaxKind][kMaxPlane];
 };
+// The flight file stores the Table verbatim; telemetry/schema.py
+// mirrors this fixed shape (49 u64 words per row, comm-major order).
+static_assert(sizeof(Row) == (5 + kLatBuckets + kSizeBuckets) * 8,
+              "flight metrics row layout");
+static_assert(sizeof(Table) ==
+                  sizeof(Row) * kMaxComm * kMaxKind * kMaxPlane,
+              "flight metrics table layout");
+
+// Behind an atomic pointer so flight_init can retarget the table into
+// the mmap'd file (same single-threaded-swap discipline as the ring).
+inline std::atomic<Table*>& table_cell() {
+  static std::atomic<Table*> p{nullptr};
+  return p;
+}
 
 inline Table& table() {
-  static Table& t = *new Table;  // leaked: see ring()
-  return t;
+  Table* t = table_cell().load(std::memory_order_acquire);
+  if (!t) {
+    static Table* heap = new Table;  // leaked: see ring()
+    Table* expected = nullptr;
+    table_cell().compare_exchange_strong(expected, heap,
+                                         std::memory_order_acq_rel);
+    t = table_cell().load(std::memory_order_acquire);
+  }
+  return *t;
 }
 
 inline int log2_bucket(uint64_t v, int base, int nbuckets) {
@@ -561,6 +780,152 @@ inline size_t metrics_snapshot(uint64_t* out, size_t max_words) {
 done:
   out[1] = emitted;  // the rows actually written, not the sizing count
   return static_cast<size_t>(w - out);
+}
+
+// ---- flight-recorder arena ----------------------------------------------
+//
+// Layout: [FlightHeader | Slot[nslots] | Table].  Called ONCE from
+// init_from_env, BEFORE the bootstrap spawns any bridge thread, so the
+// pointer swaps below are single-threaded; events already in the heap
+// ring (pre-init emits, if any) migrate into the mapping.  Any failure
+// warns on stderr and leaves the heap ring in place — the recorder
+// must never take a job down.
+
+inline size_t flight_file_bytes_for(size_t nslots) {
+  return sizeof(FlightHeader) + nslots * sizeof(Slot) + sizeof(Table);
+}
+
+inline bool flight_init(int rank, int world, uint32_t epoch) {
+  if (!flight_on()) return false;
+  FlightState& s = flight_state();
+  if (s.header.load(std::memory_order_relaxed)) return true;  // once
+  Ring& r = ring();  // forces heap creation; fixes nslots
+  std::string dir = flight_dir();
+  ::mkdir(dir.c_str(), 0777);  // best-effort single level
+  timespec ts{};
+  clock_gettime(CLOCK_REALTIME, &ts);
+  uint64_t boot = static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+                  static_cast<uint64_t>(ts.tv_nsec);
+  // the boot incarnation in the name keeps a rejoined replacement (or
+  // a --restarts relaunch) from truncating its dead predecessor's
+  // evidence — the postmortem reads every incarnation
+  std::string path = dir + "/rank" + std::to_string(rank) + "-" +
+                     std::to_string(boot) + ".t4jflight";
+  size_t bytes = flight_file_bytes_for(r.nslots);
+  int fd = ::open(path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) {
+    std::fprintf(stderr,
+                 "t4j: flight recorder disabled: cannot create %s "
+                 "(errno %d)\n",
+                 path.c_str(), errno);
+    return false;
+  }
+  void* base = MAP_FAILED;
+  if (::ftruncate(fd, static_cast<off_t>(bytes)) == 0)
+    base = ::mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED,
+                  fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) {
+    std::fprintf(stderr,
+                 "t4j: flight recorder disabled: cannot mmap %zu bytes "
+                 "of %s (errno %d)\n",
+                 bytes, path.c_str(), errno);
+    ::unlink(path.c_str());
+    return false;
+  }
+  auto* h = new (base) FlightHeader();
+  // slot-by-slot placement new: the array form may prepend a count
+  // cookie, which would shift the layout the offline reader mirrors
+  Slot* slots = reinterpret_cast<Slot*>(static_cast<char*>(base) +
+                                        sizeof(FlightHeader));
+  for (size_t i = 0; i < r.nslots; ++i) new (&slots[i]) Slot();
+  auto* tbl = new (static_cast<char*>(base) + sizeof(FlightHeader) +
+                   r.nslots * sizeof(Slot)) Table();
+  std::memcpy(h->magic, kFlightMagic, sizeof(h->magic));
+  h->version = kFlightVersion;
+  h->schema = kSchemaVersion;
+  h->rank = rank;
+  h->world = world;
+  h->world_epoch.store(epoch, std::memory_order_relaxed);
+  h->mode.store(static_cast<uint32_t>(mode()), std::memory_order_relaxed);
+  h->boot_unix_ns = boot;
+  h->anchor_mono_ns.store(
+      anchor_cell().mono_ns.load(std::memory_order_relaxed),
+      std::memory_order_relaxed);
+  h->anchor_unix_ns.store(
+      anchor_cell().unix_ns.load(std::memory_order_relaxed),
+      std::memory_order_relaxed);
+  h->nslots = r.nslots;
+  h->slots_off = sizeof(FlightHeader);
+  h->metrics_off = sizeof(FlightHeader) + r.nslots * sizeof(Slot);
+  h->metrics_bytes = sizeof(Table);
+  h->heartbeat_ns.store(now_ns(), std::memory_order_relaxed);
+  // migrate anything already recorded (single-threaded: no writer can
+  // race these copies)
+  uint64_t w = r.widx->load(std::memory_order_relaxed);
+  uint64_t lo = w > r.nslots ? w - r.nslots : 0;
+  for (uint64_t i = lo; i < w; ++i) {
+    Slot& src = r.slots[i & r.mask];
+    Slot& dst = slots[i & r.mask];
+    dst.ev = src.ev;
+    dst.ticket.store(src.ticket.load(std::memory_order_relaxed),
+                     std::memory_order_relaxed);
+  }
+  h->widx.store(w, std::memory_order_relaxed);
+  h->dropped.store(r.dropped->load(std::memory_order_relaxed),
+                   std::memory_order_relaxed);
+  Table& old = table();
+  for (int c = 0; c < kMaxComm; ++c)
+    for (int k = 0; k < kMaxKind; ++k)
+      for (int p = 0; p < kMaxPlane; ++p) {
+        Row& a = old.rows[c][k][p];
+        Row& b = tbl->rows[c][k][p];
+        b.count.store(a.count.load(std::memory_order_relaxed),
+                      std::memory_order_relaxed);
+        b.bytes.store(a.bytes.load(std::memory_order_relaxed),
+                      std::memory_order_relaxed);
+        b.sum_ns.store(a.sum_ns.load(std::memory_order_relaxed),
+                       std::memory_order_relaxed);
+        b.min_ns.store(a.min_ns.load(std::memory_order_relaxed),
+                       std::memory_order_relaxed);
+        b.max_ns.store(a.max_ns.load(std::memory_order_relaxed),
+                       std::memory_order_relaxed);
+        for (int i = 0; i < kLatBuckets; ++i)
+          b.lat[i].store(a.lat[i].load(std::memory_order_relaxed),
+                         std::memory_order_relaxed);
+        for (int i = 0; i < kSizeBuckets; ++i)
+          b.size[i].store(a.size[i].load(std::memory_order_relaxed),
+                          std::memory_order_relaxed);
+      }
+  // retarget the live paths into the mapping (single-threaded; later
+  // thread creation publishes the new pointers)
+  r.slots = slots;
+  r.widx = &h->widx;
+  r.dropped = &h->dropped;
+  table_cell().store(tbl, std::memory_order_release);
+  s.base = base;
+  s.map_bytes = bytes;
+  s.path = path;
+  s.header.store(h, std::memory_order_release);
+  return true;
+}
+
+// Status query for runtime.flight_info / t4j-top: returns true when
+// the recorder is active.
+inline bool flight_info(std::string* path, uint64_t* file_bytes,
+                        uint64_t* heartbeat_ns, uint64_t* heartbeat_count,
+                        uint64_t* epoch) {
+  FlightState& s = flight_state();
+  FlightHeader* h = s.header.load(std::memory_order_acquire);
+  if (!h) return false;
+  if (path) *path = s.path;
+  if (file_bytes) *file_bytes = s.map_bytes;
+  if (heartbeat_ns)
+    *heartbeat_ns = h->heartbeat_ns.load(std::memory_order_relaxed);
+  if (heartbeat_count)
+    *heartbeat_count = h->heartbeat_count.load(std::memory_order_relaxed);
+  if (epoch) *epoch = h->world_epoch.load(std::memory_order_relaxed);
+  return true;
 }
 
 // ---- op scope -----------------------------------------------------------

@@ -20,7 +20,9 @@ the package itself cannot import.
 """
 
 from . import diagnose  # the submodule: diagnose.diagnose/main/...
+from . import postmortem  # the submodule: postmortem.analyze_dir/main/...
 from .diagnose import diagnose_path, diff_reports
+from .postmortem import analyze_dir as postmortem_dir
 from .exporter import (
     MetricsExporter,
     aggregate_snapshots,
@@ -32,6 +34,8 @@ from .recorder import py_op
 from .registry import Histogram, MetricsRegistry
 from .schema import (
     EVENT_STRUCT,
+    FLIGHT_FILE_GLOB,
+    FLIGHT_VERSION,
     KIND_NAMES,
     PLANE_NAMES,
     RANK_FILE_SCHEMA,
@@ -41,10 +45,12 @@ from .schema import (
     check_begin_end_balance,
     check_step_balance,
     decode_events,
+    encode_flight_file,
     format_recent_events,
     load_rank_file,
     load_trace,
     parse_snapshot,
+    read_flight_file,
     validate_rank_file,
     validate_trace,
 )
@@ -53,6 +59,8 @@ from .trace import merge_dir, merge_rank_objs, rank_to_chrome_events
 __all__ = [
     "EVENT_STRUCT",
     "Event",
+    "FLIGHT_FILE_GLOB",
+    "FLIGHT_VERSION",
     "Histogram",
     "KIND_NAMES",
     "MetricsExporter",
@@ -69,14 +77,18 @@ __all__ = [
     "diagnose",
     "diagnose_path",
     "diff_reports",
+    "encode_flight_file",
     "format_recent_events",
     "load_rank_file",
     "load_trace",
     "merge_dir",
     "merge_rank_objs",
     "parse_snapshot",
+    "postmortem",
+    "postmortem_dir",
     "py_op",
     "rank_to_chrome_events",
+    "read_flight_file",
     "render_prometheus",
     "validate_rank_file",
     "validate_snapshot",

@@ -112,7 +112,7 @@ def rank_file_name(rank):
 def build_rank_obj(rank, world, anchor_mono_ns, anchor_unix_ns, mode,
                    events=(), py_events=(), metrics_words=(),
                    dropped=0, link_stats=None, topology=None, job=None,
-                   tuning=None):
+                   tuning=None, flight=None):
     """Assemble a schema-valid per-rank telemetry object from raw
     drains (``events``: iterable of :class:`schema.Event` or 8-field
     rows; ``metrics_words``: the u64 snapshot)."""
@@ -139,6 +139,10 @@ def build_rank_obj(rank, world, anchor_mono_ns, anchor_unix_ns, mode,
         "link_stats": link_stats or {},
         "topology": topology or {},
         "tuning": tuning or {},
+        # flight-recorder status (docs/observability.md "flight
+        # recorder"): lets t4j-top / t4j-postmortem pair this drain
+        # with the rank's raw .t4jflight file
+        "flight": flight or {},
     }
     return schema.validate_rank_file(obj)
 
@@ -159,6 +163,10 @@ def collect():
     mono, unix = runtime.telemetry_anchor()
     capture_runtime_state()  # refresh while live; no-op post-finalize
     link = _accum["link_stats"] or {}
+    try:
+        flight = runtime.flight_info()
+    except Exception:
+        flight = None
     return build_rank_obj(
         rank=int(os.environ.get("T4J_RANK", 0)),
         world=int(os.environ.get("T4J_SIZE", 1)),
@@ -173,6 +181,7 @@ def collect():
         topology=_accum["topology"] or {},
         job=os.environ.get("T4J_JOB", ""),
         tuning=_accum["tuning"] or {},
+        flight=flight,
     )
 
 

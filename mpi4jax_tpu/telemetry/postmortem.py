@@ -1,0 +1,643 @@
+"""``t4j-postmortem``: cross-rank death analysis from surviving evidence.
+
+    t4j-postmortem DIR             # a --telemetry directory
+    t4j-postmortem DIR --json      # machine-readable report
+    t4j-postmortem DIR --window 10 # merge only the last 10s
+
+The retrospective counterpart of ``t4j-top``/``t4j-diagnose`` for jobs
+that did NOT end cooperatively (docs/observability.md "flight
+recorder"): a SIGKILL'd, segfaulted or OOM-killed rank never runs its
+telemetry drain, so its ``rank<k>.t4j.json`` does not exist — but with
+``T4J_FLIGHT=on`` its event ring, metrics table and header live in a
+crash-consistent mmap'd ``rank<k>-<boot>.t4jflight`` file whose seqlock
+slot tickets let this reader validate and recover the tail without any
+cooperation from the (dead) writer.
+
+Given a flight directory this module loads BOTH artifact kinds —
+survivors' drained rank files and dead ranks' raw flight files —
+validates/recovers truncated tails, merges the last N seconds onto one
+job-relative timeline (every rank's monotonic clock pinned through its
+bootstrap anchor), and names:
+
+* the first-failing rank, how it died (hard kill vs clean exit vs
+  still alive-but-wedged, told apart by the finalize flag and the
+  heartbeat age), and when;
+* its last in-flight op (open op-scope spans), step marker, and wire
+  activity (last frame tx/rx peers = the affected links);
+* each surviving peer's view of the break (link_break / reconnect /
+  link_dead / rank_dead events naming the victim);
+* whether the death preceded or followed an elastic resize epoch
+  (docs/failure-semantics.md "elastic membership").
+
+``launch.py`` runs this automatically under the first-failure report
+when a telemetry dir is configured.  Import-free of jax (stdlib only),
+like the rest of the package.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+from . import schema
+from .trace import RANK_FILE_GLOB
+
+# A heartbeat older than this (vs the analysis instant) means the
+# process is gone; younger means alive — possibly wedged, which is
+# exactly what the caller wants surfaced.  The native side bumps at
+# least every ~200ms while any thread polls, so 5s is generous.
+STALE_S = 5.0
+
+DEFAULT_WINDOW_S = 30.0
+
+_PEER_VIEW_KINDS = ("link_break", "reconnect", "link_dead", "rank_dead")
+
+
+# ---- loading -------------------------------------------------------------
+
+
+def load_dir(path, flight_dir=None):
+    """Read every artifact in a flight/telemetry directory.
+
+    ``flight_dir`` names a SEPARATE flight-recorder directory when the
+    job split them (an explicit ``T4J_FLIGHT_DIR`` next to the
+    launcher's ``--telemetry DIR``); flight files are read from both.
+
+    Returns ``{"drained": {rank: rank_obj}, "flights": {rank:
+    [flight_obj, ...]}}`` — flights sorted oldest boot first, so
+    ``[-1]`` is the current incarnation (restarts and rejoins leave
+    their dead predecessors' files behind on purpose)."""
+    p = pathlib.Path(path)
+    drained = {}
+    for f in sorted(p.glob(RANK_FILE_GLOB)):
+        try:
+            obj = schema.load_rank_file(f)
+        except (OSError, ValueError):
+            continue  # torn mid-write: the flight file still speaks
+        drained[int(obj["rank"])] = obj
+    flight_paths = sorted(p.glob(schema.FLIGHT_FILE_GLOB))
+    if flight_dir is not None:
+        fp = pathlib.Path(flight_dir)
+        if fp.resolve() != p.resolve():
+            flight_paths += sorted(fp.glob(schema.FLIGHT_FILE_GLOB))
+    flights = {}
+    for f in flight_paths:
+        try:
+            obj = schema.read_flight_file(f)
+        except (OSError, ValueError):
+            continue
+        flights.setdefault(int(obj["rank"]), []).append(obj)
+    for objs in flights.values():
+        objs.sort(key=lambda o: o["boot_unix_ns"])
+    return {"drained": drained, "flights": flights}
+
+
+def _to_unix(t_ns, anchor):
+    mono = int(anchor.get("mono_ns", 0))
+    unix = int(anchor.get("unix_ns", 0))
+    if not mono or not unix:
+        return None
+    return int(t_ns) - mono + unix
+
+
+def _rank_events(drained_obj, flight_obj):
+    """Union of a rank's drained and flight events (the drain CONSUMES
+    the ring but the mapped slots retain everything, so the two
+    overlap), deduped on the full record, publish/time order."""
+    seen = set()
+    out = []
+    for e in (flight_obj["events"] if flight_obj else []):
+        t = tuple(e)
+        if t not in seen:
+            seen.add(t)
+            out.append(e)
+    if drained_obj:
+        for row in drained_obj["events"]:
+            e = schema.event_from_list(row)
+            t = tuple(e)
+            if t not in seen:
+                seen.add(t)
+                out.append(e)
+    out.sort(key=lambda e: e.t_ns)
+    return out
+
+
+# ---- per-rank evidence ---------------------------------------------------
+
+
+def _last_inflight(events):
+    """What was open when the stream stopped: per-lane LIFO op spans
+    (the begin/end discipline check_begin_end_balance enforces), the
+    open step marker, and the last wire frames."""
+    stacks = {}
+    open_step = None
+    last_step = None
+    last_tx = last_rx = None
+    queued = completed = 0
+    last_async = None
+    for e in events:
+        if e.kind in schema.OP_KINDS:
+            stack = stacks.setdefault(e.lane, [])
+            if e.phase == schema.PHASE_BEGIN:
+                stack.append(e)
+            elif e.phase == schema.PHASE_END and stack \
+                    and stack[-1].kind == e.kind:
+                stack.pop()
+        elif e.kind == schema.STEP_KIND:
+            if e.phase == schema.PHASE_BEGIN:
+                open_step = int(e.bytes)
+                last_step = int(e.bytes)
+            elif e.phase == schema.PHASE_END:
+                open_step = None
+        elif e.kind == schema.KIND_IDS["frame_tx"]:
+            last_tx = e
+        elif e.kind == schema.KIND_IDS["frame_rx"]:
+            last_rx = e
+        elif e.kind in schema.ASYNC_KINDS:
+            name = schema.KIND_NAMES[e.kind]
+            if name == "op_queued":
+                queued += 1
+            elif name == "op_complete":
+                completed += 1
+            else:
+                last_async = e
+    open_ops = [e for stack in stacks.values() for e in stack]
+    open_ops.sort(key=lambda e: e.t_ns)
+    ops = [{
+        "op": schema.kind_name(e.kind),
+        "t_ns": e.t_ns,
+        "comm": e.comm,
+        "peer": e.peer,
+        "bytes": e.bytes,
+        "plane": schema.plane_name(e.plane),
+    } for e in open_ops]
+    links = sorted({e.peer for e in open_ops if e.peer >= 0}
+                   | ({last_tx.peer} if last_tx and last_tx.peer >= 0
+                      else set())
+                   | ({last_rx.peer} if last_rx and last_rx.peer >= 0
+                      else set()))
+    out = {
+        "ops": ops,
+        "step": open_step,
+        "last_step": last_step,
+        "links": links,
+        "inflight_async": max(0, queued - completed),
+    }
+    if last_tx:
+        out["last_frame_tx"] = {"peer": last_tx.peer, "t_ns": last_tx.t_ns,
+                                "bytes": last_tx.bytes}
+    if last_rx:
+        out["last_frame_rx"] = {"peer": last_rx.peer, "t_ns": last_rx.t_ns,
+                                "bytes": last_rx.bytes}
+    if last_async is not None:
+        op, _comm = schema.decode_async_comm(last_async.comm)
+        out["last_async_op"] = op
+    return out
+
+
+def _rank_evidence(rank, drained_obj, flight_obj, now_unix_ns, stale_s):
+    flight_hdr = None
+    anchor = {"mono_ns": 0, "unix_ns": 0}
+    if flight_obj:
+        anchor = flight_obj["anchor"]
+        flight_hdr = {k: flight_obj[k] for k in (
+            "epoch", "boot_unix_ns", "boot_token", "finalized",
+            "heartbeat_ns", "heartbeat_count", "dropped", "torn_slots",
+            "recovered_events", "file_bytes", "path", "mode")}
+    elif drained_obj:
+        anchor = drained_obj["anchor"]
+    events = _rank_events(drained_obj, flight_obj)
+    last_event_unix = None
+    if events:
+        last_event_unix = _to_unix(events[-1].t_ns, anchor)
+    heartbeat_unix = None
+    if flight_obj and flight_obj["heartbeat_ns"]:
+        heartbeat_unix = _to_unix(flight_obj["heartbeat_ns"], anchor)
+    # classification: a drained rank file proves a cooperative exit
+    # (the abort path and atexit both write it); a finalized flight
+    # header proves the native teardown ran; everything else is dead
+    # or — heartbeat still fresh — alive-but-unaccounted-for
+    if drained_obj is not None:
+        verdict = "drained"
+    elif flight_obj is None:
+        verdict = "no-evidence"
+    elif flight_obj["finalized"]:
+        verdict = "finalized"
+    else:
+        age_s = None
+        if heartbeat_unix is not None:
+            age_s = (now_unix_ns - heartbeat_unix) / 1e9
+        verdict = "alive" if age_s is not None and age_s < stale_s \
+            else "dead"
+    evid = []
+    if heartbeat_unix is not None:
+        evid.append(heartbeat_unix)
+    if last_event_unix is not None:
+        evid.append(last_event_unix)
+    epoch = 0
+    if flight_obj:
+        epoch = flight_obj["epoch"]
+    return {
+        "rank": rank,
+        "verdict": verdict,
+        "sources": ([] if drained_obj is None else ["drained"])
+        + ([] if flight_obj is None else ["flight"]),
+        "epoch": epoch,
+        "anchor": dict(anchor),
+        "flight": flight_hdr,
+        "events": events,
+        "last_event_unix_ns": last_event_unix,
+        "heartbeat_unix_ns": heartbeat_unix,
+        "last_evidence_unix_ns": max(evid) if evid else None,
+        "inflight": _last_inflight(events),
+    }
+
+
+# ---- the analysis --------------------------------------------------------
+
+
+def _peer_views(ranks, victim):
+    """Each other rank's control events naming the victim, plus the
+    resize instants — the peers' side of the break."""
+    views = {}
+    for r, ev in ranks.items():
+        if r == victim:
+            continue
+        rows = []
+        for e in ev["events"]:
+            name = schema.KIND_NAMES.get(e.kind)
+            if name in _PEER_VIEW_KINDS and e.peer == victim:
+                rows.append({"kind": name, "t_ns": e.t_ns,
+                             "t_unix_ns": _to_unix(e.t_ns, ev["anchor"]),
+                             "bytes": e.bytes})
+            elif name in ("resize_begin", "resize_done"):
+                rows.append({"kind": name, "t_ns": e.t_ns,
+                             "t_unix_ns": _to_unix(e.t_ns, ev["anchor"]),
+                             "epoch": int(e.bytes),
+                             "members": (int(e.peer)
+                                         if name == "resize_done"
+                                         else None)})
+        if rows:
+            views[r] = rows
+    return views
+
+
+def _resize_relation(victim_ev, peer_views, death_unix_ns):
+    """Order the death against the elastic resize epochs the survivors
+    observed.  Returns (relation dict or None)."""
+    resizes = {}
+    for rows in peer_views.values():
+        for row in rows:
+            if row["kind"] not in ("resize_begin", "resize_done"):
+                continue
+            rec = resizes.setdefault(row["epoch"], {})
+            key = "begin_unix_ns" if row["kind"] == "resize_begin" \
+                else "done_unix_ns"
+            t = row["t_unix_ns"]
+            if t is not None and (key not in rec or t < rec[key]):
+                rec[key] = t
+            if row.get("members") is not None:
+                rec["members"] = row["members"]
+    if not resizes:
+        return None
+    victim_epoch = victim_ev["epoch"]
+    removing = min((e for e in resizes if e > victim_epoch),
+                   default=None)
+    out = {
+        "victim_epoch": victim_epoch,
+        "epochs": {str(e): rec for e, rec in sorted(resizes.items())},
+        "removing_epoch": removing,
+    }
+    if removing is not None and death_unix_ns is not None:
+        begin = resizes[removing].get("begin_unix_ns")
+        if begin is not None:
+            out["death_preceded_resize"] = bool(death_unix_ns <= begin)
+            out["death_to_resize_ms"] = round(
+                (begin - death_unix_ns) / 1e6, 3)
+    if victim_epoch > 0:
+        out["death_followed_epoch"] = victim_epoch
+    return out
+
+
+def analyze(loaded, window_s=DEFAULT_WINDOW_S, now_unix_ns=None,
+            stale_s=STALE_S):
+    """The report dict behind both renderings (tables and --json)."""
+    if now_unix_ns is None:
+        now_unix_ns = time.time_ns()
+    all_ranks = sorted(set(loaded["drained"]) | set(loaded["flights"]))
+    ranks = {}
+    for r in all_ranks:
+        flights = loaded["flights"].get(r, [])
+        ranks[r] = _rank_evidence(
+            r, loaded["drained"].get(r), flights[-1] if flights else None,
+            now_unix_ns, stale_s)
+        ranks[r]["incarnations"] = len(flights)
+    world = max(
+        [int(o["world"]) for o in loaded["drained"].values()]
+        + [o["world"] for fl in loaded["flights"].values() for o in fl]
+        + [len(all_ranks)],
+        default=0,
+    )
+    dead = [r for r in all_ranks if ranks[r]["verdict"] == "dead"]
+    wedged = [r for r in all_ranks if ranks[r]["verdict"] == "alive"]
+    # the first failure: among hard deaths, the one whose evidence
+    # stops earliest (heartbeats tick every <=200ms while alive, so
+    # the freshest surviving word is within a beat of the death)
+    first = None
+    if dead:
+        def death_key(r):
+            t = ranks[r]["last_evidence_unix_ns"]
+            return (0, t) if t is not None else (1, r)
+
+        first = min(dead, key=death_key)
+    elif wedged:
+        first = min(
+            wedged, key=lambda r: ranks[r]["last_event_unix_ns"] or 0)
+    # corroboration: who do the survivors accuse? (link_break /
+    # link_dead / rank_dead events naming a peer)
+    accusations = {}
+    for r, ev in ranks.items():
+        for e in ev["events"]:
+            if schema.KIND_NAMES.get(e.kind) in ("link_break",
+                                                 "link_dead",
+                                                 "rank_dead") \
+                    and e.peer >= 0 and e.peer != r:
+                accusations[e.peer] = accusations.get(e.peer, 0) + 1
+    most_accused = max(accusations, key=lambda k: accusations[k]) \
+        if accusations else None
+    if first is None and most_accused is not None:
+        first = most_accused
+    peer_views = _peer_views(ranks, first) if first is not None else {}
+    death_unix = ranks[first]["last_evidence_unix_ns"] \
+        if first is not None and first in ranks else None
+    resize = _resize_relation(ranks[first], peer_views, death_unix) \
+        if first is not None and first in ranks else None
+    # job-relative timeline of the last window_s seconds, all ranks
+    t0 = min((ev["anchor"]["unix_ns"] for ev in ranks.values()
+              if ev["anchor"].get("unix_ns")), default=None)
+    t_hi = max((ev["last_evidence_unix_ns"] or 0
+                for ev in ranks.values()), default=0)
+    cutoff = t_hi - int(window_s * 1e9) if window_s else None
+    timeline = []
+    for r, ev in ranks.items():
+        for e in ev["events"]:
+            tu = _to_unix(e.t_ns, ev["anchor"])
+            if tu is None or (cutoff is not None and tu < cutoff):
+                continue
+            if e.kind in schema.CONTROL_KINDS \
+                    or e.kind == schema.STEP_KIND:
+                desc = schema.kind_name(e.kind)
+                if e.kind == schema.STEP_KIND:
+                    desc += (" begin" if e.phase == schema.PHASE_BEGIN
+                             else " end") + f" #{e.bytes}"
+                elif e.peer >= 0:
+                    desc += f" peer=r{e.peer}"
+                if e.kind in (schema.RESIZE_BEGIN_KIND,
+                              schema.RESIZE_DONE_KIND):
+                    desc += f" epoch={e.bytes}"
+                timeline.append({
+                    "t_unix_ns": tu,
+                    "t_rel_s": round((tu - t0) / 1e9, 3)
+                    if t0 else None,
+                    "rank": r,
+                    "event": desc,
+                })
+    timeline.sort(key=lambda row: row["t_unix_ns"])
+    report = {
+        "schema": "t4j-postmortem-v1",
+        "world": world,
+        "ranks_with_evidence": len(all_ranks),
+        "window_s": window_s,
+        "t0_unix_ns": t0,
+        "verdicts": {str(r): ranks[r]["verdict"] for r in all_ranks},
+        "dead_ranks": dead,
+        "wedged_ranks": wedged,
+        "first_failing_rank": first,
+        "accusations": {str(k): v for k, v in sorted(
+            accusations.items())},
+        "peer_views": {str(r): rows for r, rows in peer_views.items()},
+        "resize": resize,
+        "timeline": timeline[-200:],
+        "ranks": {},
+    }
+    if first is not None and first not in ranks:
+        # accused by every survivor but left no file at all (flight
+        # recorder off, or the file location was lost with the host)
+        report["verdicts"][str(first)] = "no-evidence"
+        report["ranks"][str(first)] = {
+            "verdict": "no-evidence", "sources": [], "incarnations": 0,
+            "epoch": 0, "events": 0, "last_evidence_rel_s": None,
+            "heartbeat_age_s": None, "heartbeat_count": None,
+            "torn_slots": 0, "dropped": 0,
+            "inflight": {"ops": [], "step": None, "last_step": None,
+                         "inflight_async": 0},
+            "affected_links": [],
+        }
+    for r in all_ranks:
+        ev = ranks[r]
+        inflight = dict(ev["inflight"])
+        inflight.pop("links", None)
+        report["ranks"][str(r)] = {
+            "verdict": ev["verdict"],
+            "sources": ev["sources"],
+            "incarnations": ev["incarnations"],
+            "epoch": ev["epoch"],
+            "events": len(ev["events"]),
+            "last_evidence_rel_s": round(
+                (ev["last_evidence_unix_ns"] - t0) / 1e9, 3)
+            if t0 and ev["last_evidence_unix_ns"] else None,
+            "heartbeat_age_s": round(
+                (now_unix_ns - ev["heartbeat_unix_ns"]) / 1e9, 3)
+            if ev["heartbeat_unix_ns"] else None,
+            "heartbeat_count": (ev["flight"] or {}).get(
+                "heartbeat_count"),
+            "torn_slots": (ev["flight"] or {}).get("torn_slots", 0),
+            "dropped": (ev["flight"] or {}).get("dropped", 0),
+            "inflight": inflight,
+            "affected_links": ev["inflight"]["links"],
+        }
+    return report
+
+
+def analyze_dir(path, window_s=DEFAULT_WINDOW_S, now_unix_ns=None,
+                stale_s=STALE_S, flight_dir=None):
+    """Load + analyze a flight/telemetry directory (``flight_dir``:
+    optional separate flight-file location, see :func:`load_dir`);
+    raises FileNotFoundError when it holds no evidence at all."""
+    loaded = load_dir(path, flight_dir=flight_dir)
+    if not loaded["drained"] and not loaded["flights"]:
+        raise FileNotFoundError(
+            f"no {RANK_FILE_GLOB} or {schema.FLIGHT_FILE_GLOB} files "
+            f"in {path}"
+        )
+    return analyze(loaded, window_s=window_s, now_unix_ns=now_unix_ns,
+                   stale_s=stale_s)
+
+
+# ---- rendering -----------------------------------------------------------
+
+
+def _rel(report, t_unix_ns):
+    t0 = report.get("t0_unix_ns")
+    if t0 is None or t_unix_ns is None:
+        return "?"
+    return f"+{(t_unix_ns - t0) / 1e9:.3f}s"
+
+
+def summary_lines(report):
+    """The compact first-failure lines (what launch.py prints under
+    its report): who died, what it was doing, who saw it, resize
+    ordering."""
+    out = []
+    first = report["first_failing_rank"]
+    if first is None:
+        out.append(
+            f"no hard deaths: {report['ranks_with_evidence']} rank(s) "
+            "accounted for "
+            f"({', '.join(sorted(set(report['verdicts'].values())))})"
+        )
+        return out
+    rk = report["ranks"][str(first)]
+    how = {"dead": "died hard (no drain; flight heartbeat stopped)",
+           "alive": "alive but wedged (heartbeat fresh, no progress)",
+           "drained": "exited with a drained telemetry file",
+           "finalized": "finalized without a drained file",
+           "no-evidence": "left no evidence"}.get(rk["verdict"],
+                                                  rk["verdict"])
+    when = (f" at +{rk['last_evidence_rel_s']}s"
+            if rk["last_evidence_rel_s"] is not None else "")
+    out.append(f"first failure: rank {first} — {how}{when} "
+               f"[epoch {rk['epoch']}, evidence: "
+               f"{'+'.join(rk['sources']) or 'none'}]")
+    inflight = rk["inflight"]
+    if inflight["ops"]:
+        op = inflight["ops"][-1]
+        peer = f" peer=r{op['peer']}" if op["peer"] >= 0 else ""
+        out.append(
+            f"  last in-flight op: {op['op']} (comm {op['comm']},"
+            f"{peer} {op['bytes']}B, plane {op['plane']})"
+        )
+    elif inflight.get("last_async_op"):
+        out.append(
+            f"  last in-flight op: {inflight['last_async_op']} "
+            f"({inflight['inflight_async']} async request(s) open)"
+        )
+    if inflight.get("step") is not None:
+        out.append(f"  died inside step #{inflight['step']}")
+    elif inflight.get("last_step") is not None:
+        out.append(f"  last completed step: #{inflight['last_step']}")
+    for key, label in (("last_frame_tx", "tx"), ("last_frame_rx", "rx")):
+        fr = inflight.get(key)
+        if fr:
+            out.append(
+                f"  last wire {label}: peer=r{fr['peer']} "
+                f"({fr['bytes']}B)"
+            )
+    if rk["affected_links"]:
+        out.append("  affected link(s): " + ", ".join(
+            f"r{first}<->r{p}" for p in rk["affected_links"]))
+    for r, rows in sorted(report["peer_views"].items(),
+                          key=lambda kv: int(kv[0])):
+        names = []
+        for row in rows:
+            if row["kind"] not in _PEER_VIEW_KINDS:
+                continue
+            when = (" " + _rel(report, row["t_unix_ns"])
+                    if row["t_unix_ns"] else "")
+            names.append(f"{row['kind']}{when}")
+        if names:
+            out.append(f"  r{r} saw: " + ", ".join(names[:6]))
+    resize = report.get("resize")
+    if resize:
+        if resize.get("removing_epoch") is not None:
+            rel = ("preceded"
+                   if resize.get("death_preceded_resize", True)
+                   else "followed")
+            out.append(
+                f"  death {rel} resize epoch "
+                f"{resize['removing_epoch']} (victim was a member of "
+                f"epoch {resize['victim_epoch']})"
+            )
+        elif resize.get("death_followed_epoch") is not None:
+            out.append(
+                "  death followed resize epoch "
+                f"{resize['death_followed_epoch']} (no later resize "
+                "observed)"
+            )
+    return out
+
+
+def render(report):
+    out = [
+        f"t4j-postmortem — {report['ranks_with_evidence']}/"
+        f"{report['world']} rank(s) with evidence, "
+        f"{len(report['dead_ranks'])} dead, "
+        f"{len(report['wedged_ranks'])} wedged"
+    ]
+    out.extend(summary_lines(report))
+    out.append("")
+    out.append(f"  {'rank':<6}{'verdict':<12}{'evidence':<16}"
+               f"{'epoch':>6}{'events':>8}{'hb age':>9}{'torn':>6}"
+               f"{'last seen':>11}")
+    for r in sorted(report["ranks"], key=int):
+        rk = report["ranks"][r]
+        hb = (f"{rk['heartbeat_age_s']:.1f}s"
+              if rk["heartbeat_age_s"] is not None else "-")
+        seen = (f"+{rk['last_evidence_rel_s']:.2f}s"
+                if rk["last_evidence_rel_s"] is not None else "-")
+        out.append(
+            f"  r{r:<5}{rk['verdict']:<12}"
+            f"{'+'.join(rk['sources']) or '-':<16}{rk['epoch']:>6}"
+            f"{rk['events']:>8}{hb:>9}{rk['torn_slots']:>6}{seen:>11}"
+        )
+    if report["timeline"]:
+        out.append("")
+        out.append(f"  last {report['window_s']:g}s of control events "
+                   "(job-relative):")
+        for row in report["timeline"][-40:]:
+            rel = (f"+{row['t_rel_s']:.3f}s"
+                   if row["t_rel_s"] is not None else "?")
+            out.append(f"  {rel:>12}  r{row['rank']}  {row['event']}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="t4j-postmortem",
+        description="cross-rank death analysis from drained + "
+                    "flight-recorder files (docs/observability.md "
+                    "\"flight recorder\")",
+    )
+    ap.add_argument("path", help="--telemetry / T4J_FLIGHT_DIR directory")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report")
+    ap.add_argument("--window", type=float, default=DEFAULT_WINDOW_S,
+                    metavar="SECS",
+                    help="merge only the last SECS of events "
+                         f"(default {DEFAULT_WINDOW_S:g})")
+    ap.add_argument("--stale", type=float, default=STALE_S,
+                    metavar="SECS",
+                    help="heartbeat age past which a rank counts as "
+                         f"dead (default {STALE_S:g})")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="separate flight-recorder directory, when the "
+                         "job set T4J_FLIGHT_DIR away from the "
+                         "telemetry dir")
+    args = ap.parse_args(argv)
+    try:
+        report = analyze_dir(args.path, window_s=args.window,
+                             stale_s=args.stale,
+                             flight_dir=args.flight_dir)
+    except (OSError, ValueError) as e:
+        print(f"t4j-postmortem: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

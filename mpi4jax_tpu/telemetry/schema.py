@@ -1,6 +1,6 @@
 """Telemetry wire schema: the single Python mirror of native/src/telemetry.h.
 
-Three layouts live here (docs/observability.md "event schema"):
+Four layouts live here (docs/observability.md "event schema"):
 
 * the 32-byte packed native event record (``EVENT_STRUCT``, drained via
   ``t4j_telemetry_drain`` / ``t4j_telemetry_peek_last``),
@@ -8,13 +8,20 @@ Three layouts live here (docs/observability.md "event schema"):
   ``t4j_metrics_snapshot``),
 * the per-rank JSON file every rank drains at exit
   (``rank<k>.t4j.json``, ``validate_rank_file``) and the merged Chrome/
-  Perfetto trace (``job.trace.json``, ``validate_trace``).
+  Perfetto trace (``job.trace.json``, ``validate_trace``),
+* the crash-consistent flight-recorder file (``rank<k>-<boot>.t4jflight``,
+  ``read_flight_file``): the raw mmap'd arena a hard-killed rank
+  leaves behind — 160-byte header + the seqlock slot array + the raw
+  metrics table, every piece independently validatable so a reader
+  can recover a truncated/torn tail without any cooperation from the
+  (dead) writer.
 
 This module is deliberately import-free of jax (stdlib only), like
 analysis/contracts.py: its tests and the CI telemetry lane run on every
 container, including old-jax ones where the package itself cannot
 import.  Bump ``SCHEMA_VERSION`` in lockstep with
-``tel::kSchemaVersion``.
+``tel::kSchemaVersion``, and ``FLIGHT_VERSION`` with
+``tel::kFlightVersion``.
 """
 
 import json
@@ -444,3 +451,242 @@ def check_begin_end_balance(events):
                 f"lane {lane}: begin {kind_name(kind)} never ended"
             )
     return problems
+
+
+# ---- flight-recorder file (crash-consistent mmap arena) ------------------
+#
+# Mirror of telemetry.h FlightHeader/Slot/Table: a 160-byte header,
+# then nslots 40-byte slots (u64 seqlock ticket + the 32-byte event
+# record), then the raw metrics table (fixed shape, 49 u64 words per
+# (comm, kind, plane) row).  The writer publishes each slot with a
+# release store of ticket = global_index + 1 AFTER the payload stores,
+# so any slot whose ticket passes the position check below carries a
+# fully-written event even if the process was SIGKILL'd the next
+# instant — mmap(MAP_SHARED) means the page cache, not the process,
+# owns the bytes.
+
+FLIGHT_MAGIC = b"T4JFLT1\x00"
+FLIGHT_VERSION = 1
+FLIGHT_FILE_SCHEMA = f"t4j-flight-v{FLIGHT_VERSION}"
+FLIGHT_FILE_GLOB = "rank*.t4jflight"
+FLIGHT_HEADER_BYTES = 160
+# magic, version, schema, rank, world, epoch, mode, boot_unix_ns,
+# boot_token, anchor_mono_ns, anchor_unix_ns, nslots, widx, dropped,
+# heartbeat_ns, heartbeat_count, flags, pad, slots_off, metrics_off,
+# metrics_bytes  (24 reserved bytes follow)
+FLIGHT_HEADER_STRUCT = struct.Struct("<8sIIiiIIQQQQQQQQQIIQQQ")
+assert FLIGHT_HEADER_STRUCT.size == 136, "flight header drifted"
+FLIGHT_SLOT_STRUCT = struct.Struct("<Q" + EVENT_STRUCT.format[1:])
+assert FLIGHT_SLOT_STRUCT.size == 40, "flight slot drifted"
+FLIGHT_FINALIZED = 1  # flags bit: clean finalize ran
+
+# telemetry.h metrics-table shape (kMaxComm x kMaxKind x kMaxPlane
+# rows of [count, bytes, sum_ns, min_ns, max_ns, lat..., size...]).
+FLIGHT_MAX_COMM = 8
+FLIGHT_MAX_KIND = 16
+FLIGHT_MAX_PLANE = 6
+FLIGHT_LAT_BUCKETS = 24
+FLIGHT_SIZE_BUCKETS = 20
+FLIGHT_ROW_WORDS = 5 + FLIGHT_LAT_BUCKETS + FLIGHT_SIZE_BUCKETS
+FLIGHT_TABLE_BYTES = (FLIGHT_ROW_WORDS * 8 * FLIGHT_MAX_COMM
+                      * FLIGHT_MAX_KIND * FLIGHT_MAX_PLANE)
+
+_TEL_MODE_NAMES = {0: "off", 1: "counters", 2: "trace"}
+
+
+def flight_file_name(rank, boot_unix_ns):
+    return f"rank{int(rank)}-{int(boot_unix_ns)}.t4jflight"
+
+
+def parse_flight_header(buf):
+    """First ``FLIGHT_HEADER_BYTES`` of a flight file -> header dict.
+    Raises :class:`SchemaError` on a wrong magic/version (a torn or
+    foreign file must never parse as evidence)."""
+    if len(buf) < FLIGHT_HEADER_STRUCT.size:
+        raise SchemaError(
+            f"flight header truncated: {len(buf)} bytes < "
+            f"{FLIGHT_HEADER_STRUCT.size}"
+        )
+    (magic, version, schema_v, rank, world, epoch, mode, boot_unix_ns,
+     boot_token, anchor_mono_ns, anchor_unix_ns, nslots, widx, dropped,
+     heartbeat_ns, heartbeat_count, flags, _pad, slots_off, metrics_off,
+     metrics_bytes) = FLIGHT_HEADER_STRUCT.unpack(
+        buf[:FLIGHT_HEADER_STRUCT.size])
+    if magic != FLIGHT_MAGIC:
+        raise SchemaError(f"not a flight file (magic {magic!r})")
+    if version != FLIGHT_VERSION:
+        raise SchemaError(
+            f"flight file version {version} != {FLIGHT_VERSION}"
+        )
+    if schema_v != SCHEMA_VERSION:
+        raise SchemaError(
+            f"flight file event schema {schema_v} != {SCHEMA_VERSION}"
+        )
+    return {
+        "schema": FLIGHT_FILE_SCHEMA,
+        "rank": int(rank),
+        "world": int(world),
+        "epoch": int(epoch),
+        "mode": _TEL_MODE_NAMES.get(int(mode), f"mode{int(mode)}"),
+        "boot_unix_ns": int(boot_unix_ns),
+        "boot_token": int(boot_token),
+        "anchor": {"mono_ns": int(anchor_mono_ns),
+                   "unix_ns": int(anchor_unix_ns)},
+        "nslots": int(nslots),
+        "widx": int(widx),
+        "dropped": int(dropped),
+        "heartbeat_ns": int(heartbeat_ns),
+        "heartbeat_count": int(heartbeat_count),
+        "finalized": bool(flags & FLIGHT_FINALIZED),
+        "slots_off": int(slots_off),
+        "metrics_off": int(metrics_off),
+        "metrics_bytes": int(metrics_bytes),
+    }
+
+
+def _recover_flight_slots(buf, hdr):
+    """Slot region bytes -> (events in publish order, torn count).
+
+    A slot is accepted only when its seqlock ticket is internally
+    consistent: nonzero, at most the header's write cursor, and
+    pointing back at the slot's own position ((ticket-1) % nslots).
+    Anything else — an in-flight writer killed between the fetch_add
+    and the publish, a half-grown file, garbage — is counted as torn
+    and dropped, never misread as an event.  Publish order (the
+    ticket) is the ground truth even when timestamps tie."""
+    nslots = hdr["nslots"]
+    widx = hdr["widx"]
+    recovered = []
+    torn = 0
+    usable = min(nslots, len(buf) // FLIGHT_SLOT_STRUCT.size)
+    for pos in range(usable):
+        off = pos * FLIGHT_SLOT_STRUCT.size
+        fields = FLIGHT_SLOT_STRUCT.unpack_from(buf, off)
+        ticket = fields[0]
+        if ticket == 0:
+            continue  # never written (or invalidated mid-claim)
+        if ticket > widx or (ticket - 1) % nslots != pos:
+            torn += 1
+            continue
+        recovered.append((ticket, Event(*fields[1:])))
+    recovered.sort(key=lambda te: te[0])
+    return [e for _t, e in recovered], torn
+
+
+def _parse_flight_table(buf, mode_name):
+    """Raw metrics-table bytes -> the :func:`parse_snapshot` dict shape
+    (only rows with count > 0, comm-major order like the native
+    snapshot)."""
+    rows = []
+    row_bytes = FLIGHT_ROW_WORDS * 8
+    idx = 0
+    for comm in range(FLIGHT_MAX_COMM):
+        for kind in range(FLIGHT_MAX_KIND):
+            for plane in range(FLIGHT_MAX_PLANE):
+                off = idx * row_bytes
+                idx += 1
+                if off + row_bytes > len(buf):
+                    return None  # truncated table: no partial rows
+                words = struct.unpack_from(f"<{FLIGHT_ROW_WORDS}Q", buf,
+                                           off)
+                if not words[0]:
+                    continue
+                rows.append({
+                    "comm": comm,
+                    "kind": kind,
+                    "plane": plane,
+                    "count": int(words[0]),
+                    "bytes": int(words[1]),
+                    "sum_ns": int(words[2]),
+                    "min_ns": int(words[3]),
+                    "max_ns": int(words[4]),
+                    "lat": [int(v) for v in
+                            words[5:5 + FLIGHT_LAT_BUCKETS]],
+                    "size": [int(v) for v in
+                             words[5 + FLIGHT_LAT_BUCKETS:]],
+                })
+    mode_id = {v: k for k, v in _TEL_MODE_NAMES.items()}.get(mode_name, 0)
+    return {"version": SCHEMA_VERSION, "mode": mode_id,
+            "lat_base_log2": 10, "size_base_log2": 6, "rows": rows}
+
+
+def read_flight_file(path):
+    """Read and recover a flight-recorder file WITHOUT any writer
+    cooperation (the writer may be dead, or still running — both are
+    safe: every slot is independently validated).
+
+    Returns the header dict plus ``events`` (recovered, publish
+    order), ``metrics`` (parse_snapshot shape, or None when the table
+    region is truncated), ``torn_slots``, ``recovered_events``,
+    ``file_bytes`` and ``path``."""
+    with open(path, "rb") as f:
+        data = f.read()
+    hdr = parse_flight_header(data)
+    slots_lo = hdr["slots_off"]
+    slots_hi = min(hdr["metrics_off"], len(data))
+    events, torn = _recover_flight_slots(data[slots_lo:slots_hi], hdr)
+    metrics = _parse_flight_table(
+        data[hdr["metrics_off"]:hdr["metrics_off"] + hdr["metrics_bytes"]],
+        hdr["mode"])
+    obj = dict(hdr)
+    obj.update(
+        events=events,
+        metrics=metrics,
+        torn_slots=torn,
+        recovered_events=len(events),
+        file_bytes=len(data),
+        path=str(path),
+    )
+    return obj
+
+
+def encode_flight_file(rank, world, events=(), *, epoch=0, mode="trace",
+                       boot_unix_ns=0, boot_token=0, anchor_mono_ns=0,
+                       anchor_unix_ns=0, nslots=256, heartbeat_ns=0,
+                       heartbeat_count=0, finalized=False, dropped=0,
+                       widx=None, torn_positions=(), metrics_rows=()):
+    """Synthesize the byte-exact flight-file layout (tests and
+    fixtures: the inverse of :func:`read_flight_file`, mirroring what
+    tel::flight_init + emit produce).  ``events`` land in ring order
+    starting at ticket 1; positions in ``torn_positions`` get a
+    deliberately inconsistent ticket (an in-flight writer's slot).
+    ``metrics_rows`` are parse_snapshot-shaped row dicts."""
+    events = list(events)
+    n_written = len(events)
+    w = n_written if widx is None else int(widx)
+    flags = FLIGHT_FINALIZED if finalized else 0
+    slots_off = FLIGHT_HEADER_BYTES
+    metrics_off = slots_off + nslots * FLIGHT_SLOT_STRUCT.size
+    header = FLIGHT_HEADER_STRUCT.pack(
+        FLIGHT_MAGIC, FLIGHT_VERSION, SCHEMA_VERSION, int(rank),
+        int(world), int(epoch),
+        {v: k for k, v in _TEL_MODE_NAMES.items()}.get(mode, 2),
+        int(boot_unix_ns), int(boot_token), int(anchor_mono_ns),
+        int(anchor_unix_ns), int(nslots), w, int(dropped),
+        int(heartbeat_ns), int(heartbeat_count), flags, 0, slots_off,
+        metrics_off, FLIGHT_TABLE_BYTES,
+    ) + b"\x00" * (FLIGHT_HEADER_BYTES - FLIGHT_HEADER_STRUCT.size)
+    slots = bytearray(nslots * FLIGHT_SLOT_STRUCT.size)
+    for i, e in enumerate(events):
+        ticket = i + 1
+        pos = (ticket - 1) % nslots
+        FLIGHT_SLOT_STRUCT.pack_into(slots,
+                                     pos * FLIGHT_SLOT_STRUCT.size,
+                                     ticket, *e)
+    for pos in torn_positions:
+        # a ticket that fails the position check: reader must drop it
+        FLIGHT_SLOT_STRUCT.pack_into(
+            slots, pos * FLIGHT_SLOT_STRUCT.size, pos + 2,
+            0, 0, 0, 0, 0, 0, 0, 0)
+    table = bytearray(FLIGHT_TABLE_BYTES)
+    row_bytes = FLIGHT_ROW_WORDS * 8
+    for r in metrics_rows:
+        idx = ((r["comm"] * FLIGHT_MAX_KIND) + r["kind"]) \
+            * FLIGHT_MAX_PLANE + r["plane"]
+        words = ([r["count"], r["bytes"], r["sum_ns"], r["min_ns"],
+                  r["max_ns"]]
+                 + list(r.get("lat", [0] * FLIGHT_LAT_BUCKETS))
+                 + list(r.get("size", [0] * FLIGHT_SIZE_BUCKETS)))
+        struct.pack_into(f"<{FLIGHT_ROW_WORDS}Q", table,
+                         idx * row_bytes, *words)
+    return bytes(header) + bytes(slots) + bytes(table)

@@ -31,6 +31,7 @@ import sys
 import time
 
 from . import schema
+from .postmortem import STALE_S as FLIGHT_STALE_S
 from .registry import MetricsRegistry
 from .trace import RANK_FILE_GLOB
 
@@ -66,6 +67,52 @@ def load_rank_objs(path, lenient=False):
     return [schema.load_rank_file(p)]
 
 
+def load_flight_status(path, now_unix_ns=None):
+    """Per-rank flight-recorder status from the raw ``.t4jflight``
+    headers in a directory (docs/observability.md "flight recorder").
+
+    Header-only reads — cheap enough for ``--follow`` — translated to
+    wall time through each file's clock anchor, so a rank that is
+    alive-but-wedged shows a fresh heartbeat while a dead one goes
+    stale.  Newest boot incarnation wins per rank.  Returns ``{}``
+    for a non-directory path or when no flight files exist."""
+    p = pathlib.Path(path)
+    if not p.is_dir():
+        return {}
+    now = time.time_ns() if now_unix_ns is None else now_unix_ns
+    out = {}
+    for f in sorted(p.glob(schema.FLIGHT_FILE_GLOB)):
+        try:
+            with open(f, "rb") as fh:
+                hdr = schema.parse_flight_header(
+                    fh.read(schema.FLIGHT_HEADER_BYTES))
+            size = f.stat().st_size
+        except (OSError, ValueError):
+            continue  # torn/foreign file: skip, keep rendering
+        rank = hdr["rank"]
+        prev = out.get(rank)
+        if prev and prev["boot_unix_ns"] > hdr["boot_unix_ns"]:
+            continue
+        age = None
+        a = hdr["anchor"]
+        if hdr["heartbeat_ns"] and a["mono_ns"] and a["unix_ns"]:
+            hb_unix = hdr["heartbeat_ns"] - a["mono_ns"] + a["unix_ns"]
+            age = max(0.0, (now - hb_unix) / 1e9)
+        out[rank] = {
+            "rank": rank,
+            "path": str(f),
+            "file_bytes": size,
+            "heartbeat_age_s": round(age, 3) if age is not None else None,
+            "heartbeat_count": hdr["heartbeat_count"],
+            "finalized": hdr["finalized"],
+            "epoch": hdr["epoch"],
+            "boot_unix_ns": hdr["boot_unix_ns"],
+            "stale": (age is not None and age > FLIGHT_STALE_S
+                      and not hdr["finalized"]),
+        }
+    return out
+
+
 def _fmt_ms(v):
     return "-" if v is None else f"{v:9.3f}"
 
@@ -78,8 +125,13 @@ def _fmt_bytes(v):
     return f"{v:.1f}TB"
 
 
-def summarize(rank_objs):
-    """The data model behind both renderings (table and --json)."""
+def summarize(rank_objs, flight=None):
+    """The data model behind both renderings (table and --json).
+    ``flight`` is :func:`load_flight_status`'s per-rank dict; ranks
+    that only have a flight file (still running, wedged, or dead
+    before any drain) still get a row, so a live ``--follow`` shows
+    them instead of silently omitting the most interesting rank."""
+    flight = flight or {}
     reg = MetricsRegistry()
     per_rank = []
     links = {}
@@ -186,7 +238,21 @@ def summarize(rank_objs):
             "world_epoch": world_epoch,
             "world_size": world_size,
             "dead_ranks": dead_ranks,
+            "flight": flight.get(rank),
         })
+    # flight-only ranks (no drained file yet — running, wedged, or
+    # hard-dead): surface them instead of hiding the problem rank
+    drained_ranks = {r["rank"] for r in per_rank}
+    for rank, st in sorted(flight.items()):
+        if rank in drained_ranks:
+            continue
+        per_rank.append({
+            "rank": rank, "mode": "-", "events": 0, "py_events": 0,
+            "dropped": 0, "faults": 0, "span_s": 0.0, "reconnects": 0,
+            "resizes": 0, "world_epoch": st["epoch"], "world_size": None,
+            "dead_ranks": [], "flight": st,
+        })
+    per_rank.sort(key=lambda r: r["rank"])
     ops = []
     for op in reg.ops():
         for plane in sorted({p for (_c, o, p) in reg.rows if o == op}):
@@ -229,6 +295,7 @@ def summarize(rank_objs):
         "links": link_rows,
         "async": async_out,
         "bytes_by_plane": reg.bytes_by_plane(),
+        "flight": {str(r): st for r, st in sorted(flight.items())},
     }
 
 
@@ -252,10 +319,31 @@ def render(summary):
     if resized:
         r = max(resized, key=lambda x: x["world_epoch"])
         departed = ", ".join(f"r{d}" for d in r.get("dead_ranks", []))
+        members = r["world_size"] if r["world_size"] is not None else "?"
         out.append(
             f"  elastic: world epoch {r['world_epoch']}, "
-            f"{r['world_size']} member(s); departed: {departed or '-'}"
+            f"{members} member(s); departed: {departed or '-'}"
         )
+    # flight-recorder status in the membership line: heartbeat age
+    # tells a wedged-but-alive rank (fresh beat, no progress) from a
+    # dead one (STALE) while the job still runs
+    flight = summary.get("flight") or {}
+    if flight:
+        parts = []
+        for key in sorted(flight, key=int):
+            st = flight[key]
+            if st["finalized"]:
+                word = "done"
+            elif st["stale"]:
+                word = "STALE"
+            elif st["heartbeat_age_s"] is not None:
+                word = f"live {st['heartbeat_age_s']:.1f}s"
+            else:
+                word = "live"
+            parts.append(
+                f"r{key} {word} {_fmt_bytes(st['file_bytes'])}"
+            )
+        out.append("  flight: " + " | ".join(parts))
     if summary["ops"]:
         out.append("")
         out.append(f"  {'op':<16}{'plane':<7}{'count':>8}{'bytes':>10}"
@@ -328,15 +416,23 @@ def main(argv=None):
                          "tables")
     args = ap.parse_args(argv)
     while True:
+        flight = load_flight_status(args.path)
         try:
             summary = summarize(
-                load_rank_objs(args.path, lenient=args.follow is not None)
+                load_rank_objs(args.path, lenient=args.follow is not None),
+                flight=flight,
             )
         except FileNotFoundError as e:
-            if args.follow is None:
+            if flight:
+                # no drained rank file yet, but live flight headers
+                # exist (the job is still running, or died hard before
+                # any drain): render what the recorder knows
+                summary = summarize([], flight=flight)
+            elif args.follow is None:
                 print(f"t4j-top: {e}", file=sys.stderr)
                 return 2
-            summary = None
+            else:
+                summary = None
         except (OSError, ValueError) as e:
             # --follow mid-job: a single-file path can be mid-write by
             # a non-atomic writer; report and keep following

@@ -135,6 +135,20 @@ Telemetry (docs/observability.md):
                             the launcher's ``--metrics PORT`` sets it
                             and aggregates the job view on
                             ``<port>+nprocs``.  Unset/0 = disabled.
+* ``T4J_FLIGHT``          — truthy: crash-consistent flight recorder
+                            (docs/observability.md "flight recorder"):
+                            the event ring + metrics table live in a
+                            per-rank mmap'd file, so a SIGKILL'd/
+                            segfaulted/OOM-killed rank's last events
+                            survive for ``t4j-postmortem`` without any
+                            cooperative drain.  Sized by
+                            ``T4J_TELEMETRY_BYTES``.
+* ``T4J_FLIGHT_DIR``      — where the flight files land
+                            (``rank<k>-<boot>.t4jflight``); falls back
+                            to ``T4J_TELEMETRY_DIR``, then the current
+                            directory.  The launcher's ``--telemetry
+                            DIR`` turns the recorder on there unless
+                            ``T4J_FLIGHT`` explicitly says off.
 
 The byte knobs accept an optional K/M/G suffix
 (``T4J_SEG_BYTES=256K``) and all of them must be uniform across ranks
@@ -179,6 +193,8 @@ __all__ = [
     "telemetry_bytes",
     "telemetry_dir",
     "metrics_port",
+    "flight_enabled",
+    "flight_dir",
 ]
 
 _TRUE = {"1", "true", "on", "yes"}
@@ -611,6 +627,28 @@ def metrics_port():
             f"{world} rank port(s) below 65536"
         )
     return port
+
+
+def flight_enabled():
+    """Crash-consistent flight recorder (docs/observability.md "flight
+    recorder"): truthy ``T4J_FLIGHT`` backs the telemetry event ring +
+    metrics table with a per-rank mmap'd file
+    (``<dir>/rank<k>-<boot>.t4jflight``, sized by
+    ``T4J_TELEMETRY_BYTES``) whose contents survive a SIGKILL /
+    segfault / OOM kill — the evidence ``t4j-postmortem`` reads.  An
+    unparsable value raises (a typo'd flag must not silently record
+    nothing)."""
+    return truthy(os.environ.get("T4J_FLIGHT"), default=False)
+
+
+def flight_dir():
+    """Directory the flight-recorder files land in, or ``None`` when
+    unset (the native side then falls back to ``T4J_TELEMETRY_DIR``,
+    then the current directory)."""
+    v = os.environ.get("T4J_FLIGHT_DIR")
+    if v is None or not str(v).strip():
+        return None
+    return str(v).strip()
 
 
 def op_timeout():

@@ -1,0 +1,164 @@
+"""Flight recorder + t4j-postmortem over a real launcher job
+(docs/observability.md "flight recorder").
+
+An 8-rank ``--telemetry DIR`` job whose rank 3 SIGKILLs itself
+MID-COLLECTIVE (a helper thread fires while the rank is blocked inside
+an allreduce) must leave, from the persisted files alone:
+
+* a crash-consistent ``rank3-<boot>.t4jflight`` file (no drained
+  ``rank3.t4j.json`` — the kill skipped every exit path) whose header
+  is unfinalized and whose mmap'd ring still holds the open allreduce;
+* survivors' drained files carrying their link_break/link_dead view;
+* a ``t4j-postmortem`` verdict naming the killed rank, its in-flight
+  op and the affected links — and the launcher's own first-failure
+  report must print the flight-recorder tail plus the postmortem
+  summary.
+
+The ctypes twin (plain + ASan) is tools/postmortem_smoke.py, the
+ci_smoke ``postmortem`` lane.
+"""
+
+import pathlib
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401 -- probe only
+except Exception as e:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu unavailable: {e}", allow_module_level=True)
+
+from mpi4jax_tpu.telemetry import dump, postmortem, schema
+
+from tests.proc.test_proc_backend import run_workers
+
+pytestmark = pytest.mark.fault
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+VICTIM = 3
+
+WORKER = f"""
+import os, signal, threading, time
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+n, rank = comm.size, comm.rank()
+
+tok = m.create_token()
+x = jnp.arange(256 * 1024, dtype=jnp.float32) + rank  # 1 MB payload
+y = x
+try:
+    for it in range(8):
+        if rank == {VICTIM} and it == 4:
+            # hard death MID-collective: the timer fires while this
+            # rank is blocked inside the allreduce below — no drain,
+            # no atexit, no finalize
+            threading.Thread(
+                target=lambda: (time.sleep(0.05),
+                                os.kill(os.getpid(), signal.SIGKILL)),
+                daemon=True,
+            ).start()
+        y, tok = m.allreduce(y, m.SUM, comm=comm, token=tok)
+        np.asarray(y)
+except Exception as e:
+    # survivors: the dead peer surfaces as a contextual bridge error
+    print("WORKER-SURVIVOR-ABORT", rank, type(e).__name__, flush=True)
+    raise SystemExit(17)
+print("WORKER-UNEXPECTED-COMPLETE", rank, flush=True)
+"""
+
+ENV = {
+    "T4J_NO_SHM": "1",
+    "T4J_RING_MIN_BYTES": "0",
+    "T4J_SEG_BYTES": "65536",
+    "T4J_OP_TIMEOUT": "30",
+    "T4J_RETRY_MAX": "2",
+    "T4J_BACKOFF_BASE": "0.05",
+    "T4J_BACKOFF_MAX": "0.2",
+}
+
+
+def test_sigkilled_rank_named_from_persisted_files(tmp_path):
+    tel_dir = tmp_path / "tel"
+    proc = run_workers(
+        WORKER, nprocs=8, env=ENV, timeout=300, expect_fail=True,
+        launch_args=("--telemetry", str(tel_dir)),
+    )
+    assert "WORKER-UNEXPECTED-COMPLETE" not in proc.stdout
+
+    # the kill skipped every cooperative exit path...
+    assert not (tel_dir / dump.rank_file_name(VICTIM)).exists()
+    flights = sorted(tel_dir.glob(f"rank{VICTIM}-*.t4jflight"))
+    assert flights, sorted(p.name for p in tel_dir.iterdir())
+    fobj = schema.read_flight_file(flights[-1])
+    assert not fobj["finalized"]
+    assert fobj["events"], "flight ring recovered zero events"
+    assert fobj["heartbeat_count"] > 0
+
+    # ...yet the postmortem names the rank, its op and its links from
+    # the files alone (stale threshold 0: the job ended seconds ago,
+    # and a launcher-reaped process cannot still be beating)
+    report = postmortem.analyze_dir(tel_dir, stale_s=0.0)
+    assert report["first_failing_rank"] == VICTIM
+    assert report["verdicts"][str(VICTIM)] == "dead"
+    vic = report["ranks"][str(VICTIM)]
+    open_ops = [o["op"] for o in vic["inflight"]["ops"]]
+    assert "allreduce" in open_ops, open_ops
+    assert vic["affected_links"], "no affected links recovered"
+    assert report["peer_views"], "no surviving peer view"
+    assert any(
+        any(row["kind"] in ("link_break", "link_dead") for row in rows)
+        for rows in report["peer_views"].values()
+    )
+    for r in range(8):
+        if r != VICTIM:
+            assert report["verdicts"][str(r)] == "drained", (
+                r, report["verdicts"])
+
+    # the launcher's first-failure report used the flight fallback
+    # (the victim had no drained file) and printed the postmortem
+    assert "flight recorder" in proc.stderr, proc.stderr[-2000:]
+    assert f"postmortem: first failure: rank {VICTIM}" in proc.stderr, (
+        proc.stderr[-2000:])
+
+
+def test_clean_job_finalizes_flight_files(tmp_path):
+    tel_dir = tmp_path / "tel"
+    proc = run_workers(
+        """
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+
+comm = m.get_default_comm()
+y, _ = m.allreduce(jnp.ones(1024, jnp.float32), m.SUM, comm=comm)
+np.asarray(y)
+print("WORKER-OK", comm.rank(), flush=True)
+""",
+        nprocs=2, env=ENV, launch_args=("--telemetry", str(tel_dir)),
+    )
+    assert proc.stdout.count("WORKER-OK") == 2
+    flights = sorted(tel_dir.glob(schema.FLIGHT_FILE_GLOB))
+    assert len(flights) == 2, sorted(p.name for p in tel_dir.iterdir())
+    for f in flights:
+        fobj = schema.read_flight_file(f)
+        assert fobj["finalized"], f
+    # zero false deaths on a healthy job
+    report = postmortem.analyze_dir(tel_dir, stale_s=0.0)
+    assert report["dead_ranks"] == []
+    assert report["first_failing_rank"] is None
+    # the drained rank files pair themselves with their flight file
+    for rank in (0, 1):
+        obj = schema.load_rank_file(tel_dir / dump.rank_file_name(rank))
+        assert obj["flight"].get("path", "").endswith(".t4jflight")

@@ -371,6 +371,42 @@ class TestTelemetryDir:
         assert config.telemetry_dir() == "/tmp/tel"
 
 
+class TestFlight:
+    """Crash-consistent flight recorder knobs (docs/observability.md
+    "flight recorder")."""
+
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("T4J_FLIGHT", raising=False)
+        assert config.flight_enabled() is False
+
+    @pytest.mark.parametrize("v,want", [
+        ("on", True), ("1", True), ("true", True), ("yes", True),
+        ("off", False), ("0", False), ("", False),
+    ])
+    def test_values(self, monkeypatch, v, want):
+        monkeypatch.setenv("T4J_FLIGHT", v)
+        assert config.flight_enabled() is want
+
+    def test_bad_value_raises(self, monkeypatch):
+        # a typo'd flag must fail at launch, not silently record
+        # nothing into no file
+        monkeypatch.setenv("T4J_FLIGHT", "always")
+        with pytest.raises(ValueError):
+            config.flight_enabled()
+
+    def test_dir_default_is_none(self, monkeypatch):
+        monkeypatch.delenv("T4J_FLIGHT_DIR", raising=False)
+        assert config.flight_dir() is None
+
+    def test_dir_empty_is_none(self, monkeypatch):
+        monkeypatch.setenv("T4J_FLIGHT_DIR", "  ")
+        assert config.flight_dir() is None
+
+    def test_dir_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_FLIGHT_DIR", "/tmp/flight")
+        assert config.flight_dir() == "/tmp/flight"
+
+
 def test_ensure_initialized_rejects_bad_telemetry(monkeypatch):
     """The telemetry knobs thread through native/runtime.py like the
     deadlines: a bad env value aborts initialisation before any socket

@@ -785,3 +785,45 @@ class TestExporterMembership:
                                      metrics=[])], job="j")
         assert agg["world_size"] is None
         assert "t4j_world_size" not in exporter.render_prometheus_job(agg)
+
+    def test_job_view_full_shrink_rejoin_cycle(self):
+        """The gauges through a whole elastic life cycle (epoch 0 boot
+        -> epoch 1 shrink losing rank 3 -> epoch 2 rejoin back to 8):
+        the job view must track each transition, mark the departure
+        only while it holds, and clear it when the slot rejoins."""
+        def stage(epoch, alive, mask, ranks):
+            return exporter.aggregate_snapshots(
+                [self._snap(r, epoch=epoch, alive=alive, mask=mask)
+                 for r in ranks], job="cycle")
+
+        boot = stage(0, 8, 0xFF, range(8))
+        assert (boot["world_size"], boot["world_epoch"]) == (8, 0)
+        assert boot["departed_ranks"] == []
+        shrink = stage(1, 7, 0xF7, [r for r in range(8) if r != 3])
+        assert (shrink["world_size"], shrink["world_epoch"]) == (7, 1)
+        assert shrink["departed_ranks"] == [3]
+        rejoin = stage(2, 8, 0xFF, range(8))
+        assert (rejoin["world_size"], rejoin["world_epoch"]) == (8, 2)
+        assert rejoin["departed_ranks"] == []
+        # the Prometheus series a dashboard would scrape at each stage
+        t0, t1, t2 = (exporter.render_prometheus_job(a)
+                      for a in (boot, shrink, rejoin))
+        assert "t4j_world_size 8" in t0 and "t4j_world_epoch 0" in t0
+        assert "t4j_rank_departed" not in t0
+        assert "t4j_world_size 7" in t1 and "t4j_world_epoch 1" in t1
+        assert 't4j_rank_departed{rank="3"} 1' in t1
+        assert "t4j_world_size 8" in t2 and "t4j_world_epoch 2" in t2
+        assert "t4j_rank_departed" not in t2
+
+    def test_job_view_mid_rejoin_scrape_prefers_freshest_epoch(self):
+        """A scrape that catches survivors already at epoch 2 while a
+        laggard still reports the epoch-1 shrunk view must resolve to
+        the rejoined world — freshest epoch wins, so the dashboard
+        never regresses to a stale membership."""
+        laggard = self._snap(5, epoch=1, alive=7, mask=0xF7)
+        fresh = [self._snap(r, epoch=2, alive=8, mask=0xFF)
+                 for r in (0, 3)]
+        agg = exporter.aggregate_snapshots([laggard] + fresh, job="j")
+        assert agg["world_epoch"] == 2
+        assert agg["world_size"] == 8
+        assert agg["departed_ranks"] == []

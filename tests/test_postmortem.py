@@ -1,0 +1,329 @@
+"""t4j-postmortem pure core (mpi4jax_tpu/telemetry/postmortem.py):
+cross-rank death analysis over synthetic drained + flight files.
+
+Same stub-loader pattern as tests/test_telemetry.py so the suite runs
+on every container, old-jax included.  The native half (a REAL
+SIGKILL'd rank recovered from its mmap'd flight file) is covered by
+tools/postmortem_smoke.py (the ci_smoke ``postmortem`` lane, plain +
+ASan) and tests/proc/test_postmortem_proc.py.
+"""
+
+import importlib
+import json
+import pathlib
+import sys
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_telemetry():
+    try:
+        import mpi4jax_tpu.telemetry as tele
+
+        return tele
+    except Exception:
+        stubbed = "mpi4jax_tpu" not in sys.modules
+        if stubbed:
+            stub = types.ModuleType("mpi4jax_tpu")
+            stub.__path__ = [str(REPO / "mpi4jax_tpu")]
+            sys.modules["mpi4jax_tpu"] = stub
+        try:
+            return importlib.import_module("mpi4jax_tpu.telemetry")
+        finally:
+            if stubbed:
+                sys.modules.pop("mpi4jax_tpu", None)
+
+
+tele = _load_telemetry()
+schema = tele.schema
+dump = importlib.import_module(tele.__name__ + ".dump")
+postmortem = importlib.import_module(tele.__name__ + ".postmortem")
+
+E = schema.Event
+NOW = 10**18  # the analysis instant (unix ns)
+T0 = NOW - 100 * 10**9  # job start: 100s before the analysis
+ANCHOR_MONO = 1_000_000_000  # every rank's monotonic anchor
+
+
+def _mono(rel_s):
+    """Job-relative seconds -> the synthetic monotonic clock."""
+    return ANCHOR_MONO + int(rel_s * 1e9)
+
+
+def write_drained(d, rank, events, world=8):
+    obj = dump.build_rank_obj(
+        rank, world, ANCHOR_MONO, T0, "trace", events=events)
+    with open(d / dump.rank_file_name(rank), "w") as f:
+        json.dump(obj, f)
+
+
+def write_flight(d, rank, events, *, boot=1, epoch=0, hb_rel_s=None,
+                 finalized=False, world=8, **kw):
+    events = list(events)
+    hb = _mono(hb_rel_s) if hb_rel_s is not None else (
+        events[-1].t_ns if events else _mono(0))
+    (d / schema.flight_file_name(rank, boot)).write_bytes(
+        schema.encode_flight_file(
+            rank, world, events, epoch=epoch, boot_unix_ns=boot,
+            anchor_mono_ns=ANCHOR_MONO, anchor_unix_ns=T0,
+            heartbeat_ns=hb, heartbeat_count=max(1, len(events)),
+            finalized=finalized, **kw))
+
+
+def op_span(rel_s, kind=7, lane=11, dur_s=0.01, peer=-1, nbytes=4096):
+    return [E(_mono(rel_s), kind, 1, 2, 0, peer, lane, nbytes),
+            E(_mono(rel_s + dur_s), kind, 2, 2, 0, peer, lane, nbytes)]
+
+
+def open_op(rel_s, kind=7, lane=11, peer=-1, nbytes=4096):
+    return [E(_mono(rel_s), kind, 1, 2, 0, peer, lane, nbytes)]
+
+
+def kill_scene(d, victim=3, world=8, kill_rel_s=50.0):
+    """The canonical hard death: every survivor drains (with a
+    link_break/link_dead view of the victim), the victim leaves only
+    a flight file with an open allreduce and a stopped heartbeat."""
+    for r in range(world):
+        if r == victim:
+            continue
+        events = op_span(kill_rel_s - 10) + [
+            E(_mono(kill_rel_s + 0.3), schema.KIND_IDS["link_break"],
+              0, 5, -1, victim, 7, 0),
+            E(_mono(kill_rel_s + 0.8), schema.KIND_IDS["link_dead"],
+              0, 5, -1, victim, 7, 0),
+        ]
+        write_drained(d, r, events, world=world)
+        write_flight(d, r, events, hb_rel_s=kill_rel_s + 2.0,
+                     world=world)
+    victim_events = (
+        op_span(kill_rel_s - 10)
+        + [E(_mono(kill_rel_s - 0.2), schema.STEP_KIND, 1, 5, -1, -1,
+             7, 4)]
+        + open_op(kill_rel_s - 0.1, peer=-1)
+        + [E(_mono(kill_rel_s - 0.05), schema.KIND_IDS["frame_tx"], 0,
+             2, -1, (victim + 1) % world, 7, 65536)]
+    )
+    write_flight(d, victim, victim_events, hb_rel_s=kill_rel_s,
+                 world=world)
+    return victim
+
+
+class TestVerdicts:
+    def test_hard_death_vs_survivors(self, tmp_path):
+        victim = kill_scene(tmp_path)
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        assert report["first_failing_rank"] == victim
+        assert report["verdicts"][str(victim)] == "dead"
+        assert report["dead_ranks"] == [victim]
+        for r in range(8):
+            if r != victim:
+                assert report["verdicts"][str(r)] == "drained"
+
+    def test_fresh_heartbeat_reads_wedged_not_dead(self, tmp_path):
+        kill_scene(tmp_path, kill_rel_s=99.0)  # died 1s before "now"
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        assert report["verdicts"]["3"] == "alive"
+        assert report["wedged_ranks"] == [3]
+        assert report["first_failing_rank"] == 3  # still fingered
+
+    def test_finalized_flight_is_not_a_death(self, tmp_path):
+        write_flight(tmp_path, 0, op_span(10), finalized=True,
+                     hb_rel_s=20.0)
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        assert report["verdicts"]["0"] == "finalized"
+        assert report["dead_ranks"] == []
+        assert report["first_failing_rank"] is None
+
+    def test_no_evidence_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            postmortem.analyze_dir(tmp_path)
+
+
+class TestFirstFailure:
+    def test_earliest_death_wins_among_two(self, tmp_path):
+        write_flight(tmp_path, 1, open_op(40.0), hb_rel_s=40.0)
+        write_flight(tmp_path, 5, open_op(44.0), hb_rel_s=44.0)
+        write_drained(tmp_path, 0, op_span(45))
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        assert sorted(report["dead_ranks"]) == [1, 5]
+        assert report["first_failing_rank"] == 1
+
+    def test_accusations_fallback_without_victim_evidence(
+            self, tmp_path):
+        # flight recorder off on the dead rank: survivors' control
+        # events still converge on the accused peer
+        for r in (0, 1, 2):
+            write_drained(tmp_path, r, [
+                E(_mono(50), schema.KIND_IDS["link_dead"], 0, 5, -1, 6,
+                  7, 0)])
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        assert report["first_failing_rank"] == 6
+        assert report["verdicts"]["6"] == "no-evidence"
+        # summary_lines must not crash on the evidence-free victim
+        lines = postmortem.summary_lines(report)
+        assert any("rank 6" in ln for ln in lines)
+
+
+class TestInflightAndPeers:
+    def test_open_op_step_links_and_peer_views(self, tmp_path):
+        victim = kill_scene(tmp_path)
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        vic = report["ranks"][str(victim)]
+        assert [o["op"] for o in vic["inflight"]["ops"]] == ["allreduce"]
+        assert vic["inflight"]["step"] == 4  # died inside step #4
+        assert (victim + 1) % 8 in vic["affected_links"]
+        views = report["peer_views"]
+        assert len(views) == 7
+        kinds = {row["kind"] for rows in views.values() for row in rows}
+        assert {"link_break", "link_dead"} <= kinds
+        lines = postmortem.summary_lines(report)
+        joined = "\n".join(lines)
+        assert f"first failure: rank {victim}" in joined
+        assert "allreduce" in joined
+        assert "step #4" in joined
+
+    def test_balanced_stream_has_nothing_inflight(self, tmp_path):
+        write_drained(tmp_path, 0, op_span(10) + op_span(11))
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        assert report["ranks"]["0"]["inflight"]["ops"] == []
+
+
+class TestResizeOrdering:
+    def _resize_events(self, begin_rel_s, epoch, members):
+        return [
+            E(_mono(begin_rel_s), schema.RESIZE_BEGIN_KIND, 0, 5, -1,
+              -1, 7, epoch),
+            E(_mono(begin_rel_s + 0.5), schema.RESIZE_DONE_KIND, 0, 5,
+              -1, members, 7, epoch),
+        ]
+
+    def test_death_preceding_the_resize_that_removed_it(self, tmp_path):
+        victim = 3
+        for r in range(8):
+            if r == victim:
+                continue
+            events = [
+                E(_mono(50.2), schema.KIND_IDS["rank_dead"], 0, 5, -1,
+                  victim, 7, 1),
+            ] + self._resize_events(50.3, 1, 7)
+            write_drained(tmp_path, r, events)
+        write_flight(tmp_path, victim, open_op(49.9), hb_rel_s=50.0,
+                     epoch=0)
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        resize = report["resize"]
+        assert resize is not None
+        assert resize["victim_epoch"] == 0
+        assert resize["removing_epoch"] == 1
+        assert resize["death_preceded_resize"] is True
+        joined = "\n".join(postmortem.summary_lines(report))
+        assert "preceded resize epoch 1" in joined
+
+    def test_death_after_surviving_an_earlier_resize(self, tmp_path):
+        # victim lived through epoch 1 (its header says so) and died
+        # later, with no epoch-2 resize observed
+        victim = 2
+        for r in (0, 1):
+            write_drained(tmp_path, r, self._resize_events(30.0, 1, 7))
+        write_flight(tmp_path, victim, open_op(60.0), hb_rel_s=60.0,
+                     epoch=1)
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        resize = report["resize"]
+        assert resize["victim_epoch"] == 1
+        assert resize["removing_epoch"] is None
+        assert resize["death_followed_epoch"] == 1
+        joined = "\n".join(postmortem.summary_lines(report))
+        assert "followed resize epoch 1" in joined
+
+
+class TestTimelineAndWindow:
+    def test_window_drops_old_events(self, tmp_path):
+        events = [
+            E(_mono(5.0), schema.KIND_IDS["link_break"], 0, 5, -1, 1,
+              7, 0),
+            E(_mono(95.0), schema.KIND_IDS["link_break"], 0, 5, -1, 1,
+              7, 0),
+        ]
+        write_drained(tmp_path, 0, events)
+        wide = postmortem.analyze_dir(tmp_path, window_s=1000,
+                                      now_unix_ns=NOW)
+        narrow = postmortem.analyze_dir(tmp_path, window_s=10,
+                                        now_unix_ns=NOW)
+        assert len(wide["timeline"]) == 2
+        assert len(narrow["timeline"]) == 1
+        assert narrow["timeline"][0]["t_rel_s"] == pytest.approx(95.0)
+
+    def test_timeline_is_job_relative_and_sorted(self, tmp_path):
+        kill_scene(tmp_path)
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        rels = [row["t_rel_s"] for row in report["timeline"]]
+        assert rels == sorted(rels)
+        assert all(r is not None and r >= 0 for r in rels)
+
+
+class TestMergedEvidence:
+    def test_drained_and_flight_events_dedupe(self, tmp_path):
+        events = op_span(10)
+        write_drained(tmp_path, 0, events)
+        write_flight(tmp_path, 0, events, hb_rel_s=11.0)
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        assert report["ranks"]["0"]["events"] == 2  # not 4
+        assert report["ranks"]["0"]["sources"] == ["drained", "flight"]
+
+    def test_newest_incarnation_wins_and_counts(self, tmp_path):
+        write_flight(tmp_path, 0, open_op(10.0), boot=100,
+                     hb_rel_s=10.0)
+        write_flight(tmp_path, 0, open_op(60.0), boot=200,
+                     hb_rel_s=60.0, epoch=2)
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        assert report["ranks"]["0"]["incarnations"] == 2
+        assert report["ranks"]["0"]["epoch"] == 2
+
+    def test_torn_slots_surface_in_report(self, tmp_path):
+        write_flight(tmp_path, 0, open_op(10.0), hb_rel_s=10.0,
+                     torn_positions=(30,))
+        report = postmortem.analyze_dir(tmp_path, now_unix_ns=NOW)
+        assert report["ranks"]["0"]["torn_slots"] == 1
+
+    def test_split_flight_dir_evidence_is_found(self, tmp_path):
+        # an explicit T4J_FLIGHT_DIR can point away from the telemetry
+        # dir: the analysis must read flight files from BOTH, or a
+        # hard death in the custom dir silently degrades to
+        # "no-evidence"
+        tel = tmp_path / "tel"
+        fdir = tmp_path / "flight"
+        tel.mkdir()
+        fdir.mkdir()
+        write_drained(tel, 0, op_span(45) + [
+            E(_mono(50), schema.KIND_IDS["link_dead"], 0, 5, -1, 3, 7,
+              0)])
+        write_flight(fdir, 3, open_op(49.9), hb_rel_s=50.0)
+        report = postmortem.analyze_dir(tel, now_unix_ns=NOW,
+                                        flight_dir=fdir)
+        assert report["first_failing_rank"] == 3
+        assert report["verdicts"]["3"] == "dead"
+        assert report["ranks"]["3"]["sources"] == ["flight"]
+        # same dir passed twice must not double-count incarnations
+        write_flight(tel, 1, open_op(40.0), hb_rel_s=40.0)
+        report2 = postmortem.analyze_dir(tel, now_unix_ns=NOW,
+                                         flight_dir=tel)
+        assert report2["ranks"]["1"]["incarnations"] == 1
+
+
+class TestCLI:
+    def test_render_and_json(self, tmp_path, capsys):
+        kill_scene(tmp_path)
+        assert postmortem.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "t4j-postmortem" in out
+        assert "first failure: rank 3" in out
+        assert postmortem.main([str(tmp_path), "--json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["schema"] == "t4j-postmortem-v1"
+        assert report["first_failing_rank"] == 3
+
+    def test_missing_dir_errors(self, tmp_path, capsys):
+        assert postmortem.main([str(tmp_path / "nope")]) == 2
+        assert "t4j-postmortem" in capsys.readouterr().err

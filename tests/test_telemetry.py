@@ -54,6 +54,7 @@ recorder = importlib.import_module(tele.__name__ + ".recorder")
 trace = importlib.import_module(tele.__name__ + ".trace")
 dump = importlib.import_module(tele.__name__ + ".dump")
 top = importlib.import_module(tele.__name__ + ".top")
+exporter = importlib.import_module(tele.__name__ + ".exporter")
 
 
 # ---- schema --------------------------------------------------------------
@@ -527,3 +528,200 @@ class TestElasticEvents:
         summary = top.summarize([make_rank_obj(0)])
         assert summary["ranks"][0]["world_epoch"] == 0
         assert "elastic:" not in top.render(summary)
+
+
+class TestMembershipGaugeCycle:
+    """The exporter's membership gauges through a full elastic cycle
+    (epoch 0 boot -> epoch 1 shrink losing rank 3 -> epoch 2 rejoin):
+    per-rank t4j_world_* series and the job view's
+    t4j_world_size/t4j_world_epoch/t4j_rank_departed transitions
+    (docs/failure-semantics.md "elastic membership")."""
+
+    @staticmethod
+    def _snap(rank, epoch, alive, mask):
+        return exporter.build_snapshot(
+            rank=rank, world=8, mode="counters", metrics=[],
+            world_info={"epoch": epoch, "boot_size": 8,
+                        "alive_count": alive, "alive_mask": mask,
+                        "resizing": False},
+        )
+
+    def test_per_rank_series_follow_each_epoch(self):
+        for epoch, alive, mask in ((0, 8, 0xFF), (1, 7, 0xF7),
+                                   (2, 8, 0xFF)):
+            text = exporter.render_prometheus(
+                self._snap(0, epoch, alive, mask))
+            assert f't4j_world_size{{rank="0"}} {alive}' in text
+            assert f't4j_world_epoch{{rank="0"}} {epoch}' in text
+
+    def test_job_view_transitions_across_the_cycle(self):
+        def job_view(epoch, alive, mask, ranks):
+            return exporter.aggregate_snapshots(
+                [self._snap(r, epoch, alive, mask) for r in ranks],
+                job="cycle")
+
+        boot = job_view(0, 8, 0xFF, range(8))
+        shrink = job_view(1, 7, 0xF7, [r for r in range(8) if r != 3])
+        rejoin = job_view(2, 8, 0xFF, range(8))
+        assert [a["world_epoch"] for a in (boot, shrink, rejoin)] \
+            == [0, 1, 2]
+        assert [a["world_size"] for a in (boot, shrink, rejoin)] \
+            == [8, 7, 8]
+        assert boot["departed_ranks"] == []
+        assert shrink["departed_ranks"] == [3]
+        assert rejoin["departed_ranks"] == []  # the slot came back
+        t1 = exporter.render_prometheus_job(shrink)
+        assert 't4j_rank_departed{rank="3"} 1' in t1
+        t2 = exporter.render_prometheus_job(rejoin)
+        assert "t4j_rank_departed" not in t2
+
+
+# ---- flight recorder (crash-consistent mmap arena) -----------------------
+
+
+class TestFlightFile:
+    """The flight-file codec (docs/observability.md "flight
+    recorder"): byte-exact mirror of tel::FlightHeader/Slot/Table,
+    torn-slot recovery, and the finalize flag."""
+
+    def _events(self, n=5):
+        return [schema.Event(1000 + i * 100, 7, 1 if i % 2 == 0 else 2,
+                             2, 0, -1, 42, 4096) for i in range(n)]
+
+    def test_header_layout_pinned(self):
+        assert schema.FLIGHT_HEADER_STRUCT.size == 136
+        assert schema.FLIGHT_HEADER_BYTES == 160
+        assert schema.FLIGHT_SLOT_STRUCT.size == 40
+
+    def test_roundtrip(self, tmp_path):
+        ev = self._events()
+        p = tmp_path / schema.flight_file_name(3, 777)
+        p.write_bytes(schema.encode_flight_file(
+            3, 8, ev, epoch=2, boot_unix_ns=777, boot_token=0xBEEF,
+            anchor_mono_ns=500, anchor_unix_ns=10**18,
+            heartbeat_ns=9999, heartbeat_count=12, dropped=4))
+        obj = schema.read_flight_file(p)
+        assert obj["rank"] == 3 and obj["world"] == 8
+        assert obj["epoch"] == 2
+        assert obj["boot_token"] == 0xBEEF
+        assert obj["heartbeat_count"] == 12
+        assert obj["dropped"] == 4
+        assert not obj["finalized"]
+        assert obj["events"] == ev
+        assert obj["torn_slots"] == 0
+
+    def test_torn_slot_dropped_not_misread(self, tmp_path):
+        ev = self._events(3)
+        p = tmp_path / "rank0-1.t4jflight"
+        p.write_bytes(schema.encode_flight_file(
+            0, 2, ev, torn_positions=(7, 9)))
+        obj = schema.read_flight_file(p)
+        assert obj["events"] == ev  # the valid slots survive intact
+        assert obj["torn_slots"] == 2
+
+    def test_truncated_tail_recovers_whole_slots(self, tmp_path):
+        ev = self._events(4)
+        buf = schema.encode_flight_file(0, 2, ev, nslots=64)
+        # cut mid-way through slot 3's record AND lose the metrics
+        # table entirely — the reader must return the 3 whole slots
+        # and a None metrics, never raise or misparse
+        cut = (schema.FLIGHT_HEADER_BYTES
+               + 3 * schema.FLIGHT_SLOT_STRUCT.size + 11)
+        p = tmp_path / "rank0-2.t4jflight"
+        p.write_bytes(buf[:cut])
+        obj = schema.read_flight_file(p)
+        assert obj["events"] == ev[:3]
+        assert obj["metrics"] is None
+
+    def test_wrong_magic_rejected(self, tmp_path):
+        buf = bytearray(schema.encode_flight_file(0, 1, []))
+        buf[0] = 0x58
+        p = tmp_path / "rank0-3.t4jflight"
+        p.write_bytes(bytes(buf))
+        with pytest.raises(schema.SchemaError, match="magic"):
+            schema.read_flight_file(p)
+
+    def test_finalized_flag(self, tmp_path):
+        p = tmp_path / "rank1-4.t4jflight"
+        p.write_bytes(schema.encode_flight_file(1, 2, [],
+                                                finalized=True))
+        assert schema.read_flight_file(p)["finalized"]
+
+    def test_metrics_table_parses_like_a_snapshot(self, tmp_path):
+        row = {"comm": 0, "kind": 7, "plane": 2, "count": 10,
+               "bytes": 40960, "sum_ns": 5_000_000, "min_ns": 100_000,
+               "max_ns": 900_000,
+               "lat": [0] * schema.FLIGHT_LAT_BUCKETS,
+               "size": [0] * schema.FLIGHT_SIZE_BUCKETS}
+        row["lat"][8] = 10
+        row["size"][6] = 10
+        p = tmp_path / "rank0-5.t4jflight"
+        p.write_bytes(schema.encode_flight_file(0, 1, [],
+                                                metrics_rows=[row]))
+        metrics = schema.read_flight_file(p)["metrics"]
+        assert metrics["rows"] == [row]
+        # the same registry machinery the drained files feed
+        reg = registry.MetricsRegistry.from_snapshot(metrics)
+        agg = reg.aggregate(op="allreduce")
+        assert agg.stats()["count"] == 10
+
+
+class TestTopFlightStatus:
+    """t4j-top's flight-recorder line (docs/observability.md): per-rank
+    on/off, file size and heartbeat age, with flight-only ranks (never
+    drained — running, wedged, or hard-dead) still shown."""
+
+    def _write(self, d, rank, boot, *, hb_age_s, finalized=False,
+               now_ns=None):
+        now_ns = now_ns or 10**18
+        anchor_unix = now_ns - 60 * 10**9
+        hb_mono = 500 + (60 - hb_age_s) * 10**9
+        (d / schema.flight_file_name(rank, boot)).write_bytes(
+            schema.encode_flight_file(
+                rank, 8, [], boot_unix_ns=boot, anchor_mono_ns=500,
+                anchor_unix_ns=anchor_unix, heartbeat_ns=int(hb_mono),
+                heartbeat_count=9, finalized=finalized))
+
+    def test_status_and_staleness(self, tmp_path):
+        now = 10**18
+        self._write(tmp_path, 0, 1, hb_age_s=0.5, now_ns=now)
+        self._write(tmp_path, 3, 1, hb_age_s=45.0, now_ns=now)
+        self._write(tmp_path, 5, 1, hb_age_s=45.0, finalized=True,
+                    now_ns=now)
+        st = top.load_flight_status(tmp_path, now_unix_ns=now)
+        assert not st[0]["stale"] and st[0]["heartbeat_age_s"] < 1
+        assert st[3]["stale"]  # dead: old beat, no finalize
+        assert not st[5]["stale"]  # clean exit is not a death
+        assert st[5]["finalized"]
+
+    def test_newest_incarnation_wins(self, tmp_path):
+        now = 10**18
+        self._write(tmp_path, 2, 100, hb_age_s=50.0, now_ns=now)
+        self._write(tmp_path, 2, 200, hb_age_s=0.5, now_ns=now)
+        st = top.load_flight_status(tmp_path, now_unix_ns=now)
+        assert st[2]["boot_unix_ns"] == 200
+        assert not st[2]["stale"]
+
+    def test_render_includes_flight_line_and_flightonly_rank(
+            self, tmp_path):
+        import json
+
+        now = 10**18
+        with open(tmp_path / dump.rank_file_name(0), "w") as f:
+            json.dump(make_rank_obj(0), f)
+        self._write(tmp_path, 0, 1, hb_age_s=0.2, finalized=True,
+                    now_ns=now)
+        self._write(tmp_path, 3, 1, hb_age_s=45.0, now_ns=now)
+        flight = top.load_flight_status(tmp_path, now_unix_ns=now)
+        summary = top.summarize(top.load_rank_objs(tmp_path),
+                                flight=flight)
+        ranks = {r["rank"] for r in summary["ranks"]}
+        assert ranks == {0, 3}  # the never-drained rank is visible
+        text = top.render(summary)
+        assert "flight:" in text
+        assert "r3 STALE" in text
+        assert "r0 done" in text
+
+    def test_no_flight_files_keeps_line_silent(self, tmp_path):
+        summary = top.summarize([make_rank_obj(0)], flight={})
+        assert "flight:" not in top.render(summary)

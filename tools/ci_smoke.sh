@@ -77,6 +77,19 @@
 #                coordinator port and rejoins at epoch 2
 #                (T4J_ELASTIC=rejoin).  ctypes only — runs on old-jax
 #                containers.
+#  14. postmortem — tools/postmortem_smoke.py twice: plain and under
+#                AddressSanitizer.  The crash-consistent flight
+#                recorder (docs/observability.md "flight recorder"):
+#                an 8-rank T4J_FLIGHT=on job whose victim rank
+#                SIGKILLs itself mid-collective must leave a
+#                recoverable mmap'd flight file (unfinalized header,
+#                stopped heartbeat, the open allreduce still in the
+#                ring), and t4j-postmortem must name the victim, its
+#                in-flight op and the affected links from the
+#                persisted files alone; a clean run must finalize
+#                every header with zero false deaths, and an
+#                unset-knob run must write no flight files.  ctypes
+#                only — runs on old-jax containers.
 #  13. autotune — tools/autotune_smoke.py twice: plain and under
 #                AddressSanitizer.  An 8-rank calibrate phase (the
 #                collective knob fit measured through the telemetry
@@ -98,7 +111,7 @@ cd "$(dirname "$0")/.."
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
   lanes=(tier1 fault proc asan tsan lint resilience telemetry async
-         diagnose bench elastic autotune)
+         diagnose bench elastic autotune postmortem)
 fi
 
 run_lane() {
@@ -186,8 +199,14 @@ assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
       run_lane autotune-asan env T4J_SANITIZE=address timeout -k 10 900 \
         python tools/autotune_smoke.py 8
       ;;
+    postmortem)
+      run_lane postmortem-plain env -u T4J_SANITIZE timeout -k 10 900 \
+        python tools/postmortem_smoke.py 8
+      run_lane postmortem-asan env T4J_SANITIZE=address timeout -k 10 900 \
+        python tools/postmortem_smoke.py 8
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem)" >&2
       exit 2
       ;;
   esac

@@ -1,0 +1,457 @@
+#!/usr/bin/env python
+"""Postmortem smoke lane: the crash-consistent flight recorder +
+``t4j-postmortem`` end-to-end (docs/observability.md "flight
+recorder").
+
+Two phases over an N-rank (default 8) proc world driven through the
+native bridge's ctypes C API (no jax import anywhere — the lane runs
+on old-jax containers and under sanitizer preloads alike, the same
+harness shape as tools/telemetry_smoke.py):
+
+  1. kill — every rank loops allreduces with ``T4J_FLIGHT=on`` +
+            ``T4J_TELEMETRY=trace``; one victim rank SIGKILLs itself
+            MID-COLLECTIVE (a helper thread fires while the rank is
+            blocked inside the allreduce), so it never drains
+            anything.  Survivors observe the dead peer (exhausted
+            reconnects -> abort) and write their drained rank files.
+            The driver then asserts from the persisted files ALONE:
+            the victim left a flight file but no drained file; its
+            flight header is NOT finalized and the heartbeat stopped;
+            ``t4j-postmortem`` names the victim as the first-failing
+            rank, recovers its open (in-flight) allreduce from the
+            mmap'd ring, lists the affected links, and shows the
+            survivors' link_break/link_dead view of the victim.
+  2. clean — same workload, no kill: every rank finalizes, every
+            flight header must carry the finalized flag, and the
+            postmortem must report zero hard deaths (no false
+            positives from a healthy job).
+  3. off  — ``T4J_FLIGHT`` unset: no .t4jflight files may appear (the
+            recorder is opt-in).
+
+Run under AddressSanitizer by exporting ``T4J_SANITIZE=address``
+before invoking (tools/ci_smoke.sh does).
+
+Usage: python tools/postmortem_smoke.py [nprocs] [--phase kill|clean|off]
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import types
+import uuid
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+FAILED = 21
+
+VICTIM = 3
+KILL_ITER = 5
+COUNT = 1024 * 1024  # f32 elements per allreduce (4 MB): wide enough
+                     # that the kill timer fires while the victim is
+                     # still blocked inside the collective
+
+
+def _stub_packages():
+    for name in ("mpi4jax_tpu", "mpi4jax_tpu.utils", "mpi4jax_tpu.native"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [str(REPO / name.replace(".", "/"))]
+            sys.modules[name] = mod
+
+
+def _load_telemetry():
+    try:
+        import mpi4jax_tpu.telemetry as tele  # noqa: PLC0415
+
+        return tele
+    except Exception:
+        pass
+    _stub_packages()
+    import importlib
+
+    return importlib.import_module("mpi4jax_tpu.telemetry")
+
+
+def _load_build_module():
+    try:
+        from mpi4jax_tpu.native import build  # noqa: PLC0415
+
+        return build
+    except Exception:
+        pass
+    _stub_packages()
+    for name, rel in (
+        ("mpi4jax_tpu.utils.config", "mpi4jax_tpu/utils/config.py"),
+        ("mpi4jax_tpu.native.build", "mpi4jax_tpu/native/build.py"),
+    ):
+        if name in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(name, REPO / rel)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["mpi4jax_tpu.native.build"]
+
+
+def _sanitizer_env():
+    san = os.environ.get("T4J_SANITIZE", "").strip().lower()
+    if not san:
+        return {}
+    lib = {"address": "libasan.so", "asan": "libasan.so",
+           "1": "libasan.so", "thread": "libtsan.so",
+           "tsan": "libtsan.so"}.get(san)
+    if lib is None:
+        return {}
+    paths = []
+    for name in (lib, "libstdc++.so.6"):
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={name}"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+        if out and out != name:
+            paths.append(out)
+    if not paths:
+        return {}
+    return {
+        "LD_PRELOAD": " ".join(paths),
+        "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
+    }
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ worker
+
+
+def _load_lib(so):
+    import ctypes
+
+    i32, i64, u64, vp = (ctypes.c_int32, ctypes.c_int64, ctypes.c_uint64,
+                         ctypes.c_void_p)
+    lib = ctypes.CDLL(so)
+    lib.t4j_init.restype = ctypes.c_int
+    lib.t4j_last_error.restype = ctypes.c_char_p
+    lib.t4j_c_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_c_allreduce.restype = i32
+    lib.t4j_telemetry_drain.argtypes = [vp, i64]
+    lib.t4j_telemetry_drain.restype = i64
+    lib.t4j_telemetry_dropped.restype = u64
+    lib.t4j_telemetry_anchor.argtypes = [ctypes.POINTER(u64),
+                                         ctypes.POINTER(u64)]
+    lib.t4j_telemetry_anchor.restype = i32
+    lib.t4j_metrics_snapshot.argtypes = [ctypes.POINTER(u64), i64]
+    lib.t4j_metrics_snapshot.restype = i64
+    lib.t4j_flight_info.argtypes = [ctypes.c_char_p, i32,
+                                    ctypes.POINTER(u64),
+                                    ctypes.POINTER(u64),
+                                    ctypes.POINTER(u64),
+                                    ctypes.POINTER(u64)]
+    lib.t4j_flight_info.restype = i32
+    return lib
+
+
+def worker(so):
+    import ctypes
+
+    import numpy as np
+
+    tele = _load_telemetry()
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    lib = _load_lib(so)
+    rc = lib.t4j_init()
+    if rc != 0:
+        raise RuntimeError(f"init rc={rc}: {lib.t4j_last_error().decode()}")
+    rank = lib.t4j_world_rank()
+    n = lib.t4j_world_size()
+    phase = os.environ["SMOKE_PHASE"]
+    victim = phase == "kill" and rank == VICTIM
+    iters = KILL_ITER + 3
+    try:
+        if phase in ("kill", "clean"):
+            # flight recorder must be live from init on this phase
+            u64_ = ctypes.c_uint64
+            fb, hb, hc, ep = u64_(), u64_(), u64_(), u64_()
+            path = ctypes.create_string_buffer(4096)
+            if not lib.t4j_flight_info(path, len(path),
+                                       ctypes.byref(fb), ctypes.byref(hb),
+                                       ctypes.byref(hc), ctypes.byref(ep)):
+                raise RuntimeError("flight recorder inactive despite "
+                                   "T4J_FLIGHT=on")
+            if not pathlib.Path(path.value.decode()).exists():
+                raise RuntimeError(f"flight file missing: {path.value!r}")
+        aborted = False
+        for it in range(iters):
+            x = np.full(COUNT, float(rank + it), np.float32)
+            out = np.empty_like(x)
+            if victim and it == KILL_ITER:
+                # die MID-collective: the helper fires while this rank
+                # is blocked inside the allreduce below — no drain, no
+                # atexit, no finalize will ever run
+                threading.Thread(
+                    target=lambda: (__import__("time").sleep(0.05),
+                                    os.kill(os.getpid(), signal.SIGKILL)),
+                    daemon=True,
+                ).start()
+            st = lib.t4j_c_allreduce(0, ptr(x), ptr(out), COUNT, 0, 0)
+            if st:
+                # survivors: the dead peer surfaces as a contextual
+                # abort once reconnect retries exhaust — expected
+                aborted = True
+                print(
+                    f"r{rank} | allreduce[{it}] aborted as expected: "
+                    f"{lib.t4j_last_error().decode()[:160]}",
+                    flush=True,
+                )
+                break
+        if victim:
+            raise RuntimeError("victim survived its own SIGKILL")
+        if phase == "kill" and not aborted:
+            raise RuntimeError("survivor never observed the dead rank")
+
+        # drain into a rank file, the cooperative-exit artifact the
+        # postmortem pairs with the victim's raw flight file
+        buf = ctypes.create_string_buffer(32 * 65536)
+        got = lib.t4j_telemetry_drain(buf, len(buf))
+        events = tele.decode_events(buf.raw[:got])
+        need = lib.t4j_metrics_snapshot(None, 0)
+        words = []
+        if need > 0:
+            arr = (ctypes.c_uint64 * need)()
+            lib.t4j_metrics_snapshot(arr, need)
+            words = list(arr)
+        mono = ctypes.c_uint64(0)
+        unix = ctypes.c_uint64(0)
+        lib.t4j_telemetry_anchor(ctypes.byref(mono), ctypes.byref(unix))
+        from mpi4jax_tpu.telemetry import dump
+
+        obj = dump.build_rank_obj(
+            rank=rank, world=n,
+            anchor_mono_ns=mono.value, anchor_unix_ns=unix.value,
+            mode=os.environ.get("T4J_TELEMETRY", "off"),
+            events=events, metrics_words=words,
+            dropped=lib.t4j_telemetry_dropped(),
+            job=os.environ.get("T4J_JOB", ""),
+        )
+        out_dir = pathlib.Path(os.environ["SMOKE_DIR"])
+        p = out_dir / dump.rank_file_name(rank)
+        tmp = p.with_suffix(f".tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, p)
+        if phase == "kill":
+            print(f"SMOKE-SURVIVOR-OK {rank} events={len(events)}",
+                  flush=True)
+            # survivors of an abort skip finalize (nobody to barrier
+            # with); their flight files legitimately stay unfinalized
+            sys.exit(0)
+        lib.t4j_finalize()
+        print(f"SMOKE-CLEAN-OK {rank} events={len(events)}", flush=True)
+        sys.exit(0)
+    except RuntimeError as e:
+        print(f"SMOKE-FAILED: {e}", flush=True)
+        sys.exit(FAILED)
+
+
+# ------------------------------------------------------------------ driver
+
+
+def run_phase(phase, n, so, out_dir):
+    coord = f"127.0.0.1:{_free_port()}"
+    job = uuid.uuid4().hex[:8]
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.update(
+            T4J_RANK=str(r), T4J_SIZE=str(n), T4J_COORD=coord,
+            T4J_JOB=job, T4J_NO_SHM="1",
+            T4J_RING_MIN_BYTES="0", T4J_SEG_BYTES="65536",
+            T4J_TELEMETRY="trace",
+            # keep the survivors' dead-peer verdict fast
+            T4J_OP_TIMEOUT="20", T4J_CONNECT_TIMEOUT="30",
+            T4J_RETRY_MAX="2", T4J_BACKOFF_BASE="0.05",
+            T4J_BACKOFF_MAX="0.2",
+            SMOKE_PHASE=phase, SMOKE_DIR=str(out_dir),
+        )
+        if phase in ("kill", "clean"):
+            env["T4J_FLIGHT"] = "on"
+            env["T4J_FLIGHT_DIR"] = str(out_dir)
+        else:
+            env.pop("T4J_FLIGHT", None)
+            env["T4J_FLIGHT_DIR"] = str(out_dir)
+        env.update(_sanitizer_env())
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__, "worker", so],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    ok = True
+    rcs = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        rcs.append(p.returncode)
+        print(f"--- [{phase}] rank {r} (rc={p.returncode}) ---")
+        print(out[-1500:])
+    tele = _load_telemetry()
+    out_dir = pathlib.Path(out_dir)
+
+    if phase == "off":
+        if any(rc != 0 for rc in rcs):
+            print(f"FAIL: off phase had nonzero exits: {rcs}")
+            return False
+        flights = list(out_dir.glob(tele.FLIGHT_FILE_GLOB))
+        if flights:
+            print(f"FAIL: T4J_FLIGHT unset but flight files appeared: "
+                  f"{flights}")
+            return False
+        print("off phase OK: no flight files without the knob")
+        return ok
+
+    if phase == "clean":
+        if any(rc != 0 for rc in rcs):
+            print(f"FAIL: clean phase had nonzero exits: {rcs}")
+            return False
+        from mpi4jax_tpu.telemetry import postmortem
+
+        flights = sorted(out_dir.glob(tele.FLIGHT_FILE_GLOB))
+        if len(flights) != n:
+            print(f"FAIL: {len(flights)} flight files, want {n}")
+            return False
+        for f in flights:
+            fo = tele.read_flight_file(f)
+            if not fo["finalized"]:
+                print(f"FAIL: clean exit left {f} unfinalized")
+                return False
+            if fo["heartbeat_count"] == 0:
+                print(f"FAIL: {f} heartbeat never ticked")
+                return False
+        report = postmortem.analyze_dir(out_dir)
+        if report["dead_ranks"] or report["wedged_ranks"]:
+            print(f"FAIL: clean job misread as dead="
+                  f"{report['dead_ranks']} wedged="
+                  f"{report['wedged_ranks']}")
+            return False
+        print(f"clean phase OK: {n} finalized flight files, zero "
+              "false deaths")
+        return ok
+
+    # ---- kill phase: the postmortem is the product under test -------
+    if rcs[VICTIM] != -signal.SIGKILL:
+        print(f"FAIL: victim rc={rcs[VICTIM]}, want {-signal.SIGKILL}")
+        return False
+    for r, rc in enumerate(rcs):
+        if r != VICTIM and rc != 0:
+            print(f"FAIL: survivor {r} rc={rc}")
+            return False
+    from mpi4jax_tpu.telemetry import dump, postmortem
+
+    if (out_dir / dump.rank_file_name(VICTIM)).exists():
+        print("FAIL: the SIGKILL'd victim somehow drained a rank file")
+        return False
+    victim_flights = sorted(out_dir.glob(f"rank{VICTIM}-*.t4jflight"))
+    if not victim_flights:
+        print("FAIL: victim left no flight file")
+        return False
+    fobj = tele.read_flight_file(victim_flights[-1])
+    if fobj["finalized"]:
+        print("FAIL: victim's flight header claims a clean finalize")
+        return False
+    if not fobj["events"]:
+        print("FAIL: victim's flight ring recovered zero events")
+        return False
+    if fobj["heartbeat_count"] == 0:
+        print("FAIL: victim's heartbeat never ticked")
+        return False
+
+    # dead-vs-wedged is decided by heartbeat age: immediately after
+    # the kill the victim's last beat is still fresh (it reads as
+    # "alive but wedged", which is correct for a just-died process
+    # whose files we read half a second later).  Wait out the
+    # staleness threshold so the verdict settles to "dead".
+    import time as _time
+
+    _time.sleep(postmortem.STALE_S + 1.0)
+    report = postmortem.analyze_dir(out_dir)
+    print(postmortem.render(report))
+    checks = []
+
+    def check(cond, what):
+        checks.append((bool(cond), what))
+        if not cond:
+            print(f"FAIL: {what}")
+
+    check(report["first_failing_rank"] == VICTIM,
+          f"first_failing_rank={report['first_failing_rank']}, "
+          f"want {VICTIM}")
+    check(report["verdicts"].get(str(VICTIM)) == "dead",
+          f"victim verdict {report['verdicts'].get(str(VICTIM))!r}, "
+          "want 'dead'")
+    vic = report["ranks"][str(VICTIM)]
+    open_ops = [o["op"] for o in vic["inflight"]["ops"]]
+    check("allreduce" in open_ops,
+          f"victim in-flight ops {open_ops}, want an open allreduce")
+    check(vic["affected_links"],
+          "victim's affected links are empty")
+    check(report["peer_views"],
+          "no surviving peer recorded a view of the break")
+    saw_break = any(
+        any(row["kind"] in ("link_break", "link_dead") for row in rows)
+        for rows in report["peer_views"].values()
+    )
+    check(saw_break, "no peer recorded link_break/link_dead for the "
+                     "victim")
+    # survivors must classify as cooperative exits, not deaths
+    for r in range(n):
+        if r == VICTIM:
+            continue
+        check(report["verdicts"].get(str(r)) == "drained",
+              f"survivor {r} verdict "
+              f"{report['verdicts'].get(str(r))!r}, want 'drained'")
+    # the CLI path (what launch.py and operators run)
+    rc = postmortem.main([str(out_dir), "--json"])
+    check(rc == 0, f"t4j-postmortem CLI rc={rc}")
+    return ok and all(c for c, _ in checks)
+
+
+def main():
+    argv = list(sys.argv[1:])
+    phases = ["kill", "clean", "off"]
+    if "--phase" in argv:
+        i = argv.index("--phase")
+        phases = [argv[i + 1]]
+        del argv[i:i + 2]  # the value must not be parsed as nprocs
+    args = [a for a in argv if not a.startswith("--")]
+    n = int(args[0]) if args else 8
+    build = _load_build_module()
+    so = str(build.ensure_built())
+    ok = True
+    for phase in phases:
+        with tempfile.TemporaryDirectory(prefix="t4j_postmortem_") as d:
+            ok = run_phase(phase, n, so, pathlib.Path(d)) and ok
+    print("POSTMORTEM-SMOKE-OK" if ok else "POSTMORTEM-SMOKE-FAIL")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker(sys.argv[2])
+    else:
+        main()
