@@ -495,6 +495,80 @@ def proc_hier_busbw(timeout=900):
     return hier, flat, ratio
 
 
+def proc_striped_busbw(timeout=1200):
+    """Striped wire path (docs/performance.md "striped links and the
+    zero-copy path"): one 8-rank TCP-tier job launched at
+    T4J_STRIPES=4 under the per-connection emulated flow throttle
+    (T4J_EMU_FLOW_BPS=40M — the per-flow bottleneck a NIC-bound fabric
+    imposes, which one loopback memory bus cannot), running
+    ``proc_busbw.py --stripes 1,4`` interleaved arms on 64 MB; then a
+    second unthrottled job with MSG_ZEROCOPY armed for the
+    zerocopy-vs-copy pair.  Returns ``(striped_record, single_record,
+    stripe_ratio_record, zerocopy_ratio_record)``; any may be None."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
+    import os as _os
+
+    striped = single = sratio = zratio = None
+    base_env = dict(_os.environ)
+    base_env["T4J_NO_SHM"] = "1"
+    base_env["T4J_TUNING_CACHE"] = "off"
+    try:
+        env = dict(base_env)
+        env["T4J_STRIPES"] = "4"
+        env["T4J_EMU_FLOW_BPS"] = "40M"
+        out = subprocess.run(
+            [sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+             str(script), "--stripes", "1,4", "--mb", "64",
+             "--reps", "2"],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent), env=env,
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            metric = rec.get("metric", "")
+            if metric == "allreduce_busbw_proc8":
+                if rec.get("stripes") == 4:
+                    striped = rec
+                elif rec.get("stripes") == 1:
+                    single = rec
+            elif metric == "allreduce_striped_vs_single_proc8":
+                sratio = rec
+        if sratio is None:
+            print(
+                f"[bench] striped busbw produced no ratio record "
+                f"(rc={out.returncode}): {out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] striped busbw failed: {exc}", file=sys.stderr)
+    try:
+        env = dict(base_env)
+        env["T4J_STRIPES"] = "2"
+        env["T4J_ZEROCOPY_MIN_BYTES"] = "256K"
+        out = subprocess.run(
+            [sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+             str(script), "--stripes", "2", "--mb", "64", "--reps", "2"],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent), env=env,
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") == "allreduce_zerocopy_vs_copy_proc8":
+                zratio = rec
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] zerocopy pair failed: {exc}", file=sys.stderr)
+    return striped, single, sratio, zratio
+
+
 def proc_autotune_pair(timeout=900):
     """Mis-default recovery (docs/performance.md "trace-guided
     autotuning"): one 8-rank TCP-tier job running
@@ -947,12 +1021,14 @@ def run_bench(quick=False):
         _skip("proc_overlap_step", "quick mode")
         _skip("proc_autotune_pair", "quick mode")
         _skip("proc_halo_latency", "quick mode")
+        _skip("proc_striped_busbw", "quick mode")
     elif not native_ok:
         _skip("proc_tcp_busbw", native_reason)
         _skip("proc_hier_busbw", native_reason)
         _skip("proc_overlap_step", native_reason)
         _skip("proc_autotune_pair", native_reason)
         _skip("proc_halo_latency", native_reason)
+        _skip("proc_striped_busbw", native_reason)
     ring_rec, tree_rec = proc_tcp_busbw() if run_heavy_proc else (None, None)
     if run_heavy_proc and ring_rec is None and tree_rec is None:
         _skip("proc_tcp_busbw", "no record produced")
@@ -1021,6 +1097,31 @@ def run_bench(quick=False):
         extras["halo_p50_ms_proc8_w1_coalesce_off"] = halo_off["value"]
     if halo_ratio is not None:
         extras["halo_coalesce_speedup_proc8"] = halo_ratio["value"]
+    # striped multi-connection links (this PR's tentpole): 4-stripe vs
+    # single-flow 64 MB allreduce under the emulated per-flow throttle
+    # (the multi-flow busbw step real NIC fabrics get), plus the
+    # zerocopy-vs-copy pair — recorded honestly: loopback's kernel
+    # copies zerocopy sends anyway (zc_copied == zc_completions), so
+    # the ratio is < 1 here and wins only on real NIC paths
+    # (docs/performance.md "striped links and the zero-copy path")
+    st_rec, st_single, st_ratio, zc_ratio = (
+        proc_striped_busbw() if run_heavy_proc
+        else (None, None, None, None)
+    )
+    if run_heavy_proc and st_rec is None and st_ratio is None:
+        _skip("proc_striped_busbw", "no record produced")
+    if st_rec is not None:
+        extras["allreduce_busbw_proc8_striped_gbps"] = st_rec["value"]
+    if st_single is not None:
+        extras["allreduce_busbw_proc8_striped_single_gbps"] = (
+            st_single["value"]
+        )
+    if st_ratio is not None:
+        extras["striped_vs_single_ratio"] = st_ratio["value"]
+    if zc_ratio is not None:
+        extras["zerocopy_vs_copy_ratio"] = zc_ratio["value"]
+    elif run_heavy_proc:
+        _skip("proc_zerocopy_pair", "no record produced")
 
     if quick:
         for leg in ("transformer", "matmul_roofline",

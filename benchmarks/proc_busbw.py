@@ -95,6 +95,18 @@ def main():
         "plane, which T4J_SEG_BYTES governs, actually serves)",
     )
     ap.add_argument(
+        "--stripes", default=None, metavar="LIST",
+        help="striped-wire arms (docs/performance.md \"striped links "
+        "and the zero-copy path\"): comma list of dealing widths "
+        "(e.g. 1,2,4) A/B'd INTERLEAVED inside one world — launch "
+        "with T4J_STRIPES set to the largest width so the connections "
+        "exist, and T4J_EMU_FLOW_BPS to emulate the per-flow "
+        "bottleneck real NICs impose; one record per width plus "
+        "striped-vs-single ratios.  With T4J_ZEROCOPY_MIN_BYTES also "
+        "set, a zerocopy-off arm rides along and a "
+        "zerocopy_vs_copy ratio is emitted",
+    )
+    ap.add_argument(
         "--widths", default="1,4,16",
         help="halo widths for --op halo (comma list)",
     )
@@ -146,6 +158,9 @@ def main():
 
     if args.op == "halo":
         return _halo_main(args, comm)
+
+    if args.stripes:
+        return _stripes_main(args, comm)
 
     if args.pairs:
         return _pairs_main(args, comm)
@@ -439,6 +454,133 @@ def _pairs_main(args, comm):
         "local_world": topo["local_size"],
         "leader_world": topo["n_hosts"],
     }), flush=True)
+
+
+def _stripes_main(args, comm):
+    """Interleaved striped-wire arms (docs/performance.md "striped
+    links and the zero-copy path").
+
+    One world, built at the LAUNCHED ``T4J_STRIPES`` width; each timed
+    batch rotates through the requested dealing widths back to back
+    (``runtime.set_wire(stripes=w)`` is a runtime knob up to the built
+    width), so phase noise hits every arm equally — the same
+    interleaving convention as the hier/flat and coalescing pairs.
+    Run under ``T4J_EMU_FLOW_BPS`` to emulate the per-flow bottleneck
+    real NIC-bound fabrics impose (one memory bus cannot otherwise
+    show the multi-NIC-queue win — docs/performance.md states the
+    loopback caveat).  With ``T4J_ZEROCOPY_MIN_BYTES`` set, a
+    zerocopy-off arm at the widest width rides along.  Rank 0 prints
+    one record per arm plus ``striped_vs_single`` (and
+    ``zerocopy_vs_copy``) ratio records."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.ops._proc import proc_topology
+    from mpi4jax_tpu.utils import config
+
+    n = comm.size
+    widths = sorted({int(w) for w in str(args.stripes).split(",") if w})
+    info = runtime.wire_info() or {}
+    built = int(info.get("stripes_built", 1) or 1)
+    usable = [w for w in widths if 1 <= w <= built]
+    dropped = [w for w in widths if w not in usable]
+    if comm.rank() == 0 and dropped:
+        print(json.dumps({
+            "metric": f"stripes_arms_dropped_proc{n}",
+            "value": dropped,
+            "reason": f"built width is {built} (launch with "
+                      f"T4J_STRIPES={max(widths)} to build the "
+                      "connections)",
+        }), flush=True)
+    if not usable:
+        usable = [built]
+    per = max(int(args.mb * 1e6 / 4), n)
+    per -= per % max(n, 1)
+    x = jnp.ones((per,), jnp.float32)
+    nbytes = per * 4
+    factor = _busbw_factor("allreduce", n)
+    zc_req = int(info.get("zerocopy_min_bytes", 0) or 0)
+    zc_armed = bool(info.get("zerocopy")) and zc_req > 0
+    arms = [("stripes", w, None) for w in usable]
+    if zc_armed:
+        # zerocopy-off comparison arm at the widest width: same wire,
+        # copy path forced (T4J_ZEROCOPY_MIN_BYTES=0 at runtime)
+        arms.append(("zerocopy_off", max(usable), 0))
+
+    tok = m.create_token()
+    best = {}
+    for name, w, zc in arms:  # warm every arm (compile + dealing)
+        runtime.set_wire(stripes=w,
+                         zerocopy_min_bytes=zc if zc is not None
+                         else zc_req)
+        y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        np.asarray(y)
+    for _ in range(3):
+        for name, w, zc in arms:
+            runtime.set_wire(stripes=w,
+                             zerocopy_min_bytes=zc if zc is not None
+                             else zc_req)
+            tok = _fence(comm, tok)
+            t0 = time.perf_counter()
+            for _ in range(args.reps):
+                y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+            np.asarray(y)
+            key = (name, w)
+            dt = (time.perf_counter() - t0) / args.reps
+            best[key] = min(best.get(key, float("inf")), dt)
+    runtime.set_wire(stripes=built, zerocopy_min_bytes=zc_req)
+    if comm.rank() != 0:
+        return
+    topo = proc_topology(comm)
+    vals = {}
+    for name, w, zc in arms:
+        busbw = nbytes * factor / best[(name, w)]
+        vals[(name, w)] = busbw
+        print(json.dumps({
+            "metric": f"allreduce_busbw_proc{n}",
+            "value": round(busbw / 1e9, 3),
+            "unit": "GB/s",
+            "nprocs": n,
+            "payload_mb": nbytes / 1e6,
+            "payload_bytes": nbytes,
+            "sec_per_call": round(best[(name, w)], 6),
+            "data_plane": "ring" if nbytes >= config.ring_min_bytes()
+            else "tree",
+            "stripes": w,
+            "stripes_built": built,
+            "zerocopy": bool(zc_armed and zc is None),
+            "emu_flow_bps": int(info.get("emu_flow_bps", 0) or 0),
+            "local_world": topo["local_size"],
+            "leader_world": topo["n_hosts"],
+            "seg_bytes": config.seg_bytes(),
+            "interleaved_pairs": True,
+        }), flush=True)
+    widest = max(usable)
+    if 1 in usable and widest > 1:
+        print(json.dumps({
+            "metric": f"allreduce_striped_vs_single_proc{n}",
+            "value": round(
+                vals[("stripes", widest)] / vals[("stripes", 1)], 2),
+            "unit": "x",
+            "nprocs": n,
+            "payload_mb": nbytes / 1e6,
+            "stripes": widest,
+            "emu_flow_bps": int(info.get("emu_flow_bps", 0) or 0),
+        }), flush=True)
+    if zc_armed:
+        print(json.dumps({
+            "metric": f"allreduce_zerocopy_vs_copy_proc{n}",
+            "value": round(
+                vals[("stripes", widest)]
+                / vals[("zerocopy_off", widest)], 2),
+            "unit": "x",
+            "nprocs": n,
+            "payload_mb": nbytes / 1e6,
+            "stripes": widest,
+            "zerocopy_min_bytes": zc_req,
+        }), flush=True)
 
 
 def _inflight_main(args, comm):

@@ -26,6 +26,8 @@ __all__ = [
     "last_error",
     "set_timeouts",
     "set_tuning",
+    "set_wire",
+    "wire_info",
     "set_coalesce",
     "coalesce_bytes",
     "set_hier",
@@ -185,6 +187,25 @@ def _load():
         ctypes.POINTER(ctypes.c_int32),
     ]
     lib.t4j_link_stats.restype = ctypes.c_int32
+    lib.t4j_link_stripe_stats.argtypes = [
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.t4j_link_stripe_stats.restype = ctypes.c_int32
+    lib.t4j_set_wire.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+    ]
+    lib.t4j_wire_info.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.t4j_wire_info.restype = ctypes.c_int32
     lib.t4j_topo.argtypes = [ctypes.POINTER(ctypes.c_int32)] * 5
     lib.t4j_topo.restype = ctypes.c_int32
     lib.t4j_hier_would_select.argtypes = [ctypes.c_int32, ctypes.c_uint64]
@@ -365,6 +386,87 @@ def _link_stats_one(lib, peer):
     }
 
 
+def _stripe_stats_one(lib, peer, stripe):
+    rec = ctypes.c_uint64(0)
+    frames = ctypes.c_uint64(0)
+    nbytes = ctypes.c_uint64(0)
+    state = ctypes.c_int32(0)
+    ok = lib.t4j_link_stripe_stats(
+        int(peer), int(stripe),
+        ctypes.byref(rec), ctypes.byref(frames), ctypes.byref(nbytes),
+        ctypes.byref(state),
+    )
+    if not ok:
+        return None
+    return {
+        "reconnects": rec.value,
+        "replayed_frames": frames.value,
+        "replayed_bytes": nbytes.value,
+        "state": state.value,
+    }
+
+
+def set_wire(stripes=None, zerocopy_min_bytes=None, sendmsg_batch=None,
+             emu_flow_bps=None):
+    """Runtime override of the wire-path knobs (docs/performance.md
+    "striped links and the zero-copy path").
+
+    ``stripes`` sets the DEALING width (clamped to the built width
+    after init); before init it also fixes the number of connections
+    bootstrap builds per link.  ``None`` keeps each current value;
+    ``zerocopy_min_bytes=0`` disables MSG_ZEROCOPY;
+    ``emu_flow_bps=0`` disables the per-connection test throttle.
+    Must be uniform across ranks (the launcher propagates
+    ``T4J_STRIPES`` / ``T4J_ZEROCOPY_MIN_BYTES`` /
+    ``T4J_SENDMSG_BATCH`` / ``T4J_EMU_FLOW_BPS``): both ends of a
+    link must agree on the stripe count, and the receivers reorder by
+    the same dealing discipline the senders use."""
+    lib = _load()
+    lib.t4j_set_wire(
+        0 if stripes is None else int(stripes),
+        -1 if zerocopy_min_bytes is None else int(zerocopy_min_bytes),
+        0 if sendmsg_batch is None else int(sendmsg_batch),
+        -1 if emu_flow_bps is None else int(emu_flow_bps),
+    )
+
+
+def wire_info():
+    """Effective wire-path state: ``{"stripes_built",
+    "stripes_active", "zerocopy_min_bytes", "sendmsg_batch",
+    "emu_flow_bps", "zerocopy"}`` — ``zerocopy`` is True only when
+    requested AND the kernel honours SO_ZEROCOPY.  ``None`` when the
+    native library was never loaded."""
+    lib = _state["lib"]
+    if lib is None:
+        return None
+    sb = ctypes.c_int32(0)
+    sa = ctypes.c_int32(0)
+    zmin = ctypes.c_int64(0)
+    batch = ctypes.c_int32(0)
+    flow = ctypes.c_int64(0)
+    zc = ctypes.c_int32(0)
+    zc_done = ctypes.c_uint64(0)
+    zc_copied = ctypes.c_uint64(0)
+    lib.t4j_wire_info(
+        ctypes.byref(sb), ctypes.byref(sa), ctypes.byref(zmin),
+        ctypes.byref(batch), ctypes.byref(flow), ctypes.byref(zc),
+        ctypes.byref(zc_done), ctypes.byref(zc_copied),
+    )
+    return {
+        "stripes_built": int(sb.value),
+        "stripes_active": int(sa.value),
+        "zerocopy_min_bytes": int(zmin.value),
+        "sendmsg_batch": int(batch.value),
+        "emu_flow_bps": int(flow.value),
+        "zerocopy": bool(zc.value),
+        # completion diagnostics: copied ~= completions means the
+        # fabric (loopback always) fell back to copying — pin overhead
+        # with no copy saved (docs/performance.md)
+        "zc_completions": int(zc_done.value),
+        "zc_copied": int(zc_copied.value),
+    }
+
+
 def link_stats(peer=None):
     """Self-healing transport counters (docs/failure-semantics.md
     "self-healing transport"), or ``None`` before init.
@@ -385,7 +487,23 @@ def link_stats(peer=None):
     if lib is None or not lib.t4j_initialized():
         return None
     if peer is not None:
-        return _link_stats_one(lib, peer)
+        s = _link_stats_one(lib, peer)
+        if s is None:
+            return None
+        # per-stripe breakdown (docs/performance.md "striped links"):
+        # one dict per stripe, so t4j-top and the proc tests can see
+        # WHICH flow repaired/replayed instead of just the link sum
+        stripes = []
+        si = 0
+        while True:
+            ss = _stripe_stats_one(lib, peer, si)
+            if ss is None:
+                break
+            stripes.append(ss)
+            si += 1
+        if stripes:
+            s["stripes"] = stripes
+        return s
     agg = _link_stats_one(lib, -1)
     if agg is None:
         return None
@@ -1325,6 +1443,24 @@ def ensure_initialized():
     op_s, connect_s = config.op_timeout(), config.connect_timeout()
     ring_min, seg = config.ring_min_bytes(), config.seg_bytes()
     coalesce = config.coalesce_bytes()
+    # wire-path knobs (docs/performance.md "striped links and the
+    # zero-copy path"): validated loudly here, threaded before init —
+    # the stripe count decides how many connections bootstrap builds.
+    # "auto" stays native-default (one flow) until the tuning layer
+    # resolves a calibrated width post-init.
+    wire_stripes = config.stripes()
+    zc_min = config.zerocopy_min_bytes()
+    batch = config.sendmsg_batch()
+    flow = config.emu_flow_bps()
+    if zc_min > 0 and zc_min < 4096:
+        raise ValueError(
+            f"T4J_ZEROCOPY_MIN_BYTES={zc_min} is below the page floor "
+            "(4096): MSG_ZEROCOPY pins whole pages per send, and "
+            "sub-page frames pay the pin/completion round-trip for "
+            "no copy saved — use 0 (off) or >= 4096 "
+            "(docs/performance.md \"striped links and the zero-copy "
+            "path\")"
+        )
     config.autotune_enabled()  # loud validation; the flag acts post-init
     hier, hier_min = config.hier_mode(), config.leader_ring_min_bytes()
     retry = config.retry_max()
@@ -1350,6 +1486,10 @@ def ensure_initialized():
     lib.t4j_set_timeouts(op_s, connect_s)
     lib.t4j_set_tuning(ring_min, seg)
     lib.t4j_set_coalesce(coalesce)
+    lib.t4j_set_wire(
+        0 if wire_stripes == "auto" else int(wire_stripes),
+        zc_min, batch, flow,
+    )
     lib.t4j_set_hier(_HIER_MODES[hier], hier_min)
     lib.t4j_set_resilience(retry, boff_base, boff_max, replay)
     lib.t4j_set_elastic(_ELASTIC_MODES[elastic], world_floor, resize_s)

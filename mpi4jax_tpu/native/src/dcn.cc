@@ -13,6 +13,10 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#ifdef __linux__
+#include <linux/errqueue.h>  // MSG_ZEROCOPY completion records
+#endif
+
 #include <cerrno>
 
 #ifdef __SSE2__
@@ -30,6 +34,7 @@
 #include <cstring>
 #include <deque>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <random>
@@ -192,6 +197,81 @@ long long coalesce_bytes() {
   if (v < 0) {
     v = env_bytes("T4J_COALESCE_BYTES", kDefaultCoalesceBytes);
     g_coalesce_bytes.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+// ----------------------------------------------- wire-path tuning
+//
+// Striped multi-connection links + syscall batching + MSG_ZEROCOPY
+// (docs/performance.md "striped links and the zero-copy path").  The
+// BUILT stripe count is fixed at bootstrap (connections are dialed
+// then); the DEALING width can be changed at runtime up to the built
+// width (the trace-guided calibrator A/Bs widths inside one world).
+// -1 = "not set yet"; Python validates via utils/config.py and calls
+// set_wire, the env parse is the fallback for hand-run processes.
+
+constexpr int kMaxStripes = 16;
+constexpr int kDefaultSendmsgBatch = 8;
+
+long long env_int(const char* name, long long dflt);  // defined below
+
+std::atomic<int> g_wire_stripes{-1};       // requested dealing width
+std::atomic<long long> g_zc_min_bytes{-1};  // 0 = zerocopy off
+std::atomic<int> g_sendmsg_batch{-1};
+std::atomic<long long> g_emu_flow_bps{-1};  // 0 = no throttle
+// Fixed at init (single-threaded): connections bootstrap built per
+// link, and whether the kernel honoured SO_ZEROCOPY when requested.
+int g_built_stripes = 1;
+bool g_zc_supported = false;
+
+int requested_stripes() {
+  int v = g_wire_stripes.load(std::memory_order_relaxed);
+  if (v < 1) {
+    const char* s = std::getenv("T4J_STRIPES");
+    v = 1;  // auto resolves to 1 until the calibrator learns better
+    if (s && s[0] && std::strcmp(s, "auto") != 0) {
+      long p = std::atol(s);
+      if (p >= 1) v = static_cast<int>(p);
+    }
+    if (v > kMaxStripes) v = kMaxStripes;
+    g_wire_stripes.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+// Current dealing width: never wider than what bootstrap built.
+int active_stripes() {
+  int v = requested_stripes();
+  if (g_initialized && v > g_built_stripes) v = g_built_stripes;
+  return v < 1 ? 1 : v;
+}
+
+long long zc_min_bytes() {
+  long long v = g_zc_min_bytes.load(std::memory_order_relaxed);
+  if (v < 0) {
+    v = env_bytes("T4J_ZEROCOPY_MIN_BYTES", 0);
+    g_zc_min_bytes.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+int sendmsg_batch() {
+  int v = g_sendmsg_batch.load(std::memory_order_relaxed);
+  if (v < 1) {
+    v = static_cast<int>(
+        env_int("T4J_SENDMSG_BATCH", kDefaultSendmsgBatch));
+    if (v < 1) v = 1;
+    g_sendmsg_batch.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+long long emu_flow_bps() {
+  long long v = g_emu_flow_bps.load(std::memory_order_relaxed);
+  if (v < 0) {
+    v = env_bytes("T4J_EMU_FLOW_BPS", 0);
+    g_emu_flow_bps.store(v, std::memory_order_relaxed);
   }
   return v;
 }
@@ -714,9 +794,10 @@ struct ReconHello {
   uint32_t magic;
   uint32_t rank;        // dialer's world rank
   uint64_t boot_token;  // dialer's bootstrap incarnation token
-  uint32_t epoch;       // dialer's view of the link epoch
-  uint32_t pad;
-  uint64_t last_recv_seq;  // last contiguous seq the dialer received
+  uint32_t epoch;       // dialer's view of the STRIPE epoch
+  uint32_t pad;         // stripe index being re-dialed
+  uint64_t last_recv_seq;  // link-level received watermark
+                           // (link_recv_watermark)
 };
 static_assert(sizeof(ReconHello) == 32, "recon hello layout");
 
@@ -764,17 +845,22 @@ void enter_resize(uint64_t dead_delta, const std::string& why);
 void handle_resize_msg(int fd, const ResizeMsg& m);
 
 // A sent frame retained for replay-after-reconnect: the payload lives
-// at `off` inside the link's circular replay arena (never split across
-// the wrap point).
+// at `off` inside the stripe's circular replay arena (never split
+// across the wrap point).  zc_id: nonzero when the frame was sent
+// with MSG_ZEROCOPY — the kernel may still be reading the arena bytes
+// until completion id zc_id-1 is reaped, so eviction/overwrite must
+// wait for it (docs/sharp-bits.md "MSG_ZEROCOPY pins pages").
 struct Replay {
   WireHeader h;
   size_t off;
+  uint32_t zc_id = 0;  // kernel zerocopy completion id + 1; 0 = none
 };
 
-// Per-peer TCP link with self-healing state (docs/failure-semantics.md
-// "self-healing transport").  Lock order: send_mu before mu; never the
-// reverse.
-struct PeerLink {
+// One TCP connection of a (possibly striped) peer link, with its own
+// self-healing state (docs/failure-semantics.md "self-healing
+// transport", docs/performance.md "striped links").  Lock order:
+// send_mu before mu; never the reverse.
+struct Stripe {
   int fd = -1;
   std::mutex send_mu;  // serialises writers on fd (and fd swaps)
 
@@ -786,10 +872,10 @@ struct PeerLink {
   uint32_t epoch = 0;     // bumped on every successful reconnect
   bool repairing = false; // a dial/watchdog thread owns the break
 
-  // Current reader thread for this link's fd (TCP links only).
-  // join_mu serialises join/assign of `reader` between a repair
-  // handler and finalize; accept_busy serialises concurrent reconnect
-  // dials for the same link (handlers run on their own threads).
+  // Current reader thread for this stripe's fd.  join_mu serialises
+  // join/assign of `reader` between a repair handler and finalize;
+  // accept_busy serialises concurrent reconnect dials for the same
+  // stripe (handlers run on their own threads).
   std::thread reader;
   std::mutex join_mu;
   std::atomic<bool> accept_busy{false};
@@ -798,19 +884,35 @@ struct PeerLink {
   // The replay ring is a single preallocated circular byte arena plus
   // an entry deque — per-frame heap Bufs would pay an mmap + kernel
   // zero-fill + munmap cycle per large frame, which measured ~30%
-  // busbw on the loopback box.
-  uint64_t send_seq = 0;   // last assigned outbound seq
-  std::deque<Replay> ring; // frames (ring_min_seq-1, send_seq], newest last
+  // busbw on the loopback box.  Seqs are the LINK's namespace (this
+  // stripe holds a round-robin subset); after a stripe migration the
+  // deque is no longer seq-sorted, so eviction-loss detection tracks
+  // the max seq ever evicted instead of a contiguous floor.
+  std::deque<Replay> ring;
   std::unique_ptr<uint8_t[]> ring_buf;
   size_t ring_cap = 0;
-  size_t ring_head = 0;       // next write offset into ring_buf
-  uint64_t ring_min_seq = 1;  // lowest seq the ring still holds
+  size_t ring_head = 0;          // next write offset into ring_buf
+  uint64_t max_evicted_seq = 0;  // highest seq evicted from the ring
 
-  // --- recv side: written only by the link's single reader thread;
-  // repair reads it after joining the reader --------------------------
-  std::atomic<uint64_t> recv_seq{0};  // last contiguous seq delivered
+  // Set (under send_mu) when escalate_stripe migrated this dead
+  // stripe's ring onto a sibling: anything appended AFTER that has no
+  // redelivery path here — senders must redeal instead of buffering.
+  bool migrated = false;
 
-  // --- stats (t4j_link_stats) -----------------------------------------
+  // --- MSG_ZEROCOPY accounting, guarded by send_mu ---------------------
+  bool zc_enabled = false;  // SO_ZEROCOPY accepted on this fd
+  uint32_t zc_sent = 0;     // completion ids issued (next id == zc_sent)
+  uint32_t zc_done = 0;     // ids [0, zc_done) reaped from the errqueue
+
+  // --- emulated per-flow throttle, guarded by send_mu ------------------
+  double tb_tokens = 0;
+  Clock::time_point tb_last{};
+
+  // --- recv side: highest link seq seen on this stripe (diagnostics;
+  // delivery order lives on the link's reorder stage) ------------------
+  std::atomic<uint64_t> seen_seq{0};
+
+  // --- stats (t4j_link_stats / t4j_link_stripe_stats) ------------------
   std::atomic<uint64_t> reconnects{0};
   std::atomic<uint64_t> replayed_frames{0};
   std::atomic<uint64_t> replayed_bytes{0};
@@ -818,8 +920,45 @@ struct PeerLink {
   // A process exiting WITHOUT finalize (a fault raised through user
   // code that never reaches the atexit hook) must not std::terminate
   // in the joinable-thread destructor and mask the real exit code.
-  ~PeerLink() {
+  ~Stripe() {
     if (reader.joinable()) reader.detach();
+  }
+};
+
+// Per-peer link: N stripes plus the link-level dealing and delivery
+// state that keeps striping invisible to MPI matching.  Frames get a
+// link-global sequence number under deal_mu and are dealt round-robin
+// over the non-dead stripes; the receive side restores per-link order
+// under ro_mu (frames from a fast stripe park in `reorder` until the
+// gap fills).  Lock order: deal_mu / ro_mu are leaf locks relative to
+// stripe locks EXCEPT ro_mu -> g_mail_mu (delivery).
+struct PeerLink {
+  std::unique_ptr<Stripe[]> s;  // built stripes (TCP peers; empty for self)
+  int nstripes = 0;
+  std::mutex pipe_mu;  // one producer per same-host shm pipe
+
+  // --- send dealing, guarded by deal_mu --------------------------------
+  std::mutex deal_mu;
+  uint64_t send_seq = 0;  // last assigned outbound link seq
+  uint64_t dealt = 0;     // round-robin cursor over live stripes
+  // relaxed mirror of the stripes' kDead verdicts so dealing can skip
+  // dead stripes without taking their mutexes
+  std::atomic<uint32_t> dead_mask{0};
+
+  // --- delivery order, guarded by ro_mu --------------------------------
+  std::mutex ro_mu;
+  uint64_t delivered = 0;            // last contiguous seq delivered
+  std::map<uint64_t, Frame> reorder; // early frames from fast stripes
+
+  void alloc_stripes(int n) {
+    nstripes = n < 1 ? 1 : n;
+    s.reset(new Stripe[nstripes]);
+    dead_mask.store(0, std::memory_order_relaxed);
+  }
+  bool link_dead() const {
+    uint32_t m = dead_mask.load(std::memory_order_relaxed);
+    return nstripes > 0 &&
+           m == ((nstripes >= 32 ? ~0u : ((1u << nstripes) - 1)));
   }
 };
 
@@ -943,6 +1082,10 @@ void wake_all_pipes() {
 //   T4J_FAULT_DELAY_MS  delay mode's per-frame stall / die_after's
 //                       countdown (default 1000)
 //   T4J_FAULT_COUNT     flaky's total number of drops (default 2)
+//   T4J_FAULT_STRIPE    flaky/drop_conn: drop only this stripe index
+//                       of every link (default -1 = every stripe) —
+//                       the per-stripe self-heal matrix's handle
+//                       (docs/performance.md "striped links")
 
 struct FaultPlan {
   enum Mode { kNone, kRefuse, kCloseAfter, kDelay, kDieAfter, kFlaky };
@@ -951,6 +1094,7 @@ struct FaultPlan {
   long after = 0;
   long delay_ms = 1000;
   long count = 2;
+  int stripe = -1;  // flaky: -1 = all stripes, else just this one
 };
 
 FaultPlan g_fault_plan;
@@ -987,6 +1131,8 @@ void parse_fault_plan() {
   if (c && p.mode == FaultPlan::kFlaky &&
       std::strcmp(mode, "drop_conn") != 0)
     p.count = std::atol(c);
+  const char* sidx = std::getenv("T4J_FAULT_STRIPE");
+  if (sidx && sidx[0]) p.stripe = std::atoi(sidx);
   g_fault_plan = p;
 }
 
@@ -1009,38 +1155,50 @@ void maybe_inject_send_fault() {
                  "dying after %ld frames\n",
                  g_rank, n - 1);
     std::fflush(stderr);
-    for (auto& p : g_peers) {
-      if (p.fd >= 0) {
-        ::shutdown(p.fd, SHUT_RDWR);
-        ::close(p.fd);
+    for (auto& p : g_peers)
+      for (int si = 0; si < p.nstripes; ++si) {
+        Stripe& st = p.s[si];
+        if (st.fd >= 0) {
+          ::shutdown(st.fd, SHUT_RDWR);
+          ::close(st.fd);
+        }
       }
-    }
     _exit(42);
   }
   if (g_fault_plan.mode == FaultPlan::kFlaky) {
-    // drop (shutdown, not close: the fds stay owned by the links and
-    // the repair machinery swaps them) every TCP connection once per
-    // additional T4J_FAULT_AFTER frames, T4J_FAULT_COUNT times total —
-    // the process stays alive and the job must self-heal
+    // drop (shutdown, not close: the fds stay owned by the stripes and
+    // the repair machinery swaps them) every TCP connection — or just
+    // stripe T4J_FAULT_STRIPE of every link — once per additional
+    // T4J_FAULT_AFTER frames, T4J_FAULT_COUNT times total: the process
+    // stays alive and the job must self-heal (per stripe)
     long done = g_drops_done.load(std::memory_order_relaxed);
     long after = g_fault_plan.after > 0 ? g_fault_plan.after : 1;
     if (done < g_fault_plan.count && n > after * (done + 1) &&
         g_drops_done.compare_exchange_strong(done, done + 1,
                                              std::memory_order_relaxed)) {
       std::fprintf(stderr,
-                   "r%d | t4j fault-injection: dropping every TCP "
-                   "connection after %ld frames (drop %ld/%ld)\n",
-                   g_rank, n - 1, done + 1, g_fault_plan.count);
+                   "r%d | t4j fault-injection: dropping %s after %ld "
+                   "frames (drop %ld/%ld)\n",
+                   g_rank,
+                   g_fault_plan.stripe < 0
+                       ? "every TCP connection"
+                       : "one stripe of every TCP link",
+                   n - 1, done + 1, g_fault_plan.count);
       std::fflush(stderr);
-      for (auto& p : g_peers) {
-        // fd is only stable under send_mu (finish_repair swaps/closes
-        // it there); try_lock so a link busy in a long write or a
-        // repair is skipped rather than raced.  Callers never hold any
-        // send_mu here (multi_send runs its injection checks before
-        // acquiring locks), so this is never a self-try_lock.
-        std::unique_lock<std::mutex> lk(p.send_mu, std::try_to_lock);
-        if (lk.owns_lock() && p.fd >= 0) ::shutdown(p.fd, SHUT_RDWR);
-      }
+      for (auto& p : g_peers)
+        for (int si = 0; si < p.nstripes; ++si) {
+          if (g_fault_plan.stripe >= 0 && si != g_fault_plan.stripe)
+            continue;
+          Stripe& st = p.s[si];
+          // fd is only stable under send_mu (finish_repair swaps/
+          // closes it there); try_lock so a stripe busy in a long
+          // write or a repair is skipped rather than raced.  Callers
+          // never hold any send_mu here (the injection checks run
+          // before locks are acquired), so this is never a
+          // self-try_lock.
+          std::unique_lock<std::mutex> lk(st.send_mu, std::try_to_lock);
+          if (lk.owns_lock() && st.fd >= 0) ::shutdown(st.fd, SHUT_RDWR);
+        }
     }
     return;
   }
@@ -1111,13 +1269,15 @@ IoStatus nb_read_all(int fd, void* buf, size_t n, const Deadline& dl,
 
 // Gathered write via sendmsg(MSG_NOSIGNAL): a dead peer surfaces as
 // EPIPE (-> contextual error) instead of a process-killing SIGPIPE.
+// extra_flags: MSG_MORE for a header whose payload follows in the
+// next call (keeps TCP_NODELAY from emitting a 40-byte segment).
 IoStatus nb_write_all(int fd, iovec* iov, int iovcnt, const Deadline& dl,
-                      bool ignore_stop = false) {
+                      bool ignore_stop = false, int extra_flags = 0) {
   msghdr mh{};
   while (iovcnt > 0) {
     mh.msg_iov = iov;
     mh.msg_iovlen = iovcnt;
-    ssize_t w = ::sendmsg(fd, &mh, MSG_NOSIGNAL);
+    ssize_t w = ::sendmsg(fd, &mh, MSG_NOSIGNAL | extra_flags);
     if (w < 0) {
       if (errno == EINTR) continue;
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
@@ -1158,24 +1318,30 @@ void broadcast_abort(const std::string& why) {
   for (int peer = 0; peer < static_cast<int>(g_peers.size()); ++peer) {
     if (peer == g_rank) continue;
     PeerLink& p = g_peers[peer];
-    if (p.fd < 0) continue;
-    // a sender wedged on this socket holds send_mu; skip — that peer
-    // will observe our EOF or its own deadline instead
-    std::unique_lock<std::mutex> lk(p.send_mu, std::try_to_lock);
-    if (!lk.owns_lock()) continue;
-    iovec iov[2] = {{&h, sizeof(h)},
-                    {const_cast<char*>(msg.data()), msg.size()}};
-    (void)nb_write_all(p.fd, iov, msg.empty() ? 1 : 2, dl);
+    // first stripe whose socket is free takes the goodbye; a sender
+    // wedged on a stripe holds its send_mu — skip it (that peer will
+    // observe our EOF or its own deadline instead)
+    for (int si = 0; si < p.nstripes; ++si) {
+      Stripe& st = p.s[si];
+      if (st.fd < 0) continue;
+      std::unique_lock<std::mutex> lk(st.send_mu, std::try_to_lock);
+      if (!lk.owns_lock()) continue;
+      iovec iov[2] = {{&h, sizeof(h)},
+                      {const_cast<char*>(msg.data()), msg.size()}};
+      (void)nb_write_all(st.fd, iov, msg.empty() ? 1 : 2, dl);
+      break;
+    }
   }
 }
 
-// Self-healing entry point: a link-level transport failure (EOF, write
-// error, reset) lands here.  With resilience enabled the link is
-// marked broken and a repair cycle starts (higher rank re-dials, lower
-// rank accepts); without it — or during teardown — the legacy PR-1
-// fail-stop path runs unchanged.  Defined with the rest of the repair
-// machinery after the bootstrap helpers (it dials).
-void mark_broken(int peer, const std::string& why);
+// Self-healing entry point: a stripe-level transport failure (EOF,
+// write error, reset) lands here.  With resilience enabled the stripe
+// is marked broken and a repair cycle starts (higher rank re-dials,
+// lower rank accepts) while sibling stripes keep moving; without it —
+// or during teardown — the legacy PR-1 fail-stop path runs unchanged.
+// Defined with the rest of the repair machinery after the bootstrap
+// helpers (it dials).
+void mark_stripe_broken(int peer, int stripe, const std::string& why);
 
 // The legacy reader-side failure: post the fault unless we are already
 // tearing down (finalize-order EOF from a peer that left first is the
@@ -1184,28 +1350,158 @@ void reader_post_fault(const std::string& msg) {
   if (!g_shutting_down.load() && !g_stop.load()) post_fault(msg);
 }
 
-void reader_loop(int peer, int fd) {
-  Deadline forever;  // idle between frames is legal — wait unbounded
+// Mailbox insertion + the frame_rx record (the event's comm field
+// carries the stripe index — schema v2, telemetry/schema.py
+// event_stripe).  Caller may hold ro_mu (ro_mu -> g_mail_mu is the
+// one sanctioned order; mailbox consumers never take ro_mu).
+void mailbox_push(Frame&& f, int peer, int stripe, tel::Plane plane) {
+  uint64_t nbytes = f.data.size();
+  {
+    std::lock_guard<std::mutex> lk(g_mail_mu);
+    g_mailbox.push_back(std::move(f));
+  }
+  g_mail_cv.notify_all();
+  tel::trace_event(tel::kFrameRx, tel::kInstant, plane, stripe, peer,
+                   nbytes);
+}
+
+// Deliver a received frame in LINK order (docs/performance.md
+// "striped links"): frames carry a link-global seq, stripes present
+// them out of order, and MPI matching needs per-(src, ctx, tag) FIFO
+// — so early frames park in the link's reorder map until the gap
+// fills, duplicates (reconnect replay, stripe migration) drop, and
+// the contiguous prefix goes to the mailbox under ro_mu so no two
+// readers can interleave their pushes out of order.  Returns false
+// only for a gap on an UNSTRIPED link — TCP is in-order and the
+// replay starts at the acked tail, so that is stream corruption, the
+// caller posts the fault.
+bool deliver_frame(int peer, int stripe, uint64_t seq, Frame&& f) {
+  if (seq == 0) {  // unsequenced legacy frame: straight through
+    mailbox_push(std::move(f), peer, stripe, tel::kPlaneNone);
+    return true;
+  }
+  PeerLink& p = g_peers[peer];
+  std::lock_guard<std::mutex> lk(p.ro_mu);
+  if (seq <= p.delivered || p.reorder.count(seq))
+    return true;  // replay/migration duplicate: already have it
+  if (seq != p.delivered + 1) {
+    if (p.nstripes <= 1) return false;  // single flow: gap = corruption
+    // a sibling stripe still owes the gap frame; park this one.  The
+    // buffer is bounded by the sender side: frames for the lagging
+    // stripe blind-buffer into its bounded replay ring and then block,
+    // so at most (nstripes-1) x T4J_REPLAY_BYTES can ever park here.
+    p.reorder.emplace(seq, std::move(f));
+    return true;
+  }
+  ++p.delivered;
+  mailbox_push(std::move(f), peer, stripe, tel::kPlaneNone);
+  for (auto it = p.reorder.find(p.delivered + 1);
+       it != p.reorder.end(); it = p.reorder.find(p.delivered + 1)) {
+    Frame g = std::move(it->second);
+    p.reorder.erase(it);
+    ++p.delivered;
+    mailbox_push(std::move(g), peer, stripe, tel::kPlaneNone);
+  }
+  return true;
+}
+
+// The reconnect handshake's ack: the largest W such that EVERY frame
+// with seq <= W was received — the contiguous delivery cursor
+// extended through the contiguous prefix of the reorder map.  Frames
+// parked in reorder (received on a fast stripe while a sibling owes
+// the gap) count as received: acking only the delivery cursor made a
+// healthy stripe's normal ring eviction look like data loss whenever
+// a sibling lagged, and finish_repair would then kill a repairable
+// stripe with "grow T4J_REPLAY_BYTES".  Unstriped links have an empty
+// reorder map, so W == delivered == the legacy ack exactly.
+uint64_t link_recv_watermark(PeerLink& p) {
+  std::lock_guard<std::mutex> lk(p.ro_mu);
+  uint64_t w = p.delivered;
+  for (auto it = p.reorder.begin();
+       it != p.reorder.end() && it->first == w + 1; ++it)
+    w = it->first;
+  return w;
+}
+
+// Buffered stripe reader: one recv() pulls as many small frames as
+// the kernel has ready (the scatter half of the syscall batching —
+// the gather half is the sendmsg iovec builder in stripe_write), and
+// large bodies are read straight into the frame buffer with no
+// double copy.
+constexpr size_t kRecvBufBytes = 64 << 10;
+
+// One bounded read appending to rb[len..cap): kOk after >= 1 byte.
+IoStatus fill_some(int fd, uint8_t* rb, size_t& len, size_t cap,
+                   const Deadline& dl) {
   for (;;) {
-    WireHeader h;
-    IoStatus st = nb_read_all(fd, &h, sizeof(h), forever);
-    if (st != IoStatus::kOk) {
-      if (st == IoStatus::kStopped || g_shutting_down.load() ||
-          g_stop.load())
-        return;
-      // EOF/error at a frame boundary during teardown is the clean
-      // path; anywhere else the connection broke under us — repair it
-      // when the self-healing layer is on, else it is a dead peer
-      if (resilience_on() &&
-          !g_finalizing.load(std::memory_order_acquire)) {
-        mark_broken(peer, "recv connection lost");
-        return;
-      }
+    ssize_t r = ::recv(fd, rb + len, cap - len, 0);
+    if (r > 0) {
+      len += static_cast<size_t>(r);
+      return IoStatus::kOk;
+    }
+    if (r == 0) return IoStatus::kEof;
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      int w = io_wait(fd, POLLIN, dl);
+      if (w == 1) continue;
+      return w == 0 ? IoStatus::kTimeout : IoStatus::kStopped;
+    }
+    return IoStatus::kError;
+  }
+}
+
+void reader_loop(int peer, int stripe, int fd) {
+  Deadline forever;  // idle between frames is legal — wait unbounded
+  std::unique_ptr<uint8_t[]> rb(new uint8_t[kRecvBufBytes]);
+  size_t off = 0, len = 0;  // rb[off, off+len) holds undelivered bytes
+
+  // Shared failure handling: mid = true when the stream died inside a
+  // frame (repairable loss: the sender's replay redelivers it whole).
+  auto stream_down = [&](IoStatus st, bool mid,
+                         uint64_t body_pending) -> void {
+    if (st == IoStatus::kStopped || g_shutting_down.load() ||
+        g_stop.load())
+      return;
+    if (resilience_on() && !g_finalizing.load(std::memory_order_acquire)) {
+      mark_stripe_broken(
+          peer, stripe,
+          mid ? (st == IoStatus::kTimeout
+                     ? "recv stalled mid-frame (T4J_OP_TIMEOUT)"
+                     : "recv connection lost mid-frame")
+              : "recv connection lost");
+      return;
+    }
+    if (mid)
+      post_fault(err_prefix() + "lost peer r" + std::to_string(peer) +
+                 " mid-frame (" +
+                 (st == IoStatus::kTimeout
+                      ? "stalled beyond T4J_OP_TIMEOUT"
+                      : "connection dropped") +
+                 " with " + std::to_string(body_pending) +
+                 "-byte body pending)");
+    else
       reader_post_fault(err_prefix() + "peer r" + std::to_string(peer) +
                         " closed the connection unexpectedly (process "
                         "died or exited without finalize)");
-      return;
+  };
+
+  for (;;) {
+    // ensure a whole header is buffered.  A clean teardown lands
+    // exactly on a frame boundary (off == len == 0); EOF with partial
+    // bytes buffered is a mid-frame loss.
+    while (len < sizeof(WireHeader)) {
+      if (off && len) std::memmove(rb.get(), rb.get() + off, len);
+      off = 0;
+      IoStatus st = fill_some(fd, rb.get(), len, kRecvBufBytes, forever);
+      if (st != IoStatus::kOk) {
+        stream_down(st, len > 0, 0);
+        return;
+      }
     }
+    WireHeader h;
+    std::memcpy(&h, rb.get() + off, sizeof(h));
+    off += sizeof(h);
+    len -= sizeof(h);
     if (h.magic != kMagic) {
       // stream corruption is not a transient: no replay can fix a
       // desynchronised byte stream, so this stays fail-stop
@@ -1215,23 +1511,11 @@ void reader_loop(int peer, int fd) {
                  "corruption)");
       return;
     }
-    if (h.ctx == kAbortCtx) {
-      // MPI_Abort analog from a peer: record and wake everyone.
+    if (h.ctx == kAbortCtx && h.nbytes > 4096) {
       // broadcast_abort caps the payload at 512 bytes, so anything
-      // larger is stream corruption, not a real abort reason.
-      if (h.nbytes > 4096) {
-        post_fault(err_prefix() + "garbled abort frame from peer r" +
-                   std::to_string(peer));
-        return;
-      }
-      std::string why(h.nbytes ? h.nbytes : 0, '\0');
-      if (h.nbytes) {
-        Deadline body = Deadline::after(5.0);
-        if (nb_read_all(fd, &why[0], h.nbytes, body) != IoStatus::kOk)
-          why = "(abort reason lost in transit)";
-      }
-      post_fault(err_prefix() + "abort broadcast from rank " +
-                 std::to_string(h.src) + ": " + why);
+      // larger is stream corruption, not a real abort reason
+      post_fault(err_prefix() + "garbled abort frame from peer r" +
+                 std::to_string(peer));
       return;
     }
     Frame f;
@@ -1239,32 +1523,33 @@ void reader_loop(int peer, int fd) {
     f.ctx = static_cast<int>(h.ctx);
     f.tag = static_cast<int>(h.tag) - 1;
     f.data = Buf(h.nbytes);
-    if (h.nbytes) {
-      // mid-frame the peer is actively sending: a stall here is a real
-      // fault, so the per-op deadline applies (when configured)
+    size_t have = len < h.nbytes ? len : static_cast<size_t>(h.nbytes);
+    if (have) {
+      std::memcpy(f.data.data(), rb.get() + off, have);
+      off += have;
+      len -= have;
+    }
+    if (have < h.nbytes) {
+      // mid-frame the peer is actively sending: a stall here is a
+      // real fault, so the per-op deadline applies (when configured)
       Deadline body = Deadline::after(effective_op_timeout());
-      IoStatus bst = nb_read_all(fd, f.data.data(), h.nbytes, body);
+      IoStatus bst = nb_read_all(fd, f.data.data() + have,
+                                 h.nbytes - have, body);
       if (bst != IoStatus::kOk) {
-        if (g_shutting_down.load() || bst == IoStatus::kStopped) return;
-        // the partial frame is discarded (recv_seq not advanced), so
-        // the reconnect replay redelivers it whole
-        if (resilience_on() &&
-            !g_finalizing.load(std::memory_order_acquire)) {
-          mark_broken(peer,
-                      bst == IoStatus::kTimeout
-                          ? "recv stalled mid-frame (T4J_OP_TIMEOUT)"
-                          : "recv connection lost mid-frame");
-          return;
-        }
-        post_fault(err_prefix() + "lost peer r" + std::to_string(peer) +
-                   " mid-frame (" +
-                   (bst == IoStatus::kTimeout ? "stalled beyond "
-                                                "T4J_OP_TIMEOUT"
-                                              : "connection dropped") +
-                   " with " + std::to_string(h.nbytes) +
-                   "-byte body pending)");
+        // the partial frame is discarded (delivery cursor not
+        // advanced), so the reconnect replay redelivers it whole
+        stream_down(bst, true, h.nbytes);
         return;
       }
+    }
+    if (h.ctx == kAbortCtx) {
+      // MPI_Abort analog from a peer: record and wake everyone
+      std::string why(reinterpret_cast<const char*>(f.data.data()),
+                      f.data.size());
+      if (why.empty()) why = "(abort reason lost in transit)";
+      post_fault(err_prefix() + "abort broadcast from rank " +
+                 std::to_string(h.src) + ": " + why);
+      return;
     }
     if (h.epoch != cur_epoch()) {
       // stale-epoch traffic (a frame built before a world resize):
@@ -1276,29 +1561,19 @@ void reader_loop(int peer, int fd) {
       continue;
     }
     if (h.seq) {
-      // sequenced TCP frame: drop reconnect-replay duplicates, and
-      // treat a gap as stream corruption (TCP is in-order and the
-      // replay starts exactly at the acked tail, so gaps cannot occur
-      // on a healthy stream)
-      PeerLink& p = g_peers[peer];
-      uint64_t have = p.recv_seq.load(std::memory_order_relaxed);
-      if (h.seq <= have) continue;  // replay duplicate: already delivered
-      if (h.seq != have + 1) {
-        post_fault(err_prefix() + "sequence gap from peer r" +
-                   std::to_string(peer) + " (got frame " +
-                   std::to_string(h.seq) + " after " +
-                   std::to_string(have) + " — stream corruption)");
-        return;
-      }
-      p.recv_seq.store(h.seq, std::memory_order_relaxed);
+      Stripe& st = g_peers[peer].s[stripe];
+      uint64_t seen = st.seen_seq.load(std::memory_order_relaxed);
+      if (h.seq > seen)
+        st.seen_seq.store(h.seq, std::memory_order_relaxed);
     }
-    {
-      std::lock_guard<std::mutex> lk(g_mail_mu);
-      g_mailbox.push_back(std::move(f));
+    if (!deliver_frame(peer, stripe, h.seq, std::move(f))) {
+      post_fault(err_prefix() + "sequence gap from peer r" +
+                 std::to_string(peer) + " (got frame " +
+                 std::to_string(h.seq) + " after " +
+                 std::to_string(g_peers[peer].delivered) +
+                 " — stream corruption)");
+      return;
     }
-    g_mail_cv.notify_all();
-    tel::trace_event(tel::kFrameRx, tel::kInstant, tel::kPlaneNone, -1,
-                     peer, h.nbytes);
   }
 }
 
@@ -1337,85 +1612,653 @@ void replay_copy(uint8_t* dst, const void* src, size_t n) {
   std::memcpy(dst, src, n);
 }
 
-// Append a just-built frame to the link's circular replay arena
+// ------------------------------------------------ MSG_ZEROCOPY plumbing
+//
+// Large frames opt into MSG_ZEROCOPY (T4J_ZEROCOPY_MIN_BYTES): the
+// kernel transmits straight from the caller's pages instead of copying
+// into the socket buffer, and posts a completion record on the
+// socket's error queue once it is done with them.  Until that
+// completion is reaped the pages are pinned — overwriting them would
+// corrupt in-flight data — so replay-arena reuse (eviction/grow) and,
+// on the no-ring T4J_RETRY_MAX=0 path, returning to the caller both
+// gate on the reap (docs/sharp-bits.md "MSG_ZEROCOPY pins pages").
+
+#if defined(__linux__) && defined(MSG_ZEROCOPY) && defined(SO_ZEROCOPY)
+#define T4J_HAVE_ZEROCOPY 1
+#else
+#define T4J_HAVE_ZEROCOPY 0
+#endif
+
+// Zerocopy completion diagnostics: total completions reaped, and how
+// many the kernel reported as COPIED anyway (SO_EE_CODE_ZEROCOPY_
+// COPIED — loopback always does; a real NIC path should not).
+std::atomic<uint64_t> g_zc_completions{0};
+std::atomic<uint64_t> g_zc_copied{0};
+
+bool probe_zerocopy_support() {
+#if T4J_HAVE_ZEROCOPY
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  int one = 1;
+  bool ok = ::setsockopt(fd, SOL_SOCKET, SO_ZEROCOPY, &one,
+                         sizeof(one)) == 0;
+  ::close(fd);
+  return ok;
+#else
+  return false;
+#endif
+}
+
+// Enable SO_ZEROCOPY on a freshly installed stripe socket (caller
+// holds send_mu or is single-threaded during bootstrap).
+void stripe_enable_zc(Stripe& st) {
+#if T4J_HAVE_ZEROCOPY
+  st.zc_enabled = false;
+  if (!g_zc_supported || zc_min_bytes() <= 0 || st.fd < 0) return;
+  int one = 1;
+  st.zc_enabled = ::setsockopt(st.fd, SOL_SOCKET, SO_ZEROCOPY, &one,
+                               sizeof(one)) == 0;
+  st.zc_sent = 0;
+  st.zc_done = 0;
+#else
+  (void)st;
+#endif
+}
+
+// Drain the socket error queue, advancing zc_done (caller holds
+// send_mu).  Nonblocking; safe to call on any stripe.
+void reap_zc(Stripe& st) {
+#if T4J_HAVE_ZEROCOPY
+  if (!st.zc_enabled || st.fd < 0 || st.zc_done == st.zc_sent) return;
+  for (;;) {
+    char ctrl[256];
+    msghdr mh{};
+    mh.msg_control = ctrl;
+    mh.msg_controllen = sizeof(ctrl);
+    ssize_t r = ::recvmsg(st.fd, &mh, MSG_ERRQUEUE | MSG_DONTWAIT);
+    if (r < 0) return;  // EAGAIN: nothing pending right now
+    for (cmsghdr* c = CMSG_FIRSTHDR(&mh); c; c = CMSG_NXTHDR(&mh, c)) {
+      if (!((c->cmsg_level == SOL_IP && c->cmsg_type == IP_RECVERR) ||
+            (c->cmsg_level == SOL_IPV6 && c->cmsg_type == IPV6_RECVERR)))
+        continue;
+      auto* ee = reinterpret_cast<sock_extended_err*>(CMSG_DATA(c));
+      if (ee->ee_errno != 0 || ee->ee_origin != SO_EE_ORIGIN_ZEROCOPY)
+        continue;
+      // ids [ee_info, ee_data] completed (u32, sequential from 0).
+      // SO_EE_CODE_ZEROCOPY_COPIED = the kernel fell back to copying
+      // for this range (loopback does; some NIC paths do) — count it
+      // so introspection can tell a real zero-copy fabric from one
+      // paying pin overhead for nothing (docs/performance.md).
+      uint32_t lo = ee->ee_info, hi = ee->ee_data + 1;
+      g_zc_completions.fetch_add(hi - lo, std::memory_order_relaxed);
+#ifdef SO_EE_CODE_ZEROCOPY_COPIED
+      if (ee->ee_code & SO_EE_CODE_ZEROCOPY_COPIED)
+        g_zc_copied.fetch_add(hi - lo, std::memory_order_relaxed);
+#endif
+      if (hi > st.zc_done) st.zc_done = hi;
+    }
+  }
+#else
+  (void)st;
+#endif
+}
+
+// Block (bounded) until completion ids [0, upto) are reaped — the
+// arena-reuse / caller-buffer-release gate.  Returns false on the
+// deadline (the caller escalates: overwriting pinned pages is
+// corruption, not a recoverable slow path).
+bool zc_wait(Stripe& st, uint32_t upto, const Deadline& dl) {
+#if T4J_HAVE_ZEROCOPY
+  while (st.zc_done < upto) {
+    reap_zc(st);
+    if (st.zc_done >= upto) break;
+    if (g_stop.load(std::memory_order_acquire)) return false;
+    if (dl.expired()) return false;
+    // completions arrive promptly (loopback: as soon as the reader
+    // consumed the bytes) — a 1ms tick keeps the eviction gate from
+    // serialising the pipeline on the poll granularity (a 20ms tick
+    // measured 2.5x busbw loss on the eviction-heavy 64MB path)
+    pollfd pfd{st.fd, POLLERR, 0};
+    ::poll(&pfd, 1, dl.remaining_ms(1));
+  }
+  return true;
+#else
+  (void)st;
+  (void)upto;
+  (void)dl;
+  return true;
+#endif
+}
+
+// ---------------------------------------------- emulated flow throttle
+//
+// T4J_EMU_FLOW_BPS: per-connection token bucket applied in the write
+// path (caller holds send_mu, so the sleep paces exactly one flow —
+// sibling stripes keep writing).  This is what lets a loopback box
+// show the multi-flow busbw step real fabrics get from multiple NIC
+// queues: one throttled flow caps at the knob, N stripes at N x knob.
+void throttle_stripe(Stripe& st, size_t nbytes) {
+  long long rate = emu_flow_bps();
+  if (rate <= 0 || nbytes == 0) return;
+  Clock::time_point now = Clock::now();
+  if (st.tb_last.time_since_epoch().count() == 0) st.tb_last = now;
+  double dt = std::chrono::duration<double>(now - st.tb_last).count();
+  st.tb_last = now;
+  st.tb_tokens += dt * static_cast<double>(rate);
+  double burst = static_cast<double>(rate) * 0.05;  // 50ms of burst
+  if (st.tb_tokens > burst) st.tb_tokens = burst;
+  st.tb_tokens -= static_cast<double>(nbytes);
+  while (st.tb_tokens < 0 && !g_stop.load(std::memory_order_acquire)) {
+    double wait_s = -st.tb_tokens / static_cast<double>(rate);
+    if (wait_s > 0.05) wait_s = 0.05;
+    std::this_thread::sleep_for(
+        std::chrono::duration<double>(wait_s));
+    now = Clock::now();
+    dt = std::chrono::duration<double>(now - st.tb_last).count();
+    st.tb_last = now;
+    st.tb_tokens += dt * static_cast<double>(rate);
+  }
+}
+
+// ------------------------------------------------ per-stripe replay ring
+
+// True when a contiguous region of `nbytes` is available WITHOUT
+// evicting (the blind-buffer space check for sends on a broken
+// stripe; caller holds send_mu).
+bool ring_has_space(const Stripe& st, size_t nbytes) {
+  if (!st.ring_buf) return nbytes <= static_cast<size_t>(replay_bytes());
+  if (st.ring.empty()) return nbytes <= st.ring_cap;
+  size_t tail = st.ring.front().off;
+  if (st.ring_head > tail)
+    return st.ring_cap - st.ring_head >= nbytes || tail >= nbytes;
+  if (st.ring_head < tail) return tail - st.ring_head >= nbytes;
+  return false;
+}
+
+// Append a just-built frame to the stripe's circular replay arena
 // (caller holds send_mu), evicting the oldest frames when space runs
 // out.  The newest frame is always retained even when it alone
 // exceeds T4J_REPLAY_BYTES — an empty ring could replay nothing.
-void ring_append(PeerLink& p, const WireHeader& h, const void* buf,
-                 size_t nbytes) {
+// Eviction of a MSG_ZEROCOPY-sent entry first waits for its kernel
+// completion: the pages are pinned until then, and overwriting them
+// would corrupt data still on the wire.  Returns the appended entry
+// (for the zerocopy send path, which points its iovec at the arena
+// copy and stamps the completion id back in).
+Replay& ring_append(Stripe& st, const WireHeader& h, const void* buf,
+                    size_t nbytes) {
   size_t cap = static_cast<size_t>(replay_bytes());
   if (cap < nbytes) cap = nbytes;  // an oversized frame always fits
-  if (!p.ring_buf || p.ring_cap < cap) {
+  auto note_evicted = [&st](const Replay& r) {
+    if (r.h.seq > st.max_evicted_seq) st.max_evicted_seq = r.h.seq;
+  };
+  if (!st.ring_buf || st.ring_cap < cap) {
     // first use, or an oversized frame forces a grow: retained history
-    // is dropped (identical to evicting everything)
-    if (!p.ring.empty()) p.ring_min_seq = p.ring.back().h.seq + 1;
-    p.ring.clear();
-    p.ring_head = 0;
-    p.ring_buf.reset(new uint8_t[cap]);
-    p.ring_cap = cap;
+    // is dropped (identical to evicting everything) — but the old
+    // arena may still be pinned by in-flight zerocopy sends, so reap
+    // those first (freeing pinned pages is the one unrecoverable bug)
+    if (st.zc_sent != st.zc_done)
+      (void)zc_wait(st, st.zc_sent,
+                    Deadline::after(effective_op_timeout() > 0
+                                        ? effective_op_timeout()
+                                        : 30.0));
+    for (const Replay& r : st.ring) note_evicted(r);
+    st.ring.clear();
+    st.ring_head = 0;
+    st.ring_buf.reset(new uint8_t[cap]);
+    st.ring_cap = cap;
   }
-  auto evict = [&p] {
-    p.ring_min_seq = p.ring.front().h.seq + 1;
-    p.ring.pop_front();
-    if (p.ring.empty()) p.ring_head = 0;
+  auto evict = [&] {
+    Replay& victim = st.ring.front();
+    if (victim.zc_id && victim.zc_id > st.zc_done)
+      (void)zc_wait(st, victim.zc_id,
+                    Deadline::after(effective_op_timeout() > 0
+                                        ? effective_op_timeout()
+                                        : 30.0));
+    note_evicted(victim);
+    st.ring.pop_front();
+    if (st.ring.empty()) st.ring_head = 0;
   };
   // carve a contiguous [off, off+nbytes) region: frames never wrap, so
   // the gap between the last entry's end and the arena end is wasted
   // until the wrapped-past entries are evicted (standard ring layout)
   size_t off;
   for (;;) {
-    if (p.ring.empty()) {
+    if (st.ring.empty()) {
       off = 0;
       break;
     }
-    size_t tail = p.ring.front().off;  // oldest resident payload
-    if (p.ring_head > tail) {
-      if (p.ring_cap - p.ring_head >= nbytes) {
-        off = p.ring_head;
+    size_t tail = st.ring.front().off;  // oldest resident payload
+    if (st.ring_head > tail) {
+      if (st.ring_cap - st.ring_head >= nbytes) {
+        off = st.ring_head;
         break;
       }
       if (tail >= nbytes) {
         off = 0;  // wrap
         break;
       }
-    } else if (p.ring_head < tail && tail - p.ring_head >= nbytes) {
-      off = p.ring_head;
+    } else if (st.ring_head < tail && tail - st.ring_head >= nbytes) {
+      off = st.ring_head;
       break;
     }
     evict();
   }
-  if (nbytes) replay_copy(p.ring_buf.get() + off, buf, nbytes);
+  if (nbytes) replay_copy(st.ring_buf.get() + off, buf, nbytes);
   // keep every frame 16-aligned so replay_copy's streaming path stays
   // eligible (off 0 is aligned; aligning the head aligns the rest)
-  p.ring_head = (off + nbytes + 15) & ~static_cast<size_t>(15);
-  if (p.ring_head > p.ring_cap) p.ring_head = p.ring_cap;
-  p.ring.push_back(Replay{h, off});
+  st.ring_head = (off + nbytes + 15) & ~static_cast<size_t>(15);
+  if (st.ring_head > st.ring_cap) st.ring_head = st.ring_cap;
+  st.ring.push_back(Replay{h, off, 0});
+  return st.ring.back();
 }
 
-// Wait (bounded by `dl`) until the link to `world_dest` is up (or
-// back up) — used both before a send on a broken link and after a
-// failed write whose frame now sits in the replay ring (the repair
-// redelivers it under send_mu).  Returns normally on kUp; throws on
-// escalation, stop or deadline expiry.
-void wait_link_up(int world_dest, const Deadline& dl, size_t nbytes,
-                  int tag, double limit_s) {
-  PeerLink& p = g_peers[world_dest];
-  std::unique_lock<std::mutex> lk(p.mu);
+// Wait (bounded by `dl`) until the stripe to `world_dest` is up (or
+// back up) — used both before a send on a broken stripe whose ring is
+// full and after a failed write whose frame now sits in the replay
+// ring (the repair redelivers it under send_mu).  Returns normally on
+// kUp; throws on stop/death (raise_stopped — a dead STRIPE with live
+// siblings never lands here: dealing skips it) or deadline expiry.
+void wait_stripe_up(int world_dest, int stripe, const Deadline& dl,
+                    size_t nbytes, int tag, double limit_s) {
+  Stripe& st = g_peers[world_dest].s[stripe];
+  std::unique_lock<std::mutex> lk(st.mu);
   for (;;) {
     if (g_stop.load(std::memory_order_acquire) ||
-        p.state == PeerLink::kDead) {
+        st.state == Stripe::kDead) {
       lk.unlock();
-      raise_stopped();
+      if (g_peers[world_dest].link_dead() ||
+          g_stop.load(std::memory_order_acquire))
+        raise_stopped();
+      return;  // stripe died but siblings live: migration redeals it
     }
-    if (p.state == PeerLink::kUp) return;
+    if (st.state == Stripe::kUp) return;
     if (dl.expired()) {
       lk.unlock();
       fail_op("send of " + std::to_string(nbytes) + " bytes to peer r" +
               std::to_string(world_dest) + " (tag " + std::to_string(tag) +
+              ", stripe " + std::to_string(stripe) +
               ") made no progress for " + std::to_string(limit_s) + "s (" +
               deadline_knob() + ") — link down, reconnect still pending");
     }
-    p.cv.wait_for(lk, std::chrono::milliseconds(dl.remaining_ms(100)));
+    st.cv.wait_for(lk, std::chrono::milliseconds(dl.remaining_ms(100)));
+  }
+}
+
+// ------------------------------------------------ striped send engine
+//
+// One frame headed for one link (seq/stripe assigned by deal_frames).
+struct WirePart {
+  const void* buf;
+  size_t nbytes;
+  WireHeader h;
+  int stripe = 0;
+};
+
+// Round-robin pick over the live stripes (caller holds deal_mu): scan
+// the active dealing width first, then — so a dead stripe can never
+// strand traffic when live siblings exist OUTSIDE the active width —
+// fall back to any live built stripe.  Returns -1 only when every
+// stripe of the link is dead (the link-level verdict owns that).
+int pick_live_stripe(PeerLink& p) {
+  uint32_t dead = p.dead_mask.load(std::memory_order_relaxed);
+  int width = active_stripes();
+  if (width > p.nstripes) width = p.nstripes;
+  for (int t = 0; t < width; ++t) {
+    int si = static_cast<int>(p.dealt++ % width);
+    if (!((dead >> si) & 1)) return si;
+  }
+  for (int si = 0; si < p.nstripes; ++si)
+    if (!((dead >> si) & 1)) return si;
+  return -1;
+}
+
+// Assign link seqs + round-robin stripes under deal_mu.  Frames are
+// sequenced whenever self-healing is on (replay dedup needs it) OR
+// more than one stripe is dealing (delivery order needs it); the
+// single-flow no-healing path keeps seq 0 — the exact pre-striping
+// wire bytes.
+void deal_frames(PeerLink& p, int ctx, int tag, WirePart* parts,
+                 size_t nparts, bool healing) {
+  int width = active_stripes();
+  if (width > p.nstripes) width = p.nstripes;
+  bool sequenced = healing || width > 1;
+  std::lock_guard<std::mutex> lk(p.deal_mu);
+  for (size_t i = 0; i < nparts; ++i) {
+    WirePart& w = parts[i];
+    uint64_t seq = sequenced ? ++p.send_seq : 0;
+    w.h = WireHeader{kMagic, static_cast<uint32_t>(g_rank),
+                     static_cast<uint32_t>(ctx),
+                     static_cast<uint32_t>(tag + 1),
+                     static_cast<uint64_t>(w.nbytes), seq, cur_epoch(), 0};
+    int si = pick_live_stripe(p);
+    w.stripe = si < 0 ? 0 : si;  // all-dead: stripe 0's dead state
+                                 // surfaces the link verdict to the
+                                 // sender promptly
+  }
+}
+
+// Write a run of frames for ONE stripe (caller holds st.send_mu).
+// Small frames gather into sendmsg iovec batches (header + payload
+// pairs, up to T4J_SENDMSG_BATCH frames / one syscall); frames at or
+// above T4J_ZEROCOPY_MIN_BYTES go out individually with MSG_ZEROCOPY.
+// With healing on, payloads are already in the replay arena and the
+// iovecs point THERE (the arena copy is the only copy; the kernel
+// reads the pinned arena pages) — with healing off, iovecs point at
+// the caller's buffers and zerocopy sends are reaped before return.
+// Returns kOk, or the first failure (frames up to it are either on
+// the wire or in the ring).
+IoStatus stripe_write(Stripe& st, WirePart** run, size_t n, bool healing,
+                      const Deadline& dl, size_t* zc_out) {
+  long long zc_min = zc_min_bytes();
+  int batch_cap = sendmsg_batch();
+  if (batch_cap > 256) batch_cap = 256;  // IOV_MAX safety (2 iov/frame)
+  std::vector<iovec> iov;
+  iov.reserve(2 * static_cast<size_t>(batch_cap));
+  // On a failure mid-run (healing), every frame from `next` on must
+  // still land in the replay ring — the repair cycle is the only
+  // redelivery path, and a frame that is neither on the wire nor in
+  // the ring would be silently lost.  Over-capacity eviction here is
+  // DETECTED loss (the repair handshake escalates when the peer needs
+  // an evicted seq), matching the documented "grow T4J_REPLAY_BYTES"
+  // contract.
+  auto bail = [&](IoStatus s, size_t next) {
+    if (healing)
+      for (size_t k = next; k < n; ++k)
+        ring_append(st, run[k]->h, run[k]->buf, run[k]->nbytes);
+    return s;
+  };
+  size_t i = 0;
+  while (i < n) {
+    WirePart& w = *run[i];
+    const uint8_t* payload = static_cast<const uint8_t*>(w.buf);
+    Replay* rep = nullptr;
+    if (healing) {
+      rep = &ring_append(st, w.h, w.buf, w.nbytes);
+      payload = st.ring_buf.get() + rep->off;
+    }
+    bool zc = st.zc_enabled && zc_min > 0 && w.nbytes &&
+              static_cast<long long>(w.nbytes) >= zc_min;
+    if (zc) {
+#if T4J_HAVE_ZEROCOPY
+      throttle_stripe(st, sizeof(WireHeader) + w.nbytes);
+      // header rides a plain MSG_MORE write (40 B — not worth pinning,
+      // and pinning it would outlive the caller's stack frame); the
+      // payload goes zerocopy and uncorks it.  Each successful
+      // sendmsg(MSG_ZEROCOPY) call issues one completion id.
+      iovec hi[1] = {{&w.h, sizeof(w.h)}};
+#ifdef MSG_MORE
+      IoStatus s1 = nb_write_all(st.fd, hi, 1, dl, false, MSG_MORE);
+#else
+      IoStatus s1 = nb_write_all(st.fd, hi, 1, dl);
+#endif
+      if (s1 != IoStatus::kOk) return bail(s1, i + 1);
+      size_t left = w.nbytes;
+      const uint8_t* ptr = payload;
+      while (left > 0) {
+        iovec pv{const_cast<uint8_t*>(ptr), left};
+        msghdr mh{};
+        mh.msg_iov = &pv;
+        mh.msg_iovlen = 1;
+        ssize_t wr = ::sendmsg(st.fd, &mh, MSG_NOSIGNAL | MSG_ZEROCOPY);
+        if (wr < 0) {
+          if (errno == EINTR) continue;
+          if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            int rc = io_wait(st.fd, POLLOUT, dl);
+            if (rc == 1) continue;
+            return bail(rc == 0 ? IoStatus::kTimeout : IoStatus::kStopped,
+                        i + 1);
+          }
+          if (errno == ENOBUFS) {
+            // optmem exhausted: reap and fall back to the copy path
+            // for the remainder of this frame
+            reap_zc(st);
+            iovec cv{const_cast<uint8_t*>(ptr), left};
+            IoStatus s2 = nb_write_all(st.fd, &cv, 1, dl);
+            if (s2 != IoStatus::kOk) return bail(s2, i + 1);
+            left = 0;
+            break;
+          }
+          return bail(IoStatus::kError, i + 1);
+        }
+        ++st.zc_sent;
+        if (rep) rep->zc_id = st.zc_sent;  // pins the arena entry
+        if (zc_out) *zc_out += 1;
+        ptr += wr;
+        left -= static_cast<size_t>(wr);
+      }
+      reap_zc(st);  // opportunistic: keep the errqueue short
+      if (!healing) {
+        // no arena copy exists: the caller's buffer is the pinned
+        // storage and may be reused the moment we return — block on
+        // the completion (prompt on loopback; bounded by the deadline)
+        if (!zc_wait(st, st.zc_sent, dl))
+          return g_stop.load(std::memory_order_acquire)
+                     ? IoStatus::kStopped
+                     : IoStatus::kTimeout;
+      }
+      ++i;
+      continue;
+#endif
+    }
+    // Gather batch: this frame plus following non-zerocopy frames,
+    // one sendmsg per batch.  Under healing every frame is appended
+    // to the replay ring and its iovecs point at the ARENA copy; an
+    // append can evict older entries or grow (replace) the arena, so
+    // the pending batch is FLUSHED first whenever the next append
+    // could not be satisfied without evicting — the iovec list never
+    // holds a pointer into arena space an eviction could hand to a
+    // later frame, and a same-batch frame can never be evicted before
+    // it hits the wire.  (Deque references themselves survive
+    // push_back/pop_front of other elements; only the arena bytes
+    // need the flush discipline.)
+    iov.clear();
+    size_t batched = 0;
+    auto flush = [&]() -> IoStatus {
+      if (iov.empty()) return IoStatus::kOk;
+      size_t total = 0;
+      for (const iovec& v : iov) total += v.iov_len;
+      throttle_stripe(st, total);
+      IoStatus s = nb_write_all(st.fd, iov.data(),
+                                static_cast<int>(iov.size()), dl);
+      iov.clear();
+      return s;
+    };
+    size_t j = i;
+    while (j < n && batched < static_cast<size_t>(batch_cap)) {
+      WirePart& b = *run[j];
+      if (st.zc_enabled && zc_min > 0 && b.nbytes &&
+          static_cast<long long>(b.nbytes) >= zc_min && j != i)
+        break;  // the zerocopy frame starts its own write
+      if (healing) {
+        if (!ring_has_space(st, b.nbytes)) {
+          // the append would evict: put the pending batch on the wire
+          // first (its arena bytes must not be reused under it)
+          IoStatus s = flush();
+          if (s != IoStatus::kOk) return bail(s, j);
+        }
+        Replay& r2 = ring_append(st, b.h, b.buf, b.nbytes);
+        iov.push_back({&r2.h, sizeof(r2.h)});
+        if (r2.h.nbytes)
+          iov.push_back({st.ring_buf.get() + r2.off,
+                         static_cast<size_t>(r2.h.nbytes)});
+      } else {
+        iov.push_back({&b.h, sizeof(b.h)});
+        if (b.nbytes)
+          iov.push_back({const_cast<void*>(b.buf), b.nbytes});
+      }
+      ++batched;
+      ++j;
+    }
+    IoStatus s = flush();
+    if (s != IoStatus::kOk) return bail(s, i + batched);
+    i += batched;
+  }
+  return IoStatus::kOk;
+}
+
+void mark_stripe_broken(int peer, int stripe, const std::string& why);
+
+// Send `nparts` frames to one TCP peer through the striped wire path:
+// deal (seq + stripe), group per stripe, and write each stripe's run
+// with gather batching / zerocopy / the emulated flow throttle.  A
+// broken stripe blind-buffers into its replay ring (bounded) instead
+// of stalling the caller while siblings flow — the repair cycle
+// redelivers; only a FULL ring blocks, and only on that stripe.
+void link_send(int world_dest, int ctx, int tag, const void** bufs,
+               const size_t* sizes, size_t nparts) {
+  PeerLink& p = g_peers[world_dest];
+  bool healing = resilience_on() &&
+                 !g_finalizing.load(std::memory_order_acquire);
+  if (p.nstripes == 0 || (p.s[0].fd < 0 && !healing && p.nstripes == 1))
+    fail_arg("send to unconnected peer r" + std::to_string(world_dest));
+  double limit_s = effective_op_timeout();
+  Deadline dl = Deadline::after(limit_s);
+  for (size_t i = 0; i < nparts; ++i) maybe_inject_send_fault();
+  std::vector<WirePart> parts(nparts);
+  for (size_t i = 0; i < nparts; ++i) {
+    parts[i].buf = bufs[i];
+    parts[i].nbytes = sizes[i];
+  }
+  deal_frames(p, ctx, tag, parts.data(), nparts, healing);
+  // group per stripe, preserving per-stripe order
+  std::vector<std::vector<WirePart*>> runs(p.nstripes);
+  for (WirePart& w : parts) runs[w.stripe].push_back(&w);
+  // Runs drain stripe by stripe; a migration/redeal can move frames
+  // onto an already-visited stripe, so sweep until every run is empty.
+  bool pending = true;
+  while (pending) {
+    pending = false;
+    for (int si = 0; si < p.nstripes; ++si) {
+      if (runs[si].empty()) continue;
+      Stripe& st = p.s[si];
+      if (g_stop.load(std::memory_order_acquire)) raise_stopped();
+      bool blind = false;
+      bool stripe_dead =
+          ((p.dead_mask.load(std::memory_order_relaxed) >> si) & 1) != 0;
+      if (!stripe_dead) {
+        std::unique_lock<std::mutex> slk(st.mu);
+        if (st.state != Stripe::kUp) {
+          if (!healing) {
+            slk.unlock();
+            raise_stopped();
+          }
+          blind = st.state == Stripe::kBroken;
+          stripe_dead = st.state == Stripe::kDead;
+        }
+      }
+      if (stripe_dead) {
+        // a dead stripe's ring migrated (one-shot): frames must NOT
+        // buffer here — redeal onto a live sibling.  No live sibling
+        // means the link is (about to be) dead: surface the stop.
+        {
+          std::lock_guard<std::mutex> dlk(p.deal_mu);
+          for (WirePart* w : runs[si]) {
+            int cand = pick_live_stripe(p);
+            if (cand < 0 || cand == si) {
+              raise_stopped();
+            }
+            w->stripe = cand;
+            runs[cand].push_back(w);
+          }
+        }
+        runs[si].clear();
+        pending = true;  // re-sweep: the new homes still hold the run
+        continue;
+      }
+      if (blind) {
+        // broken stripe: buffer the run into the replay ring so
+        // siblings never stall; the repair redelivers it.  The state
+        // is re-checked under send_mu — a stripe that died (and
+        // migrated its ring) between our peek and the lock must not
+        // swallow frames.  Single-flow links keep the legacy
+        // behaviour (block for the verdict): T4J_STRIPES=1 must stay
+        // byte- and timing-stable vs HEAD.
+        if (p.nstripes > 1) {
+          bool buffered = false;
+          bool died = false;
+          {
+            std::lock_guard<std::mutex> slk(st.send_mu);
+            if (st.migrated) {
+              died = true;
+            } else {
+              bool fits = true;
+              for (WirePart* w : runs[si])
+                if (!ring_has_space(st, w->nbytes)) {
+                  fits = false;
+                  break;
+                }
+              if (fits) {
+                for (WirePart* w : runs[si])
+                  ring_append(st, w->h, w->buf, w->nbytes);
+                buffered = true;
+              }
+            }
+          }
+          if (died) {
+            pending = true;  // redealt by the stripe_dead branch above
+            continue;        // (next sweep sees the dead_mask bit)
+          }
+          if (buffered) {
+            runs[si].clear();
+            continue;
+          }
+        }
+        wait_stripe_up(world_dest, si, dl, runs[si].front()->nbytes,
+                       tag, limit_s);
+        pending = true;  // re-sweep: up again, or dead and redealt
+        continue;
+      }
+      IoStatus wst;
+      int saved_errno = 0;
+      size_t zc_frames = 0;
+      {
+        // failure handling happens OUTSIDE this scope: fail_op
+        // broadcasts the abort, and broadcast_abort try_locks every
+        // stripe's send_mu — including this one
+        std::lock_guard<std::mutex> slk(st.send_mu);
+        wst = stripe_write(st, runs[si].data(), runs[si].size(),
+                           healing, dl, &zc_frames);
+        saved_errno = errno;
+      }
+      switch (wst) {
+        case IoStatus::kOk:
+          for (WirePart* w : runs[si])
+            tel::trace_event(tel::kFrameTx, tel::kInstant,
+                             tel::kPlaneNone, si, world_dest,
+                             w->nbytes);
+          runs[si].clear();
+          continue;
+        case IoStatus::kTimeout:
+          fail_op("send of " +
+                  std::to_string(runs[si].front()->nbytes) +
+                  " bytes to peer r" + std::to_string(world_dest) +
+                  " (tag " + std::to_string(tag) + ", stripe " +
+                  std::to_string(si) + ") made no progress for " +
+                  std::to_string(limit_s) + "s (" + deadline_knob() +
+                  ") — peer stalled or not draining");
+        case IoStatus::kStopped:
+          raise_stopped();
+        default:
+          if (healing) {
+            // every frame of this run is in the stripe's replay ring
+            // (stripe_write appends before writing): hand delivery to
+            // the repair cycle.  Siblings' runs continue; single-flow
+            // links additionally wait for the verdict (legacy
+            // semantics).
+            mark_stripe_broken(world_dest, si,
+                               std::string("send failed: ") +
+                                   std::strerror(saved_errno));
+            if (p.nstripes == 1)
+              wait_stripe_up(world_dest, si, dl,
+                             runs[si].front()->nbytes, tag, limit_s);
+            runs[si].clear();
+            continue;
+          }
+          fail_op("send to peer r" + std::to_string(world_dest) +
+                  " failed: " + std::strerror(saved_errno) +
+                  " (peer process likely dead)");
+      }
+    }
   }
 }
 
@@ -1438,15 +2281,16 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
                      world_dest, nbytes);
     return;
   }
-  maybe_inject_send_fault();
-  WireHeader h{kMagic, static_cast<uint32_t>(g_rank),
-               static_cast<uint32_t>(ctx), static_cast<uint32_t>(tag + 1),
-               static_cast<uint64_t>(nbytes), 0, cur_epoch(), 0};
   if (world_dest < static_cast<int>(g_tx_pipes.size()) &&
       g_tx_pipes[world_dest]) {
+    maybe_inject_send_fault();
+    WireHeader h{kMagic, static_cast<uint32_t>(g_rank),
+                 static_cast<uint32_t>(ctx),
+                 static_cast<uint32_t>(tag + 1),
+                 static_cast<uint64_t>(nbytes), 0, cur_epoch(), 0};
     shm::Pipe* pipe = g_tx_pipes[world_dest];
     PeerLink& pp = g_peers[world_dest];
-    std::lock_guard<std::mutex> lk(pp.send_mu);  // one producer per pipe
+    std::lock_guard<std::mutex> lk(pp.pipe_mu);  // one producer per pipe
     // g_stop (not just the shutdown flag): a fault posted while we are
     // blocked on a full pipe with a dead consumer must unblock us
     if (!shm::pipe_write(pipe, &h, sizeof(h), g_stop) ||
@@ -1460,60 +2304,9 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
                      world_dest, nbytes);
     return;
   }
-  PeerLink& p = g_peers[world_dest];
-  if (p.fd < 0 && !resilience_on())
-    fail_arg("send to unconnected peer r" + std::to_string(world_dest));
-  double limit_s = effective_op_timeout();
-  Deadline dl = Deadline::after(limit_s);
-  bool healing = resilience_on() &&
-                 !g_finalizing.load(std::memory_order_acquire);
-  if (healing) {
-    // a broken link blocks new sends until the repair verdict; the
-    // send deadline covers the whole wait+write
-    wait_link_up(world_dest, dl, nbytes, tag, limit_s);
-  }
-  IoStatus st;
-  int saved_errno = 0;
-  {
-    // failure handling happens OUTSIDE this scope: fail_op broadcasts
-    // the abort, and broadcast_abort try_locks every peer's send_mu —
-    // including this one, which the same thread must not still hold
-    std::lock_guard<std::mutex> lk(p.send_mu);
-    if (healing) {
-      h.seq = ++p.send_seq;
-      ring_append(p, h, buf, nbytes);
-    }
-    // header + body in one syscall (one TCP segment for small frames)
-    iovec iov[2] = {{&h, sizeof(h)}, {const_cast<void*>(buf), nbytes}};
-    st = nb_write_all(p.fd, iov, nbytes ? 2 : 1, dl);
-    saved_errno = errno;
-  }
-  switch (st) {
-    case IoStatus::kOk:
-      tel::trace_event(tel::kFrameTx, tel::kInstant, tel::kPlaneNone, -1,
-                       world_dest, nbytes);
-      return;
-    case IoStatus::kTimeout:
-      fail_op("send of " + std::to_string(nbytes) + " bytes to peer r" +
-              std::to_string(world_dest) + " (tag " + std::to_string(tag) +
-              ") made no progress for " + std::to_string(limit_s) + "s (" +
-              deadline_knob() + ") — peer stalled or not draining");
-    case IoStatus::kStopped:
-      raise_stopped();
-    default:
-      if (healing) {
-        // the frame sits in the replay ring: once the link repairs,
-        // the repair redelivers it — this caller only has to wait for
-        // the link verdict within its own deadline
-        mark_broken(world_dest, std::string("send failed: ") +
-                                    std::strerror(saved_errno));
-        wait_link_up(world_dest, dl, nbytes, tag, limit_s);
-        return;
-      }
-      fail_op("send to peer r" + std::to_string(world_dest) +
-              " failed: " + std::strerror(saved_errno) +
-              " (peer process likely dead)");
-  }
+  const void* bufs[1] = {buf};
+  size_t sizes[1] = {nbytes};
+  link_send(world_dest, ctx, tag, bufs, sizes, 1);
 }
 
 // The one envelope-matching rule (MPI matching semantics: exact ctx,
@@ -1759,11 +2552,12 @@ int tcp_connect(const std::string& host, uint16_t port,
 //      incarnation token) escalate to the PR-1 fail-stop path: abort
 //      broadcast + posted fault, job over.
 
-// Terminal link verdict: no repair possible.  Outside teardown this is
-// exactly today's fail-stop path — abort broadcast + posted fault.
-// The fault is posted BEFORE the state flips to kDead: a sender parked
-// on the link cv must find the repair diagnostic in the fault slot
-// when it wakes, not an empty "bridge already shut down".
+// Terminal link verdict: no stripe can carry traffic any more.
+// Outside teardown this is exactly today's fail-stop path — abort
+// broadcast + posted fault.  The fault is posted BEFORE the states
+// flip to kDead: a sender parked on a stripe cv must find the repair
+// diagnostic in the fault slot when it wakes, not an empty "bridge
+// already shut down".
 void escalate_link(int peer, const std::string& why) {
   tel::control_event(tel::kLinkDead, peer, 0);
   // Elastic membership (docs/failure-semantics.md "elastic
@@ -1790,98 +2584,203 @@ void escalate_link(int peer, const std::string& why) {
     broadcast_abort(msg);
     post_fault(msg);
   }
-  {
-    std::lock_guard<std::mutex> lk(p.mu);
-    p.state = PeerLink::kDead;
-    p.repairing = false;
+  for (int si = 0; si < p.nstripes; ++si) {
+    Stripe& st = p.s[si];
+    {
+      std::lock_guard<std::mutex> lk(st.mu);
+      st.state = Stripe::kDead;
+      st.repairing = false;
+    }
+    st.cv.notify_all();
   }
-  p.cv.notify_all();
+  p.dead_mask.store(
+      p.nstripes >= 32 ? ~0u : ((1u << p.nstripes) - 1),
+      std::memory_order_relaxed);
 }
 
-// Install the fresh connection on the link and replay the unacked
-// tail.  `peer_has` is the last contiguous seq the peer reported in
-// the handshake.  Returns false (with *why set) when the replay ring
-// no longer holds the frames the peer is missing — the caller
-// escalates.  The caller must already have joined the link's old
-// reader thread.
-bool finish_repair(int peer, int fd, uint64_t peer_has, std::string* why) {
+// Move a dead stripe's replay tail onto the lowest live sibling: the
+// frames are appended to the sibling's ring (its own future repairs
+// must cover them too) and written out on its socket.  The receiver
+// dedups by link seq, so frames the peer already had are harmless.
+// Returns false when no live sibling exists.
+bool migrate_stripe(int peer, int dead_si) {
   PeerLink& p = g_peers[peer];
-  std::unique_lock<std::mutex> slk(p.send_mu);
+  uint32_t dead = p.dead_mask.load(std::memory_order_relaxed);
+  int tgt = -1;
+  for (int si = 0; si < p.nstripes; ++si)
+    if (si != dead_si && !((dead >> si) & 1)) {
+      tgt = si;
+      break;
+    }
+  if (tgt < 0) return false;
+  Stripe& src = p.s[dead_si];
+  Stripe& dst = p.s[tgt];
+  // two-stripe lock order: lower index first (the only code path that
+  // ever holds two stripe send_mus)
+  Stripe& first = dead_si < tgt ? src : dst;
+  Stripe& second = dead_si < tgt ? dst : src;
+  std::lock_guard<std::mutex> lk1(first.send_mu);
+  std::lock_guard<std::mutex> lk2(second.send_mu);
+  uint64_t frames = 0, bytes = 0;
+  IoStatus wst = IoStatus::kOk;
+  for (Replay& r : src.ring) {
+    size_t len = static_cast<size_t>(r.h.nbytes);
+    Replay& nr = ring_append(dst, r.h, src.ring_buf.get() + r.off, len);
+    if (wst == IoStatus::kOk && dst.fd >= 0) {
+      iovec iov[2] = {{&nr.h, sizeof(nr.h)},
+                      {dst.ring_buf.get() + nr.off, len}};
+      wst = nb_write_all(dst.fd, iov, len ? 2 : 1,
+                         Deadline::after(connect_timeout()));
+    }
+    ++frames;
+    bytes += len;
+  }
+  src.ring.clear();
+  src.ring_head = 0;
+  // one-shot: anything a racing sender appends to src AFTER this has
+  // no redelivery path — the flag (checked under send_mu) makes such
+  // senders redeal onto a live sibling instead of buffering here
+  src.migrated = true;
+  std::fprintf(stderr,
+               "r%d | t4j: stripe %d of link r%d is dead — migrated "
+               "%llu frame(s) / %llu bytes onto stripe %d "
+               "(siblings keep the link alive)\n",
+               g_rank, dead_si, peer,
+               static_cast<unsigned long long>(frames),
+               static_cast<unsigned long long>(bytes), tgt);
+  std::fflush(stderr);
+  // a write failure mid-migration is fine: everything is in dst's
+  // ring, and dst's own repair cycle redelivers (triggered by its
+  // reader/writer noticing the break)
+  return true;
+}
+
+void watchdog_repair(int peer, int stripe);
+
+// Terminal STRIPE verdict.  With live siblings the link survives: the
+// dead stripe's tail migrates and dealing skips it from now on — the
+// link is dead only when every stripe is
+// (docs/failure-semantics.md "per-stripe replay and escalation").
+void escalate_stripe(int peer, int si, const std::string& why) {
+  PeerLink& p = g_peers[peer];
+  if (p.nstripes <= 1) {
+    escalate_link(peer, why);
+    return;
+  }
+  Stripe& st = p.s[si];
   {
-    std::lock_guard<std::mutex> lk(p.mu);
-    if (p.state == PeerLink::kDead ||
+    std::lock_guard<std::mutex> lk(st.mu);
+    st.state = Stripe::kDead;
+    st.repairing = false;
+  }
+  p.dead_mask.fetch_or(1u << si, std::memory_order_relaxed);
+  st.cv.notify_all();
+  tel::control_event(tel::kLinkDead, peer, 0, si);
+  if (p.link_dead() || g_stop.load(std::memory_order_acquire)) {
+    escalate_link(peer, why + " (no live stripe remains)");
+    return;
+  }
+  std::fprintf(stderr,
+               "r%d | t4j: stripe %d of link r%d could not be repaired "
+               "(%s) — continuing on the surviving stripe(s)\n",
+               g_rank, si, peer, why.c_str());
+  std::fflush(stderr);
+  if (!migrate_stripe(peer, si))
+    escalate_link(peer, why + " (no live stripe remains)");
+}
+
+// Install the fresh connection on the stripe and replay its unacked
+// tail.  `peer_has` is the LINK-level received watermark the peer
+// reported in the handshake (frames at or below it were received —
+// delivered or parked in its reorder stage; frames above it that
+// arrived on other stripes dedup at the receiver).
+// Returns false (with *why set) when this stripe's replay ring
+// evicted a frame the peer may still need — the caller escalates.
+// The caller must already have joined the stripe's old reader thread.
+bool finish_repair(int peer, int si, int fd, uint64_t peer_has,
+                   std::string* why) {
+  PeerLink& p = g_peers[peer];
+  Stripe& st = p.s[si];
+  std::unique_lock<std::mutex> slk(st.send_mu);
+  {
+    std::lock_guard<std::mutex> lk(st.mu);
+    if (st.state == Stripe::kDead ||
         g_stop.load(std::memory_order_acquire)) {
       ::close(fd);
       return true;  // verdict already reached elsewhere
     }
   }
-  if (peer_has + 1 < p.ring_min_seq && p.send_seq > peer_has) {
-    *why = "peer is missing " + std::to_string(p.ring_min_seq - 1 -
-                                               peer_has) +
-           " frame(s) already evicted from the replay ring — grow "
+  if (st.max_evicted_seq > peer_has) {
+    *why = "peer is missing frame(s) up to seq " +
+           std::to_string(st.max_evicted_seq) +
+           " already evicted from this stripe's replay ring — grow "
            "T4J_REPLAY_BYTES";
     ::close(fd);
     return false;
   }
-  int old = p.fd;
-  p.fd = fd;
+  int old = st.fd;
+  st.fd = fd;
   if (old >= 0) ::close(old);
+  stripe_enable_zc(st);
   uint32_t ep;
   {
-    std::lock_guard<std::mutex> lk(p.mu);
-    p.state = PeerLink::kUp;
-    ep = ++p.epoch;
-    p.repairing = false;
-    p.reconnects.fetch_add(1, std::memory_order_relaxed);
+    std::lock_guard<std::mutex> lk(st.mu);
+    st.state = Stripe::kUp;
+    ep = ++st.epoch;
+    st.repairing = false;
+    st.reconnects.fetch_add(1, std::memory_order_relaxed);
   }
   // reader first, replay second: the peer replays its own tail
   // concurrently, and a reader consuming it keeps two large opposing
   // tails from deadlocking against full kernel buffers
   {
-    std::lock_guard<std::mutex> jk(p.join_mu);
-    p.reader = std::thread(reader_loop, peer, fd);
+    std::lock_guard<std::mutex> jk(st.join_mu);
+    st.reader = std::thread(reader_loop, peer, si, fd);
   }
-  p.cv.notify_all();
+  st.cv.notify_all();
   uint64_t frames = 0, bytes = 0;
-  IoStatus st = IoStatus::kOk;
-  for (Replay& r : p.ring) {
+  IoStatus wst = IoStatus::kOk;
+  for (Replay& r : st.ring) {
     if (r.h.seq <= peer_has) continue;
     size_t len = static_cast<size_t>(r.h.nbytes);
     iovec iov[2] = {{&r.h, sizeof(r.h)},
-                    {p.ring_buf.get() + r.off, len}};
-    st = nb_write_all(p.fd, iov, len ? 2 : 1,
-                      Deadline::after(connect_timeout()));
-    if (st != IoStatus::kOk) break;
+                    {st.ring_buf.get() + r.off, len}};
+    wst = nb_write_all(st.fd, iov, len ? 2 : 1,
+                       Deadline::after(connect_timeout()));
+    if (wst != IoStatus::kOk) break;
     ++frames;
     bytes += len;
   }
-  p.replayed_frames.fetch_add(frames, std::memory_order_relaxed);
-  p.replayed_bytes.fetch_add(bytes, std::memory_order_relaxed);
-  tel::control_event(tel::kReconnect, peer, bytes);
-  if (frames) tel::control_event(tel::kReplay, peer, bytes);
+  st.replayed_frames.fetch_add(frames, std::memory_order_relaxed);
+  st.replayed_bytes.fetch_add(bytes, std::memory_order_relaxed);
+  tel::control_event(tel::kReconnect, peer, bytes, si);
+  if (frames) tel::control_event(tel::kReplay, peer, bytes, si);
   std::fprintf(stderr,
-               "r%d | t4j: link to peer r%d reconnected (epoch %u, "
-               "replayed %llu frame(s) / %llu bytes)\n",
-               g_rank, peer, ep,
+               "r%d | t4j: link to peer r%d reconnected (stripe %d, "
+               "epoch %u, replayed %llu frame(s) / %llu bytes)\n",
+               g_rank, peer, si, ep,
                static_cast<unsigned long long>(frames),
                static_cast<unsigned long long>(bytes));
   std::fflush(stderr);
-  if (st != IoStatus::kOk && !g_stop.load(std::memory_order_acquire)) {
+  if (wst != IoStatus::kOk && !g_stop.load(std::memory_order_acquire)) {
     // the fresh connection broke again mid-replay: the un-replayed
     // tail is still in the ring, so start another cycle
     slk.unlock();
-    mark_broken(peer, "link dropped again during replay");
+    mark_stripe_broken(peer, si, "link dropped again during replay");
   }
   return true;
 }
 
 // Active (dialer-side) repair: the higher rank of the pair re-dials
-// the lower rank's mesh listener with backoff, handshakes, replays.
-void dial_repair(int peer) {
+// the lower rank's mesh listener with backoff, handshakes, replays —
+// one cycle per STRIPE, so one dropped flow repairs while its
+// siblings keep carrying traffic.
+void dial_repair(int peer, int si) {
   PeerLink& p = g_peers[peer];
+  Stripe& st = p.s[si];
   {
-    std::lock_guard<std::mutex> jk(p.join_mu);
-    if (p.reader.joinable()) p.reader.join();  // finalises p.recv_seq
+    std::lock_guard<std::mutex> jk(st.join_mu);
+    if (st.reader.joinable()) st.reader.join();
   }
   std::string why = "connection lost";
   int attempts = retry_max();
@@ -1893,8 +2792,9 @@ void dial_repair(int peer) {
     if (fd < 0) continue;
     Deadline dl = Deadline::after(connect_timeout());
     ReconHello hello{kReconMagic, static_cast<uint32_t>(g_rank),
-                     g_my_boot_token, p.epoch, 0,
-                     p.recv_seq.load(std::memory_order_relaxed)};
+                     g_my_boot_token, st.epoch,
+                     static_cast<uint32_t>(si),
+                     link_recv_watermark(p)};
     iovec hi[1] = {{&hello, sizeof(hello)}};
     if (nb_write_all(fd, hi, 1, dl) != IoStatus::kOk) {
       ::close(fd);
@@ -1922,29 +2822,33 @@ void dial_repair(int peer) {
     }
     if (!rep.ok) {
       ::close(fd);
-      escalate_link(peer, "peer rejected the reconnect handshake");
+      escalate_stripe(peer, si, "peer rejected the reconnect handshake");
       return;
     }
     {
       // adopt the acceptor's epoch: ours may have fallen behind if a
       // previous repair's reply was lost to a second drop, and both
       // sides must enter finish_repair's bump in sync
-      std::lock_guard<std::mutex> lk(p.mu);
-      if (rep.epoch > p.epoch) p.epoch = rep.epoch;
+      std::lock_guard<std::mutex> lk(st.mu);
+      if (rep.epoch > st.epoch) st.epoch = rep.epoch;
     }
-    if (!finish_repair(peer, fd, rep.last_recv_seq, &why))
-      escalate_link(peer, why);
+    if (!finish_repair(peer, si, fd, rep.last_recv_seq, &why))
+      escalate_stripe(peer, si, why);
     return;
   }
-  escalate_link(peer, why + " after " + std::to_string(attempts) +
-                          " reconnect attempt(s) (T4J_RETRY_MAX)");
+  escalate_stripe(peer, si,
+                  why + " after " + std::to_string(attempts) +
+                      " reconnect attempt(s) (T4J_RETRY_MAX)");
 }
 
 // Passive (acceptor-side) bound: the lower rank waits for the peer's
-// re-dial; past the dialer's worst-case retry budget the link is
-// declared dead so an idle acceptor cannot sit broken forever.
-void watchdog_repair(int peer) {
+// re-dial; past the dialer's PER-STRIPE worst-case retry budget the
+// stripe is declared dead so an idle acceptor cannot sit broken
+// forever (sibling stripes keep their own budgets and their own
+// traffic).
+void watchdog_repair(int peer, int si) {
   PeerLink& p = g_peers[peer];
+  Stripe& st = p.s[si];
   Deadline dl = Deadline::after(repair_budget_s());
   // Elastic mode probes the peer's mesh listener while waiting: the
   // listener is open for the peer PROCESS's whole lifetime, so a
@@ -1954,14 +2858,14 @@ void watchdog_repair(int peer) {
   // probe only runs when an escalation could go elastic.
   Deadline next_probe = Deadline::after(0.5);
   int refused = 0;
-  std::unique_lock<std::mutex> lk(p.mu);
-  while (p.state == PeerLink::kBroken) {
+  std::unique_lock<std::mutex> lk(st.mu);
+  while (st.state == Stripe::kBroken) {
     if (g_stop.load(std::memory_order_acquire)) return;
     if (dl.expired()) {
       lk.unlock();
-      escalate_link(peer,
-                    "no reconnect from the peer within the retry "
-                    "budget — peer dead or unreachable");
+      escalate_stripe(peer, si,
+                      "no reconnect from the peer within the retry "
+                      "budget — peer dead or unreachable");
       return;
     }
     if (elastic_mode() != kElasticOff && next_probe.expired()) {
@@ -1984,55 +2888,58 @@ void watchdog_repair(int peer) {
       lk.lock();
       continue;
     }
-    p.cv.wait_for(lk, std::chrono::milliseconds(100));
+    st.cv.wait_for(lk, std::chrono::milliseconds(100));
   }
 }
 
-void mark_broken(int peer, const std::string& why) {
+void mark_stripe_broken(int peer, int si, const std::string& why) {
   if (peer < 0 || peer >= g_size || peer == g_rank) return;
   PeerLink& p = g_peers[peer];
+  if (si < 0 || si >= p.nstripes) return;
+  Stripe& st = p.s[si];
   if (g_resizing.load(std::memory_order_acquire)) {
     // an elastic resize owns every link right now: the rebuild
-    // replaces them wholesale, so per-link repair cycles would only
+    // replaces them wholesale, so per-stripe repair cycles would only
     // race it (and noisily re-establish old-epoch connections)
-    std::lock_guard<std::mutex> lk(p.mu);
-    if (p.state == PeerLink::kUp) p.state = PeerLink::kBroken;
-    p.cv.notify_all();
+    std::lock_guard<std::mutex> lk(st.mu);
+    if (st.state == Stripe::kUp) st.state = Stripe::kBroken;
+    st.cv.notify_all();
     return;
   }
   bool spawn = false;
   {
-    std::lock_guard<std::mutex> lk(p.mu);
-    if (p.state != PeerLink::kUp) return;  // a cycle is already running
-    tel::control_event(tel::kLinkBreak, peer, 0);
-    p.state = PeerLink::kBroken;
-    if (!p.repairing) {
-      p.repairing = true;
+    std::lock_guard<std::mutex> lk(st.mu);
+    if (st.state != Stripe::kUp) return;  // a cycle is already running
+    tel::control_event(tel::kLinkBreak, peer, 0, si);
+    st.state = Stripe::kBroken;
+    if (!st.repairing) {
+      st.repairing = true;
       spawn = true;
     }
   }
   // wake both directions: the blocked writer fails over to the cv
   // wait, the reader drains out and exits.  fd is only stable under
   // send_mu (finish_repair swaps it there, finalize closes it there);
-  // no caller of mark_broken holds this link's send_mu, so a blocking
-  // acquire is safe and bounded (writers on a dead fd error out fast).
+  // no caller of mark_stripe_broken holds this stripe's send_mu, so a
+  // blocking acquire is safe and bounded (writers on a dead fd error
+  // out fast).
   {
-    std::lock_guard<std::mutex> lk(p.send_mu);
-    if (p.fd >= 0) ::shutdown(p.fd, SHUT_RDWR);
+    std::lock_guard<std::mutex> lk(st.send_mu);
+    if (st.fd >= 0) ::shutdown(st.fd, SHUT_RDWR);
   }
-  p.cv.notify_all();
+  st.cv.notify_all();
   std::fprintf(stderr,
-               "r%d | t4j: link to peer r%d broke (%s) — reconnecting "
-               "(T4J_RETRY_MAX=%d)\n",
-               g_rank, peer, why.c_str(), retry_max());
+               "r%d | t4j: link to peer r%d broke (stripe %d: %s) — "
+               "reconnecting (T4J_RETRY_MAX=%d)\n",
+               g_rank, peer, si, why.c_str(), retry_max());
   std::fflush(stderr);
   if (spawn) {
     // bootstrap orientation: the higher rank dialed, so it re-dials;
     // the lower rank's accept thread answers and a watchdog bounds it
     if (g_rank > peer)
-      std::thread(dial_repair, peer).detach();
+      std::thread(dial_repair, peer, si).detach();
     else
-      std::thread(watchdog_repair, peer).detach();
+      std::thread(watchdog_repair, peer, si).detach();
   }
 }
 
@@ -2064,6 +2971,7 @@ void handle_reconnect(int fd) {
     return;
   }
   int r = static_cast<int>(hello.rank);
+  int si = static_cast<int>(hello.pad);  // dialing stripe index
   auto reject = [&]() {
     ReconReply rep{kReconMagic, 0, g_my_boot_token, 0, 0, 0};
     iovec iov[1] = {{&rep, sizeof(rep)}};
@@ -2075,6 +2983,11 @@ void handle_reconnect(int fd) {
     return;
   }
   PeerLink& p = g_peers[r];
+  if (si < 0 || si >= p.nstripes) {
+    reject();
+    return;
+  }
+  Stripe& st = p.s[si];
   if (hello.boot_token != g_endpoints[r].boot_token) {
     // a RESTARTED process re-dialing under an old identity: its
     // mailbox and comm state are gone, recovery is impossible
@@ -2085,52 +2998,52 @@ void handle_reconnect(int fd) {
                   "is unrecoverable");
     return;
   }
-  if (p.accept_busy.exchange(true)) {
-    ::close(fd);  // a handler for this link is mid-handshake already;
+  if (st.accept_busy.exchange(true)) {
+    ::close(fd);  // a handler for this stripe is mid-handshake already;
     return;       // the dialer's next attempt restarts the dance
   }
   struct ClearBusy {
     std::atomic<bool>& f;
     ~ClearBusy() { f.store(false); }
-  } clear_busy{p.accept_busy};
+  } clear_busy{st.accept_busy};
   uint32_t ep_now;
   {
-    std::lock_guard<std::mutex> lk(p.mu);
-    if (p.state == PeerLink::kDead) {
+    std::lock_guard<std::mutex> lk(st.mu);
+    if (st.state == Stripe::kDead) {
       reject();
       return;
     }
     // Any authentic (token-verified) dial is honoured, even against a
-    // link we consider healthy or with a lagging epoch: the peer runs
-    // at most ONE serial dialer per link and only dials when ITS side
-    // broke, so "stale dial against a healthy link" cannot occur — but
-    // a dialer whose previous reply was lost to a second drop (the
-    // flaky regime) legitimately arrives with an older epoch and must
-    // not be bounced into the abort path.  Epochs stay a monotonic
-    // diagnostic: adopt the newer of the two (the reply hands ours
-    // back, which the dialer adopts) so both sides re-enter
-    // finish_repair's bump in sync.
-    if (hello.epoch > p.epoch) p.epoch = hello.epoch;
-    ep_now = p.epoch;
+    // stripe we consider healthy or with a lagging epoch: the peer
+    // runs at most ONE serial dialer per stripe and only dials when
+    // ITS side broke, so "stale dial against a healthy stripe" cannot
+    // occur — but a dialer whose previous reply was lost to a second
+    // drop (the flaky regime) legitimately arrives with an older
+    // epoch and must not be bounced into the abort path.  Epochs stay
+    // a monotonic diagnostic: adopt the newer of the two (the reply
+    // hands ours back, which the dialer adopts) so both sides
+    // re-enter finish_repair's bump in sync.
+    if (hello.epoch > st.epoch) st.epoch = hello.epoch;
+    ep_now = st.epoch;
   }
   // force-break if we had not noticed the drop yet (one-sided breaks
-  // are normal: the side that wrote sees the error first); mark_broken
-  // also spawns the watchdog that bounds this handshake
-  mark_broken(r, "peer re-dialed");
+  // are normal: the side that wrote sees the error first);
+  // mark_stripe_broken also spawns the watchdog bounding the handshake
+  mark_stripe_broken(r, si, "peer re-dialed");
   {
-    std::lock_guard<std::mutex> jk(p.join_mu);
-    if (p.reader.joinable()) p.reader.join();  // finalises p.recv_seq
+    std::lock_guard<std::mutex> jk(st.join_mu);
+    if (st.reader.joinable()) st.reader.join();
   }
   ReconReply rep{kReconMagic, 1, g_my_boot_token, ep_now, 0,
-                 p.recv_seq.load(std::memory_order_relaxed)};
+                 link_recv_watermark(p)};
   iovec iov[1] = {{&rep, sizeof(rep)}};
   if (nb_write_all(fd, iov, 1, dl) != IoStatus::kOk) {
     ::close(fd);  // dialer gave up: its next attempt restarts the dance
     return;
   }
   std::string why;
-  if (!finish_repair(r, fd, hello.last_recv_seq, &why))
-    escalate_link(r, why);
+  if (!finish_repair(r, si, fd, hello.last_recv_seq, &why))
+    escalate_stripe(r, si, why);
 }
 
 // Reconnect acceptor: owns the mesh listener after bootstrap and
@@ -2508,36 +3421,74 @@ void bootstrap(const std::string& coord_host, uint16_t coord_port) {
     g_endpoints[i].boot_token = table[i].boot_token;
   }
 
-  // phase 3: full mesh -- rank i accepts from ranks > i, connects to < i.
+  // phase 3: full mesh -- rank i accepts from ranks > i, connects to
+  // < i; each pair builds T4J_STRIPES parallel connections (the
+  // striping substrate), dialed CONCURRENTLY per link so an N-stripe
+  // world does not multiply bootstrap time by N (the old serial loop
+  // would).  The 8-byte mesh hello is {rank, stripe}.
+  int nstripes = g_built_stripes;
   g_peers = std::vector<PeerLink>(g_size);
+  for (int r = 0; r < g_size; ++r)
+    if (r != g_rank) g_peers[r].alloc_stripes(nstripes);
   for (int lower = 0; lower < g_rank; ++lower) {
-    int fd = tcp_connect(g_endpoints[lower].host, g_endpoints[lower].port,
-                         "rank " + std::to_string(lower) +
-                             " mesh listener");
-    uint32_t me = static_cast<uint32_t>(g_rank);
-    boot_write(fd, &me, sizeof(me),
-               "mesh handshake with rank " + std::to_string(lower));
-    g_peers[lower].fd = fd;
+    std::vector<std::thread> dials;
+    std::mutex err_mu;
+    std::string dial_err;
+    for (int si = 0; si < nstripes; ++si) {
+      dials.emplace_back([&, lower, si] {
+        try {
+          int fd = tcp_connect(
+              g_endpoints[lower].host, g_endpoints[lower].port,
+              "rank " + std::to_string(lower) + " mesh listener (stripe " +
+                  std::to_string(si) + ")");
+          uint32_t hello[2] = {static_cast<uint32_t>(g_rank),
+                               static_cast<uint32_t>(si)};
+          boot_write(fd, hello, sizeof(hello),
+                     "mesh handshake with rank " + std::to_string(lower));
+          g_peers[lower].s[si].fd = fd;
+          stripe_enable_zc(g_peers[lower].s[si]);
+        } catch (const std::exception& e) {
+          std::lock_guard<std::mutex> lk(err_mu);
+          if (dial_err.empty()) dial_err = e.what();
+        }
+      });
+    }
+    for (auto& t : dials) t.join();
+    if (!dial_err.empty()) throw BridgeError(dial_err);
   }
-  for (int higher = g_rank + 1; higher < g_size; ++higher) {
-    Deadline dl = Deadline::after(connect_timeout());
-    int fd = tcp_accept(listen_fd, dl,
-                        "mesh connections from " +
-                            std::to_string(g_size - higher) +
-                            " higher rank(s)");
-    tune_socket(fd);
-    set_nonblock(fd);
-    uint32_t who = 0;
-    boot_read(fd, &who, sizeof(who), "mesh handshake");
-    if (static_cast<int>(who) <= g_rank || static_cast<int>(who) >= g_size)
-      fail_boot("mesh handshake claimed invalid rank " +
-                std::to_string(who));
-    g_peers[who].fd = fd;
+  {
+    int expect = (g_size - g_rank - 1) * nstripes;
+    for (int k = 0; k < expect; ++k) {
+      Deadline dl = Deadline::after(connect_timeout());
+      int fd = tcp_accept(listen_fd, dl,
+                          "mesh connections from higher ranks (" +
+                              std::to_string(expect - k) +
+                              " stripe connection(s) outstanding)");
+      tune_socket(fd);
+      set_nonblock(fd);
+      uint32_t hello[2] = {0, 0};
+      boot_read(fd, hello, sizeof(hello), "mesh handshake");
+      int who = static_cast<int>(hello[0]);
+      int si = static_cast<int>(hello[1]);
+      if (who <= g_rank || who >= g_size || si < 0 || si >= nstripes)
+        fail_boot("mesh handshake claimed invalid rank/stripe " +
+                  std::to_string(hello[0]) + "/" +
+                  std::to_string(hello[1]));
+      if (g_peers[who].s[si].fd >= 0)
+        fail_boot("duplicate mesh connection for rank " +
+                  std::to_string(who) + " stripe " + std::to_string(si));
+      g_peers[who].s[si].fd = fd;
+      stripe_enable_zc(g_peers[who].s[si]);
+    }
   }
 
   for (int p = 0; p < g_size; ++p) {
-    if (p == g_rank || g_peers[p].fd < 0) continue;
-    g_peers[p].reader = std::thread(reader_loop, p, g_peers[p].fd);
+    if (p == g_rank) continue;
+    for (int si = 0; si < g_peers[p].nstripes; ++si) {
+      Stripe& st = g_peers[p].s[si];
+      if (st.fd >= 0)
+        st.reader = std::thread(reader_loop, p, si, st.fd);
+    }
   }
   if (resilience_on()) {
     // the mesh listener stays open: broken links are re-dialed here
@@ -3071,10 +4022,33 @@ size_t seg_for(size_t dsize) {
 
 void send_segmented(Comm& c, int dest_idx, int tag, const uint8_t* p,
                     size_t nbytes, size_t seg) {
+  int wd = c.ranks[dest_idx];
+  bool piped = wd < static_cast<int>(g_tx_pipes.size()) &&
+               g_tx_pipes[wd] != nullptr;
+  if (wd == g_rank || piped) {
+    for (size_t o = 0; o < nbytes; o += seg) {
+      size_t k = nbytes - o < seg ? nbytes - o : seg;
+      csend(c, dest_idx, tag, p + o, k);
+    }
+    return;
+  }
+  // TCP: hand the whole segment run to the striped send engine in ONE
+  // call — segments deal round-robin across the stripes and small
+  // ones gather into T4J_SENDMSG_BATCH-frame sendmsg calls
+  // (docs/performance.md "striped links and the zero-copy path")
+  if (g_stop.load(std::memory_order_acquire)) raise_stopped();
+  if (nbytes == 0) return;
+  std::vector<const void*> bufs;
+  std::vector<size_t> sizes;
+  bufs.reserve(nbytes / seg + 1);
+  sizes.reserve(nbytes / seg + 1);
   for (size_t o = 0; o < nbytes; o += seg) {
     size_t k = nbytes - o < seg ? nbytes - o : seg;
-    csend(c, dest_idx, tag, p + o, k);
+    bufs.push_back(p + o);
+    sizes.push_back(k);
   }
+  link_send(wd, enc_ctx(c.ctx, /*coll=*/true), tag, bufs.data(),
+            sizes.data(), bufs.size());
 }
 
 template <typename T>
@@ -3252,6 +4226,7 @@ void multi_send(Comm& c, int tag, std::vector<RootSend>& msgs) {
 
   struct Tx {
     int wdest;
+    int stripe;
     int fd;
     WireHeader h;
     iovec iov[2];
@@ -3264,43 +4239,86 @@ void multi_send(Comm& c, int tag, std::vector<RootSend>& msgs) {
   double limit_s = effective_op_timeout();
   Deadline dl = Deadline::after(limit_s);
   // injection checks run BEFORE any send_mu is held: the flaky drop
-  // try_locks every link's send_mu, and a thread must never try_lock a
-  // mutex it already owns
+  // try_locks every stripe's send_mu, and a thread must never try_lock
+  // a mutex it already owns
   for (size_t i = 0; i < tcp.size(); ++i) maybe_inject_send_fault();
+  // deal each destination's frame onto a stripe (one frame per dest
+  // here, so the per-link round-robin advances one step per fan-out)
+  std::vector<WirePart> parts(tcp.size());
+  for (size_t i = 0; i < tcp.size(); ++i) {
+    parts[i].buf = tcp[i].p;
+    parts[i].nbytes = tcp[i].nbytes;
+    deal_frames(g_peers[c.ranks[tcp[i].dest_idx]],
+                enc_ctx(c.ctx, true), tag, &parts[i], 1, healing);
+  }
   if (healing) {
-    // park on broken links like raw_send does (also before any lock is
-    // held): without this, repeated fan-outs during one outage would
-    // keep appending to the replay ring unthrottled and could evict
-    // the unacked tail — turning a healable drop into an abort
-    for (const RootSend& m : tcp)
-      wait_link_up(c.ranks[m.dest_idx], dl, m.nbytes, tag, limit_s);
+    // park on broken stripes like link_send does (also before any lock
+    // is held): without this, repeated fan-outs during one outage
+    // would keep appending to the replay ring unthrottled and could
+    // evict the unacked tail — turning a healable drop into an abort.
+    // Striped links blind-buffer in the write loop instead of waiting.
+    for (size_t i = 0; i < tcp.size(); ++i) {
+      int wd = c.ranks[tcp[i].dest_idx];
+      if (g_peers[wd].nstripes == 1)
+        wait_stripe_up(wd, parts[i].stripe, dl, tcp[i].nbytes, tag,
+                       limit_s);
+    }
   }
   std::vector<Tx> txs(tcp.size());
   for (size_t i = 0; i < tcp.size(); ++i) {
     int wd = c.ranks[tcp[i].dest_idx];
     PeerLink& p = g_peers[wd];
-    if (p.fd < 0 && !healing)
-      fail_arg("send to unconnected peer r" + std::to_string(wd));
     Tx& t = txs[i];
     t.wdest = wd;
-    t.lk = std::unique_lock<std::mutex>(p.send_mu);
-    t.fd = p.fd;  // read under send_mu: stable while the lock is held
-    t.h = WireHeader{kMagic, static_cast<uint32_t>(g_rank),
-                     static_cast<uint32_t>(enc_ctx(c.ctx, true)),
-                     static_cast<uint32_t>(tag + 1),
-                     static_cast<uint64_t>(tcp[i].nbytes), 0,
-                     cur_epoch(), 0};
-    if (healing) {
-      t.h.seq = ++p.send_seq;
-      ring_append(p, t.h, tcp[i].p, tcp[i].nbytes);
+    for (;;) {
+      t.stripe = parts[i].stripe;
+      Stripe& st = p.s[t.stripe];
+      if (st.fd < 0 && !healing)
+        fail_arg("send to unconnected peer r" + std::to_string(wd));
+      t.lk = std::unique_lock<std::mutex>(st.send_mu);
+      if (healing && st.migrated) {
+        // the stripe died and its ring migrated between dealing and
+        // this append: buffering here would strand the frame — redeal
+        // onto a live sibling (none left = the link verdict is in)
+        t.lk.unlock();
+        std::lock_guard<std::mutex> dlk(p.deal_mu);
+        int cand = pick_live_stripe(p);
+        if (cand < 0) raise_stopped();
+        parts[i].stripe = cand;
+        continue;
+      }
+      t.fd = st.fd;  // read under send_mu: stable while the lock is held
+      t.h = parts[i].h;
+      const uint8_t* payload = tcp[i].p;
+      if (healing) {
+        Replay& rep = ring_append(st, t.h, tcp[i].p, tcp[i].nbytes);
+        // write from the arena copy: uniform with the striped path,
+        // and a broken-stripe blind-buffer needs the arena resident
+        payload = st.ring_buf.get() + rep.off;
+      }
+      throttle_stripe(st, sizeof(t.h) + tcp[i].nbytes);
+      t.iov[0] = {&t.h, sizeof(t.h)};
+      t.iov[1] = {const_cast<uint8_t*>(payload), tcp[i].nbytes};
+      t.iovcnt = tcp[i].nbytes ? 2 : 1;
+      bool broken;
+      {
+        std::lock_guard<std::mutex> slk(st.mu);
+        broken = st.state == Stripe::kBroken;
+      }
+      if (broken && healing && p.nstripes > 1) {
+        // the frame is in this stripe's ring: the repair redelivers
+        // it — the fan-out keeps moving on every other socket
+        t.done = true;
+        t.lk.unlock();
+      }
+      break;
     }
-    t.iov[0] = {&t.h, sizeof(t.h)};
-    t.iov[1] = {const_cast<uint8_t*>(tcp[i].p), tcp[i].nbytes};
-    t.iovcnt = tcp[i].nbytes ? 2 : 1;
   }
 
   dl = Deadline::after(limit_s);  // fresh window for the write phase
-  size_t remaining = txs.size();
+  size_t remaining = 0;
+  for (const Tx& t : txs)
+    if (!t.done) ++remaining;  // blind-buffered frames are already done
   std::string failure;  // set -> release all locks, then fail_op
   bool stopped = false;
   while (remaining > 0 && failure.empty() && !stopped) {
@@ -3315,14 +4333,16 @@ void multi_send(Comm& c, int tag, std::vector<RootSend>& msgs) {
         if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK)
           continue;
         if (healing) {
-          // the frame is in this link's replay ring: hand delivery to
-          // the repair cycle and keep the rest of the fan-out moving
+          // the frame is in this stripe's replay ring: hand delivery
+          // to the repair cycle and keep the rest of the fan-out
+          // moving
           int err = errno;
           t.done = true;
           t.lk.unlock();
           --remaining;
-          mark_broken(t.wdest, std::string("root send failed: ") +
-                                   std::strerror(err));
+          mark_stripe_broken(t.wdest, t.stripe,
+                             std::string("root send failed: ") +
+                                 std::strerror(err));
           progressed = true;
           continue;
         }
@@ -3347,7 +4367,7 @@ void multi_send(Comm& c, int tag, std::vector<RootSend>& msgs) {
         t.lk.unlock();
         --remaining;
         tel::trace_event(tel::kFrameTx, tel::kInstant, tel::kPlaneNone,
-                         -1, t.wdest, t.h.nbytes);
+                         t.stripe, t.wdest, t.h.nbytes);
       }
     }
     if (remaining == 0 || !failure.empty()) break;
@@ -4121,6 +5141,21 @@ void engine_run_blocking(const std::shared_ptr<AsyncOp>& op) {
   }
 }
 
+// Completion-queue reaper (docs/performance.md "striped links and the
+// zero-copy path"): the engine thread opportunistically drains every
+// stripe's MSG_ZEROCOPY errqueue between ops so the ring-eviction
+// gate rarely has to block.  try_lock only — never stall the engine
+// on a busy writer.
+void reap_all_zc() {
+  if (!g_zc_supported || zc_min_bytes() <= 0) return;
+  for (auto& p : g_peers)
+    for (int si = 0; si < p.nstripes; ++si) {
+      Stripe& st = p.s[si];
+      std::unique_lock<std::mutex> lk(st.send_mu, std::try_to_lock);
+      if (lk.owns_lock()) reap_zc(st);
+    }
+}
+
 void engine_loop() {
   tls_engine_thread = true;
   AsyncEngine& e = engine();
@@ -4230,6 +5265,9 @@ void engine_loop() {
         engine_run_blocking(next);
       }
     }
+    // reap zerocopy completions between ops (cheap; no-op when the
+    // zerocopy path is off)
+    reap_all_zc();
     // poll parked irecvs every iteration: they never block the engine
     for (size_t i = 0; i < parked.size();) {
       if (engine_try_recv(parked[i]))
@@ -4463,13 +5501,16 @@ bool send_resize_msg(int dest, const ResizeMsg& m, const PeerAddr* addr) {
 // and pre-resize mailbox frames are purged.
 void quiesce_for_resize() {
   for (auto& p : g_peers) {
-    {
-      std::lock_guard<std::mutex> lk(p.send_mu);
-      if (p.fd >= 0) ::shutdown(p.fd, SHUT_RDWR);
+    for (int si = 0; si < p.nstripes; ++si) {
+      Stripe& st = p.s[si];
+      {
+        std::lock_guard<std::mutex> lk(st.send_mu);
+        if (st.fd >= 0) ::shutdown(st.fd, SHUT_RDWR);
+      }
+      st.cv.notify_all();
+      std::lock_guard<std::mutex> jk(st.join_mu);
+      if (st.reader.joinable()) st.reader.join();
     }
-    p.cv.notify_all();
-    std::lock_guard<std::mutex> jk(p.join_mu);
-    if (p.reader.joinable()) p.reader.join();
   }
   g_pipe_readers.join_all();
   // the engine fails its queued/parked/running requests against the
@@ -4499,19 +5540,35 @@ void quiesce_for_resize() {
     }
   }
   for (auto& p : g_peers) {
-    std::lock_guard<std::mutex> slk(p.send_mu);
-    if (p.fd >= 0) {
-      ::close(p.fd);
-      p.fd = -1;
+    for (int si = 0; si < p.nstripes; ++si) {
+      Stripe& st = p.s[si];
+      std::lock_guard<std::mutex> slk(st.send_mu);
+      if (st.fd >= 0) {
+        // in-flight zerocopy sends pin the arena we are about to
+        // clear; the socket is already shut down, so completions are
+        // immediate — drain them before the reset
+        (void)zc_wait(st, st.zc_sent, Deadline::after(2.0));
+        ::close(st.fd);
+        st.fd = -1;
+      }
+      st.ring.clear();
+      st.ring_head = 0;
+      st.max_evicted_seq = 0;
+      st.migrated = false;
+      st.zc_sent = 0;
+      st.zc_done = 0;
+      st.zc_enabled = false;
+      st.seen_seq.store(0, std::memory_order_relaxed);
+      std::lock_guard<std::mutex> lk(st.mu);
+      if (st.state != Stripe::kDead) st.state = Stripe::kBroken;
+      st.repairing = false;
     }
+    std::lock_guard<std::mutex> dlk(p.deal_mu);
     p.send_seq = 0;
-    p.ring.clear();
-    p.ring_head = 0;
-    p.ring_min_seq = 1;
-    p.recv_seq.store(0, std::memory_order_relaxed);
-    std::lock_guard<std::mutex> lk(p.mu);
-    if (p.state != PeerLink::kDead) p.state = PeerLink::kBroken;
-    p.repairing = false;
+    p.dealt = 0;
+    std::lock_guard<std::mutex> rlk(p.ro_mu);
+    p.delivered = 0;
+    p.reorder.clear();
   }
   {
     std::lock_guard<std::mutex> lk(g_mail_mu);
@@ -4534,8 +5591,13 @@ void apply_membership(uint64_t final_alive, uint32_t epoch, int grow_rank,
                  "r%d | t4j: rank r%d left the world at epoch %u\n",
                  g_rank, r, epoch);
     PeerLink& p = g_peers[r];
-    std::lock_guard<std::mutex> lk(p.mu);
-    p.state = PeerLink::kDead;
+    for (int si = 0; si < p.nstripes; ++si) {
+      std::lock_guard<std::mutex> lk(p.s[si].mu);
+      p.s[si].state = Stripe::kDead;
+    }
+    p.dead_mask.store(
+        p.nstripes >= 32 ? ~0u : ((1u << p.nstripes) - 1),
+        std::memory_order_relaxed);
   }
   std::fflush(stderr);
   if (grow_rank >= 0 && grow_addr) {
@@ -4548,8 +5610,11 @@ void apply_membership(uint64_t final_alive, uint32_t epoch, int grow_rank,
     if (grow_rank < static_cast<int>(g_host_fps.size()))
       g_host_fps[grow_rank] = grow_addr->host_fp;
     PeerLink& p = g_peers[grow_rank];
-    std::lock_guard<std::mutex> lk(p.mu);
-    p.state = PeerLink::kBroken;  // rebuilt below like every survivor
+    for (int si = 0; si < p.nstripes; ++si) {
+      std::lock_guard<std::mutex> lk(p.s[si].mu);
+      p.s[si].state = Stripe::kBroken;  // rebuilt like every survivor
+    }
+    p.dead_mask.store(0, std::memory_order_relaxed);
   }
   g_alive_mask.store(final_alive, std::memory_order_relaxed);
   g_world_epoch.store(epoch, std::memory_order_release);
@@ -4569,41 +5634,52 @@ void apply_membership(uint64_t final_alive, uint32_t epoch, int grow_rank,
   g_comms.push_back(world);
 }
 
-// Install a freshly handshaken link (reader started separately once
-// the stop clears — a reader started under g_stop would exit at once).
-void install_link(int r, int fd) {
+// Install a freshly handshaken stripe connection (reader started
+// separately once the stop clears — a reader started under g_stop
+// would exit at once).  The LINK-level dealing/delivery cursors were
+// already reset by quiesce_for_resize; marking the last stripe kUp is
+// what flips the link live.
+void install_link(int r, int si, int fd) {
   PeerLink& p = g_peers[r];
+  Stripe& st = p.s[si];
   {
-    std::lock_guard<std::mutex> lk(p.send_mu);
-    if (p.fd >= 0) ::shutdown(p.fd, SHUT_RDWR);
+    std::lock_guard<std::mutex> lk(st.send_mu);
+    if (st.fd >= 0) ::shutdown(st.fd, SHUT_RDWR);
   }
   {
-    std::lock_guard<std::mutex> jk(p.join_mu);
-    if (p.reader.joinable()) p.reader.join();
+    std::lock_guard<std::mutex> jk(st.join_mu);
+    if (st.reader.joinable()) st.reader.join();
   }
   {
-    std::lock_guard<std::mutex> slk(p.send_mu);
-    if (p.fd >= 0) ::close(p.fd);
-    p.fd = fd;
-    p.send_seq = 0;
-    p.ring.clear();
-    p.ring_head = 0;
-    p.ring_min_seq = 1;
-    p.recv_seq.store(0, std::memory_order_relaxed);
-    std::lock_guard<std::mutex> lk(p.mu);
-    p.state = PeerLink::kUp;
-    ++p.epoch;
+    std::lock_guard<std::mutex> slk(st.send_mu);
+    if (st.fd >= 0) ::close(st.fd);
+    st.fd = fd;
+    st.ring.clear();
+    st.ring_head = 0;
+    st.max_evicted_seq = 0;
+    st.migrated = false;
+    st.zc_sent = 0;
+    st.zc_done = 0;
+    stripe_enable_zc(st);
+    st.seen_seq.store(0, std::memory_order_relaxed);
+    std::lock_guard<std::mutex> lk(st.mu);
+    st.state = Stripe::kUp;
+    ++st.epoch;
   }
-  p.cv.notify_all();
+  p.dead_mask.fetch_and(~(1u << si), std::memory_order_relaxed);
+  st.cv.notify_all();
 }
 
 void start_reader(int r) {
   PeerLink& p = g_peers[r];
-  std::lock_guard<std::mutex> slk(p.send_mu);
-  if (p.fd < 0) return;
-  std::lock_guard<std::mutex> jk(p.join_mu);
-  if (!p.reader.joinable())
-    p.reader = std::thread(reader_loop, r, p.fd);
+  for (int si = 0; si < p.nstripes; ++si) {
+    Stripe& st = p.s[si];
+    std::lock_guard<std::mutex> slk(st.send_mu);
+    if (st.fd < 0) continue;
+    std::lock_guard<std::mutex> jk(st.join_mu);
+    if (!st.reader.joinable())
+      st.reader = std::thread(reader_loop, r, si, st.fd);
+  }
 }
 
 void start_readers(uint64_t alive) {
@@ -4612,8 +5688,10 @@ void start_readers(uint64_t alive) {
 }
 
 // Dialer side of the pair-by-pair link rebuild (bootstrap
-// orientation: the higher rank dials the lower rank's mesh listener).
-bool rebuild_dial(int r, uint32_t epoch, const Deadline& dl) {
+// orientation: the higher rank dials the lower rank's mesh listener),
+// one handshake per stripe — the dial's ResizeMsg carries the stripe
+// index in `mask`.
+bool rebuild_dial(int r, int si, uint32_t epoch, const Deadline& dl) {
   std::string why = "dial failed";
   int attempt = 0;
   while (!dl.expired()) {
@@ -4626,15 +5704,15 @@ bool rebuild_dial(int r, uint32_t epoch, const Deadline& dl) {
     if (fd >= 0) {
       Deadline io = Deadline::after(connect_timeout());
       ResizeMsg m{kResizeMagic, kResizeDial,
-                  static_cast<uint32_t>(g_rank), epoch, 0,
-                  g_my_boot_token};
+                  static_cast<uint32_t>(g_rank), epoch,
+                  static_cast<uint64_t>(si), g_my_boot_token};
       iovec iov[1] = {{&m, sizeof(m)}};
       ResizeMsg ack{};
       if (nb_write_all(fd, iov, 1, io, true) == IoStatus::kOk &&
           nb_read_all(fd, &ack, sizeof(ack), io, true) == IoStatus::kOk &&
           ack.magic == kResizeMagic && ack.type == kResizeAck &&
           ack.mask == 1 && ack.epoch == epoch) {
-        install_link(r, fd);
+        install_link(r, si, fd);
         return true;
       }
       ::close(fd);
@@ -4646,24 +5724,38 @@ bool rebuild_dial(int r, uint32_t epoch, const Deadline& dl) {
 }
 
 // Rebuild every surviving pair's TCP link at `epoch`: dial the lower
-// alive ranks, wait for the higher ones to dial us (their handshakes
-// are answered by handle_resize_msg on the accept thread).
+// alive ranks (every stripe of a link concurrently — the bootstrap
+// bugfix applies here too), wait for the higher ones to dial us
+// (their handshakes are answered by handle_resize_msg on the accept
+// thread).
 bool rebuild_links(uint64_t alive, uint32_t epoch) {
   Deadline dl = Deadline::after(resize_timeout() + connect_timeout());
   for (int r = 0; r < g_rank && r < 64; ++r) {
     if (!((alive >> r) & 1)) continue;
-    if (!rebuild_dial(r, epoch, dl)) return false;
+    int ns = g_peers[r].nstripes;
+    std::vector<std::thread> dials;
+    std::atomic<int> ok{0};
+    for (int si = 0; si < ns; ++si)
+      dials.emplace_back([&, r, si] {
+        if (rebuild_dial(r, si, epoch, dl))
+          ok.fetch_add(1, std::memory_order_relaxed);
+      });
+    for (auto& t : dials) t.join();
+    if (ok.load(std::memory_order_relaxed) != ns) return false;
   }
   for (int r = g_rank + 1; r < g_size && r < 64; ++r) {
     if (!((alive >> r) & 1)) continue;
     PeerLink& p = g_peers[r];
-    std::unique_lock<std::mutex> lk(p.mu);
-    while (p.state != PeerLink::kUp) {
-      if (dl.expired() ||
-          g_shutting_down.load(std::memory_order_acquire) ||
-          g_faulted.load(std::memory_order_acquire))
-        return false;
-      p.cv.wait_for(lk, std::chrono::milliseconds(100));
+    for (int si = 0; si < p.nstripes; ++si) {
+      Stripe& st = p.s[si];
+      std::unique_lock<std::mutex> lk(st.mu);
+      while (st.state != Stripe::kUp) {
+        if (dl.expired() ||
+            g_shutting_down.load(std::memory_order_acquire) ||
+            g_faulted.load(std::memory_order_acquire))
+          return false;
+        st.cv.wait_for(lk, std::chrono::milliseconds(100));
+      }
     }
   }
   return true;
@@ -5010,7 +6102,8 @@ void enter_resize(uint64_t dead_delta, const std::string& why) {
   g_stop.store(true, std::memory_order_release);
   wake_all_pipes();
   wake_async_engine();
-  for (auto& p : g_peers) p.cv.notify_all();
+  for (auto& p : g_peers)
+    for (int si = 0; si < p.nstripes; ++si) p.s[si].cv.notify_all();
   std::thread(resize_main_guarded).detach();
 }
 
@@ -5061,8 +6154,10 @@ void handle_resize_msg(int fd, const ResizeMsg& m) {
       break;
     }
     case kResizeDial: {
-      // link-rebuild handshake: answer once OUR membership reaches
-      // the dial's epoch (the verdict may still be in flight here)
+      // link-rebuild handshake (the dial's `mask` carries the stripe
+      // index): answer once OUR membership reaches the dial's epoch
+      // (the verdict may still be in flight here)
+      int si = static_cast<int>(m.mask);
       bool accept_dial = m.token != 0;
       {
         std::unique_lock<std::mutex> lk(g_resize.mu);
@@ -5073,7 +6168,8 @@ void handle_resize_msg(int fd, const ResizeMsg& m) {
       }
       accept_dial = accept_dial && cur_epoch() == m.epoch &&
                     rank_alive(r) &&
-                    m.token == g_endpoints[r].boot_token;
+                    m.token == g_endpoints[r].boot_token &&
+                    si >= 0 && si < g_peers[r].nstripes;
       ResizeMsg ack{kResizeMagic, kResizeAck,
                     static_cast<uint32_t>(g_rank), cur_epoch(),
                     accept_dial ? 1ull : 0ull, g_my_boot_token};
@@ -5084,9 +6180,9 @@ void handle_resize_msg(int fd, const ResizeMsg& m) {
         ::close(fd);
         return;
       }
-      install_link(r, fd);
+      install_link(r, si, fd);
       if (!g_stop.load(std::memory_order_acquire)) start_reader(r);
-      return;  // fd now owned by the link
+      return;  // fd now owned by the stripe
     }
     default:
       break;
@@ -5228,9 +6324,17 @@ void rejoin_bootstrap(const std::string& coord_host, uint16_t coord_port) {
   g_peers = std::vector<PeerLink>(g_size);
   for (int r = 0; r < g_size; ++r) {
     if (r == g_rank) continue;
-    std::lock_guard<std::mutex> lk(g_peers[r].mu);
-    g_peers[r].state =
-        rank_alive(r) ? PeerLink::kBroken : PeerLink::kDead;
+    PeerLink& p = g_peers[r];
+    p.alloc_stripes(g_built_stripes);
+    bool alive = rank_alive(r);
+    for (int si = 0; si < p.nstripes; ++si) {
+      std::lock_guard<std::mutex> lk(p.s[si].mu);
+      p.s[si].state = alive ? Stripe::kBroken : Stripe::kDead;
+    }
+    if (!alive)
+      p.dead_mask.store(
+          p.nstripes >= 32 ? ~0u : ((1u << p.nstripes) - 1),
+          std::memory_order_relaxed);
   }
   g_listen_fd = listen_fd;
   g_accept_thread.v.emplace_back(accept_loop);
@@ -5391,11 +6495,28 @@ bool link_stats(int peer, LinkStats* out) {
       static_cast<int>(g_peers.size()) != g_size)
     return false;
   auto one = [](PeerLink& p, LinkStats* s) {
-    s->reconnects = p.reconnects.load(std::memory_order_relaxed);
-    s->replayed_frames = p.replayed_frames.load(std::memory_order_relaxed);
-    s->replayed_bytes = p.replayed_bytes.load(std::memory_order_relaxed);
-    std::lock_guard<std::mutex> lk(p.mu);
-    s->state = static_cast<int>(p.state);
+    // a LINK's counters are the sum over its stripes; its state is
+    // derived stripe-wise — dead only when EVERY stripe is dead,
+    // broken when any stripe is not up (docs/failure-semantics.md
+    // "per-stripe replay and escalation")
+    s->reconnects = 0;
+    s->replayed_frames = 0;
+    s->replayed_bytes = 0;
+    int up = 0, dead = 0;
+    for (int si = 0; si < p.nstripes; ++si) {
+      Stripe& st = p.s[si];
+      s->reconnects += st.reconnects.load(std::memory_order_relaxed);
+      s->replayed_frames +=
+          st.replayed_frames.load(std::memory_order_relaxed);
+      s->replayed_bytes +=
+          st.replayed_bytes.load(std::memory_order_relaxed);
+      std::lock_guard<std::mutex> lk(st.mu);
+      if (st.state == Stripe::kUp) ++up;
+      else if (st.state == Stripe::kDead) ++dead;
+    }
+    if (p.nstripes > 0 && dead == p.nstripes) s->state = 2;
+    else if (up == p.nstripes) s->state = 0;
+    else s->state = 1;
   };
   if (peer < 0) {  // aggregate over every link
     LinkStats total{0, 0, 0, 0};
@@ -5414,6 +6535,54 @@ bool link_stats(int peer, LinkStats* out) {
   if (peer >= g_size || peer == g_rank) return false;
   one(g_peers[peer], out);
   return true;
+}
+
+bool link_stripe_stats(int peer, int stripe, LinkStats* out) {
+  if (!out || !g_initialized ||
+      static_cast<int>(g_peers.size()) != g_size)
+    return false;
+  if (peer < 0 || peer >= g_size || peer == g_rank) return false;
+  PeerLink& p = g_peers[peer];
+  if (stripe < 0 || stripe >= p.nstripes) return false;
+  Stripe& st = p.s[stripe];
+  out->reconnects = st.reconnects.load(std::memory_order_relaxed);
+  out->replayed_frames =
+      st.replayed_frames.load(std::memory_order_relaxed);
+  out->replayed_bytes = st.replayed_bytes.load(std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lk(st.mu);
+  out->state = static_cast<int>(st.state);
+  return true;
+}
+
+void set_wire(int stripes, long long zc_min, int batch,
+              long long emu_flow_bps_v) {
+  // stripes: >= 1 sets the dealing width (clamped to the built width
+  // after init and kMaxStripes always), <= 0 keeps — pre-init it also
+  // fixes the number of connections bootstrap builds per link.
+  // zc_min: < 0 keeps, 0 disables MSG_ZEROCOPY, > 0 sets the opt-in
+  // floor.  batch: >= 1 sets the frames-per-sendmsg cap, <= 0 keeps.
+  // emu_flow_bps: < 0 keeps, 0 disables, > 0 sets (bytes/second).
+  // Must be uniform across ranks like the other data-plane knobs.
+  if (stripes >= 1) {
+    if (stripes > kMaxStripes) stripes = kMaxStripes;
+    g_wire_stripes.store(stripes, std::memory_order_relaxed);
+  }
+  if (zc_min >= 0) g_zc_min_bytes.store(zc_min, std::memory_order_relaxed);
+  if (batch >= 1) g_sendmsg_batch.store(batch, std::memory_order_relaxed);
+  if (emu_flow_bps_v >= 0)
+    g_emu_flow_bps.store(emu_flow_bps_v, std::memory_order_relaxed);
+}
+
+void wire_info(WireInfo* out) {
+  if (!out) return;
+  out->stripes_built = g_initialized ? g_built_stripes : requested_stripes();
+  out->stripes_active = active_stripes();
+  out->zc_min_bytes = zc_min_bytes();
+  out->sendmsg_batch = sendmsg_batch();
+  out->emu_flow_bps = emu_flow_bps();
+  out->zerocopy = g_zc_supported && zc_min_bytes() > 0;
+  out->zc_completions = g_zc_completions.load(std::memory_order_relaxed);
+  out->zc_copied = g_zc_copied.load(std::memory_order_relaxed);
 }
 
 bool topology(TopoInfo* out) {
@@ -5830,6 +6999,27 @@ int init_from_env() {
                    std::strcmp(rejoin_s, "0") != 0 &&
                    elastic_mode() == kElasticRejoin && g_rank != 0 &&
                    g_size > 1 && g_size <= 64;
+  // Wire path (docs/performance.md "striped links and the zero-copy
+  // path"), fixed while still single-threaded: the per-link connection
+  // count bootstrap builds, and whether MSG_ZEROCOPY is usable at all.
+  // An unsupported-kernel zerocopy request degrades LOUDLY to the copy
+  // path instead of failing the job — the knob is a perf opt-in, not a
+  // correctness contract.
+  g_built_stripes = requested_stripes();
+  if (zc_min_bytes() > 0) {
+    g_zc_supported = probe_zerocopy_support();
+    if (!g_zc_supported) {
+      std::fprintf(stderr,
+                   "r%d | t4j: T4J_ZEROCOPY_MIN_BYTES=%lld requested "
+                   "but this kernel does not honour SO_ZEROCOPY — "
+                   "degrading to the copy path "
+                   "(docs/performance.md \"striped links and the "
+                   "zero-copy path\")\n",
+                   g_rank, zc_min_bytes());
+      std::fflush(stderr);
+      g_zc_min_bytes.store(0, std::memory_order_relaxed);
+    }
+  }
   parse_fault_plan();
   if (fault_armed(FaultPlan::kRefuse)) {
     // connect-failure injection: never join the bootstrap, so every
@@ -6008,24 +7198,28 @@ void finalize() {
   // mid-swap: any repair that completes after this point re-checked
   // g_stop, and any that completed before left its fresh fd here to be
   // shut down.
-  for (auto& p : g_peers) {
-    {
-      std::lock_guard<std::mutex> lk(p.send_mu);
-      if (p.fd >= 0) ::shutdown(p.fd, SHUT_RDWR);
+  for (auto& p : g_peers)
+    for (int si = 0; si < p.nstripes; ++si) {
+      Stripe& st = p.s[si];
+      {
+        std::lock_guard<std::mutex> lk(st.send_mu);
+        if (st.fd >= 0) ::shutdown(st.fd, SHUT_RDWR);
+      }
+      st.cv.notify_all();
+      std::lock_guard<std::mutex> jk(st.join_mu);
+      if (st.reader.joinable()) st.reader.join();
     }
-    p.cv.notify_all();
-    std::lock_guard<std::mutex> jk(p.join_mu);
-    if (p.reader.joinable()) p.reader.join();
-  }
-  for (auto& p : g_peers) {
-    // under send_mu: a straggling detached repair handler may still
-    // read p.fd (its finish_repair bails on g_stop under this lock)
-    std::lock_guard<std::mutex> lk(p.send_mu);
-    if (p.fd >= 0) {
-      ::close(p.fd);
-      p.fd = -1;
+  for (auto& p : g_peers)
+    for (int si = 0; si < p.nstripes; ++si) {
+      Stripe& st = p.s[si];
+      // under send_mu: a straggling detached repair handler may still
+      // read st.fd (its finish_repair bails on g_stop under this lock)
+      std::lock_guard<std::mutex> lk(st.send_mu);
+      if (st.fd >= 0) {
+        ::close(st.fd);
+        st.fd = -1;
+      }
     }
-  }
   // flight recorder: mark the clean exit so a postmortem never
   // mistakes this rank's file for a hard death (the mapping itself
   // stays live — teardown-phase events keep landing in it)

@@ -80,6 +80,41 @@
 // stalled local rank (leader or not) surfaces on every survivor as a
 // contextual BridgeError within the op deadline.
 //
+// Striped multi-connection links (docs/performance.md "striped links
+// and the zero-copy path"): each TCP peer link is backed by N parallel
+// connections ("stripes").  Frames — the existing segment/pipelining
+// unit — are dealt round-robin across the stripes under one per-link
+// sequence counter; the receiver delivers them back into per-link
+// order through a reorder stage, so MPI matching semantics are
+// untouched.  Self-healing is per stripe: each stripe keeps its own
+// replay ring and reconnect cycle, so one dropped flow repairs and
+// replays alone while its siblings keep moving; a stripe that
+// exhausts its retry budget migrates its unacked tail onto a live
+// sibling, and the LINK is dead only when every stripe is.  Syscalls
+// batch: runs of small frames to one stripe ride a single
+// sendmsg/iovec gather (T4J_SENDMSG_BATCH frames per call) and the
+// readers drain through a scatter buffer; large frames additionally
+// opt into MSG_ZEROCOPY (completion-queue reaping bounds replay-arena
+// reuse), making the replay-arena copy the only copy on the large
+// path — and no copy at all with T4J_RETRY_MAX=0.  Knobs (validated
+// in utils/config.py; uniform across ranks):
+//   T4J_STRIPES             connections per link (default auto = 1
+//                           until the calibrator learns better; the
+//                           built width is fixed at bootstrap, the
+//                           DEALING width can be lowered/raised up to
+//                           it at runtime via t4j_set_wire)
+//   T4J_ZEROCOPY_MIN_BYTES  frames at or above this use MSG_ZEROCOPY
+//                           (0 = off, the default; degrades loudly to
+//                           the copy path on kernels without
+//                           SO_ZEROCOPY)
+//   T4J_SENDMSG_BATCH       max frames gathered into one sendmsg
+//                           (default 8)
+//   T4J_EMU_FLOW_BPS        testing: per-connection token-bucket
+//                           throttle, bytes/second (0 = off) — lets a
+//                           loopback box demonstrate the multi-flow
+//                           busbw step real fabrics get from multiple
+//                           NIC queues
+//
 // Async progress engine (docs/async.md): nonblocking
 // iallreduce/isend/irecv/ireduce_scatter return a request handle
 // immediately; a dedicated progress thread (grown out of the PR-5
@@ -199,6 +234,37 @@ void set_hier(int mode, long long min_bytes);
 void set_resilience(int retry, double base_s, double max_s,
                     long long replay);
 
+// Override the env-derived wire-path knobs (striping / syscall
+// batching / zerocopy; header comment above).  stripes: >= 1 sets the
+// dealing width (clamped to the built width after init, and to
+// kMaxStripes always), <= 0 keeps.  Before init it also sets the
+// number of connections bootstrap builds per link.  zc_min: < 0
+// keeps, 0 disables MSG_ZEROCOPY, > 0 sets the opt-in floor.  batch:
+// >= 1 sets the frames-per-sendmsg gather cap, <= 0 keeps.
+// emu_flow_bps: < 0 keeps, 0 disables the per-connection throttle,
+// > 0 sets it (bytes/second).  Must be uniform across ranks
+// (utils/config.py owns validation).
+void set_wire(int stripes, long long zc_min, int batch,
+              long long emu_flow_bps);
+
+// Effective wire-path state for introspection/benchmark labels.
+struct WireInfo {
+  int stripes_built;    // connections per link (fixed at bootstrap)
+  int stripes_active;   // current dealing width (<= built)
+  long long zc_min_bytes;
+  int sendmsg_batch;
+  long long emu_flow_bps;
+  bool zerocopy;        // requested AND the kernel honours SO_ZEROCOPY
+  // completion diagnostics: how many MSG_ZEROCOPY sends completed,
+  // and how many of those the kernel reported as COPIED anyway
+  // (SO_EE_CODE_ZEROCOPY_COPIED — loopback always; real NIC paths
+  // should not, and a copied~completions ratio near 1 means the
+  // fabric pays pin overhead for no copy saved)
+  unsigned long long zc_completions;
+  unsigned long long zc_copied;
+};
+void wire_info(WireInfo* out);
+
 // -- elastic world membership (docs/failure-semantics.md "elastic
 // membership") --------------------------------------------------------------
 // When a rank is declared unrecoverable (its link exhausted the
@@ -262,8 +328,13 @@ struct LinkStats {
 };
 // peer >= 0: that link's counters (false for self/out-of-range).
 // peer < 0: aggregate over every link, state = worst.  False before
-// init.
+// init.  With striping a LINK's counters are the sum over its
+// stripes, and its state derives stripe-wise: dead only when EVERY
+// stripe is dead, broken when any stripe is down.
 bool link_stats(int peer, LinkStats* out);
+// One stripe's counters/state (docs/performance.md "striped links"):
+// false for self/out-of-range peer or stripe index, or before init.
+bool link_stripe_stats(int peer, int stripe, LinkStats* out);
 
 // World-level topology discovered at bootstrap (host fingerprints).
 // host_id is the ordinal of this rank's host in first-occurrence

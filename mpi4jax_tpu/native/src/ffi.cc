@@ -688,6 +688,39 @@ void t4j_set_resilience(int32_t retry_max, double backoff_base_s,
   t4j::set_resilience(retry_max, backoff_base_s, backoff_max_s,
                       replay_bytes);
 }
+// Wire-path knobs (docs/performance.md "striped links and the
+// zero-copy path"): stripes >= 1 sets the dealing width (pre-init it
+// also fixes the connections bootstrap builds per link), <= 0 keeps;
+// zc_min < 0 keeps, 0 disables MSG_ZEROCOPY, > 0 sets the opt-in
+// floor; batch >= 1 sets the frames-per-sendmsg gather cap; emu_flow
+// < 0 keeps, 0 disables the per-connection test throttle, > 0 sets it
+// (bytes/second).  Must be uniform across ranks; utils/config.py owns
+// validation.
+void t4j_set_wire(int32_t stripes, int64_t zc_min, int32_t batch,
+                  int64_t emu_flow_bps) {
+  t4j::set_wire(stripes, zc_min, batch, emu_flow_bps);
+}
+// Effective wire-path state: built/active stripe width, zerocopy
+// floor + whether the kernel honours it, sendmsg batch, throttle,
+// and the zerocopy completion diagnostics (completions reaped /
+// kernel-copied-anyway — loopback reports copied~completions).
+// Returns 1 always (pre-init it reports the requested values).
+int32_t t4j_wire_info(int32_t* stripes_built, int32_t* stripes_active,
+                      int64_t* zc_min, int32_t* batch,
+                      int64_t* emu_flow_bps, int32_t* zerocopy,
+                      uint64_t* zc_completions, uint64_t* zc_copied) {
+  t4j::WireInfo w;
+  t4j::wire_info(&w);
+  if (stripes_built) *stripes_built = w.stripes_built;
+  if (stripes_active) *stripes_active = w.stripes_active;
+  if (zc_min) *zc_min = w.zc_min_bytes;
+  if (batch) *batch = w.sendmsg_batch;
+  if (emu_flow_bps) *emu_flow_bps = w.emu_flow_bps;
+  if (zerocopy) *zerocopy = w.zerocopy ? 1 : 0;
+  if (zc_completions) *zc_completions = w.zc_completions;
+  if (zc_copied) *zc_copied = w.zc_copied;
+  return 1;
+}
 // Elastic membership knobs (docs/failure-semantics.md "elastic
 // membership"): mode 0 off, 1 shrink, 2 rejoin (other values keep);
 // min_world >= 1 sets; resize_timeout_s > 0 sets.  Must be set before
@@ -727,6 +760,21 @@ int32_t t4j_link_stats(int32_t peer, uint64_t* reconnects,
                        uint64_t* replayed_bytes, int32_t* state) {
   t4j::LinkStats s;
   if (!t4j::link_stats(peer, &s)) return 0;
+  if (reconnects) *reconnects = s.reconnects;
+  if (replayed_frames) *replayed_frames = s.replayed_frames;
+  if (replayed_bytes) *replayed_bytes = s.replayed_bytes;
+  if (state) *state = s.state;
+  return 1;
+}
+// One stripe's reconnect/replay counters + state (0 up, 1 broken,
+// 2 dead).  Returns 1 when filled, 0 before init or for an invalid
+// peer/stripe index (docs/performance.md "striped links").
+int32_t t4j_link_stripe_stats(int32_t peer, int32_t stripe,
+                              uint64_t* reconnects,
+                              uint64_t* replayed_frames,
+                              uint64_t* replayed_bytes, int32_t* state) {
+  t4j::LinkStats s;
+  if (!t4j::link_stripe_stats(peer, stripe, &s)) return 0;
   if (reconnects) *reconnects = s.reconnects;
   if (replayed_frames) *replayed_frames = s.replayed_frames;
   if (replayed_bytes) *replayed_bytes = s.replayed_bytes;

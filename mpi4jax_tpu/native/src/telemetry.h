@@ -81,7 +81,11 @@
 namespace t4j {
 namespace tel {
 
-constexpr uint32_t kSchemaVersion = 1;
+// v2: frame_tx/frame_rx and the link control events (break/reconnect/
+// replay/link_dead) carry the STRIPE index in the previously unused
+// `comm` field (-1 = unstriped/unknown; docs/performance.md "striped
+// links").  The 32-byte record layout itself is unchanged.
+constexpr uint32_t kSchemaVersion = 2;
 
 enum Mode : int { kOff = 0, kCounters = 1, kTrace = 2 };
 
@@ -543,10 +547,14 @@ inline void trace_event(Kind kind, Phase phase, Plane plane, int comm,
 
 // Control-plane record (link break/reconnect/replay/fault): rare and
 // vital, recorded from counters mode up so post-mortems always carry
-// them (runtime.check_health reports the tail of the ring).
-inline void control_event(Kind kind, int peer, uint64_t bytes) {
+// them (runtime.check_health reports the tail of the ring).  `stripe`
+// rides the comm field for the per-link events (schema v2; -1 =
+// unstriped/unknown) so t4j-diagnose can attribute a repair window to
+// ONE slow stripe instead of blaming the whole link.
+inline void control_event(Kind kind, int peer, uint64_t bytes,
+                          int stripe = -1) {
   if (mode() < kCounters) return;
-  emit(kind, kInstant, kPlaneCtrl, -1, peer, bytes);
+  emit(kind, kInstant, kPlaneCtrl, stripe, peer, bytes);
 }
 
 // Step-boundary record (ops.step.annotate_step via t4j_annotate_step):

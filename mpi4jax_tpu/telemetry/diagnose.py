@@ -157,7 +157,7 @@ class RankView:
         self.engine_lanes = set()  # lanes carrying engine lifecycle
         self.frame_tx = {}     # peer -> [t, ...]
         self.frame_rx = {}     # peer -> [t, ...]
-        self.ctrl = []         # (t, kind_name, peer)
+        self.ctrl = []         # (t, kind_name, peer, stripe-or-None)
         self.step_problems = []
         self.last_t = 0
         self.link_stats = {}
@@ -196,7 +196,9 @@ class RankView:
             ts.sort()
         for ts in self.frame_rx.values():
             ts.sort()
-        self.ctrl.sort()
+        # key excludes the stripe: None (unstriped) and int stripes
+        # may share a timestamp and must not be compared to each other
+        self.ctrl.sort(key=lambda c: (c[0], c[1], c[2]))
 
 
 def rank_view_from_obj(obj):
@@ -255,7 +257,8 @@ def rank_view_from_obj(obj):
             if e.peer >= 0:
                 view.frame_rx.setdefault(e.peer, []).append(t)
         elif e.kind in schema.CONTROL_KINDS:
-            view.ctrl.append((t, schema.kind_name(e.kind), e.peer))
+            view.ctrl.append((t, schema.kind_name(e.kind), e.peer,
+                              schema.event_stripe(e)))
     # python lane: spans + step names
     py_stack = {}
     for t_ns, op, phase, nbytes in obj.get("py_events", ()):
@@ -362,7 +365,13 @@ def rank_views_from_trace(trace_obj):
         elif name == "frame_rx" and int(args.get("peer", -1)) >= 0:
             view.frame_rx.setdefault(int(args["peer"]), []).append(t)
         elif kind in schema.CONTROL_KINDS:
-            view.ctrl.append((t, name, int(args.get("peer", -1))))
+            # trace args carry the raw comm field, which holds the
+            # stripe index for the per-link control kinds (schema v2)
+            comm = int(args.get("comm", -1))
+            stripe = (comm if kind in schema.STRIPE_COMM_KINDS
+                      and comm >= 0 else None)
+            view.ctrl.append((t, name, int(args.get("peer", -1)),
+                              stripe))
         elif name in ("op_progress", "op_complete"):
             # engine lifecycle instants mark the engine's tid: its op
             # slices are body executions, not caller-blocked time
@@ -490,12 +499,17 @@ def _tx_stall(view, lo, hi, gap_ns):
 def _ctrl_stall(view, lo, hi):
     """``(per_peer, resize_ns)`` inside [lo, hi).
 
-    ``per_peer`` is ``{peer: {"ns", "replays", "breaks"}}``: a
-    ``link_break`` opens a repair window closed by the next
-    ``reconnect`` on the same peer (or the window end — a break the
-    step never recovered from stalls it to the end).  Replay and break
-    counts are per peer too, so the links table attributes each event
-    to its own link, never the sum over all of them.
+    ``per_peer`` is ``{peer: {"ns", "replays", "breaks",
+    "by_stripe"}}``: a ``link_break`` opens a repair window closed by
+    the next ``reconnect`` on the same (peer, stripe) — striped links
+    repair per stripe (docs/performance.md "striped links"), so the
+    windows are keyed per stripe and a break on stripe 1 can never be
+    closed by stripe 0's reconnect.  ``by_stripe`` maps stripe ->
+    repair ns so the links table can name the ONE slow stripe instead
+    of blaming the whole link; ``None`` keys cover unstriped/legacy
+    events.  Replay and break counts are per peer too, so the links
+    table attributes each event to its own link, never the sum over
+    all of them.
 
     ``resize_ns`` is the time spent inside elastic-resize windows
     (``resize_begin`` → ``resize_done``, docs/failure-semantics.md
@@ -504,17 +518,17 @@ def _ctrl_stall(view, lo, hi):
     windows, so a link that broke because the whole world was resizing
     is never misbinned as that link's repair time."""
     open_break = {}
-    repair_ivs = {}  # peer -> [(t0, t1)]
+    repair_ivs = {}  # (peer, stripe) -> [(t0, t1)]
     per_peer = {}
     resize_open = None
     resize_ivs = []
 
     def rec(peer):
         return per_peer.setdefault(
-            peer, {"ns": 0, "replays": 0, "breaks": 0}
+            peer, {"ns": 0, "replays": 0, "breaks": 0, "by_stripe": {}}
         )
 
-    for t, kind, peer in view.ctrl:
+    for t, kind, peer, stripe in view.ctrl:
         if t < lo or t > hi:
             continue
         if kind == "resize_begin":
@@ -529,22 +543,25 @@ def _ctrl_stall(view, lo, hi):
                 resize_ivs.append((lo, t))
         elif kind == "link_break":
             rec(peer)["breaks"] += 1
-            open_break.setdefault(peer, t)
-        elif kind == "reconnect" and peer in open_break:
-            repair_ivs.setdefault(peer, []).append(
-                (open_break.pop(peer), t)
+            open_break.setdefault((peer, stripe), t)
+        elif kind == "reconnect" and (peer, stripe) in open_break:
+            repair_ivs.setdefault((peer, stripe), []).append(
+                (open_break.pop((peer, stripe)), t)
             )
         elif kind == "replay":
             rec(peer)["replays"] += 1
     if resize_open is not None:
         resize_ivs.append((resize_open, hi))
-    for peer, t0 in open_break.items():
-        repair_ivs.setdefault(peer, []).append((t0, hi))
+    for key, t0 in open_break.items():
+        repair_ivs.setdefault(key, []).append((t0, hi))
     resize_ivs = _union(resize_ivs)
     resize_ns = _total(resize_ivs)
-    for peer, ivs in repair_ivs.items():
+    for (peer, stripe), ivs in repair_ivs.items():
         ivs = _union(ivs)
-        rec(peer)["ns"] += _total(ivs) - _overlap(ivs, resize_ivs)
+        ns = _total(ivs) - _overlap(ivs, resize_ivs)
+        r = rec(peer)
+        r["ns"] += ns
+        r["by_stripe"][stripe] = r["by_stripe"].get(stripe, 0) + ns
     return per_peer, resize_ns
 
 
@@ -650,6 +667,9 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
                 rec["repair_ms"] += c["ns"] / 1e6
                 rec["replays"] += c["replays"]
                 rec["breaks"] += c["breaks"]
+                by = rec.setdefault("by_stripe", {})
+                for stripe, ns in c.get("by_stripe", {}).items():
+                    by[stripe] = by.get(stripe, 0.0) + ns / 1e6
             for (peer, op), ns in tx_per_peer_op.items():
                 rec = link_stall[(rank, peer)]
                 rec["ops"][op] = rec["ops"].get(op, 0.0) + ns / 1e6
@@ -796,6 +816,21 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
         )
         cause = ("repair" if rec["repair_ms"] > rec["pacing_ms"]
                  else "pacing")
+        # striped links repair per stripe (docs/performance.md
+        # "striped links"): when one stripe owns the repair time, the
+        # wait-cause names THAT stripe instead of blaming the link
+        by_stripe = {
+            s: round(ms, 3)
+            for s, ms in (rec.get("by_stripe") or {}).items()
+            if s is not None
+        }
+        slow_stripe = None
+        if cause == "repair" and by_stripe:
+            top = max(by_stripe, key=by_stripe.get)
+            total = sum(by_stripe.values())
+            if total > 0 and by_stripe[top] >= 0.8 * total:
+                slow_stripe = top
+                cause = f"repair (stripe {top})"
         links_out.append({
             "rank": rank,
             "peer": peer,
@@ -804,6 +839,8 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
             "replays": rec["replays"],
             "breaks": rec["breaks"],
             "cause": cause,
+            "slow_stripe": slow_stripe,
+            "repair_by_stripe": by_stripe,
             "stalled_ops": [
                 {"op": op, "ms": round(ms, 3)} for op, ms in stalled_ops
             ],
@@ -1003,7 +1040,7 @@ def render(report, max_steps=40):
         out.append("")
         out.append(
             f"  {'link':<12}{'pacing ms':>11}{'repair ms':>11}"
-            f"{'replays':>9}{'cause':>8}  stalled ops"
+            f"{'replays':>9}{'cause':>18}  stalled ops"
         )
         for link in links:
             ops = ", ".join(
@@ -1013,7 +1050,7 @@ def render(report, max_steps=40):
             out.append(
                 f"  r{link['rank']}->r{link['peer']:<8}"
                 f"{link['pacing_ms']:>11.2f}{link['repair_ms']:>11.2f}"
-                f"{link['replays']:>9}{link['cause']:>8}  {ops}"
+                f"{link['replays']:>9}{link['cause']:>18}  {ops}"
             )
     audit = report["plane_audit"]
 

@@ -77,6 +77,17 @@ def capture_runtime_state():
         eff = _tuning.effective()
     except Exception:
         eff = None
+    # the EFFECTIVE wire-path state (built/active stripe width,
+    # zerocopy arming) rides the tuning record so t4j-diagnose judges
+    # plane/stripe choices against what the job actually ran, not the
+    # env the analysis later runs in
+    wire = None
+    try:
+        from mpi4jax_tpu.native import runtime as _runtime
+
+        wire = _runtime.wire_info()
+    except Exception:
+        pass
     if eff is not None:
         _accum["tuning"] = {
             "ring_min_bytes": eff["knobs"]["ring_min_bytes"],
@@ -85,10 +96,12 @@ def capture_runtime_state():
                 eff["knobs"]["leader_ring_min_bytes"],
             "hier": eff["knobs"]["hier"],
             "coalesce_bytes": eff["knobs"]["coalesce_bytes"],
+            "stripes": eff["knobs"].get("stripes", "auto"),
             "sources": dict(eff["sources"]),
             "cache_file": eff["cache_file"],
             "fingerprint": eff["fingerprint"],
             "autotuned": bool(eff["autotuned"]),
+            "wire": wire or {},
         }
         return
     try:
@@ -100,6 +113,8 @@ def capture_runtime_state():
             "leader_ring_min_bytes": config.leader_ring_min_bytes(),
             "hier": config.hier_mode(),
             "coalesce_bytes": config.coalesce_bytes(),
+            "stripes": config.stripes(),
+            "wire": wire or {},
         }
     except Exception:
         pass

@@ -28,8 +28,23 @@ import json
 import struct
 from collections import namedtuple
 
-SCHEMA_VERSION = 1
+# v2: frame_tx/frame_rx and the link control events (link_break /
+# reconnect / replay / link_dead) carry the STRIPE index in the
+# previously unused ``comm`` field (-1 = unstriped/unknown;
+# docs/performance.md "striped links and the zero-copy path").  The
+# 32-byte record layout itself is unchanged — bump in lockstep with
+# tel::kSchemaVersion.
+SCHEMA_VERSION = 2
 RANK_FILE_SCHEMA = f"t4j-telemetry-v{SCHEMA_VERSION}"
+# Versions the READERS accept: v1 artifacts (pre-striping) remain
+# losslessly readable — the record layout is identical and v1's comm
+# field was -1 for the reinterpreted kinds, which event_stripe already
+# maps to "unstriped".  A crash postmortem of an old run must never be
+# rejected by a tooling upgrade.
+COMPAT_SCHEMA_VERSIONS = frozenset((1, SCHEMA_VERSION))
+_COMPAT_RANK_FILE_SCHEMAS = frozenset(
+    f"t4j-telemetry-v{v}" for v in COMPAT_SCHEMA_VERSIONS
+)
 
 # t_ns, kind, phase, plane, comm, peer, lane, bytes  (telemetry.h Event)
 EVENT_STRUCT = struct.Struct("<QHBBiiIQ")
@@ -126,6 +141,20 @@ def decode_async_comm(field):
     f = int(field)
     return ASYNC_OP_NAMES.get((f >> 24) & 0xFF, "?"), f & 0xFFFFFF
 
+
+# Kinds whose `comm` field carries the wire STRIPE index (schema v2):
+# the data-plane frame instants and the per-link control events.
+STRIPE_COMM_KINDS = frozenset((20, 21, 30, 31, 32, 33))
+
+
+def event_stripe(e):
+    """The stripe index an event belongs to, or ``None`` when the
+    event kind has no stripe attribution or predates striping
+    (docs/performance.md "striped links and the zero-copy path")."""
+    if int(e.kind) in STRIPE_COMM_KINDS and int(e.comm) >= 0:
+        return int(e.comm)
+    return None
+
 PHASE_INSTANT, PHASE_BEGIN, PHASE_END = 0, 1, 2
 PHASE_NAMES = {0: "instant", 1: "begin", 2: "end"}
 
@@ -194,7 +223,7 @@ def parse_snapshot(words):
         raise SchemaError("metrics snapshot shorter than its header")
     (version, n_rows, row_words, lat_buckets, lat_base, size_buckets,
      size_base, mode) = words[:SNAP_HEADER_WORDS]
-    if version != SCHEMA_VERSION:
+    if version not in COMPAT_SCHEMA_VERSIONS:
         raise SchemaError(
             f"metrics snapshot version {version} != {SCHEMA_VERSION}"
         )
@@ -245,7 +274,7 @@ def validate_rank_file(obj):
     for key in _RANK_REQUIRED:
         if key not in obj:
             raise SchemaError(f"rank file is missing {key!r}")
-    if obj["schema"] != RANK_FILE_SCHEMA:
+    if obj["schema"] not in _COMPAT_RANK_FILE_SCHEMAS:
         raise SchemaError(
             f"rank file schema {obj['schema']!r} != {RANK_FILE_SCHEMA!r}"
         )
@@ -373,6 +402,9 @@ def format_recent_events(events):
             desc += f" #{e.bytes}"
         elif e.peer >= 0:
             desc += f" peer=r{e.peer}"
+            stripe = event_stripe(e)
+            if stripe is not None:
+                desc += f"/s{stripe}"
         age_ms = (newest - e.t_ns) / 1e6
         parts.append(f"{desc} ({age_ms:.1f}ms ago)")
     return "; ".join(parts)
@@ -518,7 +550,7 @@ def parse_flight_header(buf):
         raise SchemaError(
             f"flight file version {version} != {FLIGHT_VERSION}"
         )
-    if schema_v != SCHEMA_VERSION:
+    if schema_v not in COMPAT_SCHEMA_VERSIONS:
         raise SchemaError(
             f"flight file event schema {schema_v} != {SCHEMA_VERSION}"
         )

@@ -218,11 +218,17 @@ def summarize(rank_objs, flight=None):
                 (rank, int(peer)),
                 {"bytes": 0, "frames": 0, "t_lo": 0, "t_hi": 0},
             )
+            # striped links (docs/performance.md "striped links"):
+            # keep the per-stripe breakdown so the console can show
+            # width and point at THE stripe that repaired/replayed
+            stripes = s.get("stripes") or []
             link.update(
                 reconnects=s.get("reconnects", 0),
                 replayed_frames=s.get("replayed_frames", 0),
                 replayed_bytes=s.get("replayed_bytes", 0),
                 state=s.get("state", 0),
+                stripes=len(stripes),
+                stripe_detail=stripes,
             )
         per_rank.append({
             "rank": rank,
@@ -263,6 +269,9 @@ def summarize(rank_objs, flight=None):
     link_rows = []
     for (rank, peer), link in sorted(links.items()):
         span = (link["t_hi"] - link["t_lo"]) / 1e9
+        detail = link.get("stripe_detail") or []
+        # the stripe carrying the repairs, when exactly attributable
+        hot = [i for i, s in enumerate(detail) if s.get("reconnects")]
         link_rows.append({
             "rank": rank,
             "peer": peer,
@@ -272,6 +281,9 @@ def summarize(rank_objs, flight=None):
             "reconnects": link.get("reconnects", 0),
             "replayed_frames": link.get("replayed_frames", 0),
             "state": link.get("state", 0),
+            "stripes": link.get("stripes", 0),
+            "hot_stripe": hot[0] if len(hot) == 1 else None,
+            "stripe_detail": detail,
         })
     async_out = []
     for (rank, op), v in sorted(async_rows.items()):
@@ -375,14 +387,21 @@ def render(summary):
     if summary["links"]:
         out.append("")
         out.append(f"  {'link':<12}{'bytes':>10}{'frames':>8}"
-                   f"{'GB/s':>8}{'reconn':>8}{'replay':>8}{'state':>8}")
+                   f"{'GB/s':>8}{'stripes':>8}{'reconn':>8}"
+                   f"{'replay':>8}{'state':>8}")
         for link in summary["links"]:
             gbps = ("-" if link["gbps"] is None
                     else f"{link['gbps']:.3f}")
+            # width, plus the one stripe that repaired when exactly
+            # attributable ("2:s1" = 2 stripes, stripe 1 repaired)
+            nstripes = link.get("stripes", 0)
+            stripes = "-" if not nstripes else str(nstripes)
+            if link.get("hot_stripe") is not None:
+                stripes += f":s{link['hot_stripe']}"
             out.append(
                 f"  r{link['rank']}->r{link['peer']:<8}"
                 f"{_fmt_bytes(link['bytes']):>10}{link['frames']:>8}"
-                f"{gbps:>8}{link['reconnects']:>8}"
+                f"{gbps:>8}{stripes:>8}{link['reconnects']:>8}"
                 f"{link['replayed_frames']:>8}"
                 f"{_STATE_NAMES.get(link['state'], '?'):>8}"
             )

@@ -223,7 +223,9 @@ def startup(progress=None):
         src_codes = {"default": 0, "cache": 1, "env": 2}
         src_names = {v: k for k, v in src_codes.items()}
         order = ("ring_min_bytes", "seg_bytes", "leader_ring_min_bytes",
-                 "hier", "coalesce_bytes")
+                 "hier", "coalesce_bytes", "stripes")
+        # stripes travels as an int: 0 encodes "auto" (no fitted width)
+        stripes_v = knobs.get("stripes", "auto")
         vec = np.asarray(
             [
                 knobs["ring_min_bytes"],
@@ -231,6 +233,7 @@ def startup(progress=None):
                 knobs["leader_ring_min_bytes"],
                 _HIER_CODES.get(knobs["hier"], 0),
                 knobs["coalesce_bytes"],
+                0 if stripes_v == "auto" else int(stripes_v),
                 *[src_codes.get(sources[k], 0) for k in order],
             ],
             np.int64,
@@ -242,9 +245,10 @@ def startup(progress=None):
             "leader_ring_min_bytes": int(vec[2]),
             "hier": _HIER_NAMES.get(int(vec[3]), "auto"),
             "coalesce_bytes": int(vec[4]),
+            "stripes": "auto" if int(vec[5]) == 0 else int(vec[5]),
         }
         sources = {
-            k: src_names.get(int(vec[5 + i]), "default")
+            k: src_names.get(int(vec[6 + i]), "default")
             for i, k in enumerate(order)
         }
 
@@ -257,6 +261,13 @@ def startup(progress=None):
         leader_ring_min_bytes=knobs["leader_ring_min_bytes"],
     )
     runtime.set_coalesce(knobs["coalesce_bytes"])
+    # wire dealing width (docs/performance.md "striped links"): a
+    # fitted/cached width applies up to the BUILT width (connections
+    # are fixed at bootstrap — a cached 4 on a world built with 1
+    # takes effect on the next striped launch, not this one); "auto"
+    # keeps the native default
+    if knobs.get("stripes", "auto") != "auto":
+        runtime.set_wire(stripes=int(knobs["stripes"]))
 
     eff = {
         "knobs": dict(knobs),

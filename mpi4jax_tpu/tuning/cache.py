@@ -39,13 +39,16 @@ __all__ = [
 CACHE_SCHEMA_VERSION = 1
 
 # The calibratable knob vector, env name -> cache key.  hier is the
-# T4J_HIER mode string; everything else is a byte count.
+# T4J_HIER mode string; stripes is "auto" or an int 1..16 (the wire
+# dealing width, docs/performance.md "striped links"); everything
+# else is a byte count.
 KNOBS = {
     "T4J_RING_MIN_BYTES": "ring_min_bytes",
     "T4J_SEG_BYTES": "seg_bytes",
     "T4J_LEADER_RING_MIN_BYTES": "leader_ring_min_bytes",
     "T4J_HIER": "hier",
     "T4J_COALESCE_BYTES": "coalesce_bytes",
+    "T4J_STRIPES": "stripes",
 }
 
 KNOB_DEFAULTS = {
@@ -54,6 +57,7 @@ KNOB_DEFAULTS = {
     "leader_ring_min_bytes": 256 << 10,
     "hier": "auto",
     "coalesce_bytes": 16 << 10,
+    "stripes": "auto",
 }
 
 _SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
@@ -159,15 +163,29 @@ def resolve(cache_knobs, env=None):
     knobs, sources = {}, {}
     for env_name, key in KNOBS.items():
         raw = env.get(env_name)
-        if raw is not None and str(raw).strip() != "":
+        explicit = raw is not None and str(raw).strip() != ""
+        if explicit and key == "stripes" \
+                and str(raw).strip().lower() == "auto":
+            # "auto" is the ask-the-calibrator value, not an operator
+            # override: a cached fitted width must still win over it
+            explicit = False
+        if explicit:
             if key == "hier":
                 knobs[key] = str(raw).strip().lower()
+            elif key == "stripes":
+                s = str(raw).strip().lower()
+                knobs[key] = "auto" if s == "auto" else int(s, 10)
             else:
                 knobs[key] = _parse_bytes(raw)
             sources[key] = "env"
         elif key in cache_knobs and cache_knobs[key] is not None:
             v = cache_knobs[key]
-            knobs[key] = str(v) if key == "hier" else int(v)
+            if key == "hier":
+                knobs[key] = str(v)
+            elif key == "stripes":
+                knobs[key] = "auto" if str(v) == "auto" else int(v)
+            else:
+                knobs[key] = int(v)
             sources[key] = "cache"
         else:
             knobs[key] = KNOB_DEFAULTS[key]

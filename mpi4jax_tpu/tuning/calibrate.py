@@ -26,9 +26,11 @@ __all__ = [
     "DEFAULT_SIZES",
     "SEG_CANDIDATES",
     "COALESCE_SIZES",
+    "STRIPE_MARGIN",
     "fit_crossover",
     "fit_seg",
     "fit_coalesce",
+    "fit_stripes",
     "fit_records",
     "autotune",
 ]
@@ -94,6 +96,34 @@ def fit_coalesce(points):
     return best
 
 
+# A wider dealing still has to EARN its keep: below this speedup over
+# one flow the fit keeps stripes=1 (reorder bookkeeping and extra
+# sockets are pure overhead when one flow already fills the pipe —
+# the "within 5% of single-flow when striping is not profitable"
+# contract, docs/performance.md "striped links").
+STRIPE_MARGIN = 1.05
+
+
+def fit_stripes(points, margin=STRIPE_MARGIN):
+    """Dealing width from ``(stripes, ms)`` pairs: the fastest width,
+    except that any width > 1 must beat width 1 by ``margin`` —
+    otherwise 1 wins (striping that is not profitable must cost
+    nothing).  ``None`` on no data."""
+    pts = {int(s): float(ms) for s, ms in points}
+    if not pts:
+        return None
+    base = pts.get(1)
+    best, best_ms = None, None
+    for s, ms in sorted(pts.items()):
+        if best_ms is None or ms < best_ms:
+            best, best_ms = s, ms
+    if best is None or best == 1:
+        return 1 if 1 in pts else best
+    if base is not None and base <= best_ms * margin:
+        return 1
+    return best
+
+
 def fit_records(records):
     """Fit the knob vector from ``proc_busbw.py --calibrate`` JSON
     records (each: ``{"arm", "payload_bytes", "mean_ms", ...}``, arms
@@ -124,6 +154,13 @@ def fit_records(records):
                 seg_pts.append((int(arm[4:]), float(r["mean_ms"])))
     if seg_pts:
         knobs["seg_bytes"] = fit_seg(seg_pts)
+    stripe_pts = []
+    for arm, rows in by.items():
+        if arm.startswith("stripes:"):
+            for r in rows:
+                stripe_pts.append((int(arm[8:]), float(r["mean_ms"])))
+    if stripe_pts:
+        knobs["stripes"] = fit_stripes(stripe_pts)
     hier_pts = pair("flat", "hier")
     if hier_pts:
         knobs["leader_ring_min_bytes"] = fit_crossover(hier_pts)
@@ -258,6 +295,32 @@ def autotune(sizes=None, seg_candidates=None, coalesce_sizes=None,
         seg_pts.append((seg, ms))
         say(f"seg {seg}B: {ms:.3f}ms")
     knobs["seg_bytes"] = fit_seg(seg_pts)
+
+    # ---- stripes: dealing width at the largest payload ------------------
+    #
+    # The BUILT width is fixed at bootstrap (connections exist or they
+    # do not), so the arm A/Bs the runtime DEALING width 1..built
+    # inside one world — only meaningful when the job was launched
+    # striped (T4J_STRIPES >= 2; proc_busbw --stripes and --autotune
+    # runs do that).  The fitted width is cached for the fabric; a
+    # width that does not beat single-flow by STRIPE_MARGIN fits 1, so
+    # unprofitable striping costs nothing (docs/performance.md
+    # "striped links and the zero-copy path").
+    winfo = runtime.wire_info() or {}
+    built = int(winfo.get("stripes_built", 1) or 1)
+    if built > 1 and n > 1:
+        count = max(big // 4, n)
+        x = np.ones(count, np.float32)
+        widths = sorted({1, 2, built} & set(range(1, built + 1)))
+        stripe_pts = []
+        for w in widths:
+            runtime.set_wire(stripes=w)
+            ms = arm(f"stripes:{w}", count * 4, "allreduce",
+                     lambda: runtime.host_allreduce(world, x, 0))
+            stripe_pts.append((w, ms))
+            say(f"stripes {w}: {ms:.3f}ms")
+        runtime.set_wire(stripes=built)  # restore full width for the rest
+        knobs["stripes"] = fit_stripes(stripe_pts)
 
     # ---- hier: flat vs hierarchical per size (topology permitting) ------
     topo = runtime.topology() or {}

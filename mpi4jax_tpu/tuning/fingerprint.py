@@ -21,7 +21,9 @@ __all__ = ["KNOB_SCHEMA_VERSION", "topology_fingerprint"]
 # Bump whenever the knob vector's meaning changes (a knob added,
 # removed, or re-interpreted): caches written under another schema are
 # ignored wholesale rather than half-applied.
-KNOB_SCHEMA_VERSION = 1
+# v2: the `stripes` knob joined the vector (striped multi-connection
+# links, docs/performance.md "striped links and the zero-copy path").
+KNOB_SCHEMA_VERSION = 2
 
 
 def topology_fingerprint(topology, world_size,

@@ -86,6 +86,27 @@ communicators with multiple ranks per host (docs/performance.md
                                   hierarchical path is taken (default
                                   256 KiB, the measured crossover).
 
+Striped multi-connection links and the zero-copy wire path
+(docs/performance.md "striped links and the zero-copy path"):
+
+* ``T4J_STRIPES``            — parallel TCP connections per peer link
+                               (``auto``, the default, or 1..16).  The
+                               built width is fixed at bootstrap; the
+                               dealing width can be lowered/raised up
+                               to it at runtime (the calibrator does).
+* ``T4J_ZEROCOPY_MIN_BYTES`` — frames at or above this many bytes are
+                               sent with MSG_ZEROCOPY (0 = off, the
+                               default).  On kernels without
+                               SO_ZEROCOPY the bridge degrades LOUDLY
+                               to the copy path at init.
+* ``T4J_SENDMSG_BATCH``      — max frames gathered into one sendmsg
+                               iovec call (default 8, 1..256).
+* ``T4J_EMU_FLOW_BPS``       — testing: per-connection token-bucket
+                               throttle in bytes/second (0 = off) so a
+                               loopback box can demonstrate the
+                               multi-flow busbw step real fabrics get
+                               from multiple NIC queues.
+
 Trace-guided autotuning + small-message coalescing
 (docs/performance.md "trace-guided autotuning"):
 
@@ -175,6 +196,10 @@ __all__ = [
     "int_count",
     "ring_min_bytes",
     "seg_bytes",
+    "stripes",
+    "zerocopy_min_bytes",
+    "sendmsg_batch",
+    "emu_flow_bps",
     "coalesce_bytes",
     "tuning_cache_dir",
     "autotune_enabled",
@@ -458,6 +483,79 @@ def seg_bytes():
             "T4J_SEG_BYTES must be >= 1 (a ring segment cannot be empty)"
         )
     return v
+
+
+MAX_STRIPES = 16
+
+
+def stripes():
+    """Parallel TCP connections per peer link (docs/performance.md
+    "striped links and the zero-copy path"): ``"auto"`` (the default —
+    one connection until the trace-guided calibrator learns a better
+    width for the fabric) or an explicit 1..16.  Anything else raises:
+    a typo'd stripe count must fail at launch, not silently run a
+    different wire topology than the operator asked for.  Must be
+    uniform across ranks (both ends of a link must build the same
+    number of connections)."""
+    v = os.environ.get("T4J_STRIPES")
+    if v is None or not str(v).strip():
+        return "auto"
+    s = str(v).strip().lower()
+    if s == "auto":
+        return "auto"
+    try:
+        n = int(s, 10)
+    except ValueError:
+        raise ValueError(
+            f"cannot interpret T4J_STRIPES={v!r} (want auto or an "
+            f"integer 1..{MAX_STRIPES})"
+        ) from None
+    if not 1 <= n <= MAX_STRIPES:
+        raise ValueError(
+            f"T4J_STRIPES={n} out of range (want 1..{MAX_STRIPES}: one "
+            "flow cannot be split below one connection, and the "
+            "per-stripe reader/replay state is bounded)"
+        )
+    return n
+
+
+def zerocopy_min_bytes():
+    """MSG_ZEROCOPY opt-in floor in bytes (0 = the copy path
+    everywhere, the default).  Frames at or above it are transmitted
+    straight from the replay arena (or the caller's buffer with
+    ``T4J_RETRY_MAX=0``) with the kernel-buffer copy elided; kernels
+    without SO_ZEROCOPY degrade loudly at init
+    (docs/performance.md "striped links and the zero-copy path")."""
+    return byte_count(
+        os.environ.get("T4J_ZEROCOPY_MIN_BYTES"), 0,
+        name="T4J_ZEROCOPY_MIN_BYTES",
+    )
+
+
+def sendmsg_batch():
+    """Max frames gathered into one ``sendmsg`` iovec call (default 8,
+    1..256 — two iovecs per frame against the kernel's IOV_MAX)."""
+    v = int_count(
+        os.environ.get("T4J_SENDMSG_BATCH"), 8, name="T4J_SENDMSG_BATCH"
+    )
+    if not 1 <= v <= 256:
+        raise ValueError(
+            f"T4J_SENDMSG_BATCH={v} out of range (want 1..256: a batch "
+            "cannot be empty, and each frame costs two iovec entries "
+            "against IOV_MAX)"
+        )
+    return v
+
+
+def emu_flow_bps():
+    """Per-connection token-bucket throttle in bytes/second (0 = off,
+    the default).  A TEST knob: it emulates the per-flow bottleneck of
+    a real NIC-bound fabric so the loopback box can demonstrate the
+    multi-flow busbw step (docs/performance.md "striped links and the
+    zero-copy path")."""
+    return byte_count(
+        os.environ.get("T4J_EMU_FLOW_BPS"), 0, name="T4J_EMU_FLOW_BPS"
+    )
 
 
 def coalesce_bytes():

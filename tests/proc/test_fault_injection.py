@@ -495,6 +495,82 @@ print("SELF-HEAL-OK", flush=True)
     assert "abort" not in blob, blob[-3000:]
 
 
+def test_one_stripe_drop_self_heals_per_stripe(tmp_path):
+    """Striped links (docs/performance.md "striped links and the
+    zero-copy path"): with T4J_STRIPES=4, rank 1 drops ONLY stripe 1
+    of every link mid-allreduce (``T4J_FAULT_STRIPE=1``).  The
+    per-stripe self-heal contract: every rank finishes with results
+    bit-identical to the fault-free reduction, zero aborts, the
+    killed stripe shows nonzero per-stripe reconnect counters while
+    its SIBLING stripes never break (they kept carrying traffic
+    through the repair)."""
+    body = PREAMBLE + """
+from mpi4jax_tpu.native import runtime as _rt
+
+iters, count = 12, 64 * 1024
+for it in range(iters):
+    per_rank = [
+        np.random.default_rng(1000 * it + r)
+        .integers(0, 64, size=count).astype(np.float32)
+        for r in range(size)
+    ]
+    want = per_rank[0].copy()
+    for a in per_rank[1:]:
+        want += a
+    y, _ = m.allreduce(jnp.asarray(per_rank[rank]), op=m.SUM, comm=comm)
+    got = np.asarray(y)
+    assert got.tobytes() == want.tobytes(), (
+        f"iteration {it}: result differs from the fault-free reduction"
+    )
+info = _rt.wire_info()
+assert info["stripes_built"] == 4, info
+hot = cold = 0
+for peer in range(size):
+    if peer == rank:
+        continue
+    stats = _rt.link_stats(peer) or {}
+    for si, s in enumerate(stats.get("stripes", [])):
+        if si == 1:
+            hot += s["reconnects"]
+        else:
+            cold += s["reconnects"]
+assert cold == 0, (
+    f"sibling stripes reconnected ({cold}) — the drop was meant to "
+    "hit stripe 1 only"
+)
+print(f"STRIPE-HEAL-OK hot={hot}", flush=True)
+"""
+    res = _spawn_world(
+        tmp_path, body, nprocs=8, timeout=240,
+        env_common={
+            "T4J_NO_SHM": "1",
+            "T4J_RING_MIN_BYTES": "0",
+            "T4J_SEG_BYTES": "8192",
+            "T4J_STRIPES": "4",
+            "T4J_FAULT_MODE": "flaky",
+            "T4J_FAULT_RANK": "1",
+            "T4J_FAULT_STRIPE": "1",
+            "T4J_FAULT_AFTER": "40",
+            "T4J_FAULT_COUNT": "2",
+        },
+    )
+    blob = ""
+    hot_total = 0
+    for rank, (rc, out, err) in enumerate(res):
+        assert rc == 0, (rank, rc, out[-2000:], err[-2000:])
+        assert "STRIPE-HEAL-OK" in out, (rank, out[-2000:])
+        for line in out.splitlines():
+            if line.startswith("STRIPE-HEAL-OK"):
+                hot_total += int(line.split("hot=")[1].split()[0])
+        blob += out + err
+    # the one-stripe drops really happened, the stripe really healed
+    # (nonzero per-stripe counters), nobody aborted, siblings flowed
+    assert "dropping one stripe of every TCP link" in blob, blob[-3000:]
+    assert "reconnected" in blob, blob[-3000:]
+    assert "abort" not in blob, blob[-3000:]
+    assert hot_total >= 1, "killed stripe shows zero reconnects"
+
+
 def test_drop_conn_with_retries_disabled_aborts(tmp_path):
     """drop_conn with T4J_RETRY_MAX=0: self-healing disabled, so the
     one-shot connection drop must escalate exactly like the pre-self-

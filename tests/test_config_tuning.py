@@ -187,6 +187,95 @@ class TestLeaderRingMinBytes:
             config.leader_ring_min_bytes()
 
 
+class TestStripes:
+    """T4J_STRIPES (docs/performance.md "striped links and the
+    zero-copy path"): auto (default) or an explicit 1..16."""
+
+    def test_default_is_auto(self, monkeypatch):
+        monkeypatch.delenv("T4J_STRIPES", raising=False)
+        assert config.stripes() == "auto"
+
+    def test_explicit_auto(self, monkeypatch):
+        monkeypatch.setenv("T4J_STRIPES", "auto")
+        assert config.stripes() == "auto"
+
+    @pytest.mark.parametrize("n", [1, 2, 4, 16])
+    def test_explicit_width(self, monkeypatch, n):
+        monkeypatch.setenv("T4J_STRIPES", str(n))
+        assert config.stripes() == n
+
+    @pytest.mark.parametrize("bad", ["0", "17", "-1", "64"])
+    def test_out_of_range_raises(self, monkeypatch, bad):
+        monkeypatch.setenv("T4J_STRIPES", bad)
+        with pytest.raises(ValueError, match="T4J_STRIPES"):
+            config.stripes()
+
+    @pytest.mark.parametrize("bad", ["many", "2.5", "1K"])
+    def test_garbage_raises(self, monkeypatch, bad):
+        monkeypatch.setenv("T4J_STRIPES", bad)
+        with pytest.raises(ValueError, match="T4J_STRIPES"):
+            config.stripes()
+
+
+class TestZerocopyMinBytes:
+    """T4J_ZEROCOPY_MIN_BYTES: MSG_ZEROCOPY opt-in floor (0 = off)."""
+
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("T4J_ZEROCOPY_MIN_BYTES", raising=False)
+        assert config.zerocopy_min_bytes() == 0
+
+    def test_env_value_with_suffix(self, monkeypatch):
+        monkeypatch.setenv("T4J_ZEROCOPY_MIN_BYTES", "64K")
+        assert config.zerocopy_min_bytes() == 64 << 10
+
+    @pytest.mark.parametrize("bad", ["large", "-1", "1.5M"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        monkeypatch.setenv("T4J_ZEROCOPY_MIN_BYTES", bad)
+        with pytest.raises(ValueError, match="T4J_ZEROCOPY_MIN_BYTES"):
+            config.zerocopy_min_bytes()
+
+
+class TestSendmsgBatch:
+    """T4J_SENDMSG_BATCH: frames gathered per sendmsg call (1..256)."""
+
+    def test_default_is_8(self, monkeypatch):
+        monkeypatch.delenv("T4J_SENDMSG_BATCH", raising=False)
+        assert config.sendmsg_batch() == 8
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_SENDMSG_BATCH", "32")
+        assert config.sendmsg_batch() == 32
+
+    @pytest.mark.parametrize("bad", ["0", "257", "-4"])
+    def test_out_of_range_raises(self, monkeypatch, bad):
+        monkeypatch.setenv("T4J_SENDMSG_BATCH", bad)
+        with pytest.raises(ValueError, match="T4J_SENDMSG_BATCH"):
+            config.sendmsg_batch()
+
+    def test_garbage_raises(self, monkeypatch):
+        monkeypatch.setenv("T4J_SENDMSG_BATCH", "lots")
+        with pytest.raises(ValueError, match="T4J_SENDMSG_BATCH"):
+            config.sendmsg_batch()
+
+
+class TestEmuFlowBps:
+    """T4J_EMU_FLOW_BPS: per-connection test throttle (0 = off)."""
+
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("T4J_EMU_FLOW_BPS", raising=False)
+        assert config.emu_flow_bps() == 0
+
+    def test_env_value_with_suffix(self, monkeypatch):
+        monkeypatch.setenv("T4J_EMU_FLOW_BPS", "48M")
+        assert config.emu_flow_bps() == 48 << 20
+
+    @pytest.mark.parametrize("bad", ["fast", "-1", "0.5G"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        monkeypatch.setenv("T4J_EMU_FLOW_BPS", bad)
+        with pytest.raises(ValueError, match="T4J_EMU_FLOW_BPS"):
+            config.emu_flow_bps()
+
+
 class TestCoalesceBytes:
     def test_default_is_16k(self, monkeypatch):
         monkeypatch.delenv("T4J_COALESCE_BYTES", raising=False)
@@ -522,6 +611,44 @@ class TestResizeTimeout:
         monkeypatch.setenv("T4J_RESIZE_TIMEOUT", bad)
         with pytest.raises(ValueError, match="T4J_RESIZE_TIMEOUT"):
             config.resize_timeout()
+
+
+def test_ensure_initialized_rejects_bad_stripes(monkeypatch):
+    """A typo'd stripe count must fail before the native bridge builds
+    a wire topology the operator did not ask for
+    (docs/performance.md "striped links and the zero-copy path")."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_STRIPES", "0")
+    with pytest.raises(ValueError, match="T4J_STRIPES"):
+        runtime.ensure_initialized()
+
+
+def test_ensure_initialized_rejects_subpage_zerocopy(monkeypatch):
+    """MSG_ZEROCOPY pins whole pages per send: a sub-page floor pays
+    the pin/completion round-trip for no copy saved, so the combo is
+    rejected at launch (0 = off, or >= 4096).  A kernel WITHOUT
+    SO_ZEROCOPY is handled separately — the native bridge degrades
+    loudly to the copy path at init instead of failing the job."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_ZEROCOPY_MIN_BYTES", "512")
+    with pytest.raises(ValueError, match="T4J_ZEROCOPY_MIN_BYTES"):
+        runtime.ensure_initialized()
 
 
 def test_ensure_initialized_rejects_elastic_without_retries(monkeypatch):

@@ -421,15 +421,17 @@ class TestCtrlStall:
         LB = schema.KIND_IDS["link_break"]
         RC = schema.KIND_IDS["reconnect"]
         RP = schema.KIND_IDS["replay"]
+        # comm=-1: unstriped/legacy control events (schema v2 carries
+        # the stripe index in comm for these kinds)
         events = [
             ev(0.0, STEP, B, nbytes=0),
-            ev(10.0, LB, 0, peer=1),
-            ev(14.0, RP, 0, peer=1),
-            ev(15.0, RC, 0, peer=1),   # peer 1: 5 ms repair, 1 replay
-            ev(20.0, LB, 0, peer=2),
-            ev(21.0, RP, 0, peer=2),
-            ev(22.0, RP, 0, peer=2),
-            ev(30.0, RC, 0, peer=2),   # peer 2: 10 ms repair, 2 replays
+            ev(10.0, LB, 0, comm=-1, peer=1),
+            ev(14.0, RP, 0, comm=-1, peer=1),
+            ev(15.0, RC, 0, comm=-1, peer=1),  # peer 1: 5 ms, 1 replay
+            ev(20.0, LB, 0, comm=-1, peer=2),
+            ev(21.0, RP, 0, comm=-1, peer=2),
+            ev(22.0, RP, 0, comm=-1, peer=2),
+            ev(30.0, RC, 0, comm=-1, peer=2),  # peer 2: 10 ms, 2 replays
             ev(50.0, STEP, E, nbytes=0),
         ]
         report = diagnose.diagnose(
@@ -443,6 +445,31 @@ class TestCtrlStall:
         assert links[2]["replays"] == 2
         assert links[2]["breaks"] == 1
         assert links[2]["cause"] == "repair"
+        assert links[2]["slow_stripe"] is None
+
+    def test_striped_repair_names_the_slow_stripe(self):
+        # striped link (docs/performance.md "striped links"): stripe 2
+        # owns the repair window, so the wait-cause names IT — and a
+        # break on stripe 2 must NOT be closed by stripe 0's reconnect
+        LB = schema.KIND_IDS["link_break"]
+        RC = schema.KIND_IDS["reconnect"]
+        events = [
+            ev(0.0, STEP, B, nbytes=0),
+            ev(10.0, LB, 0, comm=2, peer=1),   # stripe 2 breaks
+            ev(12.0, LB, 0, comm=0, peer=1),   # stripe 0 blips too
+            ev(13.0, RC, 0, comm=0, peer=1),   # ...and repairs in 1 ms
+            ev(40.0, RC, 0, comm=2, peer=1),   # stripe 2 takes 30 ms
+            ev(50.0, STEP, E, nbytes=0),
+        ]
+        report = diagnose.diagnose(
+            [diagnose.rank_view_from_obj(rank_obj(0, events, world=1))]
+        )
+        link = {lk["peer"]: lk for lk in report["links"]}[1]
+        assert link["repair_ms"] == pytest.approx(31.0)
+        assert link["slow_stripe"] == 2
+        assert link["cause"] == "repair (stripe 2)"
+        assert link["repair_by_stripe"][2] == pytest.approx(30.0)
+        assert link["breaks"] == 2
 
     def test_unrecovered_break_stalls_to_step_end(self):
         LB = schema.KIND_IDS["link_break"]

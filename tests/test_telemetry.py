@@ -725,3 +725,43 @@ class TestTopFlightStatus:
     def test_no_flight_files_keeps_line_silent(self, tmp_path):
         summary = top.summarize([make_rank_obj(0)], flight={})
         assert "flight:" not in top.render(summary)
+
+
+def test_schema_v1_artifacts_still_readable():
+    """Schema v2 only reinterprets the previously-unused comm field of
+    the frame/link-control kinds, so v1 artifacts (pre-striping) must
+    stay losslessly readable — a postmortem of an old run cannot be
+    regenerated after a tooling upgrade."""
+    events = [schema.Event(1000 + i, 7, 1 if i == 0 else 2, 2, 0, -1,
+                           5, 64) for i in range(2)]
+    obj = dump.build_rank_obj(
+        rank=0, world=1, anchor_mono_ns=1000, anchor_unix_ns=2000,
+        mode="trace", events=events,
+    )
+    obj["schema"] = "t4j-telemetry-v1"
+    assert schema.validate_rank_file(obj) is obj
+    # flight files: same event layout, schema word 1
+    blob = schema.encode_flight_file(0, 1, events)
+    blob = bytearray(blob)
+    import struct as _struct
+
+    # schema field sits after magic (8s) + version (I)
+    _struct.pack_into("<I", blob, 12, 1)
+    import io
+    import pathlib
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = pathlib.Path(d) / "rank0-1.t4jflight"
+        p.write_bytes(bytes(blob))
+        rec = schema.read_flight_file(p)
+    assert rec["recovered_events"] == 2
+    del io
+    # v3+ still refuses (unknown layouts must never half-parse)
+    header = schema.FLIGHT_HEADER_STRUCT.pack(
+        schema.FLIGHT_MAGIC, schema.FLIGHT_VERSION, 3, 0, 1, 0, 2,
+        0, 0, 0, 0, 256, 0, 0, 0, 0, 0, 0, schema.FLIGHT_HEADER_BYTES,
+        schema.FLIGHT_HEADER_BYTES + 256 * 40, schema.FLIGHT_TABLE_BYTES,
+    )
+    with pytest.raises(schema.SchemaError):
+        schema.parse_flight_header(header)

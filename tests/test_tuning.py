@@ -218,6 +218,29 @@ class TestResolve:
         assert set(knobs) == set(cache.KNOB_DEFAULTS)
         assert all(s == "default" for s in sources.values())
 
+    def test_stripes_values(self):
+        # default is auto; cache ints apply; explicit env ints win
+        knobs, sources = cache.resolve({}, env={})
+        assert knobs["stripes"] == "auto"
+        knobs, sources = cache.resolve({"stripes": 4}, env={})
+        assert knobs["stripes"] == 4 and sources["stripes"] == "cache"
+        knobs, sources = cache.resolve(
+            {"stripes": 4}, env={"T4J_STRIPES": "2"}
+        )
+        assert knobs["stripes"] == 2 and sources["stripes"] == "env"
+
+    def test_stripes_env_auto_defers_to_cache(self):
+        # "auto" is the ask-the-calibrator value, NOT an operator
+        # override: a fitted width in the cache must still apply
+        # (docs/performance.md "striped links and the zero-copy path")
+        knobs, sources = cache.resolve(
+            {"stripes": 4}, env={"T4J_STRIPES": "auto"}
+        )
+        assert knobs["stripes"] == 4 and sources["stripes"] == "cache"
+        knobs, sources = cache.resolve({}, env={"T4J_STRIPES": "auto"})
+        assert knobs["stripes"] == "auto"
+        assert sources["stripes"] == "default"
+
 
 # ---- fitters -------------------------------------------------------------
 
@@ -268,6 +291,24 @@ class TestFitters:
     def test_coalesce_never_wins_is_off(self):
         assert calibrate.fit_coalesce([(1024, 2.0, 1.0)]) == 0
 
+    def test_stripes_fastest_width_wins(self):
+        # 4 flows clearly beat one: the fit takes the widest winner
+        assert calibrate.fit_stripes(
+            [(1, 4.0), (2, 2.2), (4, 1.2)]
+        ) == 4
+
+    def test_stripes_unprofitable_keeps_one(self):
+        # within STRIPE_MARGIN of single-flow: striping must cost
+        # nothing when it is not profitable — the fit keeps 1
+        assert calibrate.fit_stripes(
+            [(1, 1.00), (2, 0.99), (4, 0.98)]
+        ) == 1
+        assert calibrate.fit_stripes([(1, 1.0), (4, 1.3)]) == 1
+
+    def test_stripes_empty_and_single(self):
+        assert calibrate.fit_stripes([]) is None
+        assert calibrate.fit_stripes([(2, 1.0)]) == 2
+
     def test_fit_records_round_trip(self):
         records = [
             {"arm": "tree", "payload_bytes": 1024, "mean_ms": 1.0},
@@ -282,6 +323,10 @@ class TestFitters:
             {"arm": "hier", "payload_bytes": 1 << 20, "mean_ms": 2.0},
             {"arm": "unfused", "payload_bytes": 4096, "mean_ms": 1.0},
             {"arm": "fused", "payload_bytes": 4096, "mean_ms": 0.6},
+            {"arm": "stripes:1", "payload_bytes": 1 << 20,
+             "mean_ms": 4.0},
+            {"arm": "stripes:4", "payload_bytes": 1 << 20,
+             "mean_ms": 1.5},
         ]
         knobs = calibrate.fit_records(records)
         assert knobs["ring_min_bytes"] == 1 << 20
@@ -289,6 +334,7 @@ class TestFitters:
         assert knobs["leader_ring_min_bytes"] == 1 << 20
         assert knobs["hier"] == "auto"
         assert knobs["coalesce_bytes"] == 4096
+        assert knobs["stripes"] == 4
 
     def test_fit_records_partial_coverage(self):
         knobs = calibrate.fit_records(

@@ -90,6 +90,23 @@
 #                every header with zero false deaths, and an
 #                unset-knob run must write no flight files.  ctypes
 #                only — runs on old-jax containers.
+#  15. stripe — tools/stripe_smoke.py three times over: plain, ASan,
+#                and TSan (stripe readers/writers/repair dialers are
+#                exactly the concurrency TSan exists for; the
+#                throttle perf phase auto-skips under sanitizers).
+#                Striped multi-connection links
+#                (docs/performance.md "striped links and the
+#                zero-copy path"): stripe-width matrix (2/3/8) with
+#                ring + tiny-p2p ordering checks, a one-stripe kill
+#                (T4J_FAULT_STRIPE) that must self-heal per stripe
+#                with siblings never breaking, MSG_ZEROCOPY
+#                armed-or-loud-degrade, the byte-stable T4J_STRIPES=1
+#                legacy path, and the emulated multi-flow busbw step
+#                (>= 1.25x at 4 stripes under T4J_EMU_FLOW_BPS).
+#                Plus one striped elastic shrink run
+#                (T4J_STRIPES=2 elastic_smoke) so the resize path
+#                stays green over striped links.  ctypes only — runs
+#                on old-jax containers.
 #  13. autotune — tools/autotune_smoke.py twice: plain and under
 #                AddressSanitizer.  An 8-rank calibrate phase (the
 #                collective knob fit measured through the telemetry
@@ -111,7 +128,7 @@ cd "$(dirname "$0")/.."
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
   lanes=(tier1 fault proc asan tsan lint resilience telemetry async
-         diagnose bench elastic autotune postmortem)
+         diagnose bench elastic autotune postmortem stripe)
 fi
 
 run_lane() {
@@ -205,8 +222,18 @@ assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
       run_lane postmortem-asan env T4J_SANITIZE=address timeout -k 10 900 \
         python tools/postmortem_smoke.py 8
       ;;
+    stripe)
+      run_lane stripe-plain env -u T4J_SANITIZE timeout -k 10 1200 \
+        python tools/stripe_smoke.py 8
+      run_lane stripe-asan env T4J_SANITIZE=address timeout -k 10 1800 \
+        python tools/stripe_smoke.py 8
+      run_lane stripe-tsan env T4J_SANITIZE=thread timeout -k 10 1800 \
+        python tools/stripe_smoke.py 4
+      run_lane stripe-elastic env -u T4J_SANITIZE T4J_STRIPES=2 \
+        timeout -k 10 1200 python tools/elastic_smoke.py 8
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem|stripe)" >&2
       exit 2
       ;;
   esac

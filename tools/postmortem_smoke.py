@@ -179,7 +179,14 @@ def worker(so):
     n = lib.t4j_world_size()
     phase = os.environ["SMOKE_PHASE"]
     victim = phase == "kill" and rank == VICTIM
-    iters = KILL_ITER + 3
+    # the kill phase loops far past KILL_ITER: the victim keeps
+    # reducing until its SIGKILL timer fires (a fixed +3 raced on fast
+    # wire paths — the batched/striped syscall layer finishes 4 MB
+    # allreduces quicker than the 50 ms fuse, and every rank completed
+    # before anyone died), and the survivors keep going until the dead
+    # peer's escalation aborts their collective — which is the event
+    # the phase exists to observe
+    iters = KILL_ITER + (500 if phase == "kill" else 3)
     try:
         if phase in ("kill", "clean"):
             # flight recorder must be live from init on this phase
