@@ -708,6 +708,46 @@ def proc_overlap_step(timeout=900):
     return on, off, speedup
 
 
+def proc_serving(timeout=1200):
+    """Continuous-batching serving under open-loop Poisson load
+    (docs/serving.md): one 8-rank launcher job running
+    ``benchmarks/serving.py --arms pairs`` — admission-on and
+    admission-off windows interleaved over the same seeded arrival
+    stream.  Returns the dict of records keyed by metric name (empty
+    on failure)."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "serving.py"
+    argv = [
+        sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+        str(script), "--arms", "pairs", "--windows", "2",
+        "--duration", "6", "--rate", "6", "--slo", "6000",
+    ]
+    recs = {}
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent),
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if str(rec.get("metric", "")).startswith("serving_"):
+                recs[rec["metric"]] = rec
+        if not recs:
+            print(
+                f"[bench] serving produced no records "
+                f"(rc={out.returncode}): {out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] serving failed: {exc}", file=sys.stderr)
+    return recs
+
+
 def run_bench(quick=False):
     import jax
 
@@ -1022,6 +1062,7 @@ def run_bench(quick=False):
         _skip("proc_autotune_pair", "quick mode")
         _skip("proc_halo_latency", "quick mode")
         _skip("proc_striped_busbw", "quick mode")
+        _skip("proc_serving", "quick mode")
     elif not native_ok:
         _skip("proc_tcp_busbw", native_reason)
         _skip("proc_hier_busbw", native_reason)
@@ -1029,6 +1070,7 @@ def run_bench(quick=False):
         _skip("proc_autotune_pair", native_reason)
         _skip("proc_halo_latency", native_reason)
         _skip("proc_striped_busbw", native_reason)
+        _skip("proc_serving", native_reason)
     ring_rec, tree_rec = proc_tcp_busbw() if run_heavy_proc else (None, None)
     if run_heavy_proc and ring_rec is None and tree_rec is None:
         _skip("proc_tcp_busbw", "no record produced")
@@ -1122,6 +1164,24 @@ def run_bench(quick=False):
         extras["zerocopy_vs_copy_ratio"] = zc_ratio["value"]
     elif run_heavy_proc:
         _skip("proc_zerocopy_pair", "no record produced")
+    # serving under SLO (docs/serving.md): p50/p99/rps/shed-rate and
+    # SLO attainment of the admission-controlled arm, with the
+    # uncontrolled baseline's p99 + attainment as the contrast —
+    # interleaved pairs over the same seeded arrival stream
+    sv_recs = proc_serving() if run_heavy_proc else {}
+    if run_heavy_proc and not sv_recs:
+        _skip("proc_serving", "no record produced")
+    for metric in (
+        "serving_p50_ms_proc8",
+        "serving_p99_ms_proc8",
+        "serving_rps_proc8",
+        "serving_shed_rate_proc8",
+        "serving_slo_attainment_proc8",
+        "serving_p99_ms_proc8_admit_off",
+        "serving_slo_attainment_proc8_admit_off",
+    ):
+        if metric in sv_recs:
+            extras[metric] = sv_recs[metric]["value"]
 
     if quick:
         for leg in ("transformer", "matmul_roofline",

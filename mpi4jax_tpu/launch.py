@@ -241,6 +241,33 @@ def main(argv=None):
         "and applied to this job; later jobs on the same fabric load "
         "it automatically.  Explicit T4J_* knob env vars still win.",
     )
+    parser.add_argument(
+        "--serve",
+        action="store_true",
+        help="serving-job wiring (docs/serving.md): sets T4J_ADMIT=on "
+        "for every rank (deadline-aware admission control with "
+        "honest shed accounting) unless the environment explicitly "
+        "chose, pair with --slo for the latency target.  The program "
+        "is expected to run a mpi4jax_tpu.serving engine "
+        "(benchmarks/serving.py is the reference loop).",
+    )
+    parser.add_argument(
+        "--slo",
+        type=float,
+        default=None,
+        metavar="MS",
+        help="with --serve: per-request end-to-end latency SLO in "
+        "milliseconds (T4J_SLO_MS for every rank; admission sheds "
+        "predicted misses instead of blowing the p99)",
+    )
+    parser.add_argument(
+        "--max-batch",
+        type=int,
+        default=None,
+        metavar="N",
+        help="with --serve: concurrent decode slots in the serving "
+        "engine's KV pool (T4J_MAX_BATCH)",
+    )
     parser.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("prog", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -262,6 +289,15 @@ def main(argv=None):
             "--metrics PORT must leave room for nprocs+1 ports below "
             "65536"
         )
+    if args.slo is not None and not args.serve:
+        parser.error("--slo requires --serve (it sets the serving "
+                     "engine's T4J_SLO_MS)")
+    if args.max_batch is not None and not args.serve:
+        parser.error("--max-batch requires --serve (it sets the "
+                     "serving engine's T4J_MAX_BATCH)")
+    if args.slo is not None and args.slo <= 0:
+        parser.error("--slo must be > 0 milliseconds (omit it for no "
+                     "SLO)")
 
     attempts = args.restarts + 1
     for attempt in range(1, attempts + 1):
@@ -455,6 +491,15 @@ def _run_job(args):
             env.setdefault("T4J_FLIGHT_DIR", tel_dir)
         if args.autotune:
             env["T4J_AUTOTUNE"] = "1"
+        if args.serve:
+            # serving wiring (docs/serving.md): admission on unless
+            # the environment explicitly chose (off included — the
+            # uncontrolled-baseline arm of the benchmarks)
+            env.setdefault("T4J_ADMIT", "on")
+            if args.slo is not None:
+                env["T4J_SLO_MS"] = str(args.slo)
+            if args.max_batch is not None:
+                env["T4J_MAX_BATCH"] = str(args.max_batch)
         if args.metrics is not None:
             env["T4J_METRICS_PORT"] = str(args.metrics)
             # the exporter serves the metrics table + link stats —

@@ -608,7 +608,8 @@ def _decode_step_sharded(params, cache, last_tok, pos, cfg, comm_tp, hq_l, hk_l)
 
 
 def _prefill_sharded(
-    params, prompt, cfg, comm_tp, hq_l, hk_l, max_len, impl="xla"
+    params, prompt, cfg, comm_tp, hq_l, hk_l, max_len, impl="xla",
+    logits_pos=None,
 ):
     """Batched prefill on the local tp shard: one causal forward pass
     over the whole prompt, writing every prompt position's K/V into the
@@ -622,6 +623,14 @@ def _prefill_sharded(
     forward instead of P dispatches.  Returns ``(cache, logits)`` with
     the LAST prompt position's ``[B, V]`` logits — the caller picks
     the next token (greedy or sampled).
+
+    ``logits_pos`` (traced scalar) returns the logits of THAT position
+    instead of the last one: the serving engine right-pads prompts to
+    a compile-size bucket (one executable per bucket, not per length)
+    and reads the logits at the true last prompt position — the padded
+    tail positions are causally invisible to it, and their garbage KV
+    is overwritten in order by the decode steps that follow
+    (mpi4jax_tpu/serving/engine.py).
     """
     dh = cfg.head_dim
     b, p_len = prompt.shape
@@ -652,7 +661,13 @@ def _prefill_sharded(
 
     (x, _token), cache = lax.scan(layer, (x, token), params.blocks)
     x = _rmsnorm(x, params.ln_f, cfg.eps)
-    logits = (x[:, -1, :] @ params.head)  # (B, V): last prompt position
+    if logits_pos is None:
+        last = x[:, -1, :]  # (B, d): last prompt position
+    else:
+        last = lax.dynamic_index_in_dim(
+            x, logits_pos, axis=1, keepdims=False
+        )
+    logits = last @ params.head  # (B, V)
     return cache, logits
 
 

@@ -1478,6 +1478,18 @@ def ensure_initialized():
             "disables that ladder entirely "
             "(docs/failure-semantics.md \"elastic membership\")"
         )
+    # serving knobs (docs/serving.md): validated loudly here like the
+    # deadlines — they act in the Python serving tier post-init
+    serve_slo = config.slo_ms()
+    config.max_batch()
+    serve_admit = config.admit_mode()
+    if serve_slo > 0 and serve_admit == "off":
+        raise ValueError(
+            f"T4J_SLO_MS={serve_slo:g} with T4J_ADMIT=off: an SLO "
+            "with admission control off cannot be enforced, only "
+            "missed — set T4J_ADMIT=on (shed to hold the deadline) "
+            "or drop the SLO (docs/serving.md \"admission control\")"
+        )
     tel_mode, tel_bytes = config.telemetry_mode(), config.telemetry_bytes()
     tel_dir = config.telemetry_dir()
     flight = config.flight_enabled()

@@ -1,0 +1,88 @@
+"""Continuous-batching multi-host inference serving
+(docs/serving.md).
+
+The latency-bound workload over the transport (ROADMAP item 3): a
+request queue + slot-based batch scheduler (:mod:`.scheduler`) admits
+prefills into free KV slots while in-flight decode continues, a
+deadline-aware admission controller (:mod:`.admission` — token bucket
++ SLO estimator fed by the live exporter's straggler/worst-link
+gauges) sheds load *before* it blows the p99 target, and a seeded
+open-loop Poisson load generator (:mod:`.loadgen`) drives the closed
+loop ``benchmarks/serving.py`` measures.
+
+Split exactly like ``telemetry/`` and ``tuning/``:
+
+* the **pure core** (:mod:`.request`, :mod:`.scheduler`,
+  :mod:`.admission`, :mod:`.loadgen`, :mod:`.plan`, :mod:`.stats`) is
+  import-free of jax — it stub-loads on old-jax containers
+  (tests/test_serving.py) and under the ctypes smoke
+  (tools/serving_smoke.py);
+* the **engine** (:mod:`.engine`, imported lazily) turns
+  ``models/transformer.py``'s ``_prefill_sharded`` /
+  ``_decode_step_sharded`` KV-cache machinery into the actual
+  tensor-parallel continuous-batching decoder on the proc tier —
+  rank 0 is the frontend (loadgen + scheduler + admission), every
+  rank executes the broadcast step plan (:mod:`.plan`).
+
+Knobs (validated in utils/config.py): ``T4J_SLO_MS`` (the p99
+latency target), ``T4J_MAX_BATCH`` (decode slots), ``T4J_ADMIT``
+(``off`` | ``on``).  ``launch.py --serve`` wires them.
+"""
+
+from . import admission, loadgen, plan, request, scheduler, stats
+from .admission import (
+    AdmissionController,
+    SLOEstimator,
+    TokenBucket,
+    degradation_factor,
+)
+from .loadgen import LoadGen
+from .plan import PlanError, decode_plan, encode_plan, plan_words
+from .request import Request, RequestState
+from .scheduler import (
+    FollowerMirror,
+    SchedulerError,
+    SlotScheduler,
+    StepPlan,
+    slots_digest,
+)
+from .stats import ServingStats, current, publish
+
+__all__ = [
+    "AdmissionController",
+    "FollowerMirror",
+    "LoadGen",
+    "PlanError",
+    "Request",
+    "RequestState",
+    "SLOEstimator",
+    "SchedulerError",
+    "ServingStats",
+    "SlotScheduler",
+    "StepPlan",
+    "TokenBucket",
+    "admission",
+    "current",
+    "decode_plan",
+    "degradation_factor",
+    "encode_plan",
+    "engine",
+    "loadgen",
+    "plan",
+    "plan_words",
+    "publish",
+    "request",
+    "scheduler",
+    "slots_digest",
+    "stats",
+]
+
+
+def __getattr__(name):
+    # the engine imports jax (and the ops layer); loading it lazily
+    # keeps the pure core stub-loadable on old-jax containers
+    if name == "engine":
+        import importlib
+
+        return importlib.import_module(__name__ + ".engine")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

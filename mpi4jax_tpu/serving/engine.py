@@ -1,0 +1,612 @@
+"""Continuous-batching tensor-parallel inference engine
+(docs/serving.md).
+
+Turns ``models/transformer.py``'s ``_prefill_sharded`` /
+``_decode_step_sharded`` KV-cache machinery into a served loop on the
+proc tier: the KV cache is a pool of ``max_batch`` *slots*, every
+engine step decodes all active slots one position (one jitted
+executable, per-slot positions) while a queued request's prefill is
+admitted into a free slot *in the same step* — so a long generation
+never blocks a short request, and the batch stays as full as
+admission allows.
+
+Control plane: rank 0 is the frontend (load generator, admission
+controller, scheduler); every step it broadcasts a fixed-size plan
+vector (:mod:`.plan`) over ``host_bcast``, and followers execute it
+against a :class:`~.scheduler.FollowerMirror` whose digest is checked
+every step — scheduling reads live telemetry only rank 0 sees, so the
+plan cannot be recomputed per rank (the same uniformity argument as
+tuning's rank-0 knob broadcast).
+
+Data plane: the per-layer Megatron f/g collectives inside the decode
+and prefill executables.  Since PR 7 every collective body runs on
+the async progress engine (blocking = submit + wait on the one wire
+path), so decode's wire phase progresses off the caller's thread;
+with ``overlap=True`` the engine dispatches the step's prefill
+executables BEFORE blocking on the decode logits, so prefill compute
+overlaps decode comm (docs/async.md; docs/serving.md reports the
+measured effect honestly — a CPU-oversubscribed loopback box has
+little idle to harvest).
+
+Each step is wrapped in a ``step_scope`` marker, so ``t4j-diagnose``
+decomposes any p99 blowup into compute / caller-blocked / wire /
+repair per rank — the acceptance demo uses exactly that to attribute
+a delayed rank (docs/serving.md "diagnosing a p99 blowup").
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4jax_tpu.models import transformer as tfm
+from mpi4jax_tpu.ops import reductions
+from mpi4jax_tpu.ops import step as step_mod
+from mpi4jax_tpu.ops._core import create_token
+from mpi4jax_tpu.ops.allreduce import allreduce
+from mpi4jax_tpu.parallel.longseq import local_attention
+from mpi4jax_tpu.serving import plan as plan_mod
+from mpi4jax_tpu.serving import stats as stats_mod
+from mpi4jax_tpu.serving.admission import (
+    AdmissionController,
+    SLOEstimator,
+    TokenBucket,
+)
+from mpi4jax_tpu.serving.scheduler import (
+    FollowerMirror,
+    SlotScheduler,
+)
+from mpi4jax_tpu.serving.stats import ServingStats
+from mpi4jax_tpu.utils import config
+
+__all__ = ["ServingEngine", "shard_params"]
+
+
+def shard_params(params, tp, rank):
+    """Slice full (replicated) transformer params to rank ``rank``'s
+    tensor-parallel shard: qkv/mlp-up column shards, o/mlp-down row
+    shards, everything else replicated — the same layout
+    ``param_specs`` declares for the mesh tier."""
+    if tp == 1:
+        return params
+    b = params.blocks
+
+    def cols(w):  # (L, d, n) -> rank's n/tp column block
+        n = w.shape[2]
+        if n % tp:
+            raise ValueError(
+                f"cannot shard {n} columns over tp={tp}"
+            )
+        k = n // tp
+        return w[:, :, rank * k:(rank + 1) * k]
+
+    def rows(w):  # (L, n, d) -> rank's n/tp row block
+        n = w.shape[1]
+        if n % tp:
+            raise ValueError(f"cannot shard {n} rows over tp={tp}")
+        k = n // tp
+        return w[:, rank * k:(rank + 1) * k, :]
+
+    blocks = b._replace(
+        wq=cols(b.wq), wk=cols(b.wk), wv=cols(b.wv), wo=rows(b.wo),
+        w1=cols(b.w1), w2=rows(b.w2),
+    )
+    return params._replace(blocks=blocks)
+
+
+def _decode_step_slots(params, cache, last_tok, pos, cfg, comm_tp,
+                       hq_l, hk_l):
+    """One decode step over EVERY slot with per-slot positions —
+    ``models.transformer._decode_step_sharded`` generalised from one
+    scalar ``pos`` to a ``[B]`` vector (continuous batching runs each
+    slot at its own depth).  Inactive slots are computed and ignored
+    (static shapes; the waste is the classic static-batch cost,
+    docs/serving.md).  Same math per row, so responses stay
+    token-identical to the offline decoder."""
+    dh = cfg.head_dim
+    b = last_tok.shape[0]
+    s_max = cache.shape[3]
+    x = params.embed[last_tok][:, None, :]  # (B, 1, d)
+    token = create_token()
+    # one-hot write mask for the per-row KV position: 0/1 multiply-add
+    # is exact in f32, so the update matches dynamic_update_slice bit
+    # for bit
+    oh = (jnp.arange(s_max)[None, :] == pos[:, None])
+    ohf = oh.astype(cache.dtype)[..., None, None]  # (B, S, 1, 1)
+
+    def layer(carry, inputs):
+        x, token = carry
+        bp, kv = inputs
+        h = tfm._rmsnorm(x, bp.ln1, cfg.eps)
+        h, token = tfm._f_collective(h, comm_tp, token)
+        q = (h @ bp.wq).reshape(b, 1, hq_l, dh)
+        k_new = (h @ bp.wk).reshape(b, 1, hk_l, dh)
+        v_new = (h @ bp.wv).reshape(b, 1, hk_l, dh)
+        k_cache = kv[0] * (1 - ohf) + k_new * ohf
+        v_cache = kv[1] * (1 - ohf) + v_new * ohf
+        # per-row causal offset: vmap local_attention over the batch
+        # with each row's own q_offset (the scalar-pos decode step is
+        # the B=const special case)
+        attn = jax.vmap(
+            lambda q1, k1, v1, p1: local_attention(
+                q1[None], k1[None], v1[None], causal=True,
+                q_offset=p1, impl="xla",
+            )[0]
+        )(q, k_cache, v_cache, pos)
+        a_part = attn.reshape(b, 1, hq_l * dh) @ bp.wo
+        a, token = allreduce(
+            a_part, reductions.SUM, comm=comm_tp, token=token
+        )
+        x = x + a
+        h2 = tfm._rmsnorm(x, bp.ln2, cfg.eps)
+        h2, token = tfm._f_collective(h2, comm_tp, token)
+        m_part = jax.nn.gelu(h2 @ bp.w1) @ bp.w2
+        m, token = allreduce(
+            m_part, reductions.SUM, comm=comm_tp, token=token
+        )
+        return (x + m, token), jnp.stack([k_cache, v_cache])
+
+    (x, _token), cache = lax.scan(
+        layer, (x, token), (params.blocks, cache)
+    )
+    x = tfm._rmsnorm(x, params.ln_f, cfg.eps)
+    logits = (x @ params.head)[:, 0, :]  # (B, V)
+    return cache, logits
+
+
+class ServingEngine:
+    """One rank's half of the serving loop (leader on rank 0).
+
+    ``comm`` is the tensor-parallel communicator (the proc world in
+    the benchmarks); ``params`` are FULL (replicated) parameters —
+    the engine shards them.  Knobs default from the environment
+    (``T4J_MAX_BATCH`` / ``T4J_ADMIT`` / ``T4J_SLO_MS``,
+    utils/config.py).
+    """
+
+    def __init__(self, comm, cfg, params, *, max_len, max_batch=None,
+                 admit=None, slo_ms=None, rate_limit=0.0, burst=8,
+                 overlap=True, markers=True, seed_step_ms=20.0,
+                 fabric_poll_s=0.5, estimator=None):
+        self.comm = comm
+        self.cfg = cfg
+        self.tp = comm.size
+        self.rank = comm.rank()
+        self.is_leader = self.rank == 0
+        self.max_len = int(max_len)
+        self.max_batch = (config.max_batch() if max_batch is None
+                          else int(max_batch))
+        self.admit_mode = (config.admit_mode() if admit is None
+                           else admit)
+        slo = config.slo_ms() if slo_ms is None else float(slo_ms)
+        if self.admit_mode == "off":
+            slo = 0.0  # cannot be enforced; config rejects it being set
+        self.slo_ms = slo
+        self.overlap = bool(overlap)
+        self.markers = bool(markers)
+        tfm._check_tp_divisibility(cfg, self.tp)
+        self.hq_l = cfg.heads // self.tp
+        self.hk_l = cfg.kv_heads // self.tp
+        self.params = shard_params(params, self.tp, self.rank)
+        self.cache = jnp.zeros(
+            (cfg.layers, 2, self.max_batch, self.max_len, self.hk_l,
+             cfg.head_dim),
+            self.params.embed.dtype,
+        )
+        # host-side token buffers (one row per slot)
+        self.toks = np.zeros((self.max_batch, self.max_len), np.int64)
+        self._row_len = np.zeros(self.max_batch, np.int64)
+        self.finished = []  # (rid, token tuple) in completion order
+
+        if self.is_leader:
+            self.sched = SlotScheduler(self.max_batch, self.max_len)
+            est = estimator or SLOEstimator(seed_step_ms=seed_step_ms)
+            bucket = (TokenBucket(rate_limit, burst)
+                      if rate_limit else None)
+            self.ctrl = AdmissionController(
+                self.admit_mode, slo_ms=self.slo_ms, estimator=est,
+                bucket=bucket,
+            )
+            self.stats = ServingStats(
+                slo_ms=self.slo_ms, max_batch=self.max_batch,
+                admit_mode=self.admit_mode,
+            )
+            self.mirror = None
+        else:
+            self.sched = None
+            self.ctrl = None
+            self.stats = ServingStats(
+                slo_ms=self.slo_ms, max_batch=self.max_batch,
+                admit_mode=self.admit_mode,
+            )
+            self.mirror = FollowerMirror(self.max_batch, self.max_len)
+
+        self._plan_words = plan_mod.plan_words(self.max_batch,
+                                               self.max_len)
+        self._decode_jit = jax.jit(self._decode_fn)
+        self._prefill_jits = {}
+        self._step_idx = 0
+        self._stopped = False
+        self._fabric_poll_s = float(fabric_poll_s)
+        self._last_fabric_poll = 0.0
+
+    # ---- jitted bodies ---------------------------------------------------
+
+    def _decode_fn(self, params, cache, last_tok, pos):
+        return _decode_step_slots(
+            params, cache, last_tok, pos, self.cfg, self.comm,
+            self.hq_l, self.hk_l,
+        )
+
+    def _prefill_bucket(self, p_len):
+        """Compile-size bucket: smallest power of two >= p_len (floor
+        8), capped at max_len — one executable per bucket instead of
+        one per prompt length."""
+        b = 8
+        while b < p_len:
+            b <<= 1
+        return min(b, self.max_len)
+
+    def _prefill_jit(self, bucket):
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            cfg, comm = self.cfg, self.comm
+            hq_l, hk_l, max_len = self.hq_l, self.hk_l, self.max_len
+
+            def prefill(params, cache, prompt, slot, p_len):
+                kv, logits = tfm._prefill_sharded(
+                    params, prompt, cfg, comm, hq_l, hk_l, max_len,
+                    logits_pos=p_len - 1,
+                )
+                cache = lax.dynamic_update_slice(
+                    cache, kv, (0, 0, slot, 0, 0, 0)
+                )
+                return cache, logits[0]
+
+            fn = jax.jit(prefill)
+            self._prefill_jits[bucket] = fn
+        return fn
+
+    # ---- leader: request intake -----------------------------------------
+
+    SHED_PROMPT = "prompt-too-long"
+
+    def offer(self, req, now_ms):
+        """Admission decision for one arriving request (leader only).
+        Returns ``"admit"`` or ``"shed"``."""
+        assert self.is_leader, "offer() is the leader's entry point"
+        self.stats.observe_submitted()
+        if req.prompt_len >= self.max_len:
+            # unservable regardless of load: the slot budget leaves no
+            # room to generate.  Shed (counted) instead of letting
+            # sched.submit raise and take the whole serving loop down
+            # with one oversized client request.
+            self.sched.shed_request(req, now_ms, self.SHED_PROMPT)
+            self.stats.observe_shed(self.SHED_PROMPT)
+            return "shed"
+        verdict, reason = self.ctrl.decide(req, now_ms, self.sched)
+        if verdict == "admit":
+            self.sched.submit(req, now_ms)
+        else:
+            self.sched.shed_request(req, now_ms, reason)
+            self.stats.observe_shed(reason)
+        return verdict
+
+    def _poll_fabric(self, now_ms):
+        """Feed the admission controller the live fabric signals: the
+        worst-link gauges from this rank's own link stats (the PR-8
+        exporter's job view carries the same fields aggregated; pass
+        one through :meth:`set_fabric_view` when a launcher aggregator
+        is scraping)."""
+        if now_ms - self._last_fabric_poll < self._fabric_poll_s * 1e3:
+            return
+        self._last_fabric_poll = now_ms
+        try:
+            from mpi4jax_tpu.native import runtime
+
+            agg = runtime.link_stats() or {}
+        except Exception:
+            return
+        view = {"worst_link": {
+            "state": agg.get("state", 0),
+            "reconnects": agg.get("max_reconnects", 0),
+            "peer": agg.get("worst_peer"),
+            "rank": self.rank,
+        }}
+        self.ctrl.observe_fabric(view)
+
+    def set_fabric_view(self, job_view):
+        """Feed an exporter job-view dict (launcher ``--metrics``
+        aggregate) into admission's degradation model."""
+        if self.ctrl is not None:
+            self.ctrl.observe_fabric(job_view)
+
+    # ---- the step --------------------------------------------------------
+
+    def _bcast(self, vec_or_none):
+        if vec_or_none is None:
+            vec = np.zeros(self._plan_words, np.int64)
+        else:
+            vec = np.asarray(vec_or_none, np.int64)
+        if self.tp == 1:
+            # single-member world: the leader is the whole control
+            # plane (SelfComm tests and tp=1 serving)
+            return vec
+        from mpi4jax_tpu.native import runtime
+
+        return runtime.host_bcast(
+            runtime.comm_handle(self.comm), vec, 0
+        )
+
+    def _execute(self, admissions, decode_slots, positions):
+        """Run one step's executables: the decode over all slots (when
+        any slot is active) and each admission's prefill.  With
+        ``overlap=True`` prefills are dispatched before the decode
+        result is blocked on, so their compute overlaps the decode
+        collectives' wire phase (every collective body runs on the
+        PR-7 progress engine).
+
+        Returns ``(decode_ms, prefill_ms)``: the wall up to the decode
+        logits landing, and the MARGINAL wall the prefills added after
+        that — attributed separately so a batch that always has a slot
+        decoding still teaches the prefill estimator (a combined wall
+        would inflate the step EWMA at every admission and freeze the
+        prefill model at its seed)."""
+        t0 = time.perf_counter()
+        decode_out = None
+        if decode_slots:
+            pos_all = np.zeros(self.max_batch, np.int32)
+            last_all = np.zeros(self.max_batch, np.int32)
+            for s, p in zip(decode_slots, positions):
+                pos_all[s] = p
+                last_all[s] = self.toks[s, p]
+            self.cache, decode_out = self._decode_jit(
+                self.params, self.cache, jnp.asarray(last_all),
+                jnp.asarray(pos_all),
+            )
+            if not self.overlap:
+                jax.block_until_ready(decode_out)
+        prefill_out = []
+        for slot, rid, prompt, max_new in admissions:
+            p_len = len(prompt)
+            bucket = self._prefill_bucket(p_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p_len] = prompt
+            self.toks[slot] = 0
+            self.toks[slot, :p_len] = prompt
+            self._row_len[slot] = p_len
+            self.cache, logits = self._prefill_jit(bucket)(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.int32(slot), jnp.int32(p_len),
+            )
+            prefill_out.append((slot, logits))
+        # block and write tokens: decode first (its logits were
+        # dispatched first), then the prefills' first tokens
+        if decode_out is not None:
+            logits_np = np.asarray(decode_out)
+            for s, p in zip(decode_slots, positions):
+                nxt = int(np.argmax(logits_np[s]))
+                self.toks[s, p + 1] = nxt
+                self._row_len[s] = p + 2
+        t_decode = time.perf_counter()
+        for slot, logits in prefill_out:
+            row = np.asarray(logits)
+            p_len = int(self._row_len[slot])
+            nxt = int(np.argmax(row))
+            self.toks[slot, p_len] = nxt
+            self._row_len[slot] = p_len + 1
+        t_end = time.perf_counter()
+        return (t_decode - t0) * 1e3, (t_end - t_decode) * 1e3
+
+    def step(self, now_ms=None):
+        """One serve step.  Leader: plan + broadcast + execute + book;
+        follower: receive + verify + execute + book.  Returns False
+        once a stop plan has been processed."""
+        if self._stopped:
+            return False
+        if now_ms is None:
+            now_ms = time.monotonic() * 1e3
+        if self.is_leader:
+            return self._leader_step(now_ms)
+        return self._follower_step()
+
+    def _leader_step(self, now_ms, stop=False):
+        self._poll_fabric(now_ms)
+        for req in self.ctrl.reconsider_queued(now_ms, self.sched):
+            self.stats.observe_shed(req.shed_reason)
+        digest = self.sched.state_digest()
+        plan = self.sched.plan_step(now_ms)
+        vec = plan_mod.encode_plan(
+            plan, self.max_batch, self.max_len, digest, stop=stop
+        )
+        self._bcast(vec)
+        admissions = [
+            (slot, req.rid, req.prompt, req.max_new)
+            for slot, req in plan.admissions
+        ]
+        t0 = time.perf_counter()
+        scope = (step_mod.step_scope(f"serve:{plan.step}")
+                 if self.markers else None)
+        if scope is not None:
+            scope.__enter__()
+        try:
+            decode_ms, prefill_ms = self._execute(
+                admissions, plan.decode_slots, plan.positions
+            )
+        finally:
+            if scope is not None:
+                scope.__exit__(None, None, None)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        est = self.ctrl.estimator
+        if plan.decode_slots:
+            est.observe_step(decode_ms)
+        if admissions:
+            # marginal prefill cost when a decode shared the step (so
+            # the prefill model keeps learning under load), full wall
+            # otherwise
+            p_wall = (prefill_ms if plan.decode_slots
+                      else decode_ms + prefill_ms)
+            if p_wall > 0:
+                est.observe_prefill(
+                    p_wall,
+                    max(len(p) for _s, _r, p, _m in admissions),
+                )
+        # completions happened at the END of the executed step, not at
+        # the planning instant — stamp them with the post-execution
+        # clock or TTFT/latency would exclude the very step that
+        # produced the token
+        done_ms = now_ms + wall_ms
+        for slot, _req in plan.admissions:
+            self.sched.prefill_done(slot, done_ms)
+        self.sched.step_done(plan, done_ms)
+        for req in self.sched.finished:
+            # completion and harvest happen in the same step, so the
+            # freed slot's host buffer still holds the tokens (a new
+            # admission can only land there NEXT plan)
+            n = req.prompt_len + req.generated
+            row = self.toks[req.last_slot, :n]
+            self.finished.append(
+                (req.rid, tuple(int(t) for t in row))
+            )
+            self.stats.observe_completed(req)
+        self.sched.finished.clear()
+        self.stats.observe_step(
+            self.sched.queue_depth(), self.sched.occupancy()
+        )
+        snap = self.stats.snapshot()
+        if stop:
+            # keep the final gauges visible (exit-time rank files and
+            # post-mortems read them) but marked: a live scrape must
+            # be able to tell a stopped engine from a running one
+            snap["stopped"] = True
+        stats_mod.publish(snap)
+        if stop:
+            self._stopped = True
+            return False
+        return True
+
+    def _follower_step(self):
+        vec = self._bcast(None)
+        decoded = plan_mod.decode_plan(
+            vec, self.max_batch, self.max_len,
+            expect_digest=self.mirror.state_digest(),
+        )
+        scope = (step_mod.step_scope(f"serve:{decoded['step']}")
+                 if self.markers else None)
+        if scope is not None:
+            scope.__enter__()
+        try:
+            admitted, finished = self.mirror.apply(decoded)
+            self._execute(
+                admitted, decoded["decode_slots"],
+                decoded["positions"],
+            )
+        finally:
+            if scope is not None:
+                scope.__exit__(None, None, None)
+        # same completion order as the leader: prefill-instant
+        # completions first (prefill_done runs before step_done
+        # there), then the decode completions
+        for slot, _rid, _prompt, _mn in admitted:
+            done = self.mirror.prefill_done(slot)
+            if done is not None:
+                s, rid = done
+                n = int(self._row_len[s])
+                self.finished.append(
+                    (rid, tuple(int(t) for t in self.toks[s, :n]))
+                )
+        for slot, rid in finished:
+            n = int(self._row_len[slot])
+            self.finished.append(
+                (rid, tuple(int(t) for t in self.toks[slot, :n]))
+            )
+        self.stats.observe_step(0, self.mirror.occupancy())
+        snap = self.stats.snapshot()
+        if decoded["stop"]:
+            snap["stopped"] = True
+        stats_mod.publish(snap)
+        if decoded["stop"]:
+            self._stopped = True
+            return False
+        return True
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def reconfigure(self, admit, slo_ms=0.0, rate_limit=0.0, burst=8,
+                    stats=None, measure_slo_ms=None):
+        """Swap the leader's admission arm between serving windows
+        (benchmarks/serving.py interleaves admission-on and -off arms
+        in ONE job — followers only execute broadcast plans, so the
+        arm switch is purely leader-side).  The learned service-time
+        estimator carries over; ``stats`` lets the caller keep one
+        accumulating :class:`ServingStats` per arm.
+        ``measure_slo_ms`` sets the REPORTING SLO for an off arm
+        (measured against, never enforced — the uncontrolled baseline
+        still records how badly it missed)."""
+        assert self.is_leader, "reconfigure is leader-side"
+        if not self.sched.idle():
+            raise RuntimeError(
+                "reconfigure with requests in flight; drain the "
+                "window first"
+            )
+        est = self.ctrl.estimator
+        enforce_slo = float(slo_ms) if admit == "on" else 0.0
+        self.admit_mode = admit
+        self.slo_ms = enforce_slo
+        self.ctrl = AdmissionController(
+            admit, slo_ms=enforce_slo, estimator=est,
+            bucket=TokenBucket(rate_limit, burst) if rate_limit
+            else None,
+        )
+        report_slo = (measure_slo_ms if measure_slo_ms is not None
+                      else enforce_slo)
+        self.stats = stats if stats is not None else ServingStats(
+            slo_ms=report_slo, max_batch=self.max_batch,
+            admit_mode=admit,
+        )
+        return self
+
+    def drain(self, now_ms_fn=None, max_steps=100000, stop=True):
+        """Leader: keep stepping (no new arrivals) until every queued
+        and in-flight request finished, then (``stop=True``) broadcast
+        the stop plan.  ``stop=False`` leaves followers in the loop —
+        the between-windows drain of an interleaved benchmark.
+        Verifies the request accounting — a leaked slot fails loudly
+        (tests/proc/test_serving_proc.py pins it)."""
+        assert self.is_leader
+        steps = 0
+        while not self.sched.idle():
+            now = (now_ms_fn() if now_ms_fn
+                   else time.monotonic() * 1e3)
+            self._leader_step(now)
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"drain did not converge in {max_steps} steps "
+                    f"(queue={self.sched.queue_depth()}, "
+                    f"occupancy={self.sched.occupancy()})"
+                )
+        if stop:
+            now = now_ms_fn() if now_ms_fn else time.monotonic() * 1e3
+            self._leader_step(now, stop=True)
+        self.sched.check_accounting()
+
+    def stop(self, now_ms=None):
+        """Leader: broadcast the stop plan (the world must be idle —
+        use :meth:`drain` when requests may be in flight)."""
+        assert self.is_leader
+        if now_ms is None:
+            now_ms = time.monotonic() * 1e3
+        self._leader_step(now_ms, stop=True)
+
+    def run_follower(self):
+        """Follower loop: execute broadcast plans until the stop
+        plan.  Returns the completions seen on this rank."""
+        assert not self.is_leader
+        while self._follower_step():
+            pass
+        self._stopped = True
+        return self.finished

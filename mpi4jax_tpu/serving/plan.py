@@ -1,0 +1,159 @@
+"""Cross-rank step-plan codec (docs/serving.md "the control plane").
+
+Scheduling is rank 0's job — admission reads live telemetry that other
+ranks legitimately see differently, so the plan CANNOT be recomputed
+per rank (divergent plans would issue mismatched collectives and
+deadlock; the same uniformity argument as tuning's rank-0 knob
+broadcast).  Rank 0 encodes each step's decisions into one fixed-size
+``int64`` vector, broadcast over the existing ``host_bcast`` control
+plane; followers decode and execute.
+
+Layout (``plan_words(max_batch, p_max)`` words total)::
+
+    [0] MAGIC            [1] step index        [2] flags (bit0 = stop)
+    [3] n_admissions     [4] n_decode          [5] scheduler digest
+    [6 .. 6+5*max_batch) admission entries (slot, rid, p_len,
+                         max_new, deadline_ms or -1), -1-padded
+    [.. +max_batch)      decode slot indices, -1-padded
+    [.. +max_batch)      decode positions,    -1-padded
+    [.. +max_batch*p_max) admitted prompts' token ids, row per
+                         admission slot order, -1-padded
+
+The ``scheduler digest`` is the leader's
+:meth:`SlotScheduler.state_digest` BEFORE applying the plan: a
+follower whose mirrored state drifted raises :class:`PlanError`
+naming the step instead of decoding garbage with a straight face
+(the analysis subsystem's fingerprint philosophy).
+"""
+
+from .request import Request
+
+__all__ = ["MAGIC", "PlanError", "decode_plan", "encode_plan",
+           "follower_request", "plan_words"]
+
+MAGIC = 0x74346A53  # "t4jS"
+
+_HEADER = 6
+
+
+class PlanError(RuntimeError):
+    """A step plan failed validation (bad magic, truncated vector,
+    or a leader/follower scheduler-state divergence)."""
+
+
+def plan_words(max_batch, p_max):
+    """Vector length in int64 words for a ``max_batch``-slot engine
+    with prompts bounded by ``p_max`` tokens."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if p_max < 1:
+        raise ValueError(f"p_max must be >= 1, got {p_max}")
+    return _HEADER + 5 * max_batch + 2 * max_batch + max_batch * p_max
+
+
+def encode_plan(plan, max_batch, p_max, digest, stop=False):
+    """Scheduler :class:`~.scheduler.StepPlan` -> list of ints.
+
+    ``digest`` is the leader scheduler's pre-plan state digest.  A
+    ``stop=True`` plan tells followers to leave the serve loop after
+    this step (its admissions/decode lists are usually empty)."""
+    n_admit = len(plan.admissions)
+    n_decode = len(plan.decode_slots)
+    if n_admit > max_batch or n_decode > max_batch:
+        raise PlanError(
+            f"plan exceeds max_batch={max_batch}: "
+            f"{n_admit} admissions, {n_decode} decodes"
+        )
+    vec = [MAGIC, int(plan.step), 1 if stop else 0, n_admit, n_decode,
+           int(digest)]
+    for slot, req in plan.admissions:
+        if req.prompt_len > p_max:
+            raise PlanError(
+                f"request {req.rid}: prompt length {req.prompt_len} "
+                f"exceeds the plan payload bound p_max={p_max}"
+            )
+        dl = -1 if req.deadline_ms is None else int(req.deadline_ms)
+        vec += [int(slot), int(req.rid), req.prompt_len,
+                int(req.max_new), dl]
+    vec += [-1] * (5 * (max_batch - n_admit))
+    vec += [int(s) for s in plan.decode_slots]
+    vec += [-1] * (max_batch - n_decode)
+    vec += [int(p) for p in plan.positions]
+    vec += [-1] * (max_batch - n_decode)
+    for _slot, req in plan.admissions:
+        vec += list(req.prompt) + [-1] * (p_max - req.prompt_len)
+    vec += [-1] * (p_max * (max_batch - n_admit))
+    assert len(vec) == plan_words(max_batch, p_max)
+    return vec
+
+
+def decode_plan(vec, max_batch, p_max, expect_digest=None):
+    """Int vector -> dict with keys ``step``, ``stop``,
+    ``admissions`` (list of ``(slot, rid, p_len, max_new,
+    deadline_ms-or-None)``), ``prompts`` (token tuple per admission),
+    ``decode_slots``, ``positions``.
+
+    ``expect_digest`` is the follower's own mirrored-scheduler digest;
+    a mismatch raises :class:`PlanError` naming the step (state drift
+    must fail attributably, not decode garbage)."""
+    vec = [int(v) for v in vec]
+    if len(vec) != plan_words(max_batch, p_max):
+        raise PlanError(
+            f"plan vector has {len(vec)} words, want "
+            f"{plan_words(max_batch, p_max)} for "
+            f"max_batch={max_batch}, p_max={p_max}"
+        )
+    if vec[0] != MAGIC:
+        raise PlanError(f"bad plan magic {vec[0]:#x} (want {MAGIC:#x})")
+    step, flags, n_admit, n_decode, digest = vec[1:_HEADER]
+    if not 0 <= n_admit <= max_batch or not 0 <= n_decode <= max_batch:
+        raise PlanError(
+            f"plan step {step}: counts out of range "
+            f"(admit={n_admit}, decode={n_decode}, "
+            f"max_batch={max_batch})"
+        )
+    if expect_digest is not None and digest != int(expect_digest):
+        raise PlanError(
+            f"scheduler state diverged at step {step}: leader digest "
+            f"{digest:#x} != local {int(expect_digest):#x} — a "
+            "follower missed or misapplied an earlier plan"
+        )
+    admissions = []
+    base = _HEADER
+    for i in range(n_admit):
+        slot, rid, p_len, max_new, dl = vec[base + 5 * i:base + 5 * i + 5]
+        admissions.append(
+            (slot, rid, p_len, max_new, None if dl < 0 else float(dl))
+        )
+    base += 5 * max_batch
+    decode_slots = vec[base:base + n_decode]
+    base += max_batch
+    positions = vec[base:base + n_decode]
+    base += max_batch
+    prompts = []
+    for i, (_s, _r, p_len, _m, _d) in enumerate(admissions):
+        row = vec[base + i * p_max:base + i * p_max + p_len]
+        if len(row) != p_len or any(t < 0 for t in row):
+            raise PlanError(
+                f"plan step {step}: truncated prompt payload for "
+                f"admission {i}"
+            )
+        prompts.append(tuple(row))
+    return {
+        "step": step,
+        "stop": bool(flags & 1),
+        "admissions": admissions,
+        "prompts": prompts,
+        "decode_slots": decode_slots,
+        "positions": positions,
+        "digest": digest,
+    }
+
+
+def follower_request(rid, prompt_tokens, max_new, arrival_ms=0.0,
+                     deadline_ms=None):
+    """Rebuild a :class:`Request` on a follower rank from plan fields +
+    the broadcast prompt payload (arrival time is leader-side state the
+    follower doesn't need; it defaults inert)."""
+    return Request(rid, prompt_tokens, max_new, arrival_ms,
+                   deadline_ms)

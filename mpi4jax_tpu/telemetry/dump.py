@@ -127,7 +127,7 @@ def rank_file_name(rank):
 def build_rank_obj(rank, world, anchor_mono_ns, anchor_unix_ns, mode,
                    events=(), py_events=(), metrics_words=(),
                    dropped=0, link_stats=None, topology=None, job=None,
-                   tuning=None, flight=None):
+                   tuning=None, flight=None, serving=None):
     """Assemble a schema-valid per-rank telemetry object from raw
     drains (``events``: iterable of :class:`schema.Event` or 8-field
     rows; ``metrics_words``: the u64 snapshot)."""
@@ -158,6 +158,10 @@ def build_rank_obj(rank, world, anchor_mono_ns, anchor_unix_ns, mode,
         # recorder"): lets t4j-top / t4j-postmortem pair this drain
         # with the rank's raw .t4jflight file
         "flight": flight or {},
+        # serving gauges (docs/serving.md): the engine's last
+        # published snapshot, so t4j-top shows the serving loop next
+        # to the transport it feeds on ({} outside serving jobs)
+        "serving": serving or {},
     }
     return schema.validate_rank_file(obj)
 
@@ -182,6 +186,12 @@ def collect():
         flight = runtime.flight_info()
     except Exception:
         flight = None
+    try:
+        from mpi4jax_tpu.serving import stats as _serving_stats
+
+        serving = _serving_stats.current()
+    except Exception:
+        serving = None
     return build_rank_obj(
         rank=int(os.environ.get("T4J_RANK", 0)),
         world=int(os.environ.get("T4J_SIZE", 1)),
@@ -197,6 +207,7 @@ def collect():
         job=os.environ.get("T4J_JOB", ""),
         tuning=_accum["tuning"] or {},
         flight=flight,
+        serving=serving,
     )
 
 

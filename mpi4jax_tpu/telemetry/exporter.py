@@ -68,7 +68,7 @@ def validate_snapshot(obj):
 
 def build_snapshot(rank, world, mode, metrics, link_stats=None,
                    last_events=(), dropped=0, step=None, job="",
-                   ts_unix_ns=None, world_info=None):
+                   ts_unix_ns=None, world_info=None, serving=None):
     """Assemble a schema-valid snapshot from raw pieces.
 
     ``metrics`` is a native u64-word snapshot, a parsed snapshot dict,
@@ -106,6 +106,9 @@ def build_snapshot(rank, world, mode, metrics, link_stats=None,
         # elastic membership view (docs/failure-semantics.md "elastic
         # membership"): {} outside elastic jobs / before init
         "world_info": dict(world_info or {}),
+        # serving gauges (docs/serving.md): the engine's published
+        # queue/occupancy/shed/SLO snapshot; {} outside serving jobs
+        "serving": dict(serving or {}),
         "last_events": schema.format_recent_events(events).split("; ")
         if events else [],
         "last_events_raw": [schema.event_to_list(e) for e in events],
@@ -133,6 +136,12 @@ def collect_snapshot():
             step = {"index": open_step[0], "name": open_step[1]}
     except Exception:
         pass
+    try:
+        from mpi4jax_tpu.serving import stats as _serving_stats
+
+        serving = _serving_stats.current()
+    except Exception:
+        serving = None
     return build_snapshot(
         rank=int(os.environ.get("T4J_RANK", 0)),
         world=int(os.environ.get("T4J_SIZE", 1)),
@@ -144,6 +153,7 @@ def collect_snapshot():
         step=step,
         job=os.environ.get("T4J_JOB", ""),
         world_info=runtime.world_info(),
+        serving=serving,
     )
 
 
@@ -259,6 +269,42 @@ def render_prometheus(obj, prefix="t4j"):
         emit("world_resizing", base,
              1 if wi.get("resizing") else 0,
              help_="1 while a membership agreement/rebuild is running")
+    sv = obj.get("serving") or {}
+    if sv:
+        # serving gauges (docs/serving.md): the continuous-batching
+        # loop next to the transport signals admission control reads
+        emit("serving_queue_depth", base, sv.get("queue_depth"),
+             help_="requests queued for a free KV slot")
+        emit("serving_batch_occupancy", base,
+             sv.get("batch_occupancy"),
+             help_="KV slots holding a request (of "
+                   "serving_max_batch)")
+        emit("serving_max_batch", base, sv.get("max_batch"))
+        emit("serving_submitted_total", base, sv.get("submitted"),
+             help_="requests offered", type_="counter")
+        emit("serving_completed_total", base, sv.get("completed"),
+             help_="requests completed", type_="counter")
+        emit("serving_shed_total", base, sv.get("shed"),
+             help_="requests shed by admission control",
+             type_="counter")
+        for q in ("p50", "p99"):
+            v = sv.get(f"latency_{q}_ms")
+            if v is not None:
+                emit(f"serving_latency_{q}_ms", base, round(v, 3),
+                     help_=f"end-to-end request latency {q}")
+        if sv.get("slo_ms"):
+            emit("serving_slo_ms", base, sv["slo_ms"],
+                 help_="configured end-to-end latency SLO")
+        att = sv.get("slo_attainment")
+        if att is not None:
+            emit("serving_slo_attainment", base, round(att, 4),
+                 help_="requests finished within SLO over requests "
+                       "offered (sheds count against)")
+        if sv.get("stopped"):
+            emit("serving_stopped", base, 1,
+                 help_="1 once the engine broadcast its stop plan "
+                       "(the gauges above are its final state, not "
+                       "live)")
     return "\n".join(lines) + "\n"
 
 
@@ -306,6 +352,18 @@ def aggregate_snapshots(objs, job=""):
     straggler = None
     if len(comm_ms) > 1:
         straggler = min(comm_ms, key=lambda r: comm_ms[r])
+    # serving gauges: the frontend (lowest serving rank — rank 0 in
+    # the engine's control plane) owns queue/shed/SLO truth; follower
+    # occupancy corroborates, so the job view carries the frontend
+    # block plus how many ranks are serving
+    serving = {}
+    serving_ranks = []
+    for obj in sorted(objs, key=lambda o: int(o["rank"])):
+        sv = obj.get("serving") or {}
+        if sv:
+            serving_ranks.append(int(obj["rank"]))
+            if not serving:
+                serving = dict(sv)
     # elastic membership: the freshest epoch any rank reports wins
     # (mid-resize scrapes can catch ranks on both sides of the fence)
     world = {}
@@ -333,6 +391,8 @@ def aggregate_snapshots(objs, job=""):
         "world_size": world.get("alive_count"),
         "world_epoch": world.get("epoch"),
         "departed_ranks": departed,
+        "serving": serving,
+        "serving_ranks": serving_ranks,
     }
 
 
@@ -362,6 +422,34 @@ def render_prometheus_job(agg, prefix="t4j_job"):
     lines.append(f"{prefix}_worst_link_state {worst['state']}")
     if worst["rank"] is not None:
         lines.append(f"{prefix}_worst_link_rank {worst['rank']}")
+    sv = agg.get("serving") or {}
+    if sv:
+        # the launcher job view's serving block (docs/serving.md):
+        # queue depth, batch occupancy, shed count, p99 vs SLO
+        for key, name in (
+            ("queue_depth", "serving_queue_depth"),
+            ("batch_occupancy", "serving_batch_occupancy"),
+            ("shed", "serving_shed_total"),
+            ("completed", "serving_completed_total"),
+        ):
+            if sv.get(key) is not None:
+                lines.append(f"{prefix}_{name} {sv[key]}")
+        if sv.get("latency_p99_ms") is not None:
+            lines.append(
+                f"{prefix}_serving_latency_p99_ms "
+                f"{round(sv['latency_p99_ms'], 3)}"
+            )
+        if sv.get("slo_ms"):
+            lines.append(f"{prefix}_serving_slo_ms {sv['slo_ms']}")
+        if sv.get("slo_attainment") is not None:
+            lines.append(
+                f"{prefix}_serving_slo_attainment "
+                f"{round(sv['slo_attainment'], 4)}"
+            )
+        lines.append(
+            f"{prefix}_serving_ranks "
+            f"{len(agg.get('serving_ranks') or [])}"
+        )
     if agg.get("world_size") is not None:
         # the t4j_world_size / t4j_world_epoch membership gauges
         # (docs/failure-semantics.md "elastic membership"): dashboards

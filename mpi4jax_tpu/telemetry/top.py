@@ -301,6 +301,16 @@ def summarize(rank_objs, flight=None):
             "busy_ms": round(v["busy_ns"] / 1e6, 3),
             "overlap_pct": v.get("overlap_pct"),
         })
+    # serving gauges (docs/serving.md): the frontend's (lowest
+    # serving rank's) block owns queue/shed/SLO truth; followers only
+    # corroborate occupancy
+    serving = {}
+    for obj in sorted(rank_objs, key=lambda o: int(o["rank"])):
+        sv = obj.get("serving") or {}
+        if sv:
+            serving = dict(sv)
+            serving["rank"] = int(obj["rank"])
+            break
     return {
         "ranks": per_rank,
         "ops": ops,
@@ -308,6 +318,7 @@ def summarize(rank_objs, flight=None):
         "async": async_out,
         "bytes_by_plane": reg.bytes_by_plane(),
         "flight": {str(r): st for r, st in sorted(flight.items())},
+        "serving": serving,
     }
 
 
@@ -356,6 +367,23 @@ def render(summary):
                 f"r{key} {word} {_fmt_bytes(st['file_bytes'])}"
             )
         out.append("  flight: " + " | ".join(parts))
+    sv = summary.get("serving") or {}
+    if sv:
+        # serving line (docs/serving.md): queue/occupancy/shed and
+        # p99 against the SLO, from the frontend's published gauges
+        p99 = sv.get("latency_p99_ms")
+        slo = sv.get("slo_ms")
+        vs = ("-" if p99 is None
+              else f"{p99:.0f}ms" + (f"/{slo:.0f}ms SLO" if slo else ""))
+        att = sv.get("slo_attainment")
+        out.append(
+            f"  serving: admit={sv.get('admit_mode', '?')} queue "
+            f"{sv.get('queue_depth', 0)} occupancy "
+            f"{sv.get('batch_occupancy', 0)}/{sv.get('max_batch', '?')}"
+            f" done {sv.get('completed', 0)} shed {sv.get('shed', 0)}"
+            f" p99 {vs}"
+            + (f" attain {att:.2f}" if att is not None else "")
+        )
     if summary["ops"]:
         out.append("")
         out.append(f"  {'op':<16}{'plane':<7}{'count':>8}{'bytes':>10}"

@@ -171,6 +171,20 @@ Telemetry (docs/observability.md):
                             DIR`` turns the recorder on there unless
                             ``T4J_FLIGHT`` explicitly says off.
 
+Serving (docs/serving.md — the continuous-batching inference loop):
+
+* ``T4J_SLO_MS``    — end-to-end latency SLO per request in
+                      milliseconds (0/unset = no SLO).  Requires
+                      ``T4J_ADMIT=on``: an SLO with admission off
+                      cannot be enforced, only missed — the
+                      combination is rejected at init.
+* ``T4J_MAX_BATCH`` — concurrent decode slots in the serving engine's
+                      KV-cache pool (default 8, 1..1024).
+* ``T4J_ADMIT``     — ``off`` (default: admit everything — the
+                      uncontrolled baseline) or ``on`` (token-bucket
+                      + SLO-estimator admission: predicted deadline
+                      misses are shed at the door, and counted).
+
 The byte knobs accept an optional K/M/G suffix
 (``T4J_SEG_BYTES=256K``) and all of them must be uniform across ranks
 — the launcher propagates the env, and ranks disagreeing on a
@@ -214,6 +228,9 @@ __all__ = [
     "resize_timeout",
     "bucket_bytes",
     "verify_mode",
+    "slo_ms",
+    "max_batch",
+    "admit_mode",
     "telemetry_mode",
     "telemetry_bytes",
     "telemetry_dir",
@@ -640,6 +657,48 @@ def verify_mode():
         raise ValueError(
             f"cannot interpret T4J_VERIFY={v!r} "
             "(want off|fingerprint|full)"
+        )
+    return v
+
+
+def slo_ms():
+    """Per-request end-to-end latency SLO in milliseconds
+    (docs/serving.md), or 0 when unset.  Must be finite and >= 0; a
+    typo'd SLO must fail at launch, not silently serve without a
+    deadline.  ``ensure_initialized`` additionally rejects an SLO with
+    ``T4J_ADMIT=off`` — nothing would enforce it."""
+    v = seconds(os.environ.get("T4J_SLO_MS"), 0.0, name="T4J_SLO_MS")
+    return v
+
+
+def max_batch():
+    """Concurrent decode slots in the serving engine's KV-cache pool
+    (default 8).  Bounded 1..1024: the slot cache is
+    ``layers x 2 x max_batch x max_len`` KV positions of real memory,
+    and the per-step plan vector scales with it."""
+    v = int_count(os.environ.get("T4J_MAX_BATCH"), 8,
+                  name="T4J_MAX_BATCH")
+    if not 1 <= v <= 1024:
+        raise ValueError(
+            f"T4J_MAX_BATCH={v} out of range (want 1..1024: at least "
+            "one slot, and the KV slot pool is real memory)"
+        )
+    return v
+
+
+def admit_mode():
+    """Serving admission-control mode (docs/serving.md): ``off``
+    (default — every request is admitted; the uncontrolled baseline)
+    or ``on`` (token bucket + SLO-estimator shedding).  Anything else
+    raises — a typo'd mode must fail at launch, not silently serve
+    uncontrolled while the operator believes the SLO is guarded."""
+    v = os.environ.get("T4J_ADMIT")
+    if v is None or not str(v).strip():
+        return "off"
+    v = str(v).strip().lower()
+    if v not in ("off", "on"):
+        raise ValueError(
+            f"cannot interpret T4J_ADMIT={v!r} (want off|on)"
         )
     return v
 

@@ -684,3 +684,125 @@ def test_ensure_initialized_rejects_bad_elastic(monkeypatch):
     monkeypatch.setenv("T4J_ELASTIC", "grow")
     with pytest.raises(ValueError, match="T4J_ELASTIC"):
         runtime.ensure_initialized()
+
+
+class TestSloMs:
+    """T4J_SLO_MS (docs/serving.md): the serving engine's per-request
+    latency target — validated loudly before the engine ever reads
+    it; enforcement requires T4J_ADMIT=on (the combination check
+    lives in ensure_initialized, pinned below)."""
+
+    def test_default_is_zero(self, monkeypatch):
+        monkeypatch.delenv("T4J_SLO_MS", raising=False)
+        assert config.slo_ms() == 0.0
+
+    def test_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_SLO_MS", "2500")
+        assert config.slo_ms() == 2500.0
+
+    def test_fractional_ok(self, monkeypatch):
+        monkeypatch.setenv("T4J_SLO_MS", "0.5")
+        assert config.slo_ms() == 0.5
+
+    @pytest.mark.parametrize("bad", ["soon", "-100", "inf", "nan"])
+    def test_rejects_garbage(self, bad, monkeypatch):
+        monkeypatch.setenv("T4J_SLO_MS", bad)
+        with pytest.raises(ValueError, match="T4J_SLO_MS"):
+            config.slo_ms()
+
+
+class TestMaxBatch:
+    """T4J_MAX_BATCH (docs/serving.md): concurrent decode slots in
+    the serving engine's KV pool."""
+
+    def test_default(self, monkeypatch):
+        monkeypatch.delenv("T4J_MAX_BATCH", raising=False)
+        assert config.max_batch() == 8
+
+    def test_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_MAX_BATCH", "32")
+        assert config.max_batch() == 32
+
+    @pytest.mark.parametrize("bad", ["0", "1025", "-3"])
+    def test_rejects_out_of_range(self, bad, monkeypatch):
+        monkeypatch.setenv("T4J_MAX_BATCH", bad)
+        with pytest.raises(ValueError, match="T4J_MAX_BATCH"):
+            config.max_batch()
+
+    def test_rejects_garbage(self, monkeypatch):
+        monkeypatch.setenv("T4J_MAX_BATCH", "many")
+        with pytest.raises(ValueError, match="T4J_MAX_BATCH"):
+            config.max_batch()
+
+
+class TestAdmitMode:
+    """T4J_ADMIT (docs/serving.md "admission control"): off = admit
+    everything (the uncontrolled baseline), on = token bucket + SLO
+    shedding.  A typo'd mode must fail at launch, not silently serve
+    uncontrolled while the operator believes the SLO is guarded."""
+
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("T4J_ADMIT", raising=False)
+        assert config.admit_mode() == "off"
+
+    @pytest.mark.parametrize("value,want", [
+        ("off", "off"), ("on", "on"), (" ON ", "on"), ("", "off"),
+    ])
+    def test_values(self, value, want, monkeypatch):
+        monkeypatch.setenv("T4J_ADMIT", value)
+        assert config.admit_mode() == want
+
+    @pytest.mark.parametrize("bad", ["auto", "1", "slo", "shed"])
+    def test_rejects_garbage(self, bad, monkeypatch):
+        monkeypatch.setenv("T4J_ADMIT", bad)
+        with pytest.raises(ValueError, match="T4J_ADMIT"):
+            config.admit_mode()
+
+
+def test_ensure_initialized_rejects_slo_without_admission(monkeypatch):
+    """An SLO with admission off cannot be enforced, only missed —
+    the combination fails at init, naming both knobs
+    (docs/serving.md "admission control")."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_SLO_MS", "1000")
+    monkeypatch.setenv("T4J_ADMIT", "off")
+    with pytest.raises(ValueError, match="T4J_ADMIT=off"):
+        runtime.ensure_initialized()
+
+
+def test_ensure_initialized_rejects_bad_admit(monkeypatch):
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_ADMIT", "shed-everything")
+    with pytest.raises(ValueError, match="T4J_ADMIT"):
+        runtime.ensure_initialized()
+
+
+def test_ensure_initialized_rejects_bad_max_batch(monkeypatch):
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_MAX_BATCH", "0")
+    with pytest.raises(ValueError, match="T4J_MAX_BATCH"):
+        runtime.ensure_initialized()

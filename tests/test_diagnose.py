@@ -854,3 +854,68 @@ class TestExporterMembership:
         assert agg["world_epoch"] == 2
         assert agg["world_size"] == 8
         assert agg["departed_ranks"] == []
+
+
+class TestExporterServing:
+    """The serving gauges on the launcher job view (docs/serving.md):
+    the frontend's queue/shed/SLO block rides the aggregate next to
+    the membership gauges, and the Prometheus job rendering carries
+    queue depth, batch occupancy, shed count and p99-vs-SLO."""
+
+    @staticmethod
+    def _serving(rank, **over):
+        sv = {
+            "schema": "t4j-serving-v1", "admit_mode": "on",
+            "slo_ms": 500.0, "max_batch": 4, "queue_depth": 2,
+            "batch_occupancy": 3, "steps": 10, "submitted": 12,
+            "completed": 9, "shed": 1,
+            "shed_by_reason": {"predicted-miss": 1}, "slo_ok": 9,
+            "slo_attainment": 0.9, "latency_p50_ms": 80.0,
+            "latency_p99_ms": 420.0, "first_token_p50_ms": 20.0,
+            "first_token_p99_ms": 60.0,
+        }
+        sv.update(over)
+        return sv
+
+    def _snap(self, rank, serving=None):
+        return exporter.build_snapshot(
+            rank=rank, world=4, mode="counters", metrics=[],
+            serving=serving,
+        )
+
+    def test_job_view_takes_frontend_block(self):
+        # rank 0 is the frontend; followers publish occupancy-only
+        # blocks the aggregate must not prefer
+        objs = [
+            self._snap(1, self._serving(1, queue_depth=0,
+                                        submitted=0)),
+            self._snap(0, self._serving(0)),
+            self._snap(2),
+        ]
+        agg = exporter.aggregate_snapshots(objs, job="serve")
+        assert agg["serving"]["queue_depth"] == 2
+        assert agg["serving"]["submitted"] == 12
+        assert agg["serving_ranks"] == [0, 1]
+
+    def test_job_prometheus_serving_rows(self):
+        agg = exporter.aggregate_snapshots(
+            [self._snap(0, self._serving(0))], job="serve"
+        )
+        text = exporter.render_prometheus_job(agg)
+        assert "t4j_job_serving_queue_depth 2" in text
+        assert "t4j_job_serving_batch_occupancy 3" in text
+        assert "t4j_job_serving_shed_total 1" in text
+        assert "t4j_job_serving_completed_total 9" in text
+        assert "t4j_job_serving_latency_p99_ms 420.0" in text
+        assert "t4j_job_serving_slo_ms 500.0" in text
+        assert "t4j_job_serving_slo_attainment 0.9" in text
+        assert "t4j_job_serving_ranks 1" in text
+
+    def test_job_view_without_serving_unchanged(self):
+        agg = exporter.aggregate_snapshots(
+            [self._snap(0), self._snap(1)], job="j"
+        )
+        assert agg["serving"] == {}
+        assert "t4j_job_serving" not in exporter.render_prometheus_job(
+            agg
+        )

@@ -1,0 +1,735 @@
+"""Serving pure core (mpi4jax_tpu/serving/): request lifecycle, slot
+scheduler, follower mirror, plan codec, admission control, load
+generator, and the stats/gauge surface.
+
+The package's pure core is deliberately import-free of jax (like
+telemetry/ and tuning/), so these tests run on every container —
+including old-jax ones where ``import mpi4jax_tpu`` raises at the
+version gate: the loader below registers a lightweight package stub
+and imports the real subpackage under it (the tests/test_telemetry.py
+pattern).
+
+The jax half (the continuous-batching engine over the transformer KV
+machinery) is covered end-to-end by tests/proc/test_serving_proc.py
+and the ci_smoke ``serving`` lane (tools/serving_smoke.py).
+"""
+
+import importlib
+import pathlib
+import sys
+import types
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_serving():
+    try:
+        import mpi4jax_tpu.serving as serving
+
+        return serving
+    except Exception:
+        # stub the parent just long enough to import the jax-free
+        # subpackage, then REMOVE it (see tests/test_telemetry.py for
+        # why a lingering stub would change the tier-1 failure set)
+        stubbed = "mpi4jax_tpu" not in sys.modules
+        if stubbed:
+            stub = types.ModuleType("mpi4jax_tpu")
+            stub.__path__ = [str(REPO / "mpi4jax_tpu")]
+            sys.modules["mpi4jax_tpu"] = stub
+        try:
+            return importlib.import_module("mpi4jax_tpu.serving")
+        finally:
+            if stubbed:
+                sys.modules.pop("mpi4jax_tpu", None)
+
+
+serving = _load_serving()
+admission = importlib.import_module(serving.__name__ + ".admission")
+loadgen = importlib.import_module(serving.__name__ + ".loadgen")
+plan_mod = importlib.import_module(serving.__name__ + ".plan")
+request = importlib.import_module(serving.__name__ + ".request")
+scheduler = importlib.import_module(serving.__name__ + ".scheduler")
+stats_mod = importlib.import_module(serving.__name__ + ".stats")
+
+Request = request.Request
+RequestState = request.RequestState
+SlotScheduler = scheduler.SlotScheduler
+FollowerMirror = scheduler.FollowerMirror
+SchedulerError = scheduler.SchedulerError
+
+
+def _req(rid=0, p_len=4, max_new=4, arrival=0.0, deadline=None):
+    return Request(rid, tuple(range(1, p_len + 1)), max_new, arrival,
+                   deadline_ms=deadline)
+
+
+# ---- request lifecycle ---------------------------------------------------
+
+
+class TestRequest:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_new"):
+            Request(0, (1, 2), 0, 0.0)
+        with pytest.raises(ValueError, match="empty prompt"):
+            Request(0, (), 3, 0.0)
+
+    def test_latency_none_in_flight(self):
+        r = _req()
+        assert r.latency_ms() is None
+        r.done_ms = 50.0
+        assert r.latency_ms() == 50.0
+
+    def test_within_slo_requires_completion(self):
+        r = _req(deadline=100.0)
+        assert not r.within_slo()  # still queued
+        r.state = RequestState.DONE
+        r.done_ms = 80.0
+        assert r.within_slo()
+        r.done_ms = 120.0
+        assert not r.within_slo()
+
+    def test_no_deadline_completion_is_within(self):
+        r = _req()
+        r.state = RequestState.DONE
+        r.done_ms = 9999.0
+        assert r.within_slo()
+
+    def test_shed_never_within_slo(self):
+        r = _req(deadline=1e9)
+        r.state = RequestState.SHED
+        r.done_ms = 1.0
+        assert not r.within_slo()
+
+
+# ---- slot scheduler ------------------------------------------------------
+
+
+class TestSlotScheduler:
+    def test_admits_fifo_into_free_slots(self):
+        s = SlotScheduler(max_batch=2, max_len=16,
+                          max_prefill_per_step=2)
+        a, b, c = _req(0), _req(1), _req(2)
+        for r in (a, b, c):
+            s.submit(r, 0.0)
+        plan = s.plan_step(0.0)
+        assert [(sl, r.rid) for sl, r in plan.admissions] == [
+            (0, 0), (1, 1)
+        ]
+        assert s.queue_depth() == 1
+        assert s.occupancy() == 2
+        assert a.state == RequestState.ADMITTED
+
+    def test_prefill_per_step_bound(self):
+        s = SlotScheduler(max_batch=4, max_len=16)
+        for i in range(3):
+            s.submit(_req(i), 0.0)
+        plan = s.plan_step(0.0)
+        assert len(plan.admissions) == 1  # default bound = 1
+
+    def test_decode_joins_after_prefill(self):
+        s = SlotScheduler(max_batch=2, max_len=16)
+        s.submit(_req(0, p_len=4, max_new=3), 0.0)
+        p0 = s.plan_step(0.0)
+        assert p0.decode_slots == []
+        s.prefill_done(0, 0.0)
+        s.step_done(p0, 0.0)
+        p1 = s.plan_step(1.0)
+        assert p1.decode_slots == [0]
+        assert p1.positions == [4]  # next write pos = prompt_len
+
+    def test_completion_after_max_new(self):
+        s = SlotScheduler(max_batch=1, max_len=32)
+        r = _req(0, p_len=4, max_new=3)
+        s.submit(r, 0.0)
+        p = s.plan_step(0.0)
+        s.prefill_done(0, 0.0)  # token 1
+        s.step_done(p, 0.0)
+        for _ in range(2):  # tokens 2, 3
+            p = s.plan_step(0.0)
+            s.step_done(p, 0.0)
+        assert r.state == RequestState.DONE
+        assert r.generated == 3
+        assert s.finished == [r]
+        assert s.occupancy() == 0
+
+    def test_budget_clamps_generation(self):
+        s = SlotScheduler(max_batch=1, max_len=8)
+        r = _req(0, p_len=6, max_new=50)
+        s.submit(r, 0.0)
+        p = s.plan_step(0.0)
+        s.prefill_done(0, 0.0)
+        s.step_done(p, 0.0)
+        p = s.plan_step(0.0)
+        s.step_done(p, 0.0)
+        # positions 6..7 exist; prefill emits idx 6, one decode emits 7
+        assert r.state == RequestState.DONE
+        assert r.generated == 2
+
+    def test_prompt_filling_budget_completes_at_prefill(self):
+        s = SlotScheduler(max_batch=1, max_len=8)
+        r = _req(0, p_len=7, max_new=5)
+        s.submit(r, 0.0)
+        s.plan_step(0.0)
+        s.prefill_done(0, 0.0)
+        assert r.state == RequestState.DONE
+        assert r.generated == 1
+
+    def test_oversized_prompt_rejected(self):
+        s = SlotScheduler(max_batch=1, max_len=8)
+        with pytest.raises(SchedulerError, match="no room"):
+            s.submit(_req(0, p_len=8), 0.0)
+
+    def test_freed_slot_reusable_next_plan(self):
+        s = SlotScheduler(max_batch=1, max_len=16)
+        s.submit(_req(0, p_len=4, max_new=1), 0.0)
+        s.plan_step(0.0)
+        s.prefill_done(0, 0.0)  # completes instantly (max_new=1)
+        s.submit(_req(1), 1.0)
+        p = s.plan_step(1.0)
+        assert [(sl, r.rid) for sl, r in p.admissions] == [(0, 1)]
+
+    def test_shed_queued(self):
+        s = SlotScheduler(max_batch=1, max_len=16)
+        r = _req(0)
+        s.submit(r, 0.0)
+        s.shed_request(r, 1.0, "test-reason")
+        assert r.state == RequestState.SHED
+        assert r.shed_reason == "test-reason"
+        assert s.shed == 1
+        assert s.queue_depth() == 0
+        s.check_accounting()
+
+    def test_shed_at_door_counts(self):
+        s = SlotScheduler(max_batch=1, max_len=16)
+        r = _req(0)
+        s.shed_request(r, 0.0, "bucket")  # never submitted
+        assert s.submitted == 1 and s.shed == 1
+        s.check_accounting()
+
+    def test_shed_active_raises(self):
+        s = SlotScheduler(max_batch=1, max_len=16)
+        r = _req(0)
+        s.submit(r, 0.0)
+        s.plan_step(0.0)
+        with pytest.raises(SchedulerError, match="completion"):
+            s.shed_request(r, 0.0, "late")
+
+    def test_accounting_leak_detected(self):
+        s = SlotScheduler(max_batch=1, max_len=16)
+        s.submit(_req(0), 0.0)
+        s.submitted += 1  # corrupt the books
+        with pytest.raises(SchedulerError, match="request leak"):
+            s.check_accounting()
+
+    def test_step_done_on_free_slot_raises(self):
+        s = SlotScheduler(max_batch=2, max_len=16)
+        s.submit(_req(0), 0.0)
+        p = s.plan_step(0.0)
+        s.prefill_done(0, 0.0)
+        p2 = s.plan_step(0.0)
+        s.step_done(p2, 0.0)
+        fake = scheduler.StepPlan(99, [], [1], [4])
+        with pytest.raises(SchedulerError, match="free"):
+            s.step_done(fake, 0.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_batch"):
+            SlotScheduler(0, 16)
+        with pytest.raises(ValueError, match="max_len"):
+            SlotScheduler(1, 1)
+
+
+# ---- follower mirror + digest -------------------------------------------
+
+
+class TestFollowerMirror:
+    def _drive(self, steps=12, max_batch=2, max_len=16):
+        """Leader and mirror side by side: every plan's pre-state
+        digest must agree, every applied plan must keep them agreeing."""
+        leader = SlotScheduler(max_batch, max_len,
+                               max_prefill_per_step=2)
+        mirror = FollowerMirror(max_batch, max_len)
+        rid = 0
+        for i in range(steps):
+            if i % 3 == 0:
+                leader.submit(_req(rid, p_len=3 + rid % 4,
+                                   max_new=1 + rid % 5), float(i))
+                rid += 1
+            digest = leader.state_digest()
+            assert digest == mirror.state_digest(), f"drift at step {i}"
+            plan = leader.plan_step(float(i))
+            vec = plan_mod.encode_plan(plan, max_batch, max_len, digest)
+            decoded = plan_mod.decode_plan(
+                vec, max_batch, max_len,
+                expect_digest=mirror.state_digest(),
+            )
+            admitted, _fin = mirror.apply(decoded)
+            for slot, _req2 in plan.admissions:
+                leader.prefill_done(slot, float(i))
+            for slot, _r, _p, _m in admitted:
+                mirror.prefill_done(slot)
+            leader.step_done(plan, float(i))
+        return leader, mirror
+
+    def test_stays_in_lockstep(self):
+        leader, mirror = self._drive()
+        assert leader.state_digest() == mirror.state_digest()
+        assert mirror.completed == leader.completed
+
+    def test_drift_raises_plan_error(self):
+        leader = SlotScheduler(2, 16)
+        mirror = FollowerMirror(2, 16)
+        leader.submit(_req(0), 0.0)
+        digest = leader.state_digest()
+        plan = leader.plan_step(0.0)
+        vec = plan_mod.encode_plan(plan, 2, 16, digest)
+        decoded = plan_mod.decode_plan(vec, 2, 16,
+                                       expect_digest=digest)
+        mirror.apply(decoded)
+        # replaying the same admission plan = follower drift
+        with pytest.raises(plan_mod.PlanError, match="diverged"):
+            plan_mod.decode_plan(vec, 2, 16,
+                                 expect_digest=mirror.state_digest())
+
+    def test_decode_pos_mismatch_raises(self):
+        mirror = FollowerMirror(2, 16)
+        decoded = {
+            "step": 0, "stop": False, "admissions": [], "prompts": [],
+            "decode_slots": [0], "positions": [4],
+        }
+        with pytest.raises(SchedulerError, match="mirror has"):
+            mirror.apply(decoded)
+
+
+# ---- plan codec ----------------------------------------------------------
+
+
+class TestPlanCodec:
+    def test_roundtrip_with_prompts(self):
+        s = SlotScheduler(3, 16, max_prefill_per_step=2)
+        s.submit(_req(7, p_len=5, max_new=4, deadline=1234.0), 0.0)
+        s.submit(_req(8, p_len=2, max_new=9), 0.0)
+        digest = s.state_digest()
+        plan = s.plan_step(0.0)
+        vec = plan_mod.encode_plan(plan, 3, 16, digest)
+        assert len(vec) == plan_mod.plan_words(3, 16)
+        d = plan_mod.decode_plan(vec, 3, 16, expect_digest=digest)
+        assert d["step"] == plan.step
+        assert not d["stop"]
+        assert d["admissions"] == [
+            (0, 7, 5, 4, 1234.0), (1, 8, 2, 9, None)
+        ]
+        assert d["prompts"] == [(1, 2, 3, 4, 5), (1, 2)]
+
+    def test_stop_flag(self):
+        plan = scheduler.StepPlan(3, [], [], [])
+        vec = plan_mod.encode_plan(plan, 2, 8, 0, stop=True)
+        assert plan_mod.decode_plan(vec, 2, 8)["stop"]
+
+    def test_bad_magic(self):
+        vec = [0] * plan_mod.plan_words(2, 8)
+        with pytest.raises(plan_mod.PlanError, match="magic"):
+            plan_mod.decode_plan(vec, 2, 8)
+
+    def test_truncated_vector(self):
+        with pytest.raises(plan_mod.PlanError, match="words"):
+            plan_mod.decode_plan([plan_mod.MAGIC, 0, 0], 2, 8)
+
+    def test_counts_out_of_range(self):
+        vec = [plan_mod.MAGIC, 0, 0, 99, 0, 0] + [0] * (
+            plan_mod.plan_words(2, 8) - 6
+        )
+        with pytest.raises(plan_mod.PlanError, match="out of range"):
+            plan_mod.decode_plan(vec, 2, 8)
+
+    def test_prompt_over_p_max_rejected(self):
+        plan = scheduler.StepPlan(
+            0, [(0, _req(0, p_len=9))], [], []
+        )
+        with pytest.raises(plan_mod.PlanError, match="p_max"):
+            plan_mod.encode_plan(plan, 2, 8, 0)
+
+    def test_digest_check_optional(self):
+        plan = scheduler.StepPlan(0, [], [], [])
+        vec = plan_mod.encode_plan(plan, 2, 8, 42)
+        d = plan_mod.decode_plan(vec, 2, 8)  # no expect_digest
+        assert d["digest"] == 42
+
+
+# ---- token bucket --------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_dry(self):
+        b = admission.TokenBucket(rate_per_s=10, burst=3)
+        assert [b.allow(0.0) for _ in range(4)] == [
+            True, True, True, False
+        ]
+
+    def test_refills_at_rate(self):
+        b = admission.TokenBucket(rate_per_s=10, burst=1)
+        assert b.allow(0.0)
+        assert not b.allow(50.0)   # 0.5 token accrued
+        assert b.allow(150.0)      # >= 1 token accrued
+
+    def test_rate_zero_always_allows(self):
+        b = admission.TokenBucket(0, 1)
+        assert all(b.allow(t) for t in range(100))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            admission.TokenBucket(-1, 1)
+        with pytest.raises(ValueError):
+            admission.TokenBucket(1, 0)
+
+
+# ---- SLO estimator -------------------------------------------------------
+
+
+class TestSLOEstimator:
+    def test_ewma_converges(self):
+        e = admission.SLOEstimator(alpha=0.5, seed_step_ms=100.0)
+        for _ in range(20):
+            e.observe_step(10.0)
+        assert abs(e.step_ms - 10.0) < 0.1
+
+    def test_prefill_per_token(self):
+        e = admission.SLOEstimator(alpha=1.0)
+        e.observe_prefill(50.0, prompt_len=10)
+        assert e.prefill_ms_per_tok == pytest.approx(5.0)
+
+    def test_predict_monotonic_in_queue(self):
+        e = admission.SLOEstimator(seed_step_ms=10.0)
+        args = dict(prompt_len=8, max_new=8, occupancy=2, max_batch=4,
+                    residual_ms=40.0)
+        a = e.predict_ms(queue_ahead=0, **args)
+        b = e.predict_ms(queue_ahead=6, **args)
+        assert b > a
+
+    def test_predict_scales_with_degradation(self):
+        e = admission.SLOEstimator(seed_step_ms=10.0)
+        args = dict(prompt_len=8, max_new=8, queue_ahead=2,
+                    occupancy=4, max_batch=4, residual_ms=40.0)
+        assert (e.predict_ms(degradation=3.0, **args)
+                > e.predict_ms(degradation=1.0, **args))
+
+    def test_residual_service(self):
+        e = admission.SLOEstimator(seed_step_ms=10.0)
+        reqs = [_req(0, max_new=10), _req(1, max_new=2)]
+        reqs[0].generated = 4
+        reqs[1].generated = 1
+        # mean remaining = (6 + 1)/2 tokens * 10 ms
+        assert e.residual_service_ms(reqs) == pytest.approx(35.0)
+        assert e.residual_service_ms([]) == 0.0
+
+
+# ---- fabric degradation --------------------------------------------------
+
+
+class TestDegradationFactor:
+    def test_empty_view_is_neutral(self):
+        assert admission.degradation_factor(None) == (1.0, ())
+        assert admission.degradation_factor({}) == (1.0, ())
+
+    def test_repairing_link_penalised(self):
+        f, reasons = admission.degradation_factor(
+            {"worst_link": {"state": 1, "rank": 0, "peer": 3,
+                            "reconnects": 0}}
+        )
+        assert f == pytest.approx(2.0)
+        assert any("state=1" in r for r in reasons)
+
+    def test_reconnects_penalised(self):
+        f, reasons = admission.degradation_factor(
+            {"worst_link": {"state": 0, "reconnects": 4}}
+        )
+        assert f == pytest.approx(1.5)
+        assert any("4 reconnect" in r for r in reasons)
+
+    def test_both_stack(self):
+        f, _ = admission.degradation_factor(
+            {"worst_link": {"state": 2, "reconnects": 9}}
+        )
+        assert f == pytest.approx(2.5)
+
+
+# ---- admission controller ------------------------------------------------
+
+
+class TestAdmissionController:
+    def test_off_admits_everything(self):
+        c = admission.AdmissionController("off")
+        s = SlotScheduler(1, 16)
+        for i in range(50):
+            v, reason = c.decide(_req(i), 0.0, s)
+            assert v == "admit" and reason is None
+
+    def test_off_with_slo_rejected(self):
+        with pytest.raises(ValueError, match="admission mode 'off'"):
+            admission.AdmissionController("off", slo_ms=100.0)
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="'off' or 'on'"):
+            admission.AdmissionController("auto")
+
+    def test_bucket_shed(self):
+        c = admission.AdmissionController(
+            "on", bucket=admission.TokenBucket(1, 1)
+        )
+        s = SlotScheduler(1, 16)
+        assert c.decide(_req(0), 0.0, s)[0] == "admit"
+        v, reason = c.decide(_req(1), 0.0, s)
+        assert v == "shed" and reason == c.SHED_BUCKET
+
+    def test_predicted_miss_shed(self):
+        est = admission.SLOEstimator(seed_step_ms=100.0)
+        c = admission.AdmissionController("on", slo_ms=200.0,
+                                          estimator=est)
+        s = SlotScheduler(1, 16)
+        # 8 tokens x 100 ms/step >> 200 ms deadline
+        r = _req(0, max_new=8, deadline=200.0)
+        v, reason = c.decide(r, 0.0, s)
+        assert v == "shed" and reason == c.SHED_PREDICTED
+
+    def test_fast_service_admits_under_slo(self):
+        est = admission.SLOEstimator(seed_step_ms=1.0,
+                                     seed_prefill_ms_per_tok=0.1)
+        c = admission.AdmissionController("on", slo_ms=500.0,
+                                          estimator=est)
+        s = SlotScheduler(4, 16)
+        assert c.decide(_req(0, deadline=500.0), 0.0, s)[0] == "admit"
+
+    def test_degradation_tips_the_decision(self):
+        est = admission.SLOEstimator(seed_step_ms=20.0,
+                                     seed_prefill_ms_per_tok=0.1)
+        c = admission.AdmissionController("on", slo_ms=200.0,
+                                          estimator=est)
+        s = SlotScheduler(4, 16)
+        r = _req(0, max_new=8, deadline=200.0)
+        assert c.decide(r, 0.0, s)[0] == "admit"
+        c.observe_fabric(
+            {"worst_link": {"state": 1, "reconnects": 3}}
+        )
+        r2 = _req(1, max_new=8, deadline=200.0)
+        assert c.decide(r2, 0.0, s)[0] == "shed"
+
+    def test_reconsider_sheds_hopeless_queued(self):
+        est = admission.SLOEstimator(seed_step_ms=1.0,
+                                     seed_prefill_ms_per_tok=0.1)
+        c = admission.AdmissionController("on", slo_ms=100.0,
+                                          estimator=est)
+        s = SlotScheduler(1, 16)
+        r = _req(0, max_new=8, deadline=100.0)
+        s.submit(r, 0.0)
+        assert c.reconsider_queued(0.0, s) == []
+        # 99 ms later even a free slot cannot land it inside 100 ms
+        victims = c.reconsider_queued(99.0, s)
+        assert victims == [r]
+        assert r.state == RequestState.SHED
+        assert r.shed_reason == c.SHED_HOPELESS
+        s.check_accounting()
+
+    def test_reconsider_noop_when_off(self):
+        c = admission.AdmissionController("off")
+        s = SlotScheduler(1, 16)
+        s.submit(_req(0), 0.0)
+        assert c.reconsider_queued(1e9, s) == []
+
+
+# ---- load generator ------------------------------------------------------
+
+
+class TestLoadGen:
+    def test_deterministic(self):
+        a = loadgen.LoadGen(seed=5, rate_rps=100)
+        b = loadgen.LoadGen(seed=5, rate_rps=100)
+        ra, rb = a.take(20), b.take(20)
+        assert [r.prompt for r in ra] == [r.prompt for r in rb]
+        assert [r.arrival_ms for r in ra] == [r.arrival_ms for r in rb]
+        assert [r.max_new for r in ra] == [r.max_new for r in rb]
+
+    def test_poisson_mean_rate(self):
+        g = loadgen.LoadGen(seed=1, rate_rps=50)
+        reqs = g.take(2000)
+        mean_gap = reqs[-1].arrival_ms / len(reqs)
+        assert 15 < mean_gap < 25  # 1/50 s = 20 ms +- sampling noise
+
+    def test_until_matches_take(self):
+        a = loadgen.LoadGen(seed=9, rate_rps=200)
+        b = loadgen.LoadGen(seed=9, rate_rps=200)
+        taken = a.take(30)
+        horizon = taken[-1].arrival_ms
+        got = []
+        t = 0.0
+        while t < horizon:
+            t = min(t + 7.0, horizon)
+            got.extend(b.until(t))
+        assert [r.rid for r in got] == [r.rid for r in taken]
+        assert [r.prompt for r in got] == [r.prompt for r in taken]
+        assert [r.arrival_ms for r in got] == [
+            r.arrival_ms for r in taken
+        ]
+
+    def test_rids_sequential(self):
+        g = loadgen.LoadGen(seed=2, rate_rps=10)
+        assert [r.rid for r in g.take(5)] == [0, 1, 2, 3, 4]
+
+    def test_prompt_bounds_and_vocab(self):
+        g = loadgen.LoadGen(seed=3, rate_rps=10,
+                            prompt_len=("uniform", 2, 5), vocab=16)
+        for r in g.take(100):
+            assert 2 <= r.prompt_len <= 5
+            assert all(0 <= t < 16 for t in r.prompt)
+
+    def test_deadline_stamping(self):
+        g = loadgen.LoadGen(seed=4, rate_rps=10,
+                            deadline_fn=lambda t: t + 500.0)
+        r = g.next_request()
+        assert r.deadline_ms == pytest.approx(r.arrival_ms + 500.0)
+
+    def test_dist_specs(self):
+        rng = __import__("random").Random(0)
+        assert loadgen.make_dist(("fixed", 7))(rng) == 7
+        lo_hi = {loadgen.make_dist(("bimodal", 2, 9, 0.5))(rng)
+                 for _ in range(50)}
+        assert lo_hi == {2, 9}
+        with pytest.raises(ValueError, match="unknown distribution"):
+            loadgen.make_dist(("zipf", 1))
+        with pytest.raises(ValueError, match="lo <= hi"):
+            loadgen.make_dist(("uniform", 5, 2))
+        with pytest.raises(ValueError, match="rate_rps"):
+            loadgen.LoadGen(seed=0, rate_rps=0)
+
+
+# ---- stats / gauges ------------------------------------------------------
+
+
+class TestServingStats:
+    def _completed(self, lat_ms, deadline=None):
+        r = _req(0, deadline=deadline)
+        r.state = RequestState.DONE
+        r.first_token_ms = lat_ms / 2
+        r.done_ms = lat_ms
+        return r
+
+    def test_slo_attainment_counts_sheds(self):
+        s = stats_mod.ServingStats(slo_ms=100.0)
+        s.observe_completed(self._completed(50.0, deadline=100.0))
+        s.observe_completed(self._completed(150.0, deadline=100.0))
+        s.observe_shed("predicted-miss")
+        # 1 in-SLO out of 3 offered: sheds count against attainment
+        assert s.slo_attainment() == pytest.approx(1 / 3)
+
+    def test_attainment_none_before_traffic(self):
+        assert stats_mod.ServingStats().slo_attainment() is None
+
+    def test_percentiles_clamped_to_observed(self):
+        s = stats_mod.ServingStats()
+        for ms in (10.0, 12.0, 14.0):
+            s.observe_completed(self._completed(ms))
+        snap = s.snapshot()
+        assert 10.0 <= snap["latency_p50_ms"] <= 14.0
+        assert 10.0 <= snap["latency_p99_ms"] <= 14.0
+
+    def test_minute_scale_tail_not_flattened(self):
+        # the native op-latency histogram tops out at ~8.6 s; the
+        # end-to-end histogram must keep resolving far beyond it, or
+        # an overloaded baseline's p99 would read ~12 s no matter how
+        # badly it blew up
+        s = stats_mod.ServingStats()
+        for ms in [10_000.0] * 9 + [300_000.0]:
+            s.observe_completed(self._completed(ms))
+        snap = s.snapshot()
+        assert snap["latency_p50_ms"] < 20_000
+        assert snap["latency_p99_ms"] > 100_000
+
+    def test_snapshot_schema(self):
+        s = stats_mod.ServingStats(slo_ms=250.0, max_batch=4,
+                                   admit_mode="on")
+        s.observe_step(queue_depth=3, occupancy=2)
+        s.observe_shed("token-bucket")
+        snap = s.snapshot()
+        assert snap["schema"] == stats_mod.SERVING_SCHEMA
+        for key in ("queue_depth", "batch_occupancy", "shed",
+                    "completed", "submitted", "slo_ms",
+                    "slo_attainment", "latency_p50_ms",
+                    "latency_p99_ms", "admit_mode", "max_batch"):
+            assert key in snap, key
+        assert snap["queue_depth"] == 3
+        assert snap["batch_occupancy"] == 2
+        assert snap["shed_by_reason"] == {"token-bucket": 1}
+
+    def test_publish_current(self):
+        stats_mod.publish({"schema": stats_mod.SERVING_SCHEMA})
+        assert stats_mod.current() == {
+            "schema": stats_mod.SERVING_SCHEMA
+        }
+        stats_mod.publish(None)
+        assert stats_mod.current() is None
+
+
+# ---- closed loop (pure) --------------------------------------------------
+
+
+class TestClosedLoop:
+    def _run(self, admit, rate, slo=300.0, steps=300, step_ms=5.0):
+        gen = loadgen.LoadGen(
+            seed=11, rate_rps=rate, prompt_len=("uniform", 2, 6),
+            max_new=("uniform", 2, 8), vocab=32,
+        )
+        sched = SlotScheduler(4, 16)
+        est = admission.SLOEstimator(seed_step_ms=step_ms,
+                                     seed_prefill_ms_per_tok=0.5)
+        ctrl = admission.AdmissionController(
+            admit, slo_ms=slo if admit == "on" else 0.0,
+            estimator=est,
+        )
+        stats = stats_mod.ServingStats(slo_ms=slo, max_batch=4,
+                                       admit_mode=admit)
+        gen.deadline_fn = lambda t: t + slo
+        now = 0.0
+        for _ in range(steps):
+            now += step_ms
+            for req in gen.until(now):
+                stats.observe_submitted()
+                v, reason = ctrl.decide(req, now, sched)
+                if v == "admit":
+                    sched.submit(req, now)
+                else:
+                    sched.shed_request(req, now, reason)
+                    stats.observe_shed(reason)
+            for r in ctrl.reconsider_queued(now, sched):
+                stats.observe_shed(r.shed_reason)
+            plan = sched.plan_step(now)
+            for slot, req in plan.admissions:
+                est.observe_prefill(step_ms / 2, req.prompt_len)
+                sched.prefill_done(slot, now)
+            if plan.decode_slots:
+                est.observe_step(step_ms)
+            sched.step_done(plan, now)
+            for r in sched.finished:
+                stats.observe_completed(r)
+            sched.finished.clear()
+            stats.observe_step(sched.queue_depth(), sched.occupancy())
+        sched.check_accounting()
+        return sched, stats
+
+    def test_overload_with_admission_sheds_and_balances(self):
+        sched, stats = self._run("on", rate=400)
+        snap = stats.snapshot()
+        assert snap["shed"] > 0
+        assert snap["completed"] > 0
+        # honest books: offered = completed + shed + still in system
+        assert (sched.submitted
+                == sched.completed + sched.shed
+                + sched.queue_depth() + sched.occupancy())
+
+    def test_gentle_load_no_sheds(self):
+        _sched, stats = self._run("on", rate=20)
+        assert stats.snapshot()["shed"] == 0
+
+    def test_admission_off_never_sheds(self):
+        _sched, stats = self._run("off", rate=400)
+        assert stats.snapshot()["shed"] == 0

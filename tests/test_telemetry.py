@@ -576,6 +576,88 @@ class TestMembershipGaugeCycle:
         assert "t4j_rank_departed" not in t2
 
 
+class TestServingGauges:
+    """The exporter's serving gauges (docs/serving.md): per-rank
+    t4j_serving_* rows and the t4j-top serving line, next to the
+    membership gauges above — queue depth, batch occupancy, shed
+    count, p99-vs-SLO."""
+
+    @staticmethod
+    def _serving(**over):
+        sv = {
+            "schema": "t4j-serving-v1", "admit_mode": "on",
+            "slo_ms": 500.0, "max_batch": 4, "queue_depth": 3,
+            "batch_occupancy": 2, "steps": 40, "submitted": 30,
+            "completed": 20, "shed": 5,
+            "shed_by_reason": {"predicted-miss": 5}, "slo_ok": 18,
+            "slo_attainment": 0.72, "latency_p50_ms": 120.0,
+            "latency_p99_ms": 480.0, "first_token_p50_ms": 40.0,
+            "first_token_p99_ms": 90.0,
+        }
+        sv.update(over)
+        return sv
+
+    def _snap(self, rank=0, **over):
+        return exporter.build_snapshot(
+            rank=rank, world=8, mode="counters", metrics=[],
+            serving=self._serving(**over),
+        )
+
+    def test_rank_prometheus_serving_rows(self):
+        text = exporter.render_prometheus(self._snap())
+        assert 't4j_serving_queue_depth{rank="0"} 3' in text
+        assert 't4j_serving_batch_occupancy{rank="0"} 2' in text
+        assert 't4j_serving_shed_total{rank="0"} 5' in text
+        assert 't4j_serving_completed_total{rank="0"} 20' in text
+        assert 't4j_serving_latency_p99_ms{rank="0"} 480.0' in text
+        assert 't4j_serving_slo_ms{rank="0"} 500.0' in text
+        assert 't4j_serving_slo_attainment{rank="0"} 0.72' in text
+
+    def test_snapshot_without_serving_unchanged(self):
+        snap = exporter.build_snapshot(rank=0, world=2,
+                                       mode="counters", metrics=[])
+        assert snap["serving"] == {}
+        assert "t4j_serving" not in exporter.render_prometheus(snap)
+
+    def test_no_slo_omits_slo_rows(self):
+        text = exporter.render_prometheus(
+            self._snap(slo_ms=None))
+        assert "t4j_serving_queue_depth" in text
+        assert "t4j_serving_slo_ms" not in text
+
+    def test_stopped_engine_is_marked(self):
+        # a stopped engine's final gauges stay published for exit-time
+        # rank files, but a live scrape must be able to tell
+        live = exporter.render_prometheus(self._snap())
+        assert "t4j_serving_stopped" not in live
+        stopped = exporter.render_prometheus(self._snap(stopped=True))
+        assert 't4j_serving_stopped{rank="0"} 1' in stopped
+
+    def test_top_serving_line(self):
+        objs = [
+            dump.build_rank_obj(
+                rank=r, world=2, anchor_mono_ns=0, anchor_unix_ns=0,
+                mode="counters",
+                serving=self._serving() if r == 0 else None,
+            )
+            for r in range(2)
+        ]
+        summary = top.summarize(objs)
+        assert summary["serving"]["rank"] == 0
+        assert summary["serving"]["queue_depth"] == 3
+        text = "\n".join(top.render(summary).splitlines())
+        assert "serving: admit=on queue 3 occupancy 2/4" in text
+        assert "p99 480ms/500ms SLO" in text
+        assert "attain 0.72" in text
+
+    def test_top_without_serving_has_no_line(self):
+        objs = [dump.build_rank_obj(
+            rank=0, world=1, anchor_mono_ns=0, anchor_unix_ns=0,
+            mode="counters",
+        )]
+        assert "serving:" not in top.render(top.summarize(objs))
+
+
 # ---- flight recorder (crash-consistent mmap arena) -----------------------
 
 

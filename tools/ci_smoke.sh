@@ -107,6 +107,18 @@
 #                (T4J_STRIPES=2 elastic_smoke) so the resize path
 #                stays green over striped links.  ctypes only — runs
 #                on old-jax containers.
+#  16. serving — tools/serving_smoke.py twice: plain and under
+#                AddressSanitizer.  The continuous-batching serving
+#                control plane (docs/serving.md) over the real native
+#                bridge: an 8-rank Poisson burst past capacity with
+#                admission ON must shed (counted, never swallowed)
+#                while every rank executes the digest-checked
+#                broadcast step plans and converges to the identical
+#                completion sequence, then drain to zero
+#                queued/active requests at exit; an admission-OFF
+#                phase must complete everything with zero sheds.
+#                ctypes + the jax-free serving pure core only — runs
+#                on old-jax containers.
 #  13. autotune — tools/autotune_smoke.py twice: plain and under
 #                AddressSanitizer.  An 8-rank calibrate phase (the
 #                collective knob fit measured through the telemetry
@@ -128,7 +140,7 @@ cd "$(dirname "$0")/.."
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
   lanes=(tier1 fault proc asan tsan lint resilience telemetry async
-         diagnose bench elastic autotune postmortem stripe)
+         diagnose bench elastic autotune postmortem stripe serving)
 fi
 
 run_lane() {
@@ -232,8 +244,14 @@ assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
       run_lane stripe-elastic env -u T4J_SANITIZE T4J_STRIPES=2 \
         timeout -k 10 1200 python tools/elastic_smoke.py 8
       ;;
+    serving)
+      run_lane serving-plain env -u T4J_SANITIZE timeout -k 10 900 \
+        python tools/serving_smoke.py 8
+      run_lane serving-asan env T4J_SANITIZE=address timeout -k 10 900 \
+        python tools/serving_smoke.py 8
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem|stripe)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem|stripe|serving)" >&2
       exit 2
       ;;
   esac

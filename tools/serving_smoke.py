@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Serving smoke lane: the continuous-batching control plane over the
+real native bridge (docs/serving.md).
+
+Two phases over an N-rank (default 8) proc world driven through
+``native/runtime.py``'s ctypes surface plus the jax-free ``serving``
+pure core (stub-loaded, so the lane runs on old-jax containers and
+under sanitizer preloads — the tools/autotune_smoke.py harness shape).
+The model is SIMULATED (each decode step is one real native allreduce
+sized like a decode activation + a fixed service delay); the
+scheduler / admission / plan-broadcast machinery is the real thing:
+
+  1. burst — a short seeded Poisson burst deliberately past capacity
+             with admission ON and a tight SLO: rank 0 plans, every
+             rank executes the broadcast plans (digest-checked
+             mirrors), sheds MUST happen and be counted, every rank
+             must converge to the identical completion set, and the
+             drain must leave zero queued/active requests (the
+             request-leak check passes).
+  2. open  — the same machinery with admission OFF at a gentle rate:
+             zero sheds, everything completes, clean drain — the
+             uncontrolled baseline stays byte-honest.
+
+Run under AddressSanitizer by exporting ``T4J_SANITIZE=address``
+before invoking (tools/ci_smoke.sh does).
+
+Usage: python tools/serving_smoke.py [nprocs] [--phase burst|open]
+"""
+
+import hashlib
+import importlib
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+import types
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _stub_packages():
+    """Lightweight package stubs so the jax-free submodules (serving/,
+    telemetry/, utils/config.py, native/runtime.py) import by their
+    real dotted names on containers where the package __init__ refuses
+    (old jax) — the tools/telemetry_smoke.py pattern."""
+    for name in ("mpi4jax_tpu", "mpi4jax_tpu.utils",
+                 "mpi4jax_tpu.native"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [str(REPO / name.replace(".", "/"))]
+            sys.modules[name] = mod
+
+
+def _load(name):
+    try:
+        return importlib.import_module(name)
+    except Exception:
+        _stub_packages()
+        return importlib.import_module(name)
+
+
+def _sanitizer_env():
+    san = os.environ.get("T4J_SANITIZE", "").strip().lower()
+    if not san:
+        return {}
+    lib = {"address": "libasan.so", "asan": "libasan.so",
+           "1": "libasan.so", "thread": "libtsan.so",
+           "tsan": "libtsan.so"}.get(san)
+    if lib is None:
+        return {}
+    paths = []
+    for name in (lib, "libstdc++.so.6"):
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={name}"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+        if out and out != name:
+            paths.append(out)
+    if not paths:
+        return {}
+    return {
+        "LD_PRELOAD": " ".join(paths),
+        "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
+        "TSAN_OPTIONS": "report_bugs=1",
+    }
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ worker
+
+SUM_OP = 0  # reductions.SUM's native opcode
+MAX_BATCH = 3
+MAX_LEN = 24
+D_SIM = 256  # simulated decode-activation floats per allreduce
+
+
+def worker():
+    import numpy as np
+
+    runtime = _load("mpi4jax_tpu.native.runtime")
+    config = _load("mpi4jax_tpu.utils.config")
+    serving = _load("mpi4jax_tpu.serving")
+
+    rank = int(os.environ["T4J_RANK"])
+    n = int(os.environ["T4J_SIZE"])
+    phase = os.environ["SMOKE_PHASE"]
+    admit = "on" if phase == "burst" else "off"
+    slo_ms = 250.0 if admit == "on" else 0.0
+
+    lib = runtime._load()
+    lib.t4j_set_timeouts(config.op_timeout(), config.connect_timeout())
+    rc = lib.t4j_init()
+    assert rc == 0, (rc, runtime.last_error())
+
+    plan_words = serving.plan_words(MAX_BATCH, MAX_LEN)
+
+    def bcast_plan(vec_or_none):
+        if vec_or_none is None:
+            buf = np.zeros(plan_words, np.int64)
+        else:
+            buf = np.asarray(vec_or_none, np.int64)
+        return runtime.host_bcast(0, buf, 0)
+
+    def simulate_decode(n_active):
+        # the decode step's wire footprint: one real allreduce of a
+        # decode-activation-sized vector, plus a deterministic
+        # service floor so the SLO math has something to measure
+        x = np.full(D_SIM * max(1, n_active), 1.0 + rank, np.float32)
+        out = runtime.host_allreduce(0, x, SUM_OP)
+        time.sleep(0.004)
+        return out
+
+    completions = []  # (rid, generated) in completion order
+
+    if rank == 0:
+        sched = serving.SlotScheduler(MAX_BATCH, MAX_LEN)
+        est = serving.SLOEstimator(seed_step_ms=6.0,
+                                   seed_prefill_ms_per_tok=0.2)
+        ctrl = serving.AdmissionController(
+            admit, slo_ms=slo_ms, estimator=est,
+        )
+        stats = serving.ServingStats(slo_ms=slo_ms,
+                                     max_batch=MAX_BATCH,
+                                     admit_mode=admit)
+        rate = 120.0 if phase == "burst" else 25.0
+        gen = serving.LoadGen(
+            seed=7, rate_rps=rate, prompt_len=("uniform", 2, 8),
+            max_new=("uniform", 3, 10), vocab=64,
+            deadline_fn=ctrl.deadline_for,
+        )
+        horizon_ms = 700.0 if phase == "burst" else 500.0
+        t0 = time.perf_counter()
+        now_ms = lambda: (time.perf_counter() - t0) * 1e3  # noqa: E731
+
+        def leader_step(stop=False):
+            now = now_ms()
+            for req in ctrl.reconsider_queued(now, sched):
+                stats.observe_shed(req.shed_reason)
+            digest = sched.state_digest()
+            plan = sched.plan_step(now)
+            bcast_plan(serving.encode_plan(
+                plan, MAX_BATCH, MAX_LEN, digest, stop=stop))
+            t_step = time.perf_counter()
+            if plan.decode_slots or plan.admissions:
+                simulate_decode(len(plan.decode_slots))
+            wall = (time.perf_counter() - t_step) * 1e3
+            if plan.decode_slots:
+                est.observe_step(wall)
+            elif plan.admissions:
+                est.observe_prefill(
+                    wall,
+                    max(r.prompt_len for _s, r in plan.admissions),
+                )
+            for slot, _req in plan.admissions:
+                sched.prefill_done(slot, now_ms())
+            sched.step_done(plan, now_ms())
+            for req in sched.finished:
+                completions.append((req.rid, req.generated))
+                stats.observe_completed(req)
+            sched.finished.clear()
+            stats.observe_step(sched.queue_depth(), sched.occupancy())
+
+        while now_ms() < horizon_ms:
+            for req in gen.until(now_ms()):
+                stats.observe_submitted()
+                verdict, reason = ctrl.decide(req, now_ms(), sched)
+                if verdict == "admit":
+                    sched.submit(req, now_ms())
+                else:
+                    sched.shed_request(req, now_ms(), reason)
+                    stats.observe_shed(reason)
+            leader_step()
+        while not sched.idle():  # clean drain at exit
+            leader_step()
+        leader_step(stop=True)
+        sched.check_accounting()
+        snap = stats.snapshot()
+        assert snap["queue_depth"] == 0, snap
+        assert snap["batch_occupancy"] == 0, snap
+        if phase == "burst":
+            assert snap["shed"] > 0, (
+                "overload burst with admission on shed nothing", snap
+            )
+            assert snap["completed"] > 0, snap
+            assert snap["shed_by_reason"], snap
+        else:
+            assert snap["shed"] == 0, snap
+            assert snap["completed"] == snap["submitted"], snap
+        print(f"SMOKE-STATS {snap['submitted']} {snap['completed']} "
+              f"{snap['shed']}", flush=True)
+    else:
+        mirror = serving.scheduler.FollowerMirror(MAX_BATCH, MAX_LEN)
+        while True:
+            vec = bcast_plan(None)
+            decoded = serving.decode_plan(
+                vec, MAX_BATCH, MAX_LEN,
+                expect_digest=mirror.state_digest(),
+            )
+            admitted, finished = mirror.apply(decoded)
+            if decoded["decode_slots"] or admitted:
+                simulate_decode(len(decoded["decode_slots"]))
+            for slot, rid, _prompt, _mn in admitted:
+                done = mirror.prefill_done(slot)
+                if done is not None:
+                    completions.append((done[1], 1))
+            for _slot, rid in finished:
+                completions.append((rid, -1))
+            if decoded["stop"]:
+                break
+        assert mirror.idle(), "follower mirror not drained at stop"
+
+    # every rank must agree on WHICH requests completed, in order
+    # (followers don't know generated counts for multi-step requests;
+    # agreement is on the rid sequence)
+    rid_seq = ",".join(str(r) for r, _g in completions)
+    dig = hashlib.sha256(rid_seq.encode()).digest()[:8]
+    import numpy as np
+
+    all_digs = runtime.host_allgather(
+        0, np.frombuffer(dig, np.uint8)
+    )
+    uniq = {bytes(all_digs[i].tobytes()) for i in range(n)}
+    assert len(uniq) == 1, (
+        f"rank {rank}: completion sets diverged across ranks"
+    )
+    print(f"SMOKE-SERVE-OK {rank} completions={len(completions)}",
+          flush=True)
+    lib.t4j_finalize()
+
+
+# ------------------------------------------------------------------ driver
+
+
+def run_phase(phase, n):
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.update(
+            T4J_RANK=str(r), T4J_SIZE=str(n), T4J_COORD=coord,
+            T4J_NO_SHM="1", SMOKE_PHASE=phase,
+        )
+        env.update(_sanitizer_env())
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__, "worker"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    ok = True
+    outs = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        if p.returncode != 0:
+            ok = False
+        print(f"--- [{phase}] rank {r} (rc={p.returncode}) ---")
+        print(out[-2000:])
+    if not ok:
+        return False
+    if not all("SMOKE-SERVE-OK" in o for o in outs):
+        return False
+    if "SMOKE-STATS" not in outs[0]:
+        return False
+    return True
+
+
+def main():
+    argv = list(sys.argv[1:])
+    phases = ["burst", "open"]
+    if "--phase" in argv:
+        i = argv.index("--phase")
+        phases = [argv[i + 1]]
+        del argv[i:i + 2]  # the value must not be parsed as nprocs
+    args = [a for a in argv if not a.startswith("--")]
+    n = int(args[0]) if args else 8
+    ok = True
+    for phase in phases:
+        ok = run_phase(phase, n) and ok
+    print("SERVING-SMOKE-OK" if ok else "SERVING-SMOKE-FAIL")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker()
+    else:
+        main()
