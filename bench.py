@@ -569,6 +569,62 @@ def proc_striped_busbw(timeout=1200):
     return striped, single, sratio, zratio
 
 
+def proc_compress_busbw(timeout=1200):
+    """Compressed collectives (docs/performance.md "Compressed
+    collectives"): one 8-rank TCP-tier job with every rank its own
+    emulated host (T4J_EMU_LOCAL=1 — compression engages only on
+    cross-host hops) under the per-flow throttle (T4J_EMU_FLOW_BPS=48M
+    — the NIC-bound regime where the wire-byte halving becomes a time
+    halving), running ``proc_busbw.py --wire-dtype off,bf16,fp8``
+    interleaved arms on 64 MB.  Returns ``(off_record, bf16_record,
+    fp8_record, bf16_ratio_record, fp8_ratio_record)``; any may be
+    None."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
+    import os as _os
+
+    recs = {"off": None, "bf16": None, "fp8": None}
+    ratios = {"bf16": None, "fp8": None}
+    try:
+        env = dict(_os.environ)
+        env["T4J_NO_SHM"] = "1"
+        env["T4J_EMU_LOCAL"] = "1"
+        env["T4J_EMU_FLOW_BPS"] = "48M"
+        env["T4J_TUNING_CACHE"] = "off"
+        env["T4J_SEG_BYTES"] = "262144"
+        out = subprocess.run(
+            [sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+             str(script), "--wire-dtype", "off,bf16,fp8", "--mb", "64",
+             "--reps", "2"],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent), env=env,
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            metric = rec.get("metric", "")
+            mode = rec.get("wire_dtype")
+            if metric == "allreduce_busbw_proc8" and mode in recs:
+                recs[mode] = rec
+            elif (metric == "allreduce_compress_vs_f32_proc8"
+                  and mode in ratios):
+                ratios[mode] = rec
+        if ratios["bf16"] is None:
+            print(
+                f"[bench] compress busbw produced no ratio record "
+                f"(rc={out.returncode}): {out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] compress busbw failed: {exc}", file=sys.stderr)
+    return (recs["off"], recs["bf16"], recs["fp8"],
+            ratios["bf16"], ratios["fp8"])
+
+
 def proc_autotune_pair(timeout=900):
     """Mis-default recovery (docs/performance.md "trace-guided
     autotuning"): one 8-rank TCP-tier job running
@@ -1062,6 +1118,7 @@ def run_bench(quick=False):
         _skip("proc_autotune_pair", "quick mode")
         _skip("proc_halo_latency", "quick mode")
         _skip("proc_striped_busbw", "quick mode")
+        _skip("proc_compress_busbw", "quick mode")
         _skip("proc_serving", "quick mode")
     elif not native_ok:
         _skip("proc_tcp_busbw", native_reason)
@@ -1070,6 +1127,7 @@ def run_bench(quick=False):
         _skip("proc_autotune_pair", native_reason)
         _skip("proc_halo_latency", native_reason)
         _skip("proc_striped_busbw", native_reason)
+        _skip("proc_compress_busbw", native_reason)
         _skip("proc_serving", native_reason)
     ring_rec, tree_rec = proc_tcp_busbw() if run_heavy_proc else (None, None)
     if run_heavy_proc and ring_rec is None and tree_rec is None:
@@ -1164,6 +1222,30 @@ def run_bench(quick=False):
         extras["zerocopy_vs_copy_ratio"] = zc_ratio["value"]
     elif run_heavy_proc:
         _skip("proc_zerocopy_pair", "no record produced")
+    # compressed collectives (this PR's tentpole): bf16/fp8 wire dtypes
+    # vs the f32 baseline on a flow-capped 64 MB allreduce with every
+    # rank its own emulated host — the NIC-bound regime where halving
+    # the wire bytes halves the time (docs/performance.md "Compressed
+    # collectives"); each arm's record carries its wire-counter deltas
+    # so a ratio measured against a non-engaged arm is self-labelling
+    cp_off, cp_bf16, cp_fp8, cp_bratio, cp_fratio = (
+        proc_compress_busbw() if run_heavy_proc
+        else (None, None, None, None, None)
+    )
+    if run_heavy_proc and cp_off is None and cp_bratio is None:
+        _skip("proc_compress_busbw", "no record produced")
+    if cp_off is not None:
+        extras["allreduce_busbw_proc8_wire_off_gbps"] = cp_off["value"]
+    if cp_bf16 is not None:
+        extras["allreduce_busbw_proc8_bf16_gbps"] = cp_bf16["value"]
+    if cp_fp8 is not None:
+        extras["allreduce_busbw_proc8_fp8_gbps"] = cp_fp8["value"]
+    if cp_bratio is not None:
+        extras["compress_vs_f32_ratio"] = cp_bratio["value"]
+    elif run_heavy_proc and cp_off is not None:
+        _skip("proc_compress_ratio", "no ratio record produced")
+    if cp_fratio is not None:
+        extras["compress_fp8_vs_f32_ratio"] = cp_fratio["value"]
     # serving under SLO (docs/serving.md): p50/p99/rps/shed-rate and
     # SLO attainment of the admission-controlled arm, with the
     # uncontrolled baseline's p99 + attainment as the contrast —

@@ -107,6 +107,19 @@ def main():
         "zerocopy_vs_copy ratio is emitted",
     )
     ap.add_argument(
+        "--wire-dtype", default=None, metavar="LIST", dest="wire_dtype",
+        help="compressed-collective arms (docs/performance.md "
+        "\"Compressed collectives\"): comma list of wire dtypes "
+        "(off,bf16,fp8) A/B'd INTERLEAVED inside one world via "
+        "runtime.set_wire_dtype.  Compression only engages on "
+        "cross-host hops, so on a loopback box launch with "
+        "T4J_NO_SHM=1 T4J_EMU_LOCAL=1 (every rank its own emulated "
+        "host) and T4J_EMU_FLOW_BPS to emulate the NIC bottleneck "
+        "that makes the byte saving a time saving; composes with "
+        "--stripes (the compressed segments ride the striped wire).  "
+        "One record per arm plus a compress_vs_f32 ratio record",
+    )
+    ap.add_argument(
         "--widths", default="1,4,16",
         help="halo widths for --op halo (comma list)",
     )
@@ -158,6 +171,9 @@ def main():
 
     if args.op == "halo":
         return _halo_main(args, comm)
+
+    if args.wire_dtype:
+        return _wire_dtype_main(args, comm)
 
     if args.stripes:
         return _stripes_main(args, comm)
@@ -580,6 +596,136 @@ def _stripes_main(args, comm):
             "payload_mb": nbytes / 1e6,
             "stripes": widest,
             "zerocopy_min_bytes": zc_req,
+        }), flush=True)
+
+
+def _wire_dtype_main(args, comm):
+    """Interleaved compressed-collective arms (docs/performance.md
+    "Compressed collectives").
+
+    One world; each timed batch rotates through the requested wire
+    dtypes back to back (``runtime.set_wire_dtype(mode)`` is a pure
+    runtime knob — no rebuild, no renegotiation), so phase noise hits
+    every arm equally — the same interleaving convention as the
+    hier/flat and striped pairs.  Compression engages only when every
+    ring hop is cross-host, so a loopback box must launch with
+    ``T4J_NO_SHM=1 T4J_EMU_LOCAL=1`` (each rank its own emulated
+    host); ``T4J_EMU_FLOW_BPS`` then makes the byte saving a TIME
+    saving the way a NIC-bound fabric would.  Per-arm wire byte
+    counters (``runtime.wire_dtype_info`` deltas) ride each record as
+    proof the arm actually compressed — a record whose
+    ``wire_bytes_delta`` is 0 for a compressed mode measured the f32
+    path and says so via ``compressed_engaged``.  With ``--stripes N``
+    the arms run at that dealing width (compressed segments ride the
+    striped wire).  Rank 0 prints one record per arm plus a
+    ``compress_vs_f32`` ratio record per compressed mode."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.ops._proc import proc_topology
+    from mpi4jax_tpu.utils import config
+
+    n = comm.size
+    modes = []
+    for tokn in str(args.wire_dtype).split(","):
+        tokn = tokn.strip().lower()
+        if not tokn:
+            continue
+        if tokn not in runtime.WIRE_DTYPE_CODES:
+            raise SystemExit(
+                f"--wire-dtype: unknown mode {tokn!r} "
+                f"(want {'|'.join(runtime.WIRE_DTYPE_CODES)})"
+            )
+        if tokn not in modes:
+            modes.append(tokn)
+    if "off" not in modes:
+        modes.insert(0, "off")  # the f32 baseline every ratio divides by
+
+    info0 = runtime.wire_dtype_info() or {}
+    launched = info0.get("wire_dtype", "off")
+    winfo = runtime.wire_info() or {}
+    stripes = None
+    if args.stripes:
+        built = int(winfo.get("stripes_built", 1) or 1)
+        stripes = min(max(int(w) for w in str(args.stripes).split(",")
+                          if w), built)
+        runtime.set_wire(stripes=stripes)
+
+    per = max(int(args.mb * 1e6 / 4), n)
+    per -= per % max(n, 1)
+    x = jnp.ones((per,), jnp.float32)
+    nbytes = per * 4
+    factor = _busbw_factor("allreduce", n)
+
+    tok = m.create_token()
+    for mode in modes:  # warm every arm (compile + staging buffers)
+        runtime.set_wire_dtype(mode)
+        y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        np.asarray(y)
+    best = {}
+    wire_delta = {}
+    for _ in range(3):
+        for mode in modes:
+            runtime.set_wire_dtype(mode)
+            tok = _fence(comm, tok)
+            before = runtime.wire_dtype_info() or {}
+            t0 = time.perf_counter()
+            for _ in range(args.reps):
+                y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+            np.asarray(y)
+            dt = (time.perf_counter() - t0) / args.reps
+            best[mode] = min(best.get(mode, float("inf")), dt)
+            after = runtime.wire_dtype_info() or {}
+            wire_delta[mode] = {
+                k: int(after.get(k, 0)) - int(before.get(k, 0))
+                for k in ("wire_logical_bytes", "wire_bytes")
+            }
+    runtime.set_wire_dtype(launched)
+    if comm.rank() != 0:
+        return
+    topo = proc_topology(comm)
+    vals = {}
+    for mode in modes:
+        busbw = nbytes * factor / best[mode]
+        vals[mode] = busbw
+        delta = wire_delta.get(mode, {})
+        print(json.dumps({
+            "metric": f"allreduce_busbw_proc{n}",
+            "value": round(busbw / 1e9, 3),
+            "unit": "GB/s",
+            "nprocs": n,
+            "payload_mb": nbytes / 1e6,
+            "payload_bytes": nbytes,
+            "sec_per_call": round(best[mode], 6),
+            "data_plane": "ring" if nbytes >= config.ring_min_bytes()
+            else "tree",
+            "wire_dtype": mode,
+            "compressed_engaged": bool(delta.get("wire_bytes", 0) > 0),
+            "wire_logical_bytes_delta": delta.get(
+                "wire_logical_bytes", 0),
+            "wire_bytes_delta": delta.get("wire_bytes", 0),
+            "stripes": stripes,
+            "emu_flow_bps": int(winfo.get("emu_flow_bps", 0) or 0),
+            "local_world": topo["local_size"],
+            "leader_world": topo["n_hosts"],
+            "seg_bytes": config.seg_bytes(),
+            "interleaved_pairs": True,
+        }), flush=True)
+    for mode in modes:
+        if mode == "off":
+            continue
+        print(json.dumps({
+            "metric": f"allreduce_compress_vs_f32_proc{n}",
+            "value": round(vals[mode] / vals["off"], 2),
+            "unit": "x",
+            "nprocs": n,
+            "payload_mb": nbytes / 1e6,
+            "wire_dtype": mode,
+            "compressed_engaged": bool(
+                wire_delta.get(mode, {}).get("wire_bytes", 0) > 0),
+            "emu_flow_bps": int(winfo.get("emu_flow_bps", 0) or 0),
         }), flush=True)
 
 

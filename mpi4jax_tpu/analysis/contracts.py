@@ -24,6 +24,7 @@ digests across ranks.
 """
 
 import hashlib
+import os
 import re
 from dataclasses import dataclass
 
@@ -67,6 +68,9 @@ RULES = {
     "T4J008": "request never waited: a nonblocking op's request is not "
               "consumed by wait/waitall before the trace ends, or a "
               "request is waited more than once",
+    "T4J009": "mixed wire dtypes on one communicator: ranks disagree on "
+              "the compressed-collective wire dtype for a reduction step "
+              "(T4J_WIRE_DTYPE must be set uniformly across every rank)",
 }
 
 
@@ -443,7 +447,25 @@ def classify_trace_error(exc):
 # ----------------------------------------------------------- fingerprints
 
 
-def step_signature(ev):
+def _effective_wire_dtype():
+    """This rank's effective compressed-collective wire dtype
+    (``off|bf16|fp8``), preferring the native bridge's answer (which
+    reflects the calibrator's fit applied at tuning startup) over the
+    raw env knob.  Invalid env spellings read as ``off`` here — loud
+    validation is utils/config.py's job at bridge init."""
+    try:
+        from mpi4jax_tpu.native import runtime
+
+        info = runtime.wire_dtype_info()
+        if info:
+            return info.get("wire_dtype", "off")
+    except Exception:
+        pass
+    mode = str(os.environ.get("T4J_WIRE_DTYPE") or "").strip().lower()
+    return mode if mode in ("bf16", "fp8") else "off"
+
+
+def step_signature(ev, wire_dtype=None):
     """Canonical one-line signature of a schedule step.
 
     This is the unit of cross-rank agreement: two ranks executing "the
@@ -452,6 +474,15 @@ def step_signature(ev):
     token identities) are excluded; fields that must agree (op kind,
     comm identity and size, dtype/shape, reduce op, root, tag, and the
     p2p pattern) are included.
+
+    The trailing field is the rank's effective **wire dtype** for steps
+    the compressed-collective policy applies to (f32 SUM reductions,
+    docs/performance.md "Compressed collectives") — a per-RANK knob that
+    must nevertheless agree across a comm, because mixed modes run
+    mismatched wire framing and corrupt the reduction.  Divergence only
+    in this field is reported as rule T4J009 rather than the generic
+    T4J007 (:func:`divergence_message`).  ``wire_dtype`` overrides the
+    ambient mode (tests, offline replay of another job's schedule).
     """
     parts = [
         ev.kind,
@@ -474,6 +505,13 @@ def step_signature(ev):
             parts.append(f"{name}={spec}")
         else:
             parts.append(f"{name}:{_spec_kind(spec)}")
+    if ev.reduce_op == "sum" and ev.dtype == "float32":
+        mode = _effective_wire_dtype() if wire_dtype is None else wire_dtype
+        parts.append(f"wire={mode}")
+    else:
+        # integer/MIN/MAX and non-reduction steps never compress
+        # (native comm_wire_dtype gate) — no wire field to disagree on
+        parts.append("-")
     return "|".join(parts)
 
 
@@ -526,10 +564,33 @@ def first_divergence(lines_by_rank):
     return None
 
 
+def _wire_only_divergence(details):
+    """If every rank's line at the diverging step agrees except in the
+    trailing ``wire=`` field, return the set of modes in play (the
+    T4J009 case); else ``None`` (generic T4J007)."""
+    rows = [str(line).split("|") for line in details.values()]
+    if len(rows) < 2 or any(len(r) < 2 for r in rows):
+        return None
+    if any(len(r) != len(rows[0]) for r in rows):
+        return None
+    if len({"|".join(r[:-1]) for r in rows}) != 1:
+        return None
+    tails = {r[-1] for r in rows}
+    if len(tails) > 1 and all(t.startswith("wire=") for t in tails):
+        return sorted(t[len("wire="):] for t in tails)
+    return None
+
+
 def divergence_message(step, details, deadline_hint=None):
     """Human-readable CommContractError text naming the first differing
     step — raised identically on every rank so each job log carries the
-    full diagnosis regardless of which rank the user inspects."""
+    full diagnosis regardless of which rank the user inspects.
+
+    A divergence confined to the wire-dtype field is its own rule: the
+    SCHEDULE agrees, the per-rank compression knob doesn't — the fix is
+    environmental (set ``T4J_WIRE_DTYPE`` uniformly, or let the tuning
+    broadcast set it), not a code change, so the message says so under
+    the dedicated ID T4J009."""
     by_line = {}
     for rank, line in sorted(details.items()):
         by_line.setdefault(line, []).append(rank)
@@ -538,12 +599,24 @@ def divergence_message(step, details, deadline_hint=None):
         f"{','.join(map(str, ranks))}: {line}"
         for line, ranks in by_line.items()
     )
-    msg = (
-        f"T4J007: communication schedules diverge at step {step}: "
-        f"{sides}. Every rank of a communicator must issue the same "
-        "collective sequence; a rank-dependent branch or a mismatched "
-        "tag/shape/reduce-op is the usual cause (docs/static-analysis.md)."
-    )
+    modes = _wire_only_divergence(details)
+    if modes is not None:
+        msg = (
+            f"T4J009: ranks mix compressed-collective wire dtypes "
+            f"({'/'.join(modes)}) on one communicator, first at step "
+            f"{step}: {sides}. The schedules agree — the per-rank "
+            "T4J_WIRE_DTYPE knob does not; set it identically on every "
+            "rank (or unset it and let the tuning broadcast decide) "
+            "(docs/static-analysis.md)."
+        )
+    else:
+        msg = (
+            f"T4J007: communication schedules diverge at step {step}: "
+            f"{sides}. Every rank of a communicator must issue the same "
+            "collective sequence; a rank-dependent branch or a mismatched "
+            "tag/shape/reduce-op is the usual cause "
+            "(docs/static-analysis.md)."
+        )
     if deadline_hint:
         msg += f" ({deadline_hint})"
     return msg

@@ -28,6 +28,8 @@ __all__ = [
     "set_tuning",
     "set_wire",
     "wire_info",
+    "set_wire_dtype",
+    "wire_dtype_info",
     "set_coalesce",
     "coalesce_bytes",
     "set_hier",
@@ -206,6 +208,12 @@ def _load():
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
     ]
     lib.t4j_wire_info.restype = ctypes.c_int32
+    lib.t4j_set_wire_dtype.argtypes = [ctypes.c_int32]
+    lib.t4j_wire_dtype_info.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.t4j_wire_dtype_info.restype = ctypes.c_int32
     lib.t4j_topo.argtypes = [ctypes.POINTER(ctypes.c_int32)] * 5
     lib.t4j_topo.restype = ctypes.c_int32
     lib.t4j_hier_would_select.argtypes = [ctypes.c_int32, ctypes.c_uint64]
@@ -452,7 +460,7 @@ def wire_info():
         ctypes.byref(batch), ctypes.byref(flow), ctypes.byref(zc),
         ctypes.byref(zc_done), ctypes.byref(zc_copied),
     )
-    return {
+    info = {
         "stripes_built": int(sb.value),
         "stripes_active": int(sa.value),
         "zerocopy_min_bytes": int(zmin.value),
@@ -464,6 +472,59 @@ def wire_info():
         # with no copy saved (docs/performance.md)
         "zc_completions": int(zc_done.value),
         "zc_copied": int(zc_copied.value),
+    }
+    info.update(wire_dtype_info() or {})
+    return info
+
+
+WIRE_DTYPE_CODES = {"off": 0, "bf16": 1, "fp8": 2}
+WIRE_DTYPE_NAMES = {v: k for k, v in WIRE_DTYPE_CODES.items()}
+
+
+def set_wire_dtype(mode=None):
+    """Runtime override of the compressed-collective wire dtype
+    (docs/performance.md "Compressed collectives"): ``"off"`` /
+    ``"bf16"`` / ``"fp8"`` or the native code 0/1/2; ``None`` keeps
+    the current value.  Runtime-changeable like the dealing width (the
+    calibrator and the interleaved benchmark arms A/B it inside one
+    world), but must stay uniform across ranks — divergent wire
+    dtypes exchange mismatched frame sizes and deadlock (t4j-lint rule
+    T4J009 names the divergence)."""
+    lib = _load()
+    if mode is None:
+        code = -1
+    elif isinstance(mode, str):
+        try:
+            code = WIRE_DTYPE_CODES[mode.strip().lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown wire dtype {mode!r} "
+                f"(want {'|'.join(WIRE_DTYPE_CODES)})"
+            ) from None
+    else:
+        code = int(mode)
+    lib.t4j_set_wire_dtype(code)
+
+
+def wire_dtype_info():
+    """Effective compressed-collective state: ``{"wire_dtype",
+    "wire_logical_bytes", "wire_bytes"}`` — the byte counters
+    accumulate over the compressed send path only (0 while the mode is
+    off), so ``wire_bytes / wire_logical_bytes`` is the provable wire
+    saving.  ``None`` when the native library was never loaded."""
+    lib = _state["lib"]
+    if lib is None:
+        return None
+    mode = ctypes.c_int32(0)
+    logical = ctypes.c_uint64(0)
+    wire = ctypes.c_uint64(0)
+    lib.t4j_wire_dtype_info(
+        ctypes.byref(mode), ctypes.byref(logical), ctypes.byref(wire)
+    )
+    return {
+        "wire_dtype": WIRE_DTYPE_NAMES.get(int(mode.value), "off"),
+        "wire_logical_bytes": int(logical.value),
+        "wire_bytes": int(wire.value),
     }
 
 
@@ -1452,6 +1513,14 @@ def ensure_initialized():
     zc_min = config.zerocopy_min_bytes()
     batch = config.sendmsg_batch()
     flow = config.emu_flow_bps()
+    # compressed-collective wire dtype (docs/performance.md
+    # "Compressed collectives"): a typo'd T4J_WIRE_DTYPE raises HERE,
+    # before init — silently running uncompressed would fake the
+    # benchmark the operator asked for.  Note the eligibility rule is
+    # per-collective in the native layer (f32 SUM only; integer and
+    # MIN/MAX payloads have no defined cast and always travel exact),
+    # so fp8/bf16 is a policy cap, not a promise.
+    wdtype = config.wire_dtype()
     if zc_min > 0 and zc_min < 4096:
         raise ValueError(
             f"T4J_ZEROCOPY_MIN_BYTES={zc_min} is below the page floor "
@@ -1502,6 +1571,7 @@ def ensure_initialized():
         0 if wire_stripes == "auto" else int(wire_stripes),
         zc_min, batch, flow,
     )
+    lib.t4j_set_wire_dtype(WIRE_DTYPE_CODES[wdtype])
     lib.t4j_set_hier(_HIER_MODES[hier], hier_min)
     lib.t4j_set_resilience(retry, boff_base, boff_max, replay)
     lib.t4j_set_elastic(_ELASTIC_MODES[elastic], world_floor, resize_s)

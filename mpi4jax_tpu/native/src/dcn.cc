@@ -34,6 +34,7 @@
 #include <cstring>
 #include <deque>
 #include <functional>
+#include <limits>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -274,6 +275,156 @@ long long emu_flow_bps() {
     g_emu_flow_bps.store(v, std::memory_order_relaxed);
   }
   return v;
+}
+
+// ---------------------------------------------- compressed wire dtype
+//
+// Low-precision wire dtypes for the segmented ring / hier-leader
+// collectives (docs/performance.md "Compressed collectives"): f32 SUM
+// payloads travel as bf16 or fp8(e4m3) on cross-host hops while the
+// accumulation and the user-visible result stay f32.  The downcast
+// lands in the wire staging buffer the send engine uses directly as
+// the frame payload (so with healing the replay arena copies — and
+// replays — the already-compressed bytes), and the upcast is fused
+// into the recv-combine fold: one pass either side, and compressed
+// segments are just smaller frames to the striping / self-heal /
+// telemetry machinery.  -1 = "not set yet"; Python validates via
+// utils/config.py and calls set_wire_dtype, the env parse is the
+// fallback for hand-run processes.
+
+constexpr int kWireOff = 0, kWireBf16 = 1, kWireFp8 = 2;
+
+std::atomic<int> g_wire_dtype{-1};
+// Cumulative logical (f32) vs wire (compressed) bytes over the
+// compressed send path: the provable byte saving for t4j-top /
+// t4j-diagnose.  Stay 0 while the mode is off.
+std::atomic<unsigned long long> g_wire_logical_bytes{0};
+std::atomic<unsigned long long> g_wire_comp_bytes{0};
+
+int wire_dtype_mode() {
+  int v = g_wire_dtype.load(std::memory_order_relaxed);
+  if (v < 0) {
+    const char* s = std::getenv("T4J_WIRE_DTYPE");
+    v = kWireOff;
+    if (s && s[0]) {
+      if (!std::strcmp(s, "bf16")) v = kWireBf16;
+      else if (!std::strcmp(s, "fp8")) v = kWireFp8;
+      // anything else (incl. "off") stays off: utils/config.py
+      // already failed loudly on invalid spellings at bridge init
+    }
+    g_wire_dtype.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+// Bytes per wire element (logical element is always 4-byte f32).
+inline size_t wire_elem_size(int wdt) { return wdt == kWireBf16 ? 2 : 1; }
+
+// f32 -> bf16, round-to-nearest-even, NaN quieted (the standard
+// truncation-with-rounding trick: add 0x7fff plus the LSB of the
+// result mantissa, then take the high half).
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  if ((u & 0x7fffffffu) > 0x7f800000u)  // NaN: quiet, keep sign
+    return static_cast<uint16_t>((u >> 16) | 0x0040);
+  u += 0x7fffu + ((u >> 16) & 1u);
+  return static_cast<uint16_t>(u >> 16);
+}
+
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t u = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &u, 4);
+  return f;
+}
+
+// f32 -> fp8 e4m3 (OCP E4M3: bias 7, no infinities, 0x7f mantissa
+// pattern is NaN, max finite 448).  Saturating: |x| > 448 (incl. inf)
+// clamps to +-448.  Subnormal quantum is 2^-9; round-to-nearest-even
+// throughout.
+inline uint8_t f32_to_fp8(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  uint8_t sign = static_cast<uint8_t>((u >> 24) & 0x80u);
+  uint32_t abs = u & 0x7fffffffu;
+  if (abs > 0x7f800000u) return static_cast<uint8_t>(sign | 0x7f);  // NaN
+  float af;
+  std::memcpy(&af, &abs, 4);
+  if (af > 448.0f) return static_cast<uint8_t>(sign | 0x7e);  // saturate
+  int e = static_cast<int>(abs >> 23) - 127;
+  if (e < -6) {
+    // subnormal range [0, 2^-6): quantize to multiples of 2^-9; a
+    // value rounding up to 2^-6 rolls naturally into code 8, the
+    // first normal
+    int q = static_cast<int>(lrintf(af * 512.0f));
+    return static_cast<uint8_t>(sign | static_cast<uint8_t>(q));
+  }
+  // normal: RNE into the 3-bit mantissa, re-derive the exponent (the
+  // round can carry into it), then pack biased-by-7
+  uint32_t r = abs + 0x7ffffu + ((abs >> 20) & 1u);
+  e = static_cast<int>(r >> 23) - 127;
+  uint32_t m = (r >> 20) & 7u;
+  return static_cast<uint8_t>(sign |
+                              static_cast<uint8_t>(((e + 7) << 3) | m));
+}
+
+// fp8 e4m3 -> f32 through a 256-entry LUT (magic-static init).
+inline const float* fp8_lut() {
+  static const float* table = [] {
+    static float t[256];
+    for (int b = 0; b < 256; ++b) {
+      int e = (b >> 3) & 0xf;
+      int m = b & 7;
+      float v;
+      if (e == 0)
+        v = static_cast<float>(m) * 0x1p-9f;  // subnormals (and +-0)
+      else if (e == 15 && m == 7)
+        v = std::numeric_limits<float>::quiet_NaN();
+      else
+        v = ldexpf(static_cast<float>(8 + m), e - 10);  // (8+m)*2^(e-7-3)
+    t[b] = (b & 0x80) ? -v : v;
+    }
+    return t;
+  }();
+  return table;
+}
+
+inline float fp8_to_f32(uint8_t b) { return fp8_lut()[b]; }
+
+// One-pass batch downcast into the wire staging buffer.
+void downcast_wire(int wdt, const float* in, uint8_t* out, size_t n) {
+  if (wdt == kWireBf16) {
+    uint16_t* o = reinterpret_cast<uint16_t*>(out);
+    for (size_t i = 0; i < n; ++i) o[i] = f32_to_bf16(in[i]);
+  } else {
+    for (size_t i = 0; i < n; ++i) out[i] = f32_to_fp8(in[i]);
+  }
+}
+
+// Fused upcast+combine: acc[i] = local[i] + upcast(wire[i]).  acc may
+// alias local (the in-place hier leader ring).
+void upcast_add_wire(int wdt, const float* local, const uint8_t* wire,
+                     float* acc, size_t n) {
+  if (wdt == kWireBf16) {
+    const uint16_t* w = reinterpret_cast<const uint16_t*>(wire);
+    for (size_t i = 0; i < n; ++i) acc[i] = local[i] + bf16_to_f32(w[i]);
+  } else {
+    const float* lut = fp8_lut();
+    for (size_t i = 0; i < n; ++i) acc[i] = local[i] + lut[wire[i]];
+  }
+}
+
+// Upcast-while-copying (the allgather phase of a compressed ring).
+void upcast_copy_wire(int wdt, const uint8_t* wire, float* dst,
+                      size_t n) {
+  if (wdt == kWireBf16) {
+    const uint16_t* w = reinterpret_cast<const uint16_t*>(wire);
+    for (size_t i = 0; i < n; ++i) dst[i] = bf16_to_f32(w[i]);
+  } else {
+    const float* lut = fp8_lut();
+    for (size_t i = 0; i < n; ++i) dst[i] = lut[wire[i]];
+  }
 }
 
 // ---------------------------------------------- hierarchical tuning
@@ -4020,6 +4171,32 @@ size_t seg_for(size_t dsize) {
   return (elems < 1 ? 1 : elems) * dsize;
 }
 
+// Effective wire dtype for ONE collective on ONE comm (docs/
+// performance.md "Compressed collectives").  Compression requires f32
+// SUM (the only dtype x op pair with a defined wire cast — integer and
+// MIN/MAX payloads always travel exact), a multi-member ring, and
+// EVERY ring hop crossing hosts: a single same-host (pipe-eligible)
+// pair would mix compressed and exact hops, and in the allgather
+// phase — where each block passes through every member — ranks
+// downstream of the exact hop would see different result bits than
+// the rest.  g_host_fps is the bootstrap-agreed host table (T4J_NO_SHM
+// and T4J_EMU_LOCAL already ride the fingerprint), so every rank
+// reaches the same verdict with no negotiation round.
+int comm_wire_dtype(const Comm& c, DType dt, ReduceOp op) {
+  if (dt != DType::kF32 || op != ReduceOp::kSum) return kWireOff;
+  int wdt = wire_dtype_mode();
+  if (wdt == kWireOff) return kWireOff;
+  int n = static_cast<int>(c.ranks.size());
+  if (n < 2 || c.my_index < 0) return kWireOff;
+  if (static_cast<int>(g_host_fps.size()) != g_size) return kWireOff;
+  for (int j = 0; j < n; ++j) {
+    if (g_host_fps[c.ranks[j]] ==
+        g_host_fps[c.ranks[ring_mod(j + 1, n)]])
+      return kWireOff;
+  }
+  return wdt;
+}
+
 void send_segmented(Comm& c, int dest_idx, int tag, const uint8_t* p,
                     size_t nbytes, size_t seg) {
   int wd = c.ranks[dest_idx];
@@ -4049,6 +4226,59 @@ void send_segmented(Comm& c, int dest_idx, int tag, const uint8_t* p,
   }
   link_send(wd, enc_ctx(c.ctx, /*coll=*/true), tag, bufs.data(),
             sizes.data(), bufs.size());
+}
+
+// Compressed variant of send_segmented for f32 payloads: downcast each
+// segment into a wire staging buffer and hand the (smaller) frames to
+// the striped send engine in one call.  The staging buffer IS the
+// frame payload, so with healing enabled the replay arena copies —
+// and on a link break replays — the already-compressed bytes, and
+// striping / syscall batching / flow emulation / per-frame telemetry
+// see nothing but ordinary smaller frames.  Caller guarantees
+// wdt != kWireOff, nbytes % 4 == 0, seg % 4 == 0, and (via
+// comm_wire_dtype) that the destination is a cross-host TCP peer.
+void send_segmented_compressed(Comm& c, int dest_idx, int tag,
+                               const uint8_t* p, size_t nbytes,
+                               size_t seg, int wdt) {
+  if (g_stop.load(std::memory_order_acquire)) raise_stopped();
+  if (nbytes == 0) return;
+  int wd = c.ranks[dest_idx];
+  size_t wsize = wire_elem_size(wdt);
+  size_t nelems = nbytes / 4;
+  Buf wire(nelems * wsize);
+  downcast_wire(wdt, reinterpret_cast<const float*>(p), wire.data(),
+                nelems);
+  std::vector<const void*> bufs;
+  std::vector<size_t> sizes;
+  bufs.reserve(nbytes / seg + 1);
+  sizes.reserve(nbytes / seg + 1);
+  size_t wseg = (seg / 4) * wsize;
+  for (size_t o = 0; o < nelems * wsize; o += wseg) {
+    size_t k = nelems * wsize - o < wseg ? nelems * wsize - o : wseg;
+    bufs.push_back(wire.data() + o);
+    sizes.push_back(k);
+  }
+  link_send(wd, enc_ctx(c.ctx, /*coll=*/true), tag, bufs.data(),
+            sizes.data(), bufs.size());
+  g_wire_logical_bytes.fetch_add(nbytes, std::memory_order_relaxed);
+  g_wire_comp_bytes.fetch_add(nelems * wsize,
+                              std::memory_order_relaxed);
+}
+
+// Quantise a resident f32 range in place (downcast then upcast).  The
+// allgather owner's copy of its own block must equal what every
+// receiver reconstructs from the wire bytes, or ranks would end the
+// collective with different result bits — the replicated-result
+// contract.  Round-tripping is idempotent (a wire-representable value
+// downcasts back to the same code), so the owner's subsequent send
+// carries exactly the codes the receivers already decode.
+void quantize_inplace_wire(int wdt, uint8_t* p, size_t nbytes) {
+  size_t nelems = nbytes / 4;
+  if (nelems == 0) return;
+  Buf tmp(nelems * wire_elem_size(wdt));
+  downcast_wire(wdt, reinterpret_cast<const float*>(p), tmp.data(),
+                nelems);
+  upcast_copy_wire(wdt, tmp.data(), reinterpret_cast<float*>(p), nelems);
 }
 
 template <typename T>
@@ -4127,6 +4357,47 @@ void recv_copy_segmented(Comm& c, int src_idx, int tag, uint8_t* dst,
   }
 }
 
+// Compressed counterpart of recv_combine_segmented: the upcast is
+// fused into the combine fold (acc[i] = local[i] + upcast(wire[i]),
+// one pass, no intermediate f32 buffer).  nbytes/seg are LOGICAL
+// (f32) quantities; the expected frame carries nbytes/4 wire
+// elements.  acc == local (the in-place hier leader ring) is legal,
+// exactly as for the exact path.
+void recv_combine_segmented_compressed(Comm& c, int src_idx, int tag,
+                                       const uint8_t* local,
+                                       uint8_t* acc, size_t nbytes,
+                                       size_t seg, int wdt) {
+  size_t wsize = wire_elem_size(wdt);
+  for (size_t o = 0; o < nbytes; o += seg) {
+    size_t k = nbytes - o < seg ? nbytes - o : seg;
+    size_t wk = (k / 4) * wsize;
+    Frame f = crecv(c, src_idx, tag);
+    if (f.data.size() != wk) fail_size(f, wk);
+    upcast_add_wire(wdt, reinterpret_cast<const float*>(local + o),
+                    f.data.data(), reinterpret_cast<float*>(acc + o),
+                    k / 4);
+  }
+}
+
+// Compressed counterpart of recv_copy_segmented (the allgather phase):
+// upcast while copying.  A block forwarded on the next step is
+// re-downcast, which is exact — downcast(upcast(x)) == x — so every
+// member of the ring materialises identical result bytes no matter
+// how many compressed hops a block took.
+void recv_copy_segmented_compressed(Comm& c, int src_idx, int tag,
+                                    uint8_t* dst, size_t nbytes,
+                                    size_t seg, int wdt) {
+  size_t wsize = wire_elem_size(wdt);
+  for (size_t o = 0; o < nbytes; o += seg) {
+    size_t k = nbytes - o < seg ? nbytes - o : seg;
+    size_t wk = (k / 4) * wsize;
+    Frame f = crecv(c, src_idx, tag);
+    if (f.data.size() != wk) fail_size(f, wk);
+    upcast_copy_wire(wdt, f.data.data(),
+                     reinterpret_cast<float*>(dst + o), k / 4);
+  }
+}
+
 // Ring reduce-scatter: block b starts accumulating at rank b+1 and
 // travels the ring once, so rank r ends holding block r fully reduced
 // in `out_block`.  Step s (0..n-2): send the partial of block r-1-s to
@@ -4138,7 +4409,7 @@ void recv_copy_segmented(Comm& c, int src_idx, int tag, uint8_t* dst,
 void ring_reduce_scatter(Comm& c, const uint8_t* in, uint8_t* out_block,
                          const std::vector<size_t>& off,
                          const std::vector<size_t>& len, DType dt,
-                         ReduceOp op) {
+                         ReduceOp op, int wdt = kWireOff) {
   int n = static_cast<int>(c.ranks.size());
   int me = c.my_index;
   int right = ring_mod(me + 1, n), left = ring_mod(me - 1, n);
@@ -4151,11 +4422,20 @@ void ring_reduce_scatter(Comm& c, const uint8_t* in, uint8_t* out_block,
   for (int s = 0; s < n - 1; ++s) {
     int sb = ring_mod(me - 1 - s, n);
     int rb = ring_mod(me - 2 - s, n);
-    send_segmented(c, right, kTagRingRS,
-                   s == 0 ? in + off[sb] : sending, len[sb], seg);
+    const uint8_t* sp = s == 0 ? in + off[sb] : sending;
+    if (wdt == kWireOff)
+      send_segmented(c, right, kTagRingRS, sp, len[sb], seg);
+    else
+      send_segmented_compressed(c, right, kTagRingRS, sp, len[sb], seg,
+                                wdt);
     uint8_t* acc = s == n - 2 ? out_block : building;
-    recv_combine_segmented(c, left, kTagRingRS, in + off[rb], acc,
-                           len[rb], seg, dt, op);
+    if (wdt == kWireOff)
+      recv_combine_segmented(c, left, kTagRingRS, in + off[rb], acc,
+                             len[rb], seg, dt, op);
+    else
+      recv_combine_segmented_compressed(c, left, kTagRingRS,
+                                        in + off[rb], acc, len[rb],
+                                        seg, wdt);
     std::swap(building, sending);
   }
 }
@@ -4164,16 +4444,27 @@ void ring_reduce_scatter(Comm& c, const uint8_t* in, uint8_t* out_block,
 // then travels the ring once.  Step s: send block r-s right, receive
 // block r-1-s from the left.
 void ring_allgather(Comm& c, uint8_t* buf, const std::vector<size_t>& off,
-                    const std::vector<size_t>& len) {
+                    const std::vector<size_t>& len, int wdt = kWireOff) {
   int n = static_cast<int>(c.ranks.size());
   int me = c.my_index;
   int right = ring_mod(me + 1, n), left = ring_mod(me - 1, n);
-  size_t seg = seg_for(1);
+  // compressed blocks are f32: segments must stay element-aligned so
+  // each one downcasts/upcasts independently
+  size_t seg = wdt == kWireOff ? seg_for(1) : seg_for(4);
+  if (wdt != kWireOff) quantize_inplace_wire(wdt, buf + off[me], len[me]);
   for (int s = 0; s < n - 1; ++s) {
     int sb = ring_mod(me - s, n);
     int rb = ring_mod(me - 1 - s, n);
-    send_segmented(c, right, kTagRingAG, buf + off[sb], len[sb], seg);
-    recv_copy_segmented(c, left, kTagRingAG, buf + off[rb], len[rb], seg);
+    if (wdt == kWireOff) {
+      send_segmented(c, right, kTagRingAG, buf + off[sb], len[sb], seg);
+      recv_copy_segmented(c, left, kTagRingAG, buf + off[rb], len[rb],
+                          seg);
+    } else {
+      send_segmented_compressed(c, right, kTagRingAG, buf + off[sb],
+                                len[sb], seg, wdt);
+      recv_copy_segmented_compressed(c, left, kTagRingAG, buf + off[rb],
+                                     len[rb], seg, wdt);
+    }
   }
 }
 
@@ -4708,9 +4999,14 @@ void hier_allreduce_impl(Comm& c, const void* in, void* out, size_t count,
         boff[b] = bp.off(b) * esz;
         blen[b] = bp.len(b) * esz;
       }
+      // leaders sit on distinct hosts by construction, so the leader
+      // comm is all-TCP and compression engages whenever the knob is
+      // on and the payload is f32 SUM — the shm leaf phases above and
+      // below stay exact
+      int wdt = comm_wire_dtype(*v.hc, dt, op);
       ring_reduce_scatter(*v.hc, o8 + o, o8 + o + boff[c.my_host], boff,
-                          blen, dt, op);
-      ring_allgather(*v.hc, o8 + o, boff, blen);
+                          blen, dt, op, wdt);
+      ring_allgather(*v.hc, o8 + o, boff, blen, wdt);
     }
     // locals reach this fold while the leader is ringing chunk k (its
     // chunk-k+1 contribution is already staged, so the fold needs
@@ -4873,7 +5169,8 @@ void hier_reduce_scatter_impl(Comm& c, const void* in, void* out,
       ringin = grouped.data();
     }
     Buf myblk(len[c.my_host]);
-    ring_reduce_scatter(*v.hc, ringin, myblk.data(), off, len, dt, op);
+    ring_reduce_scatter(*v.hc, ringin, myblk.data(), off, len, dt, op,
+                        comm_wire_dtype(*v.hc, dt, op));
     // one block per local member in local order: exactly the arena
     // scatter's root layout
     if (v.solo)
@@ -6585,6 +6882,26 @@ void wire_info(WireInfo* out) {
   out->zc_copied = g_zc_copied.load(std::memory_order_relaxed);
 }
 
+void set_wire_dtype(int mode) {
+  // < 0 keeps (the "<0 keeps" convention of every set_* entry);
+  // 0/1/2 = off/bf16/fp8.  Runtime-changeable like the dealing width:
+  // the calibrator and the interleaved benchmark arms A/B it inside
+  // one world.  utils/config.py owns env validation; out-of-range
+  // values are clamped to off rather than trusted.
+  if (mode < 0) return;
+  if (mode > kWireFp8) mode = kWireOff;
+  g_wire_dtype.store(mode, std::memory_order_relaxed);
+}
+
+void wire_dtype_info(int* mode, unsigned long long* logical_bytes,
+                     unsigned long long* wire_bytes) {
+  if (mode) *mode = wire_dtype_mode();
+  if (logical_bytes)
+    *logical_bytes = g_wire_logical_bytes.load(std::memory_order_relaxed);
+  if (wire_bytes)
+    *wire_bytes = g_wire_comp_bytes.load(std::memory_order_relaxed);
+}
+
 bool topology(TopoInfo* out) {
   if (!g_initialized || !out) return false;
   if (static_cast<int>(g_host_fps.size()) != g_size) {
@@ -7586,8 +7903,14 @@ void allreduce(int comm, const void* in, void* out, size_t count, DType dt,
     }
     const uint8_t* i8 = static_cast<const uint8_t*>(in);
     uint8_t* o8 = static_cast<uint8_t*>(out);
-    ring_reduce_scatter(c, i8, o8 + off[c.my_index], off, len, dt, op);
-    ring_allgather(c, o8, off, len);
+    // one verdict for BOTH phases: a compressed reduce-scatter with an
+    // exact allgather (or vice versa) would be fine numerically, but
+    // the knob's contract is "payload compressed on the wire" per
+    // collective, and the counters/tests key on that
+    int wdt = comm_wire_dtype(c, dt, op);
+    ring_reduce_scatter(c, i8, o8 + off[c.my_index], off, len, dt, op,
+                        wdt);
+    ring_allgather(c, o8, off, len, wdt);
     return;
   }
   ts.plane = tel::kPlaneTree;
@@ -7632,7 +7955,8 @@ void reduce_scatter(int comm, const void* in, void* out, size_t count_each,
     std::vector<size_t> off(n), len(n, block);
     for (int b = 0; b < n; ++b) off[b] = block * b;
     ring_reduce_scatter(c, static_cast<const uint8_t*>(in),
-                        static_cast<uint8_t*>(out), off, len, dt, op);
+                        static_cast<uint8_t*>(out), off, len, dt, op,
+                        comm_wire_dtype(c, dt, op));
     return;
   }
   // small messages: binomial reduce to member 0, scatter the blocks

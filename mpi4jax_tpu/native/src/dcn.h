@@ -265,6 +265,28 @@ struct WireInfo {
 };
 void wire_info(WireInfo* out);
 
+// Wire dtype for compressed collectives (docs/performance.md
+// "Compressed collectives").  mode: 0 = off (payloads travel f32,
+// bit-identical to the uncompressed build), 1 = bf16 (round-to-
+// nearest-even), 2 = fp8 e4m3 (saturating, max 448); < 0 keeps the
+// current value.  Compression applies per-segment inside the ring /
+// hierarchical-leader loops, and only to f32 SUM payloads on comms
+// whose EVERY ring hop crosses hosts — a single shm/pipe hop disables
+// it for the whole comm so all ranks of a collective see identical
+// result bytes regardless of their position on the ring.  Must be
+// uniform across ranks (divergent wire dtypes would exchange
+// mismatched frame sizes and deadlock; t4j-lint rule T4J009 catches
+// it statically).  utils/config.py owns env validation
+// (T4J_WIRE_DTYPE=off|bf16|fp8).
+void set_wire_dtype(int mode);
+
+// Effective wire-dtype state: mode (0 off / 1 bf16 / 2 fp8) plus the
+// cumulative logical (f32) vs wire (compressed) byte counters over the
+// compressed send path — the counters are the provable byte saving
+// (telemetry/dump.py records both; they stay 0 while mode is off).
+void wire_dtype_info(int* mode, unsigned long long* logical_bytes,
+                     unsigned long long* wire_bytes);
+
 // -- elastic world membership (docs/failure-semantics.md "elastic
 // membership") --------------------------------------------------------------
 // When a rank is declared unrecoverable (its link exhausted the

@@ -721,6 +721,25 @@ int32_t t4j_wire_info(int32_t* stripes_built, int32_t* stripes_active,
   if (zc_copied) *zc_copied = w.zc_copied;
   return 1;
 }
+// Compressed-collective wire dtype (docs/performance.md "Compressed
+// collectives"): mode 0 off, 1 bf16, 2 fp8(e4m3); < 0 keeps.
+// Runtime-changeable (the calibrator A/Bs it); must be uniform across
+// ranks.  utils/config.py owns env validation.
+void t4j_set_wire_dtype(int32_t mode) { t4j::set_wire_dtype(mode); }
+// Effective wire dtype plus the cumulative logical (f32) vs wire
+// (compressed) byte counters over the compressed send path — the
+// provable byte saving.  Returns 1 always (pre-init it reports the
+// requested mode and zero counters).
+int32_t t4j_wire_dtype_info(int32_t* mode, uint64_t* logical_bytes,
+                            uint64_t* wire_bytes) {
+  int m = 0;
+  unsigned long long lb = 0, wb = 0;
+  t4j::wire_dtype_info(&m, &lb, &wb);
+  if (mode) *mode = m;
+  if (logical_bytes) *logical_bytes = lb;
+  if (wire_bytes) *wire_bytes = wb;
+  return 1;
+}
 // Elastic membership knobs (docs/failure-semantics.md "elastic
 // membership"): mode 0 off, 1 shrink, 2 rejoin (other values keep);
 // min_world >= 1 sets; resize_timeout_s > 0 sets.  Must be set before

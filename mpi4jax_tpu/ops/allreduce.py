@@ -177,6 +177,31 @@ class BucketedGradSync:
         grads, token = sync(grads, token=token)   # mean over comm_dp
 
     ``average=False`` returns sums instead of means.
+
+    **Error feedback for compressed wire dtypes** (docs/performance.md
+    "Compressed collectives"): when ``T4J_WIRE_DTYPE`` (or the
+    calibrator) selects a low-precision wire dtype, pass a residual
+    pytree through ``residuals`` and the sync quantises each f32
+    bucket to the wire dtype BEFORE the allreduce, carrying the
+    quantisation error into the next step::
+
+        res = {}                                   # step 0: no carry
+        grads, token, res = sync(grads, token=token, residuals=res)
+
+    Per bucket: ``send = grad + residual_in``, ``q = upcast(downcast(
+    send))``, ``residual_out = send - q`` and ``q`` is what travels —
+    already wire-representable, so the native downcast is lossless on
+    the first hop and the residual accounts for the whole local
+    quantisation error (it is exactly zero when the stream is wire-
+    representable, e.g. a constant integer-valued gradient).  Master
+    weights and the returned gradients stay f32.  The residual dict is
+    per-rank MUTABLE state: checkpoint it with the optimizer state,
+    and reset it (``{}``) after an elastic resize epoch — a residual
+    measured against the old membership's quantisation stream is stale
+    (docs/sharp-bits.md "error-feedback residuals are per-rank
+    state").  Without ``residuals`` the call keeps the classic
+    2-tuple signature and never quantises in Python (the native wire
+    layer may still compress eligible comms).
     """
 
     def __init__(self, comm=None, bucket_bytes=None, average=True,
@@ -215,9 +240,45 @@ class BucketedGradSync:
             cur["bytes"] += nbytes
         return buckets
 
-    def sync(self, grads, *, token=None):
+    def _wire_dtype(self):
+        """The effective wire dtype as the Python layer sees it:
+        ``"off"`` unless the comm is proc-tier and the native bridge
+        reports a non-off mode (env knob or calibrator fit, applied at
+        ``tuning.startup``).  Per-comm eligibility (same-host hops) is
+        the native layer's business; quantising here when the wire
+        happens to be exact is still correct — ``q`` is what every rank
+        reduces, and the residual accounts for the error exactly."""
+        if self.comm.backend != "proc":
+            return "off"
+        try:
+            from mpi4jax_tpu.native import runtime
+
+            info = runtime.wire_dtype_info()
+        except Exception:
+            info = None
+        return (info or {}).get("wire_dtype", "off")
+
+    @staticmethod
+    def _wire_jnp_dtype(mode):
+        if mode == "bf16":
+            return jnp.bfloat16
+        if mode == "fp8":
+            # ml_dtypes e4m3fn, same wire format as the native cast
+            # (overflow behaviour differs at |x| > 448: jax converts to
+            # NaN where the wire saturates — gradients that large have
+            # already left fp8's useful range)
+            return getattr(jnp, "float8_e4m3fn", None)
+        return None
+
+    def sync(self, grads, *, token=None, residuals=None):
         """Return ``(synced_grads, token)`` — the same pytree with every
-        leaf summed (or averaged) over the communicator."""
+        leaf summed (or averaged) over the communicator.
+
+        With ``residuals`` (a dict, ``{}`` on the first step) the
+        return is ``(synced_grads, token, new_residuals)`` and each f32
+        bucket is error-feedback quantised to the effective wire dtype
+        (see the class docstring); non-f32 buckets and ``"off"`` mode
+        pass through untouched (their residual keys are dropped)."""
         import jax as _jax
 
         from mpi4jax_tpu.ops._core import as_token
@@ -226,14 +287,32 @@ class BucketedGradSync:
         token = as_token(token)
         leaves, treedef = _jax.tree_util.tree_flatten(grads)
         if not leaves:
-            return grads, token
+            if residuals is None:
+                return grads, token
+            return grads, token, {}
         leaves = [jnp.asarray(x) for x in leaves]
+        ef = residuals is not None
+        qdt = self._wire_jnp_dtype(self._wire_dtype()) if ef else None
+        new_res = {} if ef else None
         scale = 1.0 / float(self.comm.size) if self.average else None
         pending = []  # (bucket, request-or-reduced)
-        for bucket in self._buckets(leaves):
+        for bi, bucket in enumerate(self._buckets(leaves)):
             flat = jnp.concatenate(
                 [leaves[i].reshape(-1) for i in bucket["idx"]]
             )
+            if qdt is not None and bucket["dtype"] == "float32":
+                # error feedback: fold the carried residual in, send
+                # the wire-representable rounding of the sum, keep the
+                # rounding error for the next step.  Keyed by bucket
+                # index — the greedy layout is deterministic for a
+                # fixed pytree, so keys are stable across steps.
+                prev = residuals.get(bi) if hasattr(
+                    residuals, "get") else None
+                if prev is not None:
+                    flat = flat + jnp.asarray(prev, flat.dtype)
+                q = flat.astype(qdt).astype(flat.dtype)
+                new_res[bi] = flat - q
+                flat = q
             if self.overlap:
                 req, token = iallreduce(
                     flat, reductions.SUM, comm=self.comm, token=token
@@ -257,6 +336,9 @@ class BucketedGradSync:
                 n = leaves[i].size
                 out[i] = red[off:off + n].reshape(leaves[i].shape)
                 off += n
-        return _jax.tree_util.tree_unflatten(treedef, out), token
+        synced = _jax.tree_util.tree_unflatten(treedef, out)
+        if ef:
+            return synced, token, new_res
+        return synced, token
 
     __call__ = sync

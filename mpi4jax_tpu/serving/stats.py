@@ -135,12 +135,32 @@ class ServingStats:
             return None
         return self.slo_ok / offered
 
+    @staticmethod
+    def _wire_dtype():
+        """The effective compressed-collective wire dtype the engine's
+        decode/prefill allreduces run under (docs/performance.md
+        "Compressed collectives").  The knob is global — it opts in via
+        the tuning broadcast at bridge init, not per engine — but the
+        serving snapshot surfaces it because a latency regression that
+        is really a fleet-wide knob change should be visible from the
+        serving gauges alone.  ``"off"`` outside a native job."""
+        try:
+            from mpi4jax_tpu.native import runtime
+
+            info = runtime.wire_dtype_info()
+            if info:
+                return info.get("wire_dtype", "off")
+        except Exception:
+            pass
+        return "off"
+
     def snapshot(self):
         p = [self.latency.percentile_ms(q) for q in (0.50, 0.99)]
         ft = [self.first_token.percentile_ms(q) for q in (0.50, 0.99)]
         return {
             "schema": SERVING_SCHEMA,
             "admit_mode": self.admit_mode,
+            "wire_dtype": self._wire_dtype(),
             "slo_ms": self.slo_ms or None,
             "max_batch": self.max_batch,
             "queue_depth": self.queue_depth,

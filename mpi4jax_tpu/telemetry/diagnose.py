@@ -755,6 +755,24 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
         tunings[0] if tunings else {},
     )
     knob_sources = tuning_meta.get("sources") or {}
+    # compressed collectives (docs/performance.md "Compressed
+    # collectives"): judged against the job's EFFECTIVE wire dtype —
+    # the same provenance rule as the byte knobs — with the per-rank
+    # logical/wire counters summed as the evidence
+    wire_dtype = next(
+        (t.get("wire_dtype") or (t.get("wire") or {}).get("wire_dtype")
+         for t in tunings
+         if t.get("wire_dtype") or (t.get("wire") or {}).get("wire_dtype")),
+        "off",
+    )
+    wire_logical = sum(
+        int((t.get("wire") or {}).get("wire_logical_bytes") or 0)
+        for t in tunings
+    )
+    wire_on_wire = sum(
+        int((t.get("wire") or {}).get("wire_bytes") or 0)
+        for t in tunings
+    )
     audit = {
         "ring_min_bytes": int(ring_min_bytes),
         "leader_ring_min_bytes": int(leader_ring_min_bytes),
@@ -767,6 +785,12 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
         "tuning_cache_file": tuning_meta.get("cache_file"),
         "tuning_fingerprint": tuning_meta.get("fingerprint"),
         "autotuned": bool(tuning_meta.get("autotuned", False)),
+        "wire_dtype": wire_dtype,
+        "wire_dtype_source": knob_sources.get("wire_dtype"),
+        "wire_logical_bytes": wire_logical,
+        "wire_bytes": wire_on_wire,
+        "wire_ratio": (round(wire_logical / wire_on_wire, 2)
+                       if wire_on_wire else None),
         "tree_bytes_over_ring_min": 0,
         "tree_calls_over_ring_min": 0,
         "flat_bytes_over_leader_min_on_multihost": 0,
@@ -1086,6 +1110,28 @@ def render(report, max_steps=40):
             f"{_knob(audit['leader_ring_min_bytes'], audit.get('leader_ring_min_source'))}"
             " where the hierarchical plane applies — check T4J_HIER"
         )
+    if audit.get("wire_dtype", "off") != "off":
+        src = audit.get("wire_dtype_source")
+        knob = (f"{audit['wire_dtype']} ({src})" if src
+                else audit["wire_dtype"])
+        out.append("")
+        if audit.get("wire_bytes"):
+            mb_l = audit["wire_logical_bytes"] / 1e6
+            mb_w = audit["wire_bytes"] / 1e6
+            out.append(
+                f"  wire audit: compressed collectives active, "
+                f"T4J_WIRE_DTYPE={knob}: {mb_l:.1f} MB logical moved as "
+                f"{mb_w:.1f} MB on the wire "
+                f"({audit['wire_ratio']:.2f}x saving)"
+            )
+        else:
+            out.append(
+                f"  wire audit: T4J_WIRE_DTYPE={knob} but no compressed "
+                "traffic was recorded — every eligible hop was same-host "
+                "(pipes never compress) or no f32 SUM collective crossed "
+                "hosts; the knob costs nothing here but also buys "
+                "nothing (docs/performance.md)"
+            )
     if report["step_marker_problems"]:
         out.append("")
         out.append("  step-marker problems: "

@@ -97,6 +97,7 @@ def capture_runtime_state():
             "hier": eff["knobs"]["hier"],
             "coalesce_bytes": eff["knobs"]["coalesce_bytes"],
             "stripes": eff["knobs"].get("stripes", "auto"),
+            "wire_dtype": eff["knobs"].get("wire_dtype", "off"),
             "sources": dict(eff["sources"]),
             "cache_file": eff["cache_file"],
             "fingerprint": eff["fingerprint"],
@@ -114,6 +115,7 @@ def capture_runtime_state():
             "hier": config.hier_mode(),
             "coalesce_bytes": config.coalesce_bytes(),
             "stripes": config.stripes(),
+            "wire_dtype": config.wire_dtype(),
             "wire": wire or {},
         }
     except Exception:

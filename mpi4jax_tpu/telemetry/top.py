@@ -135,6 +135,7 @@ def summarize(rank_objs, flight=None):
     reg = MetricsRegistry()
     per_rank = []
     links = {}
+    wire_by_rank = {}  # rank -> compressed-collective state
     async_rows = {}  # (rank, op) -> accumulators
     for obj in rank_objs:
         reg.merge(MetricsRegistry.from_snapshot(obj["metrics"]))
@@ -212,6 +213,21 @@ def summarize(rank_objs, flight=None):
         for (r, _o), v in async_rows.items():
             if r == rank:
                 v["overlap_pct"] = overlap_pct
+        # compressed-collective state (docs/performance.md "Compressed
+        # collectives"): the rank dump's tuning.wire record carries the
+        # effective mode plus logical-vs-wire byte counters, so the
+        # console can PROVE the wire saving rather than assert the knob
+        tun = obj.get("tuning") or {}
+        w = tun.get("wire") or {}
+        mode = w.get("wire_dtype") or tun.get("wire_dtype") or "off"
+        logical = int(w.get("wire_logical_bytes") or 0)
+        on_wire = int(w.get("wire_bytes") or 0)
+        wire_by_rank[rank] = {
+            "wire_dtype": mode,
+            "wire_logical_bytes": logical,
+            "wire_bytes": on_wire,
+            "ratio": round(logical / on_wire, 2) if on_wire else None,
+        }
         per_peer = (obj.get("link_stats") or {}).get("per_peer", {})
         for peer, s in per_peer.items():
             link = links.setdefault(
@@ -284,6 +300,9 @@ def summarize(rank_objs, flight=None):
             "stripes": link.get("stripes", 0),
             "hot_stripe": hot[0] if len(hot) == 1 else None,
             "stripe_detail": detail,
+            # the SENDING rank's compression state: downcast happens on
+            # the tx side, so that is whose counters describe this link
+            "wire": wire_by_rank.get(rank),
         })
     async_out = []
     for (rank, op), v in sorted(async_rows.items()):
@@ -416,7 +435,7 @@ def render(summary):
         out.append("")
         out.append(f"  {'link':<12}{'bytes':>10}{'frames':>8}"
                    f"{'GB/s':>8}{'stripes':>8}{'reconn':>8}"
-                   f"{'replay':>8}{'state':>8}")
+                   f"{'replay':>8}{'state':>8}{'wire:':>12}")
         for link in summary["links"]:
             gbps = ("-" if link["gbps"] is None
                     else f"{link['gbps']:.3f}")
@@ -426,12 +445,22 @@ def render(summary):
             stripes = "-" if not nstripes else str(nstripes)
             if link.get("hot_stripe") is not None:
                 stripes += f":s{link['hot_stripe']}"
+            # compression on the tx side: mode plus the measured
+            # logical/wire ratio ("bf16 2.00x"); "-" = uncompressed f32
+            wi = link.get("wire") or {}
+            if wi.get("wire_dtype", "off") == "off":
+                wire = "-"
+            elif wi.get("ratio"):
+                wire = f"{wi['wire_dtype']} {wi['ratio']:.2f}x"
+            else:
+                wire = wi["wire_dtype"]
             out.append(
                 f"  r{link['rank']}->r{link['peer']:<8}"
                 f"{_fmt_bytes(link['bytes']):>10}{link['frames']:>8}"
                 f"{gbps:>8}{stripes:>8}{link['reconnects']:>8}"
                 f"{link['replayed_frames']:>8}"
                 f"{_STATE_NAMES.get(link['state'], '?'):>8}"
+                f"{wire:>12}"
             )
     if summary["ranks"]:
         out.append("")

@@ -155,6 +155,9 @@ def autotune_and_store(progress=None):
 _HIER_CODES = {"auto": 0, "on": 1, "off": 2}
 _HIER_NAMES = {v: k for k, v in _HIER_CODES.items()}
 
+_WIRE_CODES = {"off": 0, "bf16": 1, "fp8": 2}
+_WIRE_NAMES = {v: k for k, v in _WIRE_CODES.items()}
+
 
 def startup(progress=None):
     """Load/resolve/apply the tuning vector for this job (called from
@@ -223,7 +226,7 @@ def startup(progress=None):
         src_codes = {"default": 0, "cache": 1, "env": 2}
         src_names = {v: k for k, v in src_codes.items()}
         order = ("ring_min_bytes", "seg_bytes", "leader_ring_min_bytes",
-                 "hier", "coalesce_bytes", "stripes")
+                 "hier", "coalesce_bytes", "stripes", "wire_dtype")
         # stripes travels as an int: 0 encodes "auto" (no fitted width)
         stripes_v = knobs.get("stripes", "auto")
         vec = np.asarray(
@@ -234,6 +237,7 @@ def startup(progress=None):
                 _HIER_CODES.get(knobs["hier"], 0),
                 knobs["coalesce_bytes"],
                 0 if stripes_v == "auto" else int(stripes_v),
+                _WIRE_CODES.get(knobs.get("wire_dtype", "off"), 0),
                 *[src_codes.get(sources[k], 0) for k in order],
             ],
             np.int64,
@@ -246,9 +250,10 @@ def startup(progress=None):
             "hier": _HIER_NAMES.get(int(vec[3]), "auto"),
             "coalesce_bytes": int(vec[4]),
             "stripes": "auto" if int(vec[5]) == 0 else int(vec[5]),
+            "wire_dtype": _WIRE_NAMES.get(int(vec[6]), "off"),
         }
         sources = {
-            k: src_names.get(int(vec[6 + i]), "default")
+            k: src_names.get(int(vec[7 + i]), "default")
             for i, k in enumerate(order)
         }
 
@@ -268,6 +273,11 @@ def startup(progress=None):
     # keeps the native default
     if knobs.get("stripes", "auto") != "auto":
         runtime.set_wire(stripes=int(knobs["stripes"]))
+    # compressed-collective wire dtype (docs/performance.md
+    # "Compressed collectives"): a fitted/cached mode applies at
+    # runtime like the dealing width — the uniformity contract rides
+    # the same rank-0 broadcast as every other knob
+    runtime.set_wire_dtype(knobs.get("wire_dtype", "off"))
 
     eff = {
         "knobs": dict(knobs),

@@ -40,8 +40,10 @@ CACHE_SCHEMA_VERSION = 1
 
 # The calibratable knob vector, env name -> cache key.  hier is the
 # T4J_HIER mode string; stripes is "auto" or an int 1..16 (the wire
-# dealing width, docs/performance.md "striped links"); everything
-# else is a byte count.
+# dealing width, docs/performance.md "striped links"); wire_dtype is
+# the compressed-collective mode string off|bf16|fp8
+# (docs/performance.md "Compressed collectives"); everything else is
+# a byte count.
 KNOBS = {
     "T4J_RING_MIN_BYTES": "ring_min_bytes",
     "T4J_SEG_BYTES": "seg_bytes",
@@ -49,6 +51,7 @@ KNOBS = {
     "T4J_HIER": "hier",
     "T4J_COALESCE_BYTES": "coalesce_bytes",
     "T4J_STRIPES": "stripes",
+    "T4J_WIRE_DTYPE": "wire_dtype",
 }
 
 KNOB_DEFAULTS = {
@@ -58,7 +61,10 @@ KNOB_DEFAULTS = {
     "hier": "auto",
     "coalesce_bytes": 16 << 10,
     "stripes": "auto",
+    "wire_dtype": "off",
 }
+
+_WIRE_DTYPES = ("off", "bf16", "fp8")
 
 _SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
 
@@ -170,7 +176,7 @@ def resolve(cache_knobs, env=None):
             # override: a cached fitted width must still win over it
             explicit = False
         if explicit:
-            if key == "hier":
+            if key in ("hier", "wire_dtype"):
                 knobs[key] = str(raw).strip().lower()
             elif key == "stripes":
                 s = str(raw).strip().lower()
@@ -182,6 +188,10 @@ def resolve(cache_knobs, env=None):
             v = cache_knobs[key]
             if key == "hier":
                 knobs[key] = str(v)
+            elif key == "wire_dtype":
+                # a cache file edited to an unknown dtype must not
+                # smuggle an un-runnable mode past config validation
+                knobs[key] = str(v) if str(v) in _WIRE_DTYPES else "off"
             elif key == "stripes":
                 knobs[key] = "auto" if str(v) == "auto" else int(v)
             else:

@@ -27,10 +27,12 @@ __all__ = [
     "SEG_CANDIDATES",
     "COALESCE_SIZES",
     "STRIPE_MARGIN",
+    "WIRE_MARGIN",
     "fit_crossover",
     "fit_seg",
     "fit_coalesce",
     "fit_stripes",
+    "fit_wire_dtype",
     "fit_records",
     "autotune",
 ]
@@ -124,10 +126,42 @@ def fit_stripes(points, margin=STRIPE_MARGIN):
     return best
 
 
+# A compressed wire dtype has to EARN its keep the same way a wider
+# dealing does: below this speedup over the exact f32 wire the fit
+# keeps off — off is additionally bit-exact, so a tie must never tip
+# toward compression.  On bandwidth-bound (DCN/flow-capped) planes
+# halved bytes clear the margin easily; on shm/loopback planes the
+# cast passes are pure overhead and off wins (docs/performance.md
+# "Compressed collectives").
+WIRE_MARGIN = 1.05
+
+
+def fit_wire_dtype(points, margin=WIRE_MARGIN):
+    """Compressed wire dtype from ``(mode, ms)`` pairs
+    (``off``/``bf16``/``fp8``): the fastest mode, except any
+    compressed mode must beat ``off`` by ``margin`` — otherwise
+    ``off`` wins (compression that is not profitable must cost
+    nothing, and only off is bit-exact).  ``None`` on no data."""
+    pts = {str(m): float(ms) for m, ms in points}
+    if not pts:
+        return None
+    base = pts.get("off")
+    best, best_ms = None, None
+    for m, ms in sorted(pts.items()):
+        if best_ms is None or ms < best_ms:
+            best, best_ms = m, ms
+    if best is None or best == "off":
+        return "off" if "off" in pts else best
+    if base is not None and base <= best_ms * margin:
+        return "off"
+    return best
+
+
 def fit_records(records):
     """Fit the knob vector from ``proc_busbw.py --calibrate`` JSON
     records (each: ``{"arm", "payload_bytes", "mean_ms", ...}``, arms
-    ``tree|ring|hier|flat|seg:<bytes>|fused|unfused``).
+    ``tree|ring|hier|flat|seg:<bytes>|stripes:<n>|wire:<dtype>|``
+    ``fused|unfused``).
 
     Returns a partial knob dict (only the knobs the records cover).
     """
@@ -161,6 +195,13 @@ def fit_records(records):
                 stripe_pts.append((int(arm[8:]), float(r["mean_ms"])))
     if stripe_pts:
         knobs["stripes"] = fit_stripes(stripe_pts)
+    wire_pts = []
+    for arm, rows in by.items():
+        if arm.startswith("wire:"):
+            for r in rows:
+                wire_pts.append((arm[5:], float(r["mean_ms"])))
+    if wire_pts:
+        knobs["wire_dtype"] = fit_wire_dtype(wire_pts)
     hier_pts = pair("flat", "hier")
     if hier_pts:
         knobs["leader_ring_min_bytes"] = fit_crossover(hier_pts)
@@ -321,6 +362,28 @@ def autotune(sizes=None, seg_candidates=None, coalesce_sizes=None,
             say(f"stripes {w}: {ms:.3f}ms")
         runtime.set_wire(stripes=built)  # restore full width for the rest
         knobs["stripes"] = fit_stripes(stripe_pts)
+
+    # ---- wire dtype: compressed vs exact f32 at the largest payload -----
+    #
+    # The mode is runtime-changeable like the dealing width, and — key
+    # property — a wire dtype that cannot engage (shm arena plane,
+    # same-host ring hops, non-f32/SUM payloads) changes NOTHING on
+    # the wire, so the arms are always safe to run: where compression
+    # never engages the three arms measure equal within noise and the
+    # margin fits `off`, which is exactly the wanted verdict for the
+    # shm plane (docs/performance.md "Compressed collectives").
+    if n > 1:
+        count = max(big // 4, n)
+        x = np.ones(count, np.float32)
+        wire_pts = []
+        for wmode in ("off", "bf16", "fp8"):
+            runtime.set_wire_dtype(wmode)
+            ms = arm(f"wire:{wmode}", count * 4, "allreduce",
+                     lambda: runtime.host_allreduce(world, x, 0))
+            wire_pts.append((wmode, ms))
+            say(f"wire {wmode}: {ms:.3f}ms")
+        runtime.set_wire_dtype("off")  # exact wire for the remaining arms
+        knobs["wire_dtype"] = fit_wire_dtype(wire_pts)
 
     # ---- hier: flat vs hierarchical per size (topology permitting) ------
     topo = runtime.topology() or {}

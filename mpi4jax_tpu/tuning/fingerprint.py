@@ -23,7 +23,9 @@ __all__ = ["KNOB_SCHEMA_VERSION", "topology_fingerprint"]
 # ignored wholesale rather than half-applied.
 # v2: the `stripes` knob joined the vector (striped multi-connection
 # links, docs/performance.md "striped links and the zero-copy path").
-KNOB_SCHEMA_VERSION = 2
+# v3: the `wire_dtype` knob joined the vector (compressed collectives,
+# docs/performance.md "Compressed collectives").
+KNOB_SCHEMA_VERSION = 3
 
 
 def topology_fingerprint(topology, world_size,

@@ -106,6 +106,15 @@ Striped multi-connection links and the zero-copy wire path
                                loopback box can demonstrate the
                                multi-flow busbw step real fabrics get
                                from multiple NIC queues.
+* ``T4J_WIRE_DTYPE``         — compressed-collective wire dtype
+                               (``off``, the default — bit-identical
+                               to the uncompressed build — or
+                               ``bf16``/``fp8``): f32 SUM ring/hier
+                               payloads travel low-precision on
+                               cross-host hops while accumulation and
+                               results stay f32 (docs/performance.md
+                               "Compressed collectives").  The
+                               calibrator fits it per fabric.
 
 Trace-guided autotuning + small-message coalescing
 (docs/performance.md "trace-guided autotuning"):
@@ -214,6 +223,7 @@ __all__ = [
     "zerocopy_min_bytes",
     "sendmsg_batch",
     "emu_flow_bps",
+    "wire_dtype",
     "coalesce_bytes",
     "tuning_cache_dir",
     "autotune_enabled",
@@ -573,6 +583,34 @@ def emu_flow_bps():
     return byte_count(
         os.environ.get("T4J_EMU_FLOW_BPS"), 0, name="T4J_EMU_FLOW_BPS"
     )
+
+
+WIRE_DTYPES = ("off", "bf16", "fp8")
+
+
+def wire_dtype():
+    """Compressed-collective wire dtype (docs/performance.md
+    "Compressed collectives"): ``off`` (the default — payloads travel
+    f32, bit-identical to the uncompressed build), ``bf16`` or ``fp8``
+    (e4m3).  Compression applies only to f32 SUM collectives on
+    all-cross-host rings — integer and MIN/MAX payloads have no
+    defined wire cast and always travel exact, and a single
+    shm/pipe-eligible hop disables it for the whole comm so every rank
+    sees identical result bytes.  Anything else raises: a typo'd wire
+    dtype must fail at launch, not silently run uncompressed (the
+    operator would read "bf16 busbw" off a f32 run).  Must be uniform
+    across ranks (mismatched wire dtypes exchange mismatched frame
+    sizes; t4j-lint rule T4J009 names the divergence)."""
+    v = os.environ.get("T4J_WIRE_DTYPE")
+    if v is None or not str(v).strip():
+        return "off"
+    v = str(v).strip().lower()
+    if v not in WIRE_DTYPES:
+        raise ValueError(
+            f"cannot interpret T4J_WIRE_DTYPE={v!r} "
+            f"(want {'|'.join(WIRE_DTYPES)})"
+        )
+    return v
 
 
 def coalesce_bytes():

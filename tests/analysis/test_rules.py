@@ -136,7 +136,11 @@ class TestNativeDtypes:
             pathlib.Path(__file__).resolve().parent.parent.parent
             / "mpi4jax_tpu" / "native" / "runtime.py"
         ).read_text()
-        table = re.search(r"_DTYPE_CODES = \{(.*?)\}", src, re.S).group(1)
+        # anchored: runtime.py also defines WIRE_DTYPE_CODES, whose
+        # name the unanchored pattern would match first
+        table = re.search(
+            r"^_DTYPE_CODES = \{(.*?)\}", src, re.S | re.M
+        ).group(1)
         names = set(re.findall(r'"(\w+)":', table))
         assert names == set(contracts.NATIVE_DTYPES)
 
@@ -313,12 +317,80 @@ class TestRequestRules:
         assert "never waited" in contracts.RULES["T4J008"]
 
 
+class TestWireDtypeRule:
+    """T4J009 — mixed compressed-collective wire dtypes on one comm
+    (docs/performance.md "Compressed collectives")."""
+
+    def test_signature_carries_wire_field_for_f32_sum(self, contracts):
+        e = ev(contracts, 0, "allreduce", reduce_op="sum")
+        assert contracts.step_signature(e, wire_dtype="bf16").endswith(
+            "|wire=bf16"
+        )
+        assert contracts.step_signature(e, wire_dtype="off").endswith(
+            "|wire=off"
+        )
+
+    @pytest.mark.parametrize("kw", [
+        dict(kind="allreduce", reduce_op="max"),          # MAX: never
+        dict(kind="allreduce", reduce_op="sum",
+             dtype="int32"),                              # ints: never
+        dict(kind="bcast", root=0),                       # no reduction
+    ])
+    def test_ineligible_steps_have_no_wire_field(self, contracts, kw):
+        e = ev(contracts, 0, kw.pop("kind"), **kw)
+        sig = contracts.step_signature(e, wire_dtype="bf16")
+        assert sig.endswith("|-")
+        # ...so ranks with different knobs still agree on these steps
+        assert sig == contracts.step_signature(e, wire_dtype="fp8")
+
+    def test_mixed_modes_diverge_as_t4j009(self, contracts):
+        e = ev(contracts, 0, "allreduce", reduce_op="sum")
+        a = contracts.step_signature(e, wire_dtype="bf16")
+        b = contracts.step_signature(e, wire_dtype="off")
+        assert a != b
+        step, details = contracts.first_divergence([[a], [b]])
+        msg = contracts.divergence_message(step, details)
+        assert "T4J009" in msg and "T4J007" not in msg
+        assert "bf16" in msg and "T4J_WIRE_DTYPE" in msg
+
+    def test_real_schedule_divergence_stays_t4j007(self, contracts):
+        a = contracts.step_signature(
+            ev(contracts, 0, "allreduce", reduce_op="sum"),
+            wire_dtype="bf16",
+        )
+        b = contracts.step_signature(
+            ev(contracts, 0, "allreduce", reduce_op="max"),
+            wire_dtype="off",
+        )
+        step, details = contracts.first_divergence([[a], [b]])
+        msg = contracts.divergence_message(step, details)
+        # op fields differ too — the generic rule, not the knob rule
+        assert "T4J007" in msg and "T4J009" not in msg
+
+    def test_schedule_ends_is_not_t4j009(self, contracts):
+        msg = contracts.divergence_message(
+            1, {0: "allreduce|...|wire=bf16", 1: "<schedule ends>"}
+        )
+        assert "T4J007" in msg
+
+    def test_explicit_mode_overrides_ambient(self, contracts, monkeypatch):
+        monkeypatch.setenv("T4J_WIRE_DTYPE", "fp8")
+        e = ev(contracts, 0, "allreduce", reduce_op="sum")
+        assert contracts.step_signature(e, wire_dtype="off").endswith(
+            "|wire=off"
+        )
+
+    def test_rule_catalogued(self, contracts):
+        assert "T4J009" in contracts.RULES
+        assert "wire dtype" in contracts.RULES["T4J009"]
+
+
 class TestRuleCatalog:
     def test_ids_stable(self, contracts):
         # released IDs are frozen: renumbering breaks suppressions and
         # CI greps downstream
         assert set(contracts.RULES) == {
-            f"T4J00{i}" for i in range(1, 9)
+            f"T4J00{i}" for i in range(1, 10)
         }
 
     def test_finding_str_carries_rule_and_src(self, contracts):

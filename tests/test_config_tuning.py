@@ -806,3 +806,132 @@ def test_ensure_initialized_rejects_bad_max_batch(monkeypatch):
     monkeypatch.setenv("T4J_MAX_BATCH", "0")
     with pytest.raises(ValueError, match="T4J_MAX_BATCH"):
         runtime.ensure_initialized()
+
+
+class TestWireDtype:
+    """T4J_WIRE_DTYPE (docs/performance.md "Compressed collectives"):
+    off (default, bit-identical) | bf16 | fp8, validated at launch,
+    resolved through the tuning cache with env > cache > default
+    precedence, fitted by the calibrator only when compression beats
+    the f32 baseline by the profit margin."""
+
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("T4J_WIRE_DTYPE", raising=False)
+        assert config.wire_dtype() == "off"
+
+    def test_empty_is_off(self, monkeypatch):
+        monkeypatch.setenv("T4J_WIRE_DTYPE", "   ")
+        assert config.wire_dtype() == "off"
+
+    @pytest.mark.parametrize("mode", ["off", "bf16", "fp8"])
+    def test_explicit_modes(self, monkeypatch, mode):
+        monkeypatch.setenv("T4J_WIRE_DTYPE", mode)
+        assert config.wire_dtype() == mode
+
+    def test_case_and_whitespace_normalised(self, monkeypatch):
+        monkeypatch.setenv("T4J_WIRE_DTYPE", "  BF16 ")
+        assert config.wire_dtype() == "bf16"
+
+    @pytest.mark.parametrize("bad", ["f16", "int8", "e5m2", "1", "on"])
+    def test_unknown_mode_raises(self, monkeypatch, bad):
+        """A typo must fail at launch, not silently run uncompressed —
+        the operator would read "bf16 busbw" off a f32 run."""
+        monkeypatch.setenv("T4J_WIRE_DTYPE", bad)
+        with pytest.raises(ValueError, match="T4J_WIRE_DTYPE"):
+            config.wire_dtype()
+
+    def test_resolve_env_wins_over_cache(self, monkeypatch):
+        from mpi4jax_tpu.tuning import cache
+
+        monkeypatch.setenv("T4J_WIRE_DTYPE", "bf16")
+        knobs, sources = cache.resolve({"wire_dtype": "fp8"})
+        assert knobs["wire_dtype"] == "bf16"
+        assert sources["wire_dtype"] == "env"
+
+    def test_resolve_cache_wins_over_default(self, monkeypatch):
+        from mpi4jax_tpu.tuning import cache
+
+        monkeypatch.delenv("T4J_WIRE_DTYPE", raising=False)
+        knobs, sources = cache.resolve({"wire_dtype": "fp8"})
+        assert knobs["wire_dtype"] == "fp8"
+        assert sources["wire_dtype"] == "cache"
+
+    def test_resolve_default_is_off(self, monkeypatch):
+        from mpi4jax_tpu.tuning import cache
+
+        monkeypatch.delenv("T4J_WIRE_DTYPE", raising=False)
+        knobs, sources = cache.resolve({})
+        assert knobs["wire_dtype"] == "off"
+        assert sources["wire_dtype"] == "default"
+
+    def test_resolve_rejects_smuggled_cache_dtype(self, monkeypatch):
+        """A hand-edited cache file must not push an un-runnable mode
+        past config validation: unknown cached dtypes read as off."""
+        from mpi4jax_tpu.tuning import cache
+
+        monkeypatch.delenv("T4J_WIRE_DTYPE", raising=False)
+        knobs, _ = cache.resolve({"wire_dtype": "int4"})
+        assert knobs["wire_dtype"] == "off"
+
+    def test_fit_picks_profitable_compression(self):
+        from mpi4jax_tpu.tuning import calibrate
+
+        got = calibrate.fit_wire_dtype(
+            [("off", 10.0), ("bf16", 5.0), ("fp8", 6.0)]
+        )
+        assert got == "bf16"
+
+    def test_fit_unprofitable_compression_stays_off(self):
+        """Within the profit margin the bit-exact mode wins: equal
+        times on the unthrottled shm plane must fit off."""
+        from mpi4jax_tpu.tuning import calibrate
+
+        got = calibrate.fit_wire_dtype(
+            [("off", 10.0), ("bf16", 10.0), ("fp8", 10.1)]
+        )
+        assert got == "off"
+
+    def test_fit_margin_boundary(self):
+        from mpi4jax_tpu.tuning import calibrate
+
+        # 4% faster: inside the 1.05 margin, off keeps the knob
+        assert calibrate.fit_wire_dtype(
+            [("off", 10.0), ("bf16", 9.62)]
+        ) == "off"
+        # 10% faster: clears the margin
+        assert calibrate.fit_wire_dtype(
+            [("off", 10.0), ("bf16", 9.0)]
+        ) == "bf16"
+
+    def test_fit_no_data_is_none(self):
+        from mpi4jax_tpu.tuning import calibrate
+
+        assert calibrate.fit_wire_dtype([]) is None
+
+    def test_schema_version_covers_wire_knob(self):
+        """The wire_dtype knob joined the broadcast vector: stale
+        pre-compression cache files must miss on the fingerprint."""
+        from mpi4jax_tpu.tuning import fingerprint
+
+        assert fingerprint.KNOB_SCHEMA_VERSION == 3
+
+
+def test_ensure_initialized_rejects_bad_wire_dtype(monkeypatch):
+    """A typo'd wire dtype must fail before init — silently running
+    uncompressed would fake the benchmark the operator asked for
+    (docs/performance.md "Compressed collectives").  The eligibility
+    rule stays per-collective in the native layer: integer and MIN/MAX
+    payloads have no defined cast and always travel exact, so fp8/bf16
+    is a policy cap, not a promise."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_WIRE_DTYPE", "e5m2")
+    with pytest.raises(ValueError, match="T4J_WIRE_DTYPE"):
+        runtime.ensure_initialized()

@@ -107,6 +107,21 @@
 #                (T4J_STRIPES=2 elastic_smoke) so the resize path
 #                stays green over striped links.  ctypes only — runs
 #                on old-jax containers.
+#  17. compress — tools/compress_smoke.py twice: plain and under
+#                AddressSanitizer.  Compressed collectives
+#                (docs/performance.md "Compressed collectives") over
+#                the real native bridge with T4J_EMU_LOCAL=1 (one
+#                emulated host per rank, so the every-hop-cross-host
+#                predicate engages): the cast-fused bf16/fp8 ring
+#                against the f32 oracle within the documented
+#                quantisation tolerance with BIT-identical results
+#                across ranks and the logical/wire byte counters
+#                proving the 2x/4x saving, the byte-stable
+#                T4J_WIRE_DTYPE=off contract (bit-identical, counters
+#                zero), and the flow-capped off-vs-bf16 interleaved
+#                busbw step (>= 1.4x gate; auto-skips under
+#                sanitizers).  ctypes only — runs on old-jax
+#                containers.
 #  16. serving — tools/serving_smoke.py twice: plain and under
 #                AddressSanitizer.  The continuous-batching serving
 #                control plane (docs/serving.md) over the real native
@@ -140,7 +155,8 @@ cd "$(dirname "$0")/.."
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
   lanes=(tier1 fault proc asan tsan lint resilience telemetry async
-         diagnose bench elastic autotune postmortem stripe serving)
+         diagnose bench elastic autotune postmortem stripe serving
+         compress)
 fi
 
 run_lane() {
@@ -250,8 +266,14 @@ assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
       run_lane serving-asan env T4J_SANITIZE=address timeout -k 10 900 \
         python tools/serving_smoke.py 8
       ;;
+    compress)
+      run_lane compress-plain env -u T4J_SANITIZE timeout -k 10 1200 \
+        python tools/compress_smoke.py 8
+      run_lane compress-asan env T4J_SANITIZE=address timeout -k 10 1800 \
+        python tools/compress_smoke.py 8
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem|stripe|serving)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem|stripe|serving|compress)" >&2
       exit 2
       ;;
   esac

@@ -1,0 +1,450 @@
+#!/usr/bin/env python
+"""Compressed-collectives smoke lane (docs/performance.md "Compressed
+collectives").
+
+Four phases over an N-rank (default 8) proc world driven through the
+native bridge's ctypes C API (no jax import in the workers, so the
+lane runs on old-jax containers and under sanitizer preloads alike).
+``T4J_EMU_LOCAL=1`` makes every rank fingerprint as its own emulated
+host, so the every-hop-cross-host compression predicate engages
+exactly as it would on a real multi-host fabric:
+
+  1. oracle-bf16 — T4J_WIRE_DTYPE=bf16: the cast-fused ring allreduce
+                   against the f32 oracle sum, within the documented
+                   per-hop quantisation tolerance; the logical/wire
+                   byte counters must show the 2-byte wire elements
+                   (ratio ~2x) — the telemetry proof of the saving.
+  2. oracle-fp8  — same with the 1-byte e4m3 wire dtype (ratio ~4x,
+                   looser tolerance), data kept inside fp8's
+                   saturation range.
+  3. off         — T4J_WIRE_DTYPE=off: results BIT-identical to the
+                   host-computed reduction and both wire counters
+                   exactly zero — the byte-stable contract that makes
+                   `off` safe to default.
+  4. throttle    — T4J_EMU_FLOW_BPS per-connection throttle: the same
+                   16 MB allreduce measured with wire off vs bf16 in
+                   interleaved same-conditions arms must show the
+                   byte-halving as busbw (>= 1.4x gate here; the bench
+                   records the real ratio).  Skipped under
+                   ``T4J_SANITIZE`` (perf gate, like the stripe lane's).
+
+Run under AddressSanitizer/TSan by exporting ``T4J_SANITIZE`` before
+invoking (tools/ci_smoke.sh does).
+
+Usage: python tools/compress_smoke.py [nprocs] [--phase NAME]
+"""
+
+import importlib.util
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import types
+import uuid
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+ITERS = 8
+COUNT = 64 * 1024  # f32 elements per allreduce (256 KB)
+
+# per-element gates for the quantised ring against the f32 oracle:
+# every RS hop requantises the running PARTIAL sum once, so the error
+# is a walk of (n-1) half-ulps sized by the partial-sum magnitude —
+# cancellation can leave a final value far smaller than the partials,
+# which is why each dtype gets an absolute term sized to
+# (n-1) * half_ulp(n * |x|max) and the fp8 data range is kept narrow
+# (|x| < 0.5 -> partials < 4, half-ulp 0.25, worst walk 1.75).
+# bf16 (|x| < 4, partials < 32, half-ulp 2^-8*32): worst walk ~0.9.
+TOL = {"bf16": (0.05, 1.0), "fp8": (0.5, 2.0)}  # (rtol, atol)
+RANGE = {"bf16": 4.0, "fp8": 0.5}               # uniform(-r, r) inputs
+
+
+def _load_build_module():
+    try:
+        from mpi4jax_tpu.native import build  # noqa: PLC0415
+
+        return build
+    except Exception:
+        pass
+    for name in ("mpi4jax_tpu", "mpi4jax_tpu.utils", "mpi4jax_tpu.native"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [str(REPO / name.replace(".", "/"))]
+            sys.modules[name] = mod
+    for name, rel in (
+        ("mpi4jax_tpu.utils.config", "mpi4jax_tpu/utils/config.py"),
+        ("mpi4jax_tpu.native.build", "mpi4jax_tpu/native/build.py"),
+    ):
+        if name in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(name, REPO / rel)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["mpi4jax_tpu.native.build"]
+
+
+def _sanitizer_env():
+    san = os.environ.get("T4J_SANITIZE", "").strip().lower()
+    if not san:
+        return {}
+    lib = {"address": "libasan.so", "asan": "libasan.so",
+           "1": "libasan.so", "thread": "libtsan.so",
+           "tsan": "libtsan.so"}.get(san)
+    if lib is None:
+        return {}
+    paths = []
+    for name in (lib, "libstdc++.so.6"):
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={name}"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+        if out and out != name:
+            paths.append(out)
+    if not paths:
+        return {}
+    env = {
+        "LD_PRELOAD": " ".join(paths),
+        "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
+        "TSAN_OPTIONS": "report_bugs=1",
+    }
+    if lib == "libtsan.so":
+        # same convention as tools/stripe_smoke.py (gcc-10 libtsan
+        # symbolizer wedge + the pre-existing engine-teardown report)
+        env["TSAN_OPTIONS"] = os.environ.get(
+            "TSAN_OPTIONS", "report_bugs=1:exitcode=0:symbolize=0")
+    return env
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ worker
+
+
+def _load_lib(so):
+    import ctypes
+
+    lib = ctypes.CDLL(so)
+    i32, u64, vp = ctypes.c_int32, ctypes.c_uint64, ctypes.c_void_p
+    u64p = ctypes.POINTER(u64)
+    i32p = ctypes.POINTER(i32)
+    lib.t4j_init.restype = ctypes.c_int
+    lib.t4j_last_error.restype = ctypes.c_char_p
+    lib.t4j_c_allreduce.argtypes = [i32, vp, vp, u64, i32, i32]
+    lib.t4j_c_allreduce.restype = i32
+    lib.t4j_c_barrier.argtypes = [i32]
+    lib.t4j_c_barrier.restype = i32
+    lib.t4j_set_wire_dtype.argtypes = [i32]
+    lib.t4j_wire_dtype_info.argtypes = [i32p, u64p, u64p]
+    lib.t4j_wire_dtype_info.restype = i32
+    return lib
+
+
+def _wire_dtype_info(lib):
+    import ctypes
+
+    mode = ctypes.c_int32(0)
+    logical = ctypes.c_uint64(0)
+    wire = ctypes.c_uint64(0)
+    lib.t4j_wire_dtype_info(ctypes.byref(mode), ctypes.byref(logical),
+                            ctypes.byref(wire))
+    return {"mode": mode.value, "logical": logical.value,
+            "wire": wire.value}
+
+
+def _ptr(a):
+    import ctypes
+
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def _oracle_phase(lib, rank, n, wdt):
+    """Quantised ring vs f32 oracle, then the counter proof."""
+    import numpy as np
+
+    import hashlib
+
+    rtol, atol = TOL[wdt]
+    worst = 0.0
+    digest = hashlib.sha256()
+    for it in range(ITERS):
+        # keep sums comfortably inside fp8's 448 saturation ceiling;
+        # non-integer data so the tolerance gate is honest (integers
+        # under 64 would be bf16-exact and hide a broken cast)
+        per = [np.random.default_rng(1000 * it + r)
+               .uniform(-RANGE[wdt], RANGE[wdt], size=COUNT)
+               .astype(np.float32)
+               for r in range(n)]
+        want = per[0].astype(np.float64)
+        for a in per[1:]:
+            want += a
+        out = np.empty(COUNT, np.float32)
+        st = lib.t4j_c_allreduce(0, _ptr(per[rank]), _ptr(out), COUNT,
+                                 0, 0)
+        if st:
+            raise RuntimeError(
+                f"allreduce[{it}]: {lib.t4j_last_error().decode()}"
+            )
+        err = np.abs(out.astype(np.float64) - want)
+        bound = atol + rtol * np.abs(want)
+        bad = err > bound
+        if bad.any():
+            i = int(np.argmax(err - bound))
+            raise AssertionError(
+                f"iteration {it}: {int(bad.sum())} element(s) outside "
+                f"the {wdt} tolerance (rtol={rtol}, atol={atol}); "
+                f"worst at [{i}]: got {out[i]!r} want {want[i]!r}"
+            )
+        worst = max(worst, float((err / np.maximum(bound, 1e-12)).max()))
+        digest.update(out.tobytes())
+    info = _wire_dtype_info(lib)
+    if info["logical"] == 0 or info["wire"] == 0:
+        raise AssertionError(
+            f"{wdt} phase moved no compressed bytes "
+            f"(counters {info}) — the compression predicate never "
+            "engaged; with T4J_EMU_LOCAL=1 every loopback hop should "
+            "classify cross-host"
+        )
+    ratio = info["logical"] / info["wire"]
+    want_ratio = 2.0 if wdt == "bf16" else 4.0
+    if not (want_ratio * 0.9 <= ratio <= want_ratio * 1.1):
+        raise AssertionError(
+            f"logical/wire byte ratio {ratio:.2f} is not the {wdt} "
+            f"element-size ratio ~{want_ratio} (counters {info})"
+        )
+    print(f"ORACLE r{rank} wdt={wdt} worst_tol_frac={worst:.3f} "
+          f"logical={info['logical']} wire={info['wire']} "
+          f"ratio={ratio:.2f} digest={digest.hexdigest()[:16]}",
+          flush=True)
+
+
+def _off_phase(lib, rank, n):
+    """off must be BIT-identical to the host reduction, counters 0."""
+    import numpy as np
+
+    for it in range(ITERS):
+        per = [np.random.default_rng(1000 * it + r)
+               .integers(0, 64, size=COUNT).astype(np.float32)
+               for r in range(n)]
+        want = per[0].copy()
+        for a in per[1:]:
+            want += a
+        out = np.empty(COUNT, np.float32)
+        st = lib.t4j_c_allreduce(0, _ptr(per[rank]), _ptr(out), COUNT,
+                                 0, 0)
+        if st:
+            raise RuntimeError(
+                f"allreduce[{it}]: {lib.t4j_last_error().decode()}"
+            )
+        if out.tobytes() != want.tobytes():
+            raise AssertionError(
+                f"iteration {it}: T4J_WIRE_DTYPE=off is not "
+                f"bit-identical to the plain reduction (first bad "
+                f"index {int(np.argmax(out != want))})"
+            )
+    info = _wire_dtype_info(lib)
+    if info["mode"] != 0 or info["logical"] != 0 or info["wire"] != 0:
+        raise AssertionError(
+            f"off phase touched the compressed path (counters {info}) "
+            "— byte-stable contract broken"
+        )
+    print(f"OFF r{rank} bit-identical, counters zero", flush=True)
+
+
+def _throttle_phase(lib, rank):
+    """Interleaved off/bf16 arms under the per-flow throttle: the
+    byte-halving must show as busbw."""
+    import time
+
+    import numpy as np
+
+    count = 4 * 1024 * 1024  # 16 MB f32: the >=16 MB regime the
+    # acceptance gate names (large enough that the flow cap, not the
+    # per-segment latency, dominates both arms)
+    x = np.ones(count, np.float32)
+    out = np.empty_like(x)
+
+    def timed(mode, reps=3):
+        lib.t4j_set_wire_dtype(mode)
+        lib.t4j_c_barrier(0)
+        lib.t4j_c_allreduce(0, _ptr(x), _ptr(out), count, 0, 0)
+        lib.t4j_c_barrier(0)
+        t = time.monotonic()
+        for _ in range(reps):
+            st = lib.t4j_c_allreduce(0, _ptr(x), _ptr(out), count, 0, 0)
+            if st:
+                raise RuntimeError(lib.t4j_last_error().decode())
+        lib.t4j_c_barrier(0)
+        return (time.monotonic() - t) / reps
+
+    # interleaved same-conditions pairs, like the stripe throttle
+    t_off = timed(0)
+    t_bf = timed(1)
+    t_off2 = timed(0)
+    t_bf2 = timed(1)
+    lib.t4j_set_wire_dtype(0)
+    ratio = max(t_off, t_off2) / max(min(t_bf, t_bf2), 1e-9)
+    print(f"THROTTLE r{rank} off={min(t_off, t_off2):.3f}s "
+          f"bf16={min(t_bf, t_bf2):.3f}s ratio={ratio:.2f}", flush=True)
+
+
+def worker(so, phase):
+    import time
+
+    lib = _load_lib(so)
+    rc = lib.t4j_init()
+    if rc != 0:
+        raise RuntimeError(f"init rc={rc}: {lib.t4j_last_error().decode()}")
+    rank = lib.t4j_world_rank()
+    n = lib.t4j_world_size()
+    t0 = time.monotonic()
+    try:
+        if phase in ("oracle-bf16", "oracle-fp8"):
+            _oracle_phase(lib, rank, n, phase.split("-", 1)[1])
+        elif phase == "off":
+            _off_phase(lib, rank, n)
+        elif phase == "throttle":
+            _throttle_phase(lib, rank)
+        else:
+            raise RuntimeError(f"unknown worker phase {phase}")
+        print(f"COMPRESS-OK {rank} elapsed={time.monotonic() - t0:.2f}s",
+              flush=True)
+        lib.t4j_finalize()
+        sys.exit(0)
+    except (RuntimeError, AssertionError) as e:
+        print(f"COMPRESS-FAILED after {time.monotonic() - t0:.2f}s: {e}",
+              flush=True)
+        sys.exit(23)
+
+
+# ------------------------------------------------------------------ driver
+
+
+def run_phase(phase, n, so, extra_env):
+    coord = f"127.0.0.1:{_free_port()}"
+    job = uuid.uuid4().hex[:8]
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.update(
+            T4J_RANK=str(r), T4J_SIZE=str(n), T4J_COORD=coord,
+            T4J_JOB=job, T4J_NO_SHM="1",
+            # one emulated host per rank: every ring hop classifies
+            # cross-host, so the every-hop predicate engages exactly
+            # as on a real multi-host fabric (T4J_NO_SHM alone leaves
+            # all ranks sharing one host fingerprint)
+            T4J_EMU_LOCAL="1",
+            # ring path with small segments so the cast-fused segment
+            # loop runs many times per collective
+            T4J_RING_MIN_BYTES="0", T4J_SEG_BYTES="16384",
+        )
+        env.update(extra_env)
+        env.update(_sanitizer_env())
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__, "worker", so, phase],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    outs, ok = [], True
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        print(f"--- [{phase}] rank {r} (rc={p.returncode}) ---")
+        print(out[-2500:])
+        if p.returncode != 0:
+            ok = False
+    if phase.startswith("oracle-") and ok:
+        # the replicated-result contract: every rank must end each
+        # compressed allreduce with the SAME bits — the allgather owner
+        # quantises its resident block so it matches what receivers
+        # reconstruct from the wire
+        digests = set()
+        for out in outs:
+            for line in out.splitlines():
+                if line.startswith("ORACLE") and "digest=" in line:
+                    digests.add(line.split("digest=")[1].split()[0])
+        if len(digests) != 1:
+            ok = False
+            print(f"FAIL: ranks ended the compressed allreduce with "
+                  f"different result bits ({sorted(digests)}) — the "
+                  "replicated-result contract is broken")
+    if phase == "throttle" and ok:
+        ratios = []
+        for out in outs:
+            for line in out.splitlines():
+                if line.startswith("THROTTLE") and "ratio=" in line:
+                    ratios.append(float(line.split("ratio=")[1]))
+        if not ratios:
+            ok = False
+            print("FAIL: no throttle measurement")
+        else:
+            med = sorted(ratios)[len(ratios) // 2]
+            print(f"throttle byte-halving step: median ratio {med:.2f} "
+                  f"(per-rank {['%.2f' % v for v in ratios]})")
+            if med < 1.4:
+                ok = False
+                print("FAIL: bf16 arms did not beat f32 under the "
+                      "per-connection throttle (>= 1.4x gate — half "
+                      "the bytes should step well past it)")
+    return ok
+
+
+def main():
+    argv = list(sys.argv[1:])
+    phases = ["oracle-bf16", "oracle-fp8", "off", "throttle"]
+    if "--phase" in argv:
+        i = argv.index("--phase")
+        phases = [argv[i + 1]]
+        del argv[i:i + 2]  # the value must not be parsed as nprocs
+    args = [a for a in argv if not a.startswith("--")]
+    n = int(args[0]) if args else 8
+    build = _load_build_module()
+    so = str(build.ensure_built())
+    ok = True
+    for phase in phases:
+        if phase == "oracle-bf16":
+            ok = run_phase(phase, n, so,
+                           {"T4J_WIRE_DTYPE": "bf16"}) and ok
+        elif phase == "oracle-fp8":
+            ok = run_phase(phase, n, so,
+                           {"T4J_WIRE_DTYPE": "fp8"}) and ok
+        elif phase == "off":
+            ok = run_phase(phase, n, so, {"T4J_WIRE_DTYPE": "off"}) and ok
+        elif phase == "throttle":
+            if os.environ.get("T4J_SANITIZE", "").strip():
+                # a perf gate: sanitizer instrumentation makes the CPU
+                # side the bottleneck, not the throttled flow — the
+                # correctness phases above already ran sanitized
+                print("=== phase throttle skipped under T4J_SANITIZE "
+                      "(perf gate; runs in the plain lane) ===")
+                continue
+            env = {
+                # 48 MB/s per flow: a 16 MB ring allreduce is
+                # wire-bound at f32, so halving the bytes (bf16)
+                # nearly halves the time
+                "T4J_EMU_FLOW_BPS": "48M",
+                "T4J_SEG_BYTES": "262144",
+            }
+            ok = run_phase(phase, min(n, 4), so, env) and ok
+        else:
+            print(f"unknown phase {phase}", file=sys.stderr)
+            ok = False
+    print("COMPRESS-SMOKE-OK" if ok else "COMPRESS-SMOKE-FAIL")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker(sys.argv[2], sys.argv[3])
+    else:
+        main()
