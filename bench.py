@@ -625,6 +625,59 @@ def proc_compress_busbw(timeout=1200):
             ratios["bf16"], ratios["fp8"])
 
 
+def proc_uring_busbw(timeout=1200):
+    """io_uring wire backend (docs/performance.md "io_uring wire
+    backend"): one 8-rank TCP-tier job running
+    ``proc_busbw.py --wire-backend sendmsg,uring`` interleaved arms on
+    a SMALL (256 KB) payload — the syscall-bound decode-step regime
+    the submission ring exists for — with each arm's record carrying
+    its native tx/rx syscall-counter deltas as evidence.  Returns
+    ``(sendmsg_record, uring_record, ratio_record, dropped_record)``;
+    any may be None (``dropped_record`` is non-None exactly when the
+    kernel has no usable io_uring and the uring arm was skipped)."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "proc_busbw.py"
+    import os as _os
+
+    recs = {"sendmsg": None, "uring": None}
+    ratio = dropped = None
+    try:
+        env = dict(_os.environ)
+        env["T4J_NO_SHM"] = "1"  # the wire backend serves the TCP plane
+        env["T4J_TUNING_CACHE"] = "off"
+        out = subprocess.run(
+            [sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+             str(script), "--wire-backend", "sendmsg,uring",
+             "--mb", "0.25", "--reps", "10"],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent), env=env,
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            metric = rec.get("metric", "")
+            backend = rec.get("wire_backend")
+            if metric == "allreduce_busbw_proc8" and backend in recs:
+                recs[backend] = rec
+            elif metric == "allreduce_uring_vs_sendmsg_proc8":
+                ratio = rec
+            elif metric == "wire_backend_arms_dropped_proc8":
+                dropped = rec
+        if ratio is None and dropped is None:
+            print(
+                f"[bench] uring busbw produced no ratio record "
+                f"(rc={out.returncode}): {out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] uring busbw failed: {exc}", file=sys.stderr)
+    return recs["sendmsg"], recs["uring"], ratio, dropped
+
+
 def proc_autotune_pair(timeout=900):
     """Mis-default recovery (docs/performance.md "trace-guided
     autotuning"): one 8-rank TCP-tier job running
@@ -1119,6 +1172,7 @@ def run_bench(quick=False):
         _skip("proc_halo_latency", "quick mode")
         _skip("proc_striped_busbw", "quick mode")
         _skip("proc_compress_busbw", "quick mode")
+        _skip("proc_uring_busbw", "quick mode")
         _skip("proc_serving", "quick mode")
     elif not native_ok:
         _skip("proc_tcp_busbw", native_reason)
@@ -1128,6 +1182,7 @@ def run_bench(quick=False):
         _skip("proc_halo_latency", native_reason)
         _skip("proc_striped_busbw", native_reason)
         _skip("proc_compress_busbw", native_reason)
+        _skip("proc_uring_busbw", native_reason)
         _skip("proc_serving", native_reason)
     ring_rec, tree_rec = proc_tcp_busbw() if run_heavy_proc else (None, None)
     if run_heavy_proc and ring_rec is None and tree_rec is None:
@@ -1246,6 +1301,48 @@ def run_bench(quick=False):
         _skip("proc_compress_ratio", "no ratio record produced")
     if cp_fratio is not None:
         extras["compress_fp8_vs_f32_ratio"] = cp_fratio["value"]
+    # io_uring wire backend (this PR's tentpole): sendmsg vs uring on
+    # a small (syscall-bound) allreduce, interleaved inside one world;
+    # the p50 and the native syscall-counter deltas are the evidence
+    # the batched submission actually cut kernel crossings — a kernel
+    # without io_uring records an explicit skip instead of silently
+    # benchmarking sendmsg twice (docs/performance.md "io_uring wire
+    # backend")
+    ur_send, ur_rec, ur_ratio, ur_dropped = (
+        proc_uring_busbw() if run_heavy_proc
+        else (None, None, None, None)
+    )
+    if run_heavy_proc and ur_dropped is not None:
+        _skip("proc_uring_busbw",
+              ur_dropped.get("reason", "uring arm dropped"))
+    elif run_heavy_proc and ur_send is None and ur_ratio is None:
+        _skip("proc_uring_busbw", "no record produced")
+    if ur_send is not None:
+        extras["allreduce_busbw_proc8_sendmsg_gbps"] = ur_send["value"]
+        if ur_send.get("p50_ms") is not None:
+            extras["sendmsg_p50_ms_proc8"] = ur_send["p50_ms"]
+        if ur_send.get("tx_syscalls_per_call") is not None:
+            extras["sendmsg_tx_syscalls_per_call_proc8"] = (
+                ur_send["tx_syscalls_per_call"]
+            )
+    if ur_rec is not None:
+        extras["allreduce_busbw_proc8_uring_gbps"] = ur_rec["value"]
+        if ur_rec.get("p50_ms") is not None:
+            extras["uring_p50_ms_proc8"] = ur_rec["p50_ms"]
+        if ur_rec.get("tx_syscalls_per_call") is not None:
+            extras["uring_tx_syscalls_per_call_proc8"] = (
+                ur_rec["tx_syscalls_per_call"]
+            )
+    if ur_ratio is not None:
+        extras["uring_vs_sendmsg_ratio"] = ur_ratio["value"]
+        if ur_ratio.get("p50_ratio") is not None:
+            extras["uring_vs_sendmsg_p50_ratio"] = ur_ratio["p50_ratio"]
+        if ur_ratio.get("syscall_ratio") is not None:
+            extras["uring_vs_sendmsg_syscall_ratio"] = (
+                ur_ratio["syscall_ratio"]
+            )
+    elif run_heavy_proc and ur_rec is not None:
+        _skip("proc_uring_ratio", "no ratio record produced")
     # serving under SLO (docs/serving.md): p50/p99/rps/shed-rate and
     # SLO attainment of the admission-controlled arm, with the
     # uncontrolled baseline's p99 + attainment as the contrast —

@@ -120,6 +120,21 @@ def main():
         "One record per arm plus a compress_vs_f32 ratio record",
     )
     ap.add_argument(
+        "--wire-backend", default=None, metavar="LIST",
+        dest="wire_backend",
+        help="wire data-plane arms (docs/performance.md \"io_uring "
+        "wire backend\"): comma list of backends (sendmsg,uring) "
+        "A/B'd INTERLEAVED inside one world via "
+        "runtime.set_wire_backend — both backends put identical "
+        "bytes on the wire, so the arms are always safe.  Composes "
+        "with --stripes (arms run at that dealing width) and "
+        "--wire-dtype (first listed mode applies to every arm).  One "
+        "record per backend carrying the native tx/rx syscall-counter "
+        "deltas as evidence, plus a uring_vs_sendmsg ratio record; a "
+        "kernel without io_uring drops the uring arm with an explicit "
+        "record instead of silently measuring sendmsg twice",
+    )
+    ap.add_argument(
         "--widths", default="1,4,16",
         help="halo widths for --op halo (comma list)",
     )
@@ -171,6 +186,9 @@ def main():
 
     if args.op == "halo":
         return _halo_main(args, comm)
+
+    if args.wire_backend:
+        return _wire_backend_main(args, comm)
 
     if args.wire_dtype:
         return _wire_dtype_main(args, comm)
@@ -726,6 +744,160 @@ def _wire_dtype_main(args, comm):
             "compressed_engaged": bool(
                 wire_delta.get(mode, {}).get("wire_bytes", 0) > 0),
             "emu_flow_bps": int(winfo.get("emu_flow_bps", 0) or 0),
+        }), flush=True)
+
+
+def _wire_backend_main(args, comm):
+    """Interleaved wire data-plane arms (docs/performance.md "io_uring
+    wire backend").
+
+    One world; each timed batch rotates through the requested backends
+    back to back (``runtime.set_wire_backend(b)`` is a pure runtime
+    knob — both backends put identical bytes on the wire, so no
+    renegotiation), the same interleaving convention as the hier/flat,
+    striped and compressed pairs.  The claim under test is
+    syscall-bound small-frame latency, so each record carries a
+    per-call p50 AND the native per-link syscall-counter deltas
+    (``runtime.link_stats`` ``tx_syscalls``/``rx_syscalls``) — the
+    evidence is the measured kernel-crossing count dropping per call,
+    never a hand-derived estimate.  Composes with ``--stripes`` (arms
+    run at that dealing width) and ``--wire-dtype`` (the first listed
+    mode applies to every arm).  A kernel without io_uring drops the
+    uring arm with an explicit ``wire_backend_arms_dropped`` record.
+    Rank 0 prints one record per backend plus a ``uring_vs_sendmsg``
+    ratio record when both arms ran."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m
+    from mpi4jax_tpu.native import runtime
+    from mpi4jax_tpu.ops._proc import proc_topology
+    from mpi4jax_tpu.utils import config
+
+    n = comm.size
+    backends = []
+    for tokn in str(args.wire_backend).split(","):
+        tokn = tokn.strip().lower()
+        if not tokn:
+            continue
+        if tokn not in ("sendmsg", "uring"):
+            raise SystemExit(
+                f"--wire-backend: unknown backend {tokn!r} "
+                "(want sendmsg|uring)"
+            )
+        if tokn not in backends:
+            backends.append(tokn)
+    if "sendmsg" not in backends:
+        backends.insert(0, "sendmsg")  # the baseline every ratio needs
+
+    binfo = runtime.wire_backend_info() or {}
+    launched = binfo.get("wire_backend", "auto")
+    if "uring" in backends and not binfo.get("uring_supported"):
+        # explicit skip record: BENCH_history must show the arm was
+        # dropped for a reason, not silently measure sendmsg twice
+        if comm.rank() == 0:
+            print(json.dumps({
+                "metric": f"wire_backend_arms_dropped_proc{n}",
+                "dropped": ["uring"],
+                "reason": "no usable io_uring on this kernel",
+                "nprocs": n,
+            }), flush=True)
+        backends = [b for b in backends if b != "uring"]
+
+    winfo = runtime.wire_info() or {}
+    stripes = None
+    if args.stripes:
+        built = int(winfo.get("stripes_built", 1) or 1)
+        stripes = min(max(int(w) for w in str(args.stripes).split(",")
+                          if w), built)
+        runtime.set_wire(stripes=stripes)
+    wdtype = None
+    launched_dtype = (runtime.wire_dtype_info()
+                      or {}).get("wire_dtype", "off")
+    if args.wire_dtype:
+        wdtype = str(args.wire_dtype).split(",")[0].strip().lower()
+        runtime.set_wire_dtype(wdtype)
+
+    per = max(int(args.mb * 1e6 / 4), n)
+    per -= per % max(n, 1)
+    x = jnp.ones((per,), jnp.float32)
+    nbytes = per * 4
+    factor = _busbw_factor("allreduce", n)
+
+    tok = m.create_token()
+    for b in backends:  # warm every arm (ring setup, buffer regs)
+        runtime.set_wire_backend(b)
+        y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+        np.asarray(y)
+    times = {b: [] for b in backends}
+    sys_delta = {b: [0, 0] for b in backends}
+    calls = {b: 0 for b in backends}
+    for _ in range(3):
+        for b in backends:
+            runtime.set_wire_backend(b)
+            tok = _fence(comm, tok)
+            before = runtime.link_stats() or {}
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                y, tok = m.allreduce(x, m.SUM, comm=comm, token=tok)
+                np.asarray(y)
+                times[b].append(time.perf_counter() - t0)
+            after = runtime.link_stats() or {}
+            sys_delta[b][0] += (int(after.get("tx_syscalls", 0))
+                                - int(before.get("tx_syscalls", 0)))
+            sys_delta[b][1] += (int(after.get("rx_syscalls", 0))
+                                - int(before.get("rx_syscalls", 0)))
+            calls[b] += args.reps
+    runtime.set_wire_backend(launched)
+    if wdtype is not None:
+        runtime.set_wire_dtype(launched_dtype)
+    if comm.rank() != 0:
+        return
+    topo = proc_topology(comm)
+    p50 = {b: sorted(ts)[len(ts) // 2] for b, ts in times.items()}
+    best = {b: min(ts) for b, ts in times.items()}
+    spc = {b: (sys_delta[b][0] / calls[b] if calls[b] else None)
+           for b in backends}
+    for b in backends:
+        busbw = nbytes * factor / best[b]
+        print(json.dumps({
+            "metric": f"allreduce_busbw_proc{n}",
+            "value": round(busbw / 1e9, 3),
+            "unit": "GB/s",
+            "nprocs": n,
+            "payload_mb": nbytes / 1e6,
+            "payload_bytes": nbytes,
+            "sec_per_call": round(best[b], 6),
+            "p50_ms": round(p50[b] * 1e3, 4),
+            "data_plane": "ring" if nbytes >= config.ring_min_bytes()
+            else "tree",
+            "wire_backend": b,
+            "tx_syscalls_delta": sys_delta[b][0],
+            "rx_syscalls_delta": sys_delta[b][1],
+            "tx_syscalls_per_call": (round(spc[b], 2)
+                                     if spc[b] is not None else None),
+            "stripes": stripes,
+            "wire_dtype": wdtype,
+            "emu_flow_bps": int(winfo.get("emu_flow_bps", 0) or 0),
+            "local_world": topo["local_size"],
+            "leader_world": topo["n_hosts"],
+            "seg_bytes": config.seg_bytes(),
+            "interleaved_pairs": True,
+        }), flush=True)
+    if "uring" in backends and "sendmsg" in backends:
+        print(json.dumps({
+            "metric": f"allreduce_uring_vs_sendmsg_proc{n}",
+            "value": round(best["sendmsg"] / best["uring"], 2),
+            "unit": "x",
+            "nprocs": n,
+            "payload_mb": nbytes / 1e6,
+            "p50_ratio": round(p50["sendmsg"] / p50["uring"], 2),
+            "syscall_ratio": (
+                round(spc["sendmsg"] / spc["uring"], 2)
+                if spc.get("uring") and spc.get("sendmsg") else None
+            ),
+            "stripes": stripes,
+            "wire_dtype": wdtype,
         }), flush=True)
 
 

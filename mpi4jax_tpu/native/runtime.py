@@ -30,6 +30,8 @@ __all__ = [
     "wire_info",
     "set_wire_dtype",
     "wire_dtype_info",
+    "set_wire_backend",
+    "wire_backend_info",
     "set_coalesce",
     "coalesce_bytes",
     "set_hier",
@@ -186,12 +188,16 @@ def _load():
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_int32),
     ]
     lib.t4j_link_stats.restype = ctypes.c_int32
     lib.t4j_link_stripe_stats.argtypes = [
         ctypes.c_int32,
         ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64),
@@ -214,6 +220,12 @@ def _load():
         ctypes.POINTER(ctypes.c_uint64),
     ]
     lib.t4j_wire_dtype_info.restype = ctypes.c_int32
+    lib.t4j_set_wire_backend.argtypes = [ctypes.c_int32]
+    lib.t4j_wire_backend_info.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.t4j_wire_backend_info.restype = ctypes.c_int32
     lib.t4j_topo.argtypes = [ctypes.POINTER(ctypes.c_int32)] * 5
     lib.t4j_topo.restype = ctypes.c_int32
     lib.t4j_hier_would_select.argtypes = [ctypes.c_int32, ctypes.c_uint64]
@@ -378,10 +390,13 @@ def _link_stats_one(lib, peer):
     rec = ctypes.c_uint64(0)
     frames = ctypes.c_uint64(0)
     nbytes = ctypes.c_uint64(0)
+    txsc = ctypes.c_uint64(0)
+    rxsc = ctypes.c_uint64(0)
     state = ctypes.c_int32(0)
     ok = lib.t4j_link_stats(
         int(peer),
         ctypes.byref(rec), ctypes.byref(frames), ctypes.byref(nbytes),
+        ctypes.byref(txsc), ctypes.byref(rxsc),
         ctypes.byref(state),
     )
     if not ok:
@@ -390,6 +405,8 @@ def _link_stats_one(lib, peer):
         "reconnects": rec.value,
         "replayed_frames": frames.value,
         "replayed_bytes": nbytes.value,
+        "tx_syscalls": txsc.value,
+        "rx_syscalls": rxsc.value,
         "state": state.value,
     }
 
@@ -398,10 +415,13 @@ def _stripe_stats_one(lib, peer, stripe):
     rec = ctypes.c_uint64(0)
     frames = ctypes.c_uint64(0)
     nbytes = ctypes.c_uint64(0)
+    txsc = ctypes.c_uint64(0)
+    rxsc = ctypes.c_uint64(0)
     state = ctypes.c_int32(0)
     ok = lib.t4j_link_stripe_stats(
         int(peer), int(stripe),
         ctypes.byref(rec), ctypes.byref(frames), ctypes.byref(nbytes),
+        ctypes.byref(txsc), ctypes.byref(rxsc),
         ctypes.byref(state),
     )
     if not ok:
@@ -410,6 +430,8 @@ def _stripe_stats_one(lib, peer, stripe):
         "reconnects": rec.value,
         "replayed_frames": frames.value,
         "replayed_bytes": nbytes.value,
+        "tx_syscalls": txsc.value,
+        "rx_syscalls": rxsc.value,
         "state": state.value,
     }
 
@@ -474,6 +496,7 @@ def wire_info():
         "zc_copied": int(zc_copied.value),
     }
     info.update(wire_dtype_info() or {})
+    info.update(wire_backend_info() or {})
     return info
 
 
@@ -525,6 +548,63 @@ def wire_dtype_info():
         "wire_dtype": WIRE_DTYPE_NAMES.get(int(mode.value), "off"),
         "wire_logical_bytes": int(logical.value),
         "wire_bytes": int(wire.value),
+    }
+
+
+WIRE_BACKEND_CODES = {"sendmsg": 0, "uring": 1, "auto": 2}
+WIRE_BACKEND_NAMES = {v: k for k, v in WIRE_BACKEND_CODES.items()}
+
+
+def set_wire_backend(mode=None):
+    """Runtime override of the wire data-plane backend
+    (docs/performance.md "io_uring wire backend"): ``"sendmsg"`` /
+    ``"uring"`` / ``"auto"`` or the native code 0/1/2; ``None`` keeps
+    the current value.  Runtime-changeable between collectives (the
+    calibrator and the interleaved benchmark arms A/B it inside one
+    world) because both backends put identical bytes on the wire; it
+    does NOT need to be uniform across ranks, but the launcher
+    propagates ``T4J_WIRE_BACKEND`` so benchmarks compare like with
+    like.  On a kernel without io_uring ``"uring"`` degrades loudly to
+    sendmsg (one stderr line per process)."""
+    lib = _load()
+    if mode is None:
+        code = -1
+    elif isinstance(mode, str):
+        try:
+            code = WIRE_BACKEND_CODES[mode.strip().lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown wire backend {mode!r} "
+                f"(want {'|'.join(WIRE_BACKEND_CODES)})"
+            ) from None
+    else:
+        code = int(mode)
+    lib.t4j_set_wire_backend(code)
+
+
+def wire_backend_info():
+    """Effective wire-backend state: ``{"wire_backend",
+    "uring_supported", "wire_backend_active"}`` — ``wire_backend`` is
+    the requested mode, ``uring_supported`` whether the kernel's
+    io_uring probe succeeded, ``wire_backend_active`` the backend the
+    stripe threads actually use (``"uring"`` only when requested AND
+    supported; ``"auto"`` resolves to sendmsg until the calibrator
+    learns otherwise).  Valid pre-init — ``ensure_initialized`` uses
+    it to reject an explicit uring request on a kernel without
+    io_uring.  ``None`` when the native library was never loaded."""
+    lib = _state["lib"]
+    if lib is None:
+        return None
+    mode = ctypes.c_int32(0)
+    supported = ctypes.c_int32(0)
+    active = ctypes.c_int32(0)
+    lib.t4j_wire_backend_info(
+        ctypes.byref(mode), ctypes.byref(supported), ctypes.byref(active)
+    )
+    return {
+        "wire_backend": WIRE_BACKEND_NAMES.get(int(mode.value), "auto"),
+        "uring_supported": bool(supported.value),
+        "wire_backend_active": "uring" if active.value else "sendmsg",
     }
 
 
@@ -1521,6 +1601,14 @@ def ensure_initialized():
     # MIN/MAX payloads have no defined cast and always travel exact),
     # so fp8/bf16 is a policy cap, not a promise.
     wdtype = config.wire_dtype()
+    # wire data-plane backend (docs/performance.md "io_uring wire
+    # backend"): a typo'd T4J_WIRE_BACKEND raises HERE, before init.
+    # An EXPLICIT uring request on a kernel whose io_uring probe fails
+    # is also rejected below (after the library loads) — the managed
+    # path fails loud rather than silently benchmarking sendmsg under
+    # a uring label; standalone ctypes users get the native layer's
+    # loud one-line degrade instead.
+    wbackend = config.wire_backend()
     if zc_min > 0 and zc_min < 4096:
         raise ValueError(
             f"T4J_ZEROCOPY_MIN_BYTES={zc_min} is below the page floor "
@@ -1572,6 +1660,16 @@ def ensure_initialized():
         zc_min, batch, flow,
     )
     lib.t4j_set_wire_dtype(WIRE_DTYPE_CODES[wdtype])
+    lib.t4j_set_wire_backend(WIRE_BACKEND_CODES[wbackend])
+    if wbackend == "uring":
+        binfo = wire_backend_info()
+        if binfo is not None and not binfo["uring_supported"]:
+            raise ValueError(
+                "T4J_WIRE_BACKEND=uring but this kernel has no usable "
+                "io_uring (the probe failed) — use auto (resolves to "
+                "sendmsg here) or sendmsg (docs/performance.md "
+                "\"io_uring wire backend\")"
+            )
     lib.t4j_set_hier(_HIER_MODES[hier], hier_min)
     lib.t4j_set_resilience(retry, boff_base, boff_max, replay)
     lib.t4j_set_elastic(_ELASTIC_MODES[elastic], world_floor, resize_s)

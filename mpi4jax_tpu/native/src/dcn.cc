@@ -17,6 +17,28 @@
 #include <linux/errqueue.h>  // MSG_ZEROCOPY completion records
 #endif
 
+// io_uring wire backend (docs/performance.md "io_uring wire
+// backend"): raw syscalls against the uapi header — the container
+// toolchain carries no liburing, and the three syscalls plus two
+// mmaps are all the backend needs.  Compile-gated on the header,
+// runtime-gated on an io_uring_setup probe (kernels without io_uring,
+// or with it seccomp-filtered, degrade loudly to the sendmsg
+// backend).
+#if defined(__linux__) && defined(__has_include)
+#if __has_include(<linux/io_uring.h>)
+#define T4J_HAVE_URING 1
+#include <linux/io_uring.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#endif
+#endif
+#ifndef T4J_HAVE_URING
+#define T4J_HAVE_URING 0
+#endif
+
+#include <csignal>
+
 #include <cerrno>
 
 #ifdef __SSE2__
@@ -275,6 +297,346 @@ long long emu_flow_bps() {
     g_emu_flow_bps.store(v, std::memory_order_relaxed);
   }
   return v;
+}
+
+// ------------------------------------------------- wire backend
+//
+// Pluggable data plane (docs/performance.md "io_uring wire backend").
+// The sendmsg backend is the classic gather-write/recv loop, byte-
+// stable against every prior release; the uring backend submits whole
+// segment runs as one io_uring_enter (SENDMSG chains for small
+// frames, header + WRITE_FIXED over the registered replay arena for
+// large ones) and replaces the reader's recv+poll pair with a single
+// completion wait.  Frame bytes on the wire are identical across
+// backends — only the syscall shape changes — so mixed backends
+// interoperate and every fault/replay/elastic/compression contract is
+// backend-independent.  "auto" resolves to sendmsg until the
+// calibrator learns better (mirroring T4J_STRIPES); an explicit
+// "uring" on a kernel without usable io_uring degrades LOUDLY to
+// sendmsg at init.
+
+constexpr int kBackendSendmsg = 0, kBackendUring = 1, kBackendAuto = 2;
+
+std::atomic<int> g_wire_backend{-1};
+std::atomic<int> g_uring_supported{-1};  // -1 = not probed yet
+std::atomic<bool> g_uring_degrade_logged{false};
+
+// Per-thread destination for data-plane syscall counters
+// (Stripe::tx_syscalls / rx_syscalls): stripe_write points it at the
+// stripe's tx counter, reader_loop at its rx counter, and every
+// kernel crossing on the hot paths (sendmsg/recv/read/poll/
+// io_uring_enter) bumps through it.  Never hand-derived — this is the
+// syscalls-per-frame evidence t4j-top and the acceptance gate read.
+thread_local std::atomic<uint64_t>* tls_syscall_ctr = nullptr;
+
+inline void count_syscall() {
+  if (tls_syscall_ctr)
+    tls_syscall_ctr->fetch_add(1, std::memory_order_relaxed);
+}
+
+struct TlsSyscallScope {
+  std::atomic<uint64_t>* prev;
+  explicit TlsSyscallScope(std::atomic<uint64_t>* c) : prev(tls_syscall_ctr) {
+    tls_syscall_ctr = c;
+  }
+  ~TlsSyscallScope() { tls_syscall_ctr = prev; }
+};
+
+// Adaptive io poll tick (the historical hard 100 ms floor inflated
+// small-frame latency under light load): a global gauge of frames
+// actively being sent or received picks between a tight bound while
+// work is in flight and a lazy one when the rank is idle — idle ranks
+// must not spin (asserted by tests via the syscall counters), and the
+// idle tick stays far under telemetry/postmortem.py's 5 s heartbeat
+// staleness threshold so a parked rank still reads as alive.
+constexpr int kIoTickBusyMs = 5;
+constexpr int kIoTickIdleMs = 250;
+
+std::atomic<int> g_inflight_frames{0};
+
+inline int io_tick_ms() {
+  return g_inflight_frames.load(std::memory_order_relaxed) > 0
+             ? kIoTickBusyMs
+             : kIoTickIdleMs;
+}
+
+struct InflightScope {
+  InflightScope() { g_inflight_frames.fetch_add(1, std::memory_order_relaxed); }
+  ~InflightScope() {
+    g_inflight_frames.fetch_sub(1, std::memory_order_relaxed);
+  }
+};
+
+#if T4J_HAVE_URING
+
+inline int sys_uring_setup(unsigned entries, io_uring_params* p) {
+  return static_cast<int>(::syscall(__NR_io_uring_setup, entries, p));
+}
+inline int sys_uring_enter(int fd, unsigned to_submit, unsigned min_complete,
+                           unsigned flags, const void* arg, size_t argsz) {
+  return static_cast<int>(::syscall(__NR_io_uring_enter, fd, to_submit,
+                                    min_complete, flags, arg, argsz));
+}
+inline int sys_uring_register(int fd, unsigned opcode, const void* arg,
+                              unsigned nr_args) {
+  return static_cast<int>(
+      ::syscall(__NR_io_uring_register, fd, opcode, arg, nr_args));
+}
+
+// Minimal SQ/CQ ring pair over the raw mmap layout.  Single submitter
+// thread per ring (each Stripe's send path is serialised by send_mu,
+// each reader owns its recv ring, the engine owns its wait ring), so
+// the only cross-party ordering is against the kernel: acquire on the
+// kernel-written tail/head words, release on ours.
+struct UringRing {
+  int fd = -1;
+  unsigned entries = 0;
+  bool ext_arg = false;
+  void* sq_mem = nullptr;
+  void* cq_mem = nullptr;
+  size_t sq_len = 0, cq_len = 0;
+  io_uring_sqe* sqes = nullptr;
+  size_t sqes_len = 0;
+  unsigned* sq_head = nullptr;
+  unsigned* sq_tail = nullptr;
+  unsigned* sq_mask = nullptr;
+  unsigned* sq_array = nullptr;
+  unsigned* cq_head = nullptr;
+  unsigned* cq_tail = nullptr;
+  unsigned* cq_mask = nullptr;
+  io_uring_cqe* cqes = nullptr;
+  // Registered-buffer state (IORING_REGISTER_BUFFERS over the replay
+  // arena / reader buffer).  Re-registration only happens with no
+  // SQEs in flight — stripe_write's invariant is that it never
+  // returns while the kernel still references caller memory.
+  bool bufs_registered = false;
+  const void* reg_base = nullptr;
+  size_t reg_len = 0;
+
+  bool open_ring(unsigned want) {
+    io_uring_params p;
+    std::memset(&p, 0, sizeof(p));
+    fd = sys_uring_setup(want, &p);
+    if (fd < 0) return false;
+    if (!(p.features & IORING_FEAT_NODROP) ||
+        !(p.features & IORING_FEAT_EXT_ARG)) {
+      // Pre-5.11 semantics (droppable CQEs, no timed enter): not
+      // worth a second code path — the probe rejects these kernels
+      // too, this is just belt and braces.
+      ::close(fd);
+      fd = -1;
+      return false;
+    }
+    ext_arg = true;
+    bool single = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+    sq_len = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+    cq_len = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+    if (single) sq_len = cq_len = (sq_len > cq_len ? sq_len : cq_len);
+    sq_mem = ::mmap(nullptr, sq_len, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
+    if (sq_mem == MAP_FAILED) {
+      sq_mem = nullptr;
+      close_ring();
+      return false;
+    }
+    if (single) {
+      cq_mem = sq_mem;
+    } else {
+      cq_mem = ::mmap(nullptr, cq_len, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_CQ_RING);
+      if (cq_mem == MAP_FAILED) {
+        cq_mem = nullptr;
+        close_ring();
+        return false;
+      }
+    }
+    sqes_len = p.sq_entries * sizeof(io_uring_sqe);
+    sqes = static_cast<io_uring_sqe*>(
+        ::mmap(nullptr, sqes_len, PROT_READ | PROT_WRITE,
+               MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES));
+    if (sqes == MAP_FAILED) {
+      sqes = nullptr;
+      close_ring();
+      return false;
+    }
+    auto* sq = static_cast<uint8_t*>(sq_mem);
+    sq_head = reinterpret_cast<unsigned*>(sq + p.sq_off.head);
+    sq_tail = reinterpret_cast<unsigned*>(sq + p.sq_off.tail);
+    sq_mask = reinterpret_cast<unsigned*>(sq + p.sq_off.ring_mask);
+    sq_array = reinterpret_cast<unsigned*>(sq + p.sq_off.array);
+    auto* cq = static_cast<uint8_t*>(cq_mem);
+    cq_head = reinterpret_cast<unsigned*>(cq + p.cq_off.head);
+    cq_tail = reinterpret_cast<unsigned*>(cq + p.cq_off.tail);
+    cq_mask = reinterpret_cast<unsigned*>(cq + p.cq_off.ring_mask);
+    cqes = reinterpret_cast<io_uring_cqe*>(cq + p.cq_off.cqes);
+    entries = p.sq_entries;
+    return true;
+  }
+
+  void close_ring() {
+    if (sqes) ::munmap(sqes, sqes_len);
+    if (cq_mem && cq_mem != sq_mem) ::munmap(cq_mem, cq_len);
+    if (sq_mem) ::munmap(sq_mem, sq_len);
+    sqes = nullptr;
+    sq_mem = cq_mem = nullptr;
+    if (fd >= 0) ::close(fd);
+    fd = -1;
+    bufs_registered = false;
+  }
+
+  ~UringRing() { close_ring(); }
+
+  io_uring_sqe* get_sqe() {
+    unsigned tail = *sq_tail;
+    unsigned idx = tail & *sq_mask;
+    io_uring_sqe* e = &sqes[idx];
+    std::memset(e, 0, sizeof(*e));
+    sq_array[idx] = idx;
+    __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
+    return e;
+  }
+
+  bool pop_cqe(io_uring_cqe* out) {
+    unsigned head = *cq_head;
+    if (head == __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE)) return false;
+    *out = cqes[head & *cq_mask];
+    __atomic_store_n(cq_head, head + 1, __ATOMIC_RELEASE);
+    return true;
+  }
+
+  // (Re-)register one buffer as fixed index 0.  Caller guarantees no
+  // SQE referencing the old registration is in flight.
+  bool register_buffer(const void* base, size_t len) {
+    if (fd < 0 || !base || !len) return false;
+    if (bufs_registered && base == reg_base && len == reg_len) return true;
+    if (bufs_registered) {
+      if (sys_uring_register(fd, IORING_UNREGISTER_BUFFERS, nullptr, 0) < 0)
+        return false;
+      bufs_registered = false;
+    }
+    iovec iov;
+    iov.iov_base = const_cast<void*>(base);
+    iov.iov_len = len;
+    if (sys_uring_register(fd, IORING_REGISTER_BUFFERS, &iov, 1) < 0)
+      return false;
+    bufs_registered = true;
+    reg_base = base;
+    reg_len = len;
+    return true;
+  }
+};
+
+// One kernel crossing: submit whatever is queued and/or wait for
+// completions, bounded by wait_ms (-1 = no wait, just submit/peek).
+// Returns the enter() result (submitted count or -1/errno); -ETIME
+// and EINTR are normal and surface as 0 with errno preserved for the
+// caller's tick loop.
+int uring_enter(UringRing& r, unsigned to_submit, unsigned min_complete,
+                int wait_ms) {
+  count_syscall();
+  if (wait_ms >= 0) {
+    __kernel_timespec ts;
+    ts.tv_sec = wait_ms / 1000;
+    ts.tv_nsec = static_cast<long long>(wait_ms % 1000) * 1000000LL;
+    io_uring_getevents_arg arg;
+    std::memset(&arg, 0, sizeof(arg));
+    arg.ts = reinterpret_cast<uint64_t>(&ts);
+    return sys_uring_enter(
+        r.fd, to_submit, min_complete,
+        IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG, &arg, sizeof(arg));
+  }
+  return sys_uring_enter(r.fd, to_submit, min_complete, 0, nullptr, 0);
+}
+
+#endif  // T4J_HAVE_URING
+
+// Kernel support probe: one tiny io_uring_setup (also catches seccomp
+// filters that ENOSYS the syscall).  T4J_URING_FORCE_UNSUPPORTED=1
+// lets tests exercise the no-io_uring degrade path on any kernel.
+bool probe_uring_support() {
+#if T4J_HAVE_URING
+  const char* force = std::getenv("T4J_URING_FORCE_UNSUPPORTED");
+  if (force && force[0] && std::strcmp(force, "0") != 0) return false;
+  io_uring_params p;
+  std::memset(&p, 0, sizeof(p));
+  int fd = sys_uring_setup(4, &p);
+  if (fd < 0) return false;
+  ::close(fd);
+  return (p.features & IORING_FEAT_NODROP) &&
+         (p.features & IORING_FEAT_EXT_ARG);
+#else
+  return false;
+#endif
+}
+
+bool uring_supported() {
+  int v = g_uring_supported.load(std::memory_order_acquire);
+  if (v < 0) {
+    v = probe_uring_support() ? 1 : 0;
+    g_uring_supported.store(v, std::memory_order_release);
+  }
+  return v == 1;
+}
+
+// Requested mode; env parse is the fallback for hand-run processes
+// (utils/config.py validates and calls set_wire_backend first in the
+// normal bridge path).
+int wire_backend_mode() {
+  int v = g_wire_backend.load(std::memory_order_relaxed);
+  if (v < 0) {
+    const char* s = std::getenv("T4J_WIRE_BACKEND");
+    v = kBackendAuto;
+    if (s && s[0]) {
+      if (!std::strcmp(s, "sendmsg")) v = kBackendSendmsg;
+      else if (!std::strcmp(s, "uring")) v = kBackendUring;
+      // anything else (incl. "auto") stays auto: utils/config.py
+      // already failed loudly on invalid spellings at bridge init
+    }
+    g_wire_backend.store(v, std::memory_order_relaxed);
+  }
+  return v;
+}
+
+// ACTIVE backend after resolution: uring only when explicitly
+// requested (directly or by the calibrator writing the fitted arm
+// through set_wire_backend) AND the kernel probe passed.  The loud
+// degrade for an explicit-but-unsupported request prints once.
+bool uring_active() {
+  int m = wire_backend_mode();
+  if (m != kBackendUring) return false;
+  if (uring_supported()) return true;
+  if (!g_uring_degrade_logged.exchange(true)) {
+    std::fprintf(stderr,
+                 "r%d | t4j: T4J_WIRE_BACKEND=uring requested but this "
+                 "kernel has no usable io_uring — degrading to the sendmsg "
+                 "backend (docs/performance.md \"io_uring wire backend\")\n",
+                 g_rank);
+    std::fflush(stderr);
+  }
+  return false;
+}
+
+#if T4J_HAVE_URING
+// Engine-thread completion-driven idle wait: when the uring backend
+// is active the engine's idle cv.wait_for becomes an io_uring_enter
+// wait on a persistent POLL_ADD over this eventfd, and every notifier
+// that would have signalled the engine's condvars also pokes it.  The
+// wait is tick-bounded, so a poke lost to the (tiny) park-flag race
+// costs one tick, never a hang — the same bound the condvar ticks
+// gave.  The evfd is deliberately leaked at engine exit: closing it
+// while a racing poker holds the fd number could hit a recycled fd.
+std::atomic<int> g_engine_evfd{-1};
+std::atomic<bool> g_engine_parked{false};
+#endif
+
+void poke_engine() {
+#if T4J_HAVE_URING
+  if (!g_engine_parked.load(std::memory_order_relaxed)) return;
+  int fd = g_engine_evfd.load(std::memory_order_relaxed);
+  if (fd < 0) return;
+  uint64_t one = 1;
+  (void)!::write(fd, &one, sizeof(one));
+#endif
 }
 
 // ---------------------------------------------- compressed wire dtype
@@ -1011,6 +1373,20 @@ struct Replay {
 // self-healing state (docs/failure-semantics.md "self-healing
 // transport", docs/performance.md "striped links").  Lock order:
 // send_mu before mu; never the reverse.
+#if T4J_HAVE_URING
+// Per-stripe io_uring send context, guarded by the stripe's send_mu
+// (one submitter).  The msghdr/iovec arrays are stable storage for
+// SQEs between submit and completion — stripe_write never returns
+// with SQEs in flight, so their lifetime is one stripe_write call.
+struct UringSendCtx {
+  UringRing ring;
+  bool ok = false;        // ring opened
+  bool fixed_ok = true;   // WRITE_FIXED/registered-arena path usable
+  std::vector<msghdr> mhs;
+  std::vector<iovec> iovs;
+};
+#endif
+
 struct Stripe {
   int fd = -1;
   std::mutex send_mu;  // serialises writers on fd (and fd swaps)
@@ -1067,6 +1443,18 @@ struct Stripe {
   std::atomic<uint64_t> reconnects{0};
   std::atomic<uint64_t> replayed_frames{0};
   std::atomic<uint64_t> replayed_bytes{0};
+  // Data-plane kernel crossings on this stripe (see tls_syscall_ctr):
+  // the numerator of the syscalls-per-frame metric, both backends.
+  std::atomic<uint64_t> tx_syscalls{0};
+  std::atomic<uint64_t> rx_syscalls{0};
+
+#if T4J_HAVE_URING
+  // io_uring send context, lazily built under send_mu on the first
+  // uring-backend write on this stripe (and after every fd swap the
+  // registered arena simply re-registers — registration is per-ring,
+  // not per-fd, so reconnects need no special casing).
+  std::unique_ptr<UringSendCtx> uring;
+#endif
 
   // A process exiting WITHOUT finalize (a fault raised through user
   // code that never reaches the atexit hook) must not std::terminate
@@ -1199,6 +1587,7 @@ void wake_all_pipes() {
   // wait cannot miss the notification (classic lost-wakeup window)
   { std::lock_guard<std::mutex> lk(g_mail_mu); }
   g_mail_cv.notify_all();
+  poke_engine();
 }
 
 // ------------------------------------------------- deterministic faults
@@ -1387,9 +1776,12 @@ int io_wait(int fd, short events, const Deadline& dl,
     // t4j-postmortem and t4j-top key on
     tel::flight_heartbeat();
     if (!ignore_stop && g_stop.load(std::memory_order_acquire)) return -1;
-    int tick = dl.remaining_ms(100);
+    // adaptive tick: tight while frames are in flight (small-frame
+    // latency), lazy when idle (idle ranks must not spin)
+    int tick = dl.remaining_ms(io_tick_ms());
     if (dl.bounded && tick == 0) return 0;
     pollfd pfd{fd, events, 0};
+    count_syscall();
     int rc = ::poll(&pfd, 1, tick);
     if (rc < 0 && errno != EINTR && errno != EAGAIN) return -1;
     if (rc > 0) return 1;
@@ -1400,6 +1792,7 @@ IoStatus nb_read_all(int fd, void* buf, size_t n, const Deadline& dl,
                      bool ignore_stop = false) {
   char* p = static_cast<char*>(buf);
   while (n > 0) {
+    count_syscall();
     ssize_t r = ::read(fd, p, n);
     if (r > 0) {
       p += r;
@@ -1428,6 +1821,7 @@ IoStatus nb_write_all(int fd, iovec* iov, int iovcnt, const Deadline& dl,
   while (iovcnt > 0) {
     mh.msg_iov = iov;
     mh.msg_iovlen = iovcnt;
+    count_syscall();
     ssize_t w = ::sendmsg(fd, &mh, MSG_NOSIGNAL | extra_flags);
     if (w < 0) {
       if (errno == EINTR) continue;
@@ -1512,6 +1906,7 @@ void mailbox_push(Frame&& f, int peer, int stripe, tel::Plane plane) {
     g_mailbox.push_back(std::move(f));
   }
   g_mail_cv.notify_all();
+  poke_engine();
   tel::trace_event(tel::kFrameRx, tel::kInstant, plane, stripe, peer,
                    nbytes);
 }
@@ -1585,6 +1980,7 @@ constexpr size_t kRecvBufBytes = 64 << 10;
 IoStatus fill_some(int fd, uint8_t* rb, size_t& len, size_t cap,
                    const Deadline& dl) {
   for (;;) {
+    count_syscall();
     ssize_t r = ::recv(fd, rb + len, cap - len, 0);
     if (r > 0) {
       len += static_cast<size_t>(r);
@@ -1601,9 +1997,168 @@ IoStatus fill_some(int fd, uint8_t* rb, size_t& len, size_t cap,
   }
 }
 
+#if T4J_HAVE_URING
+
+constexpr uint64_t kCancelUd = ~0ull;
+
+// Cancel-and-drain in-flight SQEs (identified by user_data) so no SQE
+// ever outlives the memory it points at.  Returns false if the ring
+// wedged — the caller must then LEAK the referenced buffer rather
+// than hand memory the kernel may still write back to the allocator.
+bool uring_cancel_drain(UringRing& r, unsigned inflight,
+                        const uint64_t* uds, unsigned nuds) {
+  if (r.fd < 0 || inflight == 0) return true;
+  unsigned to_submit = 0;
+  for (unsigned i = 0; i < nuds; ++i) {
+    io_uring_sqe* e = r.get_sqe();
+    e->opcode = IORING_OP_ASYNC_CANCEL;
+    e->fd = -1;
+    e->addr = uds[i];
+    e->user_data = kCancelUd;
+    ++to_submit;
+  }
+  for (int round = 0; round < 100 && inflight; ++round) {
+    tel::flight_heartbeat();
+    int rc = uring_enter(r, to_submit, 1, 100);
+    if (rc >= 0) to_submit = 0;
+    else if (errno != ETIME && errno != EINTR && errno != EAGAIN &&
+             errno != EBUSY)
+      break;
+    io_uring_cqe cqe;
+    while (r.pop_cqe(&cqe))
+      if (cqe.user_data != kCancelUd && inflight) --inflight;
+  }
+  return inflight == 0;
+}
+
+// Per-reader io_uring recv context: its own ring (rings are single-
+// submitter) with the 64 KiB reader buffer registered as fixed
+// index 0.
+struct UringRecvCtx {
+  UringRing ring;
+  bool fixed_ok = false;
+
+  bool open_for(uint8_t* rb, size_t cap) {
+    if (!ring.open_ring(8)) return false;
+    fixed_ok = ring.register_buffer(rb, cap);
+    return true;
+  }
+};
+
+// uring variant of fill_some: one READ_FIXED (over the registered
+// reader buffer) or RECV completion wait replaces the classic
+// recv+poll syscall pair — a quiet reader parks inside
+// io_uring_enter and wakes with the bytes already landed.  Never
+// returns with the recv SQE still in flight (stop/timeout edges
+// cancel-and-drain; *wedged reports a drain failure).
+IoStatus fill_some_uring(UringRecvCtx& c, int fd, uint8_t* rb, size_t& len,
+                         size_t cap, const Deadline& dl, bool* wedged) {
+  // Opportunistic drain first: bytes that accumulated while the
+  // caller processed the previous batch are claimed with ONE plain
+  // recv — the completion path below is only paid when the socket is
+  // genuinely empty, where its single enter replaces the classic
+  // recv(EAGAIN)+poll+recv round trip.  Without this, the kernel-side
+  // retry completes the armed RECV the instant the FIRST bytes land,
+  // so an eager reader wakes per TCP chunk instead of per accumulated
+  // run and spends MORE syscalls than the classic path, not fewer.
+  {
+    count_syscall();
+    ssize_t r = ::recv(fd, rb + len, cap - len, MSG_DONTWAIT);
+    if (r > 0) {
+      len += static_cast<size_t>(r);
+      return IoStatus::kOk;
+    }
+    if (r == 0) return IoStatus::kEof;
+    if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+        errno != EINTR)
+      return IoStatus::kError;
+  }
+  bool submitted = false;
+  auto abort_inflight = [&]() {
+    if (!submitted) return;
+    const uint64_t ud = 1;
+    if (!uring_cancel_drain(c.ring, 1, &ud, 1)) *wedged = true;
+    submitted = false;
+  };
+  for (;;) {
+    tel::flight_heartbeat();
+    bool stopping = g_stop.load(std::memory_order_acquire);
+    int tick = dl.remaining_ms(io_tick_ms());
+    if (stopping || (dl.bounded && tick == 0)) {
+      abort_inflight();
+      return stopping ? IoStatus::kStopped : IoStatus::kTimeout;
+    }
+    int rc;
+    if (!submitted) {
+      io_uring_sqe* e = c.ring.get_sqe();
+      if (c.fixed_ok) {
+        e->opcode = IORING_OP_READ_FIXED;
+        e->buf_index = 0;
+      } else {
+        e->opcode = IORING_OP_RECV;
+      }
+      e->fd = fd;
+      e->addr = reinterpret_cast<uint64_t>(rb + len);
+      e->len = static_cast<unsigned>(cap - len);
+      e->user_data = 1;
+      rc = uring_enter(c.ring, 1, 1, tick);
+      if (rc >= 1) submitted = true;
+    } else {
+      rc = uring_enter(c.ring, 0, 1, tick);
+    }
+    if (rc < 0 && errno != ETIME && errno != EINTR && errno != EAGAIN &&
+        errno != EBUSY) {
+      abort_inflight();
+      return IoStatus::kError;
+    }
+    io_uring_cqe cqe;
+    while (c.ring.pop_cqe(&cqe)) {
+      if (cqe.user_data == kCancelUd) continue;  // stale drain residue
+      submitted = false;
+      int res = cqe.res;
+      if (res > 0) {
+        len += static_cast<size_t>(res);
+        return IoStatus::kOk;
+      }
+      if (res == 0) return IoStatus::kEof;
+      if (res == -EINTR || res == -EAGAIN) break;  // resubmit
+      if (c.fixed_ok &&
+          (res == -EINVAL || res == -EOPNOTSUPP || res == -EFAULT)) {
+        // registered-buffer path not honoured here: quiet sticky
+        // fallback to plain RECV on this reader
+        c.fixed_ok = false;
+        break;
+      }
+      errno = -res;
+      return IoStatus::kError;
+    }
+  }
+}
+
+#endif  // T4J_HAVE_URING
+
 void reader_loop(int peer, int stripe, int fd) {
   Deadline forever;  // idle between frames is legal — wait unbounded
+  // every kernel crossing this thread makes lands on the stripe's rx
+  // counter (syscalls-per-frame observability)
+  TlsSyscallScope sysc_scope(&g_peers[peer].s[stripe].rx_syscalls);
   std::unique_ptr<uint8_t[]> rb(new uint8_t[kRecvBufBytes]);
+#if T4J_HAVE_URING
+  bool ring_wedged = false;
+  // if the recv ring wedges on teardown the kernel may still own a
+  // READ_FIXED into rb: leak the 64 KiB rather than free it under an
+  // in-flight DMA-style write.  Guard destroys before rb, after uctx.
+  struct RbGuard {
+    std::unique_ptr<uint8_t[]>* rb;
+    bool* wedged;
+    ~RbGuard() {
+      if (*wedged) (void)rb->release();
+    }
+  } rb_guard{&rb, &ring_wedged};
+  UringRecvCtx uctx;
+  const bool use_uring =
+      uring_active() && uctx.open_for(rb.get(), kRecvBufBytes);
+#endif
   size_t off = 0, len = 0;  // rb[off, off+len) holds undelivered bytes
 
   // Shared failure handling: mid = true when the stream died inside a
@@ -1643,7 +2198,14 @@ void reader_loop(int peer, int stripe, int fd) {
     while (len < sizeof(WireHeader)) {
       if (off && len) std::memmove(rb.get(), rb.get() + off, len);
       off = 0;
-      IoStatus st = fill_some(fd, rb.get(), len, kRecvBufBytes, forever);
+      IoStatus st;
+#if T4J_HAVE_URING
+      if (use_uring)
+        st = fill_some_uring(uctx, fd, rb.get(), len, kRecvBufBytes,
+                             forever, &ring_wedged);
+      else
+#endif
+        st = fill_some(fd, rb.get(), len, kRecvBufBytes, forever);
       if (st != IoStatus::kOk) {
         stream_down(st, len > 0, 0);
         return;
@@ -1682,7 +2244,9 @@ void reader_loop(int peer, int stripe, int fd) {
     }
     if (have < h.nbytes) {
       // mid-frame the peer is actively sending: a stall here is a
-      // real fault, so the per-op deadline applies (when configured)
+      // real fault, so the per-op deadline applies (when configured),
+      // and the poll tick tightens while the body is in flight
+      InflightScope busy;
       Deadline body = Deadline::after(effective_op_timeout());
       IoStatus bst = nb_read_all(fd, f.data.data() + have,
                                  h.nbytes - have, body);
@@ -1826,6 +2390,7 @@ void reap_zc(Stripe& st) {
     msghdr mh{};
     mh.msg_control = ctrl;
     mh.msg_controllen = sizeof(ctrl);
+    count_syscall();
     ssize_t r = ::recvmsg(st.fd, &mh, MSG_ERRQUEUE | MSG_DONTWAIT);
     if (r < 0) return;  // EAGAIN: nothing pending right now
     for (cmsghdr* c = CMSG_FIRSTHDR(&mh); c; c = CMSG_NXTHDR(&mh, c)) {
@@ -1870,6 +2435,7 @@ bool zc_wait(Stripe& st, uint32_t upto, const Deadline& dl) {
     // serialising the pipeline on the poll granularity (a 20ms tick
     // measured 2.5x busbw loss on the eviction-heavy 64MB path)
     pollfd pfd{st.fd, POLLERR, 0};
+    count_syscall();
     ::poll(&pfd, 1, dl.remaining_ms(1));
   }
   return true;
@@ -2088,6 +2654,292 @@ void deal_frames(PeerLink& p, int ctx, int tag, WirePart* parts,
   }
 }
 
+#if T4J_HAVE_URING
+
+// Payloads at or above this (and already resident in the replay
+// arena, i.e. healing on) take the registered-buffer WRITE_FIXED
+// path; below it one SENDMSG SQE per frame is cheaper than splitting
+// header and payload across two SQEs.
+constexpr size_t kUringFixedMinBytes = 64 << 10;
+
+// io_uring variant of stripe_write (caller holds st.send_mu, tls
+// syscall counter already points at tx).  A whole segment run is
+// queued as one SENDMSG SQE per frame, IOSQE_IO_LINK-chained to
+// preserve stream order, and submitted with ONE io_uring_enter that
+// also waits for the batch's completions.  Large arena-resident
+// frames go out as a MSG_MORE header SEND linked to a WRITE_FIXED
+// over the registered replay arena — fixed-buffer I/O, the arena is
+// the only memory the kernel ever sees.  The WRITE_FIXED SQE always
+// TERMINATES its chain: io_uring only breaks links on res < 0, not
+// on short success, and socket writes may legitimately complete
+// short under backpressure — a linked successor after a short write
+// would silently desynchronise the stream.  Short remainders are
+// resubmitted explicitly instead.
+//
+// INVARIANT: never returns with SQEs in flight (stop/timeout edges
+// cancel-and-drain after shutting the socket down), so every iovec /
+// msghdr / arena pointer an SQE references strictly outlives it.
+// Any failed or short SQE kills the socket: the peer then observes a
+// clean mid-frame EOF (repairable via replay) rather than garbled
+// framing (fail-stop).  Wire bytes are identical to the sendmsg
+// backend — only the syscall shape differs.
+IoStatus stripe_write_uring(Stripe& st, WirePart** run, size_t n,
+                            bool healing, const Deadline& dl) {
+  UringSendCtx& c = *st.uring;
+  constexpr long long kPending = INT64_MIN;
+  auto bail = [&](IoStatus s, size_t next) {
+    if (healing)
+      for (size_t k = next; k < n; ++k)
+        ring_append(st, run[k]->h, run[k]->buf, run[k]->nbytes);
+    return s;
+  };
+  auto kill_stream = [&]() {
+    if (st.fd >= 0) ::shutdown(st.fd, SHUT_RDWR);
+  };
+  std::vector<long long> res;     // per-user_data completion results
+  std::vector<uint64_t> expect;   // bytes each SQE must move
+  // Submit `queued` SQEs and wait until every slot in res[] has a
+  // completion.  One enter both submits and waits on the happy path.
+  auto wait_all = [&](unsigned queued) -> IoStatus {
+    unsigned count = static_cast<unsigned>(res.size());
+    unsigned done = 0;
+    unsigned to_submit = queued;
+    for (;;) {
+      io_uring_cqe cqe;
+      while (c.ring.pop_cqe(&cqe)) {
+        if (cqe.user_data == kCancelUd) continue;
+        if (cqe.user_data < count && res[cqe.user_data] == kPending) {
+          res[cqe.user_data] = cqe.res;
+          ++done;
+        }
+      }
+      if (done >= count && !to_submit) return IoStatus::kOk;
+      tel::flight_heartbeat();
+      bool stopping = g_stop.load(std::memory_order_acquire);
+      int tick = dl.remaining_ms(io_tick_ms());
+      bool timed_out = dl.bounded && tick == 0;
+      int hard_errno = 0;
+      if (!stopping && !timed_out) {
+        int rc = uring_enter(c.ring, to_submit, count - done, tick);
+        if (rc >= 0) {
+          unsigned sub = static_cast<unsigned>(rc);
+          to_submit -= sub < to_submit ? sub : to_submit;
+          continue;
+        }
+        if (errno == ETIME || errno == EINTR || errno == EAGAIN ||
+            errno == EBUSY)
+          continue;
+        hard_errno = errno;
+      }
+      // stop / deadline / broken ring: SQEs already queued cannot be
+      // un-queued — force the submit, kill the stream so blocked
+      // sends resolve promptly, then cancel-and-drain
+      if (to_submit) {
+        int rc = uring_enter(c.ring, to_submit, 0, -1);
+        if (rc > 0)
+          to_submit -= static_cast<unsigned>(rc) < to_submit
+                           ? static_cast<unsigned>(rc)
+                           : to_submit;
+      }
+      kill_stream();
+      std::vector<uint64_t> uds;
+      for (unsigned k = 0; k < count; ++k)
+        if (res[k] == kPending) uds.push_back(k);
+      if (!uring_cancel_drain(c.ring, count - done, uds.data(),
+                              static_cast<unsigned>(uds.size())))
+        c.ok = false;  // wedged ring: never reuse it (keeps arena pin)
+      errno = hard_errno ? hard_errno : EPIPE;
+      return stopping
+                 ? IoStatus::kStopped
+                 : (timed_out ? IoStatus::kTimeout : IoStatus::kError);
+    }
+  };
+  size_t batch_cap = c.ring.entries > 2 ? c.ring.entries - 2 : 1;
+  if (batch_cap > 256) batch_cap = 256;
+  bool pre_appended = false;  // run[i] already in the ring (see below)
+  size_t i = 0;
+  while (i < n) {
+    WirePart& w = *run[i];
+    if (healing && c.fixed_ok && !pre_appended &&
+        w.nbytes >= kUringFixedMinBytes) {
+      // ---- registered-arena WRITE_FIXED path (its own submission:
+      // nothing pending — fixed frames always start a fresh batch)
+      Replay& rep = ring_append(st, w.h, w.buf, w.nbytes);
+      if (!c.ring.register_buffer(st.ring_buf.get(), st.ring_cap)) {
+        // registration refused (pin limits): sticky fallback — the
+        // frame is already appended, let the SENDMSG batch below
+        // send it from the arena without re-appending
+        c.fixed_ok = false;
+        pre_appended = true;
+      } else {
+        throttle_stripe(st, sizeof(WireHeader) + w.nbytes);
+        uint8_t* base = st.ring_buf.get() + rep.off;
+        res.assign(2, kPending);
+        expect.assign(2, 0);
+        expect[0] = sizeof(WireHeader);
+        expect[1] = w.nbytes;
+        io_uring_sqe* eh = c.ring.get_sqe();
+        eh->opcode = IORING_OP_SEND;
+        eh->fd = st.fd;
+        eh->addr = reinterpret_cast<uint64_t>(&rep.h);
+        eh->len = sizeof(WireHeader);
+        eh->msg_flags = MSG_NOSIGNAL | MSG_WAITALL | MSG_MORE;
+        eh->flags = IOSQE_IO_LINK;
+        eh->user_data = 0;
+        io_uring_sqe* ep = c.ring.get_sqe();
+        ep->opcode = IORING_OP_WRITE_FIXED;
+        ep->fd = st.fd;
+        ep->addr = reinterpret_cast<uint64_t>(base);
+        ep->len = static_cast<unsigned>(w.nbytes);
+        ep->off = 0;
+        ep->buf_index = 0;
+        ep->user_data = 1;
+        IoStatus s = wait_all(2);
+        if (s != IoStatus::kOk) return bail(s, i + 1);
+        if (res[0] != static_cast<long long>(sizeof(WireHeader))) {
+          kill_stream();
+          errno = res[0] < 0 ? static_cast<int>(-res[0]) : EPIPE;
+          if (res[0] == -ECANCELED) errno = EPIPE;
+          return bail(IoStatus::kError, i + 1);
+        }
+        long long sent = res[1];
+        if (sent < 0 && (sent == -EINVAL || sent == -EOPNOTSUPP ||
+                         sent == -EFAULT)) {
+          // fixed-buffer op not honoured here: header is already on
+          // the wire, finish the payload classically and stop trying
+          c.fixed_ok = false;
+          iovec pv{base, w.nbytes};
+          IoStatus s2 = nb_write_all(st.fd, &pv, 1, dl);
+          if (s2 != IoStatus::kOk) return bail(s2, i + 1);
+          ++i;
+          continue;
+        }
+        size_t done_b = sent > 0 ? static_cast<size_t>(sent) : 0;
+        if (sent < 0 && sent != -EINTR && sent != -EAGAIN) {
+          kill_stream();
+          errno = sent == -ECANCELED ? EPIPE : static_cast<int>(-sent);
+          return bail(IoStatus::kError, i + 1);
+        }
+        // short (or retryable) completion: resubmit the remainder —
+        // each remainder SQE again terminates its own submission
+        while (done_b < w.nbytes) {
+          res.assign(1, kPending);
+          io_uring_sqe* er = c.ring.get_sqe();
+          er->opcode = IORING_OP_WRITE_FIXED;
+          er->fd = st.fd;
+          er->addr = reinterpret_cast<uint64_t>(base + done_b);
+          er->len = static_cast<unsigned>(w.nbytes - done_b);
+          er->off = 0;
+          er->buf_index = 0;
+          er->user_data = 0;
+          IoStatus s2 = wait_all(1);
+          if (s2 != IoStatus::kOk) return bail(s2, i + 1);
+          long long r2 = res[0];
+          if (r2 == -EINTR || r2 == -EAGAIN) continue;
+          if (r2 <= 0) {
+            kill_stream();
+            errno = r2 < 0 ? static_cast<int>(-r2) : EPIPE;
+            if (r2 == -ECANCELED) errno = EPIPE;
+            return bail(IoStatus::kError, i + 1);
+          }
+          done_b += static_cast<size_t>(r2);
+        }
+        ++i;
+        continue;
+      }
+    }
+    // ---- gather batch: the whole run segment as ONE SENDMSG SQE —
+    // header + payload iovec pairs, the exact classic gather-write
+    // shape, so a single submission and a single completion cover the
+    // run.  (An earlier shape queued one linked SENDMSG per frame;
+    // the link-by-link task-work between chained SQEs cost more
+    // small-frame latency than the batched submit saved.)  Same arena
+    // flush discipline as the classic path: an append that would
+    // evict breaks the batch so no queued iovec ever points at arena
+    // bytes an eviction could hand to a later frame.
+    size_t maxb = (n - i) < batch_cap ? (n - i) : batch_cap;
+    c.mhs.clear();
+    c.iovs.clear();
+    c.mhs.reserve(1);
+    c.iovs.reserve(2 * maxb);
+    size_t total = 0;
+    size_t j = i;
+    while (j < n && (j - i) < maxb) {
+      WirePart& b = *run[j];
+      if (j != i && healing && c.fixed_ok &&
+          b.nbytes >= kUringFixedMinBytes)
+        break;  // the fixed frame starts its own submission
+      if (healing) {
+        bool pre = (j == i) && pre_appended;
+        if (!pre && j != i && !ring_has_space(st, b.nbytes))
+          break;  // would evict under the pending batch: flush first
+        Replay& r2 =
+            pre ? st.ring.back() : ring_append(st, b.h, b.buf, b.nbytes);
+        c.iovs.push_back({&r2.h, sizeof(WireHeader)});
+        if (r2.h.nbytes)
+          c.iovs.push_back({st.ring_buf.get() + r2.off,
+                            static_cast<size_t>(r2.h.nbytes)});
+      } else {
+        c.iovs.push_back({&b.h, sizeof(WireHeader)});
+        if (b.nbytes)
+          c.iovs.push_back({const_cast<void*>(b.buf), b.nbytes});
+      }
+      total += sizeof(WireHeader) + b.nbytes;
+      ++j;
+    }
+    size_t batched = j - i;
+    throttle_stripe(st, total);
+    size_t sent_total = 0;
+    size_t iov_pos = 0;  // first iovec not yet fully on the wire
+    while (sent_total < total) {
+      c.mhs.assign(1, msghdr{});
+      msghdr& mh = c.mhs[0];
+      mh.msg_iov = c.iovs.data() + iov_pos;
+      mh.msg_iovlen = c.iovs.size() - iov_pos;
+      res.assign(1, kPending);
+      io_uring_sqe* e = c.ring.get_sqe();
+      e->opcode = IORING_OP_SENDMSG;
+      e->fd = st.fd;
+      e->addr = reinterpret_cast<uint64_t>(&mh);
+      e->len = 1;
+      e->msg_flags = MSG_NOSIGNAL | MSG_WAITALL;
+      e->user_data = 0;
+      IoStatus s = wait_all(1);
+      if (s != IoStatus::kOk) return bail(s, i + batched);
+      long long r = res[0];
+      if (r == -EINTR || r == -EAGAIN) continue;
+      if (r <= 0) {
+        // error or -ECANCELED from a broken link: the stream byte
+        // position is indeterminate — kill it and let replay
+        // (healing) or fail-stop (not) own recovery
+        kill_stream();
+        errno = r < 0 ? (r == -ECANCELED ? EPIPE : static_cast<int>(-r))
+                      : EPIPE;
+        return bail(IoStatus::kError, i + batched);
+      }
+      sent_total += static_cast<size_t>(r);
+      // short completion (pre-WAITALL-retry kernels or a signal
+      // race): advance the iovec cursor and resubmit the tail —
+      // never kill a healthy stream for a short write
+      size_t adv = static_cast<size_t>(r);
+      while (iov_pos < c.iovs.size() && adv >= c.iovs[iov_pos].iov_len) {
+        adv -= c.iovs[iov_pos].iov_len;
+        ++iov_pos;
+      }
+      if (adv && iov_pos < c.iovs.size()) {
+        c.iovs[iov_pos].iov_base =
+            static_cast<uint8_t*>(c.iovs[iov_pos].iov_base) + adv;
+        c.iovs[iov_pos].iov_len -= adv;
+      }
+    }
+    i += batched;
+    pre_appended = false;
+  }
+  return IoStatus::kOk;
+}
+
+#endif  // T4J_HAVE_URING
+
 // Write a run of frames for ONE stripe (caller holds st.send_mu).
 // Small frames gather into sendmsg iovec batches (header + payload
 // pairs, up to T4J_SENDMSG_BATCH frames / one syscall); frames at or
@@ -2100,6 +2952,19 @@ void deal_frames(PeerLink& p, int ctx, int tag, WirePart* parts,
 // the wire or in the ring).
 IoStatus stripe_write(Stripe& st, WirePart** run, size_t n, bool healing,
                       const Deadline& dl, size_t* zc_out) {
+  // every kernel crossing below lands on this stripe's tx counter
+  TlsSyscallScope sysc_scope(&st.tx_syscalls);
+#if T4J_HAVE_URING
+  if (uring_active()) {
+    if (!st.uring) {
+      st.uring.reset(new UringSendCtx);
+      st.uring->ok = st.uring->ring.open_ring(512);
+    }
+    if (st.uring->ok) return stripe_write_uring(st, run, n, healing, dl);
+    // ring setup failed (fd limits, seccomp): quiet sticky fallback
+    // to the classic path on this stripe — wire bytes are identical
+  }
+#endif
   long long zc_min = zc_min_bytes();
   int batch_cap = sendmsg_batch();
   if (batch_cap > 256) batch_cap = 256;  // IOV_MAX safety (2 iov/frame)
@@ -2150,6 +3015,7 @@ IoStatus stripe_write(Stripe& st, WirePart** run, size_t n, bool healing,
         msghdr mh{};
         mh.msg_iov = &pv;
         mh.msg_iovlen = 1;
+        count_syscall();
         ssize_t wr = ::sendmsg(st.fd, &mh, MSG_NOSIGNAL | MSG_ZEROCOPY);
         if (wr < 0) {
           if (errno == EINTR) continue;
@@ -2264,6 +3130,7 @@ void link_send(int world_dest, int ctx, int tag, const void** bufs,
     fail_arg("send to unconnected peer r" + std::to_string(world_dest));
   double limit_s = effective_op_timeout();
   Deadline dl = Deadline::after(limit_s);
+  InflightScope busy;  // tighten the io poll tick while we send
   for (size_t i = 0; i < nparts; ++i) maybe_inject_send_fault();
   std::vector<WirePart> parts(nparts);
   for (size_t i = 0; i < nparts; ++i) {
@@ -2428,6 +3295,7 @@ void raw_send(int world_dest, int ctx, int tag, const void* buf,
       g_mailbox.push_back(std::move(f));
     }
     g_mail_cv.notify_all();
+    poke_engine();
     tel::trace_event(tel::kFrameTx, tel::kInstant, tel::kPlaneNone, -1,
                      world_dest, nbytes);
     return;
@@ -2504,8 +3372,11 @@ Frame raw_recv(int world_source, int ctx, int tag) {
               "behind");
     }
     if (dl.bounded)
-      g_mail_cv.wait_for(lk,
-                         std::chrono::milliseconds(dl.remaining_ms(100)));
+      // adaptive tick (io_tick_ms): tight while frames are moving so
+      // a notify raced against the deadline check costs ~5ms, lazy
+      // when the rank is idle so bounded recvs don't spin
+      g_mail_cv.wait_for(
+          lk, std::chrono::milliseconds(dl.remaining_ms(io_tick_ms())));
     else
       // unbounded (the default): sleep until notified — post_fault and
       // raw_send both notify under g_mail_mu, so no wakeup can be lost
@@ -3316,6 +4187,7 @@ void pipe_reader_loop(int peer, shm::Pipe* pipe) {
       g_mailbox.push_back(std::move(f));
     }
     g_mail_cv.notify_all();
+    poke_engine();
     tel::trace_event(tel::kFrameRx, tel::kInstant, tel::kPlaneShm, -1,
                      peer, h.nbytes);
   }
@@ -5304,6 +6176,7 @@ void wake_async_engine() {
   { std::lock_guard<std::mutex> lk(e.mu); }
   e.cv.notify_all();
   e.done_cv.notify_all();
+  poke_engine();
 }
 
 // Async lifecycle events pack the submitted op's kind into the comm
@@ -5457,6 +6330,59 @@ void engine_loop() {
   tls_engine_thread = true;
   AsyncEngine& e = engine();
   std::vector<std::shared_ptr<AsyncOp>> parked;  // unmatched irecvs
+#if T4J_HAVE_URING
+  // Completion-driven idle progress (uring backend): the idle
+  // cv.wait_for becomes an io_uring_enter wait on a persistent
+  // POLL_ADD over the engine eventfd — notifiers poke the evfd (see
+  // poke_engine), the flight-recorder heartbeat still bumps per poll
+  // tick at the call sites.  Falls back to the condvars whenever the
+  // ring is unavailable.
+  struct EngineWait {
+    UringRing ring;
+    bool ok = false;
+    bool armed = false;            // POLL_ADD queued or in flight
+    unsigned pending_submit = 0;
+  } ew;
+  if (uring_active() && ew.ring.open_ring(8)) {
+    int efd = ::eventfd(0, EFD_NONBLOCK);
+    if (efd >= 0) {
+      g_engine_evfd.store(efd, std::memory_order_release);
+      ew.ok = true;
+    }
+  }
+  auto uring_idle_wait = [&](int ms) -> bool {
+    if (!ew.ok) return false;
+    int efd = g_engine_evfd.load(std::memory_order_relaxed);
+    if (efd < 0) return false;
+    if (!ew.armed) {
+      io_uring_sqe* sq = ew.ring.get_sqe();
+      sq->opcode = IORING_OP_POLL_ADD;
+      sq->fd = efd;
+      sq->poll32_events = POLLIN;
+      sq->user_data = 1;
+      ew.armed = true;
+      ew.pending_submit += 1;
+    }
+    int rc = uring_enter(ew.ring, ew.pending_submit, 1, ms);
+    if (rc >= 0) {
+      unsigned sub = static_cast<unsigned>(rc);
+      ew.pending_submit -=
+          sub < ew.pending_submit ? sub : ew.pending_submit;
+    } else if (errno != ETIME && errno != EINTR && errno != EAGAIN &&
+               errno != EBUSY) {
+      ew.ok = false;  // wedged: permanent fallback to the condvars
+      return false;
+    }
+    io_uring_cqe cqe;
+    while (ew.ring.pop_cqe(&cqe)) {
+      if (cqe.user_data != 1) continue;
+      ew.armed = false;  // poked (or poll error): re-arm next round
+      uint64_t v;
+      (void)!::read(efd, &v, sizeof(v));
+    }
+    return true;
+  };
+#endif
   for (;;) {
     std::shared_ptr<AsyncOp> next;
     bool quit;
@@ -5468,6 +6394,19 @@ void engine_loop() {
         // flight-recorder heartbeat even when no op (and no socket
         // poll) is in flight
         tel::flight_heartbeat();
+#if T4J_HAVE_URING
+        if (ew.ok) {
+          // park flag set BEFORE unlocking e.mu: any notifier that
+          // mutates engine state after our predicate check reads it
+          // after its own e.mu section, so its poke cannot be lost
+          g_engine_parked.store(true, std::memory_order_seq_cst);
+          lk.unlock();
+          bool used = uring_idle_wait(io_tick_ms());
+          g_engine_parked.store(false, std::memory_order_relaxed);
+          lk.lock();
+          if (used) continue;
+        }
+#endif
         e.cv.wait_for(lk, std::chrono::milliseconds(200));
       }
       quit = e.quit;
@@ -5526,6 +6465,16 @@ void engine_loop() {
             // same bounded wait while soft-stopped (resize in flight):
             // a resizing rank is alive, and its heartbeat must say so
             tel::flight_heartbeat();
+#if T4J_HAVE_URING
+            if (ew.ok) {
+              g_engine_parked.store(true, std::memory_order_seq_cst);
+              lk.unlock();
+              bool used = uring_idle_wait(io_tick_ms());
+              g_engine_parked.store(false, std::memory_order_relaxed);
+              lk.lock();
+              if (used) continue;
+            }
+#endif
             e.cv.wait_for(lk, std::chrono::milliseconds(200));
           }
           if (e.quit && e.queue.empty()) return;
@@ -5587,8 +6536,25 @@ void engine_loop() {
             break;
           }
       if (!ready && e.qsize.load(std::memory_order_relaxed) == 0 &&
-          !g_stop.load(std::memory_order_acquire))
-        g_mail_cv.wait_for(mlk, std::chrono::milliseconds(100));
+          !g_stop.load(std::memory_order_acquire)) {
+        tel::flight_heartbeat();
+#if T4J_HAVE_URING
+        if (ew.ok) {
+          g_engine_parked.store(true, std::memory_order_seq_cst);
+          mlk.unlock();
+          bool used = uring_idle_wait(io_tick_ms());
+          g_engine_parked.store(false, std::memory_order_relaxed);
+          if (!used) {
+            mlk.lock();
+            g_mail_cv.wait_for(mlk, std::chrono::milliseconds(100));
+          }
+        } else
+#endif
+          // the tick bounds the parked-deadline checks; adaptive so an
+          // idle engine with parked recvs does not spin
+          g_mail_cv.wait_for(
+              mlk, std::chrono::milliseconds(io_tick_ms()));
+      }
     }
   }
 }
@@ -5622,6 +6588,7 @@ uint64_t async_submit(const std::shared_ptr<AsyncOp>& op) {
   // the engine may be sleeping on the mailbox condvar (parked recvs)
   { std::lock_guard<std::mutex> lk(g_mail_mu); }
   g_mail_cv.notify_all();
+  poke_engine();
   return id;
 }
 
@@ -5668,6 +6635,7 @@ void stop_async_engine() {
   // already set on this path, so one notify makes it raise and drain
   { std::lock_guard<std::mutex> lk(g_mail_mu); }
   g_mail_cv.notify_all();
+  poke_engine();
   if (t.joinable()) t.join();
   size_t leaked;
   std::string kinds;
@@ -6799,6 +7767,8 @@ bool link_stats(int peer, LinkStats* out) {
     s->reconnects = 0;
     s->replayed_frames = 0;
     s->replayed_bytes = 0;
+    s->tx_syscalls = 0;
+    s->rx_syscalls = 0;
     int up = 0, dead = 0;
     for (int si = 0; si < p.nstripes; ++si) {
       Stripe& st = p.s[si];
@@ -6807,6 +7777,8 @@ bool link_stats(int peer, LinkStats* out) {
           st.replayed_frames.load(std::memory_order_relaxed);
       s->replayed_bytes +=
           st.replayed_bytes.load(std::memory_order_relaxed);
+      s->tx_syscalls += st.tx_syscalls.load(std::memory_order_relaxed);
+      s->rx_syscalls += st.rx_syscalls.load(std::memory_order_relaxed);
       std::lock_guard<std::mutex> lk(st.mu);
       if (st.state == Stripe::kUp) ++up;
       else if (st.state == Stripe::kDead) ++dead;
@@ -6816,14 +7788,16 @@ bool link_stats(int peer, LinkStats* out) {
     else s->state = 1;
   };
   if (peer < 0) {  // aggregate over every link
-    LinkStats total{0, 0, 0, 0};
+    LinkStats total{0, 0, 0, 0, 0, 0};
     for (int r = 0; r < g_size; ++r) {
       if (r == g_rank) continue;
-      LinkStats s{0, 0, 0, 0};
+      LinkStats s{0, 0, 0, 0, 0, 0};
       one(g_peers[r], &s);
       total.reconnects += s.reconnects;
       total.replayed_frames += s.replayed_frames;
       total.replayed_bytes += s.replayed_bytes;
+      total.tx_syscalls += s.tx_syscalls;
+      total.rx_syscalls += s.rx_syscalls;
       if (s.state > total.state) total.state = s.state;
     }
     *out = total;
@@ -6846,6 +7820,8 @@ bool link_stripe_stats(int peer, int stripe, LinkStats* out) {
   out->replayed_frames =
       st.replayed_frames.load(std::memory_order_relaxed);
   out->replayed_bytes = st.replayed_bytes.load(std::memory_order_relaxed);
+  out->tx_syscalls = st.tx_syscalls.load(std::memory_order_relaxed);
+  out->rx_syscalls = st.rx_syscalls.load(std::memory_order_relaxed);
   std::lock_guard<std::mutex> lk(st.mu);
   out->state = static_cast<int>(st.state);
   return true;
@@ -6900,6 +7876,29 @@ void wire_dtype_info(int* mode, unsigned long long* logical_bytes,
     *logical_bytes = g_wire_logical_bytes.load(std::memory_order_relaxed);
   if (wire_bytes)
     *wire_bytes = g_wire_comp_bytes.load(std::memory_order_relaxed);
+}
+
+void set_wire_backend(int mode) {
+  // < 0 keeps; 0/1/2 = sendmsg/uring/auto.  Out-of-range values are
+  // clamped to auto rather than trusted (utils/config.py owns env
+  // validation; the calibrator writes the fitted arm through here).
+  // Runtime-changeable: the stripe send contexts are built lazily on
+  // the first uring write and readers pick their path per connection,
+  // so the interleaved benchmark arms can A/B it inside one world
+  // (in-flight frames finish on the backend they started on — wire
+  // bytes are identical either way).
+  if (mode < 0) return;
+  if (mode > kBackendAuto) mode = kBackendAuto;
+  g_wire_backend.store(mode, std::memory_order_relaxed);
+}
+
+void wire_backend_info(int* mode, int* supported, int* active) {
+  // Valid before init: the probe is one cheap io_uring_setup, cached.
+  // Python's ensure_initialized uses `supported` to reject an
+  // explicit T4J_WIRE_BACKEND=uring on kernels that cannot honour it.
+  if (mode) *mode = wire_backend_mode();
+  if (supported) *supported = uring_supported() ? 1 : 0;
+  if (active) *active = uring_active() ? 1 : 0;
 }
 
 bool topology(TopoInfo* out) {
@@ -7336,6 +8335,28 @@ int init_from_env() {
       std::fflush(stderr);
       g_zc_min_bytes.store(0, std::memory_order_relaxed);
     }
+  }
+  // Wire backend (docs/performance.md "io_uring wire backend"):
+  // resolve the request while single-threaded so the probe and the
+  // loud no-io_uring degrade happen exactly once, before any reader
+  // or sender thread consults uring_active().
+  if (wire_backend_mode() == kBackendUring) {
+    if (uring_active()) {
+      // WRITE_FIXED on a socket has write(2) semantics — no
+      // MSG_NOSIGNAL — so make a dead peer surface as EPIPE instead
+      // of a process-killing SIGPIPE.  CPython already ignores
+      // SIGPIPE; this covers bare embedders, and an installed
+      // handler is respected.
+      struct sigaction sa;
+      std::memset(&sa, 0, sizeof(sa));
+      if (::sigaction(SIGPIPE, nullptr, &sa) == 0 &&
+          sa.sa_handler == SIG_DFL) {
+        sa.sa_handler = SIG_IGN;
+        (void)::sigaction(SIGPIPE, &sa, nullptr);
+      }
+    }
+    // !uring_active(): the explicit-request degrade already printed
+    // its one loud line inside uring_active()
   }
   parse_fault_plan();
   if (fault_armed(FaultPlan::kRefuse)) {

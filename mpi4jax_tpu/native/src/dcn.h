@@ -265,6 +265,29 @@ struct WireInfo {
 };
 void wire_info(WireInfo* out);
 
+// Wire backend selection (docs/performance.md "io_uring wire
+// backend").  mode: 0 = sendmsg (the classic gather-write/recv data
+// plane, byte-stable vs every prior release), 1 = io_uring (SQ-ring
+// submission of send/recv chains with registered buffers over the
+// replay arena), 2 = auto (uring when the kernel supports it and the
+// calibrator found it profitable, else sendmsg); < 0 keeps.  An
+// explicit uring request on a kernel without io_uring degrades
+// LOUDLY to sendmsg at init (the knob is a perf opt-in, not a
+// correctness contract — Python additionally rejects it before init
+// when the probe fails).  Frame bytes on the wire are identical
+// across backends: only the syscall shape changes.  Must be uniform
+// across ranks only by convention (mixed backends interoperate — the
+// wire protocol is unchanged — but benchmark labels assume
+// uniformity).  utils/config.py owns env validation
+// (T4J_WIRE_BACKEND=auto|sendmsg|uring).
+void set_wire_backend(int mode);
+
+// Effective wire-backend state: requested mode (0 sendmsg / 1 uring /
+// 2 auto), whether the running kernel supports io_uring (probed once,
+// cheap, valid before init), and the ACTIVE backend after resolution
+// (0 sendmsg / 1 uring).
+void wire_backend_info(int* mode, int* supported, int* active);
+
 // Wire dtype for compressed collectives (docs/performance.md
 // "Compressed collectives").  mode: 0 = off (payloads travel f32,
 // bit-identical to the uncompressed build), 1 = bf16 (round-to-
@@ -346,6 +369,15 @@ struct LinkStats {
   uint64_t reconnects;
   uint64_t replayed_frames;
   uint64_t replayed_bytes;
+  // Data-plane syscall counters (docs/performance.md "io_uring wire
+  // backend"): every kernel crossing the send/recv paths make on this
+  // link — sendmsg/recv/read/poll on the classic backend,
+  // io_uring_enter on the uring one.  The syscalls-per-frame ratio
+  // these give against the frame counters is the acceptance metric
+  // for the uring backend; it is counted at the syscall sites, never
+  // hand-derived.
+  uint64_t tx_syscalls;
+  uint64_t rx_syscalls;
   int state;
 };
 // peer >= 0: that link's counters (false for self/out-of-range).

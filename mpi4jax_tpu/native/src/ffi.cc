@@ -726,6 +726,25 @@ int32_t t4j_wire_info(int32_t* stripes_built, int32_t* stripes_active,
 // Runtime-changeable (the calibrator A/Bs it); must be uniform across
 // ranks.  utils/config.py owns env validation.
 void t4j_set_wire_dtype(int32_t mode) { t4j::set_wire_dtype(mode); }
+// Wire backend (docs/performance.md "io_uring wire backend"): mode
+// 0 sendmsg, 1 io_uring, 2 auto (< 0 keeps, > 2 clamps to auto).
+// Runtime-changeable between collectives (the calibrator A/Bs it);
+// must be uniform across ranks.  utils/config.py owns env validation.
+void t4j_set_wire_backend(int32_t mode) { t4j::set_wire_backend(mode); }
+// Requested mode, whether the kernel's io_uring probe succeeded, and
+// whether the uring data plane is actually in effect (mode == uring
+// AND supported).  Valid pre-init so ensure_initialized can reject an
+// explicit uring request on a kernel without io_uring before sockets
+// exist.  Returns 1 always.
+int32_t t4j_wire_backend_info(int32_t* mode, int32_t* supported,
+                              int32_t* active) {
+  int m = 0, s = 0, a = 0;
+  t4j::wire_backend_info(&m, &s, &a);
+  if (mode) *mode = m;
+  if (supported) *supported = s;
+  if (active) *active = a;
+  return 1;
+}
 // Effective wire dtype plus the cumulative logical (f32) vs wire
 // (compressed) byte counters over the compressed send path — the
 // provable byte saving.  Returns 1 always (pre-init it reports the
@@ -770,33 +789,42 @@ int32_t t4j_world_info(uint32_t* epoch, int32_t* alive_count,
 int32_t t4j_resize_wait(double timeout_s) {
   return t4j::resize_wait(timeout_s) ? 1 : 0;
 }
-// Per-peer reconnect/replay counters.  peer >= 0 selects one link;
-// peer < 0 aggregates every link (state = worst: 0 up, 1 broken,
-// 2 dead).  Returns 1 when the outputs were filled, 0 before init or
-// for an invalid peer.
+// Per-peer reconnect/replay/syscall counters.  peer >= 0 selects one
+// link; peer < 0 aggregates every link (state = worst: 0 up, 1 broken,
+// 2 dead).  tx/rx_syscalls count kernel crossings made by the wire
+// threads (sendmsg/recv/poll or io_uring_enter) — the syscalls-per-
+// frame metric reads these, never a hand-derived estimate.  Returns 1
+// when the outputs were filled, 0 before init or for an invalid peer.
 int32_t t4j_link_stats(int32_t peer, uint64_t* reconnects,
                        uint64_t* replayed_frames,
-                       uint64_t* replayed_bytes, int32_t* state) {
+                       uint64_t* replayed_bytes, uint64_t* tx_syscalls,
+                       uint64_t* rx_syscalls, int32_t* state) {
   t4j::LinkStats s;
   if (!t4j::link_stats(peer, &s)) return 0;
   if (reconnects) *reconnects = s.reconnects;
   if (replayed_frames) *replayed_frames = s.replayed_frames;
   if (replayed_bytes) *replayed_bytes = s.replayed_bytes;
+  if (tx_syscalls) *tx_syscalls = s.tx_syscalls;
+  if (rx_syscalls) *rx_syscalls = s.rx_syscalls;
   if (state) *state = s.state;
   return 1;
 }
-// One stripe's reconnect/replay counters + state (0 up, 1 broken,
-// 2 dead).  Returns 1 when filled, 0 before init or for an invalid
-// peer/stripe index (docs/performance.md "striped links").
+// One stripe's reconnect/replay/syscall counters + state (0 up,
+// 1 broken, 2 dead).  Returns 1 when filled, 0 before init or for an
+// invalid peer/stripe index (docs/performance.md "striped links").
 int32_t t4j_link_stripe_stats(int32_t peer, int32_t stripe,
                               uint64_t* reconnects,
                               uint64_t* replayed_frames,
-                              uint64_t* replayed_bytes, int32_t* state) {
+                              uint64_t* replayed_bytes,
+                              uint64_t* tx_syscalls,
+                              uint64_t* rx_syscalls, int32_t* state) {
   t4j::LinkStats s;
   if (!t4j::link_stripe_stats(peer, stripe, &s)) return 0;
   if (reconnects) *reconnects = s.reconnects;
   if (replayed_frames) *replayed_frames = s.replayed_frames;
   if (replayed_bytes) *replayed_bytes = s.replayed_bytes;
+  if (tx_syscalls) *tx_syscalls = s.tx_syscalls;
+  if (rx_syscalls) *rx_syscalls = s.rx_syscalls;
   if (state) *state = s.state;
   return 1;
 }

@@ -773,6 +773,32 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
         int((t.get("wire") or {}).get("wire_bytes") or 0)
         for t in tunings
     )
+    # wire backend (docs/performance.md "io_uring wire backend"):
+    # judged with the same provenance rule, with the native syscall
+    # counters summed as the evidence — the metric the acceptance
+    # gate reads, never derived from event counts
+    wire_backend = next(
+        (t.get("wire_backend")
+         or (t.get("wire") or {}).get("wire_backend")
+         for t in tunings
+         if t.get("wire_backend")
+         or (t.get("wire") or {}).get("wire_backend")),
+        "auto",
+    )
+    backend_active = next(
+        ((t.get("wire") or {}).get("wire_backend_active")
+         for t in tunings
+         if (t.get("wire") or {}).get("wire_backend_active")),
+        None,
+    )
+    tx_sys_total = sum(
+        int((v.link_stats.get("aggregate") or {}).get("tx_syscalls", 0))
+        for v in views
+    )
+    rx_sys_total = sum(
+        int((v.link_stats.get("aggregate") or {}).get("rx_syscalls", 0))
+        for v in views
+    )
     audit = {
         "ring_min_bytes": int(ring_min_bytes),
         "leader_ring_min_bytes": int(leader_ring_min_bytes),
@@ -791,6 +817,11 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
         "wire_bytes": wire_on_wire,
         "wire_ratio": (round(wire_logical / wire_on_wire, 2)
                        if wire_on_wire else None),
+        "wire_backend": wire_backend,
+        "wire_backend_source": knob_sources.get("wire_backend"),
+        "wire_backend_active": backend_active,
+        "tx_syscalls": tx_sys_total,
+        "rx_syscalls": rx_sys_total,
         "tree_bytes_over_ring_min": 0,
         "tree_calls_over_ring_min": 0,
         "flat_bytes_over_leader_min_on_multihost": 0,
@@ -834,6 +865,15 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
         })
 
     links_out = []
+    # per-link native syscall counters (dumped with the rank files):
+    # rides the stall rows so the wire attribution can say whether a
+    # slow link was syscall-bound and which backend it ran
+    sys_by_link = {}
+    for v in views:
+        for peer, s in (v.link_stats.get("per_peer") or {}).items():
+            sys_by_link[(v.rank, int(peer))] = (
+                int(s.get("tx_syscalls", 0)), int(s.get("rx_syscalls", 0))
+            )
     for (rank, peer), rec in sorted(link_stall.items()):
         stalled_ops = sorted(
             rec["ops"].items(), key=lambda kv: kv[1], reverse=True
@@ -855,11 +895,14 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
             if total > 0 and by_stripe[top] >= 0.8 * total:
                 slow_stripe = top
                 cause = f"repair (stripe {top})"
+        txs, rxs = sys_by_link.get((rank, peer), (0, 0))
         links_out.append({
             "rank": rank,
             "peer": peer,
             "pacing_ms": round(rec["pacing_ms"], 3),
             "repair_ms": round(rec["repair_ms"], 3),
+            "tx_syscalls": txs,
+            "rx_syscalls": rxs,
             "replays": rec["replays"],
             "breaks": rec["breaks"],
             "cause": cause,
@@ -1132,6 +1175,20 @@ def render(report, max_steps=40):
                 "hosts; the knob costs nothing here but also buys "
                 "nothing (docs/performance.md)"
             )
+    if audit.get("tx_syscalls") or audit.get("rx_syscalls"):
+        src = audit.get("wire_backend_source")
+        knob = (f"{audit.get('wire_backend', 'auto')} ({src})" if src
+                else audit.get("wire_backend", "auto"))
+        active = audit.get("wire_backend_active")
+        out.append("")
+        out.append(
+            f"  wire audit: T4J_WIRE_BACKEND={knob}"
+            + (f" (active: {active})" if active else "")
+            + f", {audit['tx_syscalls']} tx / {audit['rx_syscalls']} rx "
+            "kernel crossings by the wire threads — the uring backend "
+            "is judged by this counter dropping per frame, not by "
+            "assumption (docs/performance.md \"io_uring wire backend\")"
+        )
     if report["step_marker_problems"]:
         out.append("")
         out.append("  step-marker problems: "

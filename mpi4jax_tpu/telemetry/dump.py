@@ -98,6 +98,7 @@ def capture_runtime_state():
             "coalesce_bytes": eff["knobs"]["coalesce_bytes"],
             "stripes": eff["knobs"].get("stripes", "auto"),
             "wire_dtype": eff["knobs"].get("wire_dtype", "off"),
+            "wire_backend": eff["knobs"].get("wire_backend", "auto"),
             "sources": dict(eff["sources"]),
             "cache_file": eff["cache_file"],
             "fingerprint": eff["fingerprint"],
@@ -116,6 +117,7 @@ def capture_runtime_state():
             "coalesce_bytes": config.coalesce_bytes(),
             "stripes": config.stripes(),
             "wire_dtype": config.wire_dtype(),
+            "wire_backend": config.wire_backend(),
             "wire": wire or {},
         }
     except Exception:

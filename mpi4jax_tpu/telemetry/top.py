@@ -242,6 +242,8 @@ def summarize(rank_objs, flight=None):
                 reconnects=s.get("reconnects", 0),
                 replayed_frames=s.get("replayed_frames", 0),
                 replayed_bytes=s.get("replayed_bytes", 0),
+                tx_syscalls=s.get("tx_syscalls", 0),
+                rx_syscalls=s.get("rx_syscalls", 0),
                 state=s.get("state", 0),
                 stripes=len(stripes),
                 stripe_detail=stripes,
@@ -296,6 +298,15 @@ def summarize(rank_objs, flight=None):
             "gbps": link["bytes"] / span / 1e9 if span > 0 else None,
             "reconnects": link.get("reconnects", 0),
             "replayed_frames": link.get("replayed_frames", 0),
+            # kernel crossings made by the wire threads (native
+            # counters, docs/performance.md "io_uring wire backend");
+            # sys/frame is what the uring backend is supposed to cut
+            "tx_syscalls": link.get("tx_syscalls", 0),
+            "rx_syscalls": link.get("rx_syscalls", 0),
+            "syscalls_per_frame": (
+                round(link.get("tx_syscalls", 0) / link["frames"], 2)
+                if link["frames"] else None
+            ),
             "state": link.get("state", 0),
             "stripes": link.get("stripes", 0),
             "hot_stripe": hot[0] if len(hot) == 1 else None,
@@ -435,7 +446,8 @@ def render(summary):
         out.append("")
         out.append(f"  {'link':<12}{'bytes':>10}{'frames':>8}"
                    f"{'GB/s':>8}{'stripes':>8}{'reconn':>8}"
-                   f"{'replay':>8}{'state':>8}{'wire:':>12}")
+                   f"{'replay':>8}{'txsys':>8}{'rxsys':>8}"
+                   f"{'sys/fr':>8}{'state':>8}{'wire:':>12}")
         for link in summary["links"]:
             gbps = ("-" if link["gbps"] is None
                     else f"{link['gbps']:.3f}")
@@ -454,11 +466,15 @@ def render(summary):
                 wire = f"{wi['wire_dtype']} {wi['ratio']:.2f}x"
             else:
                 wire = wi["wire_dtype"]
+            spf = link.get("syscalls_per_frame")
             out.append(
                 f"  r{link['rank']}->r{link['peer']:<8}"
                 f"{_fmt_bytes(link['bytes']):>10}{link['frames']:>8}"
                 f"{gbps:>8}{stripes:>8}{link['reconnects']:>8}"
                 f"{link['replayed_frames']:>8}"
+                f"{link.get('tx_syscalls', 0):>8}"
+                f"{link.get('rx_syscalls', 0):>8}"
+                f"{'-' if spf is None else f'{spf:.2f}':>8}"
                 f"{_STATE_NAMES.get(link['state'], '?'):>8}"
                 f"{wire:>12}"
             )

@@ -158,6 +158,9 @@ _HIER_NAMES = {v: k for k, v in _HIER_CODES.items()}
 _WIRE_CODES = {"off": 0, "bf16": 1, "fp8": 2}
 _WIRE_NAMES = {v: k for k, v in _WIRE_CODES.items()}
 
+_BACKEND_CODES = {"auto": 0, "sendmsg": 1, "uring": 2}
+_BACKEND_NAMES = {v: k for k, v in _BACKEND_CODES.items()}
+
 
 def startup(progress=None):
     """Load/resolve/apply the tuning vector for this job (called from
@@ -226,7 +229,8 @@ def startup(progress=None):
         src_codes = {"default": 0, "cache": 1, "env": 2}
         src_names = {v: k for k, v in src_codes.items()}
         order = ("ring_min_bytes", "seg_bytes", "leader_ring_min_bytes",
-                 "hier", "coalesce_bytes", "stripes", "wire_dtype")
+                 "hier", "coalesce_bytes", "stripes", "wire_dtype",
+                 "wire_backend")
         # stripes travels as an int: 0 encodes "auto" (no fitted width)
         stripes_v = knobs.get("stripes", "auto")
         vec = np.asarray(
@@ -238,6 +242,9 @@ def startup(progress=None):
                 knobs["coalesce_bytes"],
                 0 if stripes_v == "auto" else int(stripes_v),
                 _WIRE_CODES.get(knobs.get("wire_dtype", "off"), 0),
+                _BACKEND_CODES.get(
+                    knobs.get("wire_backend", "auto"), 0
+                ),
                 *[src_codes.get(sources[k], 0) for k in order],
             ],
             np.int64,
@@ -251,9 +258,10 @@ def startup(progress=None):
             "coalesce_bytes": int(vec[4]),
             "stripes": "auto" if int(vec[5]) == 0 else int(vec[5]),
             "wire_dtype": _WIRE_NAMES.get(int(vec[6]), "off"),
+            "wire_backend": _BACKEND_NAMES.get(int(vec[7]), "auto"),
         }
         sources = {
-            k: src_names.get(int(vec[7 + i]), "default")
+            k: src_names.get(int(vec[8 + i]), "default")
             for i, k in enumerate(order)
         }
 
@@ -278,6 +286,13 @@ def startup(progress=None):
     # runtime like the dealing width — the uniformity contract rides
     # the same rank-0 broadcast as every other knob
     runtime.set_wire_dtype(knobs.get("wire_dtype", "off"))
+    # wire data-plane backend (docs/performance.md "io_uring wire
+    # backend"): a fitted/cached backend applies at runtime — the
+    # native layer degrades loudly to sendmsg if this kernel cannot
+    # honour a cached "uring" (cache written on another machine);
+    # "auto" keeps the native default (sendmsg)
+    if knobs.get("wire_backend", "auto") != "auto":
+        runtime.set_wire_backend(knobs["wire_backend"])
 
     eff = {
         "knobs": dict(knobs),

@@ -42,8 +42,9 @@ CACHE_SCHEMA_VERSION = 1
 # T4J_HIER mode string; stripes is "auto" or an int 1..16 (the wire
 # dealing width, docs/performance.md "striped links"); wire_dtype is
 # the compressed-collective mode string off|bf16|fp8
-# (docs/performance.md "Compressed collectives"); everything else is
-# a byte count.
+# (docs/performance.md "Compressed collectives"); wire_backend is the
+# data-plane mode string auto|sendmsg|uring (docs/performance.md
+# "io_uring wire backend"); everything else is a byte count.
 KNOBS = {
     "T4J_RING_MIN_BYTES": "ring_min_bytes",
     "T4J_SEG_BYTES": "seg_bytes",
@@ -52,6 +53,7 @@ KNOBS = {
     "T4J_COALESCE_BYTES": "coalesce_bytes",
     "T4J_STRIPES": "stripes",
     "T4J_WIRE_DTYPE": "wire_dtype",
+    "T4J_WIRE_BACKEND": "wire_backend",
 }
 
 KNOB_DEFAULTS = {
@@ -62,9 +64,11 @@ KNOB_DEFAULTS = {
     "coalesce_bytes": 16 << 10,
     "stripes": "auto",
     "wire_dtype": "off",
+    "wire_backend": "auto",
 }
 
 _WIRE_DTYPES = ("off", "bf16", "fp8")
+_WIRE_BACKENDS = ("auto", "sendmsg", "uring")
 
 _SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
 
@@ -170,13 +174,14 @@ def resolve(cache_knobs, env=None):
     for env_name, key in KNOBS.items():
         raw = env.get(env_name)
         explicit = raw is not None and str(raw).strip() != ""
-        if explicit and key == "stripes" \
+        if explicit and key in ("stripes", "wire_backend") \
                 and str(raw).strip().lower() == "auto":
             # "auto" is the ask-the-calibrator value, not an operator
-            # override: a cached fitted width must still win over it
+            # override: a cached fitted width/backend must still win
+            # over it
             explicit = False
         if explicit:
-            if key in ("hier", "wire_dtype"):
+            if key in ("hier", "wire_dtype", "wire_backend"):
                 knobs[key] = str(raw).strip().lower()
             elif key == "stripes":
                 s = str(raw).strip().lower()
@@ -192,6 +197,12 @@ def resolve(cache_knobs, env=None):
                 # a cache file edited to an unknown dtype must not
                 # smuggle an un-runnable mode past config validation
                 knobs[key] = str(v) if str(v) in _WIRE_DTYPES else "off"
+            elif key == "wire_backend":
+                # same smuggle guard: an edited cache must not name a
+                # backend config validation would have rejected
+                knobs[key] = (
+                    str(v) if str(v) in _WIRE_BACKENDS else "auto"
+                )
             elif key == "stripes":
                 knobs[key] = "auto" if str(v) == "auto" else int(v)
             else:

@@ -28,11 +28,13 @@ __all__ = [
     "COALESCE_SIZES",
     "STRIPE_MARGIN",
     "WIRE_MARGIN",
+    "BACKEND_MARGIN",
     "fit_crossover",
     "fit_seg",
     "fit_coalesce",
     "fit_stripes",
     "fit_wire_dtype",
+    "fit_wire_backend",
     "fit_records",
     "autotune",
 ]
@@ -157,11 +159,42 @@ def fit_wire_dtype(points, margin=WIRE_MARGIN):
     return best
 
 
+# The io_uring data plane has to EARN its keep the same way: below
+# this speedup over the classic sendmsg loops the fit keeps sendmsg —
+# sendmsg is additionally the backend every prior release shipped, so
+# a tie must never tip toward the newer data plane.  Batched
+# submission pays on small-frame (syscall-bound) traffic; on payloads
+# where one sendmsg already moves megabytes the submission ring saves
+# nothing (docs/performance.md "io_uring wire backend").
+BACKEND_MARGIN = 1.05
+
+
+def fit_wire_backend(points, margin=BACKEND_MARGIN):
+    """Wire backend from ``(backend, ms)`` pairs
+    (``sendmsg``/``uring``): the fastest backend, except ``uring``
+    must beat ``sendmsg`` by ``margin`` — otherwise ``sendmsg`` wins
+    (a data plane that is not profitable must cost nothing, and
+    sendmsg is the longest-proven path).  ``None`` on no data."""
+    pts = {str(b): float(ms) for b, ms in points}
+    if not pts:
+        return None
+    base = pts.get("sendmsg")
+    best, best_ms = None, None
+    for b, ms in sorted(pts.items()):
+        if best_ms is None or ms < best_ms:
+            best, best_ms = b, ms
+    if best is None or best == "sendmsg":
+        return "sendmsg" if "sendmsg" in pts else best
+    if base is not None and base <= best_ms * margin:
+        return "sendmsg"
+    return best
+
+
 def fit_records(records):
     """Fit the knob vector from ``proc_busbw.py --calibrate`` JSON
     records (each: ``{"arm", "payload_bytes", "mean_ms", ...}``, arms
     ``tree|ring|hier|flat|seg:<bytes>|stripes:<n>|wire:<dtype>|``
-    ``fused|unfused``).
+    ``backend:<sendmsg|uring>|fused|unfused``).
 
     Returns a partial knob dict (only the knobs the records cover).
     """
@@ -202,6 +235,13 @@ def fit_records(records):
                 wire_pts.append((arm[5:], float(r["mean_ms"])))
     if wire_pts:
         knobs["wire_dtype"] = fit_wire_dtype(wire_pts)
+    backend_pts = []
+    for arm, rows in by.items():
+        if arm.startswith("backend:"):
+            for r in rows:
+                backend_pts.append((arm[8:], float(r["mean_ms"])))
+    if backend_pts:
+        knobs["wire_backend"] = fit_wire_backend(backend_pts)
     hier_pts = pair("flat", "hier")
     if hier_pts:
         knobs["leader_ring_min_bytes"] = fit_crossover(hier_pts)
@@ -384,6 +424,29 @@ def autotune(sizes=None, seg_candidates=None, coalesce_sizes=None,
             say(f"wire {wmode}: {ms:.3f}ms")
         runtime.set_wire_dtype("off")  # exact wire for the remaining arms
         knobs["wire_dtype"] = fit_wire_dtype(wire_pts)
+
+    # ---- wire backend: sendmsg vs io_uring at the smallest payload ------
+    #
+    # Batched SQ submission pays where the wire is syscall-bound —
+    # small frames, the decode-step and compressed-latency regime —
+    # so the arm A/Bs at the SMALLEST ladder size, not the largest.
+    # Both backends put identical bytes on the wire (the arms are
+    # always safe); a kernel without io_uring skips the arm entirely
+    # and the fit records nothing rather than a fake tie
+    # (docs/performance.md "io_uring wire backend").
+    if n > 1 and (runtime.wire_backend_info() or {}).get("uring_supported"):
+        small = min(sizes)
+        count = max(small // 4, n)
+        x = np.ones(count, np.float32)
+        backend_pts = []
+        for bmode in ("sendmsg", "uring"):
+            runtime.set_wire_backend(bmode)
+            ms = arm(f"backend:{bmode}", count * 4, "allreduce",
+                     lambda: runtime.host_allreduce(world, x, 0))
+            backend_pts.append((bmode, ms))
+            say(f"backend {bmode}: {ms:.3f}ms")
+        runtime.set_wire_backend("auto")  # native default for the rest
+        knobs["wire_backend"] = fit_wire_backend(backend_pts)
 
     # ---- hier: flat vs hierarchical per size (topology permitting) ------
     topo = runtime.topology() or {}

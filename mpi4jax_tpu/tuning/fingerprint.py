@@ -25,7 +25,9 @@ __all__ = ["KNOB_SCHEMA_VERSION", "topology_fingerprint"]
 # links, docs/performance.md "striped links and the zero-copy path").
 # v3: the `wire_dtype` knob joined the vector (compressed collectives,
 # docs/performance.md "Compressed collectives").
-KNOB_SCHEMA_VERSION = 3
+# v4: the `wire_backend` knob joined the vector (io_uring data plane,
+# docs/performance.md "io_uring wire backend").
+KNOB_SCHEMA_VERSION = 4
 
 
 def topology_fingerprint(topology, world_size,

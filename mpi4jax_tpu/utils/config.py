@@ -115,6 +115,15 @@ Striped multi-connection links and the zero-copy wire path
                                results stay f32 (docs/performance.md
                                "Compressed collectives").  The
                                calibrator fits it per fabric.
+* ``T4J_WIRE_BACKEND``       — wire data-plane backend (``auto``, the
+                               default, or ``sendmsg``/``uring``):
+                               ``uring`` drives the stripe loops
+                               through io_uring submission rings with
+                               the replay arena registered as a fixed
+                               buffer; kernels without io_uring
+                               degrade loudly to sendmsg
+                               (docs/performance.md "io_uring wire
+                               backend").  The calibrator fits it.
 
 Trace-guided autotuning + small-message coalescing
 (docs/performance.md "trace-guided autotuning"):
@@ -609,6 +618,35 @@ def wire_dtype():
         raise ValueError(
             f"cannot interpret T4J_WIRE_DTYPE={v!r} "
             f"(want {'|'.join(WIRE_DTYPES)})"
+        )
+    return v
+
+
+WIRE_BACKENDS = ("auto", "sendmsg", "uring")
+
+
+def wire_backend():
+    """Wire data-plane backend (docs/performance.md "io_uring wire
+    backend"): ``auto`` (the default — sendmsg until the trace-guided
+    calibrator learns that uring pays on this kernel/fabric),
+    ``sendmsg`` (the classic readv/sendmsg loops, byte-stable with
+    every prior release) or ``uring`` (io_uring submission/completion
+    rings with the replay arena registered as a fixed buffer).
+    Anything else raises: a typo'd backend must fail at launch, not
+    silently benchmark the wrong data plane.  Both backends put
+    identical bytes on the wire, so the choice need not be uniform
+    across ranks; an explicit ``uring`` on a kernel whose io_uring
+    probe fails is rejected at ``ensure_initialized`` (standalone
+    ctypes users get the native layer's loud degrade to sendmsg
+    instead)."""
+    v = os.environ.get("T4J_WIRE_BACKEND")
+    if v is None or not str(v).strip():
+        return "auto"
+    v = str(v).strip().lower()
+    if v not in WIRE_BACKENDS:
+        raise ValueError(
+            f"cannot interpret T4J_WIRE_BACKEND={v!r} "
+            f"(want {'|'.join(WIRE_BACKENDS)})"
         )
     return v
 

@@ -13,3 +13,30 @@ import pytest
 def pytest_collection_modifyitems(config, items):
     for item in items:
         item.add_marker(pytest.mark.proc)
+
+
+@pytest.fixture(params=["sendmsg", "uring"])
+def wire_backend(request, monkeypatch):
+    """Run the requesting test once per wire backend
+    (docs/performance.md "io_uring wire backend").
+
+    The spawn helpers in this directory all build child environments
+    from ``dict(os.environ)``, so pinning ``T4J_WIRE_BACKEND`` here
+    reaches every rank of the job.  The uring leg skips (not fails) on
+    kernels without a usable io_uring — an explicit ``uring`` request
+    would otherwise be rejected at init, which is its own test in
+    tests/test_config_tuning.py, not something every matrix should
+    trip over."""
+    mode = request.param
+    if mode == "uring":
+        try:
+            from mpi4jax_tpu.native import runtime
+
+            runtime._load()
+            binfo = runtime.wire_backend_info() or {}
+        except Exception as e:  # pragma: no cover - old-jax containers
+            pytest.skip(f"native runtime unavailable: {e}")
+        if not binfo.get("uring_supported"):
+            pytest.skip("no usable io_uring on this kernel")
+    monkeypatch.setenv("T4J_WIRE_BACKEND", mode)
+    return mode

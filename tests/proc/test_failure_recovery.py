@@ -106,7 +106,9 @@ def _launch(script, *args, nprocs=2):
     )
 
 
-def test_kill_resume_bit_identical(tmp_path):
+def test_kill_resume_bit_identical(tmp_path, wire_backend):
+    # parameterized over both wire backends: resume must replay to the
+    # same bits whether the transport ran on sendmsg or io_uring
     job = tmp_path / "job.py"
     job.write_text(JOB)
     run_a = tmp_path / "a"

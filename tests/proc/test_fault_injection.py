@@ -174,14 +174,15 @@ except Exception as e:
         assert "peer r1" in blob or "rank 1" in blob, (rank, blob[-2000:])
 
 
-def test_dead_peer_mid_ring(tmp_path):
+def test_dead_peer_mid_ring(tmp_path, wire_backend):
     """close_after with a ring-sized payload: rank 1 dies partway
     through the segmented ring allreduce (T4J_RING_MIN_BYTES=0 forces
     the ring path, small T4J_SEG_BYTES makes each step many frames, and
     T4J_FAULT_AFTER lands the death mid-stream).  Survivors must raise
     a contextual BridgeError naming peer r1 — the per-segment sends and
     recvs run under the same deadline/abort contract as whole-message
-    collectives (docs/failure-semantics.md)."""
+    collectives (docs/failure-semantics.md), on BOTH wire backends:
+    escalation is backend-independent."""
     body = PREAMBLE + f"""
 x = jnp.ones((64 * 1024,), jnp.float32)  # 256 KB through the ring
 t0 = time.monotonic()
@@ -447,13 +448,15 @@ except Exception as e:
 # ------------------------------------------------- self-healing transport
 
 
-def test_flaky_connection_self_heals(tmp_path):
+def test_flaky_connection_self_heals(tmp_path, wire_backend):
     """flaky: rank 1 drops every TCP connection twice mid-allreduce
     (≥2 drops per link), then behaves.  The self-healing transport
     (docs/failure-semantics.md "self-healing transport") must
     reconnect and replay so every rank finishes ALL iterations with
     results bit-identical to the fault-free reduction — zero abort
-    broadcasts, zero raised ops."""
+    broadcasts, zero raised ops.  Runs on both wire backends: replay
+    after reconnect reads the same arena whether the kernel saw it via
+    sendmsg or io_uring registered buffers."""
     body = PREAMBLE + """
 iters, count = 12, 64 * 1024
 for it in range(iters):
@@ -495,7 +498,7 @@ print("SELF-HEAL-OK", flush=True)
     assert "abort" not in blob, blob[-3000:]
 
 
-def test_one_stripe_drop_self_heals_per_stripe(tmp_path):
+def test_one_stripe_drop_self_heals_per_stripe(tmp_path, wire_backend):
     """Striped links (docs/performance.md "striped links and the
     zero-copy path"): with T4J_STRIPES=4, rank 1 drops ONLY stripe 1
     of every link mid-allreduce (``T4J_FAULT_STRIPE=1``).  The
@@ -503,7 +506,8 @@ def test_one_stripe_drop_self_heals_per_stripe(tmp_path):
     bit-identical to the fault-free reduction, zero aborts, the
     killed stripe shows nonzero per-stripe reconnect counters while
     its SIBLING stripes never break (they kept carrying traffic
-    through the repair)."""
+    through the repair).  Both wire backends: the per-stripe repair
+    path must cancel/drain in-flight uring SQEs before rebuilding."""
     body = PREAMBLE + """
 from mpi4jax_tpu.native import runtime as _rt
 

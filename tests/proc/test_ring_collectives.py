@@ -176,11 +176,13 @@ def _run_matrix(nprocs, timeout=240):
         assert f"MATRIX-OK {r}" in out, (r, out[-3000:], err[-3000:])
 
 
-def test_ring_matrix_non_power_of_two_world():
+def test_ring_matrix_non_power_of_two_world(wire_backend):
     """n=3: uneven ring blocks everywhere, incl. zero-length blocks for
-    the 1-byte payload."""
+    the 1-byte payload.  Parameterized over both wire backends — the
+    matrix results must be bit-identical whichever path carried the
+    segments (the backend changes syscalls, never bytes)."""
     _run_matrix(3)
 
 
-def test_ring_matrix_even_world():
+def test_ring_matrix_even_world(wire_backend):
     _run_matrix(4)

@@ -909,11 +909,12 @@ class TestWireDtype:
         assert calibrate.fit_wire_dtype([]) is None
 
     def test_schema_version_covers_wire_knob(self):
-        """The wire_dtype knob joined the broadcast vector: stale
-        pre-compression cache files must miss on the fingerprint."""
+        """The wire_dtype knob joined the vector at v3 and wire_backend
+        at v4: stale pre-backend cache files must miss on the
+        fingerprint."""
         from mpi4jax_tpu.tuning import fingerprint
 
-        assert fingerprint.KNOB_SCHEMA_VERSION == 3
+        assert fingerprint.KNOB_SCHEMA_VERSION == 4
 
 
 def test_ensure_initialized_rejects_bad_wire_dtype(monkeypatch):
@@ -934,4 +935,173 @@ def test_ensure_initialized_rejects_bad_wire_dtype(monkeypatch):
     monkeypatch.setenv("T4J_SIZE", "1")
     monkeypatch.setenv("T4J_WIRE_DTYPE", "e5m2")
     with pytest.raises(ValueError, match="T4J_WIRE_DTYPE"):
+        runtime.ensure_initialized()
+
+
+class TestWireBackend:
+    """T4J_WIRE_BACKEND (docs/performance.md "io_uring wire backend"):
+    auto (default) | sendmsg | uring, validated at launch, resolved
+    through the tuning cache with env > cache > default precedence,
+    fitted by the calibrator only when io_uring beats sendmsg by the
+    profit margin — and rejected outright at init when the operator
+    pins uring on a kernel whose io_uring probe fails."""
+
+    def test_default_is_auto(self, monkeypatch):
+        monkeypatch.delenv("T4J_WIRE_BACKEND", raising=False)
+        assert config.wire_backend() == "auto"
+
+    def test_empty_is_auto(self, monkeypatch):
+        monkeypatch.setenv("T4J_WIRE_BACKEND", "   ")
+        assert config.wire_backend() == "auto"
+
+    @pytest.mark.parametrize("mode", ["auto", "sendmsg", "uring"])
+    def test_explicit_modes(self, monkeypatch, mode):
+        monkeypatch.setenv("T4J_WIRE_BACKEND", mode)
+        assert config.wire_backend() == mode
+
+    def test_case_and_whitespace_normalised(self, monkeypatch):
+        monkeypatch.setenv("T4J_WIRE_BACKEND", "  URING ")
+        assert config.wire_backend() == "uring"
+
+    @pytest.mark.parametrize("bad", ["epoll", "io_uring", "1", "on",
+                                     "send"])
+    def test_unknown_backend_raises(self, monkeypatch, bad):
+        """A typo must fail at launch, not silently run on sendmsg —
+        the operator would read "uring p50" off a sendmsg run."""
+        monkeypatch.setenv("T4J_WIRE_BACKEND", bad)
+        with pytest.raises(ValueError, match="T4J_WIRE_BACKEND"):
+            config.wire_backend()
+
+    def test_resolve_env_wins_over_cache(self, monkeypatch):
+        from mpi4jax_tpu.tuning import cache
+
+        monkeypatch.setenv("T4J_WIRE_BACKEND", "sendmsg")
+        knobs, sources = cache.resolve({"wire_backend": "uring"})
+        assert knobs["wire_backend"] == "sendmsg"
+        assert sources["wire_backend"] == "env"
+
+    def test_resolve_env_auto_defers_to_cache(self, monkeypatch):
+        """Explicit auto in the env is "let the calibrator choose", not
+        an override: a cached learned backend must win through it."""
+        from mpi4jax_tpu.tuning import cache
+
+        monkeypatch.setenv("T4J_WIRE_BACKEND", "auto")
+        knobs, sources = cache.resolve({"wire_backend": "uring"})
+        assert knobs["wire_backend"] == "uring"
+        assert sources["wire_backend"] == "cache"
+
+    def test_resolve_cache_wins_over_default(self, monkeypatch):
+        from mpi4jax_tpu.tuning import cache
+
+        monkeypatch.delenv("T4J_WIRE_BACKEND", raising=False)
+        knobs, sources = cache.resolve({"wire_backend": "uring"})
+        assert knobs["wire_backend"] == "uring"
+        assert sources["wire_backend"] == "cache"
+
+    def test_resolve_default_is_auto(self, monkeypatch):
+        from mpi4jax_tpu.tuning import cache
+
+        monkeypatch.delenv("T4J_WIRE_BACKEND", raising=False)
+        knobs, sources = cache.resolve({})
+        assert knobs["wire_backend"] == "auto"
+        assert sources["wire_backend"] == "default"
+
+    def test_resolve_rejects_smuggled_cache_backend(self, monkeypatch):
+        """A hand-edited cache file must not push an un-runnable
+        backend past config validation: unknown cached backends read
+        as auto."""
+        from mpi4jax_tpu.tuning import cache
+
+        monkeypatch.delenv("T4J_WIRE_BACKEND", raising=False)
+        knobs, _ = cache.resolve({"wire_backend": "epoll"})
+        assert knobs["wire_backend"] == "auto"
+
+    def test_fit_picks_profitable_uring(self):
+        from mpi4jax_tpu.tuning import calibrate
+
+        got = calibrate.fit_wire_backend(
+            [("sendmsg", 10.0), ("uring", 5.0)]
+        )
+        assert got == "uring"
+
+    def test_fit_unprofitable_uring_stays_sendmsg(self):
+        """Within the profit margin the boring backend wins: equal
+        times must fit sendmsg, the path every kernel has."""
+        from mpi4jax_tpu.tuning import calibrate
+
+        got = calibrate.fit_wire_backend(
+            [("sendmsg", 10.0), ("uring", 10.0)]
+        )
+        assert got == "sendmsg"
+
+    def test_fit_margin_boundary(self):
+        from mpi4jax_tpu.tuning import calibrate
+
+        # 4% faster: inside the 1.05 margin, sendmsg keeps the knob
+        assert calibrate.fit_wire_backend(
+            [("sendmsg", 10.0), ("uring", 9.62)]
+        ) == "sendmsg"
+        # 10% faster: clears the margin
+        assert calibrate.fit_wire_backend(
+            [("sendmsg", 10.0), ("uring", 9.0)]
+        ) == "uring"
+
+    def test_fit_no_data_is_none(self):
+        from mpi4jax_tpu.tuning import calibrate
+
+        assert calibrate.fit_wire_backend([]) is None
+
+    def test_fit_records_parses_backend_arms(self):
+        """The calibrator's "backend:<b>" arm records must round-trip
+        into a wire_backend knob through fit_records."""
+        from mpi4jax_tpu.tuning import calibrate
+
+        recs = [
+            {"arm": "backend:sendmsg", "payload_bytes": 4096,
+             "mean_ms": 10.0},
+            {"arm": "backend:uring", "payload_bytes": 4096,
+             "mean_ms": 5.0},
+        ]
+        knobs = calibrate.fit_records(recs)
+        assert knobs.get("wire_backend") == "uring"
+
+
+def test_ensure_initialized_rejects_bad_wire_backend(monkeypatch):
+    """A typo'd wire backend must fail before init, same contract as
+    every other data-plane knob."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_WIRE_BACKEND", "epoll")
+    with pytest.raises(ValueError, match="T4J_WIRE_BACKEND"):
+        runtime.ensure_initialized()
+
+
+def test_ensure_initialized_rejects_uring_without_kernel_support(
+        monkeypatch):
+    """Explicitly pinned T4J_WIRE_BACKEND=uring on a kernel whose
+    io_uring probe fails must raise at init on the managed path — a
+    silent sendmsg fallback would fake every "uring" benchmark the
+    operator asked for.  (auto degrades instead; standalone ctypes
+    users get the loud native stderr degrade line.)  The probe failure
+    is simulated with the T4J_URING_FORCE_UNSUPPORTED test override so
+    the test runs identically on kernels with and without io_uring."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_URING_FORCE_UNSUPPORTED", "1")
+    monkeypatch.setenv("T4J_WIRE_BACKEND", "uring")
+    with pytest.raises(ValueError, match="io_uring"):
         runtime.ensure_initialized()

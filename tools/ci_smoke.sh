@@ -146,6 +146,23 @@
 #                frames; docs/performance.md "trace-guided
 #                autotuning").  ctypes + the jax-free tuning package
 #                only — runs on old-jax containers.
+#  18. uring  — tools/uring_smoke.py three times over: plain, ASan,
+#                and TSan (the completion-driven engine fold is
+#                exactly the concurrency TSan exists for; the perf
+#                phase auto-skips under sanitizers).  The io_uring
+#                wire backend (docs/performance.md "io_uring wire
+#                backend"): forced-unsupported probe must degrade
+#                LOUDLY to sendmsg, an 8-rank striped ring must be
+#                bit-identical on both backends with live syscall
+#                counters, registered-buffer fixed I/O must survive
+#                replay-ring eviction and a killed-stripe self-heal
+#                under uring, idle ranks must not spin on either
+#                backend (adaptive io tick), and the interleaved
+#                small-frame arms must show uring cutting syscalls
+#                per call without a p50 regression.  On kernels
+#                without io_uring the uring phases skip loudly and
+#                the degrade contract still runs.  ctypes only —
+#                runs on old-jax containers.
 #
 # Usage: tools/ci_smoke.sh [lane...]   (default: all twelve)
 
@@ -156,7 +173,7 @@ lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
   lanes=(tier1 fault proc asan tsan lint resilience telemetry async
          diagnose bench elastic autotune postmortem stripe serving
-         compress)
+         compress uring)
 fi
 
 run_lane() {
@@ -205,6 +222,9 @@ for lane in "${lanes[@]}"; do
       ;;
     resilience)
       run_lane resilience env T4J_SANITIZE=address timeout -k 10 900 \
+        python tools/resilience_smoke.py 8
+      run_lane resilience-uring env -u T4J_SANITIZE \
+        T4J_WIRE_BACKEND=uring timeout -k 10 900 \
         python tools/resilience_smoke.py 8
       ;;
     telemetry)
@@ -259,6 +279,9 @@ assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
         python tools/stripe_smoke.py 4
       run_lane stripe-elastic env -u T4J_SANITIZE T4J_STRIPES=2 \
         timeout -k 10 1200 python tools/elastic_smoke.py 8
+      run_lane stripe-uring env -u T4J_SANITIZE \
+        T4J_WIRE_BACKEND=uring timeout -k 10 1200 \
+        python tools/stripe_smoke.py 8
       ;;
     serving)
       run_lane serving-plain env -u T4J_SANITIZE timeout -k 10 900 \
@@ -272,8 +295,16 @@ assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
       run_lane compress-asan env T4J_SANITIZE=address timeout -k 10 1800 \
         python tools/compress_smoke.py 8
       ;;
+    uring)
+      run_lane uring-plain env -u T4J_SANITIZE timeout -k 10 1200 \
+        python tools/uring_smoke.py 8
+      run_lane uring-asan env T4J_SANITIZE=address timeout -k 10 1800 \
+        python tools/uring_smoke.py 8
+      run_lane uring-tsan env T4J_SANITIZE=thread timeout -k 10 1800 \
+        python tools/uring_smoke.py 4
+      ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem|stripe|serving|compress)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem|stripe|serving|compress|uring)" >&2
       exit 2
       ;;
   esac

@@ -169,6 +169,8 @@ def _load_lib(so):
     lib.t4j_link_stats.argtypes = [i32, ctypes.POINTER(u64),
                                    ctypes.POINTER(u64),
                                    ctypes.POINTER(u64),
+                                   ctypes.POINTER(u64),
+                                   ctypes.POINTER(u64),
                                    ctypes.POINTER(i32)]
     lib.t4j_link_stats.restype = i32
     return lib
@@ -205,12 +207,15 @@ def _per_peer_links(lib, n):
     for peer in range(n):
         rec_, fr_, by_ = (ctypes.c_uint64(), ctypes.c_uint64(),
                           ctypes.c_uint64())
+        tx_, rx_ = ctypes.c_uint64(), ctypes.c_uint64()
         st_ = ctypes.c_int32()
         if lib.t4j_link_stats(peer, ctypes.byref(rec_), ctypes.byref(fr_),
-                              ctypes.byref(by_), ctypes.byref(st_)):
+                              ctypes.byref(by_), ctypes.byref(tx_),
+                              ctypes.byref(rx_), ctypes.byref(st_)):
             out[str(peer)] = {
                 "reconnects": rec_.value, "replayed_frames": fr_.value,
-                "replayed_bytes": by_.value, "state": st_.value,
+                "replayed_bytes": by_.value, "tx_syscalls": tx_.value,
+                "rx_syscalls": rx_.value, "state": st_.value,
             }
     return out
 

@@ -123,7 +123,7 @@ def _load_lib(so):
     lib.t4j_c_allgather.restype = i32
     lib.t4j_c_barrier.argtypes = [i32]
     lib.t4j_c_barrier.restype = i32
-    lib.t4j_link_stats.argtypes = [i32, u64p, u64p, u64p,
+    lib.t4j_link_stats.argtypes = [i32, u64p, u64p, u64p, u64p, u64p,
                                    ctypes.POINTER(i32)]
     lib.t4j_link_stats.restype = i32
     lib.t4j_telemetry_drain.argtypes = [vp, ctypes.c_int64]
@@ -211,9 +211,11 @@ def worker(so):
         import ctypes as ct
 
         rec, fr, by = ct.c_uint64(), ct.c_uint64(), ct.c_uint64()
+        tx, rx = ct.c_uint64(), ct.c_uint64()
         state = ct.c_int32()
         lib.t4j_link_stats(-1, ct.byref(rec), ct.byref(fr),
-                           ct.byref(by), ct.byref(state))
+                           ct.byref(by), ct.byref(tx), ct.byref(rx),
+                           ct.byref(state))
         print(
             f"SMOKE-OK {rank} reconnects={rec.value} "
             f"replayed_frames={fr.value} replayed_bytes={by.value} "

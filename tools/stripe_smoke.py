@@ -142,10 +142,11 @@ def _load_lib(so):
     lib.t4j_c_sendrecv.restype = i32
     lib.t4j_c_barrier.argtypes = [i32]
     lib.t4j_c_barrier.restype = i32
-    lib.t4j_link_stats.argtypes = [i32, u64p, u64p, u64p, i32p]
+    lib.t4j_link_stats.argtypes = [i32, u64p, u64p, u64p, u64p, u64p,
+                                   i32p]
     lib.t4j_link_stats.restype = i32
     lib.t4j_link_stripe_stats.argtypes = [i32, i32, u64p, u64p, u64p,
-                                          i32p]
+                                          u64p, u64p, i32p]
     lib.t4j_link_stripe_stats.restype = i32
     lib.t4j_wire_info.argtypes = [i32p, i32p,
                                   ctypes.POINTER(ctypes.c_int64), i32p,
@@ -180,13 +181,17 @@ def _stripe_stats(lib, peer, stripe):
     rec = ctypes.c_uint64(0)
     fr = ctypes.c_uint64(0)
     by = ctypes.c_uint64(0)
+    tx = ctypes.c_uint64(0)
+    rx = ctypes.c_uint64(0)
     stt = ctypes.c_int32(0)
     if not lib.t4j_link_stripe_stats(peer, stripe, ctypes.byref(rec),
                                      ctypes.byref(fr), ctypes.byref(by),
+                                     ctypes.byref(tx), ctypes.byref(rx),
                                      ctypes.byref(stt)):
         return None
     return {"reconnects": rec.value, "replayed_frames": fr.value,
-            "replayed_bytes": by.value, "state": stt.value}
+            "replayed_bytes": by.value, "tx_syscalls": tx.value,
+            "rx_syscalls": rx.value, "state": stt.value}
 
 
 def _run_collectives(lib, rank, n, iters, count):
