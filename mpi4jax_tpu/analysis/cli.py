@@ -1,4 +1,5 @@
-"""``t4j-lint`` — command-line front end of the contract verifier.
+"""``t4j-lint`` / ``t4j-verify`` — command-line front ends of the
+contract verifier.
 
 Lints the communication schedules of Python programs before any byte
 moves::
@@ -17,18 +18,37 @@ entries are cheap even for programs whose real inputs are huge.  Files
 without ``T4J_LINT_ENTRIES`` are reported as skipped (exit code is
 unaffected): lint coverage is opt-in per program, exactly like a test.
 
-Exit codes: 0 clean, 1 findings, 2 usage/target errors — the usual
-linter contract so CI lanes (tools/ci_smoke.sh lint lane) can gate on
-it.
+``t4j-verify`` (:func:`verify_main`) adds the cross-rank simulator
+(analysis/simulate.py, rules T4J010–T4J014) over three input shapes::
+
+    t4j-verify examples/shallow_water.py        # trace + specialize
+    t4j-verify --traces r0.json r1.json         # per-rank recordings
+    t4j-verify --plan-stream serve_plans.jsonl  # serving control plane
+
+The ``--traces`` and ``--plan-stream`` paths never import jax — a
+trace recorded on a pod replays on any machine.
+
+Both commands share the linter exit-code contract (documented in
+docs/static-analysis.md, gated on by tools/ci_smoke.sh):
+
+* **0** — clean: every target checked, no findings;
+* **1** — findings: at least one rule fired;
+* **2** — usage or trace error: a target failed to import, a trace
+  file was malformed, or verification itself crashed.
+
+``--format json`` prints one JSON object on stdout (``findings`` list
+with ``rule``/``message``/``src_info``/``where``, plus counters) so CI
+gates on structure + exit code instead of grepping prose.
 """
 
 import argparse
 import importlib.util
+import json
 import os
 import pathlib
 import sys
 
-__all__ = ["main"]
+__all__ = ["main", "verify_main"]
 
 
 def _ensure_devices():
@@ -68,6 +88,87 @@ def _entries(mod):
     return out
 
 
+class _Output:
+    """Collects findings for text or JSON emission with one code path.
+
+    Text mode prints findings as they arrive (a linter's expected
+    behaviour); JSON mode buffers everything and prints one object at
+    the end so stdout is machine-parseable.
+    """
+
+    def __init__(self, fmt, quiet=False):
+        self.fmt = fmt
+        self.quiet = quiet
+        self.findings = []
+        self.errors = []
+        self.notes = []
+
+    def finding(self, where, f):
+        self.findings.append({
+            "where": where,
+            "rule": f.rule,
+            "message": f.message,
+            "src_info": f.src_info,
+        })
+        if self.fmt == "text":
+            print(f"{where}: {f}")
+
+    def error(self, where, msg):
+        self.errors.append({"where": where, "message": str(msg)})
+        if self.fmt == "text":
+            print(f"{where}: {msg}", file=sys.stderr)
+
+    def note(self, where, msg):
+        self.notes.append({"where": where, "message": str(msg)})
+        if self.fmt == "text" and not self.quiet:
+            print(f"{where}: note: {msg}")
+
+    def info(self, text):
+        if self.fmt == "text" and not self.quiet:
+            print(text)
+
+    def finish(self, prog, n_checked):
+        code = 2 if self.errors else (1 if self.findings else 0)
+        if self.fmt == "json":
+            print(json.dumps({
+                "tool": prog,
+                "checked": n_checked,
+                "findings": self.findings,
+                "errors": self.errors,
+                "notes": self.notes,
+                "exit_code": code,
+            }, indent=2))
+        elif not self.quiet:
+            print(
+                f"{prog}: {n_checked} "
+                f"entr{'y' if n_checked == 1 else 'ies'} checked, "
+                f"{len(self.findings)} finding(s)"
+                + (f", {len(self.errors)} error(s)" if self.errors
+                   else "")
+            )
+        return code
+
+
+def _simulate_events(events, out, where, max_states, eager_bytes):
+    """Specialize one SPMD trace per rank and run the match engine on
+    each communicator group (rules T4J010–T4J014)."""
+    from mpi4jax_tpu.analysis import simulate as sim
+
+    n = 0
+    for comm_id, schedules in sim.specialize_spmd(events):
+        result = sim.simulate(
+            schedules, max_states=max_states, eager_bytes=eager_bytes
+        )
+        n += 1
+        for note in result.notes:
+            out.note(where, f"[comm {comm_id}] {note}")
+        for f in result.findings:
+            out.finding(f"{where}[comm {comm_id}]", f)
+    if n == 0:
+        out.note(where, "no multi-rank communicator in the recorded "
+                        "schedule; nothing to simulate")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="t4j-lint",
@@ -99,44 +200,54 @@ def main(argv=None):
         help="threshold for --coalesce (default: the effective "
         "T4J_COALESCE_BYTES); implies --coalesce",
     )
+    parser.add_argument(
+        "--format", default="text", choices=["text", "json"],
+        help="output format; json prints one machine-readable object "
+        "(default: text)",
+    )
+    parser.add_argument(
+        "--simulate", action="store_true",
+        help="also run the cross-rank match-engine simulator over each "
+        "entry's recorded schedule (rules T4J010–T4J014; same engine "
+        "as t4j-verify)",
+    )
+    parser.add_argument(
+        "--max-states", type=int, default=None, metavar="N",
+        help="wildcard-exploration state cap for --simulate",
+    )
     args = parser.parse_args(argv)
     if args.coalesce_bytes is not None:
         args.coalesce = True
 
     _ensure_devices()
+    from mpi4jax_tpu.analysis import simulate as sim
     from mpi4jax_tpu.analysis.verify import verify_comm
 
-    n_findings = 0
+    out = _Output(args.format, quiet=args.quiet)
     n_entries = 0
-    broken = 0
     for path in args.files:
         try:
             mod = _load_module(path)
         except Exception as exc:
-            print(f"{path}: cannot import target: {exc}", file=sys.stderr)
-            broken += 1
+            out.error(path, f"cannot import target: {exc}")
             continue
         entries = _entries(mod)
         if entries is None:
-            if not args.quiet:
-                print(f"{path}: no T4J_LINT_ENTRIES, skipped")
+            out.info(f"{path}: no T4J_LINT_ENTRIES, skipped")
             continue
         for name, thunk in entries:
+            where = f"{path}::{name}"
             if args.list:
-                print(f"{path}::{name}")
+                print(where)
                 continue
             n_entries += 1
             try:
                 report = verify_comm(thunk, mode=args.mode)()
             except Exception as exc:
-                print(
-                    f"{path}::{name}: verification crashed: {exc}",
-                    file=sys.stderr,
-                )
-                broken += 1
+                out.error(where, f"verification crashed: {exc}")
                 continue
             for note in report.notes:
-                print(f"{path}::{name}: note: {note}")
+                out.note(where, note)
             if args.coalesce:
                 # feed the recorded schedule forward into the
                 # coalescing planner (the run-time ops apply the same
@@ -149,24 +260,158 @@ def main(argv=None):
                     else args.coalesce_bytes
                 )
                 runs = tuning.coalesce.find_runs(report.events, threshold)
-                print(f"{path}::{name}: "
-                      + tuning.coalesce.render_plan(runs, threshold))
+                out.info(f"{where}: "
+                         + tuning.coalesce.render_plan(runs, threshold))
             if report.ok:
-                if not args.quiet:
-                    print(f"{path}::{name}: {report}")
+                out.info(f"{where}: {report}")
             else:
-                n_findings += len(report.findings)
                 for f in report.findings:
-                    print(f"{path}::{name}: {f}")
+                    out.finding(where, f)
+            if args.simulate:
+                _simulate_events(
+                    report.events, out, where,
+                    args.max_states or sim.DEFAULT_MAX_STATES,
+                    sim.DEFAULT_EAGER_BYTES,
+                )
 
-    if not args.list and not args.quiet:
-        print(
-            f"t4j-lint: {n_entries} entr{'y' if n_entries == 1 else 'ies'}"
-            f" checked, {n_findings} finding(s)"
-        )
-    if broken:
-        return 2
-    return 1 if n_findings else 0
+    if args.list:
+        return 0
+    return out.finish("t4j-lint", n_entries)
+
+
+def verify_main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="t4j-verify",
+        description="cross-rank schedule simulator: MPI-semantics "
+        "deadlock, nondeterminism and matching checks before a job "
+        "ever opens a socket (rules T4J010–T4J014, "
+        "docs/static-analysis.md)",
+    )
+    parser.add_argument(
+        "files", nargs="*",
+        help="Python files with T4J_LINT_ENTRIES: each entry is "
+        "traced, specialized per rank, and simulated",
+    )
+    parser.add_argument(
+        "--traces", nargs="+", metavar="SCHEDULE.json",
+        help="per-rank schedule files (record.dump_schedule output), "
+        "one whole job per invocation; never imports jax",
+    )
+    parser.add_argument(
+        "--plan-stream", metavar="STREAM.jsonl",
+        help="recorded serving plan stream (ServingEngine plan_log / "
+        "T4J_PLAN_LOG): replays the follower mirror and simulates the "
+        "control-plane broadcasts; never imports jax",
+    )
+    parser.add_argument(
+        "--format", default="text", choices=["text", "json"],
+    )
+    parser.add_argument(
+        "--max-states", type=int, default=None, metavar="N",
+        help="wildcard-exploration state cap (default 4096)",
+    )
+    parser.add_argument(
+        "--eager-bytes", type=int, default=None, metavar="BYTES",
+        help="send eager/rendezvous threshold (default 65536)",
+    )
+    parser.add_argument("-q", "--quiet", action="store_true")
+    args = parser.parse_args(argv)
+    if not args.files and not args.traces and not args.plan_stream:
+        parser.error("nothing to verify: give Python files, --traces, "
+                     "or --plan-stream")
+
+    from mpi4jax_tpu.analysis import simulate as sim
+
+    max_states = args.max_states or sim.DEFAULT_MAX_STATES
+    eager = (sim.DEFAULT_EAGER_BYTES if args.eager_bytes is None
+             else args.eager_bytes)
+    out = _Output(args.format, quiet=args.quiet)
+    n_checked = 0
+
+    if args.traces:
+        from mpi4jax_tpu.analysis.record import load_schedule
+
+        schedules = []
+        try:
+            loaded = [load_schedule(p) for p in args.traces]
+        except (OSError, ValueError) as exc:
+            out.error("--traces", exc)
+            loaded = None
+        if loaded is not None:
+            # order by recorded rank when every file carries one,
+            # else positionally
+            if all(r is not None for r, _e in loaded):
+                loaded.sort(key=lambda re: int(re[0]))
+            schedules = [e for _r, e in loaded]
+            n_checked += 1
+            where = "+".join(args.traces)
+            result = sim.simulate(
+                schedules, max_states=max_states, eager_bytes=eager
+            )
+            for note in result.notes:
+                out.note(where, note)
+            for f in result.findings:
+                out.finding(where, f)
+            if result.ok:
+                out.info(f"{where}: {len(schedules)} rank schedule(s) "
+                         "simulated clean "
+                         f"({result.states} state(s) explored)")
+
+    if args.plan_stream:
+        from mpi4jax_tpu.serving import plan as plan_mod
+
+        where = args.plan_stream
+        try:
+            meta, vecs = plan_mod.load_plan_stream(args.plan_stream)
+        except (OSError, plan_mod.PlanError) as exc:
+            out.error(where, exc)
+            meta = None
+        if meta is not None:
+            n_checked += 1
+            before = len(out.findings)
+            for f in plan_mod.replay_stream(meta, vecs, source=where):
+                out.finding(where, f)
+            schedules = plan_mod.plan_stream_schedule(
+                meta, vecs, source=where
+            )
+            result = sim.simulate(
+                schedules, max_states=max_states, eager_bytes=eager
+            )
+            for f in result.findings:
+                out.finding(where, f)
+            if len(out.findings) == before:
+                out.info(
+                    f"{where}: {len(vecs)} plan(s) replayed clean over "
+                    f"{len(schedules)} rank(s)")
+
+    if args.files:
+        _ensure_devices()
+        from mpi4jax_tpu.analysis.verify import verify_comm
+
+        for path in args.files:
+            try:
+                mod = _load_module(path)
+            except Exception as exc:
+                out.error(path, f"cannot import target: {exc}")
+                continue
+            entries = _entries(mod)
+            if entries is None:
+                out.info(f"{path}: no T4J_LINT_ENTRIES, skipped")
+                continue
+            for name, thunk in entries:
+                where = f"{path}::{name}"
+                n_checked += 1
+                try:
+                    report = verify_comm(thunk, mode="full")()
+                except Exception as exc:
+                    out.error(where, f"verification crashed: {exc}")
+                    continue
+                for f in report.findings:
+                    out.finding(where, f)
+                _simulate_events(report.events, out, where,
+                                 max_states, eager)
+
+    return out.finish("t4j-verify", n_checked)
 
 
 if __name__ == "__main__":

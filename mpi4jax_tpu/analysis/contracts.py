@@ -35,6 +35,7 @@ __all__ = [
     "CommContractError",
     "check_schedule",
     "classify_trace_error",
+    "dedupe_findings",
     "step_signature",
     "schedule_lines",
     "schedule_digest",
@@ -71,6 +72,26 @@ RULES = {
     "T4J009": "mixed wire dtypes on one communicator: ranks disagree on "
               "the compressed-collective wire dtype for a reduction step "
               "(T4J_WIRE_DTYPE must be set uniformly across every rank)",
+    # T4J010..T4J014 are the cross-rank *simulator* rules
+    # (analysis/simulate.py, ``t4j-verify``): they need every rank's
+    # schedule in hand, which is exactly what the fingerprint pass's
+    # agreeing-schedules blind spot is — schedules that AGREE step for
+    # step can still deadlock or complete nondeterministically.
+    "T4J010": "cross-rank deadlock: the ranks' schedules form a "
+              "wait-for cycle under MPI matching semantics "
+              "(posted-order receives, rendezvous sends over the eager "
+              "threshold, in-order submission per rank)",
+    "T4J011": "wildcard nondeterminism: an ANY_SOURCE/ANY_TAG receive "
+              "admits two match orders that reach different final "
+              "states (racing senders)",
+    "T4J012": "orphan matching: a send no schedule ever receives, or a "
+              "receive no schedule ever sends to, at whole-job scope",
+    "T4J013": "collective ordering inversion: ranks interleave "
+              "collectives and point-to-point ops (or two collectives) "
+              "in an order that cyclically blocks",
+    "T4J014": "cross-rank wire-dtype mix: member ranks of one "
+              "communicator disagree on compressed-collective "
+              "eligibility or wire mode for matching reduction steps",
 }
 
 
@@ -171,6 +192,33 @@ def _finding(rule, message, event=None):
         src_info=event.src_info if event is not None else "",
         event_seq=event.seq if event is not None else None,
     )
+
+
+def dedupe_findings(findings):
+    """Collapse findings that say the same thing about the same place.
+
+    A composite op (``gather`` -> ``allgather`` on the mesh backend)
+    records under the reentrancy guard; when the guard's edge cases let
+    both the outer and the inner op produce an event, the two events
+    share one file:line anchor and every schedule rule that fires on
+    them fires twice — the same anchor then repeats in ``--coalesce``
+    output and in reports.  Key on ``(rule, src_info, message)`` with
+    the step number stripped, preserving first-seen order; findings
+    without an anchor are never collapsed (nothing ties them together).
+    """
+    seen = set()
+    out = []
+    for f in findings:
+        if not f.src_info:
+            out.append(f)
+            continue
+        key = (f.rule, f.src_info, re.sub(r"\bstep \d+\b", "step *",
+                                          f.message))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(f)
+    return out
 
 
 # ------------------------------------------------------- schedule checks

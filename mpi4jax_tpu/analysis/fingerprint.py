@@ -29,6 +29,7 @@ Two transports, matching the repo's two multi-rank tiers:
 """
 
 import hashlib
+import json
 
 import numpy as np
 
@@ -43,8 +44,20 @@ __all__ = ["exchange_and_check", "serialize_schedule", "FP_BYTES"]
 FP_BYTES = 16384          # fixed exchange-buffer size per rank
 _MAX_SECTION_STEPS = 200  # above this a section ships digest-only
 
+# Point-to-point steps are EXCLUDED from the per-comm lockstep diff:
+# their per-rank asymmetry is the norm, not divergence (`if rank == 0:
+# send else: recv` is the canonical correct p2p program, and would
+# false-positive a positional comparison).  P2p agreement is envelope
+# matching — the cross-rank simulator's job (the @sched rung below;
+# rules T4J010/T4J011/T4J012) in full mode, and the runtime rendezvous
+# engine's otherwise.  Collectives stay lockstep-diffed: every member
+# must issue the same sequence.
+_P2P_KINDS = frozenset((
+    "send", "isend", "recv", "irecv", "sendrecv", "sendrecv_multi",
+))
 
-def serialize_schedule(events):
+
+def serialize_schedule(events, with_sched=False):
     """Canonical per-comm serialisation of one rank's schedule.
 
     Sections are ordered by first appearance; each carries the comm's
@@ -52,16 +65,27 @@ def serialize_schedule(events):
     a digest of the full step sequence, and — for reasonably sized
     schedules — the per-step signature lines used to name the first
     differing step.
+
+    ``with_sched=True`` appends an ``@sched`` section: the full event
+    export (record.event_to_dict, one compact JSON object per line)
+    that lets every receiving rank run the cross-rank match-engine
+    simulator (analysis/simulate.py) over the assembled whole-job
+    schedule — catching schedules that AGREE per-comm yet still
+    deadlock.  The degrade ladder runs full+sim -> full -> digest-only
+    -> one global digest; a rung is dropped whole, never truncated,
+    because a cut-off tail would silently compare equal.
     """
     sections = []  # (comm_header, [step lines])
     index = {}
     for ev in events:
+        if ev.kind in _P2P_KINDS:
+            continue  # envelope-matched, not lockstep (see _P2P_KINDS)
         key = _comm_header(ev)
         if key not in index:
             index[key] = len(sections)
             sections.append((key, []))
         sections[index[key]][1].append(step_signature(ev))
-    def render(with_steps):
+    def render(with_steps, sched=False):
         out = []
         for header, lines in sections:
             digest = hashlib.sha256(
@@ -70,13 +94,20 @@ def serialize_schedule(events):
             out.append(f"@comm {header} n={len(lines)} sha={digest}")
             if with_steps and len(lines) <= _MAX_SECTION_STEPS:
                 out.extend(lines)
+        if sched:
+            from mpi4jax_tpu.analysis.record import event_to_dict
+
+            out.append(f"@sched n={len(events)}")
+            for ev in events:
+                out.append(json.dumps(
+                    event_to_dict(ev), separators=(",", ":")
+                ))
         return "\n".join(out).encode()
 
-    # never truncate: a cut-off tail section would silently compare
-    # equal across ranks that diverge only there.  Degrade in whole
-    # steps instead — full text, then digest-only headers, then one
-    # global digest (coarser error, same detection power).
-    text = render(with_steps=True)
+    text = render(with_steps=True, sched=with_sched) if with_sched \
+        else b""
+    if not text or len(text) >= FP_BYTES:
+        text = render(with_steps=True)
     if len(text) >= FP_BYTES:
         text = render(with_steps=False)
     if len(text) >= FP_BYTES:
@@ -93,7 +124,7 @@ def _comm_header(ev):
 
 
 def exchange_and_check(events, world=None, timeout=None,
-                       local_findings=()):
+                       local_findings=(), simulate=False):
     """Exchange this rank's schedule and raise on divergence.
 
     ``world`` is ``None`` (auto: use the proc tier when the native
@@ -105,11 +136,18 @@ def exchange_and_check(events, world=None, timeout=None,
     broken: the rank still participates — the exchange is a collective
     and sitting out would wedge every clean peer — but posts a sentinel,
     and the *peers* raise immediately naming it.
+
+    ``simulate=True`` ships the full event export when it fits
+    (``@sched`` section) and, when every rank's blob carries one, runs
+    the whole-job match-engine simulator after the per-comm diffs pass
+    — so a divergence verdict can cite an actual deadlock cycle
+    (T4J010/T4J013) or wildcard race (T4J011) instead of only a digest
+    mismatch, and agreement no longer means safety.
     """
     if local_findings:
         payload = ("!findings " + ",".join(local_findings)).encode()
     else:
-        payload = serialize_schedule(events)
+        payload = serialize_schedule(events, with_sched=simulate)
     if world is not None:
         rank, size = int(world[0]), int(world[1])
         if size <= 1:
@@ -127,7 +165,7 @@ def exchange_and_check(events, world=None, timeout=None,
         from mpi4jax_tpu.native import runtime
 
         rank = runtime.world_rank()
-    _compare(blobs, my_rank=rank)
+    _compare(blobs, my_rank=rank, simulate=simulate)
     return len(blobs)
 
 
@@ -148,7 +186,7 @@ def _proc_exchange(payload):
     return [bytes(row.tobytes()).rstrip(b"\x00") for row in gathered]
 
 
-def _compare(blobs, my_rank=None):
+def _compare(blobs, my_rank=None, simulate=False):
     """Diff every per-comm section this process is a member of; raise
     CommContractError naming the first differing step on mismatch."""
     broken = {
@@ -173,7 +211,7 @@ def _compare(blobs, my_rank=None):
     all_comms = []
     for sections in parsed:
         for comm_id in sections:
-            if comm_id not in all_comms:
+            if comm_id != "@sched" and comm_id not in all_comms:
                 all_comms.append(comm_id)
     for comm_id in all_comms:
         members = _members(comm_id, len(blobs))
@@ -216,10 +254,36 @@ def _compare(blobs, my_rank=None):
             "inline; re-run with a smaller program to see the step)."
         )
 
+    # Every per-comm section agrees.  Agreement is not safety: run the
+    # match-engine simulator over the assembled whole-job schedule when
+    # every rank shipped its full event export (the @sched rung of the
+    # degrade ladder).  Orphan checking stays off here — a rank outside
+    # some communicator legitimately never posts the matching op.
+    if simulate and all("@sched" in p for p in parsed):
+        from mpi4jax_tpu.analysis import simulate as _sim
+
+        schedules = [
+            _sim.schedule_from_events(
+                p["@sched"]["events"], rank=r, world=len(blobs)
+            )
+            for r, p in enumerate(parsed)
+        ]
+        result = _sim.simulate(schedules, orphans=False)
+        if result.findings:
+            lines = "\n".join(f"  {f}" for f in result.findings)
+            raise CommContractError(
+                "cross-rank simulation of the exchanged schedules "
+                f"found {len(result.findings)} hazard(s) — the "
+                "schedules agree per-comm but cannot complete "
+                f"together:\n{lines}",
+                findings=result.findings,
+            )
+
 
 def _parse(blob):
     sections = {}
     current = None
+    sched = None
     for line in blob.decode(errors="replace").splitlines():
         if line.startswith("@comm "):
             head = line[len("@comm "):]
@@ -227,6 +291,16 @@ def _parse(blob):
             sha = rest.partition("sha=")[2]
             current = {"sha": sha, "lines": []}
             sections[comm_id] = current
+            sched = None
+        elif line.startswith("@sched"):
+            sched = {"events": []}
+            sections["@sched"] = sched
+            current = None
+        elif sched is not None and line:
+            try:
+                sched["events"].append(json.loads(line))
+            except ValueError:
+                pass  # a malformed line degrades to fewer events
         elif current is not None and line:
             current["lines"].append(line)
     return sections

@@ -145,11 +145,47 @@ def _walk(jaxpr, tainted_invars, path, occurrences, findings):
         # -- generic recursion into sub-jaxprs --------------------------
         for sub in _sub_jaxprs(eqn):
             any_taint = any(_is_tainted(v, tainted) for v in eqn.invars)
-            sub_taint = (
-                set(sub.invars) if any_taint else set()
-            )  # conservative: taint everywhere if any operand is tainted
+            if prim in _POSITIONAL_PRIMS:
+                # call-like primitives pass their operands through to
+                # the sub-jaxpr positionally (pjit exactly; shard_map /
+                # custom_partitioning may curry constants in front, so
+                # align the zip at the TAIL) — precise mapping keeps an
+                # untainted shard_map operand untainted inside, so a
+                # cond on plain data inside shard_map does not
+                # false-positive T4J005 just because axis_index was
+                # used elsewhere in the call
+                sub_taint = _tail_align_taint(sub, eqn.invars, tainted)
+            else:
+                sub_taint = (
+                    set(sub.invars) if any_taint else set()
+                )  # conservative: taint everywhere if any operand is
+            #      tainted (scan/while reorder operands into carries)
             _walk(sub, sub_taint, path + (prim,), occurrences, findings)
     return tainted
+
+
+# Primitives whose sub-jaxpr invars line up positionally with the eqn
+# invars.  shard_map is the ROADMAP item-1 target: a collective under a
+# rank-dependent branch INSIDE shard_map must still raise T4J005, which
+# needs taint to flow through the shard_map call boundary (axis_index
+# inside the body is also seeded directly — both routes must work).
+_POSITIONAL_PRIMS = frozenset({
+    "pjit", "shard_map", "custom_partitioning", "closed_call",
+    "core_call", "xla_call",
+})
+
+
+def _tail_align_taint(sub_jaxpr, outer_invars, tainted):
+    """Map outer operand taint onto sub-jaxpr invars, aligning at the
+    tail (leading sub invars with no outer counterpart — lifted
+    constants — stay untainted)."""
+    sub_in = list(sub_jaxpr.invars)
+    outer = list(outer_invars)
+    sub_taint = set()
+    for inner, out_v in zip(reversed(sub_in), reversed(outer)):
+        if _is_tainted(out_v, tainted):
+            sub_taint.add(inner)
+    return sub_taint
 
 
 def _is_tainted(var, tainted):

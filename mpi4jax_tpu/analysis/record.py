@@ -22,10 +22,19 @@ user step count twice.
 """
 
 import inspect
+import json
 import threading
 import traceback
 
-__all__ = ["active", "recording", "record_op", "take_events"]
+__all__ = [
+    "active",
+    "dump_schedule",
+    "event_to_dict",
+    "load_schedule",
+    "record_op",
+    "recording",
+    "take_events",
+]
 
 _state = threading.local()
 
@@ -108,6 +117,22 @@ def record_op(name, fn, args, kwargs, out):
     except Exception:
         ev = None
     if ev is not None:
+        # Hardening for the reentrancy guard's edge cases: a composite
+        # op returns its inner op's result verbatim, so if the inner
+        # call ever escapes the depth guard (a raising __enter__, an op
+        # calling another op outside its op_frame) the duplicate event
+        # carries the SAME outgoing token and anchor as the one before
+        # it.  A user program genuinely repeating an op always threads
+        # a fresh token, so this collapses only true double-records.
+        prev = scope.events[-1] if scope.events else None
+        if (
+            prev is not None
+            and ev.token_out is not None
+            and prev.token_out == ev.token_out
+            and prev.kind == ev.kind
+            and prev.src_info == ev.src_info
+        ):
+            return
         scope.events.append(ev)
 
 
@@ -311,7 +336,13 @@ def _spec(spec):
     return "static"
 
 
-_LIB_MARKERS = ("mpi4jax_tpu/ops", "mpi4jax_tpu/analysis", "jax/")
+_LIB_MARKERS = (
+    "mpi4jax_tpu/ops",
+    "mpi4jax_tpu/analysis",
+    "mpi4jax_tpu/parallel",
+    "mpi4jax_tpu/serving",
+    "jax/",
+)
 
 
 def _user_frame():
@@ -324,3 +355,77 @@ def _user_frame():
             continue
         return f"{fr.filename}:{fr.lineno}"
     return ""
+
+
+# ------------------------------------------------------ schedule export
+
+# The JSON schedule format consumed by analysis/simulate.py and
+# ``t4j-verify --traces``: one object per file with a format tag and
+# the event list.  Every value is a JSON scalar/array, so a trace
+# recorded on a TPU pod replays on any machine (including old-jax
+# containers where this module loads via the test stub loader).
+_SCHEDULE_FORMAT = "t4j-schedule-v1"
+
+_EXPORT_FIELDS = (
+    "seq", "kind", "comm_key", "backend", "comm_size", "dtype",
+    "shape", "reduce_op", "tag", "source", "dest", "root", "rank",
+    "comm_ranks", "src_info", "request_out", "requests_in",
+)
+
+
+def event_to_dict(ev):
+    """A CommEvent as a plain JSON-ready dict.
+
+    Token identities are deliberately dropped — they are process-local
+    addresses, meaningless across ranks or runs.  The rank's effective
+    wire mode is stamped onto compression-eligible steps (f32 SUM
+    reductions — the same gate as ``step_signature``) so the simulator
+    can run the cross-rank T4J014 check offline.
+    """
+    from mpi4jax_tpu.analysis.contracts import _effective_wire_dtype
+
+    d = {}
+    for f in _EXPORT_FIELDS:
+        v = getattr(ev, f, None)
+        if isinstance(v, tuple):
+            v = list(v)
+        d[f] = v
+    if ev.reduce_op == "sum" and ev.dtype == "float32":
+        d["wire"] = _effective_wire_dtype()
+    return d
+
+
+def dump_schedule(events, path, rank=None):
+    """Write one rank's recorded events as a JSON schedule file."""
+    doc = {
+        "format": _SCHEDULE_FORMAT,
+        "rank": rank,
+        "events": [event_to_dict(ev) for ev in events],
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=None, separators=(",", ":"))
+        fh.write("\n")
+
+
+def load_schedule(path):
+    """Read a schedule file back as ``(rank, [event dicts])``.
+
+    Returns plain dicts (not CommEvents): the simulator duck-types its
+    events, and reconstructing the frozen dataclass would drag in
+    fields the export deliberately dropped.  Raises ``ValueError`` on a
+    wrong format tag so ``t4j-verify`` can exit 2 with a real message
+    instead of a KeyError.
+    """
+    with open(path) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or doc.get("format") != _SCHEDULE_FORMAT:
+        raise ValueError(
+            f"{path}: not a {_SCHEDULE_FORMAT} schedule file "
+            f"(format={doc.get('format')!r})"
+            if isinstance(doc, dict)
+            else f"{path}: not a JSON object"
+        )
+    events = doc.get("events")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: 'events' must be a list")
+    return doc.get("rank"), events

@@ -1,0 +1,1008 @@
+"""Cross-rank schedule simulator — rules T4J010..T4J014 (``t4j-verify``).
+
+The fingerprint pass (analysis/fingerprint.py) catches schedules that
+*diverge*; this module covers the complementary blind spot: schedules
+that AGREE step for step and still deadlock or complete
+nondeterministically when the ranks' schedules meet on the wire.  Given
+one recorded schedule per rank (the PR-4 recorder's events, a JSON
+export from :func:`..record.dump_schedule`, or N per-rank schedules
+specialised from one SPMD trace via :func:`specialize_spmd`), it
+symbolically executes them under the runtime's actual semantics:
+
+* **per-rank in-order submission** — each rank posts its ops in program
+  order and a blocking op stops the rank (the engine's
+  MPI_THREAD_SERIALIZED cross-comm ordering: a blocked op on comm A
+  blocks later ops on comm B too);
+* **posted-order receive matching** — among receives that could match
+  one message the earliest-posted wins, and messages between a fixed
+  (sender, receiver) pair never overtake each other (the PR-7
+  ``frame_matches`` contract);
+* **eager/rendezvous sends** — sends at or under ``eager_bytes``
+  buffer and complete immediately (the wire path stages small
+  payloads); larger sends block until matched (TCP backpressure —
+  the classic MPI eager-threshold semantics, and the reason send/send
+  cycles only deadlock above the threshold);
+* **nonblocking requests** — ``isend``/``irecv``/``iallreduce``/
+  ``ireduce_scatter`` post immediately and their rank proceeds; the
+  ``wait``/``waitall`` consuming the request blocks until completion;
+* **collectives as all-member sync points** — the k-th collective a
+  rank issues on a comm joins the comm's k-th slot; the slot completes
+  when every member has arrived with an agreeing op signature.
+
+Wildcard receives (``ANY_SOURCE``/``ANY_TAG``) are the only source of
+nondeterminism, so they are the only branch points: the exploration is
+a bounded DPOR-style DFS that forks the match engine once per visible
+candidate sender whenever a wildcard receive could match more than one
+message, capped at ``max_states`` explored states (the cap is reported,
+never silent).  Deterministic matches are confluent and applied
+greedily.
+
+Like the rest of the analyzer's pure cores (contracts.py, tuning/,
+telemetry/), this module imports nothing from jax or the package at
+module scope except the contracts rule core, so it loads on old-jax
+containers via the stub-parent loader (tests/analysis/conftest.py) and
+events are duck-typed (:class:`~.contracts.CommEvent` or plain dicts).
+"""
+
+from mpi4jax_tpu.analysis.contracts import Finding, dedupe_findings
+
+__all__ = [
+    "DEFAULT_EAGER_BYTES",
+    "DEFAULT_MAX_STATES",
+    "SimResult",
+    "schedule_from_events",
+    "simulate",
+    "specialize_spmd",
+]
+
+# sends at or under this many payload bytes complete eagerly (buffered
+# on the wire path); larger sends are rendezvous and block until
+# matched — the same order of magnitude as classic MPI eager limits
+DEFAULT_EAGER_BYTES = 65536
+DEFAULT_MAX_STATES = 4096
+
+_ITEMSIZE = {
+    "float32": 4, "float64": 8, "int8": 1, "int16": 2, "int32": 4,
+    "int64": 8, "uint8": 1, "uint16": 2, "uint32": 4, "uint64": 8,
+    "bool": 1, "complex64": 8, "complex128": 16, "float16": 2,
+    "bfloat16": 2,
+}
+
+_SEND_KINDS = ("send", "isend")
+_RECV_KINDS = ("recv", "irecv")
+_SENDRECV_KINDS = ("sendrecv", "sendrecv_multi")
+_WAIT_KINDS = ("wait", "waitall")
+_ICOLL_KINDS = ("iallreduce", "ireduce_scatter")
+_NOOP_KINDS = ("test",)
+
+
+def _get(ev, name, default=None):
+    if isinstance(ev, dict):
+        return ev.get(name, default)
+    return getattr(ev, name, default)
+
+
+def _comm_id(ev):
+    key = _get(ev, "comm_key")
+    if isinstance(key, (tuple, list)):
+        return "/".join(str(p) for p in key)
+    return str(key)
+
+
+def _payload_bytes(ev):
+    shape = _get(ev, "shape") or ()
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * _ITEMSIZE.get(str(_get(ev, "dtype") or ""), 4)
+
+
+def _norm_spec(spec):
+    """JSON round-trips turn pair tuples into lists; normalise back."""
+    if isinstance(spec, list):
+        try:
+            return tuple(sorted((int(s), int(d)) for s, d in spec))
+        except (TypeError, ValueError):
+            return "static"
+    return spec
+
+
+def _resolve_pairs(spec, rank, want):
+    """Resolve a permutation pair spec for ``rank``: ``want="dest"``
+    returns the d with s==rank, ``want="source"`` the s with d==rank,
+    or None when the rank has no pair (a non-periodic edge)."""
+    for s, d in spec:
+        if want == "dest" and int(s) == rank:
+            return int(d)
+        if want == "source" and int(d) == rank:
+            return int(s)
+    return None
+
+
+class _Op:
+    """One normalised schedule step of one rank."""
+
+    __slots__ = ("rank", "idx", "kind", "cat", "comm", "members",
+                 "dest", "source", "tag", "nbytes", "req", "reqs",
+                 "src_info", "sig", "wire", "unknown_peer",
+                 "dtype", "redop")
+
+    def __repr__(self):
+        return f"_Op(r{self.rank}#{self.idx} {self.sig})"
+
+
+def _op_sig(ev, kind):
+    bits = [kind, _comm_id(ev)]
+    dtype, shape = _get(ev, "dtype"), _get(ev, "shape")
+    if dtype or shape:
+        bits.append(f"{dtype}[{'x'.join(str(d) for d in shape or ())}]")
+    red = _get(ev, "reduce_op")
+    if red:
+        bits.append(f"op={red}")
+    root = _get(ev, "root")
+    if root is not None:
+        bits.append(f"root={root}")
+    return " ".join(bits)
+
+
+def schedule_from_events(events, rank=None, world=None, wire=None):
+    """Normalise one rank's recorded events into simulator ops.
+
+    ``rank`` overrides the per-event rank (needed for mesh events,
+    where the rank is a traced value and records as None); ``world``
+    supplies the default member set for comms whose membership is not
+    recorded; ``wire`` overrides the rank's compressed-collective wire
+    mode (else each event's exported ``wire`` field is used).
+    """
+    ops = []
+    for idx, ev in enumerate(events):
+        kind = str(_get(ev, "kind") or "")
+        op = _Op()
+        op.rank = _get(ev, "rank") if rank is None else rank
+        op.idx = idx
+        op.kind = kind
+        op.comm = _comm_id(ev)
+        members = tuple(_get(ev, "comm_ranks") or ())
+        if not members:
+            size = int(_get(ev, "comm_size") or 1)
+            members = tuple(range(size if world is None else world))
+        op.members = members
+        op.dest = _norm_spec(_get(ev, "dest"))
+        op.source = _norm_spec(_get(ev, "source"))
+        op.tag = _get(ev, "tag")
+        op.nbytes = _payload_bytes(ev)
+        op.req = _get(ev, "request_out")
+        op.reqs = tuple(_get(ev, "requests_in") or ())
+        op.src_info = str(_get(ev, "src_info") or "")
+        op.sig = _op_sig(ev, kind)
+        op.wire = wire if wire is not None else _get(ev, "wire")
+        op.unknown_peer = False
+        op.dtype = str(_get(ev, "dtype") or "")
+        op.redop = str(_get(ev, "reduce_op") or "")
+
+        if kind in _SEND_KINDS or kind in _RECV_KINDS \
+                or kind in _SENDRECV_KINDS:
+            op.cat = ("sendrecv" if kind in _SENDRECV_KINDS
+                      else "send" if kind in _SEND_KINDS else "recv")
+            for attr in ("dest", "source"):
+                spec = getattr(op, attr)
+                if isinstance(spec, tuple) and op.rank is not None:
+                    setattr(op, attr, _resolve_pairs(
+                        spec, op.rank,
+                        "dest" if attr == "dest" else "source"))
+                elif spec in ("traced", "callable", "static"):
+                    op.unknown_peer = True
+        elif kind in _WAIT_KINDS:
+            op.cat = "wait"
+        elif kind in _NOOP_KINDS:
+            op.cat = "noop"
+        elif kind in _ICOLL_KINDS:
+            op.cat = "icoll"
+        elif len(op.members) > 1:
+            # everything else with a multi-member comm is an all-member
+            # sync point (allreduce, bcast, barrier, halo composites...)
+            op.cat = "coll"
+        else:
+            op.cat = "noop"
+        ops.append(op)
+    return ops
+
+
+def specialize_spmd(events, world=None):
+    """Split one SPMD trace into per-rank schedules, one group per
+    communicator.
+
+    Under SPMD every rank runs the same program, so rank r's schedule
+    is the trace itself with ``rank=r`` and permutation pair specs
+    resolved per rank.  Membership of sub-communicators (grid axes) in
+    the world is not recorded on the mesh backend, so each comm is
+    simulated in its own ``comm_size``-rank group — sound for a single
+    trace, since cross-comm ordering inversions need rank-divergent
+    programs, which one trace cannot express (per-rank MPMD traces go
+    through :func:`simulate` whole).  Returns a list of
+    ``(comm_id, [rank0_ops, rank1_ops, ...])`` groups.
+    """
+    by_comm = {}
+    for ev in events:
+        by_comm.setdefault(_comm_id(ev), []).append(ev)
+    groups = []
+    for comm_id, evs in by_comm.items():
+        size = max(int(_get(ev, "comm_size") or 1) for ev in evs)
+        if size <= 1:
+            continue
+        schedules = []
+        for r in range(size):
+            ops = schedule_from_events(evs, rank=r, world=size)
+            # drop p2p halves the pattern gives this rank no part in
+            # (non-periodic edges resolve to None)
+            ops = [op for op in ops
+                   if not (op.cat == "send" and op.dest is None)
+                   and not (op.cat == "recv" and op.source is None)]
+            for i, op in enumerate(ops):
+                op.idx = i
+            schedules.append(ops)
+        groups.append((comm_id, schedules))
+    return groups
+
+
+class SimResult:
+    """Outcome of one :func:`simulate` run."""
+
+    def __init__(self, findings, outcomes, states, truncated, notes):
+        self.findings = findings
+        self.outcomes = outcomes        # distinct terminal match maps
+        self.states = states            # states explored
+        self.truncated = truncated      # hit max_states
+        self.notes = list(notes)
+
+    @property
+    def ok(self):
+        return not self.findings
+
+    def __repr__(self):
+        return (f"SimResult(findings={len(self.findings)}, "
+                f"outcomes={len(self.outcomes)}, states={self.states}, "
+                f"truncated={self.truncated})")
+
+
+# ------------------------------------------------------------ match engine
+
+
+class _State:
+    """One node of the exploration: every mutable matching fact."""
+
+    __slots__ = ("pc", "blocked", "sends", "recvs", "reqs_done",
+                 "slots", "coll_count", "matches", "post_ctr", "dead")
+
+    @classmethod
+    def initial(cls, n_ranks):
+        st = cls()
+        st.pc = [0] * n_ranks
+        st.blocked = [None] * n_ranks   # rank -> blocking descriptor
+        st.sends = []                   # posted send records (dicts)
+        st.recvs = []                   # posted recv records (dicts)
+        st.reqs_done = set()
+        st.slots = {}                   # comm -> [slot dicts]
+        st.coll_count = {}              # (comm, rank) -> arrivals so far
+        st.matches = {}                 # (rank, idx) -> (sender, tag)
+        st.post_ctr = 0
+        st.dead = None                  # finding that killed the branch
+        return st
+
+    def clone(self):
+        st = _State()
+        st.pc = list(self.pc)
+        st.blocked = list(self.blocked)
+        st.sends = [dict(s) for s in self.sends]
+        st.recvs = [dict(r) for r in self.recvs]
+        st.reqs_done = set(self.reqs_done)
+        st.slots = {
+            c: [{"arrived": dict(s["arrived"]), "done": s["done"]}
+                for s in slots]
+            for c, slots in self.slots.items()
+        }
+        st.coll_count = dict(self.coll_count)
+        st.matches = dict(self.matches)
+        st.post_ctr = self.post_ctr
+        st.dead = self.dead
+        return st
+
+
+def _anchor(op):
+    return f" at {op.src_info}" if op.src_info else ""
+
+
+def simulate(schedules, *, eager_bytes=DEFAULT_EAGER_BYTES,
+             max_states=DEFAULT_MAX_STATES, orphans=True,
+             max_findings=16):
+    """Symbolically execute ``schedules`` (one op list per rank, from
+    :func:`schedule_from_events`) and return a :class:`SimResult`.
+
+    ``orphans=False`` skips the whole-job T4J012 envelope pre-pass —
+    used by the fingerprint exchange, where a partial-world schedule
+    set would make absence-of-a-recv a false positive.
+    """
+    schedules = [
+        s if (s and isinstance(s[0], _Op)) else
+        schedule_from_events(s, rank=r, world=len(schedules))
+        for r, s in enumerate(schedules)
+    ]
+    findings = []
+    notes = []
+    if orphans:
+        findings += _check_orphans(schedules)
+    findings += _check_wire_mix(schedules)
+    unknowable = sorted({
+        op.comm for ops in schedules for op in ops if op.unknown_peer
+    })
+    if unknowable:
+        notes.append(
+            "p2p routing on comm(s) %s is dynamic "
+            "(traced/callable partner): match simulation skipped for "
+            "those ops" % ", ".join(unknowable))
+
+    # --------------------------------------------- bounded DFS exploration
+    outcomes = {}           # frozenset(match items) -> representative
+    deadlocks = []          # (finding, match-map) per stuck terminal
+    states = 0
+    truncated = False
+    stack = [_State.initial(len(schedules))]
+    while stack:
+        if states >= max_states:
+            truncated = True
+            break
+        st = stack.pop()
+        states += 1
+        choice = _run_to_fixpoint(st, schedules, eager_bytes)
+        if st.dead is not None:
+            deadlocks.append((st.dead, dict(st.matches)))
+            continue
+        if choice is not None:
+            recv, cands = choice
+            for cand in cands:
+                branch = st.clone()
+                _apply_match(branch, _find_record(branch.recvs, recv),
+                             _find_record(branch.sends, cand),
+                             schedules, eager_bytes)
+                stack.append(branch)
+            continue
+        if _all_done(st, schedules):
+            key = frozenset(st.matches.items())
+            outcomes.setdefault(key, dict(st.matches))
+        else:
+            f = _deadlock_finding(st, schedules)
+            deadlocks.append((f, dict(st.matches)))
+
+    for f, _m in deadlocks:
+        if f is not None:
+            findings.append(f)
+    if len(outcomes) > 1:
+        findings.append(_nondet_finding(outcomes, schedules))
+    elif outcomes and deadlocks:
+        findings.append(Finding(
+            rule="T4J011",
+            message=(
+                "wildcard nondeterminism: one ANY_SOURCE/ANY_TAG match "
+                "order completes the job while another deadlocks "
+                "(see the T4J010/T4J013 finding for the blocking "
+                "order) — the racing receives make completion "
+                "order-dependent."
+            ),
+        ))
+    if truncated:
+        notes.append(
+            f"exploration capped at max_states={max_states}: wildcard "
+            "branches beyond the cap were not explored (findings are "
+            "sound but possibly incomplete)")
+    findings = dedupe_findings(findings)[:max_findings]
+    return SimResult(findings, list(outcomes.values()), states,
+                     truncated, notes)
+
+
+def _find_record(records, rec):
+    """Locate ``rec``'s copy in a cloned state by its post id."""
+    for r in records:
+        if r["post"] == rec["post"]:
+            return r
+    raise KeyError(rec["post"])
+
+
+def _all_done(st, schedules):
+    return all(
+        st.blocked[r] is None and st.pc[r] >= len(schedules[r])
+        for r in range(len(schedules))
+    )
+
+
+def _run_to_fixpoint(st, schedules, eager_bytes):
+    """Advance every rank and apply deterministic matches until no
+    progress; returns a wildcard choice point ``(recv_rec, [send_rec,
+    ...])`` when that is the only way forward, else None."""
+    progress = True
+    while progress and st.dead is None:
+        progress = False
+        for r in range(len(schedules)):
+            if _advance_rank(st, r, schedules, eager_bytes):
+                progress = True
+            if st.dead is not None:
+                return None
+        while True:
+            det = _deterministic_match(st)
+            if det is None:
+                break
+            recv, send = det
+            _apply_match(st, recv, send, schedules, eager_bytes)
+            progress = True
+    if st.dead is not None:
+        return None
+    return _wildcard_choice(st)
+
+
+def _advance_rank(st, r, schedules, eager_bytes):
+    """Post ops for rank ``r`` until it blocks or its schedule ends.
+    Returns True when anything happened."""
+    moved = False
+    while st.dead is None:
+        blk = st.blocked[r]
+        if blk is not None:
+            if not _try_unblock(st, r, blk):
+                return moved
+            st.blocked[r] = None
+            moved = True
+        ops = schedules[r]
+        if st.pc[r] >= len(ops):
+            return moved
+        op = ops[st.pc[r]]
+        st.pc[r] += 1
+        moved = True
+        if op.cat == "noop" or op.unknown_peer:
+            continue
+        if op.cat == "send":
+            if op.dest is None:
+                # MPI_PROC_NULL semantics (non-periodic halo edge):
+                # the send half is a no-op
+                if op.req is not None:
+                    st.reqs_done.add(op.req)
+                continue
+            rec = _post_send(st, op, eager_bytes)
+            if op.kind == "send" and not rec["completed"]:
+                st.blocked[r] = ("send", op, rec["post"])
+        elif op.cat == "recv":
+            rec = _post_recv(st, op)
+            if op.kind == "recv":
+                st.blocked[r] = ("recv", op, rec["post"])
+        elif op.cat == "sendrecv":
+            spost = rpost = None
+            if op.dest is not None:
+                spost = _post_send(st, op, eager_bytes)["post"]
+            if op.source is not None:
+                rpost = _post_recv(st, op)["post"]
+            if spost is not None or rpost is not None:
+                st.blocked[r] = ("sendrecv", op, spost, rpost)
+        elif op.cat == "wait":
+            remaining = tuple(q for q in op.reqs
+                              if q not in st.reqs_done)
+            if remaining:
+                st.blocked[r] = ("wait", op, remaining)
+        elif op.cat in ("coll", "icoll"):
+            slot_i = _arrive_collective(st, op)
+            if st.dead is not None:
+                return moved
+            slot = st.slots[op.comm][slot_i]
+            if not slot["done"] and op.cat == "coll":
+                st.blocked[r] = ("coll", op, slot_i)
+    return moved
+
+
+def _post_send(st, op, eager_bytes):
+    eager = op.nbytes <= eager_bytes
+    rec = {
+        "post": st.post_ctr, "rank": op.rank, "idx": op.idx,
+        "comm": op.comm, "dest": op.dest, "tag": op.tag,
+        "matched": False, "completed": eager, "req": op.req,
+        "sig": op.sig, "src_info": op.src_info, "nbytes": op.nbytes,
+    }
+    st.post_ctr += 1
+    st.sends.append(rec)
+    if eager and op.req is not None:
+        st.reqs_done.add(op.req)
+    return rec
+
+
+def _post_recv(st, op):
+    rec = {
+        "post": st.post_ctr, "rank": op.rank, "idx": op.idx,
+        "comm": op.comm, "source": op.source, "tag": op.tag,
+        "matched": False, "req": op.req, "sig": op.sig,
+        "src_info": op.src_info, "members": op.members,
+    }
+    st.post_ctr += 1
+    st.recvs.append(rec)
+    return rec
+
+
+def _arrive_collective(st, op):
+    """Join the comm's next slot for this rank; completes the slot when
+    every member has arrived with an agreeing signature."""
+    slots = st.slots.setdefault(op.comm, [])
+    k = st.coll_count.get((op.comm, op.rank), 0)
+    st.coll_count[(op.comm, op.rank)] = k + 1
+    while len(slots) <= k:
+        slots.append({"arrived": {}, "done": False})
+    slot = slots[k]
+    slot["arrived"][op.rank] = op
+    if len(slot["arrived"]) >= len(op.members):
+        sigs = {a.sig for a in slot["arrived"].values()}
+        if len(sigs) > 1:
+            sides = "; ".join(
+                f"rank {rk}: {a.sig}{_anchor(a)}"
+                for rk, a in sorted(slot["arrived"].items())
+            )
+            st.dead = Finding(
+                rule="T4J013",
+                message=(
+                    f"collective ordering inversion on comm {op.comm}: "
+                    f"every member arrived at collective slot {k} but "
+                    f"with different ops — {sides}. The ranks entered "
+                    "the comm's collectives in different interleavings; "
+                    "each blocks inside a different collective and none "
+                    "can complete."
+                ),
+                src_info=op.src_info,
+            )
+            return k
+        slot["done"] = True
+        for a in slot["arrived"].values():
+            if a.req is not None:
+                st.reqs_done.add(a.req)
+    return k
+
+
+def _try_unblock(st, r, blk):
+    kind = blk[0]
+    if kind == "send":
+        return _find_record(st.sends, {"post": blk[2]})["completed"]
+    if kind == "recv":
+        return _find_record(st.recvs, {"post": blk[2]})["matched"]
+    if kind == "sendrecv":
+        s_ok = (blk[2] is None
+                or _find_record(st.sends, {"post": blk[2]})["completed"])
+        r_ok = (blk[3] is None
+                or _find_record(st.recvs, {"post": blk[3]})["matched"])
+        return s_ok and r_ok
+    if kind == "wait":
+        return all(q in st.reqs_done for q in blk[2])
+    if kind == "coll":
+        op = blk[1]
+        return st.slots[op.comm][blk[2]]["done"]
+    return False
+
+
+def _envelope_match(recv, send):
+    if recv["comm"] != send["comm"]:
+        return False
+    if send["dest"] != recv["rank"]:
+        return False
+    src = recv["source"]
+    if src not in ("ANY", None) and src != send["rank"]:
+        return False
+    rtag, stag = recv["tag"], send["tag"]
+    if rtag in (None, -1, "ANY"):
+        return True
+    return rtag == stag
+
+
+def _candidates(st, recv):
+    """Matchable sends for a posted recv: per sender, the earliest
+    unmatched posted send (non-overtaking).  Posted-order priority: a
+    send is NOT a candidate when an earlier-posted unmatched recv on
+    the same rank also matches its envelope — that recv gets the
+    message first (the ``frame_matches`` posted-order contract)."""
+    per_sender = {}
+    for s in st.sends:
+        if s["matched"] or not _envelope_match(recv, s):
+            continue
+        claimed = any(
+            r2["post"] < recv["post"] and not r2["matched"]
+            and r2["rank"] == recv["rank"] and _envelope_match(r2, s)
+            for r2 in st.recvs
+        )
+        if claimed:
+            continue
+        prev = per_sender.get(s["rank"])
+        if prev is None or s["post"] < prev["post"]:
+            per_sender[s["rank"]] = s
+    return [per_sender[k] for k in sorted(per_sender)]
+
+
+def _deterministic_match(st):
+    """The earliest-posted unmatched recv with exactly one candidate,
+    or a non-wildcard recv with any candidate."""
+    for recv in sorted((r for r in st.recvs if not r["matched"]),
+                       key=lambda r: r["post"]):
+        cands = _candidates(st, recv)
+        if len(cands) == 1:
+            return recv, cands[0]
+    return None
+
+
+def _wildcard_choice(st):
+    for recv in sorted((r for r in st.recvs if not r["matched"]),
+                       key=lambda r: r["post"]):
+        cands = _candidates(st, recv)
+        if len(cands) > 1:
+            return recv, cands
+    return None
+
+
+def _apply_match(st, recv, send, schedules, eager_bytes):
+    recv["matched"] = True
+    send["matched"] = True
+    send["completed"] = True
+    if send["req"] is not None:
+        st.reqs_done.add(send["req"])
+    if recv["req"] is not None:
+        st.reqs_done.add(recv["req"])
+    st.matches[(recv["rank"], recv["idx"])] = (
+        send["rank"], send["tag"]
+    )
+
+
+# ------------------------------------------------------- stuck-state report
+
+
+def _wait_edges(st, r, schedules):
+    """Outgoing wait-for edges of a blocked rank: (target_rank, label,
+    is_collective)."""
+    blk = st.blocked[r]
+    if blk is None:
+        return []
+    kind, op = blk[0], blk[1]
+    edges = []
+    if kind == "send" or (kind == "sendrecv" and blk[2] is not None
+                          and not _find_record(
+                              st.sends, {"post": blk[2]})["completed"]):
+        d = op.dest
+        edges.append((d, f"{op.sig} dest={d} tag={op.tag}"
+                         f"{_anchor(op)} waits for rank {d} to post a "
+                         "matching recv (rendezvous send over the "
+                         "eager threshold)", False))
+    recv_post = blk[3] if kind == "sendrecv" else (
+        blk[2] if kind == "recv" else None)
+    if recv_post is not None and not _find_record(
+            st.recvs, {"post": recv_post})["matched"]:
+        rec = _find_record(st.recvs, {"post": recv_post})
+        src = rec["source"]
+        if src in ("ANY", None):
+            for m in op.members:
+                if m != r:
+                    edges.append((m, f"{op.sig} source=ANY tag={op.tag}"
+                                     f"{_anchor(op)} waits for any "
+                                     "matching send", False))
+        else:
+            edges.append((src, f"{op.sig} source={src} tag={op.tag}"
+                              f"{_anchor(op)} waits for rank {src} to "
+                              "send", False))
+    if kind == "wait":
+        for q in blk[2]:
+            origin = _req_origin(schedules[r], q)
+            if origin is None:
+                continue
+            if origin.cat == "send":
+                edges.append((origin.dest,
+                              f"wait on {origin.sig}{_anchor(op)} "
+                              f"waits for rank {origin.dest} to recv",
+                              False))
+            elif origin.cat == "recv":
+                if origin.source in ("ANY", None):
+                    for m in origin.members:
+                        if m != r:
+                            edges.append((m, f"wait on {origin.sig}"
+                                             f"{_anchor(op)}", False))
+                else:
+                    edges.append((origin.source,
+                                  f"wait on {origin.sig}{_anchor(op)} "
+                                  f"waits for rank {origin.source} to "
+                                  "send", False))
+            elif origin.cat == "icoll":
+                for m in _missing_members(st, origin):
+                    edges.append((m, f"wait on {origin.sig}"
+                                     f"{_anchor(op)} waits for rank "
+                                     f"{m} to join the collective",
+                                  True))
+    if kind == "coll":
+        for m in _missing_members(st, op):
+            edges.append((m, f"{op.sig}{_anchor(op)} waits for rank "
+                             f"{m} to join the collective", True))
+    return edges
+
+
+def _req_origin(ops, req):
+    for op in ops:
+        if op.req == req:
+            return op
+    return None
+
+
+def _missing_members(st, op):
+    slots = st.slots.get(op.comm, ())
+    for slot in slots:
+        if not slot["done"] and op.rank in slot["arrived"] and \
+                slot["arrived"][op.rank] is op:
+            return [m for m in op.members if m not in slot["arrived"]]
+    return [m for m in op.members if m != op.rank]
+
+
+def _deadlock_finding(st, schedules):
+    """Classify a stuck state: wait-for cycle -> T4J010/T4J013, sink
+    waiting on terminated ranks -> dynamic orphan (T4J012)."""
+    graph = {}
+    for r in range(len(schedules)):
+        edges = _wait_edges(st, r, schedules)
+        if edges:
+            graph[r] = edges
+    cycle = _find_cycle(graph)
+    if cycle is not None:
+        has_coll = any(is_coll for _t, _l, is_coll in
+                       (graph[r][i] for r, i in cycle))
+        steps = []
+        for r, i in cycle:
+            _target, label, _c = graph[r][i]
+            steps.append(f"rank {r}: {label}")
+        rule = "T4J013" if has_coll else "T4J010"
+        head = ("collective ordering inversion"
+                if has_coll else "cross-rank deadlock")
+        anchor = ""
+        for r, i in cycle:
+            op = st.blocked[r][1]
+            if op.src_info:
+                anchor = op.src_info
+                break
+        return Finding(
+            rule=rule,
+            message=(
+                f"{head}: wait-for cycle of length {len(cycle)}: "
+                + "; ".join(steps)
+                + " — every edge blocks under MPI matching semantics, "
+                "so no rank can ever proceed."
+            ),
+            src_info=anchor,
+        )
+    # no cycle: some blocked rank waits only on ranks that finished
+    for r, edges in sorted(graph.items()):
+        targets = {t for t, _l, _c in edges}
+        done = {t for t in targets
+                if t >= len(schedules) or (
+                    st.blocked[t] is None
+                    and st.pc[t] >= len(schedules[t]))}
+        if targets and targets == done:
+            _t, label, _c = edges[0]
+            return Finding(
+                rule="T4J012",
+                message=(
+                    f"orphan matching: rank {r}: {label}, but every "
+                    "rank it waits on has already finished its "
+                    "schedule — the matching op is never posted."
+                ),
+                src_info=st.blocked[r][1].src_info,
+            )
+    if graph:
+        r, edges = sorted(graph.items())[0]
+        _t, label, _c = edges[0]
+        return Finding(
+            rule="T4J010",
+            message=(
+                f"cross-rank deadlock: the job is stuck with rank {r}: "
+                f"{label} and no match engine transition enabled."
+            ),
+            src_info=st.blocked[r][1].src_info,
+        )
+    return None
+
+
+def _find_cycle(graph):
+    """Any cycle in the wait-for digraph as [(rank, edge_index), ...]."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {r: WHITE for r in graph}
+    path = []
+
+    def dfs(r):
+        color[r] = GREY
+        for i, (target, _label, _c) in enumerate(graph[r]):
+            if target not in graph:
+                continue
+            if color.get(target, WHITE) == GREY:
+                start = next(j for j, (pr, _pi) in enumerate(path)
+                             if pr == target)
+                return path[start:] + [(r, i)]
+            if color.get(target, WHITE) == WHITE:
+                path.append((r, i))
+                hit = dfs(target)
+                path.pop()
+                if hit is not None:
+                    return hit
+        color[r] = BLACK
+        return None
+
+    for r in sorted(graph):
+        if color[r] == WHITE:
+            hit = dfs(r)
+            if hit is not None:
+                # rotate so the edge indices line up with their rank
+                fixed = []
+                n = len(hit)
+                for j in range(n):
+                    rr = hit[j][0]
+                    # edge index recorded when LEAVING rr is hit[j][1]
+                    fixed.append((rr, hit[j][1]))
+                return fixed
+    return None
+
+
+def _nondet_finding(outcomes, schedules):
+    keys = sorted(
+        {k for m in outcomes.values() for k in m},
+    )
+    first = None
+    senders = set()
+    for key in keys:
+        vals = {tuple(m.get(key, ("<unmatched>", None)))
+                for m in outcomes.values()}
+        if len(vals) > 1:
+            first = key
+            senders = {v[0] for v in vals}
+            break
+    r, idx = first if first else (None, None)
+    anchor = ""
+    desc = "a wildcard receive"
+    if r is not None and idx is not None and r < len(schedules):
+        for op in schedules[r]:
+            if op.idx == idx:
+                anchor = op.src_info
+                desc = f"rank {r}: {op.sig} source=ANY{_anchor(op)}"
+                break
+    return Finding(
+        rule="T4J011",
+        message=(
+            f"wildcard nondeterminism: {desc} can match sends from "
+            f"ranks {sorted((str(s) for s in senders if s is not None))} "
+            f"depending on arrival order — {len(outcomes)} distinct "
+            "final states are reachable. Pin the source (or make the "
+            "result order-insensitive)."
+        ),
+        src_info=anchor,
+    )
+
+
+# ----------------------------------------------------- whole-job pre-passes
+
+
+def _check_orphans(schedules):
+    """T4J012 — whole-job envelope closure, per comm: every send must
+    have a potential receiver in the dest rank's schedule, every recv a
+    potential sender.  Count-based greedy matching: specific receives
+    consume matching sends first, wildcard receives absorb the rest."""
+    findings = []
+    sends = []          # (comm, sender, dest, tag, op)
+    recvs = []          # (comm, receiver, source, tag, op)
+    dynamic_comms = set()
+    for ops in schedules:
+        for op in ops:
+            if op.unknown_peer:
+                dynamic_comms.add(op.comm)
+                continue
+            if op.cat in ("send", "sendrecv") and op.dest is not None:
+                sends.append([op.comm, op.rank, op.dest, op.tag, op,
+                              False])
+            if op.cat in ("recv", "sendrecv") and \
+                    (op.source is not None or op.cat == "recv"):
+                recvs.append([op.comm, op.rank, op.source, op.tag, op,
+                              False])
+    # pass 1: specific receives claim matching sends
+    for rv in recvs:
+        if rv[2] in ("ANY", None):
+            continue
+        for sd in sends:
+            if sd[5] or sd[0] != rv[0] or sd[0] in dynamic_comms:
+                continue
+            if sd[2] == rv[1] and sd[1] == rv[2] and \
+                    _tags_match(rv[3], sd[3]):
+                sd[5] = rv[5] = True
+                break
+    # pass 2: wildcard receives absorb remaining sends to their rank
+    for rv in recvs:
+        if rv[5] or rv[2] not in ("ANY", None):
+            continue
+        for sd in sends:
+            if sd[5] or sd[0] != rv[0] or sd[0] in dynamic_comms:
+                continue
+            if sd[2] == rv[1] and _tags_match(rv[3], sd[3]):
+                sd[5] = rv[5] = True
+                break
+    for comm, sender, dest, tag, op, used in sends:
+        if used or comm in dynamic_comms:
+            continue
+        findings.append(Finding(
+            rule="T4J012",
+            message=(
+                f"orphan send: rank {sender}: {op.sig} dest={dest} "
+                f"tag={tag}{_anchor(op)} is never received — no recv "
+                f"in rank {dest}'s schedule matches its envelope "
+                "(whole-job scope)."
+            ),
+            src_info=op.src_info,
+        ))
+    for comm, receiver, source, tag, op, used in recvs:
+        if used or comm in dynamic_comms:
+            continue
+        src_txt = "ANY" if source in ("ANY", None) else source
+        findings.append(Finding(
+            rule="T4J012",
+            message=(
+                f"orphan recv: rank {receiver}: {op.sig} "
+                f"source={src_txt} tag={tag}{_anchor(op)} can never be "
+                "satisfied — no unclaimed send in any schedule "
+                "matches its envelope (whole-job scope)."
+            ),
+            src_info=op.src_info,
+        ))
+    return findings
+
+
+def _tags_match(rtag, stag):
+    if rtag in (None, -1, "ANY"):
+        return True
+    return rtag == stag
+
+
+def _check_wire_mix(schedules):
+    """T4J014 — ROADMAP item 5: member ranks of one comm must agree on
+    the compressed-collective wire mode for the reduction steps the
+    compression gate applies to.  Needs every rank's schedule in hand
+    (the fingerprint pass can only compare; this sees the whole comm)."""
+    findings = []
+    by_comm = {}
+    for ops in schedules:
+        for op in ops:
+            if op.cat not in ("coll", "icoll"):
+                continue
+            if op.wire is None:
+                continue
+            # only the compression gate's eligible steps (f32 SUM
+            # reductions — the T4J009 contract) carry a wire mode
+            if op.redop != "sum" or op.dtype != "float32":
+                continue
+            by_comm.setdefault(op.comm, {}).setdefault(
+                op.rank, set()).add((str(op.wire), op.src_info))
+    for comm, per_rank in sorted(by_comm.items()):
+        modes = {}
+        for rank, pairs in per_rank.items():
+            for mode, _src in pairs:
+                modes.setdefault(mode, []).append(rank)
+        if len(modes) <= 1:
+            continue
+        sides = "; ".join(
+            f"rank{'s' if len(rs) > 1 else ''} "
+            f"{','.join(str(x) for x in sorted(set(rs)))}: wire={m}"
+            for m, rs in sorted(modes.items())
+        )
+        anchor = ""
+        for pairs in per_rank.values():
+            for _m, src in pairs:
+                if src:
+                    anchor = src
+                    break
+            if anchor:
+                break
+        findings.append(Finding(
+            rule="T4J014",
+            message=(
+                f"cross-rank wire-dtype mix on comm {comm}: {sides}. "
+                "Compression eligibility is a wire framing contract — "
+                "mixed modes corrupt the reduction mid-ring. Set "
+                "T4J_WIRE_DTYPE identically on every rank (or let the "
+                "tuning broadcast decide)."
+            ),
+            src_info=anchor,
+        ))
+    return findings

@@ -90,7 +90,10 @@ def verify_comm(fn, *, mode=None, world=None):
 
 
 def _verify_once(fn, args, kwargs, mode, world):
-    from mpi4jax_tpu.analysis.contracts import check_schedule
+    from mpi4jax_tpu.analysis.contracts import (
+        check_schedule,
+        dedupe_findings,
+    )
     from mpi4jax_tpu.analysis.jaxpr_walk import walk_comm_jaxpr
     from mpi4jax_tpu.utils import config
 
@@ -109,6 +112,10 @@ def _verify_once(fn, args, kwargs, mode, world):
         if extraction.closed_jaxpr is not None:
             _, jaxpr_findings = walk_comm_jaxpr(extraction.closed_jaxpr)
             findings += jaxpr_findings
+    # composite ops (gather -> allgather) can double-report one user
+    # call site when an inner op slips the reentrancy guard; static
+    # rules fire per event, so the same anchor would repeat
+    findings = dedupe_findings(findings)
 
     # ALWAYS participate in the exchange, findings or not: the exchange
     # is a collective, and a rank that silently sat out because of a
@@ -120,6 +127,9 @@ def _verify_once(fn, args, kwargs, mode, world):
     peers = _fp.exchange_and_check(
         extraction.events, world=world,
         local_findings=[f.rule for f in findings],
+        # full mode ships the @sched event export so agreement gets
+        # checked by the cross-rank simulator too (T4J010/011/013/014)
+        simulate=(mode == "full"),
     )
     return Report(
         findings, extraction.events, extraction.notes, peers_checked=peers

@@ -34,6 +34,7 @@ repair per rank — the acceptance demo uses exactly that to attribute
 a delayed rank (docs/serving.md "diagnosing a p99 blowup").
 """
 
+import os
 import time
 
 import numpy as np
@@ -170,7 +171,7 @@ class ServingEngine:
     def __init__(self, comm, cfg, params, *, max_len, max_batch=None,
                  admit=None, slo_ms=None, rate_limit=0.0, burst=8,
                  overlap=True, markers=True, seed_step_ms=20.0,
-                 fabric_poll_s=0.5, estimator=None):
+                 fabric_poll_s=0.5, estimator=None, plan_log=None):
         self.comm = comm
         self.cfg = cfg
         self.tp = comm.size
@@ -223,6 +224,13 @@ class ServingEngine:
                 admit_mode=self.admit_mode,
             )
             self.mirror = FollowerMirror(self.max_batch, self.max_len)
+
+        # leader-side plan-stream recorder: every broadcast vector is
+        # appended so follower-drift bugs replay offline through
+        # ``t4j-verify --plan-stream`` (serving/plan.py replay_stream)
+        if plan_log is None:
+            plan_log = os.environ.get("T4J_PLAN_LOG") or None
+        self.plan_log = plan_log if self.is_leader else None
 
         self._plan_words = plan_mod.plan_words(self.max_batch,
                                                self.max_len)
@@ -422,6 +430,11 @@ class ServingEngine:
         vec = plan_mod.encode_plan(
             plan, self.max_batch, self.max_len, digest, stop=stop
         )
+        if self.plan_log:
+            plan_mod.append_plan_stream(
+                self.plan_log, vec, self.max_batch, self.max_len,
+                world=self.tp,
+            )
         self._bcast(vec)
         admissions = [
             (slot, req.rid, req.prompt, req.max_new)

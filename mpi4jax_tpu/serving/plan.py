@@ -26,10 +26,14 @@ naming the step instead of decoding garbage with a straight face
 (the analysis subsystem's fingerprint philosophy).
 """
 
+import json
+
 from .request import Request
 
-__all__ = ["MAGIC", "PlanError", "decode_plan", "encode_plan",
-           "follower_request", "plan_words"]
+__all__ = ["MAGIC", "PlanError", "append_plan_stream", "decode_plan",
+           "encode_plan", "follower_request", "load_plan_stream",
+           "plan_stream_schedule", "plan_words", "replay_stream",
+           "save_plan_stream"]
 
 MAGIC = 0x74346A53  # "t4jS"
 
@@ -157,3 +161,166 @@ def follower_request(rid, prompt_tokens, max_new, arrival_ms=0.0,
     follower doesn't need; it defaults inert)."""
     return Request(rid, prompt_tokens, max_new, arrival_ms,
                    deadline_ms)
+
+
+# ---------------------------------------------------------- plan streams
+#
+# A recorded plan stream makes follower-drift bugs reproducible offline:
+# the engine's leader appends every broadcast vector to a jsonl file
+# (``ServingEngine(plan_log=...)`` / ``T4J_PLAN_LOG``), and
+# ``t4j-verify --plan-stream`` replays it through a fresh
+# :class:`~.scheduler.FollowerMirror` — exactly the code path a live
+# follower runs — so a digest mismatch reproduces on a laptop with no
+# cluster, no model, and no jax.
+
+_STREAM_FORMAT = "t4j-plan-stream-v1"
+
+
+def save_plan_stream(path, vecs, max_batch, p_max, world=None):
+    """Write a full plan stream: one header line + one line per step."""
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "format": _STREAM_FORMAT, "max_batch": int(max_batch),
+            "p_max": int(p_max), "world": world,
+        }) + "\n")
+        for vec in vecs:
+            fh.write(json.dumps({"vec": [int(v) for v in vec]}) + "\n")
+
+
+def append_plan_stream(path, vec, max_batch, p_max, world=None):
+    """Append one step's vector, writing the header first when the file
+    is new/empty (the engine calls this once per ``_leader_step``)."""
+    import os
+
+    need_header = not os.path.exists(path) or os.path.getsize(path) == 0
+    with open(path, "a") as fh:
+        if need_header:
+            fh.write(json.dumps({
+                "format": _STREAM_FORMAT, "max_batch": int(max_batch),
+                "p_max": int(p_max), "world": world,
+            }) + "\n")
+        fh.write(json.dumps({"vec": [int(v) for v in vec]}) + "\n")
+
+
+def load_plan_stream(path):
+    """Read a stream back as ``(meta, [vec, ...])``; raises
+    :class:`PlanError` on a malformed file."""
+    meta = None
+    vecs = []
+    with open(path) as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError as exc:
+                raise PlanError(f"{path}:{ln}: not JSON: {exc}")
+            if meta is None:
+                if doc.get("format") != _STREAM_FORMAT:
+                    raise PlanError(
+                        f"{path}: not a {_STREAM_FORMAT} stream "
+                        f"(format={doc.get('format')!r})"
+                    )
+                meta = doc
+            else:
+                vec = doc.get("vec")
+                if not isinstance(vec, list):
+                    raise PlanError(f"{path}:{ln}: missing 'vec' list")
+                vecs.append(vec)
+    if meta is None:
+        raise PlanError(f"{path}: empty plan stream")
+    return meta, vecs
+
+
+def replay_stream(meta, vecs, source="<plan-stream>"):
+    """Replay a recorded stream through a fresh follower mirror.
+
+    Runs the literal follower code path (``decode_plan`` with the
+    mirror's own digest as ``expect_digest``, then
+    :meth:`FollowerMirror.apply`), so any drift a live follower would
+    hit reproduces here.  Returns a list of
+    :class:`~mpi4jax_tpu.analysis.contracts.Finding` — drift maps to
+    rule T4J007 (cross-rank schedule divergence: the leader's plan and
+    the follower's mirrored state ARE the two diverging schedules).
+    """
+    from mpi4jax_tpu.analysis.contracts import Finding
+
+    from .scheduler import FollowerMirror, SchedulerError
+
+    max_batch = int(meta["max_batch"])
+    p_max = int(meta["p_max"])
+    mirror = FollowerMirror(max_batch, p_max)
+    findings = []
+    for i, vec in enumerate(vecs):
+        anchor = f"{source}:step {i}"
+        try:
+            decoded = decode_plan(
+                vec, max_batch, p_max,
+                expect_digest=mirror.state_digest(),
+            )
+        except PlanError as exc:
+            findings.append(Finding(
+                rule="T4J007",
+                message=(
+                    f"plan-stream replay: follower mirror rejects the "
+                    f"leader's plan at stream entry {i}: {exc}"
+                ),
+                src_info=anchor,
+            ))
+            break
+        try:
+            admitted, _finished = mirror.apply(decoded)
+            for slot, _rid, _prompt, _max_new in admitted:
+                mirror.prefill_done(slot)
+        except SchedulerError as exc:
+            findings.append(Finding(
+                rule="T4J007",
+                message=(
+                    f"plan-stream replay: mirrored scheduler state "
+                    f"diverged applying stream entry {i} "
+                    f"(plan step {decoded['step']}): {exc}"
+                ),
+                src_info=anchor,
+            ))
+            break
+        if decoded["stop"]:
+            break
+    return findings
+
+
+def plan_stream_schedule(meta, vecs, source="<plan-stream>"):
+    """Synthesize per-rank simulator schedules from a recorded stream.
+
+    Every step plan is one ``host_bcast`` of the fixed-size vector from
+    rank 0 — a root collective on the serving control comm, identical
+    on every rank.  Feeding these through the analysis simulator
+    (``t4j-verify --plan-stream``) checks the *transport* shape of the
+    control plane — a world-size disagreement or a truncated stream on
+    one rank shows up as a collective-slot mismatch — complementing
+    :func:`replay_stream`'s state-level drift check.  Returns
+    ``[rank0_events, rank1_events, ...]`` as plain dicts.
+    """
+    world = int(meta.get("world") or 2)
+    max_batch = int(meta["max_batch"])
+    p_max = int(meta["p_max"])
+    words = plan_words(max_batch, p_max)
+    schedules = []
+    for rank in range(world):
+        events = []
+        for i, _vec in enumerate(vecs):
+            events.append({
+                "kind": "bcast",
+                "comm_key": "serving-ctrl",
+                "comm_size": world,
+                "comm_ranks": list(range(world)),
+                "dtype": "int64",
+                "shape": [words],
+                "reduce_op": "",
+                "root": 0,
+                "rank": rank,
+                "tag": None,
+                "src_info": f"{source}:step {i}",
+            })
+        schedules.append(events)
+    return schedules

@@ -37,6 +37,45 @@ def load_standalone(dotted, relpath):
         return mod
 
 
+def load_analysis(submodule):
+    """Import ``mpi4jax_tpu.analysis.<submodule>`` on any container."""
+    return load_pkg_module(f"mpi4jax_tpu.analysis.{submodule}")
+
+
+def load_pkg_module(dotted):
+    """Import a jax-free package submodule on any container.
+
+    Unlike :func:`load_standalone`, this works for modules with
+    package-internal imports (simulate.py imports contracts, cli.py
+    imports record/simulate, serving/plan.py imports the scheduler):
+    on old-jax containers a stub parent package bypasses only the
+    top-level ``__init__`` version gate — the analysis and serving
+    subpackages' module-scope chains are jax-free by design.
+    """
+    if dotted in sys.modules:
+        return sys.modules[dotted]
+    try:
+        return importlib.import_module(dotted)
+    except Exception:
+        import types
+
+        installed = False
+        if "mpi4jax_tpu" not in sys.modules:
+            pkg = types.ModuleType("mpi4jax_tpu")
+            pkg.__path__ = [str(REPO / "mpi4jax_tpu")]
+            sys.modules["mpi4jax_tpu"] = pkg
+            installed = True
+        try:
+            return importlib.import_module(dotted)
+        finally:
+            # drop the stub parent so other tests' `import mpi4jax_tpu`
+            # still raises the version-gate error they expect; the
+            # loaded submodules stay cached in sys.modules, so repeated
+            # load_analysis calls share module identity
+            if installed:
+                sys.modules.pop("mpi4jax_tpu", None)
+
+
 @pytest.fixture(scope="session")
 def contracts():
     return load_standalone(

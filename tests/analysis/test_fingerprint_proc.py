@@ -175,3 +175,101 @@ def test_agreeing_schedule_passes(tmp_path):
         marker = _marker(out)
         assert rc == NO_RAISE, (rank, rc, out, err)
         assert "peers=2" in marker, marker
+
+
+P2P_WORKER = """
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.analysis import CommContractError, verify_comm
+from mpi4jax_tpu.native import runtime
+
+runtime.ensure_initialized()
+comm = m.get_default_comm()
+rank = comm.rank()
+peer = 1 - rank
+
+
+def deadlock_step(x):
+    # agrees step for step on both ranks -- the per-comm diff passes --
+    # yet forms a rendezvous send/send cycle (128 KiB payloads are over
+    # the eager threshold); only the @sched simulator rung catches it
+    tok = m.create_token()
+    tok = m.send(x, peer, comm=comm, token=tok)
+    y, tok = m.recv(x, peer, comm=comm, token=tok)
+    return y
+
+
+def clean_step(x):
+    # canonical correct p2p: per-rank ASYMMETRIC ordering, which the
+    # lockstep diff must not flag (p2p is envelope-matched, not
+    # positional)
+    tok = m.create_token()
+    if rank == 0:
+        tok = m.send(x, peer, comm=comm, token=tok)
+        y, tok = m.recv(x, peer, comm=comm, token=tok)
+    else:
+        y, tok = m.recv(x, peer, comm=comm, token=tok)
+        tok = m.send(x, peer, comm=comm, token=tok)
+    return y
+
+
+step = deadlock_step if os.environ["SCENARIO"] == "deadlock" \\
+    else clean_step
+x = jnp.ones(32768, jnp.float32)
+t0 = time.monotonic()
+try:
+    report = verify_comm(step)(x)
+    print(f"T4JMARK ok peers={report.peers_checked} "
+          f"elapsed={time.monotonic() - t0:.3f}", flush=True)
+    sys.exit(3)
+except CommContractError as e:
+    flat = str(e).replace(chr(10), " | ")  # one marker line
+    print(f"T4JMARK raised elapsed={time.monotonic() - t0:.3f} "
+          f"msg={flat}", flush=True)
+    sys.exit(23)
+"""
+
+
+def test_agreeing_deadlock_caught_by_simulator(tmp_path):
+    # ISSUE 19 tentpole, end to end: the schedules AGREE per comm, so
+    # the PR-4 diff alone would execute straight into a cross-rank
+    # deadlock; the @sched simulator rung raises T4J010 on every rank
+    # naming the cycle, still far inside the op deadline
+    results = _spawn(
+        tmp_path, P2P_WORKER, 2,
+        {
+            "SCENARIO": "deadlock",
+            "T4J_OP_TIMEOUT": str(OP_TIMEOUT),
+        },
+    )
+    for rank, (rc, out, err) in enumerate(results):
+        marker = _marker(out)
+        assert rc == RAISED, (rank, rc, out, err)
+        assert "T4J010" in marker, marker
+        assert "wait-for cycle" in marker, marker
+        assert "rank 0" in marker and "rank 1" in marker, marker
+        assert _elapsed(marker) < OP_TIMEOUT / 5, marker
+
+
+def test_asymmetric_p2p_ordering_passes(tmp_path):
+    # the same ops in the only CORRECT ordering must verify clean:
+    # per-rank p2p asymmetry is the norm, not divergence
+    results = _spawn(
+        tmp_path, P2P_WORKER, 2,
+        {
+            "SCENARIO": "clean",
+            "T4J_OP_TIMEOUT": str(OP_TIMEOUT),
+        },
+    )
+    for rank, (rc, out, err) in enumerate(results):
+        marker = _marker(out)
+        assert rc == NO_RAISE, (rank, rc, out, err)
+        assert "peers=2" in marker, marker

@@ -1,0 +1,223 @@
+"""Rule-core tests for the jaxpr walker's control-flow recursion
+(mpi4jax_tpu/analysis/jaxpr_walk.py).
+
+The walker duck-types every jaxpr attribute it touches, so these tests
+drive it with hand-built fake eqns/jaxprs — no tracing, no jax — and
+run on every container.  The headline case is the ISSUE-19 satellite:
+a collective under a rank-dependent ``cond`` INSIDE ``shard_map`` must
+still raise T4J005, which requires taint to flow positionally through
+the shard_map call boundary (and lifted leading constants to stay
+untainted so plain-data conds inside shard_map don't false-positive).
+"""
+
+import pytest
+
+from tests.analysis.conftest import load_analysis
+
+
+@pytest.fixture(scope="module")
+def jw():
+    return load_analysis("jaxpr_walk")
+
+
+class Var:
+    """Fake jaxpr Var: identity-hashed, no ``.val`` (not a Literal)."""
+
+    def __init__(self, name):
+        self.aval = f"f32[8]<{name}>"
+
+    def __repr__(self):
+        return self.aval
+
+
+class Prim:
+    def __init__(self, name):
+        self.name = name
+
+
+class SourceInfo:
+    def __init__(self, name_stack=""):
+        self.name_stack = name_stack
+
+
+class Eqn:
+    def __init__(self, prim, invars=(), outvars=(), params=None,
+                 name_stack=""):
+        self.primitive = Prim(prim)
+        self.invars = list(invars)
+        self.outvars = list(outvars)
+        self.params = dict(params or {})
+        self.source_info = SourceInfo(name_stack)
+
+
+class Jaxpr:
+    def __init__(self, invars=(), eqns=()):
+        self.invars = list(invars)
+        self.eqns = list(eqns)
+
+
+class Closed:
+    """Wrapper mimicking ClosedJaxpr / pjit's params['jaxpr']."""
+
+    def __init__(self, jaxpr):
+        self.jaxpr = jaxpr
+
+
+def comm_eqn(op, invars=(), outvars=()):
+    return Eqn("psum", invars, outvars,
+               name_stack=f"transpose/mpi4jax_tpu.{op}")
+
+
+def divergent_cond(pred, operand):
+    """cond whose branches issue different collective schedules."""
+    bx = Var("bx")
+    br0 = Jaxpr(invars=[bx], eqns=[comm_eqn("allreduce", [bx], [Var("o")])])
+    br1 = Jaxpr(invars=[Var("by")], eqns=[])
+    return Eqn("cond", invars=[pred, operand],
+               params={"branches": (Closed(br0), Closed(br1))})
+
+
+def test_t4j005_direct_rank_cond(jw):
+    r = Var("rank")
+    top = Jaxpr(
+        invars=[],
+        eqns=[Eqn("axis_index", outvars=[r]),
+              divergent_cond(r, Var("x"))],
+    )
+    occs, findings = jw.walk_comm_jaxpr(top)
+    assert [f.rule for f in findings] == ["T4J005"]
+    assert "different communication schedules" in findings[0].message
+    assert [o.op for o in occs] == ["allreduce"]
+    assert occs[0].path == ("cond[0]",)
+
+
+def test_t4j005_inside_shard_map(jw):
+    # axis_index OUTSIDE, taint carried through the shard_map operand
+    # into a divergent cond in the body
+    r = Var("rank")
+    body_in = Var("body_in")
+    body = Jaxpr(invars=[body_in],
+                 eqns=[divergent_cond(body_in, Var("x"))])
+    top = Jaxpr(
+        invars=[],
+        eqns=[
+            Eqn("axis_index", outvars=[r]),
+            Eqn("shard_map", invars=[r], outvars=[Var("out")],
+                params={"jaxpr": Closed(body)}),
+        ],
+    )
+    occs, findings = jw.walk_comm_jaxpr(top)
+    assert [f.rule for f in findings] == ["T4J005"]
+    assert occs[0].path == ("shard_map", "cond[0]")
+
+
+def test_t4j005_axis_index_inside_shard_map_body(jw):
+    # the other route: axis_index seeded inside the body itself
+    r = Var("rank_in_body")
+    body = Jaxpr(invars=[], eqns=[
+        Eqn("axis_index", outvars=[r]),
+        divergent_cond(r, Var("x")),
+    ])
+    top = Jaxpr(invars=[], eqns=[
+        Eqn("shard_map", params={"jaxpr": Closed(body)}),
+    ])
+    _occs, findings = jw.walk_comm_jaxpr(top)
+    assert [f.rule for f in findings] == ["T4J005"]
+
+
+def test_no_false_positive_plain_data_cond_inside_shard_map(jw):
+    # axis_index used elsewhere in the program, but the shard_map
+    # operand feeding the cond is PLAIN data: positional mapping must
+    # keep it untainted (the conservative pre-fix walker flagged this)
+    r = Var("rank")
+    data = Var("data")
+    body_in = Var("body_in")
+    body = Jaxpr(invars=[body_in],
+                 eqns=[divergent_cond(body_in, Var("x"))])
+    top = Jaxpr(
+        invars=[data],
+        eqns=[
+            Eqn("axis_index", outvars=[r]),
+            Eqn("mul", invars=[r], outvars=[Var("scaled")]),
+            Eqn("shard_map", invars=[data], outvars=[Var("out")],
+                params={"jaxpr": Closed(body)}),
+        ],
+    )
+    _occs, findings = jw.walk_comm_jaxpr(top)
+    assert findings == []
+
+
+def test_tail_alignment_skips_lifted_constants(jw):
+    # shard_map bodies may curry lifted constants in FRONT of the real
+    # operands: with outer invars [tainted], body invars [const, x],
+    # tail alignment taints x and leaves const clean
+    r = Var("rank")
+    const = Var("lifted_const")
+    x = Var("x")
+    body = Jaxpr(invars=[const, x], eqns=[divergent_cond(x, const)])
+    top = Jaxpr(invars=[], eqns=[
+        Eqn("axis_index", outvars=[r]),
+        Eqn("shard_map", invars=[r], params={"jaxpr": Closed(body)}),
+    ])
+    _occs, findings = jw.walk_comm_jaxpr(top)
+    assert [f.rule for f in findings] == ["T4J005"]
+    # and the mirror case: cond on the CONSTANT stays clean
+    body2 = Jaxpr(invars=[const, x], eqns=[divergent_cond(const, x)])
+    top2 = Jaxpr(invars=[], eqns=[
+        Eqn("axis_index", outvars=[r]),
+        Eqn("shard_map", invars=[r], params={"jaxpr": Closed(body2)}),
+    ])
+    _occs, findings2 = jw.walk_comm_jaxpr(top2)
+    assert findings2 == []
+
+
+def test_uniform_branches_clean(jw):
+    # rank-dependent cond whose branches communicate IDENTICALLY is
+    # legal (halo-edge masking)
+    r = Var("rank")
+    def branch():
+        bx = Var("bx")
+        return Closed(Jaxpr(
+            invars=[bx],
+            eqns=[comm_eqn("allreduce", [bx], [Var("o")])],
+        ))
+    cond = Eqn("cond", invars=[r, Var("x")],
+               params={"branches": (branch(), branch())})
+    top = Jaxpr(invars=[], eqns=[
+        Eqn("axis_index", outvars=[r]), cond,
+    ])
+    occs, findings = jw.walk_comm_jaxpr(top)
+    assert findings == []
+    assert len(occs) == 2  # both branch occurrences still reported
+
+
+def test_scan_stays_conservative(jw):
+    # non-positional primitives (scan reorders operands into carries)
+    # keep the conservative all-invars taint
+    r = Var("rank")
+    body_in = Var("carry")
+    body = Jaxpr(invars=[body_in],
+                 eqns=[divergent_cond(body_in, Var("x"))])
+    top = Jaxpr(invars=[], eqns=[
+        Eqn("axis_index", outvars=[r]),
+        Eqn("scan", invars=[r], params={"jaxpr": Closed(body)}),
+    ])
+    _occs, findings = jw.walk_comm_jaxpr(top)
+    assert [f.rule for f in findings] == ["T4J005"]
+
+
+def test_adjacent_eqn_collapse(jw):
+    # several lowered eqns under one scope+callsite collapse to one
+    # occurrence with n_eqns counting the run
+    x = Var("x")
+    top = Jaxpr(invars=[x], eqns=[
+        comm_eqn("allreduce", [x], [Var("a")]),
+        comm_eqn("allreduce", [Var("a")], [Var("b")]),
+        Eqn("mul", invars=[Var("b")], outvars=[Var("c")]),
+        comm_eqn("bcast", [Var("c")], [Var("d")]),
+    ])
+    occs, findings = jw.walk_comm_jaxpr(top)
+    assert findings == []
+    assert [(o.op, o.n_eqns) for o in occs] == [
+        ("allreduce", 2), ("bcast", 1),
+    ]

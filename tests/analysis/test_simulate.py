@@ -1,0 +1,759 @@
+"""Pure-core matrix for the cross-rank schedule simulator
+(mpi4jax_tpu/analysis/simulate.py, rules T4J010–T4J014).
+
+Everything here runs WITHOUT jax — events are the plain dicts
+``record.dump_schedule`` exports — so the matrix runs on every
+container, including old-jax ones where the package itself cannot
+import (the ISSUE-19 acceptance gate).  Seeded-hazard cases pin each
+rule's detection AND the named ranks/ops in the message; the clean
+half (ring / halo / hier / bucketed-overlap shapes, the repo's real
+communication patterns) pins zero false positives.
+"""
+
+import contextlib
+import json
+import sys
+import types
+
+import pytest
+
+from tests.analysis.conftest import REPO, load_analysis, load_pkg_module
+
+
+@contextlib.contextmanager
+def _pkg_stub():
+    """Parent-package stub for code under test that lazily imports
+    ``mpi4jax_tpu.*`` at call time (cli.verify_main's --traces /
+    --plan-stream paths) — the same dance tests/test_serving.py does,
+    scoped to the call."""
+    stubbed = "mpi4jax_tpu" not in sys.modules
+    if stubbed:
+        pkg = types.ModuleType("mpi4jax_tpu")
+        pkg.__path__ = [str(REPO / "mpi4jax_tpu")]
+        sys.modules["mpi4jax_tpu"] = pkg
+    try:
+        yield
+    finally:
+        if stubbed:
+            sys.modules.pop("mpi4jax_tpu", None)
+
+
+@pytest.fixture(scope="module")
+def sim():
+    return load_analysis("simulate")
+
+
+@pytest.fixture(scope="module")
+def cli():
+    return load_analysis("cli")
+
+
+@pytest.fixture(scope="module")
+def record_mod():
+    return load_analysis("record")
+
+
+@pytest.fixture(scope="module")
+def plan_mod():
+    return load_pkg_module("mpi4jax_tpu.serving.plan")
+
+
+# 128 KiB f32 payload: over the eager threshold, so sends rendezvous
+BIG = (32768,)
+SMALL = (8,)
+
+
+def ev(kind, rank, **kw):
+    base = dict(
+        kind=kind, rank=rank, comm_key="world", comm_size=2,
+        comm_ranks=None, dest=None, source=None, tag=0,
+        dtype="float32", shape=BIG, reduce_op="", request_out=None,
+        requests_in=[], src_info=f"prog.py:{kw.pop('line', 1)}",
+        wire=None,
+    )
+    base.update(kw)
+    return base
+
+
+def rules(result):
+    return [f.rule for f in result.findings]
+
+
+# ------------------------------------------------------- T4J010 deadlock
+
+
+def test_sendsend_cycle_deadlock(sim):
+    s0 = [ev("send", 0, dest=1, line=3), ev("recv", 0, source=1, line=4)]
+    s1 = [ev("send", 1, dest=0, line=3), ev("recv", 1, source=0, line=4)]
+    r = sim.simulate([s0, s1])
+    assert "T4J010" in rules(r)
+
+
+def test_sendsend_cycle_names_ranks_and_anchor(sim):
+    s0 = [ev("send", 0, dest=1, line=7), ev("recv", 0, source=1)]
+    s1 = [ev("send", 1, dest=0, line=7), ev("recv", 1, source=0)]
+    r = sim.simulate([s0, s1])
+    f = next(f for f in r.findings if f.rule == "T4J010")
+    assert "rank 0" in f.message and "rank 1" in f.message
+    assert "wait-for cycle" in f.message
+    assert "prog.py:7" in f.message  # each edge carries its anchor
+    assert f.src_info  # finding-level anchor too
+
+
+def test_eager_sendsend_clean(sim):
+    # identical shape but under the eager threshold: both sends buffer
+    s0 = [ev("send", 0, dest=1, shape=SMALL), ev("recv", 0, source=1, shape=SMALL)]
+    s1 = [ev("send", 1, dest=0, shape=SMALL), ev("recv", 1, source=0, shape=SMALL)]
+    r = sim.simulate([s0, s1])
+    assert r.ok, r.findings
+
+
+def test_eager_threshold_boundary(sim):
+    # exactly eager_bytes completes eagerly; one element more blocks
+    at = [ev("send", 0, dest=1, shape=(16384,)), ev("recv", 0, source=1, shape=(16384,))]
+    at2 = [ev("send", 1, dest=0, shape=(16384,)), ev("recv", 1, source=0, shape=(16384,))]
+    assert sim.simulate([at, at2], eager_bytes=65536).ok
+    over = [ev("send", 0, dest=1, shape=(16385,)), ev("recv", 0, source=1, shape=(16385,))]
+    over2 = [ev("send", 1, dest=0, shape=(16385,)), ev("recv", 1, source=0, shape=(16385,))]
+    assert "T4J010" in rules(sim.simulate([over, over2], eager_bytes=65536))
+
+
+def test_three_rank_recv_cycle(sim):
+    # every rank receives from the next before sending: classic cycle
+    n = 3
+    scheds = []
+    for i in range(n):
+        scheds.append([
+            ev("recv", i, comm_size=n, source=(i + 1) % n, line=10),
+            ev("send", i, comm_size=n, dest=(i + 1) % n, line=11),
+        ])
+    r = sim.simulate(scheds)
+    f = next(f for f in r.findings if f.rule == "T4J010")
+    assert "length 3" in f.message
+
+
+def test_wait_on_unmatched_isend_deadlock(sim):
+    # isend posts fine, but the wait blocks forever: peer never recvs
+    s0 = [ev("isend", 0, dest=1, request_out=11, line=2),
+          ev("wait", 0, requests_in=[11], dtype="", shape=(), line=3)]
+    s1 = [ev("barrier", 1, dtype="", shape=())]
+    r = sim.simulate([s0, s1])
+    # orphan pre-pass catches the never-received send
+    assert "T4J012" in rules(r)
+
+
+# --------------------------------------------- T4J011 wildcard nondeterminism
+
+
+def test_wildcard_race_two_senders(sim):
+    s0 = [ev("recv", 0, comm_size=3, source="ANY", tag=None, line=5),
+          ev("recv", 0, comm_size=3, source="ANY", tag=None, line=6)]
+    s1 = [ev("send", 1, comm_size=3, dest=0, shape=SMALL, line=9)]
+    s2 = [ev("send", 2, comm_size=3, dest=0, shape=SMALL, line=9)]
+    r = sim.simulate([s0, s1, s2])
+    f = next(f for f in r.findings if f.rule == "T4J011")
+    assert "1" in f.message and "2" in f.message  # racing senders named
+    assert len(r.outcomes) == 2
+
+
+def test_wildcard_single_sender_clean(sim):
+    s0 = [ev("recv", 0, source="ANY", tag=None)]
+    s1 = [ev("send", 1, dest=0, shape=SMALL)]
+    r = sim.simulate([s0, s1])
+    assert r.ok, r.findings
+    assert len(r.outcomes) == 1
+
+
+def test_wildcard_any_tag_race(sim):
+    # same source rank is NOT a race (non-overtaking pins the order);
+    # two different senders with distinct tags are
+    s0 = [ev("recv", 0, comm_size=3, source="ANY", tag=None),
+          ev("recv", 0, comm_size=3, source="ANY", tag=None)]
+    s1 = [ev("send", 1, comm_size=3, dest=0, tag=7, shape=SMALL)]
+    s2 = [ev("send", 2, comm_size=3, dest=0, tag=8, shape=SMALL)]
+    r = sim.simulate([s0, s1, s2])
+    assert "T4J011" in rules(r)
+
+
+def test_same_sender_non_overtaking_no_race(sim):
+    # two sends from ONE sender to a wildcard receiver: posted order
+    # pins the match; no nondeterminism
+    s0 = [ev("recv", 0, source="ANY", tag=None),
+          ev("recv", 0, source="ANY", tag=None)]
+    s1 = [ev("send", 1, dest=0, shape=SMALL),
+          ev("send", 1, dest=0, shape=SMALL)]
+    r = sim.simulate([s0, s1])
+    assert r.ok, r.findings
+    assert len(r.outcomes) == 1
+
+
+# ------------------------------------------------------- T4J012 orphans
+
+
+def test_orphan_send(sim):
+    s0 = [ev("send", 0, dest=1, shape=SMALL, line=12)]
+    s1 = [ev("barrier", 1, dtype="", shape=())]
+    r = sim.simulate([s0, s1])
+    f = next(f for f in r.findings if f.rule == "T4J012")
+    assert "orphan send" in f.message and "rank 0" in f.message
+    assert "prog.py:12" in f.message
+
+
+def test_orphan_recv(sim):
+    s0 = [ev("recv", 0, source=1, line=20)]
+    s1 = []
+    r = sim.simulate([s0, s1])
+    f = next(f for f in r.findings if f.rule == "T4J012")
+    assert "orphan recv" in f.message
+
+
+def test_orphan_tag_mismatch(sim):
+    s0 = [ev("send", 0, dest=1, tag=1, shape=SMALL)]
+    s1 = [ev("recv", 1, source=0, tag=2)]
+    r = sim.simulate([s0, s1])
+    assert "T4J012" in rules(r)
+
+
+def test_orphans_disabled_for_exchange_path(sim):
+    s0 = [ev("send", 0, dest=1, shape=SMALL)]
+    s1 = []
+    r = sim.simulate([s0, s1], orphans=False)
+    assert "T4J012" not in rules(r)
+
+
+# ------------------------------------- T4J013 collective ordering inversion
+
+
+def test_two_collective_inversion(sim):
+    s0 = [ev("allreduce", 0, reduce_op="sum", line=1),
+          ev("bcast", 0, root=0, line=2)]
+    s1 = [ev("bcast", 1, root=0, line=2),
+          ev("allreduce", 1, reduce_op="sum", line=1)]
+    r = sim.simulate([s0, s1])
+    f = next(f for f in r.findings if f.rule == "T4J013")
+    assert "allreduce" in f.message and "bcast" in f.message
+
+
+def test_collective_vs_p2p_inversion(sim):
+    # rank 0: rendezvous send then barrier; rank 1: barrier then recv
+    s0 = [ev("send", 0, dest=1, line=3), ev("barrier", 0, dtype="", shape=(), line=4)]
+    s1 = [ev("barrier", 1, dtype="", shape=(), line=4), ev("recv", 1, source=0, line=5)]
+    r = sim.simulate([s0, s1])
+    assert "T4J013" in rules(r)
+    f = next(f for f in r.findings if f.rule == "T4J013")
+    assert "barrier" in f.message
+
+
+def test_collective_count_mismatch(sim):
+    # rank 1 issues one fewer collective: rank 0 waits forever
+    s0 = [ev("allreduce", 0, reduce_op="sum"),
+          ev("allreduce", 0, reduce_op="sum")]
+    s1 = [ev("allreduce", 1, reduce_op="sum")]
+    r = sim.simulate([s0, s1])
+    assert not r.ok
+    assert any(f.rule in ("T4J012", "T4J013") for f in r.findings)
+
+
+def test_clean_collective_sequence(sim):
+    seq = [("allreduce", "sum"), ("bcast", ""), ("barrier", "")]
+    scheds = []
+    for rank in range(2):
+        scheds.append([
+            ev(k, rank, reduce_op=op, dtype="" if k == "barrier" else "float32",
+               shape=() if k == "barrier" else BIG)
+            for k, op in seq
+        ])
+    r = sim.simulate(scheds)
+    assert r.ok, r.findings
+
+
+# ---------------------------------------------- T4J014 wire-dtype mix
+
+
+def test_wire_mix(sim):
+    s0 = [ev("allreduce", 0, reduce_op="sum", wire="bf16", line=8)]
+    s1 = [ev("allreduce", 1, reduce_op="sum", wire="off", line=8)]
+    r = sim.simulate([s0, s1])
+    f = next(f for f in r.findings if f.rule == "T4J014")
+    assert "bf16" in f.message and "off" in f.message
+    assert "rank" in f.message
+
+
+def test_wire_agreeing_clean(sim):
+    s0 = [ev("allreduce", 0, reduce_op="sum", wire="fp8")]
+    s1 = [ev("allreduce", 1, reduce_op="sum", wire="fp8")]
+    assert sim.simulate([s0, s1]).ok
+
+
+def test_wire_mix_only_on_eligible_steps(sim):
+    # integer SUM never compresses: mixed wire fields are ignored
+    s0 = [ev("allreduce", 0, reduce_op="sum", dtype="int32", wire="bf16")]
+    s1 = [ev("allreduce", 1, reduce_op="sum", dtype="int32", wire="off")]
+    assert "T4J014" not in rules(sim.simulate([s0, s1]))
+
+
+# --------------------------------------------------- clean real-world shapes
+
+
+def test_clean_ring(sim):
+    n = 4
+    scheds = []
+    for i in range(n):
+        nxt, prv = (i + 1) % n, (i - 1) % n
+        if i == 0:
+            scheds.append([ev("send", i, comm_size=n, dest=nxt),
+                           ev("recv", i, comm_size=n, source=prv)])
+        else:
+            scheds.append([ev("recv", i, comm_size=n, source=prv),
+                           ev("send", i, comm_size=n, dest=nxt)])
+    assert sim.simulate(scheds).ok
+
+
+def test_clean_sendrecv_ring(sim):
+    n = 4
+    scheds = [[ev("sendrecv", i, comm_size=n, dest=(i + 1) % n,
+                  source=(i - 1) % n)] for i in range(n)]
+    assert sim.simulate(scheds).ok
+
+
+def test_clean_halo_line_proc_null(sim):
+    # non-periodic 1-D halo: edge ranks have a missing half (PROC_NULL)
+    n = 4
+    scheds = []
+    for i in range(n):
+        dst = i + 1 if i + 1 < n else None
+        src = i - 1 if i - 1 >= 0 else None
+        scheds.append([ev("sendrecv", i, comm_size=n, dest=dst, source=src),
+                       ev("sendrecv", i, comm_size=n, dest=src, source=dst)])
+    assert sim.simulate(scheds).ok
+
+
+def test_clean_hier_two_comms(sim):
+    # hierarchical reduction: intra-node comm then inter-node comm
+    scheds = []
+    for i in range(4):
+        node = i // 2
+        scheds.append([
+            ev("reduce_scatter", i, comm_key=f"intra{node}", comm_size=2,
+               comm_ranks=[2 * node, 2 * node + 1], reduce_op="sum"),
+            ev("allreduce", i, comm_key="inter", comm_size=4,
+               comm_ranks=[0, 1, 2, 3], reduce_op="sum"),
+            ev("allgather", i, comm_key=f"intra{node}", comm_size=2,
+               comm_ranks=[2 * node, 2 * node + 1]),
+        ])
+    assert sim.simulate(scheds).ok
+
+
+def test_clean_bucketed_overlap(sim):
+    # bucketed gradient overlap: a window of isend/irecv per bucket,
+    # waitall at the end — the repo's overlap pattern
+    n = 2
+    scheds = []
+    for i in range(n):
+        peer = 1 - i
+        ops = []
+        reqs = []
+        for b in range(3):
+            ops.append(ev("isend", i, dest=peer, tag=b, request_out=100 + b))
+            ops.append(ev("irecv", i, source=peer, tag=b, request_out=200 + b))
+            reqs += [100 + b, 200 + b]
+        ops.append(ev("waitall", i, requests_in=reqs, dtype="", shape=()))
+        scheds.append(ops)
+    assert sim.simulate(scheds).ok
+
+
+def test_clean_icollective_wait(sim):
+    scheds = []
+    for i in range(2):
+        scheds.append([
+            ev("iallreduce", i, reduce_op="sum", request_out=50),
+            ev("send", i, dest=1 - i, shape=SMALL),
+            ev("recv", i, source=1 - i, shape=SMALL),
+            ev("wait", i, requests_in=[50], dtype="", shape=()),
+        ])
+    assert sim.simulate(scheds).ok
+
+
+# ------------------------------------------------ engine behaviour & API
+
+
+def test_max_states_truncation_note(sim):
+    # enough wildcard branching to blow a tiny cap
+    n = 4
+    s0 = [ev("recv", 0, comm_size=n, source="ANY", tag=None)
+          for _ in range(3)]
+    senders = [[ev("send", i, comm_size=n, dest=0, shape=SMALL)]
+               for i in range(1, n)]
+    r = sim.simulate([s0] + senders, max_states=2)
+    assert r.truncated
+    assert any("max_states" in note for note in r.notes)
+
+
+def test_unknown_peer_note(sim):
+    s0 = [ev("send", 0, dest="callable", shape=SMALL)]
+    s1 = [ev("recv", 1, source="callable")]
+    r = sim.simulate([s0, s1])
+    assert r.ok
+    assert any("dynamic" in note for note in r.notes)
+
+
+def test_result_repr_and_ok(sim):
+    r = sim.simulate([[], []])
+    assert r.ok and "findings=0" in repr(r)
+
+
+def test_deadlock_findings_deduped_across_branches(sim):
+    # a wildcard fork upstream of one inevitable deadlock must not
+    # report the same cycle once per explored branch
+    s0 = [ev("recv", 0, comm_size=3, source="ANY", tag=None, shape=SMALL),
+          ev("send", 0, comm_size=3, dest=1, line=30),
+          ev("recv", 0, comm_size=3, source=1, line=31)]
+    s1 = [ev("send", 1, comm_size=3, dest=0, shape=SMALL),
+          ev("send", 1, comm_size=3, dest=0, line=30),
+          ev("recv", 1, comm_size=3, source=0, line=31)]
+    s2 = [ev("send", 2, comm_size=3, dest=0, shape=SMALL)]
+    r = sim.simulate([s0, s1, s2])
+    t10 = [f for f in r.findings if f.rule == "T4J010"]
+    assert len(t10) <= 1
+
+
+def test_specialize_spmd_ring_clean(sim):
+    pairs = [[i, (i + 1) % 4] for i in range(4)]
+    events = [ev("sendrecv", None, comm_size=4, dest=pairs, source=pairs)]
+    groups = sim.specialize_spmd(events)
+    assert len(groups) == 1
+    _comm, scheds = groups[0]
+    assert len(scheds) == 4
+    assert sim.simulate(scheds).ok
+
+
+def test_specialize_spmd_comm_groups(sim):
+    events = [
+        ev("allreduce", None, comm_key="rows", comm_size=2, reduce_op="sum"),
+        ev("allreduce", None, comm_key="cols", comm_size=4, reduce_op="sum"),
+        ev("barrier", None, comm_key="self", comm_size=1, dtype="", shape=()),
+    ]
+    groups = dict(sim.specialize_spmd(events))
+    assert set(groups) == {"rows", "cols"}  # size-1 comm dropped
+    assert len(groups["rows"]) == 2 and len(groups["cols"]) == 4
+    for scheds in groups.values():
+        assert sim.simulate(scheds).ok
+
+
+def test_schedule_from_events_pair_resolution(sim):
+    pairs = [[0, 1], [1, 0]]
+    ops = sim.schedule_from_events(
+        [ev("send", None, dest=pairs)], rank=0, world=2
+    )
+    assert ops[0].dest == 1
+    ops = sim.schedule_from_events(
+        [ev("recv", None, source=pairs)], rank=1, world=2
+    )
+    assert ops[0].source == 0
+
+
+def test_json_roundtripped_events_simulate(sim):
+    # exactly what --traces consumes: dicts through a JSON round-trip
+    s0 = [ev("send", 0, dest=1, line=3), ev("recv", 0, source=1)]
+    s1 = [ev("send", 1, dest=0, line=3), ev("recv", 1, source=0)]
+    s0 = json.loads(json.dumps(s0))
+    s1 = json.loads(json.dumps(s1))
+    assert "T4J010" in rules(sim.simulate([s0, s1]))
+
+
+# --------------------------------------------------- schedule export (PR-4)
+
+
+def test_dump_load_roundtrip(sim, record_mod, contracts, tmp_path):
+    cev = contracts.CommEvent(
+        seq=0, kind="allreduce", comm_key=("proc", 0), backend="proc",
+        comm_size=2, dtype="float32", shape=(64,), reduce_op="sum",
+        tag=None, source=None, dest=None, root=None, rank=0,
+        comm_ranks=(0, 1), token_in=1, token_out=2, pending_out=(),
+        src_info="user.py:9", scope=None, request_out=None,
+        requests_in=(),
+    )
+    path = tmp_path / "r0.json"
+    record_mod.dump_schedule([cev], path, rank=0)
+    rank, events = record_mod.load_schedule(path)
+    assert rank == 0 and len(events) == 1
+    e = events[0]
+    assert e["kind"] == "allreduce" and e["comm_ranks"] == [0, 1]
+    assert e["src_info"] == "user.py:9"
+    assert "token_in" not in e  # process-local identities dropped
+    assert "wire" in e  # f32 SUM step carries the rank's wire mode
+    # and the export drives the simulator directly
+    ops = sim.schedule_from_events(events)
+    assert ops[0].cat == "coll" and ops[0].members == (0, 1)
+
+
+def test_record_op_collapses_escaped_double_record(record_mod, monkeypatch):
+    # a composite op whose inner call escapes the depth guard produces
+    # two events with the SAME outgoing token and anchor; the hardening
+    # collapses the pair while keeping genuine repeats (fresh tokens)
+    class FakeEv:
+        def __init__(self, token_out, kind="allreduce",
+                     src_info="u.py:5"):
+            self.token_out = token_out
+            self.kind = kind
+            self.src_info = src_info
+
+    seq = iter([FakeEv(101), FakeEv(101), FakeEv(202)])
+    monkeypatch.setattr(
+        record_mod, "_build_event",
+        lambda scope, name, fn, args, kwargs, out: next(seq),
+    )
+    with record_mod.recording() as rec:
+        for _ in range(3):
+            record_mod.record_op("allreduce", None, (), {}, None)
+        events = rec.events
+    assert [e.token_out for e in events] == [101, 202]
+
+
+def test_load_schedule_rejects_bad_format(record_mod, tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text('{"format": "something-else", "events": []}')
+    with pytest.raises(ValueError):
+        record_mod.load_schedule(p)
+
+
+# ---------------------------------------------------------- finding dedupe
+
+
+def test_dedupe_findings_same_anchor(contracts):
+    f = contracts.Finding
+    fs = [
+        f(rule="T4J002", message="send at step 3 dropped", src_info="a.py:5"),
+        f(rule="T4J002", message="send at step 4 dropped", src_info="a.py:5"),
+        f(rule="T4J004", message="other", src_info="a.py:5"),
+    ]
+    out = contracts.dedupe_findings(fs)
+    assert len(out) == 2
+    assert out[0].message == "send at step 3 dropped"  # first wins
+
+
+def test_dedupe_findings_keeps_anchorless(contracts):
+    f = contracts.Finding
+    fs = [f(rule="T4J007", message="diverged"),
+          f(rule="T4J007", message="diverged")]
+    assert len(contracts.dedupe_findings(fs)) == 2
+
+
+def test_dedupe_findings_distinct_anchors_kept(contracts):
+    f = contracts.Finding
+    fs = [f(rule="T4J002", message="m", src_info="a.py:5"),
+          f(rule="T4J002", message="m", src_info="a.py:6")]
+    assert len(contracts.dedupe_findings(fs)) == 2
+
+
+# ------------------------------------------------------ t4j-verify CLI
+
+
+def _traces(tmp_path, record_mod, schedules):
+    paths = []
+    for r, events in enumerate(schedules):
+        p = tmp_path / f"r{r}.json"
+        p.write_text(json.dumps({
+            "format": "t4j-schedule-v1", "rank": r, "events": events,
+        }))
+        paths.append(str(p))
+    return paths
+
+
+def test_verify_main_traces_clean_exit0(cli, record_mod, tmp_path, capsys):
+    paths = _traces(tmp_path, record_mod, [
+        [ev("allreduce", 0, reduce_op="sum")],
+        [ev("allreduce", 1, reduce_op="sum")],
+    ])
+    with _pkg_stub():
+        code = cli.verify_main(["--traces", *paths])
+    assert code == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_verify_main_traces_findings_exit1(cli, record_mod, tmp_path, capsys):
+    paths = _traces(tmp_path, record_mod, [
+        [ev("send", 0, dest=1), ev("recv", 0, source=1)],
+        [ev("send", 1, dest=0), ev("recv", 1, source=0)],
+    ])
+    with _pkg_stub():
+        code = cli.verify_main(["--traces", *paths])
+    assert code == 1
+    assert "T4J010" in capsys.readouterr().out
+
+
+def test_verify_main_traces_json_format(cli, record_mod, tmp_path, capsys):
+    paths = _traces(tmp_path, record_mod, [
+        [ev("send", 0, dest=1, shape=SMALL)],
+        [],
+    ])
+    with _pkg_stub():
+        code = cli.verify_main(["--traces", *paths, "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert code == 1 and doc["exit_code"] == 1
+    assert doc["findings"][0]["rule"] == "T4J012"
+    assert doc["findings"][0]["src_info"]
+
+
+def test_verify_main_bad_trace_exit2(cli, tmp_path, capsys):
+    p = tmp_path / "junk.json"
+    p.write_text("{}")
+    with _pkg_stub():
+        code = cli.verify_main(["--traces", str(p)])
+    assert code == 2
+
+
+def test_verify_main_no_input_usage_error(cli):
+    with pytest.raises(SystemExit) as exc:
+        cli.verify_main([])
+    assert exc.value.code == 2
+
+
+def test_lint_output_collector_json(cli, contracts, capsys):
+    out = cli._Output("json")
+    out.finding("here", contracts.Finding(rule="T4J010", message="m",
+                                          src_info="x.py:1"))
+    code = out.finish("t4j-verify", 1)
+    doc = json.loads(capsys.readouterr().out)
+    assert code == 1 and doc["checked"] == 1
+    assert doc["findings"] == [{"where": "here", "rule": "T4J010",
+                                "message": "m", "src_info": "x.py:1"}]
+
+
+# -------------------------------------------------- serving plan streams
+
+
+def _leader_stream(plan_mod, sched_mod, req_mod, max_batch=2, p_max=8):
+    sched = sched_mod.SlotScheduler(max_batch, p_max)
+    for rid, prompt, max_new in ((1, (5, 6, 7), 2), (2, (3, 4), 3)):
+        sched.submit(req_mod.Request(rid, prompt, max_new, 0.0, None), 0.0)
+    vecs = []
+    now = 0.0
+    for _ in range(50):
+        if sched.idle():
+            break
+        digest = sched.state_digest()
+        plan = sched.plan_step(now)
+        vecs.append(plan_mod.encode_plan(plan, max_batch, p_max, digest))
+        for slot, _req in plan.admissions:
+            sched.prefill_done(slot, now)
+        sched.step_done(plan, now)
+        now += 1.0
+    assert sched.idle()
+    return vecs
+
+
+@pytest.fixture(scope="module")
+def sched_mod():
+    return load_pkg_module("mpi4jax_tpu.serving.scheduler")
+
+
+@pytest.fixture(scope="module")
+def req_mod():
+    return load_pkg_module("mpi4jax_tpu.serving.request")
+
+
+def test_plan_stream_clean_replay(plan_mod, sched_mod, req_mod, tmp_path):
+    vecs = _leader_stream(plan_mod, sched_mod, req_mod)
+    assert vecs
+    path = tmp_path / "plans.jsonl"
+    plan_mod.save_plan_stream(path, vecs, 2, 8, world=2)
+    meta, loaded = plan_mod.load_plan_stream(path)
+    assert meta["max_batch"] == 2 and len(loaded) == len(vecs)
+    assert plan_mod.replay_stream(meta, loaded) == []
+
+
+def test_plan_stream_drift_detected(plan_mod, sched_mod, req_mod):
+    vecs = _leader_stream(plan_mod, sched_mod, req_mod)
+    vecs[1] = list(vecs[1])
+    vecs[1][5] ^= 0x5A  # corrupt the digest word: follower must drift
+    meta = {"max_batch": 2, "p_max": 8, "world": 2}
+    findings = plan_mod.replay_stream(meta, vecs)
+    assert findings and findings[0].rule == "T4J007"
+    assert "entry 1" in findings[0].message
+
+
+def test_plan_stream_schedule_simulates_clean(plan_mod, sim):
+    meta = {"max_batch": 2, "p_max": 8, "world": 2}
+    vecs = [[0] * plan_mod.plan_words(2, 8)] * 3
+    schedules = plan_mod.plan_stream_schedule(meta, vecs)
+    assert len(schedules) == 2 and len(schedules[0]) == 3
+    assert sim.simulate(schedules).ok
+
+
+def test_verify_main_plan_stream(cli, plan_mod, sched_mod, req_mod,
+                                 tmp_path, capsys):
+    vecs = _leader_stream(plan_mod, sched_mod, req_mod)
+    clean = tmp_path / "clean.jsonl"
+    plan_mod.save_plan_stream(clean, vecs, 2, 8, world=2)
+    with _pkg_stub():
+        assert cli.verify_main(["--plan-stream", str(clean)]) == 0
+    capsys.readouterr()
+    bad_vecs = [list(v) for v in vecs]
+    bad_vecs[0][5] ^= 1
+    bad = tmp_path / "bad.jsonl"
+    plan_mod.save_plan_stream(bad, bad_vecs, 2, 8, world=2)
+    with _pkg_stub():
+        assert cli.verify_main(["--plan-stream", str(bad)]) == 1
+    assert "T4J007" in capsys.readouterr().out
+
+
+def test_append_plan_stream_header_once(plan_mod, tmp_path):
+    path = tmp_path / "ap.jsonl"
+    words = plan_mod.plan_words(1, 2)
+    plan_mod.append_plan_stream(path, [0] * words, 1, 2, world=2)
+    plan_mod.append_plan_stream(path, [1] * words, 1, 2, world=2)
+    meta, vecs = plan_mod.load_plan_stream(path)
+    assert meta["format"] == "t4j-plan-stream-v1" and len(vecs) == 2
+
+
+# ----------------------------------------------------- fingerprint @sched
+
+
+def test_fingerprint_sched_section_roundtrip(sim, contracts):
+    fp = load_analysis("fingerprint")
+    cev = contracts.CommEvent(
+        seq=0, kind="send", comm_key=("proc", 0), backend="proc",
+        comm_size=2, dtype="float32", shape=(32768,), reduce_op="",
+        tag=0, source=None, dest=1, root=None, rank=0,
+        comm_ranks=(0, 1), token_in=1, token_out=2, pending_out=(),
+        src_info="user.py:3", scope=None, request_out=None,
+        requests_in=(),
+    )
+    blob = fp.serialize_schedule([cev], with_sched=True)
+    parsed = fp._parse(blob)
+    assert "@sched" in parsed
+    assert parsed["@sched"]["events"][0]["kind"] == "send"
+
+
+def test_fingerprint_compare_runs_simulator(sim, contracts):
+    fp = load_analysis("fingerprint")
+    def mk(rank):
+        return contracts.CommEvent(
+            seq=0, kind="send", comm_key=("proc", 0), backend="proc",
+            comm_size=2, dtype="float32", shape=(32768,), reduce_op="",
+            tag=0, source=None, dest=1 - rank, root=None, rank=rank,
+            comm_ranks=(0, 1), token_in=1, token_out=2, pending_out=(),
+            src_info="user.py:3", scope=None, request_out=None,
+            requests_in=(),
+        )
+    def mk_recv(rank):
+        return contracts.CommEvent(
+            seq=1, kind="recv", comm_key=("proc", 0), backend="proc",
+            comm_size=2, dtype="float32", shape=(32768,), reduce_op="",
+            tag=0, source=1 - rank, dest=None, root=None, rank=rank,
+            comm_ranks=(0, 1), token_in=2, token_out=3, pending_out=(),
+            src_info="user.py:4", scope=None, request_out=None,
+            requests_in=(),
+        )
+    blobs = [
+        fp.serialize_schedule([mk(0), mk_recv(0)], with_sched=True),
+        fp.serialize_schedule([mk(1), mk_recv(1)], with_sched=True),
+    ]
+    # schedules AGREE step for step (send/recv signatures match per
+    # comm) yet form a send/send cycle: only the simulator catches it
+    with pytest.raises(contracts.CommContractError) as exc:
+        fp._compare(blobs, my_rank=0, simulate=True)
+    assert "T4J010" in str(exc.value)
+    # without the simulate flag the agreement passes silently
+    fp._compare(blobs, my_rank=0, simulate=False)

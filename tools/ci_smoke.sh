@@ -20,7 +20,18 @@
 #                t4j-lint over examples/ + models/, so the contract
 #                analyzer dogfoods the repo's own programs on every run
 #                (docs/static-analysis.md).  Tools missing from the
-#                container are skipped inside lint.sh.
+#                container are skipped inside lint.sh.  The t4j leg
+#                gates on the --format json exit_code field, so a
+#                crashed analyzer fails the lane distinctly from
+#                findings.
+#   6b. verify — tools/verify_smoke.py: the cross-rank schedule
+#                simulator (docs/static-analysis.md T4J010-T4J014).
+#                Seeded hazard matrix (all five rule classes must
+#                fire, clean ring/halo/hier/overlap shapes must not),
+#                a recorded two-rank serving plan stream replayed
+#                clean plus a corrupted-digest drift, and — on
+#                new-jax containers — t4j-verify over the repo's own
+#                lint entries.  Pure core, runs everywhere.
 #   7. resilience — tools/resilience_smoke.py under the ASan build: an
 #                8-rank flaky-fault job (rank 1 drops every connection
 #                twice mid-allreduce) must self-heal to bit-identical
@@ -164,16 +175,16 @@
 #                the degrade contract still runs.  ctypes only —
 #                runs on old-jax containers.
 #
-# Usage: tools/ci_smoke.sh [lane...]   (default: all twelve)
+# Usage: tools/ci_smoke.sh [lane...]   (default: all lanes)
 
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
-  lanes=(tier1 fault proc asan tsan lint resilience telemetry async
-         diagnose bench elastic autotune postmortem stripe serving
-         compress uring)
+  lanes=(tier1 fault proc asan tsan lint verify resilience telemetry
+         async diagnose bench elastic autotune postmortem stripe
+         serving compress uring)
 fi
 
 run_lane() {
@@ -219,6 +230,13 @@ for lane in "${lanes[@]}"; do
       ;;
     lint)
       run_lane lint tools/lint.sh
+      ;;
+    verify)
+      # the cross-rank schedule simulator dogfooded over seeded
+      # hazards, a recorded serving plan stream, and (new-jax
+      # containers) the repo's own lint entries
+      run_lane verify env JAX_PLATFORMS=cpu timeout -k 10 600 \
+        python tools/verify_smoke.py
       ;;
     resilience)
       run_lane resilience env T4J_SANITIZE=address timeout -k 10 900 \

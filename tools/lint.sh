@@ -56,8 +56,17 @@ for leg in "${legs[@]}"; do
       # the verifier needs the package importable (jax >= floor);
       # old-jax containers skip, same contract as the test suite
       if python -c "import mpi4jax_tpu" >/dev/null 2>&1; then
-        env JAX_PLATFORMS=cpu python -m mpi4jax_tpu.analysis.cli \
-          examples/*.py mpi4jax_tpu/models/*.py || fail=1
+        # machine-readable gate: one JSON object, CI fails on its
+        # exit_code field (docs/static-analysis.md "exit codes") so a
+        # crashed run (no JSON at all) also fails, distinct from
+        # findings
+        out=$(env JAX_PLATFORMS=cpu python -m mpi4jax_tpu.analysis.cli \
+          --format json examples/*.py mpi4jax_tpu/models/*.py)
+        echo "$out"
+        code=$(echo "$out" | python -c \
+          'import json,sys; print(json.load(sys.stdin)["exit_code"])' \
+          2>/dev/null || echo 2)
+        [ "$code" = "0" ] || fail=1
       else
         echo "mpi4jax_tpu not importable (old jax), t4j-lint skipped"
       fi
