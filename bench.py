@@ -857,6 +857,49 @@ def proc_serving(timeout=1200):
     return recs
 
 
+def proc_serving_autoscale(timeout=1800):
+    """Elastic serving contrast (docs/serving.md "Autoscaling"): one
+    8-rank ``launch.py --autoscale --elastic rejoin`` job running
+    ``benchmarks/serving.py --arms ramp`` — the engine's traffic
+    policy riding a seeded 1->10->1 rps Poisson ramp against the
+    static boot-world baseline over the same arrivals.  Returns the
+    dict of records keyed by metric name (empty on failure)."""
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).parent / "benchmarks" / "serving.py"
+    argv = [
+        sys.executable, "-m", "mpi4jax_tpu.launch", "-np", "8",
+        "--elastic", "rejoin", "--autoscale",
+        str(script), "--arms", "ramp", "--ramp", "1,10,1",
+        "--windows", "1", "--duration", "9", "--slo", "6000",
+    ]
+    recs = {}
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            cwd=str(pathlib.Path(__file__).parent),
+        )
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            metric = str(rec.get("metric", ""))
+            if metric.startswith(("serving_autoscale_",
+                                  "goodput_per_rank_second_")):
+                recs[rec["metric"]] = rec
+        if not recs:
+            print(
+                f"[bench] serving autoscale produced no records "
+                f"(rc={out.returncode}): {out.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — bench must still emit its line
+        print(f"[bench] serving autoscale failed: {exc}", file=sys.stderr)
+    return recs
+
+
 def run_bench(quick=False):
     import jax
 
@@ -1174,6 +1217,7 @@ def run_bench(quick=False):
         _skip("proc_compress_busbw", "quick mode")
         _skip("proc_uring_busbw", "quick mode")
         _skip("proc_serving", "quick mode")
+        _skip("proc_serving_autoscale", "quick mode")
     elif not native_ok:
         _skip("proc_tcp_busbw", native_reason)
         _skip("proc_hier_busbw", native_reason)
@@ -1184,6 +1228,7 @@ def run_bench(quick=False):
         _skip("proc_compress_busbw", native_reason)
         _skip("proc_uring_busbw", native_reason)
         _skip("proc_serving", native_reason)
+        _skip("proc_serving_autoscale", native_reason)
     ring_rec, tree_rec = proc_tcp_busbw() if run_heavy_proc else (None, None)
     if run_heavy_proc and ring_rec is None and tree_rec is None:
         _skip("proc_tcp_busbw", "no record produced")
@@ -1361,6 +1406,32 @@ def run_bench(quick=False):
     ):
         if metric in sv_recs:
             extras[metric] = sv_recs[metric]["value"]
+    # elastic serving contrast (docs/serving.md "Autoscaling"): the
+    # traffic-driven policy riding a 1->10->1 rps ramp vs the static
+    # boot world over the SAME seeded arrivals — SLO attainment and
+    # goodput per rank-second (integrated over the live world)
+    av_recs = proc_serving_autoscale() if run_heavy_proc else {}
+    if run_heavy_proc and not av_recs:
+        _skip("proc_serving_autoscale", "no record produced")
+    for short, metric in (
+        ("serving_autoscale_slo_attainment",
+         "serving_autoscale_slo_attainment_proc8"),
+        ("goodput_per_rank_second_auto",
+         "goodput_per_rank_second_auto_proc8"),
+        ("goodput_per_rank_second_static",
+         "goodput_per_rank_second_static_proc8"),
+    ):
+        if metric in av_recs:
+            extras[short] = av_recs[metric]["value"]
+    if (av_recs
+            and "serving_autoscale_slo_attainment_proc8" in av_recs):
+        rec = av_recs["serving_autoscale_slo_attainment_proc8"]
+        if rec.get("static_slo_attainment") is not None:
+            extras["serving_static_slo_attainment"] = (
+                rec["static_slo_attainment"]
+            )
+        if rec.get("epochs_survived") is not None:
+            extras["serving_autoscale_epochs"] = rec["epochs_survived"]
 
     if quick:
         for leg in ("transformer", "matmul_roofline",

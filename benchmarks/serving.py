@@ -35,10 +35,27 @@ configured rate; an overloaded admission-on server SHEDS (counted),
 an overloaded baseline QUEUES (p99 blows up) — both outcomes are the
 measurement.
 
+``--arms ramp`` is the elastic contrast (docs/serving.md
+"Autoscaling"): an **auto** arm (the engine's traffic-driven scale
+policy armed — run under ``launch.py --autoscale --elastic rejoin``
+so grow requests spawn real T4J_REJOIN=1 ranks and in-band retires
+shrink the world back) against a **static** arm serving the SAME
+seeded piecewise Poisson ramp (``--ramp 1,10,1``) at the boot world.
+The records carry SLO attainment for both arms plus
+goodput-per-rank-second — SLO-met completions divided by the
+rank-seconds that actually served them, integrated over the live
+world as resizes land — and the membership history proving the
+epochs::
+
+    python -m mpi4jax_tpu.launch -np 8 --elastic rejoin --autoscale \\
+        benchmarks/serving.py --arms ramp --ramp 1,10,1 --slo 4000
+
 Rank 0 prints one JSON record per metric (the bench.py serving leg
 consumes ``serving_p50_ms_procN`` / ``serving_p99_ms_procN`` /
 ``serving_rps_procN`` / ``serving_shed_rate_procN`` /
-``serving_slo_attainment_procN`` + the ``_admit_off`` contrasts).
+``serving_slo_attainment_procN`` + the ``_admit_off`` contrasts; the
+autoscale leg consumes ``serving_autoscale_slo_attainment_procN`` /
+``goodput_per_rank_second_{auto,static}_procN``).
 """
 
 import argparse
@@ -136,6 +153,110 @@ def _window(engine, args, arm, arm_stats, window_idx):
     return {"offered": offered, "wall_s": wall_s}
 
 
+def _ramp_window(engine, args, arm, arm_stats, window_idx):
+    """One ramp window of ``arm`` ('auto'|'static'): the SAME seeded
+    piecewise-constant Poisson ramp (``--ramp`` rates split evenly
+    over ``--duration``).  The auto arm arms the engine's traffic
+    policy (``enable_autoscale``), feeds it a decision window every
+    ``--scale-window`` seconds, and integrates rank-seconds over the
+    LIVE world size as resizes land; the static arm serves the whole
+    ramp at the boot world.  Returns offered count, wall, integrated
+    rank-seconds, and the membership history ``[(t_s, world), ...]``."""
+    from mpi4jax_tpu.serving import LoadGen
+
+    slo = float(args.slo)
+    engine.reconfigure(
+        "off", slo_ms=slo, stats=arm_stats[arm], measure_slo_ms=slo,
+    )
+    if arm == "auto":
+        engine.enable_autoscale()
+    else:
+        engine.disable_autoscale()
+    deadline = (lambda t: t + slo) if slo else (lambda t: None)
+    rates = args.ramp
+    dur_ms = args.duration * 1e3
+    seg_ms = dur_ms / len(rates)
+    gens = [
+        LoadGen(
+            seed=args.seed + 1000 * window_idx + 17 * i,
+            rate_rps=r, prompt_len=("uniform", *args.prompt),
+            max_new=("uniform", *args.new), vocab=args.vocab,
+            deadline_fn=deadline, start_ms=i * seg_ms,
+        )
+        for i, r in enumerate(rates)
+    ]
+    t0 = time.perf_counter()
+    now_ms = lambda: (time.perf_counter() - t0) * 1e3  # noqa: E731
+    win_ms = args.scale_window * 1e3
+    offered = 0
+    rank_s = 0.0
+    last_ms = 0.0
+    next_win = win_ms
+    world = engine._alive_world()
+    membership = [(0.0, world)]
+    while True:
+        now = now_ms()
+        # rank-seconds integrate against the world that ACTUALLY
+        # served the interval — the honest denominator for goodput
+        w = engine._alive_world()
+        rank_s += world * (now - last_ms) / 1e3
+        if w != world:
+            membership.append((round(now / 1e3, 2), w))
+            world = w
+        last_ms = now
+        if now >= dur_ms:
+            break
+        for i, gen in enumerate(gens):
+            seg_end = (i + 1) * seg_ms
+            for req in gen.until(min(now, seg_end)):
+                engine.offer(req, now_ms())
+                offered += 1
+        engine.step(now_ms())
+        if arm == "auto" and now >= next_win:
+            engine.autoscale_window(now)
+            next_win += win_ms
+    engine.drain(now_ms_fn=now_ms, stop=False)
+    wall_s = time.perf_counter() - t0
+    rank_s += world * (wall_s - last_ms / 1e3)
+    engine.disable_autoscale()
+    return {
+        "offered": offered, "wall_s": wall_s, "rank_s": rank_s,
+        "membership": membership,
+    }
+
+
+def _ramp_records(arm_stats, n, info, extra):
+    """The autoscale-vs-static contrast records: SLO attainment of the
+    elastic arm (with the static baseline inlined as a label) and
+    goodput-per-rank-second for both arms — SLO-met completions over
+    the rank-seconds that actually served them."""
+    recs = []
+    snaps = {arm: arm_stats[arm].snapshot() for arm in ("auto", "static")}
+    rnd = lambda v: None if v is None else round(v, 4)  # noqa: E731
+    auto, static = snaps["auto"], snaps["static"]
+    recs.append({
+        "metric": f"serving_autoscale_slo_attainment_proc{n}",
+        "value": rnd(auto["slo_attainment"]), "unit": "fraction",
+        "nprocs": n, "slo_ms": auto["slo_ms"],
+        "static_slo_attainment": rnd(static["slo_attainment"]),
+        "epochs_survived": auto["epochs_survived"],
+        "reissued": auto["reissued"],
+        "membership": info["auto"]["membership"], **extra,
+    })
+    for arm in ("auto", "static"):
+        s = snaps[arm]
+        rank_s = info[arm]["rank_s"] or 1e-9
+        recs.append({
+            "metric": f"goodput_per_rank_second_{arm}_proc{n}",
+            "value": round(s["slo_ok"] / rank_s, 4),
+            "unit": "req/(rank*s)", "nprocs": n,
+            "slo_ok": s["slo_ok"], "completed": s["completed"],
+            "rank_seconds": round(rank_s, 2),
+            "wall_s": round(info[arm]["wall_s"], 2), **extra,
+        })
+    return recs
+
+
 def _arm_records(stats, n, arm, walls, extra):
     s = stats.snapshot()
     offered = s["completed"] + s["shed"]
@@ -177,8 +298,15 @@ def _arm_records(stats, n, arm, walls, extra):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--arms", choices=("pairs", "on", "off"),
+    ap.add_argument("--arms", choices=("pairs", "on", "off", "ramp"),
                     default="pairs")
+    ap.add_argument("--ramp", type=lambda s: tuple(
+        float(x) for x in s.split(",")), default=(1.0, 10.0, 1.0),
+        help="piecewise arrival rates for --arms ramp, split evenly "
+        "over --duration (default 1,10,1 rps)")
+    ap.add_argument("--scale-window", type=float, default=1.0,
+        help="autoscale decision-window cadence in seconds "
+        "(ramp arm)")
     ap.add_argument("--windows", type=int, default=2,
                     help="window repetitions per arm")
     ap.add_argument("--duration", type=float, default=8.0,
@@ -221,18 +349,34 @@ def main(argv=None):
         engine.run_follower()
         return 0
 
-    arms = (("on", "off") if args.arms == "pairs" else (args.arms,))
+    if args.arms == "ramp":
+        arms = ("auto", "static")
+    else:
+        arms = (("on", "off") if args.arms == "pairs"
+                else (args.arms,))
     arm_stats = {
         arm: ServingStats(slo_ms=float(args.slo),
-                          max_batch=args.max_batch, admit_mode=arm)
+                          max_batch=args.max_batch,
+                          admit_mode="off" if arm in ("auto", "static")
+                          else arm)
         for arm in arms
     }
     _warmup(engine, args)
     walls = {arm: [] for arm in arms}
+    ramp_info = {
+        arm: {"rank_s": 0.0, "wall_s": 0.0, "membership": []}
+        for arm in arms
+    }
     for w in range(args.windows):
         for arm in arms:
-            info = _window(engine, args, arm, arm_stats, w)
+            if args.arms == "ramp":
+                info = _ramp_window(engine, args, arm, arm_stats, w)
+                ramp_info[arm]["rank_s"] += info["rank_s"]
+                ramp_info[arm]["membership"] = info["membership"]
+            else:
+                info = _window(engine, args, arm, arm_stats, w)
             walls[arm].append(info["wall_s"])
+            ramp_info[arm]["wall_s"] += info["wall_s"]
             s = arm_stats[arm].snapshot()
             print(
                 f"[serving] window {w} arm={arm}: offered "
@@ -262,6 +406,12 @@ def main(argv=None):
     # it ran (that is the controlled configuration the SLO story is
     # about); a single off-arm run reports itself unsuffixed but
     # labeled admit=off
+    if args.arms == "ramp":
+        extra["ramp_rps"] = list(args.ramp)
+        records = _ramp_records(arm_stats, n, ramp_info, extra)
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        return 0
     if "on" in arm_stats:
         records += _arm_records(arm_stats["on"], n, "primary",
                                 walls["on"], extra)

@@ -232,6 +232,20 @@ def main(argv=None):
         "actually failed (e.g. it fell below T4J_MIN_WORLD).",
     )
     parser.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="traffic-driven elastic autoscaling (docs/serving.md "
+        "\"Autoscaling\"): sets T4J_AUTOSCALE=on and a grow-request "
+        "file (T4J_AUTOSCALE_REQ) for every rank.  The serving "
+        "leader's policy posts grow requests to the file; the "
+        "launcher answers by relaunching retired slots as "
+        "T4J_REJOIN=1 expansion ranks through rank 0's kept-open "
+        "coordinator port.  A follower exiting cleanly while the "
+        "leader serves on is a scaledown (the in-band retire plan), "
+        "recorded in the membership history, and its slot is reused "
+        "by the next grow.  Requires --elastic rejoin.",
+    )
+    parser.add_argument(
         "--autotune",
         action="store_true",
         help="calibrate the data-plane knob vector at init "
@@ -298,6 +312,10 @@ def main(argv=None):
     if args.slo is not None and args.slo <= 0:
         parser.error("--slo must be > 0 milliseconds (omit it for no "
                      "SLO)")
+    if args.autoscale and args.elastic != "rejoin":
+        parser.error("--autoscale requires --elastic rejoin (a grow "
+                     "admits replacement ranks through the kept-open "
+                     "coordinator port)")
 
     attempts = args.restarts + 1
     for attempt in range(1, attempts + 1):
@@ -447,6 +465,30 @@ def _start_job_metrics(port, n, job):
         return None
 
 
+def _load_autoscale_module():
+    """The pure scale-policy module holds the request-file protocol
+    (serving/autoscale.py).  Importing it through the package trips
+    the jax version gate on old-jax containers, so fall back to
+    loading the file directly — it only needs the stdlib."""
+    try:
+        from mpi4jax_tpu.serving import autoscale
+
+        return autoscale
+    except Exception:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "serving", "autoscale.py",
+        )
+        spec = importlib.util.spec_from_file_location(
+            "_t4j_launch_autoscale", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+
 def _run_job(args):
     """One launch attempt: spawn the workers, wait, fail fast."""
     n = args.nprocs
@@ -463,6 +505,17 @@ def _run_job(args):
     metrics_srv = None
     if args.metrics is not None:
         metrics_srv = _start_job_metrics(args.metrics, n, job)
+    autoscale_api = None
+    autoscale_req = None
+    if args.autoscale:
+        import tempfile
+
+        autoscale_api = _load_autoscale_module()
+        # per-job request file: the leader posts grow requests here
+        # (T4J_AUTOSCALE_REQ), the poll loop below consumes them
+        autoscale_req = os.path.join(
+            tempfile.gettempdir(), f"t4j-scale-{job}.json"
+        )
     def spawn(rank, rejoin=False):
         env = dict(os.environ)
         env.update(
@@ -474,6 +527,9 @@ def _run_job(args):
         )
         if args.elastic:
             env["T4J_ELASTIC"] = args.elastic
+        if args.autoscale:
+            env["T4J_AUTOSCALE"] = "on"
+            env["T4J_AUTOSCALE_REQ"] = autoscale_req
         if rejoin:
             # replacement slot: re-bootstrap through rank 0's kept-open
             # coordinator port instead of the full-world rendezvous
@@ -536,6 +592,8 @@ def _run_job(args):
     exited_ok = set()
     last_bad_rc = None
     relaunches = 0
+    scaled_down = []  # slots the autoscaler retired; reused by grows
+    last_scale_poll = 0.0
 
     try:
         remaining = set(range(n))
@@ -560,6 +618,27 @@ def _run_job(args):
                     ).start()
                 if rc == 0:
                     exited_ok.add(i)
+                    if (autoscale_req and i != 0 and 0 in remaining
+                            and exit_code == 0
+                            and terminated_at is None):
+                        # a clean follower exit while the leader serves
+                        # on is the autoscaler's in-band retire plan,
+                        # not a fault: record the scaledown (the
+                        # survivors' native layer is committing the
+                        # smaller world right now) and keep the slot
+                        # for a later grow
+                        epoch_guess += 1
+                        members -= 1
+                        scaled_down.append(i)
+                        history.append(
+                            f"e{epoch_guess}:scaledown({members}) "
+                            f"[rank {i} retired at "
+                            f"+{time.monotonic() - start:.1f}s]"
+                        )
+                        _say(
+                            f"rank {i} retired by the autoscaler — "
+                            f"{members} rank(s) serving"
+                        )
                     continue
                 if elastic and exit_code == 0 and terminated_at is None:
                     # elastic membership: a dead rank is a shrink, not
@@ -615,6 +694,39 @@ def _run_job(args):
                         procs[j].terminate()
             if remaining:
                 now = time.monotonic()
+                if (autoscale_req and exit_code == 0
+                        and terminated_at is None
+                        and now - last_scale_poll > 0.5):
+                    # answer the serving leader's grow requests: each
+                    # retired slot relaunches as a T4J_REJOIN=1
+                    # expansion rank (one epoch per admit).  Malformed
+                    # or stale files are consumed and ignored —
+                    # read_request never raises.
+                    last_scale_poll = now
+                    req = autoscale_api.read_request(autoscale_req)
+                    if req is not None:
+                        autoscale_api.clear_request(autoscale_req)
+                        want = min(int(req["want_world"]), n)
+                        scaled_down.sort()
+                        while (members < want and scaled_down
+                               and relaunches < 4 * n):
+                            slot = scaled_down.pop(0)
+                            relaunches += 1
+                            epoch_guess += 1
+                            members += 1
+                            history.append(
+                                f"e{epoch_guess}:grow({members}) "
+                                f"[rank {slot} relaunched: "
+                                f"{req['reason'] or 'grow request'}]"
+                            )
+                            _say(
+                                f"autoscale grow to {want}: "
+                                f"relaunching rank {slot} as an "
+                                f"expansion rank ({members} serving)"
+                            )
+                            exited_ok.discard(slot)
+                            procs[slot] = spawn(slot, rejoin=True)
+                            remaining.add(slot)
                 if (
                     args.timeout is not None
                     and exit_code == 0
@@ -694,6 +806,11 @@ def _run_job(args):
         except Exception:
             pass
         metrics_srv.stop()
+    if autoscale_req:
+        # consume any request posted after the last poll: a leftover
+        # file would leak into the temp dir (the job id namespaces it,
+        # so a successor job can never mistake it for its own)
+        autoscale_api.clear_request(autoscale_req)
     if tel_dir and exit_code != 130:
         # cross-rank death analysis from the drained + flight files:
         # on a failed job it names the first failure; on an elastic

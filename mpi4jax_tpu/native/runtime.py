@@ -724,11 +724,15 @@ def world_info():
     """Live membership view, or ``None`` before init.
 
     Returns ``{"epoch", "boot_size", "alive_count", "alive_mask",
-    "resizing", "stale_frames"}`` — ``epoch`` 0 is the bootstrap world
-    and bumps once per committed elastic resize; ``alive_mask`` bit r
-    means world rank r is a member; ``resizing`` is True while a
-    membership agreement/rebuild is in flight; ``stale_frames`` counts
-    frames dropped for carrying a pre-resize epoch (diagnostic)."""
+    "resizing", "stale_frames", "epoch_transitions"}`` — ``epoch`` 0
+    is the bootstrap world and bumps once per committed elastic
+    resize; ``alive_mask`` bit r means world rank r is a member;
+    ``resizing`` is True while a membership agreement/rebuild is in
+    flight; ``stale_frames`` counts frames dropped for carrying a
+    pre-resize epoch (diagnostic); ``epoch_transitions`` counts the
+    resize epochs THIS process has observed via the health path — the
+    exporter's per-epoch transition counter (a rejoined replacement
+    starts at 0 even though the world epoch it joins is higher)."""
     lib = _state["lib"]
     if lib is None or not lib.t4j_initialized():
         return None
@@ -749,6 +753,7 @@ def world_info():
         "alive_mask": int(mask.value),
         "resizing": bool(resizing.value),
         "stale_frames": int(stale.value),
+        "epoch_transitions": int(_state.get("epoch_transitions", 0)),
     }
 
 
@@ -812,6 +817,9 @@ def _check_world_epoch(lib):
     if info["epoch"] != last["epoch"]:
         _state["world_view"] = info
         _state["comm_cache"].clear()  # pre-resize handles are stale
+        _state["epoch_transitions"] = (
+            _state.get("epoch_transitions", 0) + 1
+        )
         raise WorldResized(
             _mask_ranks(last["alive_mask"], info["boot_size"]),
             _mask_ranks(info["alive_mask"], info["boot_size"]),
@@ -1646,6 +1654,18 @@ def ensure_initialized():
             "with admission control off cannot be enforced, only "
             "missed — set T4J_ADMIT=on (shed to hold the deadline) "
             "or drop the SLO (docs/serving.md \"admission control\")"
+        )
+    autoscale = config.autoscale_mode()
+    config.scale_up_windows()
+    config.scale_down_occ()
+    config.scale_down_windows()
+    config.scale_cooldown_windows()
+    if autoscale == "on" and elastic != "rejoin":
+        raise ValueError(
+            f"T4J_AUTOSCALE=on with T4J_ELASTIC={elastic}: growing "
+            "the world admits a relaunched rank through the kept-open "
+            "coordinator port, which only the rejoin mode provides — "
+            "set T4J_ELASTIC=rejoin (docs/serving.md \"Autoscaling\")"
         )
     tel_mode, tel_bytes = config.telemetry_mode(), config.telemetry_bytes()
     tel_dir = config.telemetry_dir()

@@ -195,13 +195,20 @@ class BucketedGradSync:
     quantisation error (it is exactly zero when the stream is wire-
     representable, e.g. a constant integer-valued gradient).  Master
     weights and the returned gradients stay f32.  The residual dict is
-    per-rank MUTABLE state: checkpoint it with the optimizer state,
-    and reset it (``{}``) after an elastic resize epoch — a residual
-    measured against the old membership's quantisation stream is stale
-    (docs/sharp-bits.md "error-feedback residuals are per-rank
-    state").  Without ``residuals`` the call keeps the classic
-    2-tuple signature and never quantises in Python (the native wire
-    layer may still compress eligible comms).
+    per-rank MUTABLE state: checkpoint it with the optimizer state.
+    Residuals are world-stamped: every returned dict carries a
+    ``"_world"`` key holding ``(epoch, alive_count)`` from the live
+    membership view, and a sync that sees a residual dict stamped with
+    a DIFFERENT epoch drops the carried residuals instead of folding a
+    pre-resize quantisation error into the post-resize stream — the
+    sharp bit docs/sharp-bits.md "error-feedback residuals are
+    per-rank state" documents, now enforced here rather than left to
+    caller discipline.  A per-bucket shape mismatch (the bucket layout
+    changed under the carrier) likewise drops that bucket's residual
+    rather than crashing the first post-resize step.  Without
+    ``residuals`` the call keeps the classic 2-tuple signature and
+    never quantises in Python (the native wire layer may still
+    compress eligible comms).
     """
 
     def __init__(self, comm=None, bucket_bytes=None, average=True,
@@ -258,6 +265,23 @@ class BucketedGradSync:
             info = None
         return (info or {}).get("wire_dtype", "off")
 
+    def _world_stamp(self):
+        """``(epoch, alive_count)`` from the live membership view, or
+        ``None`` outside a proc-tier native job — the residual-dict
+        validity stamp (a residual quantised against one membership's
+        stream is stale in the next epoch)."""
+        if self.comm.backend != "proc":
+            return None
+        try:
+            from mpi4jax_tpu.native import runtime
+
+            info = runtime.world_info()
+        except Exception:
+            info = None
+        if not info:
+            return None
+        return (int(info["epoch"]), int(info["alive_count"]))
+
     @staticmethod
     def _wire_jnp_dtype(mode):
         if mode == "bf16":
@@ -294,6 +318,18 @@ class BucketedGradSync:
         ef = residuals is not None
         qdt = self._wire_jnp_dtype(self._wire_dtype()) if ef else None
         new_res = {} if ef else None
+        carried = residuals if (ef and hasattr(residuals, "get")) else {}
+        if ef:
+            stamp = self._world_stamp()
+            prev_stamp = carried.get("_world") if carried else None
+            if (stamp is not None and prev_stamp is not None
+                    and tuple(prev_stamp) != stamp):
+                # resize-epoch commit: the carried residuals were
+                # quantised against the old membership's stream — drop
+                # them wholesale rather than fold stale error in
+                carried = {}
+            if stamp is not None:
+                new_res["_world"] = stamp
         scale = 1.0 / float(self.comm.size) if self.average else None
         pending = []  # (bucket, request-or-reduced)
         for bi, bucket in enumerate(self._buckets(leaves)):
@@ -306,10 +342,16 @@ class BucketedGradSync:
                 # rounding error for the next step.  Keyed by bucket
                 # index — the greedy layout is deterministic for a
                 # fixed pytree, so keys are stable across steps.
-                prev = residuals.get(bi) if hasattr(
-                    residuals, "get") else None
+                prev = carried.get(bi) if carried else None
                 if prev is not None:
-                    flat = flat + jnp.asarray(prev, flat.dtype)
+                    prev = jnp.asarray(prev, flat.dtype)
+                    if prev.shape != flat.shape:
+                        # bucket layout changed under the carrier (a
+                        # resized world re-shards the pytree): a
+                        # wrong-shape residual is stale, not an error
+                        prev = None
+                if prev is not None:
+                    flat = flat + prev
                 q = flat.astype(qdt).astype(flat.dtype)
                 new_res[bi] = flat - q
                 flat = q

@@ -27,17 +27,30 @@ Split exactly like ``telemetry/`` and ``tuning/``:
 Knobs (validated in utils/config.py): ``T4J_SLO_MS`` (the p99
 latency target), ``T4J_MAX_BATCH`` (decode slots), ``T4J_ADMIT``
 (``off`` | ``on``).  ``launch.py --serve`` wires them.
+
+Elastic serving (this PR's arc): :mod:`.autoscale` holds the pure
+traffic-driven scale policy (hysteresis state machine + the file
+channel ``launch.py --autoscale`` polls), the scheduler grew reissue/
+drain primitives, and the engine rides PR-10 resize epochs instead of
+dying — see docs/failure-semantics.md "Serving across epochs".
 """
 
-from . import admission, loadgen, plan, request, scheduler, stats
+from . import admission, autoscale, loadgen, plan, request, scheduler, stats
 from .admission import (
     AdmissionController,
     SLOEstimator,
     TokenBucket,
     degradation_factor,
 )
+from .autoscale import Autoscaler
 from .loadgen import LoadGen
-from .plan import PlanError, decode_plan, encode_plan, plan_words
+from .plan import (
+    PlanError,
+    decode_plan,
+    encode_plan,
+    plan_words,
+    rebuild_mirror,
+)
 from .request import Request, RequestState
 from .scheduler import (
     FollowerMirror,
@@ -50,6 +63,7 @@ from .stats import ServingStats, current, publish
 
 __all__ = [
     "AdmissionController",
+    "Autoscaler",
     "FollowerMirror",
     "LoadGen",
     "PlanError",
@@ -62,6 +76,7 @@ __all__ = [
     "StepPlan",
     "TokenBucket",
     "admission",
+    "autoscale",
     "current",
     "decode_plan",
     "degradation_factor",
@@ -71,6 +86,7 @@ __all__ = [
     "plan",
     "plan_words",
     "publish",
+    "rebuild_mirror",
     "request",
     "scheduler",
     "slots_digest",

@@ -32,6 +32,23 @@ Each step is wrapped in a ``step_scope`` marker, so ``t4j-diagnose``
 decomposes any p99 blowup into compute / caller-blocked / wire /
 repair per rank — the acceptance demo uses exactly that to attribute
 a delayed rank (docs/serving.md "diagnosing a p99 blowup").
+
+Elastic epochs (docs/failure-semantics.md "serving epoch survival"):
+with ``T4J_ELASTIC`` enabled, a membership change surfaces mid-step as
+``WorldResized`` / a ``ResizeInterrupted`` collective status.  The
+engine *rides* it instead of dying: every survivor waits the resize
+out, re-resolves tuning on the new fingerprint, re-shards the model
+for the surviving membership, and the leader reissues every in-slot
+request (completed tokens are never re-emitted — completions are
+delivered exactly once, and greedy decode re-generates the lost
+prefix deterministically).  If rank 0 died, the lowest surviving rank
+promotes itself: followers retain each admitted request's prompt
+exactly so the successor can rebuild a scheduler from its mirror.  A
+``T4J_REJOIN=1`` expansion rank rebuilds its mirror by replaying the
+leader's plan log before serving its first step, and the per-step
+digest check proves agreement.  :meth:`autoscale_window` feeds the
+:class:`~.autoscale.Autoscaler` policy that drives these epochs from
+traffic instead of faults.
 """
 
 import os
@@ -45,6 +62,7 @@ from jax import lax
 
 from mpi4jax_tpu.models import transformer as tfm
 from mpi4jax_tpu.ops import reductions
+from mpi4jax_tpu.serving import autoscale as autoscale_mod
 from mpi4jax_tpu.ops import step as step_mod
 from mpi4jax_tpu.ops._core import create_token
 from mpi4jax_tpu.ops.allreduce import allreduce
@@ -64,6 +82,19 @@ from mpi4jax_tpu.serving.stats import ServingStats
 from mpi4jax_tpu.utils import config
 
 __all__ = ["ServingEngine", "shard_params"]
+
+
+def _is_resize_error(exc):
+    """A mid-step exception that means "the world changed", not "the
+    world broke": the elastic layer's WorldResized, or a collective
+    status stringifying to ResizeInterrupted (ops raise BridgeError
+    with that tag when a resize commits under an in-flight op)."""
+    from mpi4jax_tpu.native.runtime import WorldResized
+
+    if isinstance(exc, WorldResized):
+        return True
+    s = str(exc)
+    return "ResizeInterrupted" in s or "world resized" in s
 
 
 def shard_params(params, tp, rank):
@@ -230,6 +261,7 @@ class ServingEngine:
         # ``t4j-verify --plan-stream`` (serving/plan.py replay_stream)
         if plan_log is None:
             plan_log = os.environ.get("T4J_PLAN_LOG") or None
+        self._plan_log_path = plan_log  # kept for joiners + promotion
         self.plan_log = plan_log if self.is_leader else None
 
         self._plan_words = plan_mod.plan_words(self.max_batch,
@@ -240,6 +272,24 @@ class ServingEngine:
         self._stopped = False
         self._fabric_poll_s = float(fabric_poll_s)
         self._last_fabric_poll = 0.0
+
+        # ---- elastic epoch survival state --------------------------------
+        # full params are kept so a resize can re-shard for the new
+        # world; followers retain each admitted request (prompt and
+        # all) so a promoted successor can rebuild the scheduler.
+        self._full_params = params
+        self._leader_rank = 0
+        self._rank_index = self.rank  # shard index == world rank at boot
+        self._model_ready = True
+        self._epoch = None
+        self._retained = {}  # follower: rid -> Request
+        self._scaler = None
+        self._autoscale_req = None
+        self._budget_ms = 0.0
+        self._retire_queue = []  # world ranks to retire, one per plan
+        self._drain_clamp = 8
+        if not self.is_leader and os.environ.get("T4J_REJOIN") == "1":
+            self._joiner_bootstrap()
 
     # ---- jitted bodies ---------------------------------------------------
 
@@ -412,14 +462,24 @@ class ServingEngine:
     def step(self, now_ms=None):
         """One serve step.  Leader: plan + broadcast + execute + book;
         follower: receive + verify + execute + book.  Returns False
-        once a stop plan has been processed."""
+        once a stop plan has been processed.
+
+        A resize surfacing mid-step (``WorldResized`` or a
+        ``ResizeInterrupted`` collective) is ridden, not fatal: the
+        engine rebuilds for the new membership and returns True so the
+        caller keeps stepping in the new epoch."""
         if self._stopped:
             return False
         if now_ms is None:
             now_ms = time.monotonic() * 1e3
-        if self.is_leader:
-            return self._leader_step(now_ms)
-        return self._follower_step()
+        try:
+            if self.is_leader:
+                return self._leader_step(now_ms)
+            return self._follower_step()
+        except Exception as exc:
+            if not _is_resize_error(exc):
+                raise
+            return self._ride_resize(now_ms)
 
     def _leader_step(self, now_ms, stop=False):
         self._poll_fabric(now_ms)
@@ -427,8 +487,16 @@ class ServingEngine:
             self.stats.observe_shed(req.shed_reason)
         digest = self.sched.state_digest()
         plan = self.sched.plan_step(now_ms)
+        retire = None
+        if self._retire_queue and not stop:
+            # shrink cascade: one victim per plan (the batch is already
+            # drained and admissions held, so the plan is empty); the
+            # victim executes this step, then exits cleanly, and the
+            # elastic layer turns its departure into the next epoch
+            retire = self._retire_queue.pop(0)
         vec = plan_mod.encode_plan(
-            plan, self.max_batch, self.max_len, digest, stop=stop
+            plan, self.max_batch, self.max_len, digest, stop=stop,
+            retire=retire,
         )
         if self.plan_log:
             plan_mod.append_plan_stream(
@@ -520,6 +588,13 @@ class ServingEngine:
         finally:
             if scope is not None:
                 scope.__exit__(None, None, None)
+        # retain admitted requests for promotion: if the leader dies,
+        # the lowest survivor rebuilds a scheduler from its mirror plus
+        # exactly these (prompt included — the plan carried it)
+        for _slot, rid, prompt, mn in admitted:
+            self._retained[rid] = plan_mod.follower_request(
+                rid, prompt, mn
+            )
         # same completion order as the leader: prefill-instant
         # completions first (prefill_done runs before step_done
         # there), then the decode completions
@@ -531,11 +606,13 @@ class ServingEngine:
                 self.finished.append(
                     (rid, tuple(int(t) for t in self.toks[s, :n]))
                 )
+                self._retained.pop(rid, None)
         for slot, rid in finished:
             n = int(self._row_len[slot])
             self.finished.append(
                 (rid, tuple(int(t) for t in self.toks[slot, :n]))
             )
+            self._retained.pop(rid, None)
         self.stats.observe_step(0, self.mirror.occupancy())
         snap = self.stats.snapshot()
         if decoded["stop"]:
@@ -544,7 +621,276 @@ class ServingEngine:
         if decoded["stop"]:
             self._stopped = True
             return False
+        if decoded.get("retire") == self.rank:
+            # the autoscaler retired this rank: leave the loop cleanly
+            # after executing the plan — the launcher records a
+            # scaledown, and the elastic layer commits the next epoch
+            # when it notices the departure
+            self._stopped = True
+            return False
         return True
+
+    # ---- elastic epoch survival ------------------------------------------
+
+    def _joiner_bootstrap(self):
+        """A ``T4J_REJOIN=1`` expansion rank joins mid-stream: rebuild
+        the FollowerMirror by replaying the leader's plan log so the
+        first live broadcast's digest check proves agreement BEFORE
+        this rank serves a step.  A missing log is fine (the leader
+        restarts the stream at every epoch commit, and a fresh epoch
+        has no history); a corrupt or geometry-mismatched one raises —
+        the joiner must not serve from a state it cannot prove."""
+        path = self._plan_log_path
+        if not path or not os.path.exists(path):
+            return
+        meta, vecs = plan_mod.load_plan_stream(path)
+        if (int(meta.get("max_batch", -1)) != self.max_batch
+                or int(meta.get("p_max", -1)) != self.max_len):
+            raise plan_mod.PlanError(
+                f"plan log {path}: geometry "
+                f"{meta.get('max_batch')}x{meta.get('p_max')} != "
+                f"engine {self.max_batch}x{self.max_len}; "
+                f"joiner must not serve"
+            )
+        mirror, retained = plan_mod.rebuild_mirror(
+            meta, vecs, source=path
+        )
+        self.mirror = mirror
+        self._retained.update(retained)
+
+    def _ride_resize(self, now_ms):
+        """Survive a membership change mid-serve (the tentpole of the
+        epoch-survival ladder, docs/failure-semantics.md).
+
+        Every survivor: wait the resize out, swallow the pending
+        ``WorldResized`` health signal, re-resolve tuning on the new
+        fingerprint (collective), and re-shard the model.  The leader
+        additionally reissues every in-slot request — the KV cache and
+        slot state died with the old epoch, but re-generation is
+        deterministic (greedy argmax), completions are delivered
+        exactly once, and ``req.emitted`` marks the reissue point for
+        audits — then restarts the plan log so late joiners rebuild
+        from the post-reissue state the whole world agrees on.  If the
+        old leader is among the dead, the lowest surviving rank
+        promotes itself first (:meth:`_promote`).
+
+        Returns True (keep stepping) for survivors, False when this
+        rank itself was retired from the membership."""
+        from mpi4jax_tpu.native import runtime
+
+        runtime.resize_wait()
+        try:
+            runtime.check_health()
+        except runtime.WorldResized:
+            pass  # the very epoch we are riding
+        info = runtime.world_info() or {}
+        alive = runtime.alive_ranks() or tuple(range(self.tp))
+        if self.rank not in alive:
+            # retired (or evicted) — nothing left to serve here
+            self._stopped = True
+            return False
+        runtime.refresh_after_resize()
+        was_leader = self.is_leader
+        self._leader_rank = min(alive)
+        self.is_leader = self.rank == self._leader_rank
+        if self.is_leader and not was_leader:
+            self._promote(now_ms)
+        self._rebuild_for_world(alive)
+        if self.is_leader:
+            lost = self.sched.reissue_inflight(now_ms)
+            if lost:
+                self.stats.observe_reissued(len(lost))
+            if self.plan_log:
+                # epoch commit restarts the stream: a joiner replaying
+                # it lands on the empty-slot state the reissue left
+                plan_mod.save_plan_stream(
+                    self.plan_log, [], self.max_batch, self.max_len,
+                    world=self.tp,
+                )
+            if self._scaler is not None:
+                self._scaler.resize_committed(len(alive))
+                self.stats.autoscale_state = self._scaler.state
+            if self._model_ready and not self._retire_queue and (
+                self._scaler is None
+                or self._scaler.state != autoscale_mod.DRAINING
+            ):
+                self.sched.hold_admissions(False)
+        else:
+            self.mirror.reset()
+            self._retained.clear()
+        self.stats.observe_epoch()
+        self._epoch = info.get("epoch")
+        return True
+
+    def _promote(self, now_ms):
+        """The old leader died; this (lowest surviving) rank takes over
+        the control plane.  The mirror knows which slots were live and
+        the retained map knows their prompts, so every in-flight
+        request is resubmitted to a fresh scheduler (requests only the
+        old leader had queued are gone — they were never acknowledged
+        to any other rank).  The traffic source must redirect to this
+        rank; the engine restores the serving state."""
+        sched = SlotScheduler(self.max_batch, self.max_len)
+        rows = self.mirror.rows()
+        for slot in sorted(rows):
+            rid, _pos, _end = rows[slot]
+            req = self._retained.pop(rid, None)
+            if req is None:
+                continue  # pre-join history; prompt unknown
+            req.arrival_ms = now_ms
+            req.reissues += 1
+            sched.submit(req, now_ms)
+        self._retained.clear()
+        self.sched = sched
+        self.ctrl = AdmissionController(
+            self.admit_mode, slo_ms=self.slo_ms,
+            estimator=SLOEstimator(),
+        )
+        self.mirror = None
+        self.plan_log = self._plan_log_path
+
+    def _rebuild_for_world(self, alive):
+        """Re-shard model state for the surviving membership.  The
+        engine serves only at TP-divisible world sizes; the
+        autoscaler's double/halve step policy keeps the fleet on them,
+        and a non-divisible transient (mid shrink-cascade) holds
+        admissions and carries empty plans instead of crashing."""
+        new_tp = len(alive)
+        self.tp = new_tp
+        self._rank_index = alive.index(self.rank)
+        cfg = self.cfg
+        try:
+            tfm._check_tp_divisibility(cfg, new_tp)
+            ready = True
+        except ValueError:
+            ready = False
+        self._model_ready = ready
+        self._prefill_jits = {}
+        self._decode_jit = jax.jit(self._decode_fn)
+        if ready:
+            self.hq_l = cfg.heads // new_tp
+            self.hk_l = cfg.kv_heads // new_tp
+            self.params = shard_params(
+                self._full_params, new_tp, self._rank_index
+            )
+            self.cache = jnp.zeros(
+                (cfg.layers, 2, self.max_batch, self.max_len,
+                 self.hk_l, cfg.head_dim),
+                self.params.embed.dtype,
+            )
+        elif self.sched is not None:
+            self.sched.hold_admissions(True)
+        self.toks[:] = 0
+        self._row_len[:] = 0
+
+    # ---- autoscaling (leader policy) -------------------------------------
+
+    def enable_autoscale(self, scaler=None, req_path=None,
+                         budget_ms=None, drain_clamp=8):
+        """Arm the traffic-driven scale policy (leader only).
+
+        Defaults come from the environment knobs
+        (``T4J_SCALE_UP_WINDOWS`` / ``T4J_SCALE_DOWN_OCC`` /
+        ``T4J_SCALE_DOWN_WINDOWS`` / ``T4J_SCALE_COOLDOWN_WINDOWS``,
+        floor ``T4J_MIN_WORLD``, ceiling = the boot world).
+        ``budget_ms`` is the wait the policy tolerates before growing
+        (default: half the SLO, or 1000 ms without one);
+        ``drain_clamp`` bounds each in-slot continuation during a
+        drain (``SlotScheduler.clamp_completions``)."""
+        assert self.is_leader, "autoscale policy is leader-side"
+        if scaler is None:
+            scaler = autoscale_mod.Autoscaler(
+                floor=config.min_world(),
+                ceiling=self.tp,
+                up_windows=config.scale_up_windows(),
+                down_occ=config.scale_down_occ(),
+                down_windows=config.scale_down_windows(),
+                cooldown_windows=config.scale_cooldown_windows(),
+            )
+        self._scaler = scaler
+        self._autoscale_req = (req_path if req_path is not None
+                               else config.autoscale_req_path())
+        if budget_ms is not None:
+            self._budget_ms = float(budget_ms)
+        elif self.slo_ms:
+            self._budget_ms = 0.5 * self.slo_ms
+        else:
+            self._budget_ms = 1000.0
+        self._drain_clamp = int(drain_clamp)
+        self.stats.autoscale_state = scaler.state
+        return scaler
+
+    def disable_autoscale(self):
+        """Disarm the scale policy (e.g. between interleaved bench
+        arms).  Releases any in-progress drain so a static arm is not
+        served with held admissions."""
+        if self.is_leader and self.sched.admissions_held:
+            # resume admissions; already-clamped slots just finish
+            # early (DONE, not shed)
+            self.sched.hold_admissions(False)
+        if self._scaler is not None:
+            self._scaler = None
+            self.stats.autoscale_state = "off"
+        self._autoscale_req = None
+        self._retire_queue = []
+
+    def autoscale_window(self, now_ms=None):
+        """Feed the policy one decision window (call at a cadence much
+        coarser than the step loop).  Grow decisions are posted to the
+        launcher's request file; drain decisions hold admissions and
+        clamp in-slot horizons; a completed drain arms the retire
+        cascade.  Returns the :class:`~.autoscale.AutoscaleDecision`,
+        or None when nothing was decided this window."""
+        if self._scaler is None or not self.is_leader:
+            return None
+        if now_ms is None:
+            now_ms = time.monotonic() * 1e3
+        occ = self.sched.occupancy() / float(self.max_batch)
+        if self._scaler.state == autoscale_mod.DRAINING:
+            if self.sched.occupancy() == 0:
+                dec = self._scaler.drain_complete()
+                self._retire_queue = list(dec.victims)
+                self.stats.autoscale_state = self._scaler.state
+                return dec
+            return None
+        depth = self.sched.queue_depth()
+        est = self.ctrl.estimator
+        queued = self.sched.queued()
+        if queued:
+            head = queued[0]
+            pred = est.predict_ms(
+                head.prompt_len, head.max_new, depth - 1,
+                self.sched.occupancy(), self.max_batch,
+                residual_ms=est.residual_service_ms(
+                    self.sched.active_requests()
+                ),
+            )
+        else:
+            pred = 0.0
+        dec = self._scaler.observe(
+            predicted_wait_ms=pred, budget_ms=self._budget_ms,
+            occupancy=occ, world=self._alive_world(),
+        )
+        if dec.action == "grow":
+            if self._autoscale_req:
+                autoscale_mod.post_request(
+                    self._autoscale_req, dec.target_world,
+                    self._epoch or 0, dec.reason,
+                )
+        elif dec.action == "drain":
+            self.sched.hold_admissions(True)
+            self.sched.clamp_completions(self._drain_clamp)
+        self.stats.autoscale_state = self._scaler.state
+        return dec
+
+    def _alive_world(self):
+        try:
+            from mpi4jax_tpu.native import runtime
+
+            n = runtime.effective_world_size()
+            return int(n) if n else self.tp
+        except Exception:
+            return self.tp
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -616,10 +962,14 @@ class ServingEngine:
         self._leader_step(now_ms, stop=True)
 
     def run_follower(self):
-        """Follower loop: execute broadcast plans until the stop
-        plan.  Returns the completions seen on this rank."""
+        """Follower loop: execute broadcast plans until the stop plan
+        (or until a resize promotes this rank — then control returns
+        to the caller, which must drive the leader side).  Returns the
+        completions seen on this rank."""
         assert not self.is_leader
-        while self._follower_step():
-            pass
-        self._stopped = True
+        while not self._stopped:
+            if self.is_leader:
+                return self.finished  # promoted mid-loop
+            if not self.step():
+                break
         return self.finished

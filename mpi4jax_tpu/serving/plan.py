@@ -10,7 +10,7 @@ plane; followers decode and execute.
 
 Layout (``plan_words(max_batch, p_max)`` words total)::
 
-    [0] MAGIC            [1] step index        [2] flags (bit0 = stop)
+    [0] MAGIC            [1] step index        [2] flags
     [3] n_admissions     [4] n_decode          [5] scheduler digest
     [6 .. 6+5*max_batch) admission entries (slot, rid, p_len,
                          max_new, deadline_ms or -1), -1-padded
@@ -18,6 +18,14 @@ Layout (``plan_words(max_batch, p_max)`` words total)::
     [.. +max_batch)      decode positions,    -1-padded
     [.. +max_batch*p_max) admitted prompts' token ids, row per
                          admission slot order, -1-padded
+
+``flags`` bit 0 is *stop* (followers leave the serve loop after this
+step); bits 1+ carry ``retire_rank + 1`` — the autoscaler's
+drain-then-shrink handshake: a plan with ``retire == r`` tells rank
+``r`` (and only rank ``r``) to exit cleanly after executing the step,
+which the launcher's elastic loop observes as a scale-down.  Plans
+recorded before this field existed have flags 0/1 and decode with
+``retire is None`` — old streams stay replayable.
 
 The ``scheduler digest`` is the leader's
 :meth:`SlotScheduler.state_digest` BEFORE applying the plan: a
@@ -32,8 +40,8 @@ from .request import Request
 
 __all__ = ["MAGIC", "PlanError", "append_plan_stream", "decode_plan",
            "encode_plan", "follower_request", "load_plan_stream",
-           "plan_stream_schedule", "plan_words", "replay_stream",
-           "save_plan_stream"]
+           "plan_stream_schedule", "plan_words", "rebuild_mirror",
+           "replay_stream", "save_plan_stream"]
 
 MAGIC = 0x74346A53  # "t4jS"
 
@@ -55,12 +63,15 @@ def plan_words(max_batch, p_max):
     return _HEADER + 5 * max_batch + 2 * max_batch + max_batch * p_max
 
 
-def encode_plan(plan, max_batch, p_max, digest, stop=False):
+def encode_plan(plan, max_batch, p_max, digest, stop=False,
+                retire=None):
     """Scheduler :class:`~.scheduler.StepPlan` -> list of ints.
 
     ``digest`` is the leader scheduler's pre-plan state digest.  A
     ``stop=True`` plan tells followers to leave the serve loop after
-    this step (its admissions/decode lists are usually empty)."""
+    this step (its admissions/decode lists are usually empty).
+    ``retire`` names one rank that should exit cleanly after this step
+    — the autoscaler's drained-victim handoff."""
     n_admit = len(plan.admissions)
     n_decode = len(plan.decode_slots)
     if n_admit > max_batch or n_decode > max_batch:
@@ -68,7 +79,12 @@ def encode_plan(plan, max_batch, p_max, digest, stop=False):
             f"plan exceeds max_batch={max_batch}: "
             f"{n_admit} admissions, {n_decode} decodes"
         )
-    vec = [MAGIC, int(plan.step), 1 if stop else 0, n_admit, n_decode,
+    flags = 1 if stop else 0
+    if retire is not None:
+        if int(retire) < 0:
+            raise PlanError(f"retire rank must be >= 0, got {retire}")
+        flags |= (int(retire) + 1) << 1
+    vec = [MAGIC, int(plan.step), flags, n_admit, n_decode,
            int(digest)]
     for slot, req in plan.admissions:
         if req.prompt_len > p_max:
@@ -92,10 +108,11 @@ def encode_plan(plan, max_batch, p_max, digest, stop=False):
 
 
 def decode_plan(vec, max_batch, p_max, expect_digest=None):
-    """Int vector -> dict with keys ``step``, ``stop``,
-    ``admissions`` (list of ``(slot, rid, p_len, max_new,
-    deadline_ms-or-None)``), ``prompts`` (token tuple per admission),
-    ``decode_slots``, ``positions``.
+    """Int vector -> dict with keys ``step``, ``stop``, ``retire``
+    (rank told to exit after this step, or ``None``), ``admissions``
+    (list of ``(slot, rid, p_len, max_new, deadline_ms-or-None)``),
+    ``prompts`` (token tuple per admission), ``decode_slots``,
+    ``positions``.
 
     ``expect_digest`` is the follower's own mirrored-scheduler digest;
     a mismatch raises :class:`PlanError` naming the step (state drift
@@ -143,9 +160,11 @@ def decode_plan(vec, max_batch, p_max, expect_digest=None):
                 f"admission {i}"
             )
         prompts.append(tuple(row))
+    retire = (flags >> 1) - 1
     return {
         "step": step,
         "stop": bool(flags & 1),
+        "retire": None if retire < 0 else retire,
         "admissions": admissions,
         "prompts": prompts,
         "decode_slots": decode_slots,
@@ -287,6 +306,69 @@ def replay_stream(meta, vecs, source="<plan-stream>"):
         if decoded["stop"]:
             break
     return findings
+
+
+def rebuild_mirror(meta, vecs, source="<plan-stream>",
+                   expect_digest=None):
+    """Rebuild a live :class:`~.scheduler.FollowerMirror` from a
+    recorded plan stream — the late joiner's bootstrap (docs/
+    failure-semantics.md): an expansion rank admitted into a serving
+    epoch replays the leader's plan log through the literal follower
+    code path and starts serving only if every step's digest agreed.
+
+    Unlike :func:`replay_stream` (offline triage, returns Findings)
+    this RAISES :class:`PlanError` on any drift — a joiner with a
+    divergent mirror must not serve a single step.  Returns
+    ``(mirror, requests)`` where ``requests`` maps rid ->
+    :class:`Request` for every request still holding a slot (what the
+    joiner needs to decode their remaining tokens, and what a promoted
+    leader reissues).  ``expect_digest`` optionally pins the final
+    mirror digest to the leader's current one (fetched out-of-band) —
+    the digest-agreement gate before the first served step."""
+    from .scheduler import FollowerMirror, SchedulerError
+
+    max_batch = int(meta["max_batch"])
+    p_max = int(meta["p_max"])
+    mirror = FollowerMirror(max_batch, p_max)
+    requests = {}
+    for i, vec in enumerate(vecs):
+        try:
+            decoded = decode_plan(
+                vec, max_batch, p_max,
+                expect_digest=mirror.state_digest(),
+            )
+            admitted, finished = mirror.apply(decoded)
+        except (PlanError, SchedulerError) as exc:
+            raise PlanError(
+                f"{source}: mirror rebuild diverged at stream entry "
+                f"{i}: {exc}"
+            )
+        for slot, rid, prompt, max_new in admitted:
+            dl = next(
+                d for s, r, _p, _m, d in decoded["admissions"]
+                if r == rid
+            )
+            requests[rid] = follower_request(rid, prompt, max_new,
+                                             deadline_ms=dl)
+            done = mirror.prefill_done(slot)
+            if done is not None:
+                finished = list(finished) + [done]
+        for _slot, rid in finished:
+            requests.pop(rid, None)
+        if decoded["stop"]:
+            break
+    alive = {row[0] for row in mirror.rows().values()}
+    requests = {rid: req for rid, req in requests.items()
+                if rid in alive}
+    if expect_digest is not None:
+        got = mirror.state_digest()
+        if got != int(expect_digest):
+            raise PlanError(
+                f"{source}: rebuilt mirror digest {got:#x} != leader's "
+                f"{int(expect_digest):#x} — plan log is stale or "
+                "truncated; joiner must not serve"
+            )
+    return mirror, requests
 
 
 def plan_stream_schedule(meta, vecs, source="<plan-stream>"):

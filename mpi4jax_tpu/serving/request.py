@@ -39,6 +39,7 @@ class Request:
         "rid", "prompt", "max_new", "arrival_ms", "deadline_ms",
         "state", "slot", "last_slot", "generated", "admitted_ms",
         "first_token_ms", "done_ms", "shed_reason",
+        "reissues", "emitted",
     )
 
     def __init__(self, rid, prompt, max_new, arrival_ms,
@@ -65,6 +66,16 @@ class Request:
         self.first_token_ms = None
         self.done_ms = None
         self.shed_reason = None
+        # elastic epoch survival (docs/failure-semantics.md): how many
+        # times this request was reissued after a resize wiped its slot
+        # state, and how many leading tokens had already been emitted
+        # to the client before the loss.  Re-generation is
+        # deterministic (greedy argmax), so the engine re-runs the
+        # request from its prompt but only emits tokens at index >=
+        # ``emitted`` — the rid+position dedupe contract: completed
+        # tokens are never re-emitted.
+        self.reissues = 0
+        self.emitted = 0
 
     @property
     def prompt_len(self):

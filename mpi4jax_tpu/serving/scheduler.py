@@ -15,9 +15,15 @@ time and executes the plans; tests drive it step by step.
 
 Invariant (checked by :meth:`SlotScheduler.check_accounting`): every
 submitted request is in exactly one of queued / holding-a-slot /
-done / shed.  A violated invariant raises :class:`SchedulerError`
-instead of silently leaking a slot — a leaked slot is capacity the
-admission controller thinks it has.
+done / shed, with each elastic *reissue* (a resize wiped a slot's
+state and the request went back to the queue) counted as a fresh
+submission balanced by the ``reissued`` counter:
+
+    queued + in_slots + done + shed + reissued == submitted
+
+A violated invariant raises :class:`SchedulerError` instead of
+silently leaking a slot — a leaked slot is capacity the admission
+controller thinks it has.
 """
 
 from collections import deque
@@ -107,10 +113,12 @@ class SlotScheduler:
         self._slots = [_Slot() for _ in range(self.max_batch)]
         self._queue = deque()
         self._step = 0
+        self._hold = False  # admissions held (autoscale drain)
         # accounting
         self.submitted = 0
         self.completed = 0
         self.shed = 0
+        self.reissued = 0
         self.finished = []  # completed Requests, engine drains this
 
     # ---- queue side ------------------------------------------------------
@@ -185,7 +193,7 @@ class SlotScheduler:
         :meth:`prefill_done` (same step — prefill yields the first
         generated token)."""
         admissions = []
-        free = self.free_slots()
+        free = [] if self._hold else self.free_slots()
         while (self._queue and free
                and len(admissions) < self.max_prefill_per_step):
             req = self._queue.popleft()
@@ -266,6 +274,83 @@ class SlotScheduler:
         self.completed += 1
         self.finished.append(req)
 
+    # ---- elastic epoch survival -----------------------------------------
+
+    def hold_admissions(self, hold=True):
+        """Stop (or resume) admitting queued requests into free slots.
+        Used by the autoscaler's drain phase: in-flight requests run to
+        completion while the batch empties, and by the resize window
+        itself (no request should enter a slot the next epoch will not
+        remember)."""
+        self._hold = bool(hold)
+
+    @property
+    def admissions_held(self):
+        return self._hold
+
+    def clamp_completions(self, max_remaining):
+        """Clamp every occupied slot to at most ``max_remaining`` more
+        generated tokens — the autoscaler's drain bound.  Requests
+        still complete through the normal :meth:`step_done` path (DONE,
+        not shed; ``generated`` reflects what they actually got), the
+        drain just finishes within a known number of steps instead of
+        waiting out the longest continuation.  Returns the number of
+        slots whose horizon actually moved."""
+        if max_remaining < 0:
+            raise ValueError(
+                f"max_remaining must be >= 0, got {max_remaining}"
+            )
+        clamped = 0
+        for s in self._slots:
+            if s.req is None:
+                continue
+            new_end = s.pos + int(max_remaining)
+            if new_end < s.end:
+                s.end = new_end
+                clamped += 1
+        return clamped
+
+    def snapshot_inflight(self):
+        """The requests currently holding slots, as ``(slot, Request)``
+        pairs — the leader's pre-resize snapshot (engine epoch
+        survival) and the promotion handoff's source of truth."""
+        return [
+            (i, s.req)
+            for i, s in enumerate(self._slots)
+            if s.req is not None
+        ]
+
+    def reissue_inflight(self, now_ms):
+        """A resize wiped the KV/slot state: return every in-slot
+        request to the FRONT of the queue (they were admitted first;
+        they re-enter first) and free all slots.
+
+        Each reissued request remembers how many tokens it had already
+        emitted (``req.emitted``) so the engine's dedupe-on-rid+position
+        contract holds: re-generation is deterministic, and only tokens
+        past the reissue point are emitted again.  Accounting-wise a
+        reissue is a fresh submission balanced by ``reissued`` — see
+        :meth:`check_accounting`.  Returns the reissued requests in
+        re-queue order."""
+        lost = self.snapshot_inflight()
+        out = []
+        # Reverse so appendleft preserves slot order at the queue head.
+        for slot, req in reversed(lost):
+            s = self._slots[slot]
+            req.emitted = max(req.emitted, req.generated)
+            req.reissues += 1
+            req.state = RequestState.QUEUED
+            req.slot = None
+            req.generated = 0
+            s.req = None
+            s.pos = s.end = 0
+            self._queue.appendleft(req)
+            self.submitted += 1
+            self.reissued += 1
+            out.append(req)
+        out.reverse()
+        return out
+
     # ---- lifecycle -------------------------------------------------------
 
     def idle(self):
@@ -276,16 +361,20 @@ class SlotScheduler:
 
     def check_accounting(self):
         """Raise :class:`SchedulerError` unless every submitted request
-        is queued, in a slot, done, or shed — the request-leak check
-        shutdown runs (tests/proc/test_serving_proc.py pins it)."""
+        is queued, in a slot, done, or shed — with each elastic reissue
+        counted as a fresh submission balanced by ``reissued`` — the
+        request-leak check shutdown runs (tests/proc/
+        test_serving_proc.py pins it; tools/autoscale_smoke.py asserts
+        it on every rank at every epoch)."""
         in_slots = sum(1 for s in self._slots if s.req is not None)
         total = (len(self._queue) + in_slots + self.completed
-                 + self.shed)
+                 + self.shed + self.reissued)
         if total != self.submitted:
             raise SchedulerError(
                 f"request leak: submitted={self.submitted} but "
                 f"queued={len(self._queue)} + in_slots={in_slots} + "
-                f"done={self.completed} + shed={self.shed} = {total}"
+                f"done={self.completed} + shed={self.shed} + "
+                f"reissued={self.reissued} = {total}"
             )
         return True
 
@@ -378,3 +467,15 @@ class FollowerMirror:
 
     def idle(self):
         return not self._rows
+
+    def rows(self):
+        """Occupied slots as ``{slot: (rid, pos, end)}`` — read-only
+        copy for promotion (a follower elected leader after rank 0
+        died rebuilds a :class:`SlotScheduler` from its mirror plus the
+        per-rid requests it retained) and for rebuild verification."""
+        return {i: tuple(r) for i, r in self._rows.items()}
+
+    def reset(self):
+        """Drop every mirrored slot (resize wiped the KV state; the
+        new epoch's plans re-admit from scratch)."""
+        self._rows.clear()

@@ -97,6 +97,11 @@ class ServingStats:
         self.queue_depth = 0
         self.occupancy = 0
         self.steps = 0
+        # elastic epoch survival: reissued in-flight requests and the
+        # number of resize epochs this engine rode out.
+        self.reissued = 0
+        self.epochs_survived = 0
+        self.autoscale_state = None  # autoscaler state string, or None
 
     # ---- engine feed -----------------------------------------------------
 
@@ -122,6 +127,15 @@ class ServingStats:
         self.steps += 1
         self.queue_depth = int(queue_depth)
         self.occupancy = int(occupancy)
+
+    def observe_reissued(self, n):
+        """``n`` in-flight requests went back to the queue after a
+        resize wiped their slot state (docs/failure-semantics.md)."""
+        self.reissued += int(n)
+
+    def observe_epoch(self):
+        """The engine survived one resize epoch."""
+        self.epochs_survived += 1
 
     # ---- gauges ----------------------------------------------------------
 
@@ -169,6 +183,9 @@ class ServingStats:
             "submitted": self.submitted,
             "completed": self.completed,
             "shed": self.shed,
+            "reissued": self.reissued,
+            "epochs_survived": self.epochs_survived,
+            "autoscale_state": self.autoscale_state,
             "shed_by_reason": dict(self.shed_by_reason),
             "slo_ok": self.slo_ok,
             "slo_attainment": self.slo_attainment(),

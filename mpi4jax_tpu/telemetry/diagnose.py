@@ -739,6 +739,11 @@ def diagnose(views, ring_min_bytes=None, leader_ring_min_bytes=None,
             "critical_excess_ms": scores[0][0] if scores else 0.0,
             "overlap_pct": (round(sum(overlaps) / len(overlaps), 1)
                             if overlaps else None),
+            # an elastic membership epoch committed under this step on
+            # at least one rank: its slowdown is the resize, and the
+            # phase attribution above will say so instead of blaming
+            # link repair (docs/failure-semantics.md)
+            "spans_resize": any(r["resize_ms"] > 0 for r in per_rank),
             "ranks": per_rank,
         })
 
@@ -975,8 +980,18 @@ _DIFF_KEYS = (
 def diff_reports(cur, base):
     """A/B delta between two ``--json`` reports: summary metrics with
     relative change (sign-aware: overlap up = better, times down =
-    better), straggler movement, and per-link stall deltas."""
+    better), straggler movement, and per-link stall deltas.
+
+    Arms with DIFFERENT world sizes (an autoscaled arm against a
+    static one, or an elastic job that shrank) diff honestly: a link
+    whose endpoint does not exist in the other arm's world gets
+    ``delta_ms: None`` and an ``only_in`` tag instead of a signed
+    delta — a rank that was never booted is membership, not an
+    improvement or regression."""
     out = {"schema": DIAG_SCHEMA + "+diff", "metrics": [], "links": []}
+    base_world = int(base.get("ranks") or 0)
+    cur_world = int(cur.get("ranks") or 0)
+    out["world"] = {"base": base_world, "cur": cur_world}
     for key, label, higher_better in _DIFF_KEYS:
         a = base.get("summary", {}).get(key)
         b = cur.get("summary", {}).get(key)
@@ -1007,14 +1022,29 @@ def diff_reports(cur, base):
         "base": base.get("summary", {}).get("straggler"),
         "cur": cur.get("summary", {}).get("straggler"),
     }
+    def in_world(rank, peer, world):
+        # a world size of 0 means the report predates the field;
+        # assume comparable rather than suppressing every delta
+        return world <= 0 or (rank < world and peer < world)
+
     base_links = {(r["rank"], r["peer"]): r
                   for r in base.get("links", ())}
     for link in cur.get("links", ()):
         key = (link["rank"], link["peer"])
         prev = base_links.pop(key, None)
+        cur_ms = link["pacing_ms"] + link["repair_ms"]
+        if prev is None and not in_world(*key, base_world):
+            # this link's endpoint was never part of the base arm's
+            # world: membership difference, not a regression
+            out["links"].append({
+                "rank": link["rank"], "peer": link["peer"],
+                "base_stall_ms": None,
+                "cur_stall_ms": round(cur_ms, 3),
+                "delta_ms": None, "only_in": "cur",
+            })
+            continue
         prev_ms = ((prev["pacing_ms"] + prev["repair_ms"])
                    if prev else 0.0)
-        cur_ms = link["pacing_ms"] + link["repair_ms"]
         out["links"].append({
             "rank": link["rank"], "peer": link["peer"],
             "base_stall_ms": round(prev_ms, 3),
@@ -1023,6 +1053,16 @@ def diff_reports(cur, base):
         })
     for (rank, peer), prev in sorted(base_links.items()):
         prev_ms = prev["pacing_ms"] + prev["repair_ms"]
+        if not in_world(rank, peer, cur_world):
+            # the endpoint does not exist in the current arm's world:
+            # its stall did not "vanish", the rank did
+            out["links"].append({
+                "rank": rank, "peer": peer,
+                "base_stall_ms": round(prev_ms, 3),
+                "cur_stall_ms": None,
+                "delta_ms": None, "only_in": "base",
+            })
+            continue
         out["links"].append({
             "rank": rank, "peer": peer,
             "base_stall_ms": round(prev_ms, 3), "cur_stall_ms": 0.0,
@@ -1218,7 +1258,16 @@ def render_diff(diff):
                    f"r{stra['cur']}")
     else:
         out.append(f"  straggler unchanged: {stra['base']}")
-    moved = [link for link in diff["links"] if abs(link["delta_ms"]) > 1.0]
+    world = diff.get("world") or {}
+    if world and world.get("base") != world.get("cur"):
+        out.append(
+            f"  world differs: base={world['base']} ranks, "
+            f"cur={world['cur']} ranks (membership-only links "
+            f"excluded from deltas)"
+        )
+    moved = [link for link in diff["links"]
+             if link["delta_ms"] is not None
+             and abs(link["delta_ms"]) > 1.0]
     for link in sorted(moved, key=lambda r: -abs(r["delta_ms"]))[:8]:
         out.append(
             f"  link r{link['rank']}->r{link['peer']}: stall "

@@ -269,6 +269,16 @@ def render_prometheus(obj, prefix="t4j"):
         emit("world_resizing", base,
              1 if wi.get("resizing") else 0,
              help_="1 while a membership agreement/rebuild is running")
+        # canonical autoscaler-facing name for the same signal
+        # (docs/serving.md "Autoscaling"): kept alongside
+        # world_resizing so older dashboards keep working
+        emit("resize_in_progress", base,
+             1 if wi.get("resizing") else 0,
+             help_="1 while a membership agreement/rebuild is running")
+        emit("world_epoch_transitions_total", base,
+             wi.get("epoch_transitions"),
+             help_="resize epochs this process observed and survived",
+             type_="counter")
     sv = obj.get("serving") or {}
     if sv:
         # serving gauges (docs/serving.md): the continuous-batching
@@ -286,6 +296,13 @@ def render_prometheus(obj, prefix="t4j"):
              help_="requests completed", type_="counter")
         emit("serving_shed_total", base, sv.get("shed"),
              help_="requests shed by admission control",
+             type_="counter")
+        emit("serving_reissued_total", base, sv.get("reissued"),
+             help_="in-flight requests reissued after a resize wiped "
+                   "their slot state", type_="counter")
+        emit("serving_epochs_survived_total", base,
+             sv.get("epochs_survived"),
+             help_="resize epochs the serving engine rode out",
              type_="counter")
         for q in ("p50", "p99"):
             v = sv.get(f"latency_{q}_ms")
@@ -365,11 +382,22 @@ def aggregate_snapshots(objs, job=""):
             if not serving:
                 serving = dict(sv)
     # elastic membership: the freshest epoch any rank reports wins
-    # (mid-resize scrapes can catch ranks on both sides of the fence)
+    # (mid-resize scrapes can catch ranks on both sides of the fence);
+    # resize_in_progress is an ANY — one rank still rebuilding means
+    # the job is mid-transition; transitions is a MAX — survivors
+    # carry the full count, a rejoined replacement restarts at 0
     world = {}
+    any_resizing = False
+    max_transitions = 0
     for obj in objs:
         wi = obj.get("world_info") or {}
-        if wi and int(wi.get("epoch", 0)) >= int(world.get("epoch", -1)):
+        if not wi:
+            continue
+        any_resizing = any_resizing or bool(wi.get("resizing"))
+        max_transitions = max(
+            max_transitions, int(wi.get("epoch_transitions", 0) or 0)
+        )
+        if int(wi.get("epoch", 0)) >= int(world.get("epoch", -1)):
             world = wi
     departed = []
     if world:
@@ -390,6 +418,8 @@ def aggregate_snapshots(objs, job=""):
         "worst_link": worst,
         "world_size": world.get("alive_count"),
         "world_epoch": world.get("epoch"),
+        "resize_in_progress": any_resizing if world else None,
+        "epoch_transitions": max_transitions if world else None,
         "departed_ranks": departed,
         "serving": serving,
         "serving_ranks": serving_ranks,
@@ -457,6 +487,15 @@ def render_prometheus_job(agg, prefix="t4j_job"):
         # marked series instead of silently flatlining
         lines.append(f"t4j_world_size {agg['world_size']}")
         lines.append(f"t4j_world_epoch {agg['world_epoch']}")
+        lines.append(
+            "t4j_resize_in_progress "
+            f"{1 if agg.get('resize_in_progress') else 0}"
+        )
+        if agg.get("epoch_transitions") is not None:
+            lines.append(
+                "t4j_world_epoch_transitions_total "
+                f"{agg['epoch_transitions']}"
+            )
         for r in agg.get("departed_ranks", []):
             lines.append(f't4j_rank_departed{{rank="{r}"}} 1')
     return "\n".join(lines) + "\n"

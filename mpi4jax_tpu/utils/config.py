@@ -202,6 +202,16 @@ Serving (docs/serving.md — the continuous-batching inference loop):
                       uncontrolled baseline) or ``on`` (token-bucket
                       + SLO-estimator admission: predicted deadline
                       misses are shed at the door, and counted).
+* ``T4J_AUTOSCALE`` — ``off`` (default) or ``on``: traffic-driven
+                      elastic autoscaling of the serving world
+                      (docs/serving.md "Autoscaling"); requires
+                      ``T4J_ELASTIC=rejoin``.
+* ``T4J_SCALE_UP_WINDOWS`` / ``T4J_SCALE_DOWN_WINDOWS`` /
+  ``T4J_SCALE_DOWN_OCC`` / ``T4J_SCALE_COOLDOWN_WINDOWS`` — the
+                      autoscaler's hysteresis pair, shrink threshold
+                      and flap-suppression cooldown.
+* ``T4J_AUTOSCALE_REQ`` — grow-request file the leader posts and
+                      ``launch.py --autoscale`` polls.
 
 The byte knobs accept an optional K/M/G suffix
 (``T4J_SEG_BYTES=256K``) and all of them must be uniform across ranks
@@ -250,6 +260,12 @@ __all__ = [
     "slo_ms",
     "max_batch",
     "admit_mode",
+    "autoscale_mode",
+    "scale_up_windows",
+    "scale_down_occ",
+    "scale_down_windows",
+    "scale_cooldown_windows",
+    "autoscale_req_path",
     "telemetry_mode",
     "telemetry_bytes",
     "telemetry_dir",
@@ -777,6 +793,104 @@ def admit_mode():
             f"cannot interpret T4J_ADMIT={v!r} (want off|on)"
         )
     return v
+
+
+def autoscale_mode():
+    """Traffic-driven elastic autoscaling for the serving engine
+    (docs/serving.md "Autoscaling"): ``off`` (default — the world size
+    is whatever the launcher started) or ``on`` (the leader's
+    :class:`serving.autoscale.Autoscaler` grows/shrinks the world from
+    the SLO estimator's load signal).  Anything else raises — a typo'd
+    mode must fail at launch, not silently serve at fixed capacity
+    while the operator believes the fleet is elastic."""
+    v = os.environ.get("T4J_AUTOSCALE")
+    if v is None or not str(v).strip():
+        return "off"
+    v = str(v).strip().lower()
+    if v not in ("off", "on"):
+        raise ValueError(
+            f"cannot interpret T4J_AUTOSCALE={v!r} (want off|on)"
+        )
+    return v
+
+
+def scale_up_windows():
+    """Consecutive decision windows of predicted-wait-over-budget
+    before the autoscaler requests a grow (default 3, must be >= 1).
+    The scale-up half of the hysteresis pair — one bad window is
+    noise, a streak is a trend (docs/serving.md "Autoscaling")."""
+    v = int_count(os.environ.get("T4J_SCALE_UP_WINDOWS"), 3,
+                  name="T4J_SCALE_UP_WINDOWS")
+    if v < 1:
+        raise ValueError(
+            "T4J_SCALE_UP_WINDOWS must be >= 1 (a grow needs at least "
+            "one qualifying window)"
+        )
+    return v
+
+
+def scale_down_occ():
+    """Batch-occupancy fraction below which a window counts toward
+    scale-down (default 0.35, must be in [0, 1)).  1 would make every
+    window qualify whenever a single slot is free — the shrink trigger
+    must mean 'mostly idle', not 'not perfectly full'."""
+    raw = os.environ.get("T4J_SCALE_DOWN_OCC")
+    if raw is None or not str(raw).strip():
+        return 0.35
+    try:
+        v = float(str(raw).strip())
+    except ValueError:
+        raise ValueError(
+            f"cannot interpret T4J_SCALE_DOWN_OCC={raw!r} as a "
+            "fraction"
+        ) from None
+    if not (math.isfinite(v) and 0.0 <= v < 1.0):
+        raise ValueError(
+            f"T4J_SCALE_DOWN_OCC={v} out of range (want 0 <= occ < 1)"
+        )
+    return v
+
+
+def scale_down_windows():
+    """Consecutive low-occupancy windows before the autoscaler starts
+    a drain (default 6, must be >= 1).  Deliberately defaulted above
+    T4J_SCALE_UP_WINDOWS: capacity should arrive eagerly and leave
+    reluctantly — a shrink the next ramp immediately undoes costs a
+    full resize epoch both ways."""
+    v = int_count(os.environ.get("T4J_SCALE_DOWN_WINDOWS"), 6,
+                  name="T4J_SCALE_DOWN_WINDOWS")
+    if v < 1:
+        raise ValueError(
+            "T4J_SCALE_DOWN_WINDOWS must be >= 1 (a shrink needs at "
+            "least one qualifying window)"
+        )
+    return v
+
+
+def scale_cooldown_windows():
+    """Refractory windows after any resize commit during which the
+    autoscaler accumulates no streaks (default 4, must be >= 0) — the
+    flap suppressor: post-resize windows measure a world still
+    refilling its batch, and acting on them oscillates."""
+    v = int_count(os.environ.get("T4J_SCALE_COOLDOWN_WINDOWS"), 4,
+                  name="T4J_SCALE_COOLDOWN_WINDOWS")
+    if v < 0:
+        raise ValueError(
+            "T4J_SCALE_COOLDOWN_WINDOWS must be >= 0"
+        )
+    return v
+
+
+def autoscale_req_path():
+    """Path of the grow-request file the serving leader posts and
+    ``launch.py --autoscale`` polls (serving/autoscale.py), or ``None``
+    when unset.  The launcher sets it for every rank; a leader with no
+    path simply cannot request grows (shrinks still work — they ride
+    the in-band plan retire flag)."""
+    v = os.environ.get("T4J_AUTOSCALE_REQ")
+    if v is None or not str(v).strip():
+        return None
+    return str(v).strip()
 
 
 _TELEMETRY_MODES = ("off", "counters", "trace")

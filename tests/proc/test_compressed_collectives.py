@@ -274,6 +274,86 @@ def test_error_feedback_residuals(nprocs):
         assert f"EF-CONVERGE-OK {r}" in out, out
 
 
+EF_RESET_WORKER = """
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m
+from mpi4jax_tpu.native import runtime
+from mpi4jax_tpu.ops.allreduce import BucketedGradSync
+
+comm = m.get_default_comm()
+assert comm.backend == "proc", comm.backend
+n, rank = comm.size, comm.rank()
+
+runtime.set_wire_dtype("bf16")
+sync = BucketedGradSync(comm=comm, average=True)
+
+# a bf16-NON-representable constant builds a nonzero residual carry.
+# Two build-up steps leave res = 2**-9, so a third sync WITH the carry
+# quantises to 1 + 2**-8 while a fresh sync emits 1.0 — the carry is
+# observable in the output bytes, which is what makes the drop/keep
+# assertions below discriminating.
+g = 1.0 + 2.0 ** -10
+grads = {"w": jnp.full((64,), g, jnp.float32)}
+
+res = {}
+for _ in range(2):
+    _out, _tok, res = sync.sync(grads, residuals=res)
+assert "_world" in res, ("sync did not stamp the residual dict",
+                         sorted(map(str, res)))
+assert any(np.any(np.asarray(v)) for k, v in res.items()
+           if k != "_world"), "test needs a nonzero residual carry"
+
+# fresh-sync oracle: what the first step after a residual reset emits
+fresh_out, _t, _r = sync.sync(grads, residuals={})
+fresh = np.asarray(fresh_out["w"]).tobytes()
+
+# tamper the stamp: pretend the carried dict was quantised under a
+# different membership epoch — the first post-resize sync must DROP
+# the carry (emit the fresh-sync bytes), not fold it in, not crash
+ep, alive = res["_world"]
+stale = dict(res)
+stale["_world"] = (ep + 1, max(1, alive - 1))
+out, _tok, new_res = sync.sync(grads, residuals=stale)
+assert np.asarray(out["w"]).tobytes() == fresh, (
+    "stale-epoch residuals were folded into the first post-resize "
+    "compressed allreduce")
+assert tuple(new_res["_world"]) == (ep, alive), new_res["_world"]
+
+# a wrong-shape bucket residual (the resized world re-bucketed the
+# pytree) is likewise dropped, never shape-errors the step
+bad = dict(res)
+bad[0] = np.ones(7, np.float32)
+out, _tok, _res = sync.sync(grads, residuals=bad)
+assert np.asarray(out["w"]).tobytes() == fresh, (
+    "wrong-shape residual changed the post-resize sync")
+
+# matching stamp: the carry still applies (the guard is not a reset
+# of EVERY step)
+out, _tok, _res = sync.sync(grads, residuals=dict(res))
+assert np.asarray(out["w"]).tobytes() != fresh, (
+    "a valid same-epoch residual carry was dropped")
+
+runtime.set_wire_dtype("off")
+print(f"EF-RESET-OK {rank}", flush=True)
+"""
+
+
+@pytest.mark.parametrize("nprocs", [2])
+def test_error_feedback_reset_on_resize_epoch(nprocs):
+    """The PR-14 sharp bit, enforced: a residual dict stamped with a
+    different world epoch is dropped at the next sync (no stale-world
+    error folded in, no shape crash), while a same-epoch carry keeps
+    working."""
+    out, _err = _run(EF_RESET_WORKER, nprocs, timeout=300)
+    for r in range(nprocs):
+        assert f"EF-RESET-OK {r}" in out, out
+
+
 FAULT_WORKER = """
 import hashlib
 

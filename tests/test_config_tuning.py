@@ -808,6 +808,143 @@ def test_ensure_initialized_rejects_bad_max_batch(monkeypatch):
         runtime.ensure_initialized()
 
 
+class TestAutoscaleMode:
+    """T4J_AUTOSCALE (docs/serving.md "Autoscaling"): off = the world
+    size is whatever the launcher started, on = the serving leader's
+    autoscaler grows/shrinks it from the SLO estimator's load signal.
+    A typo'd mode must fail at launch, not silently serve at fixed
+    capacity while the operator believes the fleet is elastic."""
+
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("T4J_AUTOSCALE", raising=False)
+        assert config.autoscale_mode() == "off"
+
+    @pytest.mark.parametrize("value,want", [
+        ("off", "off"), ("on", "on"), (" ON ", "on"), ("", "off"),
+    ])
+    def test_values(self, value, want, monkeypatch):
+        monkeypatch.setenv("T4J_AUTOSCALE", value)
+        assert config.autoscale_mode() == want
+
+    @pytest.mark.parametrize("bad", ["auto", "1", "grow", "elastic"])
+    def test_rejects_garbage(self, bad, monkeypatch):
+        monkeypatch.setenv("T4J_AUTOSCALE", bad)
+        with pytest.raises(ValueError, match="T4J_AUTOSCALE"):
+            config.autoscale_mode()
+
+
+class TestScaleUpWindows:
+    def test_default_is_3(self, monkeypatch):
+        monkeypatch.delenv("T4J_SCALE_UP_WINDOWS", raising=False)
+        assert config.scale_up_windows() == 3
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_SCALE_UP_WINDOWS", "5")
+        assert config.scale_up_windows() == 5
+
+    @pytest.mark.parametrize("bad", ["0", "-2", "few", "1.5"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        # a grow needs at least one qualifying window; a typo must not
+        # silently make every window qualify
+        monkeypatch.setenv("T4J_SCALE_UP_WINDOWS", bad)
+        with pytest.raises(ValueError, match="T4J_SCALE_UP_WINDOWS"):
+            config.scale_up_windows()
+
+
+class TestScaleDownOcc:
+    def test_default_is_035(self, monkeypatch):
+        monkeypatch.delenv("T4J_SCALE_DOWN_OCC", raising=False)
+        assert config.scale_down_occ() == pytest.approx(0.35)
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_SCALE_DOWN_OCC", "0.2")
+        assert config.scale_down_occ() == pytest.approx(0.2)
+
+    def test_zero_allowed(self, monkeypatch):
+        # occ 0 = never shrink on occupancy (a valid operator choice)
+        monkeypatch.setenv("T4J_SCALE_DOWN_OCC", "0")
+        assert config.scale_down_occ() == 0.0
+
+    @pytest.mark.parametrize("bad", ["1", "1.5", "-0.1", "nan", "low"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        # 1 would make every window with a single free slot qualify:
+        # the shrink trigger must mean "mostly idle"
+        monkeypatch.setenv("T4J_SCALE_DOWN_OCC", bad)
+        with pytest.raises(ValueError, match="T4J_SCALE_DOWN_OCC"):
+            config.scale_down_occ()
+
+
+class TestScaleDownWindows:
+    def test_default_is_6(self, monkeypatch):
+        # deliberately above the scale-up default: capacity arrives
+        # eagerly and leaves reluctantly
+        monkeypatch.delenv("T4J_SCALE_DOWN_WINDOWS", raising=False)
+        assert config.scale_down_windows() == 6
+        assert config.scale_down_windows() > 3
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setenv("T4J_SCALE_DOWN_WINDOWS", "10")
+        assert config.scale_down_windows() == 10
+
+    @pytest.mark.parametrize("bad", ["0", "-1", "lots"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        monkeypatch.setenv("T4J_SCALE_DOWN_WINDOWS", bad)
+        with pytest.raises(ValueError, match="T4J_SCALE_DOWN_WINDOWS"):
+            config.scale_down_windows()
+
+
+class TestScaleCooldownWindows:
+    def test_default_is_4(self, monkeypatch):
+        monkeypatch.delenv("T4J_SCALE_COOLDOWN_WINDOWS", raising=False)
+        assert config.scale_cooldown_windows() == 4
+
+    def test_zero_allowed(self, monkeypatch):
+        # cooldown 0 disables the refractory period (tests/benchmarks)
+        monkeypatch.setenv("T4J_SCALE_COOLDOWN_WINDOWS", "0")
+        assert config.scale_cooldown_windows() == 0
+
+    @pytest.mark.parametrize("bad", ["-1", "soon"])
+    def test_bad_value_raises(self, monkeypatch, bad):
+        monkeypatch.setenv("T4J_SCALE_COOLDOWN_WINDOWS", bad)
+        with pytest.raises(ValueError,
+                           match="T4J_SCALE_COOLDOWN_WINDOWS"):
+            config.scale_cooldown_windows()
+
+
+class TestAutoscaleReqPath:
+    def test_default_is_none(self, monkeypatch):
+        monkeypatch.delenv("T4J_AUTOSCALE_REQ", raising=False)
+        assert config.autoscale_req_path() is None
+
+    def test_env_value_stripped(self, monkeypatch):
+        monkeypatch.setenv("T4J_AUTOSCALE_REQ", " /tmp/t4j-scale.json ")
+        assert config.autoscale_req_path() == "/tmp/t4j-scale.json"
+
+    def test_blank_is_none(self, monkeypatch):
+        monkeypatch.setenv("T4J_AUTOSCALE_REQ", "   ")
+        assert config.autoscale_req_path() is None
+
+
+def test_ensure_initialized_rejects_autoscale_without_rejoin(monkeypatch):
+    """Growing the world admits a relaunched rank through the
+    kept-open coordinator port, which only T4J_ELASTIC=rejoin provides
+    — the combination fails at init, naming both knobs
+    (docs/serving.md "Autoscaling")."""
+    try:
+        from mpi4jax_tpu.native import runtime
+    except Exception as e:  # pragma: no cover - old-jax containers
+        pytest.skip(f"native runtime unavailable: {e}")
+
+    if runtime.is_initialized():
+        pytest.skip("bridge already initialised in this process")
+    monkeypatch.setenv("T4J_RANK", "0")
+    monkeypatch.setenv("T4J_SIZE", "1")
+    monkeypatch.setenv("T4J_AUTOSCALE", "on")
+    monkeypatch.setenv("T4J_ELASTIC", "shrink")
+    with pytest.raises(ValueError, match="T4J_AUTOSCALE=on"):
+        runtime.ensure_initialized()
+
+
 class TestWireDtype:
     """T4J_WIRE_DTYPE (docs/performance.md "Compressed collectives"):
     off (default, bit-identical) | bf16 | fp8, validated at launch,

@@ -531,6 +531,60 @@ class TestDiff:
         assert deltas[(2, 0)] > 0   # r2's appeared
         assert "straggler moved" in diagnose.render_diff(diff)
 
+    def _report_n(self, n, slow_rank=1, stall_ms=30.0):
+        """Like :meth:`_report` but for an ``n``-rank world (the
+        autoscaled arm of an A/B run)."""
+        def events(rank):
+            out = []
+            for k in range(4):
+                base = k * 100.0
+                out.append(ev(base, STEP, B, nbytes=k))
+                out.append(ev(base + 5.0, ALLREDUCE, B, plane=2,
+                              nbytes=1 << 20))
+                tx = 5.0 + (stall_ms if rank == slow_rank else 0.5)
+                out.append(ev(base + tx, FRAME_TX, 0,
+                              peer=(rank + 1) % n))
+                out.append(ev(base + tx + 5.0, ALLREDUCE, E, plane=2,
+                              nbytes=1 << 20))
+                out.append(ev(base + tx + 5.5, STEP, E, nbytes=k))
+            return out
+        views = [
+            diagnose.rank_view_from_obj(
+                rank_obj(r, events(r), world=n)
+            )
+            for r in range(n)
+        ]
+        return diagnose.diagnose(views)
+
+    def test_cross_world_diff_marks_membership_links(self):
+        # autoscaled arm shrank to 2 ranks: links touching rank 2 did
+        # not "improve", the rank left the world — they get delta None
+        # + only_in instead of a phantom negative delta
+        base = self._report(1)        # static 3-rank arm
+        cur = self._report_n(2)       # shrunk arm
+        diff = diagnose.diff_reports(cur, base)
+        assert diff["world"] == {"base": 3, "cur": 2}
+        gone = [lk for lk in diff["links"] if lk.get("only_in") == "base"]
+        assert gone
+        assert all(lk["delta_ms"] is None for lk in gone)
+        assert all(max(lk["rank"], lk["peer"]) >= 2 for lk in gone)
+        # links whose endpoints exist in BOTH worlds keep signed deltas
+        both = [lk for lk in diff["links"] if "only_in" not in lk]
+        assert both
+        assert all(lk["delta_ms"] is not None for lk in both)
+        json.loads(json.dumps(diff))  # None stays valid JSON
+        assert "world differs" in diagnose.render_diff(diff)
+
+    def test_cross_world_grow_links_are_membership_not_regression(self):
+        base = self._report_n(2)      # small arm
+        cur = self._report(1)         # grew to 3 ranks
+        diff = diagnose.diff_reports(cur, base)
+        new = [lk for lk in diff["links"] if lk.get("only_in") == "cur"]
+        assert new
+        assert all(lk["delta_ms"] is None for lk in new)
+        # render must not crash ranking None-delta links
+        assert "world differs" in diagnose.render_diff(diff)
+
 
 class TestCLI:
     def _write_job(self, tmp_path):
@@ -764,6 +818,28 @@ class TestResizePhase:
         )
         row = report["steps"][0]["ranks"][0]
         assert row["resize_ms"] == pytest.approx(40.0)
+
+    def test_step_spanning_resize_is_tagged(self):
+        # an autoscale epoch committing mid-serve: the slow step is
+        # attributed to the resize AND carries the spans_resize flag so
+        # dashboards/t4j-diagnose name the epoch, not a phantom link
+        # stall; the clean step after it stays untagged
+        events = [
+            ev(0.0, STEP, B, nbytes=0),
+            ev(5.0, self.RB, 0, peer=-1, nbytes=1),
+            ev(45.0, self.RD, 0, peer=3, nbytes=1),
+            ev(50.0, STEP, E, nbytes=0),
+            ev(100.0, STEP, B, nbytes=1),
+            ev(110.0, STEP, E, nbytes=1),
+        ]
+        report = diagnose.diagnose(
+            [diagnose.rank_view_from_obj(rank_obj(0, events, world=1))]
+        )
+        resize_step, clean_step = report["steps"][0], report["steps"][1]
+        assert resize_step["spans_resize"] is True
+        assert resize_step["critical_phase"] == "resize"
+        assert clean_step["spans_resize"] is False
+        assert clean_step["critical_phase"] != "resize"
 
 
 class TestExporterMembership:

@@ -1,0 +1,596 @@
+#!/usr/bin/env python
+"""Epoch-safe serving smoke lane: elastic autoscaling's chaos half
+(docs/serving.md "Autoscaling", docs/failure-semantics.md "serving
+epoch survival").
+
+Three phases over an N-rank (default 4) proc world driven through
+``native/runtime.py``'s ctypes surface plus the jax-free ``serving``
+pure core (stub-loaded, the tools/serving_smoke.py harness shape; the
+model is SIMULATED — one real native allreduce per decode step — the
+scheduler / plan-broadcast / reissue / autoscale machinery is the real
+thing).  All phases run under ``T4J_ELASTIC=rejoin`` (the serving
+phase of tools/elastic_smoke.py reuses kill-follower under
+``T4J_ELASTIC=shrink``) with a seeded Poisson ramp:
+
+  1. kill-follower — the driver SIGKILLs a non-leader rank mid-decode.
+                     Survivors must RIDE the resize: the leader waits
+                     it out, reissues every in-slot request, and keeps
+                     serving; the accounting invariant
+                     (queued + in_slots + done + shed + reissued ==
+                     submitted) must hold on every step of every
+                     epoch, every submitted request must complete, and
+                     ZERO aborts may fire.
+  2. kill-leader   — the driver SIGKILLs rank 0 itself.  The lowest
+                     surviving rank must PROMOTE: rebuild a scheduler
+                     from its follower mirror + retained prompts,
+                     reissue the in-flight requests, and drain them to
+                     completion as the new plan-stream root.
+  3. retire        — no faults: the leader's real Autoscaler decides a
+                     drain once the ramp ends, completions clamp, and
+                     the in-band plan retire flag walks the shrink
+                     cascade one rank per epoch (4 -> 3 -> 2); retired
+                     ranks exit rc 0 and the survivors finish on the
+                     halved world.
+
+Membership-history telemetry (world epoch / transitions) is asserted
+on every surviving rank — the epochs really happened.
+
+Run under AddressSanitizer by exporting ``T4J_SANITIZE=address``
+before invoking (tools/ci_smoke.sh does).
+
+Usage: python tools/autoscale_smoke.py [nprocs] [--phase NAME]
+"""
+
+import importlib
+import os
+import pathlib
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import types
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+RAISED = 23          # worker exit: fatal bridge error surfaced
+PHASES = ["kill-follower", "kill-leader", "retire"]
+
+SUM_OP = 0           # reductions.SUM's native opcode
+MAX_BATCH = 3
+MAX_LEN = 24
+D_SIM = 256          # simulated decode-activation floats per allreduce
+
+
+def _stub_packages():
+    for name in ("mpi4jax_tpu", "mpi4jax_tpu.utils",
+                 "mpi4jax_tpu.native"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [str(REPO / name.replace(".", "/"))]
+            sys.modules[name] = mod
+
+
+def _load(name):
+    try:
+        return importlib.import_module(name)
+    except Exception:
+        _stub_packages()
+        return importlib.import_module(name)
+
+
+def _sanitizer_env():
+    san = os.environ.get("T4J_SANITIZE", "").strip().lower()
+    if not san:
+        return {}
+    lib = {"address": "libasan.so", "asan": "libasan.so",
+           "1": "libasan.so", "thread": "libtsan.so",
+           "tsan": "libtsan.so"}.get(san)
+    if lib is None:
+        return {}
+    paths = []
+    for name in (lib, "libstdc++.so.6"):
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={name}"],
+            capture_output=True, text=True,
+        ).stdout.strip()
+        if out and out != name:
+            paths.append(out)
+    if not paths:
+        return {}
+    return {
+        "LD_PRELOAD": " ".join(paths),
+        "ASAN_OPTIONS": "detect_leaks=0:verify_asan_link_order=0",
+        "TSAN_OPTIONS": "report_bugs=1",
+    }
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ worker
+
+
+def worker():
+    import numpy as np
+
+    runtime = _load("mpi4jax_tpu.native.runtime")
+    config = _load("mpi4jax_tpu.utils.config")
+    serving = _load("mpi4jax_tpu.serving")
+
+    rank = int(os.environ["T4J_RANK"])
+    n = int(os.environ["T4J_SIZE"])
+    phase = os.environ["SMOKE_PHASE"]
+    ready_file = os.environ.get("SMOKE_READY_FILE")
+
+    lib = runtime._load()
+    lib.t4j_set_timeouts(config.op_timeout(), config.connect_timeout())
+    rc = lib.t4j_init()
+    assert rc == 0, (rc, runtime.last_error())
+
+    plan_words = serving.plan_words(MAX_BATCH, MAX_LEN)
+    t0 = time.perf_counter()
+    now_ms = lambda: (time.perf_counter() - t0) * 1e3  # noqa: E731
+    epochs_seen = set()
+
+    def bcast_plan(vec_or_none):
+        if vec_or_none is None:
+            buf = np.zeros(plan_words, np.int64)
+        else:
+            buf = np.asarray(vec_or_none, np.int64)
+        return runtime.host_bcast(0, buf, 0)
+
+    def simulate_decode(n_active):
+        x = np.full(D_SIM * max(1, n_active), 1.0 + rank, np.float32)
+        out = runtime.host_allreduce(0, x, SUM_OP)
+        time.sleep(0.004)
+        return out
+
+    def is_resize(exc):
+        return (isinstance(exc, runtime.WorldResized)
+                or "ResizeInterrupted" in str(exc)
+                or "world resized" in str(exc))
+
+    def ride():
+        """The engine's epoch-survival choreography, minus the model
+        resharding: settle, swallow the pending WorldResized, and
+        report who is left."""
+        assert runtime.resize_wait(60.0), "resize did not settle"
+        try:
+            runtime.check_health()
+        except runtime.WorldResized:
+            pass
+        alive = runtime.alive_ranks()
+        assert alive and rank in alive, (rank, alive)
+        epochs_seen.add(runtime.world_info()["epoch"])
+        return alive
+
+    def mark_ready():
+        if ready_file:
+            pathlib.Path(f"{ready_file}.{rank}").touch()
+
+    def alive_count():
+        info = runtime.world_info()
+        return info["alive_count"] if info else n
+
+    # -- leader (rank 0, or a promoted successor) ---------------------
+
+    def leader_loop(sched, stats, gen, scaler, horizon_ms):
+        """Serve until the load is drained (and any autoscale shrink
+        cascade has finished), checking the accounting invariant every
+        step; returns the completed rids in completion order."""
+        completions = []
+        retire_queue = []
+        retire_inflight = None  # delivered, waiting for its resize
+        steps = 0
+        marked = False
+        while True:
+            now = now_ms()
+            assert now < 120_000, "leader made no progress in 120s"
+            if gen is not None and now < horizon_ms:
+                for req in gen.until(now):
+                    stats.observe_submitted()
+                    sched.submit(req, now)
+            if scaler is not None and now >= horizon_ms and sched.idle():
+                # decision windows start once the ramp is served out:
+                # occupancy 0 below the threshold -> drain -> shrink
+                if scaler.state == "draining":
+                    dec = scaler.drain_complete()
+                    retire_queue = list(dec.victims)
+                    print(f"SMOKE-DRAIN victims={dec.victims}",
+                          flush=True)
+                else:
+                    scaler.observe(
+                        predicted_wait_ms=0.0, budget_ms=1e9,
+                        occupancy=0.0, world=alive_count(),
+                    )
+            # with a scaler, "idle" alone is not done — that is its
+            # INITIAL state; stop only once a shrink actually landed
+            scaler_done = scaler is None or (
+                scaler.state == "idle"
+                and any(a == "commit" for _w, a, _r in scaler.history)
+            )
+            stop = (now >= horizon_ms and sched.idle()
+                    and not retire_queue and retire_inflight is None
+                    and scaler_done)
+            # one victim per epoch: never issue the next retire while
+            # the previous one's resize has yet to commit
+            retire = (retire_queue.pop(0)
+                      if retire_queue and not stop
+                      and retire_inflight is None else None)
+            digest = sched.state_digest()
+            plan = sched.plan_step(now)
+            try:
+                bcast_plan(serving.encode_plan(
+                    plan, MAX_BATCH, MAX_LEN, digest,
+                    stop=stop, retire=retire,
+                ))
+                if plan.decode_slots or plan.admissions:
+                    simulate_decode(len(plan.decode_slots))
+            except Exception as exc:
+                if not is_resize(exc):
+                    raise
+                alive = ride()
+                reissued = sched.reissue_inflight(now_ms())
+                stats.observe_reissued(len(reissued))
+                stats.observe_epoch()
+                if scaler is not None:
+                    scaler.resize_committed(len(alive))
+                for r in (retire, retire_inflight):
+                    if r is not None and r in alive:
+                        # interrupted before the retiree acted on it
+                        retire_queue.insert(0, r)
+                retire_inflight = None
+                sched.check_accounting()
+                print(f"SMOKE-RIDE epoch={max(epochs_seen)} "
+                      f"alive={len(alive)} reissued={len(reissued)}",
+                      flush=True)
+                continue
+            if retire is not None:
+                retire_inflight = retire
+            for slot, _req in plan.admissions:
+                sched.prefill_done(slot, now_ms())
+            sched.step_done(plan, now_ms())
+            for req in sched.finished:
+                completions.append(req.rid)
+                stats.observe_completed(req)
+            sched.finished.clear()
+            stats.observe_step(sched.queue_depth(), sched.occupancy())
+            sched.check_accounting()  # the invariant, every step
+            steps += 1
+            if (not marked and steps >= 3 and sched.occupancy() > 0):
+                mark_ready()
+                marked = True
+            if stop:
+                return completions
+
+    # -- follower -----------------------------------------------------
+
+    def follower_loop():
+        """Mirror the plan stream, retaining each admitted request's
+        prompt exactly so a promotion can rebuild a scheduler.
+        Returns ("promote", mirror, retained) when this rank becomes
+        the lowest survivor, else ("retired"|"stopped", done, None)."""
+        mirror = serving.scheduler.FollowerMirror(MAX_BATCH, MAX_LEN)
+        retained = {}
+        applied = 0
+        done = 0
+        marked = False
+        while True:
+            try:
+                vec = bcast_plan(None)
+                decoded = serving.decode_plan(
+                    vec, MAX_BATCH, MAX_LEN,
+                    expect_digest=mirror.state_digest(),
+                )
+                admitted, finished = mirror.apply(decoded)
+                if decoded["decode_slots"] or admitted:
+                    simulate_decode(len(decoded["decode_slots"]))
+            except Exception as exc:
+                if not is_resize(exc):
+                    raise
+                alive = ride()
+                if rank == min(alive):
+                    return "promote", mirror, retained
+                # the leader reissues and replans from scratch; a
+                # reset mirror matches its post-reissue (empty) digest
+                mirror.reset()
+                retained.clear()
+                continue
+            for slot, rid, prompt, mn in admitted:
+                retained[rid] = serving.plan.follower_request(
+                    rid, prompt, mn
+                )
+                fin = mirror.prefill_done(slot)
+                if fin is not None:
+                    done += 1
+                    retained.pop(fin[1], None)
+            for _slot, rid in finished:
+                done += 1
+                retained.pop(rid, None)
+            applied += 1
+            if not marked and applied >= 3 and mirror.rows():
+                mark_ready()
+                marked = True
+            if decoded.get("retire") == rank:
+                assert mirror.idle(), \
+                    "retired while the mirror still held slots"
+                return "retired", done, None
+            if decoded["stop"]:
+                assert mirror.idle(), \
+                    "follower mirror not drained at stop"
+                return "stopped", done, None
+
+    def print_epilogue(extra=""):
+        info = runtime.world_info()
+        print(
+            f"AUTOSCALE-OK {rank} epoch={info['epoch']} "
+            f"alive={info['alive_count']} "
+            f"transitions={info['epoch_transitions']}{extra}",
+            flush=True,
+        )
+
+    if rank == 0:
+        sched = serving.SlotScheduler(MAX_BATCH, MAX_LEN)
+        stats = serving.ServingStats(slo_ms=0.0, max_batch=MAX_BATCH,
+                                     admit_mode="off")
+        gen = serving.LoadGen(
+            seed=11, rate_rps=90.0, prompt_len=("uniform", 2, 8),
+            max_new=("uniform", 3, 10), vocab=64,
+        )
+        scaler = None
+        if phase == "retire":
+            scaler = serving.Autoscaler(
+                floor=max(2, n // 2), ceiling=n, up_windows=3,
+                down_occ=0.5, down_windows=2, cooldown_windows=1,
+            )
+        completions = leader_loop(sched, stats, gen, scaler,
+                                  horizon_ms=700.0)
+        sched.check_accounting()
+        snap = stats.snapshot()
+        assert snap["completed"] == snap["submitted"], snap
+        assert snap["shed"] == 0, snap
+        assert len(set(completions)) == len(completions), \
+            "a completion was delivered twice"
+        if scaler is not None:
+            assert scaler.state == "idle", scaler.state
+            acts = [a for _w, a, _r in scaler.history]
+            assert acts.count("drain") == 1 and "commit" in acts, acts
+        print(
+            f"SMOKE-ACCOUNTING-OK submitted={snap['submitted']} "
+            f"completed={snap['completed']} "
+            f"reissued={snap['reissued']} "
+            f"epochs={snap['epochs_survived']}",
+            flush=True,
+        )
+        print_epilogue()
+        lib.t4j_finalize()
+    else:
+        verdict, payload, retained = follower_loop()
+        if verdict == "promote":
+            mirror = payload
+            sched = serving.SlotScheduler(MAX_BATCH, MAX_LEN)
+            stats = serving.ServingStats(slo_ms=0.0,
+                                         max_batch=MAX_BATCH,
+                                         admit_mode="off")
+            now = now_ms()
+            rows = mirror.rows()
+            promoted = 0
+            for slot in sorted(rows):
+                rid = rows[slot][0]
+                req = retained.pop(rid, None)
+                if req is None:
+                    continue
+                req.arrival_ms = now
+                req.reissues += 1
+                stats.observe_submitted()
+                sched.submit(req, now)
+                promoted += 1
+            stats.observe_reissued(promoted)
+            stats.observe_epoch()
+            print(f"SMOKE-PROMOTED {rank} reissued={promoted}",
+                  flush=True)
+            completions = leader_loop(sched, stats, gen=None,
+                                      scaler=None, horizon_ms=0.0)
+            sched.check_accounting()
+            snap = stats.snapshot()
+            assert snap["completed"] == snap["submitted"], snap
+            print(
+                f"SMOKE-ACCOUNTING-OK submitted={snap['submitted']} "
+                f"completed={snap['completed']} "
+                f"reissued={snap['reissued']} "
+                f"epochs={snap['epochs_survived']}",
+                flush=True,
+            )
+            print_epilogue(" promoted=1")
+            lib.t4j_finalize()
+        elif verdict == "retired":
+            # exit WITHOUT finalize: the closed sockets are the shrink
+            # signal the survivors ride (what a retired engine rank
+            # does when run_follower returns)
+            print(f"SMOKE-RETIRED {rank} completions={payload}",
+                  flush=True)
+            sys.exit(0)
+        else:
+            print_epilogue(f" completions={payload}")
+            lib.t4j_finalize()
+
+
+# ------------------------------------------------------------------ driver
+
+
+def run_phase(phase, n, elastic="rejoin"):
+    victim = {"kill-follower": 2, "kill-leader": 0,
+              "retire": None}[phase]
+    coord = f"127.0.0.1:{_free_port()}"
+    ready_dir = tempfile.mkdtemp(prefix="t4j-autoscale-")
+    ready = os.path.join(ready_dir, "ready")
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.update(
+            T4J_RANK=str(r), T4J_SIZE=str(n), T4J_COORD=coord,
+            T4J_NO_SHM="1", SMOKE_PHASE=phase,
+            SMOKE_READY_FILE=ready,
+            T4J_ELASTIC=elastic, T4J_MIN_WORLD="2",
+            # tight test-sized ladder (the elastic_smoke settings)
+            T4J_CONNECT_TIMEOUT="6", T4J_OP_TIMEOUT="30",
+            T4J_RETRY_MAX="2", T4J_BACKOFF_BASE="0.05",
+            T4J_BACKOFF_MAX="0.3", T4J_RESIZE_TIMEOUT="10",
+            T4J_RING_MIN_BYTES="0", T4J_SEG_BYTES="8192",
+            T4J_TELEMETRY="counters",
+        )
+        env.update(_sanitizer_env())
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__, "worker"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+
+    killed = False
+    if victim is not None:
+        # SIGKILL mid-decode: the victim touches its ready file once
+        # it has served >= 3 steps WITH occupied slots, so the kill
+        # lands while requests are in flight
+        deadline = time.monotonic() + 180
+        path = f"{ready}.{victim}"
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                time.sleep(0.1)  # a few more steps into the stream
+                os.kill(procs[victim].pid, signal.SIGKILL)
+                killed = True
+                break
+            if procs[victim].poll() is not None:
+                break  # died on its own: the reap below reports it
+            time.sleep(0.01)
+        if not killed:
+            print(f"FAIL: victim {victim} never reached mid-decode")
+
+    ok = victim is None or killed
+    outs = []
+    rcs = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        rcs.append(p.returncode)
+        print(f"--- [{phase}] rank {r} (rc={p.returncode}) ---")
+        print(out[-2500:])
+
+    survivors = [r for r in range(n) if r != victim]
+    surv_blob = "\n".join(outs[r] for r in survivors)
+
+    def accounting(blob):
+        m = re.search(
+            r"SMOKE-ACCOUNTING-OK submitted=(\d+) completed=(\d+) "
+            r"reissued=(\d+) epochs=(\d+)", blob)
+        return [int(g) for g in m.groups()] if m else None
+
+    if "escalating to abort" in surv_blob:
+        ok = False
+        print("FAIL: an abort fired during an elastic serving epoch")
+    for r in survivors:
+        if rcs[r] != 0:
+            ok = False
+            print(f"FAIL: rank {r} rc={rcs[r]} (want 0)")
+
+    if phase == "kill-follower":
+        if victim is not None and rcs[victim] != -signal.SIGKILL:
+            ok = False
+            print(f"FAIL: victim rc={rcs[victim]} (want SIGKILL)")
+        acct = accounting(outs[0])
+        if acct is None:
+            ok = False
+            print("FAIL: the leader never proved its accounting")
+        else:
+            _sub, _comp, reissued, epochs = acct
+            if reissued < 1:
+                ok = False
+                print("FAIL: the mid-decode kill reissued nothing")
+            if epochs < 1:
+                ok = False
+                print("FAIL: the leader survived zero epochs")
+        if not re.search(r"AUTOSCALE-OK \d+ epoch=[1-9]", surv_blob):
+            ok = False
+            print("FAIL: no survivor reported a bumped world epoch")
+        if "transitions=0" in surv_blob.replace("transitions=0\n", ""):
+            pass  # per-rank transition counts asserted via epoch=
+    elif phase == "kill-leader":
+        if victim is not None and rcs[victim] != -signal.SIGKILL:
+            ok = False
+            print(f"FAIL: victim rc={rcs[victim]} (want SIGKILL)")
+        successor = min(survivors)
+        if f"SMOKE-PROMOTED {successor}" not in outs[successor]:
+            ok = False
+            print(f"FAIL: rank {successor} never promoted")
+        acct = accounting(outs[successor])
+        if acct is None:
+            ok = False
+            print("FAIL: the promoted leader never proved accounting")
+        elif acct[2] < 1:
+            ok = False
+            print("FAIL: promotion reissued no in-flight requests")
+        if "promoted=1" not in outs[successor]:
+            ok = False
+            print("FAIL: the successor's epilogue is missing")
+    elif phase == "retire":
+        retired = sorted(
+            int(m) for m in re.findall(r"SMOKE-RETIRED (\d+)",
+                                       "\n".join(outs))
+        )
+        want = sorted(range(max(2, n // 2), n))
+        if retired != want:
+            ok = False
+            print(f"FAIL: retired {retired}, want {want}")
+        if "SMOKE-DRAIN" not in outs[0]:
+            ok = False
+            print("FAIL: the autoscaler never decided a drain")
+        m = re.search(r"AUTOSCALE-OK 0 epoch=(\d+) alive=(\d+)",
+                      outs[0])
+        if not m or int(m.group(2)) != max(2, n // 2):
+            ok = False
+            print("FAIL: the world never reached the shrink target")
+        elif int(m.group(1)) != n - max(2, n // 2):
+            ok = False
+            print("FAIL: the cascade did not commit one epoch per rank")
+        if accounting(outs[0]) is None:
+            ok = False
+            print("FAIL: the leader never proved its accounting")
+    return ok
+
+
+def main():
+    argv = list(sys.argv[1:])
+    phases = list(PHASES)
+    if "--phase" in argv:
+        i = argv.index("--phase")
+        phases = [argv[i + 1]]
+        del argv[i:i + 2]
+    args = [a for a in argv if not a.startswith("--")]
+    n = int(args[0]) if args else 4
+    ok = True
+    for phase in phases:
+        print(f"=== autoscale phase: {phase} (n={n}) ===", flush=True)
+        if not run_phase(phase, n):
+            ok = False
+            print(f"=== phase {phase} FAILED ===")
+        else:
+            print(f"=== phase {phase} ok ===")
+    print("AUTOSCALE-SMOKE-OK" if ok else "AUTOSCALE-SMOKE-FAILED")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker()
+    else:
+        main()

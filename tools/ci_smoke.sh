@@ -145,6 +145,23 @@
 #                phase must complete everything with zero sheds.
 #                ctypes + the jax-free serving pure core only — runs
 #                on old-jax containers.
+#  19. autoscale — tools/autoscale_smoke.py twice: plain and under
+#                AddressSanitizer.  Epoch-safe elastic serving
+#                (docs/failure-semantics.md "serving epoch survival",
+#                docs/serving.md "Autoscaling"): a 4-rank seeded
+#                Poisson ramp survives a mid-decode SIGKILL of a
+#                FOLLOWER (the leader rides the resize and reissues
+#                every in-flight request) and of the LEADER itself
+#                (the lowest survivor promotes from its plan mirror
+#                and drains the reissued requests), with the
+#                accounting invariant (queued + in_slots + done +
+#                shed + reissued == submitted) checked on every step
+#                of every epoch and zero aborts; then a no-fault
+#                phase where the real Autoscaler decides a
+#                drain-then-shrink and the in-band plan retire flag
+#                walks the cascade one rank per epoch (4 -> 3 -> 2),
+#                retirees exiting rc 0.  ctypes + the jax-free
+#                serving pure core only — runs on old-jax containers.
 #  13. autotune — tools/autotune_smoke.py twice: plain and under
 #                AddressSanitizer.  An 8-rank calibrate phase (the
 #                collective knob fit measured through the telemetry
@@ -184,7 +201,7 @@ lanes=("$@")
 if [ ${#lanes[@]} -eq 0 ]; then
   lanes=(tier1 fault proc asan tsan lint verify resilience telemetry
          async diagnose bench elastic autotune postmortem stripe
-         serving compress uring)
+         serving autoscale compress uring)
 fi
 
 run_lane() {
@@ -307,6 +324,12 @@ assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
       run_lane serving-asan env T4J_SANITIZE=address timeout -k 10 900 \
         python tools/serving_smoke.py 8
       ;;
+    autoscale)
+      run_lane autoscale-plain env -u T4J_SANITIZE timeout -k 10 1200 \
+        python tools/autoscale_smoke.py 4
+      run_lane autoscale-asan env T4J_SANITIZE=address timeout -k 10 1800 \
+        python tools/autoscale_smoke.py 4
+      ;;
     compress)
       run_lane compress-plain env -u T4J_SANITIZE timeout -k 10 1200 \
         python tools/compress_smoke.py 8
@@ -322,7 +345,7 @@ assert rec.get("metric"), rec; print("BENCH record ok:", rec["metric"])'
         python tools/uring_smoke.py 4
       ;;
     *)
-      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem|stripe|serving|compress|uring)" >&2
+      echo "unknown lane: $lane (want tier1|fault|proc|asan|tsan|lint|resilience|telemetry|async|diagnose|bench|elastic|autotune|postmortem|stripe|serving|autoscale|compress|uring)" >&2
       exit 2
       ;;
   esac

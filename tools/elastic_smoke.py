@@ -30,6 +30,15 @@ lane runs on old-jax containers and under sanitizer preloads alike):
                    token, the world grows back to N at epoch 2, and
                    EVERY member (replacement included) completes
                    collectives on the regrown world.
+  6. serving     — tools/autoscale_smoke.py's kill-follower phase
+                   under T4J_ELASTIC=shrink: a 4-rank continuous-
+                   batching serving loop loses a follower to SIGKILL
+                   mid-decode; the leader must ride the resize,
+                   reissue the lost in-flight requests and complete
+                   every submitted request with the accounting
+                   invariant holding at every epoch — zero aborts
+                   (docs/failure-semantics.md "serving epoch
+                   survival").
 
 Run under AddressSanitizer by exporting ``T4J_SANITIZE=address``
 before invoking (tools/ci_smoke.sh does).
@@ -54,7 +63,8 @@ RAISED = 23          # worker exit: fatal bridge error surfaced
 DIED = 42            # the die_after victim's exit code
 GOAL = 6             # successful collectives required at the target epoch
 COUNT = 16 * 1024    # f64 elements per allreduce (128 KB)
-PHASES = ["shrink", "shrink-tcp", "min-world", "off", "rejoin"]
+PHASES = ["shrink", "shrink-tcp", "min-world", "off", "rejoin",
+          "serving"]
 
 
 def _load_build_module():
@@ -280,6 +290,15 @@ def _spawn(so, rank, n, coord, job, extra_env):
 
 
 def run_phase(phase, n, so):
+    if phase == "serving":
+        # kill-during-decode with T4J_ELASTIC=shrink: delegate to the
+        # serving chaos harness (same directory), which spawns its own
+        # 4-rank world and sanitizer env
+        spec = importlib.util.spec_from_file_location(
+            "autoscale_smoke", REPO / "tools" / "autoscale_smoke.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.run_phase("kill-follower", 4, elastic="shrink")
     victim = 3
     coord = f"127.0.0.1:{_free_port()}"
     job = uuid.uuid4().hex[:8]
@@ -426,7 +445,7 @@ def main():
     so = str(build.ensure_built())
     ok = True
     for phase in phases:
-        pn = 4 if phase == "min-world" else n
+        pn = 4 if phase in ("min-world", "serving") else n
         print(f"=== elastic phase: {phase} (n={pn}) ===", flush=True)
         if not run_phase(phase, pn, so):
             ok = False
